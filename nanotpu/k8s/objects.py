@@ -1,0 +1,232 @@
+"""Thin typed wrappers over Kubernetes object JSON.
+
+The kube-scheduler extender protocol POSTs full ``corev1.Pod`` / node JSON at
+us (reference decodes into client-go structs, ``pkg/routes/routes.go:40-89``).
+We have no client-go; instead each wrapper holds the raw decoded dict and
+exposes the handful of fields the scheduler needs, preserving every unknown
+field byte-for-byte so optimistic-concurrency updates round-trip cleanly.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Iterator
+
+#: Kubernetes quantity suffixes that yield integral values. Extended
+#: resources must be whole integers, so milli ("100m") and other fractional
+#: forms are invalid for us and parse to None.
+_QUANTITY_SUFFIXES = {
+    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+}
+
+
+def parse_quantity(val: Any) -> int | None:
+    """Parse a k8s resource quantity into a whole integer, else None.
+
+    The reference relied on ``resource.Quantity.Value()`` via client-go; we
+    accept plain ints and the integral SI/binary suffixes k8s allows for
+    extended resources (e.g. ``"1k"`` == 1000).
+    """
+    if val is None:
+        return None
+    if isinstance(val, int):
+        return val
+    s = str(val).strip()
+    if not s:
+        return None
+    for suffix, mult in sorted(_QUANTITY_SUFFIXES.items(), key=lambda kv: -len(kv[0])):
+        if s.endswith(suffix):
+            s = s[: -len(suffix)]
+            try:
+                return int(s) * mult
+            except ValueError:
+                return None
+    try:
+        return int(s)
+    except ValueError:
+        return None
+
+
+class K8sObject:
+    """Base wrapper: raw dict + metadata accessors.
+
+    Read accessors never mutate ``raw`` (a predicate call must not change the
+    serialized object); writers go through the ``ensure_*`` helpers.
+    """
+
+    def __init__(self, raw: dict[str, Any] | None = None):
+        self.raw: dict[str, Any] = raw if raw is not None else {}
+
+    # -- metadata (read-only views; absent fields read as empty) -----------
+    @property
+    def metadata(self) -> dict[str, Any]:
+        return self.raw.get("metadata") or {}
+
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.get("namespace", "default")
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.get("uid", "")
+
+    @property
+    def resource_version(self) -> str:
+        return self.metadata.get("resourceVersion", "")
+
+    @property
+    def labels(self) -> dict[str, str]:
+        return self.metadata.get("labels") or {}
+
+    @property
+    def annotations(self) -> dict[str, str]:
+        return self.metadata.get("annotations") or {}
+
+    @property
+    def deletion_timestamp(self) -> str | None:
+        return self.metadata.get("deletionTimestamp")
+
+    # -- write paths -------------------------------------------------------
+    def ensure_metadata(self) -> dict[str, Any]:
+        return self.raw.setdefault("metadata", {})
+
+    def ensure_labels(self) -> dict[str, str]:
+        return self.ensure_metadata().setdefault("labels", {})
+
+    def ensure_annotations(self) -> dict[str, str]:
+        return self.ensure_metadata().setdefault("annotations", {})
+
+    def deepcopy(self):
+        return type(self)(copy.deepcopy(self.raw))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"{type(self).__name__}({self.namespace}/{self.name})"
+
+
+class Container:
+    def __init__(self, raw: dict[str, Any]):
+        self.raw = raw
+
+    @property
+    def name(self) -> str:
+        return self.raw.get("name", "")
+
+    def limit(self, resource: str) -> int:
+        """Integer resource limit, 0 when absent/unparsable.
+
+        Reference reads limits the same way (pkg/utils/pod.go:50-58), via
+        client-go quantity parsing; see :func:`parse_quantity`.
+        """
+        limits = (self.raw.get("resources") or {}).get("limits") or {}
+        return parse_quantity(limits.get(resource)) or 0
+
+
+class Pod(K8sObject):
+    @property
+    def spec(self) -> dict[str, Any]:
+        return self.raw.get("spec") or {}
+
+    @property
+    def status(self) -> dict[str, Any]:
+        return self.raw.get("status") or {}
+
+    @property
+    def node_name(self) -> str:
+        return self.spec.get("nodeName", "")
+
+    @property
+    def phase(self) -> str:
+        return self.status.get("phase", "")
+
+    @property
+    def containers(self) -> list[Container]:
+        return [Container(c) for c in self.spec.get("containers", [])]
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class Node(K8sObject):
+    @property
+    def status(self) -> dict[str, Any]:
+        return self.raw.get("status") or {}
+
+    def capacity(self, resource: str) -> int:
+        cap = self.status.get("capacity") or {}
+        val = cap.get(resource)
+        if val is None:
+            # Fall back to allocatable, as kubelet publishes both.
+            val = (self.status.get("allocatable") or {}).get(resource)
+        return parse_quantity(val) or 0
+
+
+def make_pod(
+    name: str,
+    namespace: str = "default",
+    uid: str = "",
+    containers: list[dict[str, Any]] | None = None,
+    annotations: dict[str, str] | None = None,
+    labels: dict[str, str] | None = None,
+    node_name: str = "",
+    phase: str = "Pending",
+) -> Pod:
+    """Fixture-style constructor (the reference's tests build v1.Pod the same
+    way — ``pkg/dealer/allocate_test.go:88-122``)."""
+    raw: dict[str, Any] = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "uid": uid or f"uid-{namespace}-{name}",
+            "annotations": dict(annotations or {}),
+            "labels": dict(labels or {}),
+            "resourceVersion": "1",
+        },
+        "spec": {"containers": containers or []},
+        "status": {"phase": phase},
+    }
+    if node_name:
+        raw["spec"]["nodeName"] = node_name
+    return Pod(raw)
+
+
+def make_container(name: str, limits: dict[str, Any] | None = None) -> dict[str, Any]:
+    c: dict[str, Any] = {"name": name}
+    if limits:
+        c["resources"] = {"limits": {k: str(v) for k, v in limits.items()}}
+    return c
+
+
+def make_node(
+    name: str,
+    capacity: dict[str, Any] | None = None,
+    labels: dict[str, str] | None = None,
+) -> Node:
+    return Node(
+        {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": {
+                "name": name,
+                "uid": f"uid-node-{name}",
+                "labels": dict(labels or {}),
+                "annotations": {},
+                "resourceVersion": "1",
+            },
+            "status": {
+                "capacity": {k: str(v) for k, v in (capacity or {}).items()},
+                "allocatable": {k: str(v) for k, v in (capacity or {}).items()},
+            },
+        }
+    )
+
+
+def iter_pods(objs: list[dict[str, Any]]) -> Iterator[Pod]:
+    for o in objs:
+        yield Pod(o)

@@ -1,0 +1,114 @@
+"""Resource-model vocabulary for the TPU-native scheduler extender.
+
+This is the rebuild of the reference's resource constants
+(``pkg/types/types.go:7-21``), re-designed for Cloud TPU:
+
+* the NVIDIA-specific ``nano-gpu/gpu-percent`` extended resource becomes a TPU
+  triple — fractional **chip** percent (primary, 100 == one physical chip),
+  plus optional **tensorcore** and **HBM** resources for finer SLO shaping;
+* the per-container card-index annotation (``nano-gpu/container-<name>`` →
+  single card int, ``pkg/types/types.go:15``) becomes a per-container *chip id
+  list* annotation, because topology-aware plans may span several ICI-adjacent
+  chips;
+* new topology vocabulary (node labels describing the slice torus) that has no
+  reference analogue — the reference models a flat card array
+  (``pkg/dealer/allocate.go:90``), we model chips on an ICI torus.
+
+Everything here is pure data: no I/O, no k8s client types.
+"""
+
+from __future__ import annotations
+
+# --------------------------------------------------------------------------
+# Extended resource names (pod spec ``resources.limits`` keys).
+# Reference: ResourceGPUPercent = "nano-gpu/gpu-percent" (pkg/types/types.go:9).
+# --------------------------------------------------------------------------
+
+#: Primary schedulable resource: percent of one TPU chip. 100 units == 1 chip.
+#: Values > 100 mean "multiple whole chips" (e.g. 400 == a 4-chip sub-slice);
+#: values < 100 mean a fractional (time-shared) chip, enforced by the agent.
+RESOURCE_TPU_PERCENT = "tpu.io/chip-percent"
+
+#: Optional secondary resources (advertised by the agent, used for demand
+#: shaping; the extender schedules on chip-percent, these ride along).
+RESOURCE_TPU_TENSORCORE = "tpu.io/tensorcore"
+RESOURCE_TPU_HBM = "tpu.io/hbm-mib"
+
+#: Units of chip-percent that equal one physical chip.
+#: Reference: GPUPercentEachCard = 100 (pkg/types/types.go:10).
+PERCENT_PER_CHIP = 100
+
+# --------------------------------------------------------------------------
+# Pod annotations / labels written at Bind time and consumed by the agent.
+# Reference: pkg/types/types.go:12-15.
+# --------------------------------------------------------------------------
+
+#: Annotation AND label marking a pod as assumed (placement decided).
+#: Reference: AnnotationGPUAssume = "nano-gpu/assume" (pkg/types/types.go:13).
+ANNOTATION_ASSUME = "tpu.io/assume"
+
+#: Per-container chip assignment annotation, format string over container name.
+#: Value is a comma-separated ascending list of chip ids on the node
+#: (e.g. "0" or "0,1,2,3"), or NOT_NEED_TPU's string for zero-request
+#: containers. Reference: AnnotationGPUContainerOn = "nano-gpu/container-%s"
+#: (pkg/types/types.go:15) whose value was a single card index.
+ANNOTATION_CONTAINER_FMT = "tpu.io/container-{name}"
+
+#: Annotation recording which placement policy bound the pod (debuggability;
+#: no reference analogue).
+ANNOTATION_BOUND_POLICY = "tpu.io/bound-by"
+
+# --------------------------------------------------------------------------
+# Node labels/annotations describing TPU topology (new; no reference analogue —
+# the reference only reads node capacity, pkg/utils/node.go:8-14).
+# --------------------------------------------------------------------------
+
+#: Node label gating metric sync / TPU handling. Replaces the reference's
+#: NVIDIA-specific gate label "nvidia-device-enable=enable"
+#: (pkg/controller/node.go:154) — a documented portability bug.
+LABEL_TPU_ENABLE = "tpu.io/device-enable"
+LABEL_TPU_ENABLE_VALUE = "enable"
+
+#: TPU generation of the node's chips, e.g. "v4", "v5p", "v5e", "v6e".
+LABEL_TPU_GENERATION = "tpu.io/generation"
+
+#: Topology of the node's local chip group as "XxYxZ", e.g. "2x2x1".
+LABEL_TPU_TOPOLOGY = "tpu.io/topology"
+
+#: This node's host coordinates inside its slice torus, "x,y,z".
+#: Used for multi-node gang placement (ICI adjacency across hosts).
+LABEL_TPU_SLICE_COORDS = "tpu.io/slice-coords"
+
+#: Name of the multi-host slice this node belongs to (ICI domain id).
+#: Hosts in different slices only reach each other over DCN.
+LABEL_TPU_SLICE = "tpu.io/slice"
+
+# --------------------------------------------------------------------------
+# Gang scheduling (new capability; BASELINE configs 3-4 need co-scheduling).
+# --------------------------------------------------------------------------
+
+#: Pods sharing this annotation value form a gang (e.g. one JAX job).
+ANNOTATION_GANG_NAME = "tpu.io/gang-name"
+
+#: Total number of pods in the gang (int as string).
+ANNOTATION_GANG_SIZE = "tpu.io/gang-size"
+
+# --------------------------------------------------------------------------
+# Placement-policy names (CLI flag values).
+# Reference: PriorityBinPack/PrioritySpread (pkg/types/types.go:18-21);
+# README.md:14 also advertises "random" which the reference never shipped.
+# --------------------------------------------------------------------------
+
+POLICY_BINPACK = "binpack"
+POLICY_SPREAD = "spread"
+POLICY_RANDOM = "random"
+
+#: Sentinel chip id for containers that request no TPU.
+#: Reference: NotNeedGPU = -1 (pkg/dealer/allocate.go:15).
+NOT_NEED_TPU = -1
+
+#: Score range the kube-scheduler extender protocol expects. The reference's
+#: raters could leak outside this range (pkg/dealer/rater.go:69,122) — ours
+#: clamp (see allocator.rater).
+SCORE_MIN = 0
+SCORE_MAX = 100

@@ -1,0 +1,36 @@
+"""Node-level helpers (rebuild of ``pkg/utils/node.go``)."""
+
+from __future__ import annotations
+
+from nanotpu import types
+from nanotpu.k8s.objects import Node
+
+
+def get_chip_count(node: Node) -> int:
+    """Number of physical chips = capacity / 100 (pkg/utils/node.go:8-14)."""
+    return node.capacity(types.RESOURCE_TPU_PERCENT) // types.PERCENT_PER_CHIP
+
+
+def is_tpu_node(node: Node) -> bool:
+    return get_chip_count(node) > 0
+
+
+def is_tpu_enabled(node: Node) -> bool:
+    """Metric-sync gate. Replaces the reference's NVIDIA-specific
+    ``nvidia-device-enable=enable`` label check (pkg/controller/node.go:153-158);
+    we additionally treat any node with TPU capacity as enabled so a missing
+    label never silently disables load-aware scheduling."""
+    if node.labels.get(types.LABEL_TPU_ENABLE) == types.LABEL_TPU_ENABLE_VALUE:
+        return True
+    return is_tpu_node(node)
+
+
+def node_topology_labels(node: Node) -> dict[str, str]:
+    """The topology-bearing labels, for logging/diagnostics."""
+    keys = (
+        types.LABEL_TPU_GENERATION,
+        types.LABEL_TPU_TOPOLOGY,
+        types.LABEL_TPU_SLICE,
+        types.LABEL_TPU_SLICE_COORDS,
+    )
+    return {k: node.labels[k] for k in keys if k in node.labels}

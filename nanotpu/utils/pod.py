@@ -1,0 +1,140 @@
+"""Pod-level helpers: lifecycle predicates and the annotation codec.
+
+Rebuild of ``pkg/utils/pod.go`` with the TPU vocabulary. Key differences from
+the reference, each deliberate:
+
+* chip assignments are *lists* of chip ids per container (topology plans can
+  span chips), vs the reference's single card index (pkg/utils/pod.go:85-92);
+* ``get_assigned_chips`` reads EVERY container's annotation — the reference's
+  ``GetGPUIDFromAnnotation`` only read ``Containers[0]`` (pkg/utils/pod.go:34),
+  a documented bug we do not replicate.
+"""
+
+from __future__ import annotations
+
+from nanotpu import types
+from nanotpu.k8s.objects import Pod
+
+
+# -- lifecycle predicates (pkg/utils/pod.go:15-29) -------------------------
+
+def is_completed_pod(pod: Pod) -> bool:
+    """Deleted, Succeeded, or Failed (pkg/utils/pod.go:15-24)."""
+    if pod.deletion_timestamp:
+        return True
+    return pod.phase in ("Succeeded", "Failed")
+
+
+def is_tpu_sharing_pod(pod: Pod) -> bool:
+    """Pod requests any tpu.io/chip-percent (pkg/utils/pod.go:27-29)."""
+    return get_tpu_percent_from_pod(pod) > 0
+
+
+def is_assumed(pod: Pod) -> bool:
+    """Bind already stamped the assume annotation (pkg/utils/pod.go:81-83)."""
+    return pod.annotations.get(types.ANNOTATION_ASSUME) == "true"
+
+
+# -- demand readers (pkg/utils/pod.go:50-58,94-100) ------------------------
+
+def get_tpu_percent_from_container(container) -> int:
+    return container.limit(types.RESOURCE_TPU_PERCENT)
+
+
+def get_tpu_percent_from_pod(pod: Pod) -> int:
+    return sum(get_tpu_percent_from_container(c) for c in pod.containers)
+
+
+# -- annotation codec ------------------------------------------------------
+
+def encode_chips(chips: list[int]) -> str:
+    """Chip id list -> annotation value ("0,1,2,3"; "-1" for no-TPU)."""
+    if not chips:
+        return str(types.NOT_NEED_TPU)
+    return ",".join(str(c) for c in sorted(chips))
+
+
+def decode_chips(value: str) -> list[int] | None:
+    """Annotation value -> chip id list.
+
+    The "-1" sentinel decodes to [] (container legitimately owns no chips);
+    a corrupted/unparsable value decodes to None so callers can tell
+    corruption apart from "no chips" and keep the pod's chips accounted for
+    (the reference's GetGPUIDFromAnnotation likewise surfaced parse errors,
+    pkg/utils/pod.go:32-48).
+    """
+    try:
+        ids = [int(p) for p in value.split(",")]
+    except ValueError:
+        return None
+    if ids == [types.NOT_NEED_TPU]:
+        return []
+    if not ids or any(i < 0 for i in ids):
+        return None
+    return sorted(set(ids))
+
+
+def annotated_pod(pod: Pod, assignments: dict[str, list[int]], policy: str = "") -> Pod:
+    """Return a deep-copied pod stamped with the placement decision.
+
+    Mirrors ``GetUpdatedPodAnnotationSpec`` (pkg/utils/pod.go:65-79): one
+    annotation per container plus the assume annotation AND label.
+
+    Raises ValueError if a TPU-requesting container has no assignment —
+    stamping the no-TPU sentinel for it would bind a pod the agent then
+    grants nothing, an invisible failure until the workload crashes.
+    """
+    out = pod.deepcopy()
+    ann = out.ensure_annotations()
+    for c in out.containers:
+        if get_tpu_percent_from_container(c) > 0 and not assignments.get(c.name):
+            raise ValueError(
+                f"container {c.name!r} requests TPU but has no chip assignment"
+            )
+        key = types.ANNOTATION_CONTAINER_FMT.format(name=c.name)
+        ann[key] = encode_chips(assignments.get(c.name, []))
+    ann[types.ANNOTATION_ASSUME] = "true"
+    if policy:
+        ann[types.ANNOTATION_BOUND_POLICY] = policy
+    out.ensure_labels()[types.ANNOTATION_ASSUME] = "true"
+    return out
+
+
+def get_container_assigned_chips(pod: Pod, container_name: str) -> list[int] | None:
+    """Parse one container's assignment back (pkg/utils/pod.go:85-92).
+
+    Returns None when the annotation is absent (pod not bound by us).
+    """
+    key = types.ANNOTATION_CONTAINER_FMT.format(name=container_name)
+    value = pod.annotations.get(key)
+    if value is None:
+        return None
+    return decode_chips(value)
+
+
+def get_assigned_chips(pod: Pod) -> dict[str, list[int]] | None:
+    """All containers' assignments, or None if any annotation is missing.
+
+    Fixes the reference's first-container-only bug (pkg/utils/pod.go:32-48).
+    """
+    out: dict[str, list[int]] = {}
+    for c in pod.containers:
+        chips = get_container_assigned_chips(pod, c.name)
+        if chips is None:
+            return None
+        out[c.name] = chips
+    return out
+
+
+# -- gang helpers (new; BASELINE configs 3-4) ------------------------------
+
+def gang_of(pod: Pod) -> tuple[str, int] | None:
+    """(gang name, size) if the pod declares gang membership, else None."""
+    name = pod.annotations.get(types.ANNOTATION_GANG_NAME)
+    if not name:
+        return None
+    try:
+        size = int(pod.annotations.get(types.ANNOTATION_GANG_SIZE, "0"))
+    except ValueError:
+        size = 0
+    return name, max(size, 0)

@@ -1,0 +1,20 @@
+"""Test harness config.
+
+All tests run on CPU with a virtual 8-device mesh so multi-chip sharding is
+exercised without TPU hardware (the driver separately dry-run-compiles the
+multi-chip path via __graft_entry__.dryrun_multichip).
+
+These env vars must be set before jax initializes, hence conftest.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

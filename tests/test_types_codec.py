@@ -1,0 +1,151 @@
+"""Resource vocabulary + annotation codec tests.
+
+Mirrors the role of the reference's fixture-driven round-trip tests
+(pkg/dealer/allocate_test.go:88-158): fake the K8s objects, not the K8s API.
+"""
+
+from nanotpu import types
+from nanotpu.k8s.objects import make_container, make_node, make_pod
+from nanotpu.utils import node as nodeutil
+from nanotpu.utils import pod as podutil
+
+
+def tpu_pod(name="p1", percents=(20,), **kw):
+    containers = [
+        make_container(f"c{i}", {types.RESOURCE_TPU_PERCENT: p} if p else None)
+        for i, p in enumerate(percents)
+    ]
+    return make_pod(name, containers=containers, **kw)
+
+
+class TestPredicates:
+    def test_completed_pod(self):
+        assert podutil.is_completed_pod(tpu_pod(phase="Succeeded"))
+        assert podutil.is_completed_pod(tpu_pod(phase="Failed"))
+        assert not podutil.is_completed_pod(tpu_pod(phase="Running"))
+        p = tpu_pod(phase="Running")
+        p.metadata["deletionTimestamp"] = "2026-07-29T00:00:00Z"
+        assert podutil.is_completed_pod(p)
+
+    def test_tpu_sharing_pod(self):
+        assert podutil.is_tpu_sharing_pod(tpu_pod(percents=(20,)))
+        assert podutil.is_tpu_sharing_pod(tpu_pod(percents=(0, 50)))
+        assert not podutil.is_tpu_sharing_pod(tpu_pod(percents=(0,)))
+
+    def test_pod_percent_sums_containers(self):
+        assert podutil.get_tpu_percent_from_pod(tpu_pod(percents=(20, 30, 0))) == 50
+
+    def test_null_resources_and_quantity_strings(self):
+        # kube API JSON may carry explicit nulls and non-integer quantities
+        from nanotpu.k8s.objects import Pod
+
+        p = Pod(
+            {
+                "metadata": {"name": "x"},
+                "spec": {
+                    "containers": [
+                        {"name": "a", "resources": {"limits": {types.RESOURCE_TPU_PERCENT: "100m"}}},
+                        {"name": "b", "resources": {"limits": None}},
+                        {"name": "c", "resources": None},
+                    ]
+                },
+            }
+        )
+        assert podutil.get_tpu_percent_from_pod(p) == 0
+
+
+class TestCodec:
+    def test_encode_decode_roundtrip(self):
+        for chips in ([], [0], [3, 1, 2], [0, 1, 2, 3]):
+            assert podutil.decode_chips(podutil.encode_chips(chips)) == sorted(chips)
+
+    def test_no_tpu_sentinel(self):
+        assert podutil.encode_chips([]) == str(types.NOT_NEED_TPU)
+        assert podutil.decode_chips("-1") == []
+
+    def test_decode_garbage_is_none_not_empty(self):
+        # corruption must be distinguishable from the legitimate "-1" sentinel,
+        # else the dealer frees chips a running workload still holds
+        assert podutil.decode_chips("abc") is None
+        assert podutil.decode_chips("") is None
+        assert podutil.decode_chips("0,,x,-5,2") is None
+        assert podutil.decode_chips("-1") == []
+        assert podutil.decode_chips("0,0,1") == [0, 1]
+
+    def test_quantity_suffixes(self):
+        from nanotpu.k8s.objects import parse_quantity
+
+        assert parse_quantity("1k") == 1000
+        assert parse_quantity("2Ki") == 2048
+        assert parse_quantity(400) == 400
+        assert parse_quantity("400") == 400
+        assert parse_quantity("100m") is None  # fractional: invalid for extended resources
+        assert parse_quantity("") is None
+
+    def test_annotated_pod_rejects_missing_tpu_assignment(self):
+        import pytest
+
+        pod = tpu_pod(percents=(20, 30))
+        with pytest.raises(ValueError):
+            podutil.annotated_pod(pod, {"c0": [0]})  # c1 requests TPU, no chips
+
+    def test_read_accessors_do_not_mutate_raw(self):
+        import json
+        from nanotpu.k8s.objects import Node, Pod
+
+        raw = {"metadata": {"name": "n"}, "status": {}}
+        before = json.dumps(raw, sort_keys=True)
+        n = Node(raw)
+        _ = n.labels, n.annotations, n.capacity(types.RESOURCE_TPU_PERCENT)
+        p = Pod({"metadata": {"name": "p"}})
+        _ = p.containers, p.phase, podutil.is_assumed(p), podutil.is_completed_pod(p)
+        assert json.dumps(raw, sort_keys=True) == before
+        assert p.raw == {"metadata": {"name": "p"}}
+
+    def test_annotated_pod_stamps_every_container(self):
+        pod = tpu_pod(percents=(20, 0, 30))
+        out = podutil.annotated_pod(
+            pod, {"c0": [0], "c1": [], "c2": [1, 2]}, policy="binpack"
+        )
+        ann = out.annotations
+        assert ann["tpu.io/container-c0"] == "0"
+        assert ann["tpu.io/container-c1"] == "-1"
+        assert ann["tpu.io/container-c2"] == "1,2"
+        assert ann[types.ANNOTATION_ASSUME] == "true"
+        assert out.labels[types.ANNOTATION_ASSUME] == "true"
+        assert ann[types.ANNOTATION_BOUND_POLICY] == "binpack"
+        # original untouched
+        assert types.ANNOTATION_ASSUME not in pod.annotations
+        assert podutil.is_assumed(out) and not podutil.is_assumed(pod)
+
+    def test_get_assigned_chips_reads_all_containers(self):
+        pod = tpu_pod(percents=(20, 30))
+        out = podutil.annotated_pod(pod, {"c0": [0], "c1": [2]})
+        assert podutil.get_assigned_chips(out) == {"c0": [0], "c1": [2]}
+        # missing any container annotation -> None (unbound)
+        assert podutil.get_assigned_chips(pod) is None
+
+    def test_gang_annotations(self):
+        pod = tpu_pod()
+        assert podutil.gang_of(pod) is None
+        ann = pod.ensure_annotations()
+        ann[types.ANNOTATION_GANG_NAME] = "llama3-8b"
+        ann[types.ANNOTATION_GANG_SIZE] = "32"
+        assert podutil.gang_of(pod) == ("llama3-8b", 32)
+
+
+class TestNodeHelpers:
+    def test_chip_count_from_capacity(self):
+        node = make_node("n1", {types.RESOURCE_TPU_PERCENT: 400})
+        assert nodeutil.get_chip_count(node) == 4
+        assert nodeutil.is_tpu_node(node)
+        assert not nodeutil.is_tpu_node(make_node("n2", {}))
+
+    def test_enable_gate_defaults_to_capacity(self):
+        tpu = make_node("n1", {types.RESOURCE_TPU_PERCENT: 400})
+        assert nodeutil.is_tpu_enabled(tpu)
+        labeled = make_node(
+            "n2", {}, labels={types.LABEL_TPU_ENABLE: types.LABEL_TPU_ENABLE_VALUE}
+        )
+        assert nodeutil.is_tpu_enabled(labeled)
+        assert not nodeutil.is_tpu_enabled(make_node("n3", {}))
