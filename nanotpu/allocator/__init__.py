@@ -1,0 +1,24 @@
+from nanotpu.allocator.core import ChipResource, ChipSet, Demand, Plan
+from nanotpu.allocator.rater import (
+    Binpack,
+    Random,
+    Rater,
+    Sample,
+    Spread,
+    clamp_score,
+    make_rater,
+)
+
+__all__ = [
+    "ChipResource",
+    "ChipSet",
+    "Demand",
+    "Plan",
+    "Binpack",
+    "Spread",
+    "Random",
+    "Sample",
+    "Rater",
+    "clamp_score",
+    "make_rater",
+]

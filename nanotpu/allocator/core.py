@@ -1,0 +1,282 @@
+"""Allocation primitives: ChipResource, Demand, Plan, ChipSet.
+
+Rebuild of ``pkg/dealer/allocate.go`` with two structural changes:
+
+* chips live on an ICI torus (:class:`nanotpu.topology.Torus`) instead of a
+  flat array (``GPUs []*GPUResource``, allocate.go:90), so multi-chip
+  containers receive *contiguous sub-boxes* and plans carry a compactness
+  score;
+* a container may span several chips: demands > 100 percent mean whole
+  chips (400 == a 2x2x1 sub-box), so Plan maps container -> chip id list
+  rather than container -> single card index (allocate.go:22-27).
+
+The rollback path in :meth:`ChipSet.allocate` restores exactly the chips it
+touched — the reference restored ``plan.Demand[i]`` onto the *wrong* index
+while unwinding (allocate.go:110-112), corrupting card accounting; we keep an
+undo log instead.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from nanotpu import types
+from nanotpu.topology import Torus
+
+
+@dataclass
+class ChipResource:
+    """One TPU chip's fractional capacity (GPUResource, allocate.go:141-145).
+
+    ``percent_free`` in [0, percent_total]; ``load`` is the live utilization
+    in [0, 1] folded in from the metrics pipeline (RemainLoad analogue,
+    allocate.go:173-195) — 0 when load-aware scheduling is off or stale.
+    """
+
+    percent_free: int = types.PERCENT_PER_CHIP
+    percent_total: int = types.PERCENT_PER_CHIP
+    load: float = 0.0
+
+    @property
+    def percent_used(self) -> int:
+        return self.percent_total - self.percent_free
+
+    def can_allocate(self, percent: int) -> bool:
+        return 0 <= percent <= self.percent_free
+
+    def sub(self, percent: int) -> None:
+        if not self.can_allocate(percent):
+            raise ValueError(
+                f"cannot allocate {percent}% from chip with {self.percent_free}% free"
+            )
+        self.percent_free -= percent
+
+    def add(self, percent: int) -> None:
+        if percent < 0 or self.percent_free + percent > self.percent_total:
+            raise ValueError(
+                f"cannot release {percent}% onto chip with {self.percent_free}%/"
+                f"{self.percent_total}%"
+            )
+        self.percent_free += percent
+
+
+@dataclass(frozen=True)
+class Demand:
+    """Per-container chip-percent request vector (allocate.go:52-75).
+
+    Built from container limits in pod order; zero-request containers keep a
+    0 entry so Plan indexes align with containers.
+    """
+
+    percents: tuple[int, ...]
+    container_names: tuple[str, ...] = ()
+
+    @staticmethod
+    def from_pod(pod) -> "Demand":
+        from nanotpu.utils import pod as podutil
+
+        containers = pod.containers
+        return Demand(
+            percents=tuple(
+                podutil.get_tpu_percent_from_container(c) for c in containers
+            ),
+            container_names=tuple(c.name for c in containers),
+        )
+
+    @property
+    def total(self) -> int:
+        return sum(self.percents)
+
+    def whole_chips(self, i: int) -> int:
+        """Whole chips demanded by container i (0 for fractional demands)."""
+        p = self.percents[i]
+        return p // types.PERCENT_PER_CHIP if p >= types.PERCENT_PER_CHIP else 0
+
+    def is_valid(self) -> bool:
+        """Multi-chip demands must be whole multiples of one chip — '250%'
+        has no placement semantics on TPU (no MIG/MPS analogue)."""
+        return all(
+            p >= 0
+            and (p <= types.PERCENT_PER_CHIP or p % types.PERCENT_PER_CHIP == 0)
+            for p in self.percents
+        )
+
+    def hash(self) -> str:
+        """Plan-cache key: first 8 hex chars of sha256 (allocate.go:72-75)."""
+        payload = ",".join(
+            f"{n}={p}" for n, p in zip(self.container_names, self.percents)
+        ) or ",".join(str(p) for p in self.percents)
+        return hashlib.sha256(payload.encode()).hexdigest()[:8]
+
+
+@dataclass
+class Plan:
+    """A placement decision for one pod on one node (allocate.go:22-27).
+
+    ``assignments[i]`` is the chip id list for container i (empty == no TPU).
+    """
+
+    demand: Demand
+    assignments: list[list[int]]
+    score: int = 0
+    compactness: float = 1.0
+
+    def by_container_name(self) -> dict[str, list[int]]:
+        names = self.demand.container_names or tuple(
+            str(i) for i in range(len(self.assignments))
+        )
+        return {n: chips for n, chips in zip(names, self.assignments)}
+
+
+class ChipSet:
+    """All chips of one node on their local torus (GPUs, allocate.go:88-131)."""
+
+    def __init__(self, torus: Torus, chips: list[ChipResource] | None = None, key: str = ""):
+        #: stable identity (node name) for deterministic tie-breaking
+        self.key = key
+        self.torus = torus
+        self.chips: list[ChipResource] = (
+            chips if chips is not None else [ChipResource() for _ in range(torus.num_chips)]
+        )
+        if len(self.chips) != torus.num_chips:
+            raise ValueError(
+                f"{len(self.chips)} chips for torus {torus.dims} "
+                f"({torus.num_chips} positions)"
+            )
+
+    @staticmethod
+    def for_node(chip_count: int, topology_spec: str | None = None, generation: str = "v5p") -> "ChipSet":
+        """Build from node capacity (NewNodeInfo path, node.go:25-42)."""
+        if topology_spec:
+            torus = Torus.from_spec(topology_spec, generation)
+            if torus.num_chips != chip_count:
+                # label disagrees with capacity: trust capacity, fall back flat
+                torus = Torus((chip_count, 1, 1), generation)
+        else:
+            torus = Torus((chip_count, 1, 1), generation)
+        return ChipSet(torus)
+
+    def __len__(self) -> int:
+        return len(self.chips)
+
+    # -- feasibility -------------------------------------------------------
+    def can_fit(self, demand: Demand) -> bool:
+        """Cheap OPTIMISTIC pre-filter: ignores ICI connectivity, so it may
+        say yes where choose() finds no connected placement. Never use it as
+        the feasibility authority — only to skip hopeless nodes early."""
+        if not demand.is_valid():
+            return False
+        free = sorted((c.percent_free for c in self.chips), reverse=True)
+        whole = sum(demand.whole_chips(i) for i in range(len(demand.percents)))
+        fulls = sum(1 for f in free if f == types.PERCENT_PER_CHIP)
+        if whole > fulls:
+            return False
+        # fractional demands each need one chip with enough headroom
+        fracs = sorted(
+            (p for p in demand.percents if 0 < p < types.PERCENT_PER_CHIP),
+            reverse=True,
+        )
+        # reserve the fullest-free chips for whole demands, then first-fit
+        remaining = list(free)
+        for _ in range(whole):
+            remaining.remove(types.PERCENT_PER_CHIP)
+        for f in fracs:
+            for idx, r in enumerate(remaining):
+                if r >= f:
+                    remaining[idx] = r - f
+                    break
+            else:
+                return False
+        return True
+
+    # -- mutation with undo log (fixes allocate.go:110-112 rollback bug) ---
+    def allocate(self, plan: Plan) -> None:
+        undo: list[tuple[int, int]] = []
+        try:
+            for i, chips in enumerate(plan.assignments):
+                percent = plan.demand.percents[i]
+                if not chips:
+                    continue
+                per_chip = self._per_chip_split(percent, len(chips))
+                for chip_id, p in zip(chips, per_chip):
+                    self.chips[chip_id].sub(p)
+                    undo.append((chip_id, p))
+        except (ValueError, IndexError):
+            for chip_id, p in reversed(undo):
+                self.chips[chip_id].add(p)
+            raise
+
+    def release(self, plan: Plan) -> None:
+        undo: list[tuple[int, int]] = []
+        try:
+            for i, chips in enumerate(plan.assignments):
+                percent = plan.demand.percents[i]
+                if not chips:
+                    continue
+                per_chip = self._per_chip_split(percent, len(chips))
+                for chip_id, p in zip(chips, per_chip):
+                    self.chips[chip_id].add(p)
+                    undo.append((chip_id, p))
+        except (ValueError, IndexError):
+            for chip_id, p in reversed(undo):
+                self.chips[chip_id].sub(p)
+            raise
+
+    @staticmethod
+    def _per_chip_split(percent: int, n_chips: int) -> list[int]:
+        """How a container's percent lands on its chips: whole demands put
+        100 on each chip; fractional demands live on a single chip."""
+        if n_chips == 0:
+            return []
+        if percent >= types.PERCENT_PER_CHIP:
+            if percent != n_chips * types.PERCENT_PER_CHIP:
+                raise ValueError(
+                    f"whole-chip demand {percent}% does not match {n_chips} chips"
+                )
+            return [types.PERCENT_PER_CHIP] * n_chips
+        if n_chips != 1:
+            raise ValueError(f"fractional demand {percent}% must land on one chip")
+        return [percent]
+
+    # -- aggregate stats (allocate.go:164-223) ----------------------------
+    def percent_used(self) -> int:
+        return sum(c.percent_used for c in self.chips)
+
+    def percent_total(self) -> int:
+        return sum(c.percent_total for c in self.chips)
+
+    def usage(self) -> float:
+        total = self.percent_total()
+        return self.percent_used() / total if total else 0.0
+
+    def available_percent_and_free_chips(self) -> tuple[int, int]:
+        avail = sum(c.percent_free for c in self.chips)
+        free = sum(
+            1 for c in self.chips if c.percent_free == c.percent_total
+        )
+        return avail, free
+
+    def usage_variance(self) -> float:
+        """Variance of per-chip usage fraction (allocate.go:205-223)."""
+        if not self.chips:
+            return 0.0
+        fracs = [
+            c.percent_used / c.percent_total if c.percent_total else 0.0
+            for c in self.chips
+        ]
+        mean = sum(fracs) / len(fracs)
+        return sum((f - mean) ** 2 for f in fracs) / len(fracs)
+
+    def snapshot(self) -> list[dict]:
+        """Debug/status view (PrintStatus analogue, dealer.go:303-309)."""
+        return [
+            {
+                "chip": i,
+                "coord": self.torus.coord(i),
+                "free": c.percent_free,
+                "total": c.percent_total,
+                "load": round(c.load, 4),
+            }
+            for i, c in enumerate(self.chips)
+        ]

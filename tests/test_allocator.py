@@ -1,0 +1,290 @@
+"""Allocator tests: arithmetic, demand/plan round-trip, rater ordering.
+
+Table-driven in the reference's style (pkg/dealer/allocate_test.go,
+rater_test.go) — but kept in sync with the real signatures, which the
+reference's stale tests were not (SURVEY §4).
+"""
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator import (
+    Binpack,
+    ChipResource,
+    ChipSet,
+    Demand,
+    Plan,
+    Random,
+    Sample,
+    Spread,
+    make_rater,
+)
+from nanotpu.k8s.objects import make_container, make_pod
+from nanotpu.topology import Torus
+
+
+def chipset(free_list, topology=None, total=100, key="n"):
+    t = topology or Torus((len(free_list), 1, 1))
+    return ChipSet(
+        t,
+        [ChipResource(percent_free=f, percent_total=total) for f in free_list],
+        key=key,
+    )
+
+
+def demand(*percents):
+    return Demand(
+        percents=tuple(percents),
+        container_names=tuple(f"c{i}" for i in range(len(percents))),
+    )
+
+
+class TestChipResource:
+    """GPUResource.Add/Sub/CanAllocate (allocate_test.go:16-86)."""
+
+    def test_sub_add_roundtrip(self):
+        c = ChipResource()
+        c.sub(30)
+        assert c.percent_free == 70 and c.percent_used == 30
+        c.add(30)
+        assert c.percent_free == 100
+
+    def test_overallocate_raises(self):
+        c = ChipResource(percent_free=20)
+        with pytest.raises(ValueError):
+            c.sub(30)
+        assert c.percent_free == 20
+
+    def test_overrelease_raises(self):
+        c = ChipResource(percent_free=90)
+        with pytest.raises(ValueError):
+            c.add(20)
+        assert c.percent_free == 90
+
+    def test_can_allocate_bounds(self):
+        c = ChipResource(percent_free=50)
+        assert c.can_allocate(50) and c.can_allocate(0)
+        assert not c.can_allocate(51) and not c.can_allocate(-1)
+
+
+class TestDemand:
+    def test_from_pod(self):
+        pod = make_pod(
+            "p",
+            containers=[
+                make_container("a", {types.RESOURCE_TPU_PERCENT: 20}),
+                make_container("b", None),
+                make_container("c", {types.RESOURCE_TPU_PERCENT: 400}),
+            ],
+        )
+        d = Demand.from_pod(pod)
+        assert d.percents == (20, 0, 400)
+        assert d.container_names == ("a", "b", "c")
+        assert d.total == 420
+        assert d.whole_chips(2) == 4 and d.whole_chips(0) == 0
+
+    def test_hash_stable_and_distinct(self):
+        assert demand(20, 30).hash() == demand(20, 30).hash()
+        assert demand(20, 30).hash() != demand(30, 20).hash()
+        assert len(demand(20).hash()) == 8
+
+    def test_validity(self):
+        assert demand(20, 100, 400).is_valid()
+        assert not demand(250).is_valid()  # no fractional multi-chip
+        assert not demand(-5).is_valid()
+
+
+class TestChipSetMutation:
+    def test_allocate_release_roundtrip(self):
+        cs = chipset([100, 100, 100, 100])
+        plan = Plan(demand=demand(60, 200), assignments=[[0], [1, 2]])
+        cs.allocate(plan)
+        assert [c.percent_free for c in cs.chips] == [40, 0, 0, 100]
+        cs.release(plan)
+        assert [c.percent_free for c in cs.chips] == [100, 100, 100, 100]
+
+    def test_failed_allocate_rolls_back_exactly(self):
+        # second container cannot fit -> first container's chips restored
+        # (the reference's rollback corrupted accounting, allocate.go:110-112)
+        cs = chipset([100, 50, 50, 50])
+        plan = Plan(demand=demand(60, 200), assignments=[[1], [0, 2]])
+        with pytest.raises(ValueError):
+            cs.allocate(plan)
+        assert [c.percent_free for c in cs.chips] == [100, 50, 50, 50]
+
+    def test_mismatched_whole_chip_plan_rejected(self):
+        cs = chipset([100, 100])
+        bad = Plan(demand=demand(200), assignments=[[0]])  # 200% on 1 chip
+        with pytest.raises(ValueError):
+            cs.allocate(bad)
+        assert [c.percent_free for c in cs.chips] == [100, 100]
+
+    def test_can_fit(self):
+        assert chipset([100, 40]).can_fit(demand(100, 30))
+        assert not chipset([90, 90]).can_fit(demand(100))
+        assert chipset([100, 100, 100, 100]).can_fit(demand(400))
+        assert not chipset([100, 100]).can_fit(demand(250))
+        assert chipset([60, 30]).can_fit(demand(30, 30, 30))
+        assert not chipset([30, 30]).can_fit(demand(30, 30, 30))
+
+    def test_stats(self):
+        cs = chipset([100, 50, 0, 100])
+        assert cs.percent_used() == 150
+        assert cs.usage() == 150 / 400
+        assert cs.available_percent_and_free_chips() == (250, 2)
+        assert cs.usage_variance() > 0
+        assert chipset([100, 100]).usage_variance() == 0
+
+
+class TestBinpackOrdering:
+    """Binpack prefers fuller nodes (rater_test.go:9-37)."""
+
+    def test_rate_prefers_fuller(self):
+        bp = Binpack()
+        empty = chipset([100, 100, 100, 100])
+        half = chipset([50, 50, 100, 100])
+        nearly_full = chipset([0, 0, 0, 60])
+        d = demand(20)
+        assert bp.rate(nearly_full, d) > bp.rate(half, d) > bp.rate(empty, d)
+
+    def test_choose_stacks_fullest_chip(self):
+        bp = Binpack()
+        cs = chipset([100, 30, 60, 100])
+        plan = bp.choose(cs, demand(20))
+        assert plan.assignments == [[1]]  # fullest chip that fits
+
+    def test_choose_infeasible_none(self):
+        assert Binpack().choose(chipset([10, 10]), demand(50)) is None
+
+    def test_scores_clamped(self):
+        bp, sp = Binpack(), Spread()
+        loaded = chipset([0, 0, 0, 0])
+        for c in loaded.chips:
+            c.load = 1.0
+        d = demand(0)
+        for rater in (bp, sp):
+            assert types.SCORE_MIN <= rater.rate(loaded, d) <= types.SCORE_MAX
+            assert types.SCORE_MIN <= rater.rate(chipset([100]), d) <= types.SCORE_MAX
+
+
+class TestSpreadOrdering:
+    """Spread prefers free nodes/chips (rater_test.go:39-131)."""
+
+    def test_rate_prefers_empty(self):
+        sp = Spread()
+        empty = chipset([100, 100, 100, 100])
+        half = chipset([50, 50, 100, 100])
+        full = chipset([0, 0, 0, 0])
+        d = demand(20)
+        assert sp.rate(empty, d) > sp.rate(half, d) > sp.rate(full, d)
+
+    def test_choose_takes_emptiest_chip(self):
+        sp = Spread()
+        cs = chipset([60, 100, 30, 100])
+        plan = sp.choose(cs, demand(20))
+        assert plan.assignments[0][0] in (1, 3)
+
+    def test_load_breaks_ties(self):
+        sp = Spread()
+        cs = chipset([100, 100])
+        cs.chips[0].load = 0.9
+        plan = sp.choose(cs, demand(20))
+        assert plan.assignments == [[1]]
+
+
+class TestTopologyAwareChoose:
+    def test_whole_chip_demand_gets_contiguous_box(self):
+        t = Torus((4, 4, 1))
+        cs = chipset([100] * 16, topology=t)
+        for rater in (Binpack(), Spread(), Random(), Sample()):
+            plan = rater.choose(cs, demand(400))
+            assert plan is not None, rater.name
+            chips = set(plan.assignments[0])
+            assert len(chips) == 4
+            assert t.is_connected(chips), rater.name
+            assert plan.compactness == 1.0, rater.name  # 2x2 box
+
+    def test_binpack_packs_next_to_used(self):
+        t = Torus((4, 4, 1))
+        cs = chipset([100] * 16, topology=t)
+        # occupy the 2x2 corner at (0,0)
+        first = Binpack().choose(cs, demand(400))
+        cs.allocate(first)
+        second = Binpack().choose(cs, demand(400))
+        used = set(first.assignments[0])
+        new = set(second.assignments[0])
+        assert not (used & new)
+        # the second box touches the first over ICI
+        touching = any(
+            n in used for c in new for n in t.neighbors(c)
+        )
+        assert touching
+
+    def test_spread_avoids_used_regions(self):
+        t = Torus((4, 4, 1))
+        cs = chipset([100] * 16, topology=t)
+        first = Spread().choose(cs, demand(100))
+        cs.allocate(first)
+        second = Spread().choose(cs, demand(100))
+        c0 = first.assignments[0][0]
+        c1 = second.assignments[0][0]
+        assert c1 not in t.neighbors(c0) and c1 != c0
+
+    def test_multi_container_distinct_whole_chips(self):
+        # BASELINE config[2]: multi-container pod -> distinct chips, adjacent
+        t = Torus((2, 2, 1))
+        cs = chipset([100] * 4, topology=t)
+        plan = Binpack().choose(cs, demand(100, 100))
+        a, b = plan.assignments
+        assert a and b and not (set(a) & set(b))
+
+    def test_non_box_volume_falls_back_to_connected_set(self):
+        # 3 chips on a 2x2x1 host: no 3x1 box fits, but an L-shape does
+        t = Torus((2, 2, 1))
+        for rater in (Binpack(), Spread(), Random(), Sample()):
+            cs = chipset([100] * 4, topology=t)
+            plan = rater.choose(cs, demand(300))
+            assert plan is not None, rater.name
+            chips = set(plan.assignments[0])
+            assert len(chips) == 3 and t.is_connected(chips), rater.name
+
+    def test_fragmented_torus_rejects_whole_box(self):
+        t = Torus((2, 2, 1))
+        cs = chipset([100, 50, 100, 100], topology=t)
+        # 4 whole chips demanded but one is fractional-used
+        assert Binpack().choose(cs, demand(400)) is None
+
+
+class TestRandomRater:
+    def test_deterministic_per_key(self):
+        cs1 = chipset([100] * 4, key="node-a")
+        cs2 = chipset([100] * 4, key="node-a")
+        r = Random()
+        p1, p2 = r.choose(cs1, demand(20)), r.choose(cs2, demand(20))
+        assert p1.assignments == p2.assignments
+        assert r.rate(cs1, demand(20)) == r.rate(cs2, demand(20))
+
+    def test_feasibility_respected(self):
+        cs = chipset([10, 80], key="n")
+        plan = Random().choose(cs, demand(50))
+        assert plan.assignments == [[1]]
+
+
+class TestSampleRater:
+    """First-fit, constant score (rater.go:21-50, allocate_test.go:160-190)."""
+
+    def test_first_fit(self):
+        plan = Sample().choose(chipset([100, 100]), demand(20, 30))
+        assert plan.assignments == [[0], [0]]  # both fit on chip 0
+        assert plan.score == types.SCORE_MAX
+
+    def test_zero_demand_container_gets_no_chip(self):
+        plan = Sample().choose(chipset([100]), demand(0, 20))
+        assert plan.assignments == [[], [0]]
+
+
+def test_make_rater_dispatch():
+    for name in ("binpack", "spread", "random", "sample"):
+        assert make_rater(name).name in (name,)
+    with pytest.raises(ValueError):
+        make_rater("bogus")

@@ -1,0 +1,161 @@
+"""ICI torus model tests: coords, adjacency, sub-box enumeration, compactness."""
+
+import pytest
+
+from nanotpu.topology import (
+    SliceGeometry,
+    Torus,
+    box_shapes_for,
+    parse_slice_coords,
+    parse_topology,
+)
+
+
+class TestParse:
+    def test_specs(self):
+        assert parse_topology("2x2x1") == (2, 2, 1)
+        assert parse_topology("4x4") == (4, 4, 1)
+        assert parse_topology("8") == (8, 1, 1)
+        for bad in ("", "0x2", "2x2x2x2", "axb"):
+            with pytest.raises(ValueError):
+                parse_topology(bad)
+
+    def test_slice_coords(self):
+        assert parse_slice_coords("1,2,3") == (1, 2, 3)
+        assert parse_slice_coords("1") == (1, 0, 0)
+        with pytest.raises(ValueError):
+            parse_slice_coords("-1,0")
+
+
+class TestTorus:
+    def test_coord_roundtrip(self):
+        t = Torus((4, 4, 2))
+        for chip in range(t.num_chips):
+            assert t.chip_id(t.coord(chip)) == chip
+
+    def test_neighbors_2x2x1_host_block(self):
+        # a v5p host: 2x2x1, no wrap (dims < 4): each chip has exactly 2 links
+        t = Torus((2, 2, 1))
+        for chip in range(4):
+            assert len(t.neighbors(chip)) == 2
+        assert t.ici_links_within(frozenset(range(4))) == 4  # a square ring
+
+    def test_neighbors_wraparound(self):
+        # 4x1x1 with wrap: ends are adjacent, every chip has 2 neighbors
+        t = Torus((4, 1, 1))
+        assert t.neighbors(0) == [1, 3]
+        assert t.neighbors(3) == [0, 2]
+        # 3x1x1: no wrap below 4
+        t3 = Torus((3, 1, 1))
+        assert t3.neighbors(0) == [1]
+
+    def test_neighbors_asymmetric_torus_wrap(self):
+        # regression: wrap on one axis must not corrupt other axes' coords
+        t = Torus((4, 6, 1))
+        n = t.neighbors(t.chip_id((3, 5, 0)))
+        assert t.chip_id((0, 5, 0)) in n  # x wraps, y stays 5
+        assert t.chip_id((0, 1, 0)) not in n
+        expected = {
+            t.chip_id((2, 5, 0)),
+            t.chip_id((0, 5, 0)),
+            t.chip_id((3, 4, 0)),
+            t.chip_id((3, 0, 0)),  # y also wraps (len 6 >= 4)
+        }
+        assert set(n) == expected
+
+    def test_grow_connected(self):
+        t = Torus((2, 2, 1))
+        grown = t.grow_connected(0, 3, {0, 1, 2, 3})
+        assert grown is not None and len(grown) == 3 and t.is_connected(set(grown))
+        assert t.grow_connected(0, 5, {0, 1, 2, 3}) is None
+        assert t.grow_connected(0, 1, {0}) == frozenset({0})
+        assert t.grow_connected(0, 2, {0, 3}) is None  # 3 not adjacent to 0
+
+    def test_connectivity(self):
+        t = Torus((4, 4, 1))
+        assert t.is_connected({0})
+        assert t.is_connected(set())
+        row0 = {t.chip_id((i, 0, 0)) for i in range(4)}
+        assert t.is_connected(row0)
+        # two opposite corners of a 4x4 are not adjacent... but wrap makes
+        # (0,0) and (3,3) reachable only through each other? They are not
+        # directly adjacent; a 2-chip set of them is disconnected.
+        corners = {t.chip_id((0, 0, 0)), t.chip_id((2, 2, 0))}
+        assert not t.is_connected(corners)
+
+    def test_sub_boxes_count(self):
+        t = Torus((4, 4, 1))
+        assert len(t.sub_boxes((2, 2, 1))) == 9  # 3*3 origins
+        assert len(t.sub_boxes((4, 4, 1))) == 1
+        assert t.sub_boxes((5, 1, 1)) == []
+
+    def test_placements_for_prefers_compact(self):
+        t = Torus((4, 4, 1))
+        plans = t.placements_for(4)
+        assert plans, "must find 4-chip placements on 4x4"
+        # first candidates should be 2x2 squares (most compact), not 4x1 rows
+        first = plans[0]
+        coords = sorted(t.coord(c) for c in first)
+        xs = {c[0] for c in coords}
+        ys = {c[1] for c in coords}
+        assert len(xs) == 2 and len(ys) == 2
+        # all placements have the right size and are connected
+        for p in plans:
+            assert len(p) == 4
+            assert t.is_connected(set(p))
+
+    def test_compactness_orders_shapes(self):
+        # 6x6 so a 4-chip row does NOT close a wraparound ring
+        t = Torus((6, 6, 1))
+        square = {t.chip_id((i, j, 0)) for i in range(2) for j in range(2)}
+        row = {t.chip_id((i, 0, 0)) for i in range(4)}
+        scattered = {t.chip_id((0, 0, 0)), t.chip_id((3, 3, 0))}
+        assert t.compactness(square) == 1.0
+        assert t.compactness(scattered) == 0.0
+        assert (
+            t.compactness(square) > t.compactness(row) > t.compactness(scattered)
+        )
+        assert t.compactness({0}) == 1.0
+
+    def test_wraparound_row_is_a_ring(self):
+        # on a 4x4 with wrap, a full row closes into a ring: 4 links == the
+        # best any 4-chip shape achieves (2x2 square also has 4)
+        t = Torus((4, 4, 1))
+        row = {t.chip_id((i, 0, 0)) for i in range(4)}
+        assert t.ici_links_within(row) == 4
+        assert t.compactness(row) == 1.0
+
+
+class TestBoxShapes:
+    def test_volumes(self):
+        for n in (1, 2, 3, 4, 6, 8, 12, 16, 64):
+            for s in box_shapes_for(n):
+                assert s[0] * s[1] * s[2] == n
+
+    def test_cube_first(self):
+        assert box_shapes_for(8)[0] == (2, 2, 2)
+        assert box_shapes_for(4)[0] in ((1, 2, 2), (2, 1, 2), (2, 2, 1))
+        assert box_shapes_for(1) == [(1, 1, 1)]
+
+
+class TestSliceGeometry:
+    def test_v5p16_hosts(self):
+        # v5p-16: 16 chips, 4 hosts of 2x2x1, slice torus 4x4x1
+        g = SliceGeometry("s0", Torus((4, 4, 1)), host_block=(2, 2, 1))
+        assert g.host_grid() == (2, 2, 1)
+        all_chips = set()
+        for hx in range(2):
+            for hy in range(2):
+                chips = g.host_chip_ids((hx, hy, 0))
+                assert len(chips) == 4
+                all_chips |= chips
+        assert all_chips == set(range(16))
+
+    def test_adjacent_hosts_more_compact(self):
+        g = SliceGeometry("s0", Torus((4, 4, 1)), host_block=(2, 2, 1))
+        adjacent = g.hosts_compactness([(0, 0, 0), (1, 0, 0)])
+        # on a 2x2 host grid every host pair is adjacent; compare vs the
+        # full 4-host square which is maximally compact
+        full = g.hosts_compactness([(0, 0, 0), (1, 0, 0), (0, 1, 0), (1, 1, 0)])
+        assert 0 < adjacent <= 1.0
+        assert full == 1.0
