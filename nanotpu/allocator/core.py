@@ -162,32 +162,27 @@ class ChipSet:
 
     # -- feasibility -------------------------------------------------------
     def can_fit(self, demand: Demand) -> bool:
-        """Cheap OPTIMISTIC pre-filter: ignores ICI connectivity, so it may
-        say yes where choose() finds no connected placement. Never use it as
-        the feasibility authority — only to skip hopeless nodes early."""
+        """Cheap OPTIMISTIC pre-filter using only *necessary* conditions —
+        it must never reject a demand choose() could place (a false negative
+        here strands pods Pending with capacity available), and may accept
+        demands choose() then rejects (connectivity, packing). choose() is
+        the feasibility authority."""
         if not demand.is_valid():
             return False
-        free = sorted((c.percent_free for c in self.chips), reverse=True)
+        free = [c.percent_free for c in self.chips]
+        if demand.total > sum(free):
+            return False
         whole = sum(demand.whole_chips(i) for i in range(len(demand.percents)))
         fulls = sum(1 for f in free if f == types.PERCENT_PER_CHIP)
         if whole > fulls:
             return False
-        # fractional demands each need one chip with enough headroom
-        fracs = sorted(
+        # the largest fractional demand needs SOME chip with that headroom
+        max_frac = max(
             (p for p in demand.percents if 0 < p < types.PERCENT_PER_CHIP),
-            reverse=True,
+            default=0,
         )
-        # reserve the fullest-free chips for whole demands, then first-fit
-        remaining = list(free)
-        for _ in range(whole):
-            remaining.remove(types.PERCENT_PER_CHIP)
-        for f in fracs:
-            for idx, r in enumerate(remaining):
-                if r >= f:
-                    remaining[idx] = r - f
-                    break
-            else:
-                return False
+        if max_frac and max(free, default=0) < max_frac:
+            return False
         return True
 
     # -- mutation with undo log (fixes allocate.go:110-112 rollback bug) ---

@@ -1,0 +1,144 @@
+"""Scheduler-extender entry point (rebuild of ``cmd/main.go``).
+
+Flags mirror the reference (main.go:63-73): ``--priority`` picks the
+placement policy, ``--port``/$PORT the serving port, ``--policy-config`` the
+hot-reloaded policy YAML, ``--prometheus-url`` + ``--load-schedule`` the
+load-aware pipeline, ``--sync-period`` the informer resync. New: ``--mock N``
+runs against an in-memory cluster with N v5p hosts (the reference had no way
+to run without a live API server, which is why its HTTP layer was untested).
+
+Usage:
+    python -m nanotpu.cmd.main --mock 4 --priority binpack --port 39999
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.client import FakeClientset
+from nanotpu.k8s.objects import make_node
+from nanotpu.metrics.registry import Registry
+from nanotpu.routes.server import SchedulerAPI, serve
+
+log = logging.getLogger("nanotpu.main")
+
+
+def make_mock_cluster(n_nodes: int, chips_per_node: int = 4) -> FakeClientset:
+    """A v5p pool: n hosts of 2x2x1 chips, slice-annotated for gang placement."""
+    client = FakeClientset()
+    # hosts arranged on a square-ish host grid inside one slice
+    side = max(1, int(n_nodes ** 0.5))
+    for i in range(n_nodes):
+        hx, hy = i % side, i // side
+        client.create_node(
+            make_node(
+                f"v5p-host-{i}",
+                {types.RESOURCE_TPU_PERCENT: chips_per_node * types.PERCENT_PER_CHIP},
+                labels={
+                    types.LABEL_TPU_GENERATION: "v5p",
+                    types.LABEL_TPU_TOPOLOGY: "2x2x1",
+                    types.LABEL_TPU_SLICE: "slice-0",
+                    types.LABEL_TPU_SLICE_COORDS: f"{hx},{hy},0",
+                    types.LABEL_TPU_ENABLE: types.LABEL_TPU_ENABLE_VALUE,
+                },
+            )
+        )
+    return client
+
+
+def build_app(argv: list[str] | None = None):
+    parser = argparse.ArgumentParser(description="nanotpu scheduler extender")
+    parser.add_argument(
+        "--priority",
+        default=types.POLICY_BINPACK,
+        choices=[types.POLICY_BINPACK, types.POLICY_SPREAD, types.POLICY_RANDOM],
+        help="placement policy (main.go:64)",
+    )
+    parser.add_argument(
+        "--port", type=int, default=int(os.environ.get("PORT", "39999"))
+    )
+    parser.add_argument("--policy-config", default="", help="policy YAML path")
+    parser.add_argument("--prometheus-url", default="")
+    parser.add_argument("--sync-period", type=int, default=30)
+    parser.add_argument(
+        "--load-schedule", action="store_true", help="enable load-aware scheduling"
+    )
+    parser.add_argument(
+        "--mock", type=int, default=0, metavar="N",
+        help="run against an in-memory cluster with N v5p hosts",
+    )
+    parser.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    if args.mock:
+        client = make_mock_cluster(args.mock)
+    else:
+        from nanotpu.k8s.rest import RestClientset
+
+        client = RestClientset.from_env(kubeconfig=args.kubeconfig)
+
+    rater = make_rater(args.priority)
+    dealer = Dealer(client, rater)
+    registry = Registry()
+    api = SchedulerAPI(dealer, registry)
+    return args, client, dealer, api
+
+
+def main(argv: list[str] | None = None) -> int:
+    args, client, dealer, api = build_app(argv)
+
+    from nanotpu.controller.controller import Controller
+
+    controller = Controller(client, dealer, resync_period_s=args.sync_period)
+    controller.start()
+
+    if args.load_schedule:
+        from nanotpu.controller.metricsync import start_metric_sync
+
+        start_metric_sync(
+            dealer,
+            client,
+            prometheus_url=args.prometheus_url,
+            policy_config=args.policy_config,
+        )
+
+    server = serve(api, args.port)
+    log.info(
+        "nanotpu extender serving on :%d (policy=%s, mock=%s)",
+        args.port, args.priority, bool(args.mock),
+    )
+
+    stop = {"flag": False}
+
+    def on_signal(signum, frame):
+        if stop["flag"]:  # second signal: hard exit (signals/signal.go:16-30)
+            os._exit(1)
+        stop["flag"] = True
+        log.info("signal %s: shutting down", signum)
+        controller.stop()
+        server.shutdown()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    try:
+        signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,213 @@
+"""Reconciler: keeps the Dealer eventually consistent with the cluster.
+
+Rebuild of ``pkg/controller/controller.go``. Same event semantics:
+
+* pod ADDED   -> enqueue if it's a TPU-sharing pod (controller.go:90-106)
+* pod MODIFIED-> enqueue iff (tracked pod turned completed) or (untracked,
+  unreleased pod became assumed) (controller.go:289-335)
+* pod DELETED -> Dealer.forget (controller.go:337-357)
+* syncPod: completed -> Release; scheduled & active & assumed -> Allocate
+  (controller.go:210-243)
+* node DELETED -> Dealer.remove_node (MISSING in the reference — NodeMaps
+  never evicted, SURVEY §2 #3 bugs list)
+
+Transient sync errors retry through the workqueue with exponential backoff,
+capped attempts (controller.go:202-268's rate-limited queue; node queue used
+10s->360s, controller.go:126).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.client import ApiError, Clientset, NotFoundError
+from nanotpu.k8s.objects import Pod
+from nanotpu.utils import pod as podutil
+
+log = logging.getLogger("nanotpu.controller")
+
+MAX_SYNC_RETRIES = 5
+BACKOFF_BASE_S = 0.05
+BACKOFF_MAX_S = 5.0
+
+
+class Controller:
+    def __init__(
+        self,
+        client: Clientset,
+        dealer: Dealer,
+        workers: int = 2,
+        resync_period_s: float = 30.0,
+    ):
+        self.client = client
+        self.dealer = dealer
+        self.workers = workers
+        #: periodic full re-list (informer resync analogue, cmd/main.go:31);
+        #: safety net for events lost across watch reconnects. <=0 disables.
+        self.resync_period_s = resync_period_s
+        self._queue: "queue.Queue[tuple[str, str, int] | None]" = queue.Queue()
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._pod_watch = None
+        self._node_watch = None
+        # key -> last seen pod object (the informer cache analogue)
+        self._cache_lock = threading.Lock()
+        self._pod_cache: dict[str, Pod] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """List-then-watch startup (WaitForCacheSync analogue,
+        controller.go:147-157): existing pods are synced before watching."""
+        try:
+            for pod in self.client.list_pods():
+                if podutil.is_tpu_sharing_pod(pod):
+                    self._remember(pod)
+                    self._enqueue(pod)
+        except ApiError as e:
+            log.warning("initial pod list failed: %s", e)
+        self._pod_watch = self.client.watch_pods()
+        self._node_watch = self.client.watch_nodes()
+        self._threads = [
+            threading.Thread(target=self._pod_loop, daemon=True, name="pods"),
+            threading.Thread(target=self._node_loop, daemon=True, name="nodes"),
+        ]
+        self._threads += [
+            threading.Thread(target=self._worker, daemon=True, name=f"sync-{i}")
+            for i in range(self.workers)
+        ]
+        if self.resync_period_s > 0:
+            self._threads.append(
+                threading.Thread(target=self._resync_loop, daemon=True, name="resync")
+            )
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._pod_watch:
+            self._pod_watch.stop()
+        if self._node_watch:
+            self._node_watch.stop()
+        for _ in range(self.workers):
+            self._queue.put(None)
+
+    def wait_idle(self, timeout: float = 5.0) -> bool:
+        """Test helper: block until the workqueue drains."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._queue.unfinished_tasks == 0:
+                return True
+            time.sleep(0.01)
+        return False
+
+    # -- informer-side -----------------------------------------------------
+    def _remember(self, pod: Pod) -> None:
+        with self._cache_lock:
+            self._pod_cache[pod.key()] = pod
+
+    def _known(self, key: str) -> Pod | None:
+        with self._cache_lock:
+            return self._pod_cache.get(key)
+
+    def _enqueue(self, pod: Pod, attempt: int = 0) -> None:
+        self._queue.put((pod.namespace, pod.name, attempt))
+
+    def _pod_loop(self) -> None:
+        for event in self._pod_watch:
+            if self._stop.is_set():
+                break
+            pod = event.obj
+            if not podutil.is_tpu_sharing_pod(pod):
+                continue
+            if event.type == "ADDED":
+                self._remember(pod)
+                self._enqueue(pod)
+            elif event.type == "MODIFIED":
+                old = self._known(pod.key())
+                self._remember(pod)
+                # enqueue only on the two meaningful transitions
+                # (controller.go:289-335)
+                if podutil.is_completed_pod(pod):
+                    self._enqueue(pod)
+                elif old is None and podutil.is_assumed(pod):
+                    self._enqueue(pod)
+                elif podutil.is_assumed(pod) and old is not None and not podutil.is_assumed(old):
+                    self._enqueue(pod)
+            elif event.type == "DELETED":
+                with self._cache_lock:
+                    self._pod_cache.pop(pod.key(), None)
+                self.dealer.forget(pod)
+
+    def _node_loop(self) -> None:
+        for event in self._node_watch:
+            if self._stop.is_set():
+                break
+            if event.type == "DELETED":
+                self.dealer.remove_node(event.obj.name)
+            elif event.type == "ADDED":
+                self.dealer.observe_node(event.obj)
+
+    def _resync_loop(self) -> None:
+        """Periodic full reconcile: re-list pods and nodes, enqueue every TPU
+        pod, evict dealer nodes that no longer exist. Catches anything a
+        dropped watch missed."""
+        while not self._stop.wait(self.resync_period_s):
+            try:
+                for pod in self.client.list_pods():
+                    if podutil.is_tpu_sharing_pod(pod):
+                        self._remember(pod)
+                        self._enqueue(pod)
+                live_nodes = {n.name for n in self.client.list_nodes()}
+                for name in self.dealer.node_names():
+                    if name not in live_nodes:
+                        self.dealer.remove_node(name)
+            except ApiError as e:
+                log.warning("resync failed: %s", e)
+
+    # -- work side ---------------------------------------------------------
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return
+                namespace, name, attempt = item
+                try:
+                    self._sync_pod(namespace, name)
+                except Exception as e:  # transient: backoff retry
+                    if attempt + 1 > MAX_SYNC_RETRIES:
+                        log.error(
+                            "dropping pod %s/%s after %d attempts: %s",
+                            namespace, name, attempt, e,
+                        )
+                        continue
+                    delay = min(BACKOFF_BASE_S * (2 ** attempt), BACKOFF_MAX_S)
+                    timer = threading.Timer(
+                        delay,
+                        self._queue.put,
+                        args=((namespace, name, attempt + 1),),
+                    )
+                    timer.daemon = True
+                    timer.start()
+            finally:
+                self._queue.task_done()
+
+    def _sync_pod(self, namespace: str, name: str) -> None:
+        """controller.go:210-243."""
+        try:
+            pod = self.client.get_pod(namespace, name)
+        except NotFoundError:
+            cached = self._known(f"{namespace}/{name}")
+            if cached is not None:
+                self.dealer.forget(cached)
+            return
+        if podutil.is_completed_pod(pod):
+            self.dealer.release(pod)
+        elif pod.node_name and podutil.is_assumed(pod) and pod.phase in (
+            "Pending", "Running",
+        ):
+            self.dealer.allocate(pod)

@@ -1,0 +1,243 @@
+"""Load-aware scheduling data plane: metric sources + sync loops.
+
+Rebuild of ``pkg/controller/node.go`` + ``pkg/prometheus``. Per policy
+metric, a loop ticks at its sync period, reads per-chip utilization for every
+TPU node, and writes it into the Dealer's usage store (which folds it into
+``ChipResource.load`` for the raters). Differences from the reference:
+
+* the primary source is the **TPU runtime metrics endpoint** on each node
+  (libtpu exposes Prometheus text; duty cycle ~ core utilization, HBM usage
+  ~ memory) — no DCGM, no GPU (BASELINE north_star);
+* a PromQL server remains supported as a secondary source
+  (``PrometheusSource``, the ``pkg/prometheus`` analogue), with the same
+  two label-shape fallbacks (prometheus.go:68-83);
+* node gate is :func:`nanotpu.utils.node.is_tpu_enabled`, not the NVIDIA
+  label (controller/node.go:153-158);
+* failures degrade: a node that cannot be scraped keeps retrying at the
+  next tick with capped logging; ≤5 consecutive errors drop to debug level
+  (node.go:68-83's retry-then-drop without losing the node forever).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.parse
+import urllib.request
+from typing import Protocol
+
+from nanotpu import types
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.client import ApiError, Clientset
+from nanotpu.k8s.objects import Node
+from nanotpu.metrics.promtext import parse_prometheus_text
+from nanotpu.policy import METRIC_CORE, METRIC_HBM, PolicyWatcher
+from nanotpu.utils import node as nodeutil
+
+log = logging.getLogger("nanotpu.metricsync")
+
+#: Default port of the per-node TPU runtime metrics endpoint (libtpu's
+#: prometheus exporter).
+TPU_RUNTIME_METRICS_PORT = 8431
+
+#: Metric names exposed by the TPU runtime, mapped to our policy metrics.
+RUNTIME_METRIC_NAMES = {
+    METRIC_CORE: ("tensorcore_duty_cycle_percent", 0.01),
+    METRIC_HBM: ("memory_bandwidth_utilization", 0.01),
+}
+
+
+class MetricSource(Protocol):
+    def chip_usage(self, node: Node, chip: int, metric: str) -> float | None:
+        """Utilization fraction [0,1] or None when unavailable."""
+
+
+class TpuRuntimeSource:
+    """Scrapes each node's libtpu metrics endpoint directly."""
+
+    def __init__(self, port: int = TPU_RUNTIME_METRICS_PORT, timeout_s: float = 5.0):
+        self.port = port
+        self.timeout_s = timeout_s
+        self._cache_lock = threading.Lock()
+        self._cache: dict[str, list] = {}  # node -> parsed samples (per tick)
+
+    def begin_tick(self) -> None:
+        with self._cache_lock:
+            self._cache.clear()
+
+    def _node_address(self, node: Node) -> str | None:
+        for addr in (node.status.get("addresses") or []):
+            if addr.get("type") in ("InternalIP", "Hostname"):
+                return addr.get("address")
+        return node.name or None
+
+    def _samples(self, node: Node):
+        with self._cache_lock:
+            if node.name in self._cache:
+                return self._cache[node.name]
+        host = self._node_address(node)
+        if not host:
+            return []
+        url = f"http://{host}:{self.port}/metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+                samples = parse_prometheus_text(resp.read().decode(errors="replace"))
+        except OSError as e:
+            log.debug("scrape %s failed: %s", url, e)
+            samples = []
+        with self._cache_lock:
+            self._cache[node.name] = samples
+        return samples
+
+    def chip_usage(self, node: Node, chip: int, metric: str) -> float | None:
+        name, scale = RUNTIME_METRIC_NAMES.get(metric, (metric, 1.0))
+        for s in self._samples(node):
+            if s.name != name:
+                continue
+            label = s.labels.get("chip") or s.labels.get("device_id") or s.labels.get("core")
+            if label is not None and label != str(chip):
+                continue
+            return max(0.0, s.value * scale)
+        return None
+
+
+class PrometheusSource:
+    """PromQL instant queries (pkg/prometheus/prometheus.go). Tries the two
+    label shapes the reference supported: {node=,chip=} then {node=,chipNode=}
+    (prometheus.go:68-83 used card/cardNode)."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _query(self, promql: str) -> float | None:
+        url = (
+            f"{self.base_url}/api/v1/query?"
+            + urllib.parse.urlencode({"query": promql})
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+                doc = json.loads(resp.read())
+        except (OSError, json.JSONDecodeError) as e:
+            log.debug("promql %r failed: %s", promql, e)
+            return None
+        results = (doc.get("data") or {}).get("result") or []
+        if not results:
+            return None
+        try:
+            value = float(results[0]["value"][1])
+        except (KeyError, IndexError, TypeError, ValueError):
+            return None
+        if value != value or value < 0:  # NaN / negative clamp (prometheus.go:34-65)
+            return 0.0
+        return value
+
+    def chip_usage(self, node: Node, chip: int, metric: str) -> float | None:
+        v = self._query(f'{metric}{{node=~"{node.name}",chip="{chip}"}} / 100')
+        if v is None:
+            v = self._query(
+                f'{metric}{{node=~"{node.name}",chipNode="{chip}"}} / 100'
+            )
+        return v
+
+
+class MetricSyncer:
+    """One loop per policy metric (controller.go:172-177 started one
+    syncMetricLoop per period)."""
+
+    def __init__(
+        self,
+        dealer: Dealer,
+        client: Clientset,
+        source: MetricSource,
+        policy: PolicyWatcher,
+    ):
+        self.dealer = dealer
+        self.client = client
+        self.source = source
+        self.policy = policy
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._errors: dict[str, int] = {}
+
+    def start(self) -> None:
+        for metric in (METRIC_CORE, METRIC_HBM):
+            t = threading.Thread(
+                target=self._loop, args=(metric,), daemon=True,
+                name=f"metricsync-{metric}",
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self, metric: str) -> None:
+        while True:
+            period = self.policy.spec().period_for(metric)
+            if self._stop.wait(period):
+                return
+            self.sync_once(metric)
+
+    def sync_once(self, metric: str) -> int:
+        """One tick: scrape every enabled TPU node. Returns chips updated."""
+        if hasattr(self.source, "begin_tick"):
+            self.source.begin_tick()
+        try:
+            nodes = self.client.list_nodes()
+        except ApiError as e:
+            log.warning("metric sync list nodes failed: %s", e)
+            return 0
+        updated = 0
+        for node in nodes:
+            if not nodeutil.is_tpu_enabled(node) or not nodeutil.is_tpu_node(node):
+                continue
+            chip_count = nodeutil.get_chip_count(node)
+            for chip in range(chip_count):
+                try:
+                    value = self.source.chip_usage(node, chip, metric)
+                except Exception as e:  # a source must never kill the loop
+                    self._note_error(node.name, e)
+                    continue
+                if value is None:
+                    continue
+                kwargs = {"core": value} if metric == METRIC_CORE else {"memory": value}
+                self.dealer.update_chip_usage(node.name, chip, **kwargs)
+                updated += 1
+            self._errors.pop(node.name, None)
+        return updated
+
+    def _note_error(self, node: str, err: Exception) -> None:
+        count = self._errors.get(node, 0) + 1
+        self._errors[node] = count
+        # first 5 errors at warning, then debug (node.go:74-82 dropped after
+        # 5 retries; we keep trying but stop shouting)
+        if count <= 5:
+            log.warning("metric scrape for node %s failed: %s", node, err)
+        else:
+            log.debug("metric scrape for node %s failed (#%d): %s", node, count, err)
+
+
+def start_metric_sync(
+    dealer: Dealer,
+    client: Clientset,
+    prometheus_url: str = "",
+    policy_config: str = "",
+) -> MetricSyncer:
+    """Wire the load-aware pipeline (cmd/main.go:115-119 + controller.go:
+    125-134). TPU runtime endpoint is the default source; a Prometheus URL
+    switches to PromQL."""
+    policy = PolicyWatcher(policy_config)
+    source: MetricSource
+    if prometheus_url:
+        source = PrometheusSource(prometheus_url)
+    else:
+        source = TpuRuntimeSource()
+    syncer = MetricSyncer(dealer, client, source, policy)
+    syncer.start()
+    log.info(
+        "load-aware metric sync started (source=%s)",
+        type(source).__name__,
+    )
+    return syncer

@@ -1,0 +1,5 @@
+from nanotpu.dealer.dealer import BindError, Dealer, plan_from_pod
+from nanotpu.dealer.nodeinfo import NodeInfo
+from nanotpu.dealer.usage import ChipUsageSample, UsageStore
+
+__all__ = ["Dealer", "BindError", "plan_from_pod", "NodeInfo", "UsageStore", "ChipUsageSample"]

@@ -1,0 +1,118 @@
+"""Per-node allocation state + plan cache (rebuild of ``pkg/dealer/node.go``).
+
+The reference's NodeInfo holds a flat card array and a plan cache keyed by
+demand hash (node.go:18-42); ours holds a :class:`ChipSet` on the node's ICI
+torus and adds a per-node lock so Assume/Score/Bind on *different* nodes never
+serialize (the reference funneled every verb through one global mutex,
+dealer.go:81 — the documented p50 bottleneck, SURVEY §6).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from nanotpu import types
+from nanotpu.allocator.core import ChipSet, Demand, Plan
+from nanotpu.allocator.rater import Rater
+from nanotpu.k8s.objects import Node
+from nanotpu.topology import DEFAULT_HOST_TOPOLOGY
+from nanotpu.utils import node as nodeutil
+
+
+class NodeInfo:
+    """Chip accounting for one node, with a demand-hash plan cache."""
+
+    def __init__(self, node: Node):
+        self.name = node.name
+        self.lock = threading.RLock()
+        chip_count = nodeutil.get_chip_count(node)
+        generation = node.labels.get(types.LABEL_TPU_GENERATION, "v5p")
+        topo = node.labels.get(
+            types.LABEL_TPU_TOPOLOGY, DEFAULT_HOST_TOPOLOGY.get(generation)
+        )
+        self.generation = generation
+        self.slice_name = node.labels.get(types.LABEL_TPU_SLICE, "")
+        self.slice_coords = node.labels.get(types.LABEL_TPU_SLICE_COORDS, "")
+        self.chips = ChipSet.for_node(chip_count, topo, generation)
+        self.chips.key = self.name
+        #: demand hash -> Plan (node.go:20,44-57)
+        self._plan_cache: dict[str, Plan] = {}
+
+    # -- verbs -------------------------------------------------------------
+    def assume(self, demand: Demand, rater: Rater) -> Plan | None:
+        """Compute (or re-use) a plan for this demand (node.go:44-57).
+
+        Returns None when infeasible. The plan is cached so the immediately
+        following Score and Bind reuse it without re-packing.
+        """
+        with self.lock:
+            key = demand.hash()
+            cached = self._plan_cache.get(key)
+            if cached is not None:
+                return cached
+            if not self.chips.can_fit(demand):
+                return None
+            plan = rater.choose(self.chips, demand)
+            if plan is not None:
+                self._plan_cache[key] = plan
+            return plan
+
+    def score(self, demand: Demand, rater: Rater) -> int:
+        """Cached plan's score; recompute on miss; SCORE_MIN when infeasible
+        (node.go:59-68)."""
+        plan = self.assume(demand, rater)
+        return plan.score if plan is not None else types.SCORE_MIN
+
+    def bind(self, demand: Demand, rater: Rater) -> Plan | None:
+        """Apply the (cached or recomputed) plan to chip accounting and drop
+        the cache — the node's state changed (node.go:70-84)."""
+        with self.lock:
+            plan = self.assume(demand, rater)
+            if plan is None:
+                return None
+            self.chips.allocate(plan)
+            self._plan_cache.clear()
+            return plan
+
+    def unbind(self, plan: Plan) -> None:
+        """Undo a bind whose API write failed (the reference leaked the
+        allocation until Release in this case)."""
+        with self.lock:
+            self.chips.release(plan)
+            self._plan_cache.clear()
+
+    def allocate(self, plan: Plan) -> None:
+        """Account an externally-learned placement (reconciler/boot replay,
+        node.go:86-89)."""
+        with self.lock:
+            self.chips.allocate(plan)
+            self._plan_cache.clear()
+
+    def release(self, plan: Plan) -> None:
+        """Return a completed pod's chips (node.go:91-94)."""
+        with self.lock:
+            self.chips.release(plan)
+            self._plan_cache.clear()
+
+    # -- metrics ingestion -------------------------------------------------
+    def set_chip_load(self, chip: int, load: float) -> None:
+        with self.lock:
+            if 0 <= chip < len(self.chips.chips):
+                self.chips.chips[chip].load = max(0.0, min(1.0, load))
+                # load shifts rater scores; cached plans are stale
+                self._plan_cache.clear()
+
+    # -- introspection -----------------------------------------------------
+    def status(self) -> dict:
+        with self.lock:
+            avail, free = self.chips.available_percent_and_free_chips()
+            return {
+                "name": self.name,
+                "generation": self.generation,
+                "topology": "x".join(map(str, self.chips.torus.dims)),
+                "slice": self.slice_name,
+                "usage": round(self.chips.usage(), 4),
+                "available_percent": avail,
+                "free_chips": free,
+                "chips": self.chips.snapshot(),
+            }

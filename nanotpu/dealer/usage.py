@@ -1,0 +1,92 @@
+"""Live chip-usage store for load-aware scheduling.
+
+Rebuild of ``pkg/dealer/nodeusage.go`` + the staleness logic of
+``pkg/dealer/stats.go``. Two deliberate fixes:
+
+* timestamps are UTC epoch seconds — the reference compared against
+  wall-clock in a hardcoded Asia/Shanghai zone (stats.go:36, type.go:13);
+* one lock per store, no unlocked getter variants (nodeusage.go:48-56 were
+  fragile).
+
+Values are utilization fractions in [0, 1]; out-of-range and stale samples
+read as 0 (scheduling must degrade to load-blind, never crash).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+#: Grace added to a policy's sync period when judging staleness
+#: (reference: 5 min, type.go:6).
+STALENESS_GRACE_S = 300.0
+
+
+@dataclass
+class ChipUsageSample:
+    core: float = 0.0
+    memory: float = 0.0
+    updated_at: float = 0.0  # epoch seconds, UTC
+
+
+class UsageStore:
+    """node -> chip -> latest usage sample."""
+
+    def __init__(self, window_s: float = 15.0):
+        self._lock = threading.Lock()
+        self._data: dict[str, dict[int, ChipUsageSample]] = {}
+        #: expected sync period; staleness cutoff = window + grace
+        self.window_s = window_s
+
+    def update(
+        self,
+        node: str,
+        chip: int,
+        core: float | None = None,
+        memory: float | None = None,
+        now: float | None = None,
+    ) -> None:
+        ts = time.time() if now is None else now
+        with self._lock:
+            sample = self._data.setdefault(node, {}).setdefault(
+                chip, ChipUsageSample()
+            )
+            if core is not None:
+                sample.core = core
+            if memory is not None:
+                sample.memory = memory
+            sample.updated_at = ts
+
+    def effective_load(self, node: str, chip: int, now: float | None = None) -> float:
+        """Usable load signal for scoring: max(core, memory) utilization,
+        0 when absent, stale, or out of range (nodeusage.go:82-111)."""
+        ts = time.time() if now is None else now
+        with self._lock:
+            sample = self._data.get(node, {}).get(chip)
+        if sample is None:
+            return 0.0
+        if ts - sample.updated_at > self.window_s + STALENESS_GRACE_S:
+            return 0.0
+        load = max(sample.core, sample.memory)
+        if not 0.0 <= load <= 1.0:
+            return 0.0
+        return load
+
+    def forget_node(self, node: str) -> None:
+        with self._lock:
+            self._data.pop(node, None)
+
+    def snapshot(self) -> dict[str, dict[int, dict]]:
+        with self._lock:
+            return {
+                node: {
+                    chip: {
+                        "core": s.core,
+                        "memory": s.memory,
+                        "updated_at": s.updated_at,
+                    }
+                    for chip, s in chips.items()
+                }
+                for node, chips in self._data.items()
+            }

@@ -1,0 +1,244 @@
+"""Kubernetes clientset interface + in-memory fake.
+
+The reference uses client-go informers/listers and typed clients
+(``cmd/main.go:42-61``, ``pkg/dealer/dealer.go:45-72``). We define the small
+surface the scheduler actually needs and provide:
+
+* :class:`FakeClientset` — in-memory, with resourceVersion bumping, optimistic
+  -concurrency conflicts, and watch streams. This is the test harness the
+  reference never had (its client-go paths were untested, SURVEY §4) and the
+  backend for bench.py's mock clusters.
+* :class:`RestClientset` (``rest.py``) — a stdlib-only REST client for real
+  API servers, used in-cluster.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Protocol
+
+from nanotpu.k8s.objects import Node, Pod
+
+
+class ApiError(Exception):
+    """Base for API failures."""
+
+    def __init__(self, message: str, code: int = 500):
+        super().__init__(message)
+        self.code = code
+
+
+class NotFoundError(ApiError):
+    def __init__(self, message: str):
+        super().__init__(message, code=404)
+
+
+class ConflictError(ApiError):
+    """Optimistic-lock failure on update (the reference retried on the
+    'please apply your changes to the latest version' message,
+    ``pkg/dealer/dealer.go:178-186``)."""
+
+    def __init__(self, message: str):
+        super().__init__(message, code=409)
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    obj: Any  # Pod | Node
+
+
+class Clientset(Protocol):
+    def get_pod(self, namespace: str, name: str) -> Pod: ...
+
+    def list_pods(self, label_selector: dict[str, str] | None = None) -> list[Pod]: ...
+
+    def update_pod(self, pod: Pod) -> Pod: ...
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None: ...
+
+    def get_node(self, name: str) -> Node: ...
+
+    def list_nodes(self) -> list[Node]: ...
+
+    def watch_pods(self) -> "Watch": ...
+
+    def watch_nodes(self) -> "Watch": ...
+
+
+class Watch:
+    """A watch stream: blocking iterator of WatchEvents with a stop()."""
+
+    def __init__(self):
+        self._q: "queue.Queue[WatchEvent | None]" = queue.Queue()
+        self._stopped = threading.Event()
+
+    def push(self, event: WatchEvent) -> None:
+        if not self._stopped.is_set():
+            self._q.put(event)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._q.put(None)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> WatchEvent:
+        item = self._q.get()
+        if item is None:
+            raise StopIteration
+        return item
+
+    def poll(self, timeout: float = 0.1) -> WatchEvent | None:
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return item
+
+
+def _matches(labels: dict[str, str], selector: dict[str, str] | None) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class FakeClientset:
+    """In-memory API server with watches and optimistic concurrency."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pods: dict[str, dict] = {}  # key ns/name -> raw
+        self._nodes: dict[str, dict] = {}
+        self._rv = itertools.count(start=2)
+        self._pod_watches: list[Watch] = []
+        self._node_watches: list[Watch] = []
+        #: (namespace, name, node) tuples recorded by bind_pod
+        self.bindings: list[tuple[str, str, str]] = []
+        #: fault injection hooks: callables raising to simulate API failures
+        self.before_update_pod: Callable[[Pod], None] | None = None
+        self.before_bind: Callable[[str, str, str], None] | None = None
+
+    # -- helpers -----------------------------------------------------------
+    def _bump(self, raw: dict) -> dict:
+        raw.setdefault("metadata", {})["resourceVersion"] = str(next(self._rv))
+        return raw
+
+    def _notify(self, watches: list[Watch], event: WatchEvent) -> None:
+        for w in list(watches):
+            w.push(event)
+
+    # -- pods --------------------------------------------------------------
+    def create_pod(self, pod: Pod) -> Pod:
+        with self._lock:
+            key = pod.key()
+            if key in self._pods:
+                raise ApiError(f"pod {key} already exists", code=409)
+            raw = self._bump(copy.deepcopy(pod.raw))
+            self._pods[key] = raw
+            out = Pod(copy.deepcopy(raw))
+            self._notify(self._pod_watches, WatchEvent("ADDED", out))
+            return out
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            if key not in self._pods:
+                raise NotFoundError(f"pod {key} not found")
+            return Pod(copy.deepcopy(self._pods[key]))
+
+    def list_pods(self, label_selector: dict[str, str] | None = None) -> list[Pod]:
+        with self._lock:
+            return [
+                Pod(copy.deepcopy(raw))
+                for raw in self._pods.values()
+                if _matches((raw.get("metadata") or {}).get("labels") or {}, label_selector)
+            ]
+
+    def update_pod(self, pod: Pod) -> Pod:
+        if self.before_update_pod:
+            self.before_update_pod(pod)
+        with self._lock:
+            key = pod.key()
+            if key not in self._pods:
+                raise NotFoundError(f"pod {key} not found")
+            current = self._pods[key]
+            cur_rv = (current.get("metadata") or {}).get("resourceVersion", "")
+            if pod.resource_version != cur_rv:
+                raise ConflictError(
+                    f"Operation cannot be fulfilled on pods {key!r}: please "
+                    f"apply your changes to the latest version and try again"
+                )
+            raw = self._bump(copy.deepcopy(pod.raw))
+            self._pods[key] = raw
+            out = Pod(copy.deepcopy(raw))
+            self._notify(self._pod_watches, WatchEvent("MODIFIED", out))
+            return out
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            if key not in self._pods:
+                raise NotFoundError(f"pod {key} not found")
+            raw = self._pods.pop(key)
+            self._notify(self._pod_watches, WatchEvent("DELETED", Pod(raw)))
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        """pods/binding subresource (dealer.go:191-199)."""
+        if self.before_bind:
+            self.before_bind(namespace, name, node_name)
+        with self._lock:
+            key = f"{namespace}/{name}"
+            if key not in self._pods:
+                raise NotFoundError(f"pod {key} not found")
+            raw = self._pods[key]
+            raw.setdefault("spec", {})["nodeName"] = node_name
+            self._bump(raw)
+            self.bindings.append((namespace, name, node_name))
+            self._notify(
+                self._pod_watches, WatchEvent("MODIFIED", Pod(copy.deepcopy(raw)))
+            )
+
+    # -- nodes -------------------------------------------------------------
+    def create_node(self, node: Node) -> Node:
+        with self._lock:
+            raw = self._bump(copy.deepcopy(node.raw))
+            self._nodes[node.name] = raw
+            out = Node(copy.deepcopy(raw))
+            self._notify(self._node_watches, WatchEvent("ADDED", out))
+            return out
+
+    def get_node(self, name: str) -> Node:
+        with self._lock:
+            if name not in self._nodes:
+                raise NotFoundError(f"node {name} not found")
+            return Node(copy.deepcopy(self._nodes[name]))
+
+    def list_nodes(self) -> list[Node]:
+        with self._lock:
+            return [Node(copy.deepcopy(raw)) for raw in self._nodes.values()]
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            if name not in self._nodes:
+                raise NotFoundError(f"node {name} not found")
+            raw = self._nodes.pop(name)
+            self._notify(self._node_watches, WatchEvent("DELETED", Node(raw)))
+
+    # -- watches -----------------------------------------------------------
+    def watch_pods(self) -> Watch:
+        with self._lock:
+            w = Watch()
+            self._pod_watches.append(w)
+            return w
+
+    def watch_nodes(self) -> Watch:
+        with self._lock:
+            w = Watch()
+            self._node_watches.append(w)
+            return w

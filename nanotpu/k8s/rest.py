@@ -1,0 +1,195 @@
+"""Real API-server client over stdlib HTTP (in-cluster or kubeconfig token).
+
+The reference built a client-go Clientset from $KUBECONFIG or the in-cluster
+service account (cmd/main.go:42-61). We implement the same two auth paths with
+urllib — no external deps — against the handful of endpoints the scheduler
+needs (get/list/update pods, bind subresource, get/list nodes, watch).
+
+Watch uses the chunked ``?watch=true`` stream of JSON lines. TLS verification
+uses the cluster CA when present.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+import threading
+import urllib.error
+import urllib.request
+
+from nanotpu.k8s.client import (
+    ApiError,
+    ConflictError,
+    NotFoundError,
+    Watch,
+    WatchEvent,
+)
+from nanotpu.k8s.objects import Node, Pod
+
+log = logging.getLogger("nanotpu.k8s.rest")
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class RestClientset:
+    def __init__(self, base_url: str, token: str = "", ca_path: str | None = None):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        if ca_path and os.path.exists(ca_path):
+            self._ctx = ssl.create_default_context(cafile=ca_path)
+        elif base_url.startswith("https"):
+            self._ctx = ssl.create_default_context()
+        else:
+            self._ctx = None
+
+    @staticmethod
+    def from_env(kubeconfig: str = "") -> "RestClientset":
+        """In-cluster service account, else $KUBECONFIG (token-auth contexts
+        only — client-cert kubeconfigs need a real kubectl proxy)."""
+        token_path = os.path.join(SA_DIR, "token")
+        if os.path.exists(token_path):
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            with open(token_path) as f:
+                token = f.read().strip()
+            return RestClientset(
+                f"https://{host}:{port}", token, os.path.join(SA_DIR, "ca.crt")
+            )
+        if kubeconfig and os.path.exists(kubeconfig):
+            import yaml
+
+            with open(kubeconfig) as f:
+                cfg = yaml.safe_load(f)
+            ctx_name = cfg.get("current-context")
+            ctx = next(
+                c["context"] for c in cfg["contexts"] if c["name"] == ctx_name
+            )
+            cluster = next(
+                c["cluster"] for c in cfg["clusters"] if c["name"] == ctx["cluster"]
+            )
+            user = next(u["user"] for u in cfg["users"] if u["name"] == ctx["user"])
+            token = user.get("token", "")
+            if not token:
+                raise ApiError(
+                    "kubeconfig user has no bearer token; use `kubectl proxy` "
+                    "and point --kubeconfig at a token context"
+                )
+            return RestClientset(cluster["server"], token)
+        raise ApiError(
+            "no in-cluster service account and no usable kubeconfig; "
+            "run with --mock N for a local cluster"
+        )
+
+    # -- plumbing ----------------------------------------------------------
+    def _request(self, method: str, path: str, body: dict | None = None) -> dict:
+        req = urllib.request.Request(
+            self.base_url + path,
+            method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+        )
+        req.add_header("Accept", "application/json")
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, context=self._ctx, timeout=30) as resp:
+                data = resp.read()
+                return json.loads(data) if data else {}
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            if e.code == 404:
+                raise NotFoundError(detail) from e
+            if e.code == 409:
+                raise ConflictError(detail) from e
+            raise ApiError(f"HTTP {e.code}: {detail}", code=e.code) from e
+        except urllib.error.URLError as e:
+            raise ApiError(f"API server unreachable: {e}") from e
+
+    # -- pods --------------------------------------------------------------
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        return Pod(self._request("GET", f"/api/v1/namespaces/{namespace}/pods/{name}"))
+
+    def list_pods(self, label_selector: dict[str, str] | None = None) -> list[Pod]:
+        path = "/api/v1/pods"
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            path += f"?labelSelector={urllib.request.quote(sel)}"
+        out = self._request("GET", path)
+        return [Pod(item) for item in out.get("items", [])]
+
+    def update_pod(self, pod: Pod) -> Pod:
+        return Pod(
+            self._request(
+                "PUT",
+                f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}",
+                pod.raw,
+            )
+        )
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        """pods/binding subresource (dealer.go:191-199)."""
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+            {
+                "apiVersion": "v1",
+                "kind": "Binding",
+                "metadata": {"name": name, "namespace": namespace},
+                "target": {"apiVersion": "v1", "kind": "Node", "name": node_name},
+            },
+        )
+
+    # -- nodes -------------------------------------------------------------
+    def get_node(self, name: str) -> Node:
+        return Node(self._request("GET", f"/api/v1/nodes/{name}"))
+
+    def list_nodes(self) -> list[Node]:
+        out = self._request("GET", "/api/v1/nodes")
+        return [Node(item) for item in out.get("items", [])]
+
+    # -- watches -----------------------------------------------------------
+    def _watch(self, path: str, wrap) -> Watch:
+        """Long-lived watch that RECONNECTS: the API server closes every
+        watch at its request timeout, and client-go informers transparently
+        re-establish — a stream that dies permanently would silently stop
+        all reconciliation (pods never released, nodes filling forever).
+        Only Watch.stop() by the consumer ends the loop."""
+        watch = Watch()
+
+        def run():
+            backoff = 1.0
+            while not watch._stopped.is_set():
+                req = urllib.request.Request(self.base_url + path)
+                if self.token:
+                    req.add_header("Authorization", f"Bearer {self.token}")
+                try:
+                    with urllib.request.urlopen(req, context=self._ctx) as resp:
+                        backoff = 1.0
+                        for line in resp:
+                            if watch._stopped.is_set():
+                                return
+                            if not line.strip():
+                                continue
+                            evt = json.loads(line)
+                            watch.push(
+                                WatchEvent(
+                                    evt.get("type", ""), wrap(evt.get("object", {}))
+                                )
+                            )
+                except Exception as e:
+                    log.warning("watch %s dropped (%s); reconnecting", path, e)
+                    if watch._stopped.wait(backoff):
+                        return
+                    backoff = min(backoff * 2, 30.0)
+
+        threading.Thread(target=run, daemon=True, name=f"watch{path}").start()
+        return watch
+
+    def watch_pods(self) -> Watch:
+        return self._watch("/api/v1/pods?watch=true", Pod)
+
+    def watch_nodes(self) -> Watch:
+        return self._watch("/api/v1/nodes?watch=true", Node)

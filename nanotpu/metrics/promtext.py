@@ -1,0 +1,65 @@
+"""Prometheus text-exposition parser (consumer side).
+
+The TPU runtime (libtpu) exposes its metrics endpoint in Prometheus text
+format (duty cycle, HBM usage, per-chip) — the TPU-native replacement for the
+reference's DCGM-via-Prometheus pipeline (``pkg/prometheus``). stdlib-only.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^\s]+)"
+)
+_LABEL_RE = re.compile(r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:\\.|[^"\\])*)"')
+
+
+@dataclass(frozen=True)
+class Sample:
+    name: str
+    labels: dict
+    value: float
+
+    def label(self, key: str, default: str = "") -> str:
+        return self.labels.get(key, default)
+
+
+def parse_prometheus_text(text: str) -> list[Sample]:
+    """Parse exposition text into samples; malformed lines are skipped (a
+    scrape must degrade, never raise)."""
+    out: list[Sample] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        if math.isnan(value):
+            continue
+        labels = {}
+        if m.group("labels"):
+            labels = {
+                lm.group("k"): lm.group("v").replace('\\"', '"')
+                for lm in _LABEL_RE.finditer(m.group("labels"))
+            }
+        out.append(Sample(m.group("name"), labels, value))
+    return out
+
+
+def find_sample(
+    samples: list[Sample], name: str, **labels: str
+) -> Sample | None:
+    for s in samples:
+        if s.name == name and all(s.labels.get(k) == v for k, v in labels.items()):
+            return s
+    return None

@@ -1,0 +1,184 @@
+"""Scheduling-policy config: YAML schema + hot reload.
+
+Rebuild of ``pkg/dealer/type.go`` + ``pkg/dealer/stats.go`` +
+``pkg/context/context.go`` with two deliberate fixes:
+
+* staleness windows are computed in UTC from epoch seconds — the reference
+  hardcoded Asia/Shanghai wall-clock (stats.go:36, type.go:13);
+* hot reload actually reaches consumers: they hold a :class:`PolicyWatcher`
+  and call ``spec()`` per use. The reference's main() copied the spec ONCE
+  into the verb closures (main.go:118), dead-ending its own 3s mtime poller
+  (context.go:44-59).
+
+Schema (ConfigMap ``deploy/policy-config.yaml``, mirroring
+dynamic-scheduler-node-annotator-cm.yaml:7-16):
+
+    policy:
+      syncPeriod:
+        - name: tpu_tensorcore_utilization
+          period: 15s
+        - name: tpu_hbm_usage
+          period: 15s
+      priority:
+        - name: tpu_tensorcore_utilization
+          weight: 0.6
+        - name: tpu_hbm_usage
+          weight: 0.4
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+from dataclasses import dataclass, field
+
+import yaml
+
+log = logging.getLogger("nanotpu.policy")
+
+#: Metric names (reference: gpu_core_usage_avg / gpu_memory_usage_avg,
+#: type.go:7-8) renamed for the TPU runtime's vocabulary.
+METRIC_CORE = "tpu_tensorcore_utilization"
+METRIC_HBM = "tpu_hbm_usage"
+
+_DURATION_RE = re.compile(r"^\s*(\d+)\s*(ms|s|m|h)?\s*$")
+_DURATION_MULT = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, None: 1.0}
+
+
+def parse_duration(spec: str | int | float) -> float:
+    """'15s' / '2m' / 15 -> seconds. Raises ValueError on garbage."""
+    if isinstance(spec, (int, float)):
+        return float(spec)
+    m = _DURATION_RE.match(str(spec))
+    if not m:
+        raise ValueError(f"bad duration {spec!r}")
+    return int(m.group(1)) * _DURATION_MULT[m.group(2)]
+
+
+@dataclass(frozen=True)
+class SyncPeriod:
+    name: str
+    period_s: float
+
+
+@dataclass(frozen=True)
+class PriorityWeight:
+    name: str
+    weight: float
+
+
+@dataclass(frozen=True)
+class PolicySpec:
+    sync_periods: tuple[SyncPeriod, ...] = ()
+    priorities: tuple[PriorityWeight, ...] = ()
+
+    def period_for(self, metric: str, default: float = 15.0) -> float:
+        for sp in self.sync_periods:
+            if sp.name == metric:
+                return sp.period_s
+        return default
+
+    def weight_for(self, metric: str, default: float = 0.5) -> float:
+        for pw in self.priorities:
+            if pw.name == metric:
+                return pw.weight
+        return default
+
+    @staticmethod
+    def default() -> "PolicySpec":
+        return PolicySpec(
+            sync_periods=(
+                SyncPeriod(METRIC_CORE, 15.0),
+                SyncPeriod(METRIC_HBM, 15.0),
+            ),
+            priorities=(
+                PriorityWeight(METRIC_CORE, 0.6),
+                PriorityWeight(METRIC_HBM, 0.4),
+            ),
+        )
+
+
+def parse_policy(text: str) -> PolicySpec:
+    """YAML -> PolicySpec. Raises ValueError on malformed input (the
+    reference PANICKED on a bad file, stats.go:13-28)."""
+    try:
+        doc = yaml.safe_load(text)
+    except yaml.YAMLError as e:
+        raise ValueError(f"policy YAML parse error: {e}") from e
+    if not doc:
+        # empty docs are rejected rather than read as "no policy": the hot-
+        # reload poller can catch a ConfigMap file mid-rewrite (truncated),
+        # and swallowing that would silently wipe the active policy
+        raise ValueError("policy document is empty")
+    body = doc.get("policy") if isinstance(doc, dict) else None
+    if body is None:
+        body = doc
+    if not isinstance(body, dict):
+        raise ValueError("policy document must be a mapping")
+    if "syncPeriod" not in body and "priority" not in body:
+        # any YAML mapping parses "successfully"; require at least one known
+        # key so unrelated/garbage files don't silently become empty policy
+        raise ValueError("policy document has neither syncPeriod nor priority")
+    periods = []
+    for entry in body.get("syncPeriod") or []:
+        try:
+            periods.append(
+                SyncPeriod(str(entry["name"]), parse_duration(entry["period"]))
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"bad syncPeriod entry {entry!r}: {e}") from e
+    weights = []
+    for entry in body.get("priority") or []:
+        try:
+            weights.append(
+                PriorityWeight(str(entry["name"]), float(entry["weight"]))
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"bad priority entry {entry!r}: {e}") from e
+    return PolicySpec(sync_periods=tuple(periods), priorities=tuple(weights))
+
+
+class PolicyWatcher:
+    """mtime-polling hot reload (context.go:26-59). Consumers call
+    ``spec()`` on every use, so reloads take effect — fixing the reference's
+    one-shot copy (main.go:118). A bad reload keeps the last good spec."""
+
+    def __init__(self, path: str = "", poll_s: float = 3.0):
+        self.path = path
+        self.poll_s = poll_s
+        self._lock = threading.Lock()
+        self._spec = PolicySpec.default()
+        self._mtime = 0.0
+        self._stop = threading.Event()
+        if path:
+            self._load(initial=True)
+            threading.Thread(
+                target=self._poll, daemon=True, name="policy-reload"
+            ).start()
+
+    def spec(self) -> PolicySpec:
+        with self._lock:
+            return self._spec
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _load(self, initial: bool = False) -> None:
+        try:
+            mtime = os.path.getmtime(self.path)
+            if not initial and mtime == self._mtime:
+                return
+            with open(self.path) as f:
+                spec = parse_policy(f.read())
+            with self._lock:
+                self._spec = spec
+                self._mtime = mtime
+            log.info("policy loaded from %s", self.path)
+        except (OSError, ValueError) as e:
+            log.error("policy load failed (%s); keeping last good spec", e)
+
+    def _poll(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self._load()

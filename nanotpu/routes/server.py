@@ -1,0 +1,185 @@
+"""HTTP routes: the kube-scheduler-facing API surface.
+
+Rebuild of ``pkg/routes/routes.go`` + ``pprof.go`` on stdlib
+ThreadingHTTPServer:
+
+* POST /scheduler/filter | /scheduler/priorities | /scheduler/bind
+* POST /status            — full dealer state dump (routes.go:212-240)
+* GET  /version           — version string (routes.go:172-178)
+* GET  /healthz           — liveness
+* GET  /metrics           — Prometheus exposition (NEW: the reference had no
+  exporter, SURVEY §5; occupancy + verb latency histograms live here)
+* GET  /debug/pprof/...   — profiling endpoints (pprof.go:10-22): Python
+  equivalents (thread dump, cProfile over a window, tracemalloc heap)
+
+Error handling: malformed JSON or handler errors return structured JSON with
+HTTP 400/500 — the reference panicked on bad Prioritize input
+(routes.go:103,108).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from nanotpu.dealer import Dealer
+from nanotpu.metrics.registry import Registry
+from nanotpu.scheduler.verbs import Bind, Predicate, Prioritize, VerbError
+
+log = logging.getLogger("nanotpu.routes")
+
+VERSION = "0.1.0"
+
+
+class SchedulerAPI:
+    """Wires verbs + metrics; handler-agnostic so tests can call dispatch()
+    without sockets and the bench can measure the exact request path."""
+
+    def __init__(self, dealer: Dealer, registry: Registry | None = None):
+        self.dealer = dealer
+        self.registry = registry or Registry()
+        self.predicate = Predicate(dealer)
+        self.prioritize = Prioritize(dealer)
+        self.bind = Bind(dealer)
+        r = self.registry
+        self.verb_latency = r.histogram(
+            "nanotpu_verb_latency_seconds", "Latency of extender verbs"
+        )
+        self.verb_total = r.counter(
+            "nanotpu_verb_requests_total", "Extender verb requests"
+        )
+        self.occupancy_gauge = r.gauge(
+            "nanotpu_chip_occupancy_ratio",
+            "Cluster-wide TPU chip occupancy (allocated percent / capacity)",
+        )
+        self.occupancy_gauge.set_function(dealer.occupancy)
+
+    # -- request dispatch --------------------------------------------------
+    def dispatch(self, method: str, path: str, body: bytes) -> tuple[int, str, str]:
+        """Returns (http status, content-type, payload)."""
+        try:
+            if method == "POST" and path == "/scheduler/filter":
+                return self._verb(self.predicate, body)
+            if method == "POST" and path == "/scheduler/priorities":
+                return self._verb(self.prioritize, body)
+            if method == "POST" and path == "/scheduler/bind":
+                return self._verb(self.bind, body)
+            if method == "POST" and path == "/status":
+                return 200, "application/json", json.dumps(self.dealer.status())
+            if method == "GET" and path == "/version":
+                return 200, "application/json", json.dumps({"version": VERSION})
+            if method == "GET" and path == "/healthz":
+                return 200, "text/plain", "ok"
+            if method == "GET" and path == "/metrics":
+                return 200, "text/plain; version=0.0.4", self.registry.render()
+            if method == "GET" and path.startswith("/debug/pprof"):
+                return self._pprof(path)
+            return 404, "application/json", json.dumps({"error": f"no route {path}"})
+        except Exception:  # never let a request kill the scheduler
+            log.exception("unhandled error on %s %s", method, path)
+            return (
+                500,
+                "application/json",
+                json.dumps({"error": traceback.format_exc(limit=3)}),
+            )
+
+    def _verb(self, verb, body: bytes) -> tuple[int, str, str]:
+        started = time.perf_counter()
+        code = 200
+        try:
+            try:
+                args = json.loads(body or b"{}")
+            except json.JSONDecodeError as e:
+                code = 400
+                return 400, "application/json", json.dumps(
+                    {"Error": f"malformed JSON: {e}"}
+                )
+            try:
+                result = verb.handle(args)
+            except VerbError as e:
+                code = 400
+                return 400, "application/json", json.dumps({"Error": str(e)})
+            return 200, "application/json", json.dumps(result)
+        finally:
+            elapsed = time.perf_counter() - started
+            self.verb_latency.observe(elapsed, verb=verb.name)
+            self.verb_total.inc(verb=verb.name, code=str(code))
+
+    # -- pprof equivalents (pkg/routes/pprof.go) ---------------------------
+    def _pprof(self, path: str) -> tuple[int, str, str]:
+        if path.endswith("/goroutine") or path.endswith("/threads"):
+            frames = sys._current_frames()
+            out = []
+            for tid, frame in frames.items():
+                out.append(f"--- thread {tid} ---")
+                out.extend(s.rstrip() for s in traceback.format_stack(frame))
+            return 200, "text/plain", "\n".join(out)
+        if path.endswith("/profile"):
+            # CPU profile over a short window. cProfile instruments only the
+            # calling thread, so this samples OTHER threads via their frames
+            # at intervals — a poor man's wall profiler that, unlike a naive
+            # cProfile.enable() here, actually sees verb-handler work.
+            samples: dict[str, int] = {}
+            deadline = time.time() + 1.0
+            me = threading.get_ident()
+            while time.time() < deadline:
+                for tid, frame in sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    stack = traceback.extract_stack(frame)
+                    if stack:
+                        top = stack[-1]
+                        key = f"{top.filename}:{top.lineno} {top.name}"
+                        samples[key] = samples.get(key, 0) + 1
+                time.sleep(0.005)
+            lines = [
+                f"{count:6d} {where}"
+                for where, count in sorted(samples.items(), key=lambda kv: -kv[1])
+            ]
+            return 200, "text/plain", "samples (5ms interval, 1s window):\n" + "\n".join(lines[:60])
+        if path.endswith("/heap"):
+            import tracemalloc
+
+            if not tracemalloc.is_tracing():
+                tracemalloc.start()
+                return 200, "text/plain", "tracemalloc started; scrape again"
+            snap = tracemalloc.take_snapshot()
+            lines = [str(s) for s in snap.statistics("lineno")[:40]]
+            return 200, "text/plain", "\n".join(lines)
+        return 200, "text/plain", "pprof: /goroutine /profile /heap"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api: SchedulerAPI  # injected by serve()
+
+    def _respond(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        code, ctype, payload = self.api.dispatch(self.command, self.path, body)
+        data = payload.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    do_GET = _respond
+    do_POST = _respond
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        log.debug("%s %s", self.address_string(), fmt % args)
+
+
+def serve(api: SchedulerAPI, port: int, host: str = "0.0.0.0") -> ThreadingHTTPServer:
+    """Start the HTTP server on a daemon thread; returns the server handle
+    (cmd/main.go:125-136's ListenAndServe)."""
+    handler = type("BoundHandler", (_Handler,), {"api": api})
+    server = ThreadingHTTPServer((host, port), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True, name="http")
+    thread.start()
+    return server

@@ -1,0 +1,133 @@
+"""Extender verb adapters: kube-scheduler wire types <-> Dealer calls.
+
+Rebuild of ``pkg/scheduler/{predicate,priority,bind}.go``. The wire format is
+the ``k8s.io/kube-scheduler/extender/v1`` JSON the reference decodes with
+client-go structs (routes.go:40-170):
+
+* Filter:      POST ExtenderArgs{Pod, NodeNames}   -> ExtenderFilterResult
+* Prioritize:  POST ExtenderArgs{Pod, NodeNames}   -> HostPriorityList
+* Bind:        POST ExtenderBindingArgs            -> ExtenderBindingResult
+
+We are nodeCacheCapable (README.md:44-57 registers the extender that way), so
+NodeNames is the node source; full Node objects in ``Nodes.Items`` are
+accepted as a fallback. Malformed input returns a JSON error result — the
+reference *panicked* on bad Prioritize input (routes.go:103,108), a
+DoS-by-request on the scheduling path we do not replicate.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from nanotpu.dealer import BindError, Dealer
+from nanotpu.k8s.client import ApiError, NotFoundError
+from nanotpu.k8s.objects import Pod
+from nanotpu.utils import pod as podutil
+
+log = logging.getLogger("nanotpu.scheduler")
+
+
+class VerbError(Exception):
+    """Bad request payload; the route layer turns this into an error result."""
+
+
+def _extract(args: dict[str, Any]) -> tuple[Pod, list[str]]:
+    if not isinstance(args, dict):
+        raise VerbError("ExtenderArgs must be a JSON object")
+    pod_raw = args.get("Pod") or args.get("pod")
+    if not isinstance(pod_raw, dict):
+        raise VerbError("ExtenderArgs.Pod missing")
+    node_names = args.get("NodeNames") or args.get("nodeNames")
+    if node_names is None:
+        # nodeCacheCapable=false fallback: full objects (routes.go:63-68
+        # errored here; we accept both shapes)
+        nodes = args.get("Nodes") or args.get("nodes") or {}
+        items = nodes.get("Items") or nodes.get("items") or []
+        node_names = [
+            ((n.get("metadata") or {}).get("name") or "") for n in items
+        ]
+        node_names = [n for n in node_names if n]
+    if not isinstance(node_names, list):
+        raise VerbError("ExtenderArgs.NodeNames must be a list")
+    return Pod(pod_raw), [str(n) for n in node_names]
+
+
+class Predicate:
+    """Filter verb (predicate.go:19-41)."""
+
+    name = "filter"
+
+    def __init__(self, dealer: Dealer):
+        self.dealer = dealer
+
+    def handle(self, args: dict[str, Any]) -> dict[str, Any]:
+        pod, node_names = _extract(args)
+        if not podutil.is_tpu_sharing_pod(pod):
+            # not ours: pass every node through untouched
+            return {"NodeNames": node_names, "FailedNodes": {}, "Error": ""}
+        ok, failed = self.dealer.assume(node_names, pod)
+        return {"NodeNames": ok, "FailedNodes": failed, "Error": ""}
+
+
+class Prioritize:
+    """Priorities verb (priority.go:19-42)."""
+
+    name = "priorities"
+
+    def __init__(self, dealer: Dealer):
+        self.dealer = dealer
+
+    def handle(self, args: dict[str, Any]) -> list[dict[str, Any]]:
+        pod, node_names = _extract(args)
+        if not podutil.is_tpu_sharing_pod(pod):
+            return [{"Host": n, "Score": 0} for n in node_names]
+        return [
+            {"Host": name, "Score": score}
+            for name, score in self.dealer.score(node_names, pod)
+        ]
+
+
+class Bind:
+    """Bind verb (bind.go:26-82): fetch fresh pod, reject completed, verify
+    UID (one re-GET on mismatch), dealer.bind, log status."""
+
+    name = "bind"
+
+    def __init__(self, dealer: Dealer):
+        self.dealer = dealer
+
+    def handle(self, args: dict[str, Any]) -> dict[str, Any]:
+        if not isinstance(args, dict):
+            raise VerbError("ExtenderBindingArgs must be a JSON object")
+        name = args.get("PodName") or args.get("podName")
+        namespace = args.get("PodNamespace") or args.get("podNamespace") or "default"
+        uid = args.get("PodUID") or args.get("podUID") or ""
+        node = args.get("Node") or args.get("node")
+        if not name or not node:
+            raise VerbError("PodName and Node are required")
+        try:
+            pod = self._get_pod(namespace, name, uid)
+        except NotFoundError:
+            return {"Error": f"pod {namespace}/{name} not found"}
+        except ApiError as e:
+            return {"Error": f"get pod {namespace}/{name}: {e}"}
+        if podutil.is_completed_pod(pod):
+            return {"Error": f"pod {namespace}/{name} is already completed"}
+        try:
+            self.dealer.bind(node, pod)
+        except BindError as e:
+            return {"Error": str(e)}
+        log.info("bound %s/%s to %s", namespace, name, node)
+        return {"Error": ""}
+
+    def _get_pod(self, namespace: str, name: str, uid: str) -> Pod:
+        pod = self.dealer.client.get_pod(namespace, name)
+        if uid and pod.uid != uid:
+            # the reference re-GET here (bind.go:67-79) made sense against
+            # client-go's local cache; our GET is already uncached, so an
+            # identical immediate re-read cannot differ — fail directly
+            raise NotFoundError(
+                f"pod {namespace}/{name} UID mismatch: want {uid}, got {pod.uid}"
+            )
+        return pod

@@ -1,0 +1,101 @@
+"""Reconciler tests: watch events -> Dealer state convergence."""
+
+import time
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.cmd.main import make_mock_cluster
+from nanotpu.controller.controller import Controller
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.objects import make_container, make_pod
+
+
+def tpu_pod(name, percent=100, **kw):
+    return make_pod(
+        name,
+        containers=[make_container("main", {types.RESOURCE_TPU_PERCENT: percent})],
+        **kw,
+    )
+
+
+@pytest.fixture
+def running():
+    client = make_mock_cluster(2)
+    dealer = Dealer(client, make_rater("binpack"))
+    ctrl = Controller(client, dealer)
+    ctrl.start()
+    yield client, dealer, ctrl
+    ctrl.stop()
+
+
+def wait_for(predicate, timeout=3.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestReconcile:
+    def test_completed_pod_released(self, running):
+        client, dealer, ctrl = running
+        pod = client.create_pod(tpu_pod("p1", 300))
+        dealer.bind("v5p-host-0", pod)
+        assert dealer.status()["nodes"]["v5p-host-0"]["available_percent"] == 100
+        # the pod finishes
+        server = client.get_pod("default", "p1")
+        server.status["phase"] = "Succeeded"
+        client.update_pod(server)
+        assert wait_for(
+            lambda: dealer.status()["nodes"]["v5p-host-0"]["available_percent"] == 400
+        )
+
+    def test_externally_bound_pod_learned(self, running):
+        client, dealer, ctrl = running
+        # simulate a pod bound by a previous scheduler instance: annotations
+        # already present, running on the node
+        from nanotpu.utils.pod import annotated_pod
+
+        pod = tpu_pod("ext", 200, node_name="v5p-host-1", phase="Running")
+        pod = annotated_pod(pod, {"main": [0, 1]})
+        client.create_pod(pod)
+        assert wait_for(
+            lambda: "v5p-host-1" in dealer.status()["nodes"]
+            and dealer.status()["nodes"]["v5p-host-1"]["available_percent"] == 200
+        )
+
+    def test_deleted_pod_forgotten(self, running):
+        client, dealer, ctrl = running
+        pod = client.create_pod(tpu_pod("p2", 400))
+        dealer.bind("v5p-host-0", pod)
+        client.delete_pod("default", "p2")
+        assert wait_for(
+            lambda: dealer.status()["nodes"]["v5p-host-0"]["available_percent"] == 400
+        )
+        assert dealer.status()["assumed_pods"] == 0
+
+    def test_node_delete_evicts(self, running):
+        client, dealer, ctrl = running
+        dealer.assume(["v5p-host-0"], tpu_pod("probe", 100))
+        assert "v5p-host-0" in dealer.status()["nodes"]
+        client.delete_node("v5p-host-0")
+        assert wait_for(lambda: "v5p-host-0" not in dealer.status()["nodes"])
+
+    def test_startup_syncs_existing_pods(self):
+        client = make_mock_cluster(1)
+        from nanotpu.utils.pod import annotated_pod
+
+        pod = tpu_pod("old", 100, node_name="v5p-host-0", phase="Running")
+        client.create_pod(annotated_pod(pod, {"main": [2]}))
+        dealer = Dealer(client, make_rater("binpack"))
+        # dealer boot pre-warm already accounts it; controller must not
+        # double-allocate
+        ctrl = Controller(client, dealer)
+        ctrl.start()
+        assert ctrl.wait_idle()
+        st = dealer.status()["nodes"]["v5p-host-0"]
+        assert st["available_percent"] == 300
+        ctrl.stop()

@@ -1,0 +1,236 @@
+"""Dealer lifecycle tests against the fake clientset — the integration layer
+the reference never tested (its client-go paths had zero coverage, SURVEY §4).
+"""
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.rater import Binpack, Spread
+from nanotpu.dealer import BindError, Dealer, plan_from_pod
+from nanotpu.k8s.client import ApiError, FakeClientset
+from nanotpu.k8s.objects import make_container, make_node, make_pod
+from nanotpu.utils import pod as podutil
+
+
+def tpu_node(name="n1", chips=4, topology="2x2x1", labels=None):
+    base = {
+        types.LABEL_TPU_GENERATION: "v5p",
+        types.LABEL_TPU_TOPOLOGY: topology,
+    }
+    base.update(labels or {})
+    return make_node(
+        name, {types.RESOURCE_TPU_PERCENT: chips * 100}, labels=base
+    )
+
+
+def tpu_pod(name, percents=(20,), **kw):
+    return make_pod(
+        name,
+        containers=[
+            make_container(f"c{i}", {types.RESOURCE_TPU_PERCENT: p} if p else None)
+            for i, p in enumerate(percents)
+        ],
+        **kw,
+    )
+
+
+@pytest.fixture
+def cluster():
+    client = FakeClientset()
+    client.create_node(tpu_node("n1"))
+    client.create_node(tpu_node("n2"))
+    return client
+
+
+class TestAssumeScore:
+    def test_assume_partitions_nodes(self, cluster):
+        cluster.create_node(make_node("cpu-only", {}))
+        d = Dealer(cluster, Binpack())
+        pod = tpu_pod("p1", (50,))
+        ok, failed = d.assume(["n1", "n2", "cpu-only", "ghost"], pod)
+        assert sorted(ok) == ["n1", "n2"]
+        assert set(failed) == {"cpu-only", "ghost"}
+
+    def test_assume_infeasible_demand(self, cluster):
+        d = Dealer(cluster, Binpack())
+        ok, failed = d.assume(["n1"], tpu_pod("p1", (800,)))
+        assert ok == [] and "n1" in failed
+
+    def test_invalid_demand_rejected_everywhere(self, cluster):
+        d = Dealer(cluster, Binpack())
+        ok, failed = d.assume(["n1", "n2"], tpu_pod("p1", (250,)))
+        assert ok == [] and len(failed) == 2
+
+    def test_score_binpack_prefers_fuller_node(self, cluster):
+        d = Dealer(cluster, Binpack())
+        filler = tpu_pod("filler", (100, 100))
+        d.assume(["n1"], filler)
+        d.bind("n1", cluster.create_pod(filler))
+        scores = dict(d.score(["n1", "n2"], tpu_pod("p2", (50,))))
+        assert scores["n1"] > scores["n2"]
+
+    def test_score_spread_prefers_empty_node(self, cluster):
+        d = Dealer(cluster, Spread())
+        filler = tpu_pod("filler", (100, 100))
+        d.bind("n1", cluster.create_pod(filler))
+        scores = dict(d.score(["n1", "n2"], tpu_pod("p2", (50,))))
+        assert scores["n2"] > scores["n1"]
+
+
+class TestBind:
+    def test_bind_annotates_and_binds(self, cluster):
+        d = Dealer(cluster, Binpack())
+        pod = cluster.create_pod(tpu_pod("p2", (200, 30)))
+        bound = d.bind("n1", pod)
+        # binding recorded
+        assert ("default", "p2", "n1") in cluster.bindings
+        # annotations persisted server-side
+        server_pod = cluster.get_pod("default", "p2")
+        assert podutil.is_assumed(server_pod)
+        chips = podutil.get_assigned_chips(server_pod)
+        assert len(chips["c0"]) == 2 and len(chips["c1"]) == 1
+        assert server_pod.annotations[types.ANNOTATION_BOUND_POLICY] == "binpack"
+        # accounting reflects 230%
+        st = d.status()["nodes"]["n1"]
+        assert st["available_percent"] == 400 - 230
+
+    def test_bind_survives_conflict(self, cluster):
+        d = Dealer(cluster, Binpack())
+        pod = cluster.create_pod(tpu_pod("p1", (100,)))
+        # another actor updates the pod after our copy was taken
+        server = cluster.get_pod("default", "p1")
+        server.ensure_annotations()["unrelated"] = "yes"
+        cluster.update_pod(server)
+        bound = d.bind("n1", pod)  # stale resourceVersion in hand
+        server_pod = cluster.get_pod("default", "p1")
+        assert podutil.is_assumed(server_pod)
+        assert server_pod.annotations["unrelated"] == "yes"  # merged, not lost
+
+    def test_bind_failure_rolls_back_accounting(self, cluster):
+        d = Dealer(cluster, Binpack())
+        pod = cluster.create_pod(tpu_pod("p1", (100,)))
+
+        def boom(ns, name, node):
+            raise ApiError("binding rejected", code=500)
+
+        cluster.before_bind = boom
+        with pytest.raises(BindError):
+            d.bind("n1", pod)
+        st = d.status()["nodes"]["n1"]
+        assert st["available_percent"] == 400  # rolled back
+        cluster.before_bind = None
+        d.bind("n1", pod)  # recovers
+
+    def test_bind_update_error_propagates(self, cluster):
+        # the reference swallowed non-conflict update errors (dealer.go:188)
+        d = Dealer(cluster, Binpack())
+        pod = cluster.create_pod(tpu_pod("p1", (100,)))
+
+        def boom(p):
+            raise ApiError("webhook denied", code=500)
+
+        cluster.before_update_pod = boom
+        with pytest.raises(BindError):
+            d.bind("n1", pod)
+        assert d.status()["nodes"]["n1"]["available_percent"] == 400
+
+    def test_bind_infeasible_raises(self, cluster):
+        d = Dealer(cluster, Binpack())
+        pod = cluster.create_pod(tpu_pod("p1", (800,)))
+        with pytest.raises(BindError):
+            d.bind("n1", pod)
+
+
+class TestLifecycle:
+    def test_release_restores_and_is_idempotent(self, cluster):
+        d = Dealer(cluster, Binpack())
+        pod = cluster.create_pod(tpu_pod("p1", (300,)))
+        d.bind("n1", pod)
+        bound = cluster.get_pod("default", "p1")
+        assert d.status()["nodes"]["n1"]["available_percent"] == 100
+        assert d.release(bound) is True
+        assert d.status()["nodes"]["n1"]["available_percent"] == 400
+        assert d.release(bound) is False  # ReleasedPodMap dedup
+        assert d.status()["nodes"]["n1"]["available_percent"] == 400
+
+    def test_forget_keeps_release_tombstone(self, cluster):
+        # K8s UIDs never recur; keeping the tombstone after forget closes the
+        # race where an in-flight release lands after the delete event
+        d = Dealer(cluster, Binpack())
+        pod = cluster.create_pod(tpu_pod("p1", (100,)))
+        d.bind("n1", pod)
+        bound = cluster.get_pod("default", "p1")
+        d.release(bound)
+        d.forget(bound)
+        assert d.release(bound) is False  # tombstone still effective
+        assert d.allocate(bound.deepcopy()) is False
+        assert d.status()["nodes"]["n1"]["available_percent"] == 400
+
+    def test_release_untracked_pod_is_refused(self, cluster):
+        # a pod that completed BEFORE our boot was never subtracted from
+        # accounting; releasing its annotations would over-commit the node
+        d = Dealer(cluster, Binpack())
+        stale = tpu_pod("old", (100,), node_name="n1", phase="Succeeded")
+        stale = podutil.annotated_pod(stale, {"c0": [0]})
+        assert d.release(stale) is False
+        assert d.status()["nodes"]["n1"]["available_percent"] == 400
+        # and it is tombstoned so later events are no-ops too
+        assert d.release(stale) is False
+
+    def test_forget_unreleased_pod_frees_chips(self, cluster):
+        d = Dealer(cluster, Binpack())
+        pod = cluster.create_pod(tpu_pod("p1", (200,)))
+        d.bind("n1", pod)
+        bound = cluster.get_pod("default", "p1")
+        d.forget(bound)
+        assert d.status()["nodes"]["n1"]["available_percent"] == 400
+
+    def test_boot_reconstruction(self, cluster):
+        d1 = Dealer(cluster, Binpack())
+        pod = cluster.create_pod(tpu_pod("p1", (200,)))
+        d1.bind("n1", pod)
+        # scheduler restarts: fresh dealer, same cluster
+        d2 = Dealer(cluster, Binpack())
+        st = d2.status()["nodes"]["n1"]
+        assert st["available_percent"] == 200
+        assert d2.status()["assumed_pods"] == 1
+
+    def test_allocate_requires_assume_and_node(self, cluster):
+        d = Dealer(cluster, Binpack())
+        unbound = tpu_pod("px", (100,))
+        assert d.allocate(unbound) is False
+        corrupt = tpu_pod("py", (100,), node_name="n1")
+        corrupt.ensure_annotations()[types.ANNOTATION_ASSUME] = "true"
+        assert d.allocate(corrupt) is False  # missing chip annotations
+
+    def test_remove_node_evicts(self, cluster):
+        d = Dealer(cluster, Binpack())
+        ok, _ = d.assume(["n1"], tpu_pod("p", (50,)))
+        assert ok == ["n1"]
+        d.remove_node("n1")
+        assert "n1" not in d.status()["nodes"]
+
+
+class TestPlanFromPod:
+    def test_roundtrip(self, cluster):
+        d = Dealer(cluster, Binpack())
+        pod = cluster.create_pod(tpu_pod("p1", (200, 40)))
+        d.bind("n1", pod)
+        bound = cluster.get_pod("default", "p1")
+        plan = plan_from_pod(bound)
+        assert plan is not None
+        assert plan.demand.percents == (200, 40)
+        assert len(plan.assignments[0]) == 2 and len(plan.assignments[1]) == 1
+
+    def test_rejects_wrong_chip_count(self):
+        pod = tpu_pod("p", (200,))
+        pod.ensure_annotations()[types.ANNOTATION_ASSUME] = "true"
+        pod.ensure_annotations()["tpu.io/container-c0"] = "0"  # 200% needs 2 chips
+        assert plan_from_pod(pod) is None
+
+    def test_occupancy_metric(self, cluster):
+        d = Dealer(cluster, Binpack())
+        assert d.occupancy() == 0.0
+        d.bind("n1", cluster.create_pod(tpu_pod("p1", (400,))))
+        # both nodes warm at boot: 4 of 8 chips allocated
+        assert d.occupancy() == 0.5

@@ -1,0 +1,187 @@
+"""Load-aware pipeline tests: policy parsing/hot-reload, prometheus text
+parsing, metric sync into rater scores."""
+
+import http.server
+import threading
+import time
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.cmd.main import make_mock_cluster
+from nanotpu.controller.metricsync import (
+    MetricSyncer,
+    PrometheusSource,
+    TpuRuntimeSource,
+)
+from nanotpu.dealer import Dealer
+from nanotpu.metrics.promtext import find_sample, parse_prometheus_text
+from nanotpu.policy import (
+    METRIC_CORE,
+    METRIC_HBM,
+    PolicySpec,
+    PolicyWatcher,
+    parse_duration,
+    parse_policy,
+)
+
+POLICY_YAML = """
+policy:
+  syncPeriod:
+    - name: tpu_tensorcore_utilization
+      period: 5s
+    - name: tpu_hbm_usage
+      period: 30s
+  priority:
+    - name: tpu_tensorcore_utilization
+      weight: 0.7
+"""
+
+
+class TestPolicy:
+    def test_parse_duration(self):
+        assert parse_duration("15s") == 15
+        assert parse_duration("2m") == 120
+        assert parse_duration("500ms") == 0.5
+        assert parse_duration(7) == 7
+        with pytest.raises(ValueError):
+            parse_duration("yesterday")
+
+    def test_parse_policy(self):
+        spec = parse_policy(POLICY_YAML)
+        assert spec.period_for(METRIC_CORE) == 5
+        assert spec.period_for(METRIC_HBM) == 30
+        assert spec.period_for("unknown", default=9) == 9
+        assert spec.weight_for(METRIC_CORE) == 0.7
+
+    def test_parse_policy_garbage_raises_not_panics(self):
+        with pytest.raises(ValueError):
+            parse_policy("policy: [not, a, mapping]")
+        with pytest.raises(ValueError):
+            parse_policy("policy:\n  syncPeriod:\n    - name: x\n      period: soon")
+
+    def test_hot_reload_reaches_consumers(self, tmp_path):
+        # the reference's one-shot copy bug (main.go:118) made reloads no-ops
+        p = tmp_path / "policy.yaml"
+        p.write_text(POLICY_YAML)
+        w = PolicyWatcher(str(p), poll_s=0.05)
+        assert w.spec().period_for(METRIC_CORE) == 5
+        time.sleep(0.1)
+        p.write_text(POLICY_YAML.replace("period: 5s", "period: 11s"))
+        deadline = time.time() + 3
+        while time.time() < deadline:
+            if w.spec().period_for(METRIC_CORE) == 11:
+                break
+            time.sleep(0.05)
+        assert w.spec().period_for(METRIC_CORE) == 11
+        # bad write keeps last good spec
+        p.write_text("::: not yaml {{{")
+        time.sleep(0.3)
+        assert w.spec().period_for(METRIC_CORE) == 11
+        w.stop()
+
+
+class TestPromText:
+    def test_parse_samples(self):
+        text = (
+            "# HELP tensorcore_duty_cycle_percent duty\n"
+            "# TYPE tensorcore_duty_cycle_percent gauge\n"
+            'tensorcore_duty_cycle_percent{chip="0"} 62.5\n'
+            'tensorcore_duty_cycle_percent{chip="1"} 10\n'
+            "malformed line !!!\n"
+            'bad_value{chip="2"} notanumber\n'
+            "no_labels_metric 3.5\n"
+        )
+        samples = parse_prometheus_text(text)
+        assert find_sample(samples, "tensorcore_duty_cycle_percent", chip="0").value == 62.5
+        assert find_sample(samples, "no_labels_metric").value == 3.5
+        assert find_sample(samples, "bad_value") is None
+
+
+class _FakeTpuRuntime(http.server.BaseHTTPRequestHandler):
+    """Per-node libtpu metrics endpoint stand-in."""
+
+    body = (
+        'tensorcore_duty_cycle_percent{chip="0"} 80\n'
+        'tensorcore_duty_cycle_percent{chip="1"} 10\n'
+        'tensorcore_duty_cycle_percent{chip="2"} 10\n'
+        'tensorcore_duty_cycle_percent{chip="3"} 10\n'
+        'memory_bandwidth_utilization{chip="0"} 50\n'
+    )
+
+    def do_GET(self):
+        data = self.body.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+class TestMetricSync:
+    def test_runtime_scrape_feeds_rater(self):
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _FakeTpuRuntime)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        port = server.server_address[1]
+
+        client = make_mock_cluster(1)
+        # point the node's address at the fake runtime endpoint
+        node = client.get_node("v5p-host-0")
+        node.status["addresses"] = [{"type": "InternalIP", "address": "127.0.0.1"}]
+        client._nodes["v5p-host-0"] = node.raw  # direct fixture poke
+
+        dealer = Dealer(client, make_rater("spread"))
+        syncer = MetricSyncer(
+            dealer, client, TpuRuntimeSource(port=port), PolicyWatcher("")
+        )
+        updated = syncer.sync_once(METRIC_CORE)
+        assert updated == 4
+        # chip 0 is hot (0.8); spread for a fractional pod avoids it
+        from nanotpu.allocator.core import Demand
+
+        info = dealer._node_info("v5p-host-0")
+        assert info.chips.chips[0].load == pytest.approx(0.8)
+        plan = dealer.rater.choose(info.chips, Demand((50,), ("c0",)))
+        assert plan.assignments[0][0] != 0
+        server.shutdown()
+
+    def test_prometheus_source_fallback_shapes(self):
+        calls = []
+
+        class FakePromHandler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                calls.append(self.path)
+                if "chipNode" in self.path:
+                    body = b'{"data":{"result":[{"value":[0,"0.42"]}]}}'
+                else:
+                    body = b'{"data":{"result":[]}}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FakePromHandler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        port = server.server_address[1]
+        src = PrometheusSource(f"http://127.0.0.1:{port}")
+        from nanotpu.k8s.objects import make_node
+
+        node = make_node("n1", {types.RESOURCE_TPU_PERCENT: 400})
+        v = src.chip_usage(node, 0, METRIC_CORE)
+        assert v == pytest.approx(0.42)
+        assert len(calls) == 2  # first shape empty -> fallback shape
+        server.shutdown()
+
+    def test_unreachable_source_degrades(self):
+        client = make_mock_cluster(1)
+        dealer = Dealer(client, make_rater("binpack"))
+        syncer = MetricSyncer(
+            dealer, client, TpuRuntimeSource(port=1, timeout_s=0.1), PolicyWatcher("")
+        )
+        assert syncer.sync_once(METRIC_CORE) == 0  # no crash, no updates
