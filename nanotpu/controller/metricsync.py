@@ -194,10 +194,12 @@ class MetricSyncer:
             if not nodeutil.is_tpu_enabled(node) or not nodeutil.is_tpu_node(node):
                 continue
             chip_count = nodeutil.get_chip_count(node)
+            errored = False
             for chip in range(chip_count):
                 try:
                     value = self.source.chip_usage(node, chip, metric)
                 except Exception as e:  # a source must never kill the loop
+                    errored = True
                     self._note_error(node.name, e)
                     continue
                 if value is None:
@@ -205,7 +207,9 @@ class MetricSyncer:
                 kwargs = {"core": value} if metric == METRIC_CORE else {"memory": value}
                 self.dealer.update_chip_usage(node.name, chip, **kwargs)
                 updated += 1
-            self._errors.pop(node.name, None)
+            if not errored:
+                # only a clean tick resets the log-throttle counter
+                self._errors.pop(node.name, None)
         return updated
 
     def _note_error(self, node: str, err: Exception) -> None:

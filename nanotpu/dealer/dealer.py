@@ -87,6 +87,7 @@ class Dealer:
         self.usage = usage or UsageStore()
         self._lock = threading.RLock()  # guards the maps below only
         self._nodes: dict[str, NodeInfo] = {}
+        self._non_tpu: set[str] = set()  # negative cache for _node_info
         self._pods: dict[str, Pod] = {}  # uid -> annotated pod (PodMaps)
         # released-uid tombstones, insertion-ordered for LRU bounding
         # (ReleasedPodMap analogue)
@@ -159,17 +160,30 @@ class Dealer:
 
     # -- node registry -----------------------------------------------------
     def _node_info(self, name: str, node: Node | None = None) -> NodeInfo | None:
-        """Get-or-build per-node state (getNodeInfo, dealer.go:271-301)."""
+        """Get-or-build per-node state (getNodeInfo, dealer.go:271-301).
+
+        Non-TPU nodes are tombstoned so every Filter/Prioritize over a large
+        mixed cluster doesn't re-GET each non-TPU candidate; the tombstone is
+        cleared when the node changes (observe_node / remove_node / resync).
+        """
         with self._lock:
             info = self._nodes.get(name)
+            if info is None and name in self._non_tpu:
+                return None
         if info is not None:
             return info
         if node is None:
             try:
                 node = self.client.get_node(name)
+            except NotFoundError:
+                with self._lock:
+                    self._non_tpu.add(name)
+                return None
             except ApiError:
                 return None
         if not nodeutil.is_tpu_node(node):
+            with self._lock:
+                self._non_tpu.add(name)
             return None
         new_info = NodeInfo(node)
         with self._lock:
@@ -181,13 +195,16 @@ class Dealer:
         return new_info
 
     def observe_node(self, node: Node) -> None:
-        """Materialize per-node state for a newly seen node."""
+        """Materialize per-node state for a newly seen/changed node."""
+        with self._lock:
+            self._non_tpu.discard(node.name)
         self._node_info(node.name, node)
 
     def remove_node(self, name: str) -> None:
         """Evict a deleted/resized node (missing in the reference)."""
         with self._lock:
             self._nodes.pop(name, None)
+            self._non_tpu.discard(name)
         self.usage.forget_node(name)
 
     def node_names(self) -> list[str]:
@@ -251,15 +268,22 @@ class Dealer:
             raise BindError(
                 f"no feasible plan for pod {pod.key()} on node {node_name}"
             )
+        # register BEFORE the API writes: update_pod fires a MODIFIED event
+        # (assume=true) that the reconciler races to allocate — the map entry
+        # is what makes _learn_bound_pod a no-op for this pod
+        with self._lock:
+            self._pods[pod.uid] = pod
+            self._released.pop(pod.uid, None)
         try:
             annotated = self._write_annotations(pod, plan)
             self.client.bind_pod(annotated.namespace, annotated.name, node_name)
         except ApiError as e:
             info.unbind(plan)
+            with self._lock:
+                self._pods.pop(pod.uid, None)
             raise BindError(f"bind of {pod.key()} to {node_name} failed: {e}") from e
         with self._lock:
             self._pods[pod.uid] = annotated
-            self._released.pop(pod.uid, None)
         return annotated
 
     def _write_annotations(self, pod: Pod, plan: Plan) -> Pod:

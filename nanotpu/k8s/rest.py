@@ -32,6 +32,10 @@ log = logging.getLogger("nanotpu.k8s.rest")
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+#: Socket read timeout for watch streams; a silent connection drop surfaces
+#: as a timeout and triggers reconnect instead of hanging reconciliation.
+WATCH_READ_TIMEOUT_S = 300
+
 
 class RestClientset:
     def __init__(self, base_url: str, token: str = "", ca_path: str | None = None):
@@ -166,7 +170,12 @@ class RestClientset:
                 if self.token:
                     req.add_header("Authorization", f"Bearer {self.token}")
                 try:
-                    with urllib.request.urlopen(req, context=self._ctx) as resp:
+                    # read timeout so a half-open TCP connection (silent NAT
+                    # drop) raises instead of blocking the watch forever; a
+                    # healthy-but-quiet watch also recycles, which is cheap
+                    with urllib.request.urlopen(
+                        req, context=self._ctx, timeout=WATCH_READ_TIMEOUT_S
+                    ) as resp:
                         backoff = 1.0
                         for line in resp:
                             if watch._stopped.is_set():

@@ -1,0 +1,225 @@
+"""Llama-3-style decoder in pure JAX, TPU-first.
+
+This is a *workload* of the scheduler (BASELINE configs[3]: "JAX Llama-3-8B
+Job on v5p-16") and the flagship model for the driver's compile checks — the
+reference repo contains no model code at all (it schedules pods, SURVEY §0),
+so this module follows public Llama-3 architecture (RMSNorm, RoPE, GQA,
+SwiGLU) rather than any reference file.
+
+TPU-first design notes:
+
+* params and activations default to **bfloat16** with fp32 RMSNorm/logit
+  accumulation — MXU-native;
+* all shapes static; attention is a dense batched matmul chain XLA fuses and
+  tiles onto the MXU (a pallas flash-attention kernel in ``nanotpu.ops`` can
+  be swapped in via ``cfg.attn_impl``);
+* parameters are a flat pytree of dicts, annotated for sharding by
+  ``nanotpu.parallel.mesh.param_specs`` (tp over heads/ffn, fsdp over the
+  remaining axis) — no parameter ever needs resharding at step time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14_336
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    # "dense" (XLA-fused) or "flash" (pallas kernel from nanotpu.ops)
+    attn_impl: str = "dense"
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab: int = 256) -> "LlamaConfig":
+        """CPU-testable config: 2 layers, 64-dim."""
+        return LlamaConfig(
+            vocab_size=vocab, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=128, max_seq_len=256, dtype="float32",
+        )
+
+
+def _dtype(cfg: LlamaConfig):
+    return jnp.dtype(cfg.dtype)
+
+
+# -- init ------------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> dict:
+    """Truncated-normal init, scaled residual projections (GPT-2 style)."""
+    dt = _dtype(cfg)
+    n_kv = cfg.n_kv_heads
+    hd = cfg.head_dim
+    keys = jax.random.split(rng, cfg.n_layers + 2)
+
+    def dense(key, shape, scale=None):
+        fan_in = shape[0]
+        scale = scale if scale is not None else 1.0 / math.sqrt(fan_in)
+        return (jax.random.truncated_normal(key, -3, 3, shape, jnp.float32) * scale).astype(dt)
+
+    def layer(key):
+        ks = jax.random.split(key, 7)
+        resid_scale = 1.0 / math.sqrt(2 * cfg.n_layers)
+        return {
+            "attn": {
+                "wq": dense(ks[0], (cfg.dim, cfg.n_heads * hd)),
+                "wk": dense(ks[1], (cfg.dim, n_kv * hd)),
+                "wv": dense(ks[2], (cfg.dim, n_kv * hd)),
+                "wo": dense(ks[3], (cfg.n_heads * hd, cfg.dim),
+                            scale=resid_scale / math.sqrt(cfg.dim)),
+            },
+            "mlp": {
+                "w_gate": dense(ks[4], (cfg.dim, cfg.ffn_dim)),
+                "w_up": dense(ks[5], (cfg.dim, cfg.ffn_dim)),
+                "w_down": dense(ks[6], (cfg.ffn_dim, cfg.dim),
+                                scale=resid_scale / math.sqrt(cfg.ffn_dim)),
+            },
+            "attn_norm": jnp.ones((cfg.dim,), jnp.float32),
+            "mlp_norm": jnp.ones((cfg.dim,), jnp.float32),
+        }
+
+    return {
+        "embed": dense(keys[0], (cfg.vocab_size, cfg.dim), scale=0.02),
+        "layers": [layer(k) for k in keys[1:-1]],
+        "final_norm": jnp.ones((cfg.dim,), jnp.float32),
+        "lm_head": dense(keys[-1], (cfg.dim, cfg.vocab_size)),
+    }
+
+
+# -- building blocks -------------------------------------------------------
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """fp32 accumulation regardless of activation dtype."""
+    orig = x.dtype
+    x = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * rms * weight).astype(orig)
+
+
+def rope_freqs(cfg: LlamaConfig, positions: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for rotary embedding, fp32. positions: [B, S] or [S]."""
+    hd = cfg.head_dim
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
+    )
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., hd/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, S, H, hd]; cos/sin broadcast over heads."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    if cos.ndim == 2:  # [S, hd/2] -> [1, S, 1, hd/2]
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    else:  # [B, S, hd/2] -> [B, S, 1, hd/2]
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _dense_attention(q, k, v, causal: bool = True):
+    """Batched MHA: q [B,S,H,hd], k/v [B,S,H,hd] (kv already repeated).
+    XLA fuses this chain and tiles the two matmuls on the MXU."""
+    B, S, H, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        logits = jnp.where(mask[None, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def attention(params: dict, x: jax.Array, cfg: LlamaConfig,
+              cos: jax.Array, sin: jax.Array) -> jax.Array:
+    B, S, _ = x.shape
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    q = (x @ params["wq"]).reshape(B, S, H, hd)
+    k = (x @ params["wk"]).reshape(B, S, KV, hd)
+    v = (x @ params["wv"]).reshape(B, S, KV, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    # GQA: repeat kv heads to full head count (XLA turns this into a
+    # broadcast inside the einsum, no materialized copy)
+    if KV != H:
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if cfg.attn_impl == "flash":
+        from nanotpu.ops.attention import flash_attention
+
+        out = flash_attention(q, k, v, causal=True)
+    else:
+        out = _dense_attention(q, k, v, causal=True)
+    return out.reshape(B, S, H * hd) @ params["wo"]
+
+
+def mlp(params: dict, x: jax.Array) -> jax.Array:
+    """SwiGLU."""
+    return (jax.nn.silu(x @ params["w_gate"]) * (x @ params["w_up"])) @ params["w_down"]
+
+
+def decoder_layer(params: dict, x: jax.Array, cfg: LlamaConfig,
+                  cos: jax.Array, sin: jax.Array) -> jax.Array:
+    x = x + attention(params["attn"], rms_norm(x, params["attn_norm"], cfg.norm_eps), cfg, cos, sin)
+    x = x + mlp(params["mlp"], rms_norm(x, params["mlp_norm"], cfg.norm_eps))
+    return x
+
+
+# -- forward ---------------------------------------------------------------
+
+def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+            positions: jax.Array | None = None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.arange(S, dtype=jnp.int32)
+    cos, sin = rope_freqs(cfg, positions)
+    x = params["embed"][tokens]
+    layer_fn = decoder_layer
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            decoder_layer, static_argnums=(2,),
+            policy=jax.checkpoint_policies.nothing_saveable,
+        )
+    for layer_params in params["layers"]:
+        x = layer_fn(layer_params, x, cfg, cos, sin)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Next-token cross entropy over tokens[:, :-1] -> tokens[:, 1:]."""
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def param_count(params) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,101 @@
+"""Device mesh + sharding rules (TPU-first parallelism).
+
+The scaling recipe: pick a mesh, annotate shardings with PartitionSpec, let
+XLA insert the collectives, which ride ICI inside a slice. Axes:
+
+* ``dp``   — pure data parallel (gradients all-reduced)
+* ``fsdp`` — data parallel with parameters/optimizer sharded (ZeRO-3 style;
+  XLA all-gathers params per layer, reduce-scatters grads)
+* ``tp``   — tensor parallel over attention heads / ffn hidden
+* ``sp``   — sequence (context) parallel, used by ring attention
+* ``ep``   — expert parallel (MoE, nanotpu.models.mixtral)
+
+The scheduler side of this repo PLACES jobs so that these axes land on
+ICI-adjacent chips (SliceGeometry); this module is what those jobs run.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nanotpu.models.llama import LlamaConfig
+
+
+def make_mesh(
+    dp: int = 1, fsdp: int = 1, tp: int = 1, sp: int = 1, ep: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a Mesh with the canonical axis order (dp, fsdp, tp, sp, ep).
+
+    Axis sizes must multiply to the device count. Size-1 axes are kept in
+    the mesh (specs may always name them; XLA drops trivial collectives).
+    """
+    devices = devices if devices is not None else jax.devices()
+    want = dp * fsdp * tp * sp * ep
+    if want != len(devices):
+        raise ValueError(
+            f"mesh {dp}x{fsdp}x{tp}x{sp}x{ep} needs {want} devices, "
+            f"have {len(devices)}"
+        )
+    arr = np.array(devices).reshape(dp, fsdp, tp, sp, ep)
+    return Mesh(arr, axis_names=("dp", "fsdp", "tp", "sp", "ep"))
+
+
+#: Batch is sharded over every data-ish axis; sequence over sp.
+BATCH_SPEC = P(("dp", "fsdp"), "sp")
+
+
+def llama_param_specs(cfg: LlamaConfig) -> dict:
+    """PartitionSpecs matching init_params' tree: tp over heads/ffn/vocab,
+    fsdp over the other matmul axis (ZeRO-3), norms replicated."""
+    layer = {
+        "attn": {
+            "wq": P("fsdp", "tp"),
+            "wk": P("fsdp", "tp"),
+            "wv": P("fsdp", "tp"),
+            "wo": P("tp", "fsdp"),
+        },
+        "mlp": {
+            "w_gate": P("fsdp", "tp"),
+            "w_up": P("fsdp", "tp"),
+            "w_down": P("tp", "fsdp"),
+        },
+        "attn_norm": P(),
+        "mlp_norm": P(),
+    }
+    return {
+        "embed": P("tp", "fsdp"),
+        "layers": [layer for _ in range(cfg.n_layers)],
+        "final_norm": P(),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+def shardings_for(mesh: Mesh, specs: Any) -> Any:
+    """Map a PartitionSpec tree to NamedShardings on a mesh."""
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def check_divisibility(cfg: LlamaConfig, mesh: Mesh) -> None:
+    """Fail fast on shardings the model shapes cannot honor."""
+    tp = mesh.shape["tp"]
+    problems = []
+    if cfg.n_heads % tp:
+        problems.append(f"n_heads {cfg.n_heads} % tp {tp}")
+    if cfg.n_kv_heads % tp:
+        problems.append(f"n_kv_heads {cfg.n_kv_heads} % tp {tp}")
+    if cfg.ffn_dim % tp:
+        problems.append(f"ffn_dim {cfg.ffn_dim} % tp {tp}")
+    if cfg.vocab_size % tp:
+        problems.append(f"vocab {cfg.vocab_size} % tp {tp}")
+    if problems:
+        raise ValueError("indivisible sharding: " + ", ".join(problems))

@@ -1,0 +1,109 @@
+"""Sharded training step: loss -> grads -> optax update under one jit.
+
+Everything (forward, backward, optimizer) compiles into a single XLA program
+over the mesh; gradient reductions become reduce-scatter/all-reduce over ICI,
+chosen by XLA from the shardings — no hand-written collectives here.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nanotpu.models import llama
+from nanotpu.parallel.mesh import (
+    BATCH_SPEC,
+    llama_param_specs,
+    shardings_for,
+)
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def init_train_state(rng: jax.Array, cfg: llama.LlamaConfig,
+                     optimizer: optax.GradientTransformation) -> TrainState:
+    params = llama.init_params(rng, cfg)
+    return TrainState(
+        params=params,
+        opt_state=optimizer.init(params),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def build_train_step(
+    cfg: llama.LlamaConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    loss_fn: Callable | None = None,
+) -> Callable[[TrainState, jax.Array], tuple[TrainState, jax.Array]]:
+    """Returns jitted (state, tokens[B,S]) -> (state, loss) with full
+    tp/fsdp/dp shardings pinned via in/out_shardings."""
+    loss_fn = loss_fn or llama.loss_fn
+    param_shardings = shardings_for(mesh, llama_param_specs(cfg))
+    repl = NamedSharding(mesh, P())
+    batch_sharding = NamedSharding(mesh, BATCH_SPEC)
+
+    @partial(
+        jax.jit,
+        donate_argnums=(0,),
+    )
+    def train_step(state: TrainState, tokens: jax.Array):
+        def compute_loss(params):
+            return loss_fn(params, tokens, cfg)
+
+        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        # keep params pinned to their shardings across steps
+        new_params = jax.lax.with_sharding_constraint(new_params, param_shardings)
+        return TrainState(new_params, new_opt, state.step + 1), loss
+
+    def step_fn(state: TrainState, tokens: jax.Array):
+        tokens = jax.device_put(tokens, batch_sharding)
+        with mesh:
+            return train_step(state, tokens)
+
+    return step_fn
+
+
+def place_state(state: TrainState, cfg: llama.LlamaConfig, mesh: Mesh) -> TrainState:
+    """Shard an (unsharded) TrainState onto the mesh: params by spec,
+    optimizer moments inherit their parameter's sharding, scalars replicate."""
+    param_shardings = shardings_for(mesh, llama_param_specs(cfg))
+    repl = NamedSharding(mesh, P())
+
+    params = jax.device_put(state.params, param_shardings)
+
+    param_flat, param_treedef = jax.tree_util.tree_flatten(state.params)
+    shard_flat, _ = jax.tree_util.tree_flatten(
+        param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
+    )
+    by_shape = {}
+    for leaf, sh in zip(param_flat, shard_flat):
+        by_shape.setdefault((leaf.shape, leaf.dtype), sh)
+
+    def opt_leaf(leaf):
+        if hasattr(leaf, "shape"):
+            sh = by_shape.get((leaf.shape, leaf.dtype), repl)
+            return jax.device_put(leaf, sh)
+        return leaf
+
+    opt_state = jax.tree_util.tree_map(opt_leaf, state.opt_state)
+    step = jax.device_put(state.step, repl)
+    return TrainState(params, opt_state, step)

@@ -104,6 +104,11 @@ class SchedulerAPI:
             except VerbError as e:
                 code = 400
                 return 400, "application/json", json.dumps({"Error": str(e)})
+            except Exception:
+                # dispatch's catch-all will answer 500; record it as such so
+                # error-rate metrics don't report success for failures
+                code = 500
+                raise
             return 200, "application/json", json.dumps(result)
         finally:
             elapsed = time.perf_counter() - started

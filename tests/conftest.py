@@ -10,11 +10,20 @@ These env vars must be set before jax initializes, hence conftest.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu: the environment pre-sets JAX_PLATFORMS (e.g. "axon" for the
+# tunneled TPU) and tests must never run on real hardware
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# jax may already be imported by a pytest plugin, with platform config read
+# from the ORIGINAL env — override through the config API as well (safe as
+# long as no backend is initialized yet, which holds at collection time)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
