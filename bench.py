@@ -100,8 +100,11 @@ def run() -> dict:
     occupancy = dealer.occupancy() * 100
     server.shutdown()
 
+    import math as _math
+
     p50 = statistics.median(cycle_latencies)
-    p99 = sorted(cycle_latencies)[max(0, int(len(cycle_latencies) * 0.99) - 1)]
+    n = len(cycle_latencies)
+    p99 = sorted(cycle_latencies)[min(n - 1, _math.ceil(0.99 * n) - 1)]
     return {
         "metric": "chip_occupancy_binpack_v5p64_pct",
         "value": round(occupancy, 2),

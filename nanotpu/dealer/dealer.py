@@ -29,6 +29,7 @@ from concurrent.futures import ThreadPoolExecutor
 from nanotpu import types
 from nanotpu.allocator.core import Demand, Plan
 from nanotpu.allocator.rater import Rater
+from nanotpu.dealer.gang import GangTracker, gang_affinity_bonus
 from nanotpu.dealer.nodeinfo import NodeInfo
 from nanotpu.dealer.usage import UsageStore
 from nanotpu.k8s.client import ApiError, Clientset, ConflictError, NotFoundError
@@ -95,6 +96,7 @@ class Dealer:
         self._pool = ThreadPoolExecutor(
             max_workers=assume_workers, thread_name_prefix="assume"
         )
+        self.gangs = GangTracker()
         self._warm_from_cluster()
 
     # -- boot-time state reconstruction (dealer.go:58-72) ------------------
@@ -156,6 +158,11 @@ class Dealer:
             log.error("replaying pod %s onto %s failed: %s", pod.key(), info.name, e)
             unreserve()
             return False
+        gang = podutil.gang_of(pod)
+        if gang:
+            self.gangs.record_bound(
+                f"{pod.namespace}/{gang[0]}", gang[1], pod.uid, pod.node_name
+            )
         return True
 
     # -- node registry -----------------------------------------------------
@@ -246,12 +253,25 @@ class Dealer:
         demand = Demand.from_pod(pod)
         if not demand.is_valid():
             return [(n, types.SCORE_MIN) for n in node_names]
+        gang = podutil.gang_of(pod)
+        member_slices: list[tuple[str, str]] = []
+        if gang:
+            for node in self.gangs.bound_nodes(f"{pod.namespace}/{gang[0]}"):
+                member = self._node_info(node)
+                if member is not None:
+                    member_slices.append((member.slice_name, member.slice_coords))
         out = []
         for name in node_names:
             info = self._node_info(name)
-            score = (
-                info.score(demand, self.rater) if info is not None else types.SCORE_MIN
-            )
+            if info is None:
+                out.append((name, types.SCORE_MIN))
+                continue
+            score = info.score(demand, self.rater)
+            if member_slices:
+                bonus = gang_affinity_bonus(
+                    info.slice_name, info.slice_coords, member_slices
+                )
+                score = min(types.SCORE_MAX, score + bonus)
             out.append((name, score))
         return out
 
@@ -272,6 +292,7 @@ class Dealer:
         # (assume=true) that the reconciler races to allocate — the map entry
         # is what makes _learn_bound_pod a no-op for this pod
         with self._lock:
+            was_released = pod.uid in self._released
             self._pods[pod.uid] = pod
             self._released.pop(pod.uid, None)
         try:
@@ -281,9 +302,27 @@ class Dealer:
             info.unbind(plan)
             with self._lock:
                 self._pods.pop(pod.uid, None)
+                if was_released:  # restore the tombstone we popped
+                    self._mark_released(pod.uid)
             raise BindError(f"bind of {pod.key()} to {node_name} failed: {e}") from e
         with self._lock:
-            self._pods[pod.uid] = annotated
+            # a release/forget may have raced us mid-bind (pod deleted while
+            # the API writes were in flight): it popped our reservation and
+            # tombstoned the uid, but couldn't return the chips (the reserved
+            # pod carried no annotations) — undo the allocation here
+            raced = pod.uid not in self._pods
+            if not raced:
+                self._pods[pod.uid] = annotated
+        if raced:
+            info.unbind(plan)
+            raise BindError(
+                f"pod {pod.key()} was released while bind was in flight"
+            )
+        gang = podutil.gang_of(pod)
+        if gang:
+            self.gangs.record_bound(
+                f"{pod.namespace}/{gang[0]}", gang[1], pod.uid, node_name
+            )
         return annotated
 
     def _write_annotations(self, pod: Pod, plan: Plan) -> Pod:
@@ -325,6 +364,7 @@ class Dealer:
                 return False
             tracked = self._pods.pop(pod.uid, None)
             self._mark_released(pod.uid)
+        self.gangs.forget_pod(pod.uid)
         if tracked is None:
             return False
         plan = plan_from_pod(tracked)
@@ -374,6 +414,7 @@ class Dealer:
             "nodes": {i.name: i.status() for i in infos},
             "assumed_pods": n_pods,
             "released_pods": n_released,
+            "gangs": self.gangs.status(),
         }
 
     def occupancy(self) -> float:

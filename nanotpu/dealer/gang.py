@@ -1,0 +1,135 @@
+"""Gang-aware placement state: ICI-affinity scoring for multi-pod jobs.
+
+The kube-scheduler extender protocol is strictly one-pod-at-a-time
+(Filter/Bind per pod, routes.go:19-27), so gang knowledge must live in the
+dealer's memory the way the PlanCache does (SURVEY §7 hard part #2). Pods
+declare membership via the ``tpu.io/gang-name``/``gang-size`` annotations
+(BASELINE configs[3-4]: a 32-pod Llama job, an 8-expert Mixtral binpack).
+
+Placement is *soft* gang affinity: Prioritize boosts candidate nodes that
+are ICI-close to where the gang's already-bound members sit —
+
+* different slice than bound members  -> no bonus (DCN hop, worst case);
+* same slice                          -> base bonus;
+* same slice AND the candidate host keeps the gang's host set compact on
+  the slice torus                      -> up to the full bonus.
+
+A hard gang barrier (refusing to bind until all members are schedulable) is
+deliberately NOT the default: the extender cannot see the scheduler's queue,
+and wedging Bind invites deadlock with non-TPU constraints; kube-scheduler
+retries make soft affinity converge in practice.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from nanotpu.topology import Coord, parse_slice_coords
+
+#: Gang keys are "<namespace>/<gang-name>" — the annotation value alone would
+#: merge same-named gangs across namespaces (the Dealer builds the key).
+
+#: Portion of the score band a full gang-affinity match can add.
+GANG_BONUS = 30
+
+
+@dataclass
+class GangMember:
+    uid: str
+    node: str
+
+
+@dataclass
+class _Gang:
+    size: int = 0
+    members: dict[str, str] = field(default_factory=dict)  # uid -> node
+
+
+class GangTracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gangs: dict[str, _Gang] = {}
+        self._by_uid: dict[str, str] = {}  # uid -> gang name
+
+    def record_bound(self, gang: str, size: int, uid: str, node: str) -> None:
+        with self._lock:
+            g = self._gangs.setdefault(gang, _Gang())
+            g.size = max(g.size, size)
+            g.members[uid] = node
+            self._by_uid[uid] = gang
+
+    def forget_pod(self, uid: str) -> None:
+        with self._lock:
+            gang = self._by_uid.pop(uid, None)
+            if gang is None:
+                return
+            g = self._gangs.get(gang)
+            if g is not None:
+                g.members.pop(uid, None)
+                if not g.members:
+                    self._gangs.pop(gang, None)
+
+    def bound_nodes(self, gang: str) -> list[str]:
+        with self._lock:
+            g = self._gangs.get(gang)
+            return sorted(set(g.members.values())) if g else []
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                name: {"size": g.size, "bound": len(g.members)}
+                for name, g in self._gangs.items()
+            }
+
+
+def gang_affinity_bonus(
+    candidate_slice: str,
+    candidate_coords: str,
+    member_slices: list[tuple[str, str]],
+) -> int:
+    """Score bonus in [0, GANG_BONUS] for placing the next gang pod.
+
+    ``member_slices``: (slice name, "x,y,z" coords) of nodes hosting bound
+    members. Unlabeled topology degrades to slice-name matching only.
+    """
+    if not member_slices:
+        return 0
+    same_slice = [
+        coords for slc, coords in member_slices if slc and slc == candidate_slice
+    ]
+    if not candidate_slice or not same_slice:
+        return 0
+    base = GANG_BONUS // 2
+    try:
+        cand = parse_slice_coords(candidate_coords) if candidate_coords else None
+        members = [parse_slice_coords(c) for c in same_slice if c]
+    except ValueError:
+        cand, members = None, []
+    if cand is None or not members:
+        return base
+    # compactness of the union of hosts on a PLAIN (non-wrapping) host grid:
+    # the grid is inferred from the coords' bounding box, so assuming
+    # wraparound would make the two most distant hosts look adjacent
+    coords = members + [cand]
+    compact = _grid_compactness(coords)
+    return base + int(round((GANG_BONUS - base) * compact))
+
+
+def _grid_compactness(coords: list[Coord]) -> float:
+    """ICI-compactness of host coords on a plain grid in [0, 1]: fraction of
+    the best-achievable nearest-neighbor adjacencies for that many hosts."""
+    from nanotpu.topology import _max_links_for_volume
+
+    k = len(coords)
+    if k <= 1:
+        return 1.0
+    cells = set(coords)
+    links = sum(
+        1
+        for (x, y, z) in cells
+        for d in ((1, 0, 0), (0, 1, 0), (0, 0, 1))
+        if (x + d[0], y + d[1], z + d[2]) in cells
+    )
+    best = _max_links_for_volume(k)
+    return min(links / best, 1.0) if best else 1.0

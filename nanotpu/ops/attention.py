@@ -1,0 +1,183 @@
+"""Flash attention as a Pallas TPU kernel, with an XLA fallback.
+
+Forward pass is a classic online-softmax blockwise kernel: grid over
+(batch, heads, q-blocks), inner ``fori_loop`` over k-blocks keeping a running
+max / denominator in VMEM scratch so the full [S, S] logits matrix never
+materializes in HBM. Block sizes honor the MXU/VPU tiling constraints
+(last dim 128; see /opt/skills/guides/pallas_guide.md §Tiling).
+
+Backward uses recomputation through the XLA path under ``jax.custom_vjp`` —
+numerically identical, O(S^2) memory only inside the fused backward matmuls
+(XLA's own attention fusion), which keeps training correct while the Pallas
+backward kernel lands later.
+
+On non-TPU backends the kernel runs in interpreter mode only under tests;
+production code paths fall back to the fused-XLA implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _xla_attention(q, k, v, causal: bool):
+    """Reference dense path (XLA fuses + tiles this fine for moderate S)."""
+    B, S, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_len: int,
+                  causal: bool, scale: float):
+    """One (batch*head, q-block) program: loop over k blocks with online
+    softmax. Refs are [1, block_q, d] for q/o and [1, S, d] for k/v."""
+    from jax.experimental import pallas as pl
+
+    _, block_q, d = q_ref.shape
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kb = pl.cdiv(seq_len, block_k)
+
+    def body(kb, carry):
+        m_prev, l_prev, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        # ragged final block: positions past seq_len are padding, mask always
+        valid = k_pos < seq_len
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            valid = valid & (q_pos >= k_pos)
+        logits = jnp.where(valid, logits, NEG_INF)
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp(jnp.where(logits == NEG_INF, NEG_INF, logits - m_safe))
+        correction = jnp.where(
+            m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_safe)
+        )
+        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * correction + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    if causal:
+        # skip k blocks strictly after this q block
+        last_kb = (qi + 1) * block_q  # first masked-out position + 1
+        num_kb_eff = jnp.minimum(num_kb, pl.cdiv(last_kb, block_k))
+    else:
+        num_kb_eff = num_kb
+    m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    # pad the sequence to a block multiple: pallas clamps ragged final
+    # blocks (dynamic-slice semantics), which would shift position math;
+    # padded k positions are masked via seq_len, padded q rows sliced off
+    blk = max(block_q, block_k)
+    S_pad = ((S + blk - 1) // blk) * blk
+    if S_pad != S:
+        pad = [(0, 0), (0, S_pad - S), (0, 0), (0, 0)]
+        q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
+    # flatten batch*heads into the grid's first axis; move seq next to d
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, S_pad, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, S_pad, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, S_pad, D)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, seq_len=S, causal=causal, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, S_pad // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S_pad, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out.reshape(B, H, S_pad, D).transpose(0, 2, 1, 3)
+    return out[:, :S] if S_pad != S else out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q, k, v, causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool | None = None,
+):
+    """q/k/v: [B, S, H, D] (kv heads already expanded) -> [B, S, H, D].
+
+    Uses the Pallas kernel on TPU backends, XLA fallback elsewhere (or set
+    ``interpret=True`` to run the kernel in interpreter mode for tests).
+    """
+    return _flash_impl(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _use_pallas(interpret: bool | None) -> bool:
+    if interpret is not None:
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _flash_impl(q, k, v, causal, block_q, block_k, interpret):
+    if _use_pallas(interpret):
+        return _flash_forward(q, k, v, causal, block_q, block_k, bool(interpret))
+    return _xla_attention(q, k, v, causal)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    # recompute through the XLA path; same math, same gradients
+    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

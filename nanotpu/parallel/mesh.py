@@ -50,16 +50,30 @@ def make_mesh(
 BATCH_SPEC = P(("dp", "fsdp"), "sp")
 
 
+def _attn_specs() -> dict:
+    """Shared attention-projection shardings (dense and MoE models)."""
+    return {
+        "wq": P("fsdp", "tp"),
+        "wk": P("fsdp", "tp"),
+        "wv": P("fsdp", "tp"),
+        "wo": P("tp", "fsdp"),
+    }
+
+
+def _backbone_specs(cfg, layer: dict) -> dict:
+    return {
+        "embed": P("tp", "fsdp"),
+        "layers": [layer for _ in range(cfg.n_layers)],
+        "final_norm": P(),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
 def llama_param_specs(cfg: LlamaConfig) -> dict:
     """PartitionSpecs matching init_params' tree: tp over heads/ffn/vocab,
     fsdp over the other matmul axis (ZeRO-3), norms replicated."""
     layer = {
-        "attn": {
-            "wq": P("fsdp", "tp"),
-            "wk": P("fsdp", "tp"),
-            "wv": P("fsdp", "tp"),
-            "wo": P("tp", "fsdp"),
-        },
+        "attn": _attn_specs(),
         "mlp": {
             "w_gate": P("fsdp", "tp"),
             "w_up": P("fsdp", "tp"),
@@ -68,12 +82,36 @@ def llama_param_specs(cfg: LlamaConfig) -> dict:
         "attn_norm": P(),
         "mlp_norm": P(),
     }
-    return {
-        "embed": P("tp", "fsdp"),
-        "layers": [layer for _ in range(cfg.n_layers)],
-        "final_norm": P(),
-        "lm_head": P("fsdp", "tp"),
+    return _backbone_specs(cfg, layer)
+
+
+def mixtral_param_specs(cfg) -> dict:
+    """PartitionSpecs for nanotpu.models.mixtral: experts sharded over ep on
+    their stacked leading axis (the dispatch einsum then becomes the
+    all-to-all-style collective), inner matmul dims over tp/fsdp as in the
+    dense model; router replicated (it is tiny and fp32)."""
+    layer = {
+        "attn": _attn_specs(),
+        "moe": {
+            "router": P(),
+            "w_gate": P("ep", "fsdp", "tp"),
+            "w_up": P("ep", "fsdp", "tp"),
+            "w_down": P("ep", "tp", "fsdp"),
+        },
+        "attn_norm": P(),
+        "moe_norm": P(),
     }
+    return _backbone_specs(cfg, layer)
+
+
+def check_moe_divisibility(cfg, mesh: Mesh) -> None:
+    """Fail fast for MoE shardings: ep over experts, plus everything the
+    dense checks cover (heads/ffn/vocab over tp) — an indivisible tp would
+    otherwise surface as an opaque error deep inside XLA."""
+    ep = mesh.shape["ep"]
+    if cfg.n_experts % ep:
+        raise ValueError(f"indivisible sharding: n_experts {cfg.n_experts} % ep {ep}")
+    check_divisibility(cfg, mesh)
 
 
 def shardings_for(mesh: Mesh, specs: Any) -> Any:

@@ -1,0 +1,121 @@
+"""Ring attention: exact causal attention with the sequence sharded over the
+``sp`` mesh axis.
+
+Each device holds a contiguous sequence shard of q/k/v. K/V blocks rotate
+around the ring via ``lax.ppermute`` (one ICI hop per step) while every
+device accumulates its queries' attention over each visiting block with
+online-softmax (log-sum-exp) merging — the sequence-parallel analogue of
+flash attention's k-loop. Memory per device stays O(S/sp · d); the full
+[S, S] score matrix never exists anywhere.
+
+Causality works on block indices: a k/v block that started on ring rank
+``src`` covers global positions [src·Sblk, (src+1)·Sblk); my queries at rank
+``r`` attend fully to blocks with src < r, causally within src == r, and not
+at all to src > r (those steps still run — SPMD needs uniform control flow —
+but are fully masked).
+
+Designed for use inside ``shard_map`` (see :func:`ring_attention_sharded`).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scale, mask):
+    """Partial attention of q against one k/v block.
+
+    q [B,Sq,H,D]; k/v [B,Sk,H,D]; mask [Sq,Sk] bool or None.
+    Returns (m [B,H,Sq,1], l, acc [B,Sq,H,D]) for LSE merging.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)  # [B,H,Sq,1]
+    m_safe = jnp.where(m == NEG_INF, 0.0, m)
+    p = jnp.exp(jnp.where(logits == NEG_INF, NEG_INF, logits - m_safe))
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v).astype(jnp.float32)
+    return m, l, acc
+
+
+def ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    axis_name: str = "sp", causal: bool = True,
+) -> jax.Array:
+    """Per-shard q/k/v [B, Sblk, H, D] -> per-shard out. Call inside
+    shard_map with the sequence dim sharded over ``axis_name``."""
+    B, Sblk, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    n = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+
+    causal_mask = jnp.tril(jnp.ones((Sblk, Sblk), jnp.bool_))
+    perm = [(i, (i + 1) % n) for i in range(n)]  # send k/v to the next rank
+
+    def step(carry, step_idx):
+        k_cur, v_cur, m_run, l_run, acc_run = carry
+        # the block on my device at step s originated at rank (rank - s) mod n
+        src = (rank - step_idx) % n
+        m_blk, l_blk, acc_blk = _block_attend(q, k_cur, v_cur, scale, None)
+        if causal:
+            m_blk_c, l_blk_c, acc_blk_c = _block_attend(
+                q, k_cur, v_cur, scale, causal_mask
+            )
+            is_self = src == rank
+            is_future = src > rank
+            m_blk = jnp.where(is_self, m_blk_c, m_blk)
+            l_blk = jnp.where(is_self, l_blk_c, l_blk)
+            acc_blk = jnp.where(is_self, acc_blk_c, acc_blk)
+            # fully masked future blocks contribute nothing
+            m_blk = jnp.where(is_future, NEG_INF, m_blk)
+            l_blk = jnp.where(is_future, 0.0, l_blk)
+            acc_blk = jnp.where(is_future, 0.0, acc_blk)
+        # LSE merge
+        m_new = jnp.maximum(m_run, m_blk)
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        c_run = jnp.where(m_run == NEG_INF, 0.0, jnp.exp(m_run - m_safe))
+        c_blk = jnp.where(m_blk == NEG_INF, 0.0, jnp.exp(m_blk - m_safe))
+        l_new = l_run * c_run + l_blk * c_blk
+        # correction factors are [B,H,Sq,1]; acc is [B,Sq,H,D]
+        c_run_t = jnp.transpose(c_run, (0, 2, 1, 3))
+        c_blk_t = jnp.transpose(c_blk, (0, 2, 1, 3))
+        acc_new = acc_run * c_run_t + acc_blk * c_blk_t
+        # rotate k/v one hop around the ring (ICI neighbor exchange)
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, m_new, l_new, acc_new), None
+
+    # mark the accumulator inits as device-varying over the ring axis so the
+    # scan carry types match (outputs depend on rank via the causal masks)
+    m0 = jax.lax.pvary(jnp.full((B, H, Sblk, 1), NEG_INF, jnp.float32), axis_name)
+    l0 = jax.lax.pvary(jnp.zeros((B, H, Sblk, 1), jnp.float32), axis_name)
+    acc0 = jax.lax.pvary(jnp.zeros((B, Sblk, H, D), jnp.float32), axis_name)
+    (k_f, v_f, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n)
+    )
+    l_t = jnp.transpose(l, (0, 2, 1, 3))  # [B,Sq,H,1]
+    out = acc / jnp.maximum(l_t, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+    causal: bool = True, axis_name: str = "sp",
+) -> jax.Array:
+    """Global q/k/v [B, S, H, D] with S sharded over ``axis_name``."""
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

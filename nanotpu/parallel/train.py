@@ -36,9 +36,10 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1) -> optax.Gradien
     )
 
 
-def init_train_state(rng: jax.Array, cfg: llama.LlamaConfig,
-                     optimizer: optax.GradientTransformation) -> TrainState:
-    params = llama.init_params(rng, cfg)
+def init_train_state(rng: jax.Array, cfg,
+                     optimizer: optax.GradientTransformation,
+                     init_fn: Callable | None = None) -> TrainState:
+    params = (init_fn or llama.init_params)(rng, cfg)
     return TrainState(
         params=params,
         opt_state=optimizer.init(params),
@@ -47,15 +48,17 @@ def init_train_state(rng: jax.Array, cfg: llama.LlamaConfig,
 
 
 def build_train_step(
-    cfg: llama.LlamaConfig,
+    cfg,
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
     loss_fn: Callable | None = None,
+    param_specs: Any | None = None,
 ) -> Callable[[TrainState, jax.Array], tuple[TrainState, jax.Array]]:
     """Returns jitted (state, tokens[B,S]) -> (state, loss) with full
-    tp/fsdp/dp shardings pinned via in/out_shardings."""
+    shardings pinned. Defaults to the dense Llama model; pass ``loss_fn`` +
+    ``param_specs`` for other models (e.g. Mixtral with ep sharding)."""
     loss_fn = loss_fn or llama.loss_fn
-    param_shardings = shardings_for(mesh, llama_param_specs(cfg))
+    param_shardings = shardings_for(mesh, param_specs or llama_param_specs(cfg))
     repl = NamedSharding(mesh, P())
     batch_sharding = NamedSharding(mesh, BATCH_SPEC)
 
@@ -82,28 +85,35 @@ def build_train_step(
     return step_fn
 
 
-def place_state(state: TrainState, cfg: llama.LlamaConfig, mesh: Mesh) -> TrainState:
+def place_state(
+    state: TrainState, cfg, mesh: Mesh, param_specs: Any | None = None
+) -> TrainState:
     """Shard an (unsharded) TrainState onto the mesh: params by spec,
     optimizer moments inherit their parameter's sharding, scalars replicate."""
-    param_shardings = shardings_for(mesh, llama_param_specs(cfg))
+    param_shardings = shardings_for(mesh, param_specs or llama_param_specs(cfg))
     repl = NamedSharding(mesh, P())
 
     params = jax.device_put(state.params, param_shardings)
 
-    param_flat, param_treedef = jax.tree_util.tree_flatten(state.params)
-    shard_flat, _ = jax.tree_util.tree_flatten(
-        param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
+    # optimizer moments (adam mu/nu) are SUBTREES mirroring the param tree —
+    # match by tree STRUCTURE, not leaf shape: square matrices like wq/wo
+    # share (shape, dtype) but have transposed shardings, so a shape-keyed
+    # map silently places moments wrong and forces re-resharding every step
+    param_treedef = jax.tree_util.tree_structure(state.params)
+
+    def is_param_subtree(x) -> bool:
+        try:
+            return jax.tree_util.tree_structure(x) == param_treedef
+        except Exception:
+            return False
+
+    def place_opt(x):
+        if is_param_subtree(x):
+            return jax.device_put(x, param_shardings)
+        return jax.tree_util.tree_map(lambda leaf: jax.device_put(leaf, repl), x)
+
+    opt_state = jax.tree_util.tree_map(
+        place_opt, state.opt_state, is_leaf=is_param_subtree
     )
-    by_shape = {}
-    for leaf, sh in zip(param_flat, shard_flat):
-        by_shape.setdefault((leaf.shape, leaf.dtype), sh)
-
-    def opt_leaf(leaf):
-        if hasattr(leaf, "shape"):
-            sh = by_shape.get((leaf.shape, leaf.dtype), repl)
-            return jax.device_put(leaf, sh)
-        return leaf
-
-    opt_state = jax.tree_util.tree_map(opt_leaf, state.opt_state)
     step = jax.device_put(state.step, repl)
     return TrainState(params, opt_state, step)

@@ -1,0 +1,128 @@
+"""Gang/topology placement tests: BASELINE configs[3-4] shapes."""
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.dealer import Dealer
+from nanotpu.dealer.gang import GANG_BONUS, GangTracker, gang_affinity_bonus
+from nanotpu.k8s.client import FakeClientset
+from nanotpu.k8s.objects import make_container, make_node, make_pod
+
+
+def slice_node(name, slice_name, coords, chips=4):
+    return make_node(
+        name,
+        {types.RESOURCE_TPU_PERCENT: chips * 100},
+        labels={
+            types.LABEL_TPU_GENERATION: "v5p",
+            types.LABEL_TPU_TOPOLOGY: "2x2x1",
+            types.LABEL_TPU_SLICE: slice_name,
+            types.LABEL_TPU_SLICE_COORDS: coords,
+        },
+    )
+
+
+def gang_pod(name, gang, size, percent=100):
+    return make_pod(
+        name,
+        containers=[make_container("w", {types.RESOURCE_TPU_PERCENT: percent})],
+        annotations={
+            types.ANNOTATION_GANG_NAME: gang,
+            types.ANNOTATION_GANG_SIZE: str(size),
+        },
+    )
+
+
+@pytest.fixture
+def pool():
+    """Two slices, each a 2x2 host grid (v5p-16-like)."""
+    client = FakeClientset()
+    for s in range(2):
+        for hx in range(2):
+            for hy in range(2):
+                client.create_node(
+                    slice_node(
+                        f"s{s}-h{hx}{hy}", f"slice-{s}", f"{hx},{hy},0"
+                    )
+                )
+    return client
+
+
+class TestAffinityScoring:
+    def test_no_members_no_bonus(self):
+        assert gang_affinity_bonus("slice-0", "0,0,0", []) == 0
+
+    def test_cross_slice_no_bonus(self):
+        assert (
+            gang_affinity_bonus("slice-1", "0,0,0", [("slice-0", "0,0,0")]) == 0
+        )
+
+    def test_same_slice_base_bonus(self):
+        b = gang_affinity_bonus("slice-0", "", [("slice-0", "0,0,0")])
+        assert b == GANG_BONUS // 2  # no coords -> base only
+
+    def test_adjacent_beats_distant(self):
+        members = [("slice-0", "0,0,0")]
+        near = gang_affinity_bonus("slice-0", "1,0,0", members)
+        far = gang_affinity_bonus("slice-0", "3,3,0", members)
+        assert near > far
+        assert near <= GANG_BONUS
+
+    def test_tracker_lifecycle(self):
+        t = GangTracker()
+        t.record_bound("g", 4, "u1", "n1")
+        t.record_bound("g", 4, "u2", "n2")
+        assert t.bound_nodes("g") == ["n1", "n2"]
+        t.forget_pod("u1")
+        assert t.bound_nodes("g") == ["n2"]
+        t.forget_pod("u2")
+        assert t.bound_nodes("g") == []
+        assert t.status() == {}
+
+
+class TestDealerGangFlow:
+    def test_scores_pull_gang_together(self, pool):
+        d = Dealer(pool, make_rater("binpack"))
+        nodes = [f"s{s}-h{hx}{hy}" for s in range(2) for hx in range(2) for hy in range(2)]
+        p0 = pool.create_pod(gang_pod("w0", "llama", 4, 400))
+        d.bind("s0-h00", p0)
+        p1 = pool.create_pod(gang_pod("w1", "llama", 4, 400))
+        scores = dict(d.score(nodes, p1))
+        # the bound member's own node is full (400 bound); other slice-0
+        # hosts must outrank every slice-1 host
+        s0_best = max(scores[n] for n in nodes if n.startswith("s0") and n != "s0-h00")
+        s1_best = max(scores[n] for n in nodes if n.startswith("s1"))
+        assert s0_best > s1_best
+
+    def test_whole_gang_lands_one_slice(self, pool):
+        d = Dealer(pool, make_rater("binpack"))
+        nodes = [f"s{s}-h{hx}{hy}" for s in range(2) for hx in range(2) for hy in range(2)]
+        placed = []
+        for i in range(4):
+            pod = pool.create_pod(gang_pod(f"w{i}", "job", 4, 400))
+            ok, _ = d.assume(nodes, pod)
+            ranked = d.score(ok, pod)
+            best = max(ranked, key=lambda kv: kv[1])[0]
+            d.bind(best, pod)
+            placed.append(best)
+        slices = {n.split("-")[0] for n in placed}
+        assert len(slices) == 1, f"gang split across slices: {placed}"
+        assert len(set(placed)) == 4  # four distinct hosts
+
+    def test_release_clears_gang_state(self, pool):
+        d = Dealer(pool, make_rater("binpack"))
+        pod = pool.create_pod(gang_pod("w0", "g2", 2, 100))
+        d.bind("s0-h00", pod)
+        assert d.status()["gangs"]["default/g2"]["bound"] == 1
+        bound = pool.get_pod("default", "w0")
+        d.release(bound)
+        assert "default/g2" not in d.status()["gangs"]
+
+    def test_restart_recovers_gang_state(self, pool):
+        d1 = Dealer(pool, make_rater("binpack"))
+        pod = pool.create_pod(gang_pod("w0", "g3", 2, 100))
+        d1.bind("s0-h01", pod)
+        d2 = Dealer(pool, make_rater("binpack"))  # fresh boot, same cluster
+        assert d2.status()["gangs"]["default/g3"]["bound"] == 1
+        assert d2.gangs.bound_nodes("default/g3") == ["s0-h01"]
