@@ -1,0 +1,32 @@
+"""nanotpu node agent: kubelet device plugin for fractional TPU chips,
+topology labelling, bind-annotation pinning, and the per-node runtime
+metrics exporter.
+
+TPU-native rebuild of the reference's companion nano-gpu-agent project
+(referenced, not vendored, at /root/reference/README.md:30-34). Import
+surface: :class:`NodeAgent`, :class:`TpuDevicePlugin`, :func:`discover`.
+gRPC pieces import lazily so environments without grpcio can still use
+discovery and the backlog.
+"""
+
+from .discovery import HostTopology, discover  # noqa: F401
+
+__all__ = [
+    "HostTopology",
+    "discover",
+    "PodBacklog",
+    "TpuDevicePlugin",
+    "NodeAgent",
+]
+
+
+def __getattr__(name):
+    if name in ("PodBacklog", "TpuDevicePlugin", "device_id", "parse_device_id"):
+        from . import plugin
+
+        return getattr(plugin, name)
+    if name == "NodeAgent":
+        from .agent import NodeAgent
+
+        return NodeAgent
+    raise AttributeError(name)

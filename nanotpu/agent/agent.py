@@ -1,0 +1,257 @@
+"""The nanotpu node agent: device plugin + node labeller + pod watcher +
+metrics exporter, wired together.
+
+TPU-native counterpart of nano-gpu-agent (the reference's companion project,
+/root/reference/README.md:30-34). One agent runs per TPU host (DaemonSet,
+deploy/nanotpu-agent.yaml) and:
+
+1. discovers the host's chips/topology (:mod:`.discovery`);
+2. serves the kubelet **device plugin** on a unix socket and registers with
+   kubelet, advertising ``tpu.io/chip-percent`` (100 slots per chip) — this
+   is what gives nodes the extended-resource capacity the scheduler filters
+   on (the reference read that capacity at pkg/utils/node.go:8-14);
+3. patches its Node with the topology labels the allocator consumes
+   (tpu.io/generation, tpu.io/topology, slice labels — nanotpu/types.py);
+4. watches pods bound to this node and feeds their bind annotations into the
+   device plugin's backlog, so ``Allocate`` pins containers to the exact
+   chips the scheduler chose (annotation codec: pkg/utils/pod.go:65-92
+   behavior, consumed node-side);
+5. exports per-chip runtime metrics on :8431 for load-aware scheduling
+   (:mod:`.exporter`).
+
+Everything is stoppable for tests; ``main()`` is the DaemonSet entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import logging
+import os
+import threading
+import time
+
+import grpc
+
+from nanotpu import types
+from nanotpu.k8s.client import Clientset, ConflictError, NotFoundError
+
+from . import deviceplugin_v1beta1_pb2 as pb
+from .deviceplugin_grpc import (
+    API_VERSION,
+    RegistrationStub,
+    add_device_plugin_servicer,
+)
+from .discovery import HostTopology, discover
+from .exporter import NodeMetricsExporter, StaticUsageProvider, UsageProvider
+from .plugin import PodBacklog, TpuDevicePlugin
+
+log = logging.getLogger("nanotpu.agent")
+
+#: kubelet's device-plugin directory (registration socket + plugin sockets).
+DEVICE_PLUGIN_DIR = "/var/lib/kubelet/device-plugins"
+KUBELET_SOCKET = "kubelet.sock"
+PLUGIN_SOCKET = "nanotpu.sock"
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        node_name: str,
+        client: Clientset | None = None,
+        host_topo: HostTopology | None = None,
+        plugin_dir: str = DEVICE_PLUGIN_DIR,
+        metrics_port: int = 8431,
+        usage_provider: UsageProvider | None = None,
+    ):
+        self.node_name = node_name
+        self.client = client
+        self.host_topo = host_topo or discover()
+        self.plugin_dir = plugin_dir
+        self.metrics_port = metrics_port
+        self.backlog = PodBacklog()
+        self.plugin = TpuDevicePlugin(self.host_topo, self.backlog)
+        self.usage_provider = usage_provider or StaticUsageProvider(
+            self.host_topo.n_chips
+        )
+        self.exporter = NodeMetricsExporter(
+            self.host_topo, self.usage_provider, metrics_port
+        )
+        self._grpc_server: grpc.Server | None = None
+        self._stop = threading.Event()
+        self._watch = None
+        self._threads: list[threading.Thread] = []
+
+    # -- device plugin serving + registration ------------------------------
+
+    @property
+    def socket_path(self) -> str:
+        return os.path.join(self.plugin_dir, PLUGIN_SOCKET)
+
+    def start_device_plugin(self) -> None:
+        if self._grpc_server is not None:
+            # Re-serving after a kubelet restart: tear the old server (and
+            # its thread pool / ListAndWatch streams) down first.
+            self._grpc_server.stop(grace=1.0)
+            self._grpc_server = None
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=4),
+            options=(("grpc.so_reuseport", 0),),
+        )
+        add_device_plugin_servicer(server, self.plugin)
+        server.add_insecure_port(f"unix://{self.socket_path}")
+        server.start()
+        self._grpc_server = server
+        log.info(
+            "device plugin serving %d chip-percent slots on %s",
+            self.host_topo.n_chips * types.PERCENT_PER_CHIP,
+            self.socket_path,
+        )
+
+    def register_with_kubelet(self, timeout_s: float = 10.0) -> None:
+        kubelet = os.path.join(self.plugin_dir, KUBELET_SOCKET)
+        with grpc.insecure_channel(f"unix://{kubelet}") as channel:
+            grpc.channel_ready_future(channel).result(timeout=timeout_s)
+            stub = RegistrationStub(channel)
+            stub.Register(
+                pb.RegisterRequest(
+                    version=API_VERSION,
+                    endpoint=PLUGIN_SOCKET,
+                    resource_name=types.RESOURCE_TPU_PERCENT,
+                    options=pb.DevicePluginOptions(
+                        get_preferred_allocation_available=True
+                    ),
+                ),
+                timeout=timeout_s,
+            )
+        log.info("registered %s with kubelet", types.RESOURCE_TPU_PERCENT)
+
+    # -- node labelling ----------------------------------------------------
+
+    def label_node(self, retries: int = 3) -> bool:
+        """Patch topology labels (and chip-percent capacity, which covers
+        fake clusters whose kubelet doesn't do device-plugin accounting)."""
+        if self.client is None:
+            return False
+        labels = self.host_topo.node_labels()
+        capacity = str(self.host_topo.n_chips * types.PERCENT_PER_CHIP)
+        for _ in range(retries):
+            try:
+                node = self.client.get_node(self.node_name)
+            except NotFoundError:
+                return False
+            except Exception as exc:
+                # API server unreachable (e.g. standalone runs where a
+                # clientset was constructed but the cluster isn't there).
+                # Labelling is best-effort; never take the agent down.
+                log.warning("cannot label node %s: %s", self.node_name, exc)
+                return False
+            node.ensure_labels().update(labels)
+            status = node.raw.setdefault("status", {})
+            for field in ("capacity", "allocatable"):
+                status.setdefault(field, {})[types.RESOURCE_TPU_PERCENT] = capacity
+            try:
+                self.client.update_node(node)
+                return True
+            except ConflictError:
+                continue
+            except Exception as exc:
+                log.warning("cannot label node %s: %s", self.node_name, exc)
+                return False
+        return False
+
+    # -- pod watcher -------------------------------------------------------
+
+    def _pump_pods(self) -> None:
+        """Feed assumed pods on this node into the Allocate backlog."""
+        if self.client is None:
+            return
+        try:
+            # Subscribe BEFORE listing (informer pattern): a pod bound in the
+            # gap between list and watch would otherwise never reach the
+            # backlog. offer() dedupes, so seeing a pod twice is harmless.
+            self._watch = self.client.watch_pods()
+            for pod in self.client.list_pods():
+                if pod.node_name == self.node_name:
+                    self.backlog.offer(pod)
+        except Exception as exc:
+            log.warning("pod watch unavailable: %s", exc)
+            return
+        while not self._stop.is_set():
+            ev = self._watch.poll(timeout=0.2)
+            if ev is None:
+                continue
+            if ev.type in ("ADDED", "MODIFIED") and ev.obj.node_name == self.node_name:
+                self.backlog.offer(ev.obj)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, register: bool = True) -> None:
+        self.start_device_plugin()
+        if register:
+            self.register_with_kubelet()
+        self.label_node()
+        self.exporter.start()
+        if self.client is not None:
+            t = threading.Thread(target=self._pump_pods, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.stop()
+        self.plugin.stop()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=1.0)
+            self._grpc_server = None
+        self.exporter.stop()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
+def main(argv: list[str] | None = None) -> None:  # pragma: no cover - binary entry
+    parser = argparse.ArgumentParser(description="nanotpu node agent")
+    parser.add_argument(
+        "--node-name", default=os.environ.get("NODE_NAME", os.uname().nodename)
+    )
+    parser.add_argument("--plugin-dir", default=DEVICE_PLUGIN_DIR)
+    parser.add_argument("--metrics-port", type=int, default=8431)
+    parser.add_argument(
+        "--no-register", action="store_true", help="skip kubelet registration"
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    client = None
+    try:
+        from nanotpu.k8s.rest import RestClientset
+
+        client = RestClientset.from_env(os.environ.get("KUBECONFIG", ""))
+    except Exception as exc:
+        log.warning("no API server client (%s); running standalone", exc)
+
+    agent = NodeAgent(
+        args.node_name,
+        client=client,
+        plugin_dir=args.plugin_dir,
+        metrics_port=args.metrics_port,
+    )
+    agent.start(register=not args.no_register)
+    try:
+        while True:
+            # Re-register if kubelet restarted (its socket gets recreated;
+            # plugins must re-Register — the standard device-plugin dance).
+            time.sleep(5.0)
+            if not args.no_register and not os.path.exists(agent.socket_path):
+                log.info("plugin socket vanished (kubelet restart?); re-serving")
+                agent.start_device_plugin()
+                agent.register_with_kubelet()
+    except KeyboardInterrupt:
+        agent.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,121 @@
+"""Hand-written gRPC bindings for the kubelet device-plugin API v1beta1.
+
+``grpcio`` is available in the image but ``grpcio-tools`` (the protoc gRPC
+plugin) is not, so the service stubs are written against grpc's generic
+handler API instead of being generated. Message classes come from the
+protoc-generated ``deviceplugin_v1beta1_pb2``.
+
+Covers both directions of the protocol:
+
+* plugin → kubelet: :class:`RegistrationStub` (``Register``);
+* kubelet → plugin: :func:`add_device_plugin_servicer` registers a servicer
+  implementing ``GetDevicePluginOptions`` / ``ListAndWatch`` / ``Allocate`` /
+  ``GetPreferredAllocation`` / ``PreStartContainer``.
+
+For tests, the inverse pair also exists (:func:`add_registration_servicer`,
+:class:`DevicePluginStub`) so a fake kubelet can run in-process.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import deviceplugin_v1beta1_pb2 as pb
+
+PACKAGE = "v1beta1"
+API_VERSION = "v1beta1"
+
+
+# --------------------------------------------------------------------------
+# Registration service (kubelet serves, plugin calls).
+# --------------------------------------------------------------------------
+
+
+class RegistrationStub:
+    def __init__(self, channel: grpc.Channel):
+        self.Register = channel.unary_unary(
+            f"/{PACKAGE}.Registration/Register",
+            request_serializer=pb.RegisterRequest.SerializeToString,
+            response_deserializer=pb.Empty.FromString,
+        )
+
+
+def add_registration_servicer(server: grpc.Server, servicer) -> None:
+    handlers = {
+        "Register": grpc.unary_unary_rpc_method_handler(
+            servicer.Register,
+            request_deserializer=pb.RegisterRequest.FromString,
+            response_serializer=pb.Empty.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(f"{PACKAGE}.Registration", handlers),)
+    )
+
+
+# --------------------------------------------------------------------------
+# DevicePlugin service (plugin serves, kubelet calls).
+# --------------------------------------------------------------------------
+
+
+class DevicePluginStub:
+    def __init__(self, channel: grpc.Channel):
+        base = f"/{PACKAGE}.DevicePlugin"
+        self.GetDevicePluginOptions = channel.unary_unary(
+            f"{base}/GetDevicePluginOptions",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.DevicePluginOptions.FromString,
+        )
+        self.ListAndWatch = channel.unary_stream(
+            f"{base}/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )
+        self.GetPreferredAllocation = channel.unary_unary(
+            f"{base}/GetPreferredAllocation",
+            request_serializer=pb.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=pb.PreferredAllocationResponse.FromString,
+        )
+        self.Allocate = channel.unary_unary(
+            f"{base}/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString,
+        )
+        self.PreStartContainer = channel.unary_unary(
+            f"{base}/PreStartContainer",
+            request_serializer=pb.PreStartContainerRequest.SerializeToString,
+            response_deserializer=pb.PreStartContainerResponse.FromString,
+        )
+
+
+def add_device_plugin_servicer(server: grpc.Server, servicer) -> None:
+    handlers = {
+        "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+            servicer.GetDevicePluginOptions,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.DevicePluginOptions.SerializeToString,
+        ),
+        "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+            servicer.ListAndWatch,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.ListAndWatchResponse.SerializeToString,
+        ),
+        "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPreferredAllocation,
+            request_deserializer=pb.PreferredAllocationRequest.FromString,
+            response_serializer=pb.PreferredAllocationResponse.SerializeToString,
+        ),
+        "Allocate": grpc.unary_unary_rpc_method_handler(
+            servicer.Allocate,
+            request_deserializer=pb.AllocateRequest.FromString,
+            response_serializer=pb.AllocateResponse.SerializeToString,
+        ),
+        "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+            servicer.PreStartContainer,
+            request_deserializer=pb.PreStartContainerRequest.FromString,
+            response_serializer=pb.PreStartContainerResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(f"{PACKAGE}.DevicePlugin", handlers),)
+    )

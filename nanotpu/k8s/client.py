@@ -65,6 +65,8 @@ class Clientset(Protocol):
 
     def list_nodes(self) -> list[Node]: ...
 
+    def update_node(self, node: Node) -> Node: ...
+
     def watch_pods(self) -> "Watch": ...
 
     def watch_nodes(self) -> "Watch": ...
@@ -222,6 +224,23 @@ class FakeClientset:
     def list_nodes(self) -> list[Node]:
         with self._lock:
             return [Node(copy.deepcopy(raw)) for raw in self._nodes.values()]
+
+    def update_node(self, node: Node) -> Node:
+        with self._lock:
+            if node.name not in self._nodes:
+                raise NotFoundError(f"node {node.name} not found")
+            current = self._nodes[node.name]
+            cur_rv = (current.get("metadata") or {}).get("resourceVersion", "")
+            if node.resource_version != cur_rv:
+                raise ConflictError(
+                    f"Operation cannot be fulfilled on nodes {node.name!r}: "
+                    f"please apply your changes to the latest version and try again"
+                )
+            raw = self._bump(copy.deepcopy(node.raw))
+            self._nodes[node.name] = raw
+            out = Node(copy.deepcopy(raw))
+            self._notify(self._node_watches, WatchEvent("MODIFIED", out))
+            return out
 
     def delete_node(self, name: str) -> None:
         with self._lock:

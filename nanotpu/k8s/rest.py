@@ -154,6 +154,9 @@ class RestClientset:
         out = self._request("GET", "/api/v1/nodes")
         return [Node(item) for item in out.get("items", [])]
 
+    def update_node(self, node: Node) -> Node:
+        return Node(self._request("PUT", f"/api/v1/nodes/{node.name}", node.raw))
+
     # -- watches -----------------------------------------------------------
     def _watch(self, path: str, wrap) -> Watch:
         """Long-lived watch that RECONNECTS: the API server closes every

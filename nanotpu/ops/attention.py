@@ -110,10 +110,12 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     scale = 1.0 / math.sqrt(D)
     block_q = min(block_q, S)
     block_k = min(block_k, S)
-    # pad the sequence to a block multiple: pallas clamps ragged final
-    # blocks (dynamic-slice semantics), which would shift position math;
-    # padded k positions are masked via seq_len, padded q rows sliced off
-    blk = max(block_q, block_k)
+    # pad the sequence to a common multiple of BOTH block sizes: the grid
+    # needs block_q | S_pad, and the k-position math needs block_k | S_pad
+    # (pallas clamps ragged final blocks with dynamic-slice semantics, which
+    # would shift positions); padded k positions are masked via seq_len,
+    # padded q rows sliced off
+    blk = math.lcm(block_q, block_k)
     S_pad = ((S + blk - 1) // blk) * blk
     if S_pad != S:
         pad = [(0, 0), (0, S_pad - S), (0, 0), (0, 0)]
@@ -157,7 +159,10 @@ def flash_attention(
 
 
 def _use_pallas(interpret: bool | None) -> bool:
-    if interpret is not None:
+    # Only interpret=True forces the kernel (interpreter mode runs anywhere);
+    # False and None both mean "compiled kernel on TPU, XLA elsewhere" —
+    # compiling the Pallas kernel on a non-TPU backend would fail to lower.
+    if interpret:
         return True
     return jax.default_backend() == "tpu"
 

@@ -35,6 +35,16 @@ class TestFlashKernel:
         flash = flash_attention(q, k, v, True, 64, 64, True)
         np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=2e-5)
 
+    @pytest.mark.parametrize("block_q,block_k", [(96, 128), (128, 96)])
+    def test_unequal_nondividing_blocks(self, block_q, block_k):
+        # Padding must reach a common multiple of BOTH blocks: with S=200 and
+        # blocks 96/128, padding only to max(block) either leaves q rows
+        # uncovered by the grid or misaligns the k-position mask.
+        q, k, v = qkv(jax.random.PRNGKey(7), S=200)
+        dense = _xla_attention(q, k, v, True)
+        flash = flash_attention(q, k, v, True, block_q, block_k, True)
+        np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=2e-5)
+
     def test_gradients_flow(self):
         q, k, v = qkv(jax.random.PRNGKey(2), S=64)
 
