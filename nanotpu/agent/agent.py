@@ -247,8 +247,13 @@ def main(argv: list[str] | None = None) -> None:  # pragma: no cover - binary en
             time.sleep(5.0)
             if not args.no_register and not os.path.exists(agent.socket_path):
                 log.info("plugin socket vanished (kubelet restart?); re-serving")
-                agent.start_device_plugin()
-                agent.register_with_kubelet()
+                try:
+                    agent.start_device_plugin()
+                    agent.register_with_kubelet()
+                except Exception as exc:
+                    # kubelet may take a while to come back; keep the
+                    # exporter and plugin alive and retry on the next tick
+                    log.warning("re-registration failed (will retry): %s", exc)
     except KeyboardInterrupt:
         agent.stop()
 

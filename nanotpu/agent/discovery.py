@@ -29,15 +29,15 @@ import os
 import re
 
 from nanotpu import types
-from nanotpu.topology import Torus, parse_topology
+from nanotpu.topology import (
+    DEFAULT_HOST_TOPOLOGY,
+    HOST_CHIPS,
+    SUBHOST_TOPOLOGY,
+    Torus,
+    parse_topology,
+)
 
 log = logging.getLogger("nanotpu.agent.discovery")
-
-#: chips per host for each accelerator generation (Cloud TPU host layout).
-CHIPS_PER_HOST = {"v4": 4, "v5p": 4, "v5e": 8, "v6e": 8}
-
-#: local (per-host) chip topology per generation.
-HOST_TOPOLOGY = {"v4": "2x2x1", "v5p": "2x2x1", "v5e": "2x4x1", "v6e": "2x4x1"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,6 +84,21 @@ def _accelerator_generation(accel_type: str) -> str:
     return head
 
 
+def _slice_chip_count(accel_type: str, gen: str) -> int | None:
+    """Total chips in the slice named by the accelerator type, or None.
+
+    Cloud TPU naming: v4/v5p type suffixes count TensorCores (2 per chip,
+    so v5p-16 == 8 chips); v5e/v6e suffixes count chips (v5litepod-4 == 4
+    chips, a real sub-host machine type)."""
+    tail = accel_type.rsplit("-", 1)[-1]
+    if not tail.isdigit():
+        return None
+    n = int(tail)
+    if gen in ("v4", "v5p"):
+        n = max(1, n // 2)
+    return n
+
+
 def _from_jax() -> HostTopology | None:
     if os.environ.get("NANOTPU_AGENT_USE_JAX") != "1":
         return None
@@ -100,7 +115,7 @@ def _from_jax() -> HostTopology | None:
     m = re.search(r"v\d+[a-z]*", kind)
     gen = m.group(0) if m else "v5p"
     n = len(devices)
-    topo = HOST_TOPOLOGY.get(gen, f"{n}x1x1")
+    topo = SUBHOST_TOPOLOGY.get(n) or DEFAULT_HOST_TOPOLOGY.get(gen, f"{n}x1x1")
     if Torus.from_spec(topo).num_chips != n:
         topo = f"{n}x1x1"
     return HostTopology(generation=gen, topology=topo, n_chips=n)
@@ -111,8 +126,13 @@ def _from_env(env: dict[str, str]) -> HostTopology | None:
     if not accel:
         return None
     gen = _accelerator_generation(accel)
-    n = CHIPS_PER_HOST.get(gen, 4)
-    topo = HOST_TOPOLOGY.get(gen, "2x2x1")
+    full_host = HOST_CHIPS.get(gen, 4)
+    slice_chips = _slice_chip_count(accel, gen)
+    # a slice smaller than a full host IS the host's chip count
+    # (v5litepod-4 → 4 chips, not 8 — advertising phantom /dev/accel files
+    # would fail container creation and overcommit the node)
+    n = min(slice_chips, full_host) if slice_chips else full_host
+    topo = SUBHOST_TOPOLOGY.get(n) or DEFAULT_HOST_TOPOLOGY.get(gen, f"{n}x1x1")
     slice_topo = env.get("TPU_TOPOLOGY", "")
     worker_id = env.get("TPU_WORKER_ID", "")
     slice_coords = ""
@@ -145,7 +165,7 @@ def _from_devfiles() -> HostTopology | None:
     if not paths:
         return None
     n = len(paths)
-    topo = {4: "2x2x1", 8: "2x4x1"}.get(n, f"{n}x1x1")
+    topo = SUBHOST_TOPOLOGY.get(n, f"{n}x1x1")
     return HostTopology(
         generation="v5p", topology=topo, n_chips=n, device_paths=tuple(paths)
     )

@@ -72,12 +72,19 @@ class PodBacklog:
     newly-assumed pod on this node; ``Allocate`` pops the oldest entry whose
     percent matches the request size."""
 
+    #: dedupe memory; one node hosts at most a few hundred pods over any
+    #: window this matters for, so the bound never evicts a live pod's key
+    SEEN_MAX = 4096
+
     def __init__(self, ttl_s: float = 300.0):
         self._entries: list[BacklogEntry] = []
         # Dedupe by pod UID (not ns/name: a recreated StatefulSet pod reuses
-        # its name but must be re-offered). Values are insert times so the
-        # set is pruned with the same TTL as the entries.
-        self._seen: dict[str, float] = {}
+        # its name but gets a fresh UID and must be re-offered). Keys are
+        # NEVER expired by time — a long-running pod's watch heartbeats
+        # would otherwise re-offer it after the TTL and its phantom entry
+        # would FIFO-steal a later pod's Allocate. Insertion-ordered dict
+        # capped at SEEN_MAX keeps memory bounded.
+        self._seen: dict[str, None] = {}
         self._lock = threading.Lock()
         self.ttl_s = ttl_s
 
@@ -88,9 +95,6 @@ class PodBacklog:
         added = 0
         now = time.monotonic()
         with self._lock:
-            self._seen = {
-                k: t for k, t in self._seen.items() if now - t < self.ttl_s
-            }
             for c in pod.containers:
                 key = f"{pod.uid or pod.key()}/{c.name}"
                 if key in self._seen:
@@ -107,7 +111,9 @@ class PodBacklog:
                     continue
                 if chips == (types.NOT_NEED_TPU,):
                     continue
-                self._seen[key] = now
+                self._seen[key] = None
+                while len(self._seen) > self.SEEN_MAX:
+                    self._seen.pop(next(iter(self._seen)))
                 self._entries.append(
                     BacklogEntry(pod.key(), c.name, percent, chips, now)
                 )

@@ -92,15 +92,40 @@ def _whole_chip_candidates(chips: ChipSet, free: list[int], k: int) -> list[froz
 
 
 def _choose(chips: ChipSet, demand: Demand, prefer_used: bool, rng_key: str | None = None) -> list[list[int]] | None:
-    """Shared placement engine.
+    """Shared placement engine: native C++ hot path with Python fallback.
 
     ``prefer_used=True`` == binpack (stack onto the fullest feasible chips /
     next to allocated regions); False == spread (emptiest chips / far from
     allocated regions). ``rng_key`` switches to deterministic-random
-    candidate selection.
+    candidate selection (Python only — sha256 ranking is not hot).
+
+    The native engine (native/allocator.cc) implements :func:`_choose_py`'s
+    binpack/spread placement with exact result parity, fuzz-enforced by
+    tests/test_native.py.
     """
     if not demand.is_valid():
         return None
+    if rng_key is None:
+        from nanotpu import native
+
+        try:
+            return native.choose(
+                chips.torus.dims,
+                [c.percent_free for c in chips.chips],
+                [c.percent_total for c in chips.chips],
+                [c.load for c in chips.chips],
+                list(demand.percents),
+                prefer_used,
+                types.PERCENT_PER_CHIP,
+            )
+        except native.NativeUnavailable:
+            pass
+    return _choose_py(chips, demand, prefer_used, rng_key)
+
+
+def _choose_py(chips: ChipSet, demand: Demand, prefer_used: bool, rng_key: str | None = None) -> list[list[int]] | None:
+    """Pure-Python placement engine — the reference implementation the
+    native path must match. Assumes ``demand.is_valid()``."""
     free = [c.percent_free for c in chips.chips]
     assignments: list[list[int]] = [[] for _ in demand.percents]
 

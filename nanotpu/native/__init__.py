@@ -1,0 +1,166 @@
+"""ctypes bindings for the native allocator hot path (native/allocator.cc).
+
+The C++ library implements the placement engine of
+``nanotpu.allocator.rater._choose`` (binpack/spread) with exact result
+parity — enforced by the fuzz tests in tests/test_native.py. The Python
+implementation remains the reference and the fallback:
+
+* ``NANOTPU_NATIVE=0`` disables the native path;
+* a missing/unbuildable library falls back silently;
+* tori over 64 chips or any native error fall back per call.
+
+``ensure_built()`` compiles the library on demand (g++, ~1s) and caches by
+source mtime, so dev environments and tests never need a separate build
+step; deployments run ``make native`` at image build instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger("nanotpu.native")
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))
+_SRC = os.path.join(_REPO_ROOT, "native", "allocator.cc")
+_LIB = os.path.join(_PKG_DIR, "libnanotpu_alloc.so")
+
+#: must match nanotpu_abi_version() in allocator.cc
+ABI_VERSION = 2
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+OK = 1
+INFEASIBLE = 0
+
+
+def ensure_built() -> bool:
+    """Compile the shared library if missing or older than its source."""
+    if not os.path.exists(_SRC):
+        return os.path.exists(_LIB)
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return True
+    try:
+        subprocess.run(
+            [
+                os.environ.get("CXX", "g++"),
+                "-O3", "-fPIC", "-shared", "-std=c++17",
+                "-o", _LIB, _SRC,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError) as exc:
+        log.warning("native allocator build failed: %s", exc)
+        return False
+
+
+def _open_checked() -> ctypes.CDLL | None:
+    """dlopen the library and verify its ABI; None on any mismatch."""
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError as exc:
+        log.warning("native allocator load failed: %s", exc)
+        return None
+    lib.nanotpu_abi_version.restype = ctypes.c_int32
+    got = lib.nanotpu_abi_version()
+    if got != ABI_VERSION:
+        log.warning("native allocator ABI %d != expected %d", got, ABI_VERSION)
+        return None
+    return lib
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("NANOTPU_NATIVE", "1") == "0":
+            return None
+        if not ensure_built():
+            return None
+        lib = _open_checked()
+        if lib is None and os.path.exists(_SRC):
+            # stale .so with an old ABI: mtime made ensure_built() a no-op,
+            # so force one rebuild from source and retry the load
+            try:
+                os.unlink(_LIB)
+            except OSError:
+                pass
+            if ensure_built():
+                lib = _open_checked()
+        if lib is None:
+            return None
+        lib.nanotpu_choose.restype = ctypes.c_int32
+        lib.nanotpu_choose.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),  # dims[3]
+            ctypes.POINTER(ctypes.c_int32),  # free_percent
+            ctypes.POINTER(ctypes.c_int32),  # total_percent
+            ctypes.POINTER(ctypes.c_double),  # load
+            ctypes.c_int32,  # n_demands
+            ctypes.POINTER(ctypes.c_int32),  # demands
+            ctypes.c_int32,  # prefer_used
+            ctypes.c_int32,  # percent_per_chip
+            ctypes.POINTER(ctypes.c_int32),  # out_assign
+            ctypes.POINTER(ctypes.c_int32),  # out_counts
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeUnavailable(Exception):
+    """The native path cannot handle this input; use the Python engine."""
+
+
+def choose(
+    dims: tuple[int, int, int],
+    free_percent: list[int],
+    total_percent: list[int],
+    load: list[float],
+    demands: list[int],
+    prefer_used: bool,
+    percent_per_chip: int,
+) -> list[list[int]] | None:
+    """Native ``_choose``. Returns assignments or None (infeasible); raises
+    :class:`NativeUnavailable` when the caller should fall back to Python."""
+    lib = _load()
+    if lib is None:
+        raise NativeUnavailable("native allocator unavailable")
+    n = len(free_percent)
+    nd = len(demands)
+    out_cap = sum(max(1, d // percent_per_chip) for d in demands) or 1
+    c_dims = (ctypes.c_int32 * 3)(*dims)
+    c_free = (ctypes.c_int32 * n)(*free_percent)
+    c_total = (ctypes.c_int32 * n)(*total_percent)
+    c_load = (ctypes.c_double * n)(*load)
+    c_demands = (ctypes.c_int32 * max(nd, 1))(*demands)
+    c_assign = (ctypes.c_int32 * out_cap)()
+    c_counts = (ctypes.c_int32 * max(nd, 1))()
+    rc = lib.nanotpu_choose(
+        c_dims, c_free, c_total, c_load, nd, c_demands,
+        1 if prefer_used else 0, percent_per_chip, c_assign, c_counts,
+    )
+    if rc == INFEASIBLE:
+        return None
+    if rc != OK:
+        raise NativeUnavailable(f"native allocator error {rc}")
+    assignments: list[list[int]] = []
+    cursor = 0
+    for i in range(nd):
+        cnt = c_counts[i]
+        assignments.append([c_assign[cursor + j] for j in range(cnt)])
+        cursor += cnt
+    return assignments

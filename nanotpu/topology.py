@@ -20,14 +20,23 @@ from dataclasses import dataclass
 from functools import lru_cache
 
 #: Per-generation default host topology (chips per K8s node and their local
-#: torus shape). v4/v5p pack 4 chips per host as a 2x2x1 block; v5e hosts
-#: vary (4 or 8 chips); these are fallbacks when the node label is absent.
+#: torus shape), the SINGLE source of truth shared by the scheduler fallback
+#: (dealer/nodeinfo.py, when the node label is absent) and the node agent's
+#: discovery (agent/discovery.py). v4/v5p pack 4 chips per host as a 2x2x1
+#: block; full v5e/v6e hosts carry 8 chips as 2x4x1 (sub-host v5e machine
+#: types exist — the agent detects those from the accelerator type).
 DEFAULT_HOST_TOPOLOGY = {
     "v4": "2x2x1",
     "v5p": "2x2x1",
-    "v5e": "2x2x1",
-    "v6e": "2x2x1",
+    "v5e": "2x4x1",
+    "v6e": "2x4x1",
 }
+
+#: Chips on a FULL host of each generation (consistent with the table above).
+HOST_CHIPS = {"v4": 4, "v5p": 4, "v5e": 8, "v6e": 8}
+
+#: Local chip grid for sub-host chip counts (v5litepod-1/-4 style types).
+SUBHOST_TOPOLOGY = {1: "1x1x1", 2: "2x1x1", 4: "2x2x1", 8: "2x4x1"}
 
 Coord = tuple[int, int, int]
 
@@ -230,7 +239,10 @@ def box_shapes_for(n: int) -> list[tuple[int, int, int]]:
         a, b, c = s
         return a * b + b * c + a * c
 
-    return sorted(shapes, key=lambda s: (max(s), surface(s)))
+    # the shape tuple itself is the final tie-break: permutations with equal
+    # surface would otherwise sort by set-iteration order, which the native
+    # allocator (native/allocator.cc) could not reproduce
+    return sorted(shapes, key=lambda s: (max(s), surface(s), s))
 
 
 @lru_cache(maxsize=4096)

@@ -1,0 +1,343 @@
+// nanotpu native allocator core: the Filter hot path in C++.
+//
+// The reference's hot loop is Rater.Choose — a per-card greedy sort run for
+// every (candidate node, pod) pair inside Assume's worker pool
+// (/root/reference/pkg/dealer/rater.go:74-110, dealer.go:107-134). Our
+// topology-aware equivalent additionally enumerates axis-aligned sub-boxes
+// of the node's ICI torus, which is the dominant cost per node. This file
+// implements that engine natively, with EXACT result parity against the
+// Python implementation in nanotpu/allocator/rater.py::_choose — every
+// ordering and tie-break below mirrors a specific line there, and the fuzz
+// tests in tests/test_native.py enforce the equivalence.
+//
+// Scope: binpack (prefer_used=1) and spread (prefer_used=0) placement.
+// The Random policy hashes sha256 per candidate and is not hot; it stays in
+// Python. Scoring (Rate) is one cheap call per node and also stays in
+// Python.
+//
+// Representation: chip sets are uint64_t bitmasks — a node-local torus is
+// at most 64 chips (v5p hosts have 4, v5e/v6e 8; a full v5p-64 *slice* is
+// 64). Larger sets return NANOTPU_ERR_TOO_BIG and callers fall back.
+
+#include <cstdint>
+#include <algorithm>
+#include <tuple>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxChips = 64;
+
+struct Torus {
+  int dims[3];
+  bool wrap[3];
+  int n;
+
+  explicit Torus(const int32_t d[3]) {
+    for (int a = 0; a < 3; ++a) {
+      dims[a] = d[a];
+      // wrap iff axis length >= 4 (topology.py Torus.wrap)
+      wrap[a] = d[a] >= 4;
+    }
+    n = dims[0] * dims[1] * dims[2];
+  }
+
+  int chip_id(int x, int y, int z) const {
+    int X = dims[0], Y = dims[1], Z = dims[2];
+    x %= X; if (x < 0) x += X;
+    y %= Y; if (y < 0) y += Y;
+    z %= Z; if (z < 0) z += Z;
+    return x * Y * Z + y * Z + z;
+  }
+
+  void coord(int chip, int c[3]) const {
+    int Y = dims[1], Z = dims[2];
+    c[0] = chip / (Y * Z);
+    c[1] = (chip / Z) % Y;
+    c[2] = chip % Z;
+  }
+
+  // Unique sorted neighbor ids, excluding self (topology.py neighbors()).
+  std::vector<int> neighbors(int chip) const {
+    int c[3];
+    coord(chip, c);
+    std::vector<int> out;
+    for (int axis = 0; axis < 3; ++axis) {
+      if (dims[axis] == 1) continue;
+      for (int step = -1; step <= 1; step += 2) {
+        int nc[3] = {c[0], c[1], c[2]};
+        nc[axis] = c[axis] + step;
+        if ((nc[axis] >= 0 && nc[axis] < dims[axis]) || wrap[axis]) {
+          int id = chip_id(nc[0], nc[1], nc[2]);
+          if (id != chip) out.push_back(id);
+        }
+      }
+    }
+    std::sort(out.begin(), out.end());
+    out.erase(std::unique(out.begin(), out.end()), out.end());
+    return out;
+  }
+};
+
+// Adjacency precomputed once per call; bitmask per chip.
+struct Adjacency {
+  std::vector<uint64_t> nbr;
+  explicit Adjacency(const Torus& t) : nbr(t.n, 0) {
+    for (int c = 0; c < t.n; ++c)
+      for (int nb : t.neighbors(c)) nbr[c] |= (1ULL << nb);
+  }
+};
+
+// All (a,b,c) with a*b*c == n, ordered by (max, surface, tuple) —
+// topology.py box_shapes_for().
+struct Shape { int a, b, c; };
+std::vector<Shape> box_shapes_for(int n) {
+  std::vector<Shape> shapes;
+  for (int a = 1; a <= n; ++a) {
+    if (n % a) continue;
+    int rem = n / a;
+    for (int b = 1; b <= rem; ++b) {
+      if (rem % b) continue;
+      shapes.push_back({a, b, rem / b});
+    }
+  }
+  auto key = [](const Shape& s) {
+    int mx = std::max(s.a, std::max(s.b, s.c));
+    int surface = s.a * s.b + s.b * s.c + s.a * s.c;
+    return std::make_tuple(mx, surface, s.a, s.b, s.c);
+  };
+  std::stable_sort(shapes.begin(), shapes.end(),
+                   [&](const Shape& l, const Shape& r) { return key(l) < key(r); });
+  // dedupe identical tuples (the Python set) — generation above cannot
+  // produce duplicates, but keep the invariant explicit
+  shapes.erase(std::unique(shapes.begin(), shapes.end(),
+                           [](const Shape& l, const Shape& r) {
+                             return l.a == r.a && l.b == r.b && l.c == r.c;
+                           }),
+               shapes.end());
+  return shapes;
+}
+
+// Ordered, deduped sub-box placements of volume k (topology.py
+// placements_for(): shapes compact-first, origins in ox,oy,oz order).
+std::vector<uint64_t> placements_for(const Torus& t, int k) {
+  std::vector<uint64_t> out;
+  for (const Shape& s : box_shapes_for(k)) {
+    if (s.a > t.dims[0] || s.b > t.dims[1] || s.c > t.dims[2]) continue;
+    for (int ox = 0; ox <= t.dims[0] - s.a; ++ox)
+      for (int oy = 0; oy <= t.dims[1] - s.b; ++oy)
+        for (int oz = 0; oz <= t.dims[2] - s.c; ++oz) {
+          uint64_t mask = 0;
+          for (int i = 0; i < s.a; ++i)
+            for (int j = 0; j < s.b; ++j)
+              for (int l = 0; l < s.c; ++l)
+                mask |= 1ULL << t.chip_id(ox + i, oy + j, oz + l);
+          if (std::find(out.begin(), out.end(), mask) == out.end())
+            out.push_back(mask);
+        }
+  }
+  return out;
+}
+
+// Greedy ICI-connected growth (topology.py grow_connected()): repeatedly add
+// the frontier chip with the most links into the chosen set, tiebreak lowest
+// id. 0 == failure (a successful result always has >= 1 bit).
+uint64_t grow_connected(const Adjacency& adj, int seed, int k, uint64_t allowed) {
+  if (!(allowed >> seed & 1) || k < 1) return 0;
+  uint64_t chosen = 1ULL << seed;
+  while (__builtin_popcountll(chosen) < k) {
+    uint64_t frontier = 0;
+    uint64_t rest = chosen;
+    while (rest) {
+      int c = __builtin_ctzll(rest);
+      rest &= rest - 1;
+      frontier |= adj.nbr[c];
+    }
+    frontier &= allowed & ~chosen;
+    if (!frontier) return 0;
+    int best = -1, best_links = -1;
+    uint64_t f = frontier;
+    while (f) {
+      int cand = __builtin_ctzll(f);
+      f &= f - 1;
+      int links = __builtin_popcountll(adj.nbr[cand] & chosen);
+      // max(key=(links, -n)): more links wins; equal links -> LOWER id wins,
+      // and we scan ids ascending, so strictly-greater keeps the lowest
+      if (links > best_links) { best_links = links; best = cand; }
+    }
+    chosen |= 1ULL << best;
+  }
+  return chosen;
+}
+
+int min_bit(uint64_t mask) { return __builtin_ctzll(mask); }
+
+}  // namespace
+
+extern "C" {
+
+// Error/result codes.
+enum {
+  NANOTPU_OK = 1,
+  NANOTPU_INFEASIBLE = 0,
+  NANOTPU_ERR_TOO_BIG = -1,
+  NANOTPU_ERR_BAD_ARGS = -2,
+};
+
+// ABI version so the ctypes loader can reject stale builds.
+int32_t nanotpu_abi_version() { return 2; }
+
+// Place `n_demands` container demands onto one node's torus.
+//
+//   dims[3]          local torus shape (product == n_chips <= 64)
+//   free_percent     per-chip free capacity
+//   total_percent    per-chip total capacity
+//   load             per-chip live utilization [0,1]
+//   demands          per-container chip-percent requests
+//   prefer_used      1 = binpack, 0 = spread
+//   percent_per_chip units per whole chip (100)
+//   out_assign       packed chip ids, demand-major; caller sizes it as
+//                    sum(max(1, demands[i] / percent_per_chip))
+//   out_counts       chips written per demand (0 for zero demands)
+//
+// Mirrors rater.py _choose(): demands processed largest-first (stable),
+// whole-chip demands get contiguous sub-boxes / grown connected sets,
+// fractional demands pick single chips by fullness/load/id.
+int32_t nanotpu_choose(const int32_t dims[3],
+                       const int32_t* free_percent,
+                       const int32_t* total_percent,
+                       const double* load,
+                       int32_t n_demands,
+                       const int32_t* demands,
+                       int32_t prefer_used,
+                       int32_t percent_per_chip,
+                       int32_t* out_assign,
+                       int32_t* out_counts) {
+  if (!dims || !free_percent || !total_percent || !load || !demands ||
+      !out_assign || !out_counts || n_demands < 0 || percent_per_chip <= 0)
+    return NANOTPU_ERR_BAD_ARGS;
+  Torus t(dims);
+  if (t.n <= 0 || t.n > kMaxChips) return NANOTPU_ERR_TOO_BIG;
+  Adjacency adj(t);
+
+  std::vector<int32_t> free_(free_percent, free_percent + t.n);
+
+  // demand order: index list stable-sorted by percent descending
+  std::vector<int> order(n_demands);
+  for (int i = 0; i < n_demands; ++i) order[i] = i;
+  std::stable_sort(order.begin(), order.end(), [&](int l, int r) {
+    return demands[l] > demands[r];
+  });
+
+  std::vector<std::vector<int>> assignments(n_demands);
+
+  auto boundary_contact = [&](uint64_t box) {
+    int contact = 0;
+    uint64_t rest = box;
+    while (rest) {
+      int c = __builtin_ctzll(rest);
+      rest &= rest - 1;
+      uint64_t outside = adj.nbr[c] & ~box;
+      while (outside) {
+        int nb = __builtin_ctzll(outside);
+        outside &= outside - 1;
+        if (free_[nb] < total_percent[nb]) ++contact;
+      }
+    }
+    return contact;
+  };
+
+  for (int i : order) {
+    int percent = demands[i];
+    if (percent <= 0) continue;
+    if (percent >= percent_per_chip) {
+      int k = percent / percent_per_chip;
+      uint64_t fully_free = 0;
+      for (int c = 0; c < t.n; ++c)
+        if (free_[c] == total_percent[c]) fully_free |= 1ULL << c;
+      // candidates: sub-boxes inside fully_free, else grown connected sets
+      std::vector<uint64_t> candidates;
+      for (uint64_t box : placements_for(t, k))
+        if ((box & ~fully_free) == 0) candidates.push_back(box);
+      if (candidates.empty()) {
+        uint64_t ff = fully_free;
+        while (ff) {
+          int seed = __builtin_ctzll(ff);
+          ff &= ff - 1;
+          uint64_t grown = grow_connected(adj, seed, k, fully_free);
+          if (grown &&
+              std::find(candidates.begin(), candidates.end(), grown) ==
+                  candidates.end())
+            candidates.push_back(grown);
+        }
+      }
+      if (candidates.empty()) return NANOTPU_INFEASIBLE;
+      uint64_t best = candidates[0];
+      if (prefer_used) {
+        // max(key=(contact, -min_chip)), first occurrence wins ties
+        int bc = boundary_contact(best), bm = min_bit(best);
+        for (size_t j = 1; j < candidates.size(); ++j) {
+          int c2 = boundary_contact(candidates[j]), m2 = min_bit(candidates[j]);
+          if (c2 > bc || (c2 == bc && m2 < bm)) {
+            best = candidates[j]; bc = c2; bm = m2;
+          }
+        }
+      } else {
+        // min(key=(contact, min_chip)), first occurrence wins ties
+        int bc = boundary_contact(best), bm = min_bit(best);
+        for (size_t j = 1; j < candidates.size(); ++j) {
+          int c2 = boundary_contact(candidates[j]), m2 = min_bit(candidates[j]);
+          if (c2 < bc || (c2 == bc && m2 < bm)) {
+            best = candidates[j]; bc = c2; bm = m2;
+          }
+        }
+      }
+      uint64_t rest = best;
+      while (rest) {
+        int c = __builtin_ctzll(rest);
+        rest &= rest - 1;
+        free_[c] = 0;
+        assignments[i].push_back(c);  // ctzll scan is ascending == sorted
+      }
+    } else {
+      int pick = -1;
+      double pick_uf = 0.0, pick_load = 0.0;
+      for (int c = 0; c < t.n; ++c) {
+        if (free_[c] < percent) continue;
+        double uf = total_percent[c]
+                        ? 1.0 - static_cast<double>(free_[c]) / total_percent[c]
+                        : 0.0;
+        if (pick < 0) {
+          pick = c; pick_uf = uf; pick_load = load[c];
+          continue;
+        }
+        if (prefer_used) {
+          // max(key=(used_frac, -load, -c)): scan ascending, replace on
+          // strictly-greater key (lower c wins ties automatically)
+          if (uf > pick_uf ||
+              (uf == pick_uf && load[c] < pick_load)) {
+            pick = c; pick_uf = uf; pick_load = load[c];
+          }
+        } else {
+          // min(key=(used_frac, load, c))
+          if (uf < pick_uf ||
+              (uf == pick_uf && load[c] < pick_load)) {
+            pick = c; pick_uf = uf; pick_load = load[c];
+          }
+        }
+      }
+      if (pick < 0) return NANOTPU_INFEASIBLE;
+      free_[pick] -= percent;
+      assignments[i].push_back(pick);
+    }
+  }
+
+  int32_t* cursor = out_assign;
+  for (int i = 0; i < n_demands; ++i) {
+    out_counts[i] = static_cast<int32_t>(assignments[i].size());
+    for (int c : assignments[i]) *cursor++ = c;
+  }
+  return NANOTPU_OK;
+}
+
+}  // extern "C"

@@ -97,6 +97,23 @@ class TestDiscovery:
         topo = discover({"TPU_ACCELERATOR_TYPE": "v5litepod-8"})
         assert topo.generation == "v5e"
         assert topo.n_chips == 8
+        assert topo.topology == "2x4x1"
+
+    def test_subhost_v5e_types(self):
+        # v5litepod-4 is a real 4-chip single-host machine type: advertising
+        # 8 chips would emit phantom /dev/accel4..7 and overcommit the node
+        topo = discover({"TPU_ACCELERATOR_TYPE": "v5litepod-4"})
+        assert topo.n_chips == 4
+        assert topo.topology == "2x2x1"
+        tiny = discover({"TPU_ACCELERATOR_TYPE": "v5litepod-1"})
+        assert tiny.n_chips == 1
+        assert tiny.topology == "1x1x1"
+
+    def test_v5p_suffix_counts_tensorcores(self):
+        # v5p-8 == 4 chips == exactly one host
+        topo = discover({"TPU_ACCELERATOR_TYPE": "v5p-8"})
+        assert topo.n_chips == 4
+        assert topo.topology == "2x2x1"
 
     def test_default_when_nothing_detected(self):
         topo = discover({})
@@ -128,6 +145,19 @@ class TestPodBacklog:
         backlog.offer(make_assumed_pod("p1", "n1", {"a": [0]}, {"a": 50}))
         assert backlog.take(100) is None
         assert backlog.take(50).pod_key == "default/p1"
+
+    def test_no_reoffer_after_entry_ttl(self):
+        # A long-running pod's watch heartbeats keep re-offering it; the
+        # dedupe memory must outlive the entry TTL or a phantom entry would
+        # FIFO-steal a later pod's Allocate (chips double-booked).
+        import time as _time
+
+        backlog = PodBacklog(ttl_s=0.01)
+        pod = make_assumed_pod("p1", "n1", {"a": [0, 1]}, {"a": 200})
+        assert backlog.offer(pod) == 1
+        assert backlog.take(200).chips == (0, 1)
+        _time.sleep(0.03)  # past the entry TTL
+        assert backlog.offer(pod) == 0  # still deduped
 
     def test_ignores_unassumed_and_no_tpu(self):
         backlog = PodBacklog()
