@@ -1,0 +1,20 @@
+# Two-stage image, mirroring the reference's golang->debian Dockerfile
+# (Dockerfile:1-18): stage 1 compiles the native allocator hot path, stage 2
+# is the slim runtime. One image serves both the scheduler extender and the
+# node agent (select the entry point via `command:` in the manifest).
+FROM debian:bookworm-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY native/ native/
+COPY nanotpu/native/__init__.py nanotpu/native/__init__.py
+RUN make -C native
+
+FROM python:3.11-slim
+RUN pip install --no-cache-dir pyyaml grpcio
+WORKDIR /app
+COPY nanotpu/ nanotpu/
+COPY --from=build /src/nanotpu/native/libnanotpu_alloc.so nanotpu/native/
+ENV PORT=39999
+EXPOSE 39999
+ENTRYPOINT ["python", "-m", "nanotpu.cmd.main"]

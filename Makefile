@@ -1,0 +1,24 @@
+# Rebuild of the reference's Makefile (docker image only, Makefile:7-11) —
+# plus the test/bench targets it lacked (SURVEY.md §4: no test targets).
+IMAGE ?= nanotpu/scheduler
+TAG ?= latest
+
+.PHONY: all native test bench image clean
+
+all: native test
+
+native:
+	$(MAKE) -C native
+
+test: native
+	python -m pytest tests/ -x -q
+
+bench: native
+	python bench.py
+
+image:
+	docker build -t $(IMAGE):$(TAG) .
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

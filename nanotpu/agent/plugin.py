@@ -98,6 +98,12 @@ class PodBacklog:
             for c in pod.containers:
                 key = f"{pod.uid or pod.key()}/{c.name}"
                 if key in self._seen:
+                    # LRU refresh: a live pod re-offered by watch heartbeats
+                    # must not age out FIFO-style, or its evicted key would
+                    # let a phantom backlog entry reappear and double-book
+                    # its chips against a later pod's Allocate.
+                    del self._seen[key]
+                    self._seen[key] = None
                     continue
                 ann = pod.annotations.get(
                     types.ANNOTATION_CONTAINER_FMT.format(name=c.name), ""

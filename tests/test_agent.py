@@ -159,6 +159,22 @@ class TestPodBacklog:
         _time.sleep(0.03)  # past the entry TTL
         assert backlog.offer(pod) == 0  # still deduped
 
+    def test_seen_eviction_is_lru_not_fifo(self):
+        # Churning batch pods must not evict a live, heartbeat-refreshed
+        # pod's dedupe key — eviction ages out idle keys only. If eviction
+        # were FIFO by first insertion, the live pod would be re-admitted
+        # as a phantom entry after SEEN_MAX churned keys.
+        backlog = PodBacklog()
+        live = make_assumed_pod("live", "n1", {"a": [0, 1]}, {"a": 200})
+        assert backlog.offer(live) == 1
+        backlog.take(200)  # agent consumed it; only the dedupe key remains
+        refresh_every = PodBacklog.SEEN_MAX // 4
+        for i in range(PodBacklog.SEEN_MAX + 64):
+            backlog.offer(make_assumed_pod(f"churn-{i}", "n1", {"a": [2]}, {"a": 50}))
+            if i % refresh_every == 0:
+                assert backlog.offer(live) == 0  # heartbeat refresh
+        assert backlog.offer(live) == 0  # never re-admitted
+
     def test_ignores_unassumed_and_no_tpu(self):
         backlog = PodBacklog()
         pod = make_pod(
