@@ -11,7 +11,7 @@ COPY nanotpu/native/__init__.py nanotpu/native/__init__.py
 RUN make -C native
 
 FROM python:3.11-slim
-RUN pip install --no-cache-dir pyyaml grpcio
+RUN pip install --no-cache-dir pyyaml grpcio protobuf
 WORKDIR /app
 COPY nanotpu/ nanotpu/
 COPY --from=build /src/nanotpu/native/libnanotpu_alloc.so nanotpu/native/

@@ -1,0 +1,108 @@
+"""Shared extender-protocol test scaffolding.
+
+One copy of the node factories and the kube-scheduler-side HTTP driver, used
+by test_http_extender, test_gang, and test_baseline_configs — so a protocol
+change (e.g. bind payload keys) is fixed in exactly one place.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.objects import make_node
+from nanotpu.routes.server import SchedulerAPI, serve
+
+
+def v5p_node(name, slice_name="slice-0", coords="0,0,0", chips=4):
+    """A v5p host: 4 chips on a 2x2x1 host-local torus, slice-annotated."""
+    return make_node(
+        name,
+        {types.RESOURCE_TPU_PERCENT: chips * types.PERCENT_PER_CHIP},
+        labels={
+            types.LABEL_TPU_GENERATION: "v5p",
+            types.LABEL_TPU_TOPOLOGY: "2x2x1",
+            types.LABEL_TPU_SLICE: slice_name,
+            types.LABEL_TPU_SLICE_COORDS: coords,
+        },
+    )
+
+
+def v4_node(name, chips=4):
+    return make_node(
+        name,
+        {types.RESOURCE_TPU_PERCENT: chips * types.PERCENT_PER_CHIP},
+        labels={
+            types.LABEL_TPU_GENERATION: "v4",
+            types.LABEL_TPU_TOPOLOGY: "2x2x1",
+        },
+    )
+
+
+def post(base: str, path: str, payload) -> tuple[int, dict | list]:
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode() if payload is not None else b"",
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def get(base: str, path: str) -> tuple[int, str]:
+    with urllib.request.urlopen(base + path) as resp:
+        return resp.status, resp.read().decode()
+
+
+class Extender:
+    """A live extender server plus the kube-scheduler-side driver loop."""
+
+    def __init__(self, client, policy=types.POLICY_BINPACK, registry=None):
+        self.client = client
+        self.dealer = Dealer(client, make_rater(policy))
+        self.api = SchedulerAPI(self.dealer, registry)
+        self.server = serve(self.api, 0, host="127.0.0.1")
+        self.base = f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def close(self):
+        self.server.shutdown()
+
+    def post(self, path, payload):
+        code, body = post(self.base, path, payload)
+        assert code == 200, (code, body)
+        return body
+
+    def schedule(self, pod, node_names):
+        """filter -> priorities -> bind, exactly as kube-scheduler would.
+
+        Returns (chosen node, priorities response).
+        """
+        args = {"Pod": pod.raw, "NodeNames": node_names}
+        filt = self.post("/scheduler/filter", args)
+        assert not filt.get("Error"), filt
+        feasible = filt["NodeNames"]
+        assert feasible, f"no feasible node for {pod.name}: {filt}"
+        prio = self.post("/scheduler/priorities", args)
+        best = max(
+            (p for p in prio if p["Host"] in set(feasible)),
+            key=lambda p: p["Score"],
+        )["Host"]
+        bind = self.post(
+            "/scheduler/bind",
+            {
+                "PodName": pod.name,
+                "PodNamespace": pod.namespace,
+                "PodUID": pod.uid,
+                "Node": best,
+            },
+        )
+        assert bind["Error"] == "", bind
+        return best, prio

@@ -7,20 +7,9 @@ from nanotpu.allocator.rater import make_rater
 from nanotpu.dealer import Dealer
 from nanotpu.dealer.gang import GANG_BONUS, GangTracker, gang_affinity_bonus
 from nanotpu.k8s.client import FakeClientset
-from nanotpu.k8s.objects import make_container, make_node, make_pod
+from nanotpu.k8s.objects import make_container, make_pod
 
-
-def slice_node(name, slice_name, coords, chips=4):
-    return make_node(
-        name,
-        {types.RESOURCE_TPU_PERCENT: chips * 100},
-        labels={
-            types.LABEL_TPU_GENERATION: "v5p",
-            types.LABEL_TPU_TOPOLOGY: "2x2x1",
-            types.LABEL_TPU_SLICE: slice_name,
-            types.LABEL_TPU_SLICE_COORDS: coords,
-        },
-    )
+from harness import v5p_node as slice_node
 
 
 def gang_pod(name, gang, size, percent=100):

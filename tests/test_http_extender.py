@@ -3,48 +3,24 @@ socket, filter -> priorities -> bind, exactly as kube-scheduler drives it.
 This is the integration layer the reference entirely lacked (SURVEY §4).
 """
 
-import json
+import urllib.error
 import urllib.request
 
 import pytest
 
 from nanotpu import types
-from nanotpu.allocator.rater import make_rater
 from nanotpu.cmd.main import make_mock_cluster
-from nanotpu.dealer import Dealer
 from nanotpu.k8s.objects import make_container, make_pod
-from nanotpu.routes.server import SchedulerAPI, serve
 from nanotpu.utils import pod as podutil
+
+from harness import Extender, get, post
 
 
 @pytest.fixture
 def app():
-    client = make_mock_cluster(2)
-    dealer = Dealer(client, make_rater("binpack"))
-    api = SchedulerAPI(dealer)
-    server = serve(api, 0, host="127.0.0.1")  # ephemeral port
-    port = server.server_address[1]
-    yield client, dealer, api, f"http://127.0.0.1:{port}"
-    server.shutdown()
-
-
-def post(base, path, payload) -> tuple[int, dict | list]:
-    req = urllib.request.Request(
-        base + path,
-        data=json.dumps(payload).encode() if payload is not None else b"",
-        method="POST",
-        headers={"Content-Type": "application/json"},
-    )
-    try:
-        with urllib.request.urlopen(req) as resp:
-            return resp.status, json.loads(resp.read())
-    except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read())
-
-
-def get(base, path) -> tuple[int, str]:
-    with urllib.request.urlopen(base + path) as resp:
-        return resp.status, resp.read().decode()
+    e = Extender(make_mock_cluster(2))
+    yield e.client, e.dealer, e.api, e.base
+    e.close()
 
 
 def tpu_pod_raw(name, percent=100):
