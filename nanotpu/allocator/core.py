@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass, field
+from functools import lru_cache
 
 from nanotpu import types
 from nanotpu.topology import Torus
@@ -103,11 +104,20 @@ class Demand:
         )
 
     def hash(self) -> str:
-        """Plan-cache key: first 8 hex chars of sha256 (allocate.go:72-75)."""
-        payload = ",".join(
-            f"{n}={p}" for n, p in zip(self.container_names, self.percents)
-        ) or ",".join(str(p) for p in self.percents)
-        return hashlib.sha256(payload.encode()).hexdigest()[:8]
+        """Plan-cache key: first 8 hex chars of sha256 (allocate.go:72-75).
+
+        Memoized — Assume recomputes it once per candidate node, and the
+        Demand is frozen, so the digest is computed at most once per
+        distinct demand shape."""
+        return _demand_hash(self.container_names, self.percents)
+
+
+@lru_cache(maxsize=65536)
+def _demand_hash(container_names: tuple[str, ...], percents: tuple[int, ...]) -> str:
+    payload = ",".join(
+        f"{n}={p}" for n, p in zip(container_names, percents)
+    ) or ",".join(str(p) for p in percents)
+    return hashlib.sha256(payload.encode()).hexdigest()[:8]
 
 
 @dataclass

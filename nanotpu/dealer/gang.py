@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
+from functools import lru_cache
 
 from nanotpu.topology import Coord, parse_slice_coords
 
@@ -117,8 +118,19 @@ def gang_affinity_bonus(
 
 
 def _grid_compactness(coords: list[Coord]) -> float:
-    """ICI-compactness of host coords on a plain grid in [0, 1]: fraction of
-    the best-achievable nearest-neighbor adjacencies for that many hosts."""
+    """ICI-compactness of the OCCUPIED host cells on a plain grid, in [0, 1]:
+    fraction of the best-achievable nearest-neighbor adjacencies for that
+    many distinct hosts.
+
+    Duplicates are deduped deliberately: a candidate host that already runs a
+    bound gang member (possible for fractional-chip gangs) is zero ICI hops
+    away, so colocating must score maximal — never below an adjacent host.
+    """
+    return _grid_compactness_cached(tuple(sorted(set(coords))))
+
+
+@lru_cache(maxsize=65536)
+def _grid_compactness_cached(coords: tuple[Coord, ...]) -> float:
     from nanotpu.topology import _max_links_for_volume
 
     k = len(coords)

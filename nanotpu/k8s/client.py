@@ -14,14 +14,13 @@ surface the scheduler actually needs and provide:
 
 from __future__ import annotations
 
-import copy
 import itertools
 import queue
 import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Protocol
 
-from nanotpu.k8s.objects import Node, Pod
+from nanotpu.k8s.objects import Node, Pod, plain_copy
 
 
 class ApiError(Exception):
@@ -141,9 +140,9 @@ class FakeClientset:
             key = pod.key()
             if key in self._pods:
                 raise ApiError(f"pod {key} already exists", code=409)
-            raw = self._bump(copy.deepcopy(pod.raw))
+            raw = self._bump(plain_copy(pod.raw))
             self._pods[key] = raw
-            out = Pod(copy.deepcopy(raw))
+            out = Pod(plain_copy(raw))
             self._notify(self._pod_watches, WatchEvent("ADDED", out))
             return out
 
@@ -152,12 +151,12 @@ class FakeClientset:
             key = f"{namespace}/{name}"
             if key not in self._pods:
                 raise NotFoundError(f"pod {key} not found")
-            return Pod(copy.deepcopy(self._pods[key]))
+            return Pod(plain_copy(self._pods[key]))
 
     def list_pods(self, label_selector: dict[str, str] | None = None) -> list[Pod]:
         with self._lock:
             return [
-                Pod(copy.deepcopy(raw))
+                Pod(plain_copy(raw))
                 for raw in self._pods.values()
                 if _matches((raw.get("metadata") or {}).get("labels") or {}, label_selector)
             ]
@@ -176,9 +175,9 @@ class FakeClientset:
                     f"Operation cannot be fulfilled on pods {key!r}: please "
                     f"apply your changes to the latest version and try again"
                 )
-            raw = self._bump(copy.deepcopy(pod.raw))
+            raw = self._bump(plain_copy(pod.raw))
             self._pods[key] = raw
-            out = Pod(copy.deepcopy(raw))
+            out = Pod(plain_copy(raw))
             self._notify(self._pod_watches, WatchEvent("MODIFIED", out))
             return out
 
@@ -203,15 +202,15 @@ class FakeClientset:
             self._bump(raw)
             self.bindings.append((namespace, name, node_name))
             self._notify(
-                self._pod_watches, WatchEvent("MODIFIED", Pod(copy.deepcopy(raw)))
+                self._pod_watches, WatchEvent("MODIFIED", Pod(plain_copy(raw)))
             )
 
     # -- nodes -------------------------------------------------------------
     def create_node(self, node: Node) -> Node:
         with self._lock:
-            raw = self._bump(copy.deepcopy(node.raw))
+            raw = self._bump(plain_copy(node.raw))
             self._nodes[node.name] = raw
-            out = Node(copy.deepcopy(raw))
+            out = Node(plain_copy(raw))
             self._notify(self._node_watches, WatchEvent("ADDED", out))
             return out
 
@@ -219,11 +218,11 @@ class FakeClientset:
         with self._lock:
             if name not in self._nodes:
                 raise NotFoundError(f"node {name} not found")
-            return Node(copy.deepcopy(self._nodes[name]))
+            return Node(plain_copy(self._nodes[name]))
 
     def list_nodes(self) -> list[Node]:
         with self._lock:
-            return [Node(copy.deepcopy(raw)) for raw in self._nodes.values()]
+            return [Node(plain_copy(raw)) for raw in self._nodes.values()]
 
     def update_node(self, node: Node) -> Node:
         with self._lock:
@@ -236,9 +235,9 @@ class FakeClientset:
                     f"Operation cannot be fulfilled on nodes {node.name!r}: "
                     f"please apply your changes to the latest version and try again"
                 )
-            raw = self._bump(copy.deepcopy(node.raw))
+            raw = self._bump(plain_copy(node.raw))
             self._nodes[node.name] = raw
-            out = Node(copy.deepcopy(raw))
+            out = Node(plain_copy(raw))
             self._notify(self._node_watches, WatchEvent("MODIFIED", out))
             return out
 

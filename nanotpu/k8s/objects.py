@@ -9,8 +9,20 @@ field byte-for-byte so optimistic-concurrency updates round-trip cleanly.
 
 from __future__ import annotations
 
-import copy
 from typing import Any, Iterator
+
+
+def plain_copy(x):
+    """Deep copy of a JSON tree (dict/list/scalars) — ~4.5x faster than
+    copy.deepcopy, which dominated the Bind profile. K8s object raws are
+    always plain JSON (built by make_pod/make_node or json.loads in the REST
+    client); any other type is returned by reference."""
+    t = type(x)
+    if t is dict:
+        return {k: plain_copy(v) for k, v in x.items()}
+    if t is list:
+        return [plain_copy(v) for v in x]
+    return x
 
 #: Kubernetes quantity suffixes that yield integral values. Extended
 #: resources must be whole integers, so milli ("100m") and other fractional
@@ -102,7 +114,7 @@ class K8sObject:
         return self.ensure_metadata().setdefault("annotations", {})
 
     def deepcopy(self):
-        return type(self)(copy.deepcopy(self.raw))
+        return type(self)(plain_copy(self.raw))
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return f"{type(self).__name__}({self.namespace}/{self.name})"

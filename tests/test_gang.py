@@ -58,6 +58,19 @@ class TestAffinityScoring:
         assert near > far
         assert near <= GANG_BONUS
 
+    def test_colocated_host_scores_maximal(self):
+        # A candidate that IS a member's host (fractional gang sharing a
+        # host) is zero ICI hops away: it must get the full bonus, never
+        # less than an adjacent host.
+        members = [("slice-0", "0,0,0")]
+        colocated = gang_affinity_bonus("slice-0", "0,0,0", members)
+        adjacent = gang_affinity_bonus("slice-0", "1,0,0", members)
+        assert colocated == GANG_BONUS
+        assert colocated >= adjacent
+        # and with several members, a duplicate never *lowers* compactness
+        members2 = [("slice-0", "0,0,0"), ("slice-0", "1,0,0")]
+        assert gang_affinity_bonus("slice-0", "0,0,0", members2) == GANG_BONUS
+
     def test_tracker_lifecycle(self):
         t = GangTracker()
         t.record_bound("g", 4, "u1", "n1")
