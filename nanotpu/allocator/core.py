@@ -109,7 +109,9 @@ class Demand:
         Memoized — Assume recomputes it once per candidate node, and the
         Demand is frozen, so the digest is computed at most once per
         distinct demand shape."""
-        return _demand_hash(self.container_names, self.percents)
+        # tuple() coercion: callers may construct Demand with list fields
+        # (the frozen dataclass doesn't coerce), which lru_cache can't key
+        return _demand_hash(tuple(self.container_names), tuple(self.percents))
 
 
 @lru_cache(maxsize=65536)

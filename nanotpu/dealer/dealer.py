@@ -245,12 +245,20 @@ class Dealer:
                 return name, "insufficient TPU capacity for demand"
             return name, None
 
-        # Fan out only on large candidate sets: with warm plan caches a
-        # per-node check is ~3us, so executor dispatch (~35us/task) dominates
-        # below this threshold — measured 4x faster serial at 16 nodes. (The
+        # Fan out on large candidate sets OR when several candidates are
+        # UNKNOWN: a known node's check is ~3us (plan-cache warm), where
+        # executor dispatch (~35us/task) dominates — measured 4x faster
+        # serial at 16 warm nodes. But an unknown node costs a blocking
+        # apiserver GET inside _node_info, and those must overlap. (The
         # reference hardcoded a 4-goroutine pool for ANY fan-out,
         # dealer.go:113-134.)
-        if len(node_names) <= ASSUME_POOL_THRESHOLD:
+        with self._lock:
+            cold = sum(
+                1
+                for n in node_names
+                if n not in self._nodes and n not in self._non_tpu
+            )
+        if len(node_names) <= ASSUME_POOL_THRESHOLD and cold <= 2:
             results = [try_node(n) for n in node_names]
         else:
             results = list(self._pool.map(try_node, node_names))
@@ -308,6 +316,10 @@ class Dealer:
         try:
             annotated = self._write_annotations(pod, plan)
             self.client.bind_pod(annotated.namespace, annotated.name, node_name)
+            # mirror what the binding subresource did server-side, so the
+            # tracked copy is releasable on its own (release derives the
+            # node from spec.nodeName)
+            annotated.raw.setdefault("spec", {})["nodeName"] = node_name
         except ApiError as e:
             info.unbind(plan)
             with self._lock:
