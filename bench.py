@@ -12,10 +12,11 @@ Prints ONE JSON line.
 
 from __future__ import annotations
 
+import http.client
 import json
+import socket
 import statistics
 import time
-import urllib.request
 
 from nanotpu import types
 from nanotpu.allocator.rater import make_rater
@@ -32,15 +33,17 @@ POD_PERCENT = 200  # 2 whole chips per pod -> 64 chips total
 OCCUPANCY_TARGET = 95.0
 
 
-def post(base: str, path: str, payload) -> dict | list:
-    req = urllib.request.Request(
-        base + path,
-        data=json.dumps(payload).encode(),
-        method="POST",
+def post(conn: http.client.HTTPConnection, path: str, payload) -> dict | list:
+    # persistent HTTP/1.1 connection — kube-scheduler's Go client reuses
+    # connections, so the benchmark should too
+    conn.request(
+        "POST",
+        path,
+        body=json.dumps(payload).encode(),
         headers={"Content-Type": "application/json"},
     )
-    with urllib.request.urlopen(req) as resp:
-        return json.loads(resp.read())
+    resp = conn.getresponse()
+    return json.loads(resp.read())
 
 
 def run_once() -> tuple[list[float], float, int, float]:
@@ -49,7 +52,9 @@ def run_once() -> tuple[list[float], float, int, float]:
     dealer = Dealer(client, make_rater("binpack"))
     api = SchedulerAPI(dealer, Registry())
     server = serve(api, 0, host="127.0.0.1")
-    base = f"http://127.0.0.1:{server.server_address[1]}"
+    conn = http.client.HTTPConnection("127.0.0.1", server.server_address[1])
+    conn.connect()
+    conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     node_names = [f"v5p-host-{i}" for i in range(N_HOSTS)]
 
     cycle_latencies: list[float] = []
@@ -73,8 +78,8 @@ def run_once() -> tuple[list[float], float, int, float]:
         )
         args = {"Pod": pod.raw, "NodeNames": node_names}
         t0 = time.perf_counter()
-        filt = post(base, "/scheduler/filter", args)
-        prio = post(base, "/scheduler/priorities", args)
+        filt = post(conn, "/scheduler/filter", args)
+        prio = post(conn, "/scheduler/priorities", args)
         feasible = set(filt["NodeNames"])
         ranked = sorted(
             (p for p in prio if p["Host"] in feasible),
@@ -83,7 +88,7 @@ def run_once() -> tuple[list[float], float, int, float]:
         result = {"Error": "no feasible node"}
         for choice in ranked:
             result = post(
-                base,
+                conn,
                 "/scheduler/bind",
                 {
                     "PodName": name,

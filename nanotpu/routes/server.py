@@ -161,6 +161,14 @@ class SchedulerAPI:
 
 class _Handler(BaseHTTPRequestHandler):
     api: SchedulerAPI  # injected by serve()
+    # HTTP/1.1 keep-alive: kube-scheduler's Go client reuses connections;
+    # 1.0 would force a TCP handshake onto every Filter/Prioritize/Bind.
+    # Safe because _respond always sends Content-Length.
+    protocol_version = "HTTP/1.1"
+    # Without TCP_NODELAY, Nagle + delayed ACK stalls every keep-alive
+    # request ~40-130ms (headers and body leave as separate writes). Go's
+    # net/http disables Nagle too.
+    disable_nagle_algorithm = True
 
     def _respond(self):
         length = int(self.headers.get("Content-Length") or 0)
