@@ -64,20 +64,15 @@ def ring_attention(
         k_cur, v_cur, m_run, l_run, acc_run = carry
         # the block on my device at step s originated at rank (rank - s) mod n
         src = (rank - step_idx) % n
-        m_blk, l_blk, acc_blk = _block_attend(q, k_cur, v_cur, scale, None)
         if causal:
-            m_blk_c, l_blk_c, acc_blk_c = _block_attend(
-                q, k_cur, v_cur, scale, causal_mask
-            )
-            is_self = src == rank
-            is_future = src > rank
-            m_blk = jnp.where(is_self, m_blk_c, m_blk)
-            l_blk = jnp.where(is_self, l_blk_c, l_blk)
-            acc_blk = jnp.where(is_self, acc_blk_c, acc_blk)
-            # fully masked future blocks contribute nothing
-            m_blk = jnp.where(is_future, NEG_INF, m_blk)
-            l_blk = jnp.where(is_future, 0.0, l_blk)
-            acc_blk = jnp.where(is_future, 0.0, acc_blk)
+            # one attend with a mask built from traced scalars: past blocks
+            # all-visible, the self block lower-triangular, future blocks
+            # fully masked (the step still runs — SPMD needs uniform control
+            # flow). This halves the FLOPs vs attending twice and selecting.
+            mask = (src < rank) | ((src == rank) & causal_mask)
+            m_blk, l_blk, acc_blk = _block_attend(q, k_cur, v_cur, scale, mask)
+        else:
+            m_blk, l_blk, acc_blk = _block_attend(q, k_cur, v_cur, scale, None)
         # LSE merge
         m_new = jnp.maximum(m_run, m_blk)
         m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
@@ -95,9 +90,14 @@ def ring_attention(
 
     # mark the accumulator inits as device-varying over the ring axis so the
     # scan carry types match (outputs depend on rank via the causal masks)
-    m0 = jax.lax.pvary(jnp.full((B, H, Sblk, 1), NEG_INF, jnp.float32), axis_name)
-    l0 = jax.lax.pvary(jnp.zeros((B, H, Sblk, 1), jnp.float32), axis_name)
-    acc0 = jax.lax.pvary(jnp.zeros((B, Sblk, H, D), jnp.float32), axis_name)
+    def _vary(x):
+        if hasattr(jax.lax, "pcast"):  # pvary deprecated in favor of pcast
+            return jax.lax.pcast(x, axis_name, to="varying")
+        return jax.lax.pvary(x, axis_name)
+
+    m0 = _vary(jnp.full((B, H, Sblk, 1), NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((B, H, Sblk, 1), jnp.float32))
+    acc0 = _vary(jnp.zeros((B, Sblk, H, D), jnp.float32))
     (k_f, v_f, m, l, acc), _ = jax.lax.scan(
         step, (k, v, m0, l0, acc0), jnp.arange(n)
     )
