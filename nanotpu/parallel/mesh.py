@@ -46,6 +46,54 @@ def make_mesh(
     return Mesh(arr, axis_names=("dp", "fsdp", "tp", "sp", "ep"))
 
 
+def make_hybrid_mesh(
+    dcn_dp: int = 0, dp: int = 1, fsdp: int = 1, tp: int = 1, sp: int = 1,
+    ep: int = 1, devices: list | None = None,
+) -> Mesh:
+    """Multi-slice mesh: ``dcn_dp`` spans slices over DCN, the remaining
+    axes stay inside a slice so their collectives ride ICI.
+
+    The bandwidth hierarchy dictates the layout: pure data parallelism is
+    the only axis whose collective (one gradient all-reduce per step) is
+    light enough for DCN, so it is the outermost axis and the only one
+    allowed to cross slice boundaries. fsdp/tp/sp/ep all-gather or all-to-
+    all activations/params every layer and must stay on ICI.
+
+    ``dcn_dp=0`` auto-detects: one slice -> plain :func:`make_mesh`; N
+    slices -> dcn_dp=N. Slice membership comes from ``device.slice_index``
+    (multi-slice TPU runtimes expose it; hosts without it are one slice).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    slice_ids = sorted(
+        {getattr(d, "slice_index", 0) for d in devices}
+    )
+    n_slices = len(slice_ids)
+    if dcn_dp == 0:
+        dcn_dp = n_slices
+    if dcn_dp == 1:
+        return make_mesh(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep, devices=devices)
+    if dcn_dp != n_slices:
+        raise ValueError(
+            f"dcn_dp={dcn_dp} but devices span {n_slices} slice(s)"
+        )
+    per_slice = dp * fsdp * tp * sp * ep
+    by_slice = {s: [] for s in slice_ids}
+    for d in devices:
+        by_slice[getattr(d, "slice_index", 0)].append(d)
+    for s, ds in by_slice.items():
+        if len(ds) != per_slice:
+            raise ValueError(
+                f"slice {s} has {len(ds)} devices, mesh needs {per_slice} per slice"
+            )
+    # [dcn_dp, per_slice] with each row one slice: the dp axis (outermost)
+    # is the only one that crosses slice rows -> its all-reduce rides DCN,
+    # every inner axis stays within a row -> ICI
+    arr = np.array(
+        [by_slice[s] for s in slice_ids]
+    ).reshape(dcn_dp * dp, fsdp, tp, sp, ep)
+    return Mesh(arr, axis_names=("dp", "fsdp", "tp", "sp", "ep"))
+
+
 #: Batch is sharded over every data-ish axis; sequence over sp.
 BATCH_SPEC = P(("dp", "fsdp"), "sp")
 

@@ -1,0 +1,49 @@
+"""Hybrid (multi-slice / DCN) mesh construction.
+
+CPU devices carry no slice_index, so they form one slice: the helper must
+fall back to the plain ICI mesh, and must reject dcn_dp values that
+contradict the detected slice count. (The multi-slice row layout itself is
+pure reshape arithmetic over the same device list — exercised here through
+the dcn_dp=1 path and validated on real multi-slice hardware.)
+"""
+
+import jax
+import pytest
+
+from nanotpu.parallel.mesh import make_hybrid_mesh, make_mesh
+
+
+def test_single_slice_auto_falls_back_to_plain_mesh():
+    m = make_hybrid_mesh(dp=1, fsdp=2, tp=4, devices=jax.devices()[:8])
+    assert dict(m.shape) == {"dp": 1, "fsdp": 2, "tp": 4, "sp": 1, "ep": 1}
+    plain = make_mesh(dp=1, fsdp=2, tp=4, devices=jax.devices()[:8])
+    assert (m.devices == plain.devices).all()
+
+
+def test_explicit_dcn_dp_1_is_plain():
+    m = make_hybrid_mesh(dcn_dp=1, dp=2, ep=4)
+    assert dict(m.shape)["dp"] == 2 and dict(m.shape)["ep"] == 4
+
+
+def test_dcn_dp_mismatch_rejected():
+    with pytest.raises(ValueError, match="span 1 slice"):
+        make_hybrid_mesh(dcn_dp=2, dp=1, fsdp=2, tp=2, devices=jax.devices()[:8])
+
+
+def test_train_step_runs_on_hybrid_fallback():
+    # the mesh from make_hybrid_mesh is a drop-in for build_train_step
+    from nanotpu.models.llama import LlamaConfig
+    from nanotpu.parallel import train as train_lib
+
+    cfg = LlamaConfig(
+        vocab_size=128, dim=64, n_layers=1, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=64, dtype="float32",
+    )
+    mesh = make_hybrid_mesh(dp=2, fsdp=2, tp=2)
+    opt = train_lib.make_optimizer()
+    state = train_lib.init_train_state(jax.random.PRNGKey(0), cfg, opt)
+    state = train_lib.place_state(state, cfg, mesh)
+    step = train_lib.build_train_step(cfg, mesh, opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 128)
+    state, loss = step(state, tokens)
+    assert jax.numpy.isfinite(loss)
