@@ -43,10 +43,11 @@ log = logging.getLogger("nanotpu.dealer")
 #: error message, dealer.go:178-186).
 BIND_CONFLICT_RETRIES = 3
 
-#: Candidate-node fan-out above which Assume uses the thread pool; below it,
-#: serial evaluation wins (executor dispatch costs more than the per-node
-#: feasibility check itself once plan caches are warm).
-ASSUME_POOL_THRESHOLD = 64
+#: Number of UNKNOWN candidate nodes above which Assume uses the thread
+#: pool. Warm-node checks are ~2-3us and GIL-bound, so the pool loses on
+#: them at ANY fan-out (measured 6x slower at 256 warm nodes); cold nodes
+#: cost a blocking apiserver GET each, and those must overlap.
+ASSUME_COLD_POOL_THRESHOLD = 2
 
 #: Max released-pod tombstones kept for idempotency (K8s UIDs never recur,
 #: so eviction only risks re-releasing ancient, long-deleted pods).
@@ -245,20 +246,18 @@ class Dealer:
                 return name, "insufficient TPU capacity for demand"
             return name, None
 
-        # Fan out on large candidate sets OR when several candidates are
-        # UNKNOWN: a known node's check is ~3us (plan-cache warm), where
-        # executor dispatch (~35us/task) dominates — measured 4x faster
-        # serial at 16 warm nodes. But an unknown node costs a blocking
-        # apiserver GET inside _node_info, and those must overlap. (The
-        # reference hardcoded a 4-goroutine pool for ANY fan-out,
-        # dealer.go:113-134.)
+        # Pool only when several candidates are UNKNOWN: their _node_info
+        # does a blocking apiserver GET each, which must overlap. Known-node
+        # checks are GIL-bound microseconds where executor dispatch only
+        # adds overhead — at any fan-out. (The reference hardcoded a
+        # 4-goroutine pool for ANY fan-out, dealer.go:113-134.)
         with self._lock:
             cold = sum(
                 1
                 for n in node_names
                 if n not in self._nodes and n not in self._non_tpu
             )
-        if len(node_names) <= ASSUME_POOL_THRESHOLD and cold <= 2:
+        if cold <= ASSUME_COLD_POOL_THRESHOLD:
             results = [try_node(n) for n in node_names]
         else:
             results = list(self._pool.map(try_node, node_names))
