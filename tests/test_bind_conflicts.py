@@ -1,0 +1,124 @@
+"""Bind-path fault injection: optimistic-lock conflicts and binding API
+failures (dealer.go:177-199 behavior — minus its bugs: the reference
+swallowed non-conflict update errors as success, dealer.go:188).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.dealer import BindError, Dealer
+from nanotpu.dealer.dealer import BIND_CONFLICT_RETRIES
+from nanotpu.k8s.client import ApiError, FakeClientset
+from nanotpu.k8s.objects import make_container, make_pod
+from nanotpu.utils import pod as podutil
+
+from harness import v5p_node
+
+
+@pytest.fixture
+def cluster():
+    client = FakeClientset()
+    client.create_node(v5p_node("n0"))
+    return client
+
+
+def tpu_pod(client, name="p0", percent=200):
+    return client.create_pod(
+        make_pod(
+            name,
+            containers=[make_container("w", {types.RESOURCE_TPU_PERCENT: percent})],
+        )
+    )
+
+
+def bump_server_side(client, key="default/p0"):
+    """Simulate a concurrent writer (e.g. a labeling webhook): bump the
+    stored pod's resourceVersion so the dealer's in-flight update conflicts."""
+    latest = client.get_pod(*key.split("/"))
+    latest.ensure_labels()["webhook/bumped"] = "true"
+    client.update_pod(latest)
+
+
+class TestOptimisticLockRetry:
+    def test_single_conflict_retried_and_bound(self, cluster):
+        dealer = Dealer(cluster, make_rater("binpack"))
+        pod = tpu_pod(cluster)
+        conflicts = {"n": 0}
+
+        def hook(_pod):
+            if conflicts["n"] == 0:
+                conflicts["n"] += 1
+                bump_server_side(cluster)  # dealer's copy is now stale
+
+        cluster.before_update_pod = hook
+        dealer.assume(["n0"], pod)
+        annotated = dealer.bind("n0", pod)
+        assert conflicts["n"] == 1
+        bound = cluster.get_pod("default", "p0")
+        assert podutil.is_assumed(bound)
+        # the retry re-GOT the latest pod: the webhook's label survived
+        assert bound.labels.get("webhook/bumped") == "true"
+        assert len(podutil.get_assigned_chips(bound)["w"]) == 2
+
+    def test_conflict_storm_exhausts_retries_and_rolls_back(self, cluster):
+        dealer = Dealer(cluster, make_rater("binpack"))
+        pod = tpu_pod(cluster)
+        calls = {"n": 0, "in_hook": False}
+
+        def hook(_pod):
+            if calls["in_hook"]:  # bump_server_side's own update re-enters
+                return
+            calls["n"] += 1
+            calls["in_hook"] = True
+            try:
+                bump_server_side(cluster)  # every attempt conflicts
+            finally:
+                calls["in_hook"] = False
+
+        cluster.before_update_pod = hook
+        dealer.assume(["n0"], pod)
+        with pytest.raises(BindError):
+            dealer.bind("n0", pod)
+        assert calls["n"] == BIND_CONFLICT_RETRIES + 1
+        # accounting rolled back: all chips free, pod untracked, no binding
+        info = dealer.status()["nodes"]["n0"]
+        assert info["available_percent"] == 400
+        assert cluster.bindings == []
+        assert not podutil.is_assumed(cluster.get_pod("default", "p0"))
+
+    def test_binding_subresource_failure_rolls_back(self, cluster):
+        dealer = Dealer(cluster, make_rater("binpack"))
+        pod = tpu_pod(cluster)
+
+        def boom(ns, name, node):
+            raise ApiError("binding webhook denied", code=500)
+
+        cluster.before_bind = boom
+        dealer.assume(["n0"], pod)
+        with pytest.raises(BindError, match="denied"):
+            dealer.bind("n0", pod)
+        info = dealer.status()["nodes"]["n0"]
+        assert info["available_percent"] == 400
+        assert cluster.bindings == []
+        # a later healthy bind of the same pod succeeds
+        cluster.before_bind = None
+        dealer.bind("n0", cluster.get_pod("default", "p0"))
+        assert ("default", "p0", "n0") in cluster.bindings
+
+    def test_update_failure_is_an_error_not_silent_success(self, cluster):
+        # the reference returned nil on non-conflict update errors
+        # (dealer.go:188) — ours must propagate
+        dealer = Dealer(cluster, make_rater("binpack"))
+        pod = tpu_pod(cluster)
+
+        def boom(_pod):
+            raise ApiError("etcdserver: request timed out", code=500)
+
+        cluster.before_update_pod = boom
+        dealer.assume(["n0"], pod)
+        with pytest.raises(BindError, match="timed out"):
+            dealer.bind("n0", pod)
+        assert dealer.status()["nodes"]["n0"]["available_percent"] == 400
