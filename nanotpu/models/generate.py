@@ -1,0 +1,168 @@
+"""Autoregressive decoding with a static-shape KV cache (TPU-native).
+
+Everything here compiles to fixed shapes: the cache is pre-allocated at
+``max_len`` per layer, prefill writes the prompt's k/v with a dynamic-slice
+update, and the decode loop is one ``lax.scan`` whose body attends a single
+query token against the cache under a position mask — no shape ever depends
+on how many tokens have been generated, so XLA compiles exactly two
+programs (prefill + step) regardless of prompt or generation length.
+
+GQA caches the KV heads unexpanded ([.., n_kv_heads, hd]) — the repeat to
+full head count happens inside the attend einsum as a broadcast, so the
+cache is ``n_heads/n_kv_heads`` times smaller in HBM (the decode-time
+bottleneck is cache bandwidth, not FLOPs).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from nanotpu.models.llama import (
+    LlamaConfig,
+    apply_rope,
+    mlp,
+    rms_norm,
+    rope_freqs,
+)
+
+NEG_INF = -1e30
+
+
+class KVCache(NamedTuple):
+    """Per-layer stacked cache: k/v [L, B, max_len, n_kv_heads, head_dim];
+    ``length`` is the number of valid positions (scalar int32)."""
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array
+
+    @staticmethod
+    def create(cfg: LlamaConfig, batch: int, max_len: int, dtype=None) -> "KVCache":
+        shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+        dt = dtype or jnp.dtype(cfg.dtype)
+        return KVCache(
+            k=jnp.zeros(shape, dt),
+            v=jnp.zeros(shape, dt),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+
+def _attend_cached(q, k_cache, v_cache, valid_len):
+    """q [B,S,H,hd] against cache [B,max_len,KV,hd]; positions >= valid_len
+    masked. For prefill S>1, q position i attends cache[: start+i+1] where
+    start = valid_len - S (causal within the new block)."""
+    B, S, H, hd = q.shape
+    KV = k_cache.shape[2]
+    max_len = k_cache.shape[1]
+    scale = 1.0 / math.sqrt(hd)
+    rep = H // KV
+    # [B,S,H,hd] x [B,T,KV,hd] -> [B,H,S,T]: group q heads onto kv heads
+    qg = q.reshape(B, S, KV, rep, hd)
+    logits = jnp.einsum("bsgrd,btgd->bgrst", qg, k_cache).astype(jnp.float32)
+    logits = logits * scale
+    pos = jnp.arange(max_len)
+    q_end = valid_len - S + jnp.arange(S) + 1  # causal frontier per q row
+    mask = pos[None, :] < q_end[:, None]  # [S, max_len]
+    logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bgrst,btgd->bsgrd", probs, v_cache)
+    return out.reshape(B, S, H, hd)
+
+
+def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start):
+    """One decoder layer over new tokens x [B,S,D], updating this layer's
+    cache slice at [start, start+S). Returns (x, k_cache, v_cache)."""
+    B, S, _ = x.shape
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    attn = layer["attn"]
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ attn["wq"]).reshape(B, S, H, hd)
+    k = (h @ attn["wk"]).reshape(B, S, KV, hd)
+    v = (h @ attn["wv"]).reshape(B, S, KV, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
+    )
+    out = _attend_cached(q, k_cache, v_cache, start + S)
+    x = x + out.reshape(B, S, H * hd) @ attn["wo"]
+    x = x + mlp(layer["mlp"], rms_norm(x, layer["mlp_norm"], cfg.norm_eps))
+    return x, k_cache, v_cache
+
+
+def _run(params, tokens, cfg, cache: KVCache):
+    """Shared prefill/step body: tokens [B,S] appended at cache.length."""
+    B, S = tokens.shape
+    start = cache.length
+    positions = start + jnp.arange(S, dtype=jnp.int32)
+    cos, sin = rope_freqs(cfg, positions)
+    x = params["embed"][tokens]
+    ks, vs = [], []
+    for i, layer in enumerate(params["layers"]):
+        x, k_l, v_l = _layer_with_cache(
+            layer, x, cfg, cos, sin, cache.k[i], cache.v[i], start
+        )
+        ks.append(k_l)
+        vs.append(v_l)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, -1] @ params["lm_head"]).astype(jnp.float32)  # [B, V]
+    new_cache = KVCache(jnp.stack(ks), jnp.stack(vs), start + S)
+    return logits, new_cache
+
+
+def prefill(params, prompt: jax.Array, cfg: LlamaConfig, max_len: int):
+    """prompt [B,S] -> (last-token logits [B,V], primed cache)."""
+    cache = KVCache.create(cfg, prompt.shape[0], max_len)
+    return _run(params, prompt, cfg, cache)
+
+
+def decode_step(params, token: jax.Array, cfg: LlamaConfig, cache: KVCache):
+    """token [B] -> (logits [B,V], cache advanced by one)."""
+    return _run(params, token[:, None], cfg, cache)
+
+
+def generate(
+    params, prompt: jax.Array, cfg: LlamaConfig, max_new_tokens: int,
+    temperature: float = 0.0, rng: jax.Array | None = None,
+    max_len: int | None = None,
+) -> jax.Array:
+    """Greedy (temperature=0) or sampled generation.
+
+    prompt [B, S] -> generated tokens [B, max_new_tokens]. Jit-friendly:
+    call under ``jax.jit`` with static cfg/max_new_tokens.
+    """
+    B, S = prompt.shape
+    max_len = max_len or min(cfg.max_seq_len, S + max_new_tokens)
+    if S + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt {S} + new {max_new_tokens} exceeds max_len {max_len}"
+        )
+    logits, cache = prefill(params, prompt, cfg, max_len)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(
+            jnp.int32
+        )
+
+    first = sample(logits, rng)
+
+    def step(carry, key):
+        token, cache = carry
+        logits, cache = decode_step(params, token, cfg, cache)
+        nxt = sample(logits, key)
+        return (nxt, cache), token
+
+    keys = jax.random.split(rng, max_new_tokens)
+    (_, _), tokens = jax.lax.scan(step, (first, cache), keys[:max_new_tokens])
+    # scan emitted the INPUT token each step: [first, ..., second-to-last]
+    return jnp.moveaxis(tokens, 0, 1)  # [B, max_new_tokens]
