@@ -33,20 +33,26 @@ NEG_INF = -1e30
 
 
 class KVCache(NamedTuple):
-    """Per-layer stacked cache: k/v [L, B, max_len, n_kv_heads, head_dim];
-    ``length`` is the number of valid positions (scalar int32)."""
+    """Per-layer cache: k/v are LENGTH-L TUPLES of [B, max_len, n_kv_heads,
+    head_dim] arrays; ``length`` is the number of valid positions.
 
-    k: jax.Array
-    v: jax.Array
+    Per-layer arrays (not one stacked [L, ...] tensor) matter for decode
+    speed: a stacked cache forces gather-update-stack round trips that XLA
+    materializes as full-cache copies every step; separate arrays donate
+    cleanly through the scan carry and update in place.
+    """
+
+    k: tuple
+    v: tuple
     length: jax.Array
 
     @staticmethod
     def create(cfg: LlamaConfig, batch: int, max_len: int, dtype=None) -> "KVCache":
-        shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+        shape = (batch, max_len, cfg.n_kv_heads, cfg.head_dim)
         dt = dtype or jnp.dtype(cfg.dtype)
         return KVCache(
-            k=jnp.zeros(shape, dt),
-            v=jnp.zeros(shape, dt),
+            k=tuple(jnp.zeros(shape, dt) for _ in range(cfg.n_layers)),
+            v=tuple(jnp.zeros(shape, dt) for _ in range(cfg.n_layers)),
             length=jnp.zeros((), jnp.int32),
         )
 
@@ -113,7 +119,7 @@ def _run(params, tokens, cfg, cache: KVCache):
         vs.append(v_l)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, -1] @ params["lm_head"]).astype(jnp.float32)  # [B, V]
-    new_cache = KVCache(jnp.stack(ks), jnp.stack(vs), start + S)
+    new_cache = KVCache(tuple(ks), tuple(vs), start + S)
     return logits, new_cache
 
 
