@@ -152,6 +152,7 @@ def generate(
         )
     logits, cache = prefill(params, prompt, cfg, max_len)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    first_key, scan_key = jax.random.split(rng)  # never reuse a consumed key
 
     def sample(logits, key):
         if temperature <= 0.0:
@@ -160,15 +161,19 @@ def generate(
             jnp.int32
         )
 
-    first = sample(logits, rng)
+    first = sample(logits, first_key)
 
     def step(carry, key):
         token, cache = carry
         logits, cache = decode_step(params, token, cfg, cache)
         nxt = sample(logits, key)
-        return (nxt, cache), token
+        return (nxt, cache), nxt
 
-    keys = jax.random.split(rng, max_new_tokens)
-    (_, _), tokens = jax.lax.scan(step, (first, cache), keys[:max_new_tokens])
-    # scan emitted the INPUT token each step: [first, ..., second-to-last]
-    return jnp.moveaxis(tokens, 0, 1)  # [B, max_new_tokens]
+    # N-1 decode steps: prefill already produced the first token
+    keys = jax.random.split(scan_key, max(max_new_tokens - 1, 1))
+    if max_new_tokens == 1:
+        return first[:, None]
+    (_, _), rest = jax.lax.scan(step, (first, cache), keys)
+    return jnp.concatenate(
+        [first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1
+    )  # [B, max_new_tokens]

@@ -70,12 +70,14 @@ def make_hybrid_mesh(
     n_slices = len(slice_ids)
     if dcn_dp == 0:
         dcn_dp = n_slices
-    if dcn_dp == 1:
-        return make_mesh(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep, devices=devices)
     if dcn_dp != n_slices:
+        # also rejects explicit dcn_dp=1 over multi-slice devices — the
+        # plain-mesh fast path would silently lay inner axes across DCN
         raise ValueError(
             f"dcn_dp={dcn_dp} but devices span {n_slices} slice(s)"
         )
+    if dcn_dp == 1:
+        return make_mesh(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep, devices=devices)
     per_slice = dp * fsdp * tp * sp * ep
     by_slice = {s: [] for s in slice_ids}
     for d in devices:
