@@ -81,7 +81,11 @@ def _attend_cached(q, k_cache, v_cache, valid_len):
 
 def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start):
     """One decoder layer over new tokens x [B,S,D], updating this layer's
-    cache slice at [start, start+S). Returns (x, k_cache, v_cache)."""
+    cache slice at [start, start+S). Returns (x, k_cache, v_cache).
+
+    Works for dense (Llama: ``mlp``/``mlp_norm``) and MoE (Mixtral:
+    ``moe``/``moe_norm``) layers — attention is identical, only the FFN
+    half differs (routing aux loss is irrelevant at inference)."""
     B, S, _ = x.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     attn = layer["attn"]
@@ -99,7 +103,19 @@ def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start):
     )
     out = _attend_cached(q, k_cache, v_cache, start + S)
     x = x + out.reshape(B, S, H * hd) @ attn["wo"]
-    x = x + mlp(layer["mlp"], rms_norm(x, layer["mlp_norm"], cfg.norm_eps))
+    if "moe" in layer:
+        # NOTE: expert capacity is computed over the tokens in THIS call
+        # (B*S), not the full sequence — matches the full forward only when
+        # capacity doesn't bind. For inference use a capacity_factor high
+        # enough that no token drops (C >= B*top_k covers the worst case).
+        from nanotpu.models.mixtral import moe_block
+
+        ffn_out, _aux = moe_block(
+            layer["moe"], rms_norm(x, layer["moe_norm"], cfg.norm_eps), cfg
+        )
+    else:
+        ffn_out = mlp(layer["mlp"], rms_norm(x, layer["mlp_norm"], cfg.norm_eps))
+    x = x + ffn_out
     return x, k_cache, v_cache
 
 

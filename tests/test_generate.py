@@ -85,3 +85,28 @@ def test_overflow_rejected(setup):
     prompt = jnp.ones((1, 10), jnp.int32)
     with pytest.raises(ValueError, match="exceeds"):
         gen.generate(params, prompt, cfg, 10, max_len=12)
+
+
+def test_mixtral_decode_matches_forward():
+    from nanotpu.models import mixtral
+
+    # capacity_factor high enough that no token ever drops, so incremental
+    # and teacher-forced routing agree (see _layer_with_cache note)
+    cfg = mixtral.MixtralConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=96, n_experts=4, top_k=2, capacity_factor=8.0,
+        max_seq_len=64, dtype="float32",
+    )
+    params = mixtral.init_params(jax.random.PRNGKey(0), cfg)
+    B, S, N = 2, 5, 4
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (B, S), 0, cfg.vocab_size)
+    logits, cache = gen.prefill(params, prompt, cfg, max_len=S + N)
+    full, _aux = mixtral.forward(params, prompt, cfg)
+    np.testing.assert_allclose(logits, full[:, -1], rtol=2e-4, atol=2e-4)
+    seq = prompt
+    for _ in range(N):
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        full, _aux = mixtral.forward(params, seq, cfg)
+        logits, cache = gen.decode_step(params, nxt, cfg, cache)
+        np.testing.assert_allclose(logits, full[:, -1], rtol=2e-4, atol=2e-4)
