@@ -1,0 +1,87 @@
+"""Multi-host runtime initialization: gang pods -> one jax.distributed job.
+
+The scheduler side places a gang of pods onto ICI-adjacent hosts of one
+slice (nanotpu.dealer.gang); this module is the workload side — each pod
+derives (coordinator, num_processes, process_id) from its K8s environment
+and joins the jax.distributed cluster, after which `jax.devices()` spans
+every gang member's chips and the meshes in nanotpu.parallel.mesh work
+unchanged (XLA routes collectives over ICI within a slice, DCN across).
+
+Wire-up in a Job manifest (see examples/llama3-8b-v5p16.yaml):
+- an Indexed Job gives every pod ``JOB_COMPLETION_INDEX``
+- a headless Service gives pod 0 a stable DNS name for the coordinator
+- ``tpu.io/gang-size`` (already on the pod for the scheduler) is the
+  process count
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+
+log = logging.getLogger("nanotpu.distributed")
+
+DEFAULT_PORT = 8476
+
+
+@dataclass(frozen=True)
+class ProcessInfo:
+    coordinator: str  # host:port of process 0
+    num_processes: int
+    process_id: int
+
+
+def process_info_from_env(env: dict[str, str] | None = None) -> ProcessInfo | None:
+    """Derive the jax.distributed triple from the pod environment.
+
+    Recognized (first match wins):
+    - explicit: NANOTPU_COORDINATOR, NANOTPU_NUM_PROCESSES, NANOTPU_PROCESS_ID
+    - Indexed Job: JOB_COMPLETION_INDEX (or the batch.kubernetes.io
+      annotation exported as JOB_INDEX) + GANG_SIZE + COORDINATOR_SERVICE
+      (headless-service DNS of pod 0)
+
+    Returns None when the pod is not part of a multi-host gang (single-host
+    jobs must skip jax.distributed entirely).
+    """
+    env = dict(os.environ if env is None else env)
+    if "NANOTPU_COORDINATOR" in env:
+        return ProcessInfo(
+            coordinator=env["NANOTPU_COORDINATOR"],
+            num_processes=int(env["NANOTPU_NUM_PROCESSES"]),
+            process_id=int(env["NANOTPU_PROCESS_ID"]),
+        )
+    idx = env.get("JOB_COMPLETION_INDEX", env.get("JOB_INDEX", ""))
+    size = env.get("GANG_SIZE", "")
+    svc = env.get("COORDINATOR_SERVICE", "")
+    if not (idx and size and svc):
+        return None
+    n = int(size)
+    if n <= 1:
+        return None
+    coord = svc if ":" in svc else f"{svc}:{DEFAULT_PORT}"
+    return ProcessInfo(coordinator=coord, num_processes=n, process_id=int(idx))
+
+
+def initialize(info: ProcessInfo | None = None) -> bool:
+    """Join the jax.distributed cluster if this pod is part of one.
+
+    Idempotent and safe on single-host jobs: returns False (and leaves JAX
+    in single-process mode) when no gang environment is present.
+    """
+    import jax
+
+    info = info or process_info_from_env()
+    if info is None:
+        log.info("no multi-host environment; staying single-process")
+        return False
+    log.info(
+        "joining jax.distributed: coordinator=%s process %d/%d",
+        info.coordinator, info.process_id, info.num_processes,
+    )
+    jax.distributed.initialize(
+        coordinator_address=info.coordinator,
+        num_processes=info.num_processes,
+        process_id=info.process_id,
+    )
+    return True

@@ -220,6 +220,12 @@ def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(level=logging.INFO, format="%(message)s")
     log = logging.getLogger("nanotpu.train")
 
+    # multi-host gangs: join jax.distributed BEFORE any jax call touches the
+    # backend (no-op for single-host jobs and in tests)
+    from nanotpu.parallel.distributed import initialize as distributed_init
+
+    distributed_init()
+
     key = (args.model, args.preset)
     if key not in _PRESETS:
         parser.error(f"no preset {key}; have {sorted(_PRESETS)}")

@@ -1,0 +1,58 @@
+"""Multi-host env wiring (process_info_from_env; jax.distributed itself
+needs real multi-process infra and is exercised on hardware)."""
+
+from nanotpu.parallel.distributed import (
+    DEFAULT_PORT,
+    ProcessInfo,
+    initialize,
+    process_info_from_env,
+)
+
+
+def test_explicit_env_wins():
+    info = process_info_from_env(
+        {
+            "NANOTPU_COORDINATOR": "10.0.0.5:9999",
+            "NANOTPU_NUM_PROCESSES": "4",
+            "NANOTPU_PROCESS_ID": "2",
+            "JOB_COMPLETION_INDEX": "9",  # ignored: explicit wins
+        }
+    )
+    assert info == ProcessInfo("10.0.0.5:9999", 4, 2)
+
+
+def test_indexed_job_env():
+    info = process_info_from_env(
+        {
+            "JOB_COMPLETION_INDEX": "3",
+            "GANG_SIZE": "8",
+            "COORDINATOR_SERVICE": "llama3-8b-0.llama3-8b",
+        }
+    )
+    assert info.process_id == 3
+    assert info.num_processes == 8
+    assert info.coordinator == f"llama3-8b-0.llama3-8b:{DEFAULT_PORT}"
+
+
+def test_explicit_port_kept():
+    info = process_info_from_env(
+        {
+            "JOB_INDEX": "0",
+            "GANG_SIZE": "2",
+            "COORDINATOR_SERVICE": "svc:1234",
+        }
+    )
+    assert info.coordinator == "svc:1234"
+
+
+def test_single_host_returns_none():
+    assert process_info_from_env({}) is None
+    assert process_info_from_env({"GANG_SIZE": "1", "JOB_INDEX": "0",
+                                  "COORDINATOR_SERVICE": "svc"}) is None
+
+
+def test_initialize_noop_without_env(monkeypatch):
+    for k in ("NANOTPU_COORDINATOR", "JOB_COMPLETION_INDEX", "JOB_INDEX",
+              "GANG_SIZE", "COORDINATOR_SERVICE"):
+        monkeypatch.delenv(k, raising=False)
+    assert initialize() is False  # single-process: must not touch jax.distributed
