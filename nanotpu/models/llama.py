@@ -40,7 +40,8 @@ class LlamaConfig:
     rope_theta: float = 500_000.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
-    # "dense" (XLA-fused) or "flash" (pallas kernel from nanotpu.ops)
+    # "dense" (XLA-fused), "flash" (pallas kernel from nanotpu.ops), or
+    # "ring" (sequence-parallel ring attention over the sp mesh axis)
     attn_impl: str = "dense"
     remat: bool = False
 
@@ -163,6 +164,16 @@ def attention(params: dict, x: jax.Array, cfg: LlamaConfig,
     v = (x @ params["wv"]).reshape(B, S, KV, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
+    if cfg.attn_impl == "ring":
+        # sequence-parallel: S is sharded over the mesh's sp axis; k/v
+        # blocks rotate the ring via ppermute (one ICI hop per step) while
+        # dp/tp shardings stay XLA-managed. Uses the ambient context mesh
+        # set by the train step. k/v stay at KV heads — the ring kernel is
+        # GQA-aware, so each hop moves H/KV× less ICI traffic.
+        from nanotpu.parallel.ring_attention import ring_attention_sharded
+
+        out = ring_attention_sharded(q, k, v, causal=True)
+        return out.reshape(B, S, H * hd) @ params["wo"]
     # GQA: repeat kv heads to full head count (XLA turns this into a
     # broadcast inside the einsum, no materialized copy)
     if KV != H:

@@ -4,6 +4,8 @@ The scaling recipe: pick a mesh, annotate shardings with PartitionSpec, let
 XLA insert the collectives, which ride ICI inside a slice. Axes:
 
 * ``dp``   — pure data parallel (gradients all-reduced)
+* ``pp``   — pipeline parallel over layer stages (GPipe microbatching,
+  nanotpu.parallel.pipeline; activations hop stage→stage via ppermute)
 * ``fsdp`` — data parallel with parameters/optimizer sharded (ZeRO-3 style;
   XLA all-gathers params per layer, reduce-scatters grads)
 * ``tp``   — tensor parallel over attention heads / ffn hidden
@@ -28,27 +30,30 @@ from nanotpu.models.llama import LlamaConfig
 
 def make_mesh(
     dp: int = 1, fsdp: int = 1, tp: int = 1, sp: int = 1, ep: int = 1,
-    devices: list | None = None,
+    pp: int = 1, devices: list | None = None,
 ) -> Mesh:
-    """Build a Mesh with the canonical axis order (dp, fsdp, tp, sp, ep).
+    """Build a Mesh with the canonical axis order (dp, pp, fsdp, tp, sp, ep).
 
     Axis sizes must multiply to the device count. Size-1 axes are kept in
     the mesh (specs may always name them; XLA drops trivial collectives).
+    ``pp`` sits right after ``dp``: pipeline hops are one activation
+    transfer per microbatch tick, far lighter traffic than the per-layer
+    fsdp/tp collectives, so those get the innermost (fastest-ICI) axes.
     """
     devices = devices if devices is not None else jax.devices()
-    want = dp * fsdp * tp * sp * ep
+    want = dp * pp * fsdp * tp * sp * ep
     if want != len(devices):
         raise ValueError(
-            f"mesh {dp}x{fsdp}x{tp}x{sp}x{ep} needs {want} devices, "
+            f"mesh {dp}x{pp}x{fsdp}x{tp}x{sp}x{ep} needs {want} devices, "
             f"have {len(devices)}"
         )
-    arr = np.array(devices).reshape(dp, fsdp, tp, sp, ep)
-    return Mesh(arr, axis_names=("dp", "fsdp", "tp", "sp", "ep"))
+    arr = np.array(devices).reshape(dp, pp, fsdp, tp, sp, ep)
+    return Mesh(arr, axis_names=("dp", "pp", "fsdp", "tp", "sp", "ep"))
 
 
 def make_hybrid_mesh(
     dcn_dp: int = 0, dp: int = 1, fsdp: int = 1, tp: int = 1, sp: int = 1,
-    ep: int = 1, devices: list | None = None,
+    ep: int = 1, pp: int = 1, devices: list | None = None,
 ) -> Mesh:
     """Multi-slice mesh: ``dcn_dp`` spans slices over DCN, the remaining
     axes stay inside a slice so their collectives ride ICI.
@@ -77,8 +82,10 @@ def make_hybrid_mesh(
             f"dcn_dp={dcn_dp} but devices span {n_slices} slice(s)"
         )
     if dcn_dp == 1:
-        return make_mesh(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep, devices=devices)
-    per_slice = dp * fsdp * tp * sp * ep
+        return make_mesh(
+            dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep, pp=pp, devices=devices
+        )
+    per_slice = dp * pp * fsdp * tp * sp * ep
     by_slice = {s: [] for s in slice_ids}
     for d in devices:
         by_slice[getattr(d, "slice_index", 0)].append(d)
@@ -92,12 +99,15 @@ def make_hybrid_mesh(
     # every inner axis stays within a row -> ICI
     arr = np.array(
         [by_slice[s] for s in slice_ids]
-    ).reshape(dcn_dp * dp, fsdp, tp, sp, ep)
-    return Mesh(arr, axis_names=("dp", "fsdp", "tp", "sp", "ep"))
+    ).reshape(dcn_dp * dp, pp, fsdp, tp, sp, ep)
+    return Mesh(arr, axis_names=("dp", "pp", "fsdp", "tp", "sp", "ep"))
 
 
-#: Batch is sharded over every data-ish axis; sequence over sp.
-BATCH_SPEC = P(("dp", "fsdp"), "sp")
+#: Token batches shard over every data-ish axis. The sequence dim stays
+#: unsharded here: token ids are tiny, their length is S+1 (the loss shift
+#: makes it indivisible by sp), and the sp sharding belongs to the
+#: *activations*, which ring attention's shard_map region imposes itself.
+BATCH_SPEC = P(("dp", "fsdp"))
 
 
 def _attn_specs() -> dict:

@@ -1,0 +1,206 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over the ``pp``
+mesh axis.
+
+The reference repo has no parallelism code at all (it schedules pods —
+SURVEY §2 "absent in reference"), so this module is TPU-native by
+construction rather than a port: layer stages live on ``pp`` mesh ranks,
+activations hop stage→stage with one ``lax.ppermute`` per microbatch tick
+(a single ICI neighbor transfer), and the whole schedule is a
+``lax.scan`` so XLA sees one static program.
+
+Composition with the other axes is the key design point: the pipeline body
+runs under ``jax.shard_map(..., axis_names={"pp"})`` — *only* ``pp`` is
+manual. dp/fsdp/tp shardings stay visible to XLA inside the stage, so the
+per-layer tensor-parallel matmul collectives and ZeRO all-gathers are still
+compiler-inserted; we hand-write only the stage-to-stage hop, which is the
+one transfer XLA cannot infer.
+
+Schedule (classic GPipe): with M microbatches and P stages the scan runs
+M + P - 1 ticks; tick t has stage r working on microbatch t - r. Bubble
+fraction = (P-1)/(M+P-1), so choose M >= a few ×P. The backward pass is
+jax.grad straight through the scan — ppermute transposes to the reverse
+permutation, giving the mirrored backward pipeline for free.
+
+Embedding, final norm and the LM head stay *outside* the pipeline region,
+sharded over tp/fsdp as in the non-pipelined model: they are a tiny
+fraction of FLOPs and keeping them out lets every pp rank hold the full
+(tp-sharded) embedding instead of threading token ids through the ring.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from nanotpu.models import llama
+from nanotpu.parallel.mesh import llama_param_specs
+
+
+# -- parameter layout ------------------------------------------------------
+
+def stack_layers(params: dict) -> dict:
+    """Convert ``layers`` from a list of per-layer trees to one tree whose
+    leaves carry a leading [n_layers] axis — the axis the ``pp`` mesh
+    dimension shards, giving each stage a contiguous block of layers."""
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *params["layers"]
+    )
+    return {**params, "layers": stacked}
+
+
+def unstack_layers(params: dict) -> dict:
+    """Inverse of :func:`stack_layers` (e.g. to hand a pipelined checkpoint
+    back to the non-pipelined forward)."""
+    n = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
+    layers = [
+        jax.tree_util.tree_map(lambda x, i=i: x[i], params["layers"])
+        for i in range(n)
+    ]
+    return {**params, "layers": layers}
+
+
+def llama_pp_param_specs(cfg) -> dict:
+    """PartitionSpecs for the stacked tree: each layer leaf gets ``pp`` on
+    its new leading axis with its dense-model tp/fsdp spec shifted right;
+    embed/head keep their non-pipelined specs (they run outside the
+    pipeline, replicated over pp)."""
+    base = llama_param_specs(cfg)
+    one_layer = base["layers"][0]
+    stacked = jax.tree_util.tree_map(
+        lambda spec: P("pp", *spec),
+        one_layer,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return {**base, "layers": stacked}
+
+
+def check_pp_divisibility(cfg, mesh: Mesh, batch: int, n_micro: int) -> None:
+    """Fail fast with a readable message instead of an opaque XLA error."""
+    pp = mesh.shape["pp"]
+    problems = []
+    if cfg.n_layers % pp:
+        problems.append(f"n_layers {cfg.n_layers} % pp {pp}")
+    if batch % n_micro:
+        problems.append(f"batch {batch} % n_micro {n_micro}")
+    if n_micro < pp:
+        problems.append(
+            f"n_micro {n_micro} < pp {pp} (pipeline can never fill)"
+        )
+    if getattr(cfg, "attn_impl", "dense") == "ring":
+        problems.append(
+            'attn_impl="ring": the sp ring cannot nest inside the pp-manual '
+            "region (sdy rejects re-binding parent axes)"
+        )
+    if problems:
+        raise ValueError("pipeline misconfigured: " + ", ".join(problems))
+
+
+# -- the pipelined region --------------------------------------------------
+
+def _stage_apply(local_layers, x, cfg, cos, sin):
+    """Apply this rank's contiguous layer block ([L/pp, ...] leaves) to one
+    microbatch of hidden states."""
+    layer_fn = llama.decoder_layer
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn, static_argnums=(2,),
+            policy=jax.checkpoint_policies.nothing_saveable,
+        )
+
+    def body(h, layer_params):
+        return layer_fn(layer_params, h, cfg, cos, sin), None
+
+    h, _ = lax.scan(body, x, local_layers)
+    return h
+
+
+def _vary_over(x, axis_name: str):
+    """Mark x device-varying over a manual mesh axis (scan-carry inits whose
+    outputs depend on lax.axis_index must start varying). pcast with a
+    pvary fallback for older jax."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    return lax.pvary(x, axis_name)
+
+
+def _pipeline_body(local_layers, xm, cos, sin, *, cfg, n_micro):
+    """shard_map body (manual over ``pp`` only). xm: [M, mB, S, D] hidden
+    states, replicated over pp; returns the same, transformed by all
+    n_layers across the stage ring."""
+    n_stages = lax.axis_size("pp")
+    rank = lax.axis_index("pp")
+    ticks = n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        recv, out = carry
+        # stage 0 feeds itself fresh microbatches; everyone else consumes
+        # what the previous stage sent last tick
+        feed = xm[jnp.clip(t, 0, n_micro - 1)]
+        h = jnp.where(rank == 0, feed, recv)
+        y = _stage_apply(local_layers, h, cfg, cos, sin)
+        # the LAST stage's y at tick t is microbatch t-(P-1), fully
+        # transformed. Writes before the pipeline fills land on index 0 and
+        # are overwritten at t = P-1 (ascending t ⇒ last write wins); ranks
+        # other than the last write garbage that the psum mask drops.
+        out = out.at[jnp.clip(t - (n_stages - 1), 0, n_micro - 1)].set(y)
+        recv = lax.ppermute(y, "pp", perm)
+        return (recv, out), None
+
+    recv0 = _vary_over(jnp.zeros_like(xm[0]), "pp")
+    out0 = _vary_over(jnp.zeros_like(xm), "pp")
+    (_, out), _ = lax.scan(tick, (recv0, out0), jnp.arange(ticks))
+    # keep only the last stage's buffer and hand it to every rank (the sum
+    # is a broadcast: all other ranks contribute zeros)
+    out = jnp.where(rank == n_stages - 1, out, jnp.zeros_like(out))
+    return lax.psum(out, "pp")
+
+
+def pipelined_forward(
+    params: dict, tokens: jax.Array, cfg, mesh: Mesh, n_micro: int,
+) -> jax.Array:
+    """tokens [B, S] -> logits [B, S, vocab] via the pp-staged decoder.
+
+    ``params`` must be the stacked tree (:func:`stack_layers`), placed with
+    :func:`llama_pp_param_specs`.
+    """
+    B, S = tokens.shape
+    check_pp_divisibility(cfg, mesh, B, n_micro)
+    positions = jnp.arange(S, dtype=jnp.int32)
+    cos, sin = llama.rope_freqs(cfg, positions)
+    x = params["embed"][tokens]
+    xm = x.reshape(n_micro, B // n_micro, S, cfg.dim)
+
+    body = jax.shard_map(
+        partial(_pipeline_body, cfg=cfg, n_micro=n_micro),
+        mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P()),
+        out_specs=P(),
+        axis_names={"pp"},
+    )
+    hm = body(params["layers"], xm, cos, sin)
+    h = hm.reshape(B, S, cfg.dim)
+    h = llama.rms_norm(h, params["final_norm"], cfg.norm_eps)
+    return (h @ params["lm_head"]).astype(jnp.float32)
+
+
+def pipelined_loss_fn(
+    params: dict, tokens: jax.Array, cfg, *, mesh: Mesh, n_micro: int,
+) -> jax.Array:
+    """Drop-in for ``build_train_step(loss_fn=...)``: same next-token cross
+    entropy as llama.loss_fn, forward replaced by the pipeline."""
+    logits = pipelined_forward(params, tokens[:, :-1], cfg, mesh, n_micro)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_pipelined_loss(mesh: Mesh, n_micro: int):
+    """Bind mesh/microbatching so the result has the (params, tokens, cfg)
+    signature build_train_step expects."""
+    return partial(pipelined_loss_fn, mesh=mesh, n_micro=n_micro)

@@ -32,17 +32,30 @@ NEG_INF = -1e30
 def _block_attend(q, k, v, scale, mask):
     """Partial attention of q against one k/v block.
 
-    q [B,Sq,H,D]; k/v [B,Sk,H,D]; mask [Sq,Sk] bool or None.
+    q [B,Sq,H,D]; k/v [B,Sk,KV,D] with KV | H (GQA: k/v stay UNexpanded —
+    the ring rotates the small KV blocks, H/KV× less ICI traffic per hop —
+    and the grouped einsums below broadcast them across each kv head's
+    query group); mask [Sq,Sk] bool or None.
     Returns (m [B,H,Sq,1], l, acc [B,Sq,H,D]) for LSE merging.
     """
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    B, Sq, H, D = q.shape
+    Sk, KV = k.shape[1], k.shape[2]
+    G = H // KV  # query heads per kv head; 1 for MHA
+    qg = q.reshape(B, Sq, KV, G, D)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32) * scale
+    logits = logits.reshape(B, H, Sq, Sk)
     if mask is not None:
         logits = jnp.where(mask[None, None], logits, NEG_INF)
     m = jnp.max(logits, axis=-1, keepdims=True)  # [B,H,Sq,1]
     m_safe = jnp.where(m == NEG_INF, 0.0, m)
     p = jnp.exp(jnp.where(logits == NEG_INF, NEG_INF, logits - m_safe))
     l = jnp.sum(p, axis=-1, keepdims=True)
-    acc = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v).astype(jnp.float32)
+    pg = p.astype(q.dtype).reshape(B, KV, G, Sq, Sk)
+    acc = (
+        jnp.einsum("bkgqs,bskd->bqkgd", pg, v)
+        .reshape(B, Sq, H, D)
+        .astype(jnp.float32)
+    )
     return m, l, acc
 
 
@@ -50,8 +63,9 @@ def ring_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     axis_name: str = "sp", causal: bool = True,
 ) -> jax.Array:
-    """Per-shard q/k/v [B, Sblk, H, D] -> per-shard out. Call inside
-    shard_map with the sequence dim sharded over ``axis_name``."""
+    """Per-shard q [B, Sblk, H, D], k/v [B, Sblk, KV, D] (KV | H; GQA kv
+    blocks ride the ring unexpanded) -> per-shard out [B, Sblk, H, D].
+    Call inside shard_map with the sequence dim sharded over ``axis_name``."""
     B, Sblk, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
     n = jax.lax.axis_size(axis_name)
@@ -88,16 +102,15 @@ def ring_attention(
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
         return (k_next, v_next, m_new, l_new, acc_new), None
 
-    # mark the accumulator inits as device-varying over the ring axis so the
-    # scan carry types match (outputs depend on rank via the causal masks)
-    def _vary(x):
-        if hasattr(jax.lax, "pcast"):  # pvary deprecated in favor of pcast
-            return jax.lax.pcast(x, axis_name, to="varying")
-        return jax.lax.pvary(x, axis_name)
-
-    m0 = _vary(jnp.full((B, H, Sblk, 1), NEG_INF, jnp.float32))
-    l0 = _vary(jnp.zeros((B, H, Sblk, 1), jnp.float32))
-    acc0 = _vary(jnp.zeros((B, Sblk, H, D), jnp.float32))
+    # scan-carry inits must be device-varying over every manual axis the
+    # outputs vary over (the ring axis via the causal masks, PLUS any
+    # enclosing manual region, e.g. the pp pipeline's stage shard_map).
+    # Deriving them arithmetically from q inherits the full varying set,
+    # whatever it is — no axis list to keep in sync; XLA folds the *0 away.
+    q32 = jnp.transpose(q, (0, 2, 1, 3)).astype(jnp.float32)  # [B,H,Sblk,D]
+    m0 = q32[..., :1] * 0 + NEG_INF
+    l0 = q32[..., :1] * 0
+    acc0 = q.astype(jnp.float32) * 0
     (k_f, v_f, m, l, acc), _ = jax.lax.scan(
         step, (k, v, m0, l0, acc0), jnp.arange(n)
     )
@@ -107,15 +120,24 @@ def ring_attention(
 
 
 def ring_attention_sharded(
-    q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+    q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh | None = None,
     causal: bool = True, axis_name: str = "sp",
 ) -> jax.Array:
-    """Global q/k/v [B, S, H, D] with S sharded over ``axis_name``."""
+    """Global q [B, S, H, D], k/v [B, S, KV, D] with S sharded over
+    ``axis_name``.
+
+    Manual only over ``axis_name``: batch/head shardings (dp/tp) stay
+    visible to XLA inside the region, so ring attention composes with the
+    other mesh axes. ``mesh=None`` uses the ambient mesh (e.g. the train
+    step's ``with mesh:`` scope) — how the model's ``attn_impl="ring"``
+    path reaches it from inside jit.
+    """
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
         partial(ring_attention, axis_name=axis_name, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        axis_names={axis_name},
     )
     return fn(q, k, v)

@@ -79,7 +79,10 @@ def build_train_step(
 
     def step_fn(state: TrainState, tokens: jax.Array):
         tokens = jax.device_put(tokens, batch_sharding)
-        with mesh:
+        # set_mesh (not the legacy `with mesh:`) so the mesh is also the
+        # *context mesh*: model internals that shard_map over an axis with
+        # mesh=None (ring attention's sp ring) resolve it from here
+        with jax.set_mesh(mesh):
             return train_step(state, tokens)
 
     return step_fn
@@ -213,6 +216,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--fsdp", type=int, default=1)
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--ep", type=int, default=1)
+    parser.add_argument("--sp", type=int, default=1,
+                        help=">1 switches attention to the sp ring")
+    parser.add_argument("--pp", type=int, default=1,
+                        help=">1 pipelines llama layers over pp stages")
+    parser.add_argument("--microbatches", type=int, default=0,
+                        help="pipeline microbatches (0 = 2*pp)")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--save-every", type=int, default=100)
     parser.add_argument("--seed", type=int, default=0)
@@ -229,40 +238,97 @@ def main(argv: list[str] | None = None) -> int:
     key = (args.model, args.preset)
     if key not in _PRESETS:
         parser.error(f"no preset {key}; have {sorted(_PRESETS)}")
+    if args.pp > 1 and args.model != "llama":
+        parser.error("--pp pipelines the dense llama stack only")
+    if args.pp > 1 and args.sp > 1:
+        # ring attention's sp shard_map cannot nest inside the pipeline's
+        # pp-manual region (sdy rejects re-binding the parent's axes);
+        # combine pp with dp/fsdp/tp instead, or sp with dp/tp
+        parser.error("--pp and --sp cannot be combined (nested shard_map)")
+    preset = dict(_PRESETS[key])
+    if args.sp > 1:
+        preset["attn_impl"] = "ring"
     if args.model == "llama":
         from nanotpu.models.llama import LlamaConfig
 
-        cfg = LlamaConfig(**_PRESETS[key])
+        cfg = LlamaConfig(**preset)
         loss, init, specs = None, None, None  # build_train_step defaults
     else:
         from nanotpu.models import mixtral
         from nanotpu.parallel.mesh import mixtral_param_specs
 
-        cfg = mixtral.MixtralConfig(**_PRESETS[key])
+        cfg = mixtral.MixtralConfig(**preset)
         loss, init, specs = mixtral.loss_fn, mixtral.init_params, mixtral_param_specs(cfg)
 
     devices = jax.devices()
-    manual = args.dp or args.fsdp > 1 or args.tp > 1 or args.ep > 1
+    manual = (args.dp or args.fsdp > 1 or args.tp > 1 or args.ep > 1
+              or args.sp > 1 or args.pp > 1)
     if manual:
         # --dp 0 with explicit parallelism flags: dp absorbs the remainder
-        denom = args.fsdp * args.tp * args.ep
+        denom = args.fsdp * args.tp * args.ep * args.sp * args.pp
         if len(devices) % denom:
             parser.error(
-                f"fsdp*tp*ep={denom} does not divide {len(devices)} devices"
+                f"fsdp*tp*ep*sp*pp={denom} does not divide {len(devices)} devices"
             )
         dp = args.dp or len(devices) // denom
-        factors = {"dp": dp, "fsdp": args.fsdp, "tp": args.tp, "ep": args.ep}
+        factors = {"dp": dp, "fsdp": args.fsdp, "tp": args.tp,
+                   "ep": args.ep, "sp": args.sp, "pp": args.pp}
     else:
         factors = _auto_mesh_factors(len(devices), args.model)
     from nanotpu.parallel.mesh import make_mesh
 
     mesh = make_mesh(devices=devices, **factors)
     data_shards = mesh.shape["dp"] * mesh.shape.get("fsdp", 1)
+    n_micro = args.microbatches or 2 * args.pp
     batch = args.batch or max(2, data_shards)
+    if args.pp > 1:
+        # the batch must split into n_micro pipeline slices AND device_put
+        # over the dp*fsdp data shards — round up to a common multiple
+        import math as _math
+
+        unit = _math.lcm(n_micro, data_shards)
+        rounded = ((batch + unit - 1) // unit) * unit
+        if args.batch and rounded != args.batch:
+            log.warning(
+                "--batch %d rounded up to %d (must split into %d "
+                "microbatches and %d data shards)",
+                args.batch, rounded, n_micro, data_shards,
+            )
+        batch = rounded
     seq = args.seq or min(cfg.max_seq_len, 512)
+    if args.sp > 1:
+        # the model sees seq-1 tokens after the loss shift; keep that
+        # divisible by sp for the ring's equal sequence shards
+        if seq - 1 < args.sp:
+            parser.error(
+                f"--seq {seq} too short for --sp {args.sp}: the model sees "
+                f"seq-1 tokens and needs at least one per sequence shard"
+            )
+        shrunk = seq - (seq - 1) % args.sp
+        if args.seq and shrunk != args.seq:
+            log.warning(
+                "--seq %d shrunk to %d (seq-1 must divide into %d "
+                "sequence shards)", args.seq, shrunk, args.sp,
+            )
+        seq = shrunk
     log.info("mesh %s | %s/%s | batch=%d seq=%d", dict(mesh.shape), *key, batch, seq)
 
     optimizer = make_optimizer()
+    if args.pp > 1:
+        from nanotpu.models.llama import init_params as _llama_init
+        from nanotpu.parallel.pipeline import (
+            check_pp_divisibility,
+            llama_pp_param_specs,
+            make_pipelined_loss,
+            stack_layers,
+        )
+
+        check_pp_divisibility(cfg, mesh, batch, n_micro)
+        # init the stacked tree directly so optimizer moments are built
+        # once, for the layout that will actually train
+        init = lambda rng, c: stack_layers(_llama_init(rng, c))  # noqa: E731
+        specs = llama_pp_param_specs(cfg)
+        loss = make_pipelined_loss(mesh, n_micro)
     state = init_train_state(jax.random.PRNGKey(args.seed), cfg, optimizer, init_fn=init)
     state = place_state(state, cfg, mesh, param_specs=specs)
     if args.checkpoint_dir:

@@ -15,7 +15,9 @@ from nanotpu.parallel.mesh import make_hybrid_mesh, make_mesh
 
 def test_single_slice_auto_falls_back_to_plain_mesh():
     m = make_hybrid_mesh(dp=1, fsdp=2, tp=4, devices=jax.devices()[:8])
-    assert dict(m.shape) == {"dp": 1, "fsdp": 2, "tp": 4, "sp": 1, "ep": 1}
+    assert dict(m.shape) == {
+        "dp": 1, "pp": 1, "fsdp": 2, "tp": 4, "sp": 1, "ep": 1,
+    }
     plain = make_mesh(dp=1, fsdp=2, tp=4, devices=jax.devices()[:8])
     assert (m.devices == plain.devices).all()
 

@@ -1,0 +1,106 @@
+"""Sequence parallelism wired into the model: cfg.attn_impl="ring" runs
+ring attention over the sp mesh axis from inside the jitted forward/train
+step (ambient context mesh), matching the dense path exactly."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanotpu.models import llama
+from nanotpu.parallel import train as train_lib
+from nanotpu.parallel.mesh import make_mesh
+
+CFG = llama.LlamaConfig(
+    vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=64, max_seq_len=64, dtype="float32",
+)
+CFG_RING = dataclasses.replace(CFG, attn_impl="ring")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.mark.parametrize("dp,sp", [(1, 8), (2, 4), (4, 2)])
+def test_ring_forward_matches_dense(params, dp, sp):
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, CFG.vocab_size)
+    want = llama.forward(params, tokens, CFG)
+    mesh = make_mesh(dp=dp, sp=sp)
+    with jax.set_mesh(mesh):
+        got = jax.jit(lambda p, t: llama.forward(p, t, CFG_RING))(params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_composes_with_tp(params):
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, CFG.vocab_size)
+    want = llama.forward(params, tokens, CFG)
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    with jax.set_mesh(mesh):
+        got = jax.jit(lambda p, t: llama.forward(p, t, CFG_RING))(params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sp_train_step_matches_dense_loss(params):
+    """Full train step with sp=4: loss equals the dense-attention step's
+    loss on identical params/tokens (seq len 33 = S+1, indivisible by sp —
+    token batches shard over batch only, activations over sp)."""
+    mesh = make_mesh(dp=2, sp=4)
+    opt = train_lib.make_optimizer()
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 33), 0, CFG.vocab_size)
+
+    def one_step(cfg):
+        state = train_lib.init_train_state(jax.random.PRNGKey(0), cfg, opt)
+        state = train_lib.place_state(state, cfg, mesh)
+        step = train_lib.build_train_step(cfg, mesh, opt)
+        _, loss = step(state, tokens)
+        return float(loss)
+
+    assert one_step(CFG_RING) == pytest.approx(one_step(CFG), abs=1e-5)
+
+
+def test_gqa_ring_blocks_stay_unexpanded():
+    """The ring kernel takes k/v at KV heads (not repeated to H): GQA
+    correctness against a reference that expands kv heads first."""
+    import math
+
+    from nanotpu.parallel.ring_attention import ring_attention_sharded
+
+    B, S, H, KV, D = 2, 16, 8, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
+
+    kf = jnp.repeat(k, H // KV, axis=2)
+    vf = jnp.repeat(v, H // KV, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf) / math.sqrt(D)
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    want = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, axis=-1), vf)
+
+    mesh = make_mesh(sp=8)
+    got = ring_attention_sharded(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_grads_flow(params):
+    """Gradients through the sp ring match dense-attention gradients."""
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 17), 0, CFG.vocab_size)
+    g_dense = jax.grad(llama.loss_fn)(params, tokens, CFG)
+    mesh = make_mesh(sp=8)
+    with jax.set_mesh(mesh):
+        g_ring = jax.jit(jax.grad(lambda p, t: llama.loss_fn(p, t, CFG_RING)))(
+            params, tokens
+        )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_dense), jax.tree_util.tree_leaves(g_ring)
+    ):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
