@@ -193,27 +193,38 @@ def moe_block(params: dict, x: jax.Array, cfg: MixtralConfig) -> tuple[jax.Array
     return out.reshape(B, S, D), aux
 
 
+def decoder_layer(
+    layer: dict, x: jax.Array, cfg: MixtralConfig,
+    cos: jax.Array, sin: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """One MoE decoder layer: attention residual + routed-experts residual.
+    Shared by :func:`forward` and the pipelined stage
+    (nanotpu.parallel.pipeline) so the two paths cannot drift.
+    Returns (x, router aux loss for this layer)."""
+    lcfg = cfg.as_llama()
+    x = x + attention(
+        layer["attn"], rms_norm(x, layer["attn_norm"], cfg.norm_eps),
+        lcfg, cos, sin,
+    )
+    moe_out, aux = moe_block(
+        layer["moe"], rms_norm(x, layer["moe_norm"], cfg.norm_eps), cfg
+    )
+    return x + moe_out, aux
+
+
 def forward(
     params: dict, tokens: jax.Array, cfg: MixtralConfig,
     positions: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """tokens [B,S] -> (logits [B,S,V] fp32, total aux loss)."""
     B, S = tokens.shape
-    lcfg = cfg.as_llama()
     if positions is None:
         positions = jnp.arange(S, dtype=jnp.int32)
-    cos, sin = rope_freqs(lcfg, positions)
+    cos, sin = rope_freqs(cfg.as_llama(), positions)
     x = params["embed"][tokens]
     aux_total = jnp.zeros((), jnp.float32)
     for layer in params["layers"]:
-        x = x + attention(
-            layer["attn"], rms_norm(x, layer["attn_norm"], cfg.norm_eps),
-            lcfg, cos, sin,
-        )
-        moe_out, aux = moe_block(
-            layer["moe"], rms_norm(x, layer["moe_norm"], cfg.norm_eps), cfg
-        )
-        x = x + moe_out
+        x, aux = decoder_layer(layer, x, cfg, cos, sin)
         aux_total = aux_total + aux
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"]).astype(jnp.float32), aux_total

@@ -25,6 +25,13 @@ Embedding, final norm and the LM head stay *outside* the pipeline region,
 sharded over tp/fsdp as in the non-pipelined model: they are a tiny
 fraction of FLOPs and keeping them out lets every pp rank hold the full
 (tp-sharded) embedding instead of threading token ids through the ring.
+
+Both model families pipeline through the same body: the dense llama stack
+(:func:`pipelined_forward`) and the Mixtral MoE stack
+(:func:`mixtral_pipelined_forward`), whose experts stay ep-sharded *inside*
+each stage — pp composes with ep because the MoE dispatch is plain einsums
+under auto axes, no nested manual region (unlike sp's ring, which cannot
+nest — see check_pp_divisibility).
 """
 
 from __future__ import annotations
@@ -63,12 +70,10 @@ def unstack_layers(params: dict) -> dict:
     return {**params, "layers": layers}
 
 
-def llama_pp_param_specs(cfg) -> dict:
-    """PartitionSpecs for the stacked tree: each layer leaf gets ``pp`` on
-    its new leading axis with its dense-model tp/fsdp spec shifted right;
-    embed/head keep their non-pipelined specs (they run outside the
-    pipeline, replicated over pp)."""
-    base = llama_param_specs(cfg)
+def _stacked_specs(base: dict) -> dict:
+    """Prefix ``pp`` onto every layer leaf's spec (the stacked leading axis
+    shards over pp); embed/head keep their non-pipelined specs (they run
+    outside the pipeline, replicated over pp)."""
     one_layer = base["layers"][0]
     stacked = jax.tree_util.tree_map(
         lambda spec: P("pp", *spec),
@@ -76,6 +81,22 @@ def llama_pp_param_specs(cfg) -> dict:
         is_leaf=lambda x: isinstance(x, P),
     )
     return {**base, "layers": stacked}
+
+
+def llama_pp_param_specs(cfg) -> dict:
+    """PartitionSpecs for the stacked dense tree: pp on the leading layer
+    axis, the tp/fsdp per-layer specs shifted right."""
+    return _stacked_specs(llama_param_specs(cfg))
+
+
+def mixtral_pp_param_specs(cfg) -> dict:
+    """Same for the MoE tree: pp on the stacked layer axis with each
+    expert leaf's (ep, fsdp/tp) spec shifted right — pp and ep compose
+    (experts stay ep-sharded *inside* each pipeline stage; the dispatch
+    collective is XLA-managed there, only the stage hop is manual)."""
+    from nanotpu.parallel.mesh import mixtral_param_specs
+
+    return _stacked_specs(mixtral_param_specs(cfg))
 
 
 def check_pp_divisibility(cfg, mesh: Mesh, batch: int, n_micro: int) -> None:
@@ -101,9 +122,9 @@ def check_pp_divisibility(cfg, mesh: Mesh, batch: int, n_micro: int) -> None:
 
 # -- the pipelined region --------------------------------------------------
 
-def _stage_apply(local_layers, x, cfg, cos, sin):
-    """Apply this rank's contiguous layer block ([L/pp, ...] leaves) to one
-    microbatch of hidden states."""
+def _llama_stage(local_layers, x, cfg, cos, sin):
+    """Apply this rank's contiguous dense layer block ([L/pp, ...] leaves)
+    to one microbatch of hidden states. Returns (h, aux=0)."""
     layer_fn = llama.decoder_layer
     if cfg.remat:
         layer_fn = jax.checkpoint(
@@ -115,7 +136,22 @@ def _stage_apply(local_layers, x, cfg, cos, sin):
         return layer_fn(layer_params, h, cfg, cos, sin), None
 
     h, _ = lax.scan(body, x, local_layers)
-    return h
+    return h, jnp.zeros((), jnp.float32)
+
+
+def _mixtral_stage(local_layers, x, cfg, cos, sin):
+    """MoE stage: scans mixtral.decoder_layer (the same function the plain
+    forward uses — the two paths cannot drift) over this rank's layer
+    block. Expert leaves keep their ep sharding inside the stage (auto
+    axes), so pp and ep compose. Returns (h, summed router aux loss for
+    this stage's layers on this microbatch)."""
+    from nanotpu.models import mixtral
+
+    def body(h, layer):
+        return mixtral.decoder_layer(layer, h, cfg, cos, sin)
+
+    h, auxs = lax.scan(body, x, local_layers)
+    return h, jnp.sum(auxs)
 
 
 def _vary_over(x, axis_name: str):
@@ -127,65 +163,109 @@ def _vary_over(x, axis_name: str):
     return lax.pvary(x, axis_name)
 
 
-def _pipeline_body(local_layers, xm, cos, sin, *, cfg, n_micro):
+def _pipeline_body(local_layers, xm, cos, sin, *, stage, cfg, n_micro):
     """shard_map body (manual over ``pp`` only). xm: [M, mB, S, D] hidden
-    states, replicated over pp; returns the same, transformed by all
-    n_layers across the stage ring."""
+    states, replicated over pp; returns (out [M, mB, S, D] transformed by
+    all n_layers across the stage ring, total aux loss scalar)."""
     n_stages = lax.axis_size("pp")
     rank = lax.axis_index("pp")
     ticks = n_micro + n_stages - 1
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def tick(carry, t):
-        recv, out = carry
+        recv, out, aux_run = carry
         # stage 0 feeds itself fresh microbatches; everyone else consumes
         # what the previous stage sent last tick
         feed = xm[jnp.clip(t, 0, n_micro - 1)]
         h = jnp.where(rank == 0, feed, recv)
-        y = _stage_apply(local_layers, h, cfg, cos, sin)
+        y, aux = stage(local_layers, h, cfg, cos, sin)
+        # rank r works on microbatch t-r; fill/drain ticks outside [0, M)
+        # are bubble garbage whose aux must not count
+        mb = t - rank
+        valid = (mb >= 0) & (mb < n_micro)
+        aux_run = aux_run + jnp.where(valid, aux, 0.0)
         # the LAST stage's y at tick t is microbatch t-(P-1), fully
         # transformed. Writes before the pipeline fills land on index 0 and
         # are overwritten at t = P-1 (ascending t ⇒ last write wins); ranks
         # other than the last write garbage that the psum mask drops.
         out = out.at[jnp.clip(t - (n_stages - 1), 0, n_micro - 1)].set(y)
         recv = lax.ppermute(y, "pp", perm)
-        return (recv, out), None
+        return (recv, out, aux_run), None
 
     recv0 = _vary_over(jnp.zeros_like(xm[0]), "pp")
     out0 = _vary_over(jnp.zeros_like(xm), "pp")
-    (_, out), _ = lax.scan(tick, (recv0, out0), jnp.arange(ticks))
+    aux0 = _vary_over(jnp.zeros((), jnp.float32), "pp")
+    (_, out, aux_run), _ = lax.scan(tick, (recv0, out0, aux0), jnp.arange(ticks))
     # keep only the last stage's buffer and hand it to every rank (the sum
-    # is a broadcast: all other ranks contribute zeros)
+    # is a broadcast: all other ranks contribute zeros). Every (stage,
+    # microbatch) pair ran on exactly one rank, so the aux psum counts each
+    # layer-microbatch contribution once.
     out = jnp.where(rank == n_stages - 1, out, jnp.zeros_like(out))
-    return lax.psum(out, "pp")
+    return lax.psum(out, "pp"), lax.psum(aux_run, "pp")
+
+
+def _pipelined_backbone(
+    params: dict, tokens: jax.Array, cfg, mesh: Mesh, n_micro: int, stage,
+) -> tuple[jax.Array, jax.Array]:
+    """Shared embed -> staged layers -> final norm/head path.
+    Returns (logits [B, S, vocab] fp32, total aux loss)."""
+    B, S = tokens.shape
+    check_pp_divisibility(cfg, mesh, B, n_micro)
+    positions = jnp.arange(S, dtype=jnp.int32)
+    rcfg = cfg.as_llama() if hasattr(cfg, "as_llama") else cfg
+    cos, sin = llama.rope_freqs(rcfg, positions)
+    x = params["embed"][tokens]
+    xm = x.reshape(n_micro, B // n_micro, S, cfg.dim)
+
+    body = jax.shard_map(
+        partial(_pipeline_body, stage=stage, cfg=cfg, n_micro=n_micro),
+        mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P()),
+        out_specs=(P(), P()),
+        axis_names={"pp"},
+    )
+    hm, aux = body(params["layers"], xm, cos, sin)
+    h = hm.reshape(B, S, cfg.dim)
+    h = llama.rms_norm(h, params["final_norm"], cfg.norm_eps)
+    return (h @ params["lm_head"]).astype(jnp.float32), aux
 
 
 def pipelined_forward(
     params: dict, tokens: jax.Array, cfg, mesh: Mesh, n_micro: int,
 ) -> jax.Array:
-    """tokens [B, S] -> logits [B, S, vocab] via the pp-staged decoder.
+    """tokens [B, S] -> logits [B, S, vocab] via the pp-staged dense
+    decoder.
 
     ``params`` must be the stacked tree (:func:`stack_layers`), placed with
     :func:`llama_pp_param_specs`.
     """
-    B, S = tokens.shape
-    check_pp_divisibility(cfg, mesh, B, n_micro)
-    positions = jnp.arange(S, dtype=jnp.int32)
-    cos, sin = llama.rope_freqs(cfg, positions)
-    x = params["embed"][tokens]
-    xm = x.reshape(n_micro, B // n_micro, S, cfg.dim)
-
-    body = jax.shard_map(
-        partial(_pipeline_body, cfg=cfg, n_micro=n_micro),
-        mesh=mesh,
-        in_specs=(P("pp"), P(), P(), P()),
-        out_specs=P(),
-        axis_names={"pp"},
+    logits, _ = _pipelined_backbone(
+        params, tokens, cfg, mesh, n_micro, _llama_stage
     )
-    hm = body(params["layers"], xm, cos, sin)
-    h = hm.reshape(B, S, cfg.dim)
-    h = llama.rms_norm(h, params["final_norm"], cfg.norm_eps)
-    return (h @ params["lm_head"]).astype(jnp.float32)
+    return logits
+
+
+def mixtral_pipelined_forward(
+    params: dict, tokens: jax.Array, cfg, mesh: Mesh, n_micro: int,
+) -> tuple[jax.Array, jax.Array]:
+    """MoE variant: returns (logits, total router aux loss).
+
+    Microbatching semantics (standard for pipelined MoE): the router's
+    load-balance aux statistics AND expert capacity contention are per
+    microbatch (mB*S tokens) rather than per batch — tokens only compete
+    for an expert's capacity within their own microbatch. Logits match the
+    non-pipelined model exactly when no token is dropped; under capacity
+    pressure the drop pattern legitimately differs.
+
+    The aux term is the MEAN over microbatches: route_topk's aux is a
+    scale-invariant mean statistic (E·Σ f·p over each layer's tokens), so
+    summing the per-microbatch values would inflate it n_micro× relative
+    to the non-pipelined objective — and make the purely-performance
+    --microbatches knob silently change the training objective."""
+    logits, aux_sum = _pipelined_backbone(
+        params, tokens, cfg, mesh, n_micro, _mixtral_stage
+    )
+    return logits, aux_sum / n_micro
 
 
 def pipelined_loss_fn(
@@ -194,13 +274,29 @@ def pipelined_loss_fn(
     """Drop-in for ``build_train_step(loss_fn=...)``: same next-token cross
     entropy as llama.loss_fn, forward replaced by the pipeline."""
     logits = pipelined_forward(params, tokens[:, :-1], cfg, mesh, n_micro)
+    return _next_token_nll(logits, tokens)
+
+
+def mixtral_pipelined_loss_fn(
+    params: dict, tokens: jax.Array, cfg, *, mesh: Mesh, n_micro: int,
+) -> jax.Array:
+    """MoE counterpart of mixtral.loss_fn over the pipeline: cross entropy
+    plus the router load-balance aux term."""
+    logits, aux = mixtral_pipelined_forward(
+        params, tokens[:, :-1], cfg, mesh, n_micro
+    )
+    return _next_token_nll(logits, tokens) + cfg.router_aux_weight * aux
+
+
+def _next_token_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
 
 
-def make_pipelined_loss(mesh: Mesh, n_micro: int):
+def make_pipelined_loss(mesh: Mesh, n_micro: int, model: str = "llama"):
     """Bind mesh/microbatching so the result has the (params, tokens, cfg)
     signature build_train_step expects."""
-    return partial(pipelined_loss_fn, mesh=mesh, n_micro=n_micro)
+    fn = pipelined_loss_fn if model == "llama" else mixtral_pipelined_loss_fn
+    return partial(fn, mesh=mesh, n_micro=n_micro)

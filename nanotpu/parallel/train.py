@@ -238,8 +238,6 @@ def main(argv: list[str] | None = None) -> int:
     key = (args.model, args.preset)
     if key not in _PRESETS:
         parser.error(f"no preset {key}; have {sorted(_PRESETS)}")
-    if args.pp > 1 and args.model != "llama":
-        parser.error("--pp pipelines the dense llama stack only")
     if args.pp > 1 and args.sp > 1:
         # ring attention's sp shard_map cannot nest inside the pipeline's
         # pp-manual region (sdy rejects re-binding the parent's axes);
@@ -320,15 +318,18 @@ def main(argv: list[str] | None = None) -> int:
             check_pp_divisibility,
             llama_pp_param_specs,
             make_pipelined_loss,
+            mixtral_pp_param_specs,
             stack_layers,
         )
 
         check_pp_divisibility(cfg, mesh, batch, n_micro)
         # init the stacked tree directly so optimizer moments are built
         # once, for the layout that will actually train
-        init = lambda rng, c: stack_layers(_llama_init(rng, c))  # noqa: E731
-        specs = llama_pp_param_specs(cfg)
-        loss = make_pipelined_loss(mesh, n_micro)
+        base_init = init or _llama_init  # init is the MoE initializer for mixtral
+        init = lambda rng, c: stack_layers(base_init(rng, c))  # noqa: E731
+        specs = (llama_pp_param_specs(cfg) if args.model == "llama"
+                 else mixtral_pp_param_specs(cfg))
+        loss = make_pipelined_loss(mesh, n_micro, model=args.model)
     state = init_train_state(jax.random.PRNGKey(args.seed), cfg, optimizer, init_fn=init)
     state = place_state(state, cfg, mesh, param_specs=specs)
     if args.checkpoint_dir:

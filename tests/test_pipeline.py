@@ -111,6 +111,74 @@ def test_full_train_step_under_pp(params):
     assert not np.allclose(before, np.asarray(state.params["lm_head"]))
 
 
+def _moe_cfg():
+    from nanotpu.models.mixtral import MixtralConfig
+
+    # capacity_factor 4.0 = E/k * 2: no token is ever dropped, in either
+    # batching — capacity CONTENTION is the one cross-token coupling in
+    # routed MoE, so drop-free configs are the only ones where microbatched
+    # and full-batch forwards agree exactly
+    return MixtralConfig(
+        vocab_size=128, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+        ffn_dim=48, n_experts=4, top_k=2, capacity_factor=4.0,
+        max_seq_len=64, dtype="float32",
+    )
+
+
+def test_mixtral_pipelined_forward_matches_plain():
+    """MoE pipeline logits are exactly the plain model's; aux differs only
+    by microbatching (per-microbatch load-balance statistics)."""
+    from nanotpu.models import mixtral
+    from nanotpu.parallel.pipeline import mixtral_pipelined_forward
+
+    cfg = _moe_cfg()
+    params = mixtral.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size)
+    want_logits, want_aux = mixtral.forward(params, tokens, cfg)
+
+    mesh = make_mesh(dp=2, pp=2, ep=2)
+    got_logits, got_aux = mixtral_pipelined_forward(
+        stack_layers(params), tokens, cfg, mesh, n_micro=4
+    )
+    np.testing.assert_allclose(np.asarray(got_logits), np.asarray(want_logits),
+                               rtol=1e-4, atol=1e-4)
+    # aux is averaged over microbatches (mean statistic): it approximates
+    # the full-batch value — NOT n_micro x it, which would mean the
+    # --microbatches perf knob changes the training objective
+    assert float(got_aux) == pytest.approx(float(want_aux), rel=0.35)
+
+
+def test_mixtral_pp_ep_train_step():
+    """One pipelined MoE train step over (dp, pp, ep): pp and ep compose."""
+    from nanotpu.models import mixtral
+    from nanotpu.parallel.pipeline import (
+        make_pipelined_loss,
+        mixtral_pp_param_specs,
+    )
+
+    cfg = _moe_cfg()
+    mesh = make_mesh(dp=2, pp=2, ep=2)
+    specs = mixtral_pp_param_specs(cfg)
+    opt = train_lib.make_optimizer()
+    state = train_lib.init_train_state(
+        jax.random.PRNGKey(0), cfg, opt,
+        init_fn=lambda r, c: stack_layers(mixtral.init_params(r, c)),
+    )
+    state = train_lib.place_state(state, cfg, mesh, param_specs=specs)
+    step = train_lib.build_train_step(
+        cfg, mesh, opt,
+        loss_fn=make_pipelined_loss(mesh, n_micro=4, model="mixtral"),
+        param_specs=specs,
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (8, 17), 0, cfg.vocab_size)
+    before = np.asarray(state.params["layers"]["moe"]["w_gate"][0])
+    state, loss = step(state, tokens)
+    assert jnp.isfinite(loss)
+    assert not np.allclose(
+        before, np.asarray(state.params["layers"]["moe"]["w_gate"][0])
+    )
+
+
 def test_divisibility_errors():
     mesh = make_mesh(pp=4, dp=2)
     with pytest.raises(ValueError, match="n_layers"):
