@@ -88,10 +88,17 @@ class Dealer:
         rater: Rater,
         usage: UsageStore | None = None,
         assume_workers: int = 8,
+        recorder: "EventRecorder | None" = None,
     ):
+        from nanotpu.k8s.events import EventRecorder
+
         self.client = client
         self.rater = rater
         self.usage = usage or UsageStore()
+        # K8s Events on bind outcomes — the reference built a recorder and
+        # never emitted (controller.go:78-81, SURVEY §5); here `kubectl
+        # describe pod` shows the placement decision
+        self.recorder = recorder or EventRecorder(client)
         self._lock = threading.RLock()  # guards the maps below only
         self._nodes: dict[str, NodeInfo] = {}
         self._non_tpu: set[str] = set()  # negative cache for _node_info
@@ -295,7 +302,28 @@ class Dealer:
     # -- Bind verb: dealer.go:155-203 --------------------------------------
     def bind(self, node_name: str, pod: Pod) -> Pod:
         """Apply the plan, write annotations (optimistic retry), post the
-        binding. Raises BindError with accounting rolled back on failure."""
+        binding. Raises BindError with accounting rolled back on failure.
+        Emits a K8s Event either way (TPUAssigned / FailedBinding)."""
+        from nanotpu.k8s import events
+
+        try:
+            bound = self._bind(node_name, pod)
+        except BindError as e:
+            self.recorder.event(
+                pod, "Warning", events.REASON_FAILED_BINDING, str(e)
+            )
+            raise
+        chips = podutil.get_assigned_chips(bound) or {}
+        placed = ", ".join(
+            f"{c}->[{','.join(map(str, ids))}]" for c, ids in chips.items() if ids
+        )
+        self.recorder.event(
+            bound, "Normal", events.REASON_ASSIGNED,
+            f"bound to {node_name} ({placed}; policy {self.rater.name})",
+        )
+        return bound
+
+    def _bind(self, node_name: str, pod: Pod) -> Pod:
         info = self._node_info(node_name)
         if info is None:
             raise BindError(f"node {node_name} is not a known TPU node")

@@ -70,6 +70,10 @@ class Clientset(Protocol):
 
     def watch_nodes(self) -> "Watch": ...
 
+    def create_event(self, namespace: str, event: dict) -> None: ...
+
+    def update_event(self, namespace: str, name: str, event: dict) -> None: ...
+
 
 class Watch:
     """A watch stream: blocking iterator of WatchEvents with a stop()."""
@@ -121,9 +125,12 @@ class FakeClientset:
         self._node_watches: list[Watch] = []
         #: (namespace, name, node) tuples recorded by bind_pod
         self.bindings: list[tuple[str, str, str]] = []
+        #: v1 Events posted by create_event (newest last)
+        self.events: list[dict] = []
         #: fault injection hooks: callables raising to simulate API failures
         self.before_update_pod: Callable[[Pod], None] | None = None
         self.before_bind: Callable[[str, str, str], None] | None = None
+        self.before_create_event: Callable[[dict], None] | None = None
 
     # -- helpers -----------------------------------------------------------
     def _bump(self, raw: dict) -> dict:
@@ -247,6 +254,24 @@ class FakeClientset:
                 raise NotFoundError(f"node {name} not found")
             raw = self._nodes.pop(name)
             self._notify(self._node_watches, WatchEvent("DELETED", Node(raw)))
+
+    # -- events ------------------------------------------------------------
+    def create_event(self, namespace: str, event: dict) -> None:
+        if self.before_create_event:
+            self.before_create_event(event)
+        with self._lock:
+            self.events.append(plain_copy(event))
+
+    def update_event(self, namespace: str, name: str, event: dict) -> None:
+        """Replace an existing event object in place (aggregated count
+        bumps PUT the same object rather than creating a new one)."""
+        with self._lock:
+            for i, ev in enumerate(self.events):
+                meta = ev.get("metadata") or {}
+                if meta.get("name") == name and meta.get("namespace") == namespace:
+                    self.events[i] = plain_copy(event)
+                    return
+            raise NotFoundError(f"event {namespace}/{name} not found")
 
     # -- watches -----------------------------------------------------------
     def watch_pods(self) -> Watch:

@@ -1,0 +1,99 @@
+"""Kubernetes Event emission for scheduling decisions.
+
+The reference constructs an EventRecorder and never emits a single event
+with it (``pkg/controller/controller.go:78-81`` — SURVEY §5 "an
+EventRecorder is built but no events are ever emitted"): operators
+debugging placement got only pod logs. Here events are first-class —
+`kubectl describe pod` shows why a pod landed where it did (node, chip
+ids, policy) or why binding failed.
+
+Emission must never break scheduling: API failures are swallowed and
+logged. Repeats of the same (object, reason, message) are aggregated the
+way client-go's correlator does it: the FIRST occurrence creates the
+Event object, every repeat PUTs the SAME object back with ``count``
+bumped and ``lastTimestamp`` advanced — a retry storm costs one etcd
+object, not N. The aggregation cache is LRU-bounded (client-go uses 4096
+keys too) so a long-running scheduler cannot leak memory through it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+
+from nanotpu.k8s.client import ApiError
+from nanotpu.k8s.objects import Pod
+
+log = logging.getLogger("nanotpu.k8s.events")
+
+COMPONENT = "nanotpu-scheduler"
+
+# reasons, in kubectl-conventional CamelCase
+REASON_ASSIGNED = "TPUAssigned"
+REASON_FAILED_BINDING = "FailedBinding"
+
+#: Aggregation keys kept (client-go's EventAggregator LRU size).
+AGGREGATE_KEYS_MAX = 4096
+
+
+class EventRecorder:
+    """Posts v1 core Events through the clientset, with update-in-place
+    count aggregation. Thread-safe; never raises."""
+
+    def __init__(self, client, component: str = COMPONENT):
+        self.client = client
+        self.component = component
+        self._lock = threading.Lock()
+        # key -> (event name, count, firstTimestamp), LRU-ordered
+        self._entries: OrderedDict[tuple, tuple[str, int, str]] = OrderedDict()
+        self._seq = 0
+
+    def event(self, pod: Pod, etype: str, reason: str, message: str) -> None:
+        """etype is "Normal" or "Warning" (v1 Event.type)."""
+        key = (pod.uid, reason, message)
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self._seq += 1
+                name = f"{pod.name}.{self._seq:x}.{int(time.time() * 1e3):x}"
+                count, first = 1, now
+            else:
+                name, count, first = entry[0], entry[1] + 1, entry[2]
+            self._entries[key] = (name, count, first)
+            self._entries.move_to_end(key)
+            while len(self._entries) > AGGREGATE_KEYS_MAX:
+                self._entries.popitem(last=False)
+        body = {
+            "metadata": {"name": name, "namespace": pod.namespace},
+            "involvedObject": {
+                "kind": "Pod",
+                "namespace": pod.namespace,
+                "name": pod.name,
+                "uid": pod.uid,
+            },
+            "reason": reason,
+            "message": message,
+            "type": etype,
+            "count": count,
+            "firstTimestamp": first,
+            "lastTimestamp": now,
+            "source": {"component": self.component},
+            "reportingComponent": self.component,
+        }
+        try:
+            if count == 1:
+                self.client.create_event(pod.namespace, body)
+            else:
+                try:
+                    self.client.update_event(pod.namespace, name, body)
+                except ApiError:
+                    # the original object may be gone (event TTL/GC) —
+                    # recreate rather than lose the signal
+                    self.client.create_event(pod.namespace, body)
+        except ApiError as e:
+            log.warning("event %s/%s dropped: %s", reason, pod.key(), e)
+        except Exception:  # pragma: no cover - never let events kill a verb
+            log.exception("event %s/%s dropped", reason, pod.key())

@@ -146,6 +146,21 @@ class RestClientset:
             },
         )
 
+    # -- events ------------------------------------------------------------
+    def create_event(self, namespace: str, event: dict) -> None:
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/events",
+            {"apiVersion": "v1", "kind": "Event", **event},
+        )
+
+    def update_event(self, namespace: str, name: str, event: dict) -> None:
+        self._request(
+            "PUT",
+            f"/api/v1/namespaces/{namespace}/events/{name}",
+            {"apiVersion": "v1", "kind": "Event", **event},
+        )
+
     # -- nodes -------------------------------------------------------------
     def get_node(self, name: str) -> Node:
         return Node(self._request("GET", f"/api/v1/nodes/{name}"))
