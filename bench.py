@@ -12,7 +12,6 @@ Prints ONE JSON line.
 
 from __future__ import annotations
 
-import http.client
 import json
 import socket
 import statistics
@@ -33,17 +32,52 @@ POD_PERCENT = 200  # 2 whole chips per pod -> 64 chips total
 OCCUPANCY_TARGET = 95.0
 
 
-def post(conn: http.client.HTTPConnection, path: str, payload) -> dict | list:
-    # persistent HTTP/1.1 connection — kube-scheduler's Go client reuses
-    # connections, so the benchmark should too
-    conn.request(
-        "POST",
-        path,
-        body=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"},
-    )
-    resp = conn.getresponse()
-    return json.loads(resp.read())
+class HttpClient:
+    """Raw-socket HTTP/1.1 keep-alive client. kube-scheduler's Go client
+    costs microseconds per request; Python's http.client costs hundreds —
+    using it would make the benchmark measure the CLIENT, not the
+    scheduler. Real request/response bytes still cross a real TCP socket."""
+
+    def __init__(self, host: str, port: int):
+        self.sock = socket.create_connection((host, port))
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.buf = b""
+
+    def _read_until(self, sep: bytes) -> bytes:
+        while sep not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("server closed")
+            self.buf += chunk
+        head, self.buf = self.buf.split(sep, 1)
+        return head
+
+    def post(self, path: str, payload) -> dict | list:
+        body = json.dumps(payload).encode()
+        self.sock.sendall(
+            (
+                f"POST {path} HTTP/1.1\r\nHost: x\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n"
+            ).encode()
+            + body
+        )
+        head = self._read_until(b"\r\n\r\n")
+        length = 0
+        for line in head.split(b"\r\n")[1:]:
+            k, _, v = line.partition(b":")
+            if k.strip().lower() == b"content-length":
+                length = int(v.strip())
+        while len(self.buf) < length:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("server closed mid-body")
+            self.buf += chunk
+        data, self.buf = self.buf[:length], self.buf[length:]
+        return json.loads(data)
+
+    def close(self) -> None:
+        self.sock.close()
 
 
 def run_once() -> tuple[list[float], float, int, float]:
@@ -52,9 +86,7 @@ def run_once() -> tuple[list[float], float, int, float]:
     dealer = Dealer(client, make_rater("binpack"))
     api = SchedulerAPI(dealer, Registry())
     server = serve(api, 0, host="127.0.0.1")
-    conn = http.client.HTTPConnection("127.0.0.1", server.server_address[1])
-    conn.connect()
-    conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    conn = HttpClient("127.0.0.1", server.server_address[1])
     node_names = [f"v5p-host-{i}" for i in range(N_HOSTS)]
 
     cycle_latencies: list[float] = []
@@ -78,8 +110,8 @@ def run_once() -> tuple[list[float], float, int, float]:
         )
         args = {"Pod": pod.raw, "NodeNames": node_names}
         t0 = time.perf_counter()
-        filt = post(conn, "/scheduler/filter", args)
-        prio = post(conn, "/scheduler/priorities", args)
+        filt = conn.post("/scheduler/filter", args)
+        prio = conn.post("/scheduler/priorities", args)
         feasible = set(filt["NodeNames"])
         ranked = sorted(
             (p for p in prio if p["Host"] in feasible),
@@ -87,8 +119,7 @@ def run_once() -> tuple[list[float], float, int, float]:
         )
         result = {"Error": "no feasible node"}
         for choice in ranked:
-            result = post(
-                conn,
+            result = conn.post(
                 "/scheduler/bind",
                 {
                     "PodName": name,
@@ -104,6 +135,7 @@ def run_once() -> tuple[list[float], float, int, float]:
             bound += 1
     elapsed = time.perf_counter() - started
     occupancy = dealer.occupancy() * 100
+    conn.close()
     server.shutdown()
     return cycle_latencies, elapsed, bound, occupancy
 

@@ -32,7 +32,9 @@ from nanotpu.allocator.rater import Rater
 from nanotpu.dealer.gang import GangTracker, gang_affinity_bonus
 from nanotpu.dealer.nodeinfo import NodeInfo
 from nanotpu.dealer.usage import UsageStore
+from nanotpu.k8s import events
 from nanotpu.k8s.client import ApiError, Clientset, ConflictError, NotFoundError
+from nanotpu.k8s.events import EventRecorder
 from nanotpu.k8s.objects import Node, Pod
 from nanotpu.utils import node as nodeutil
 from nanotpu.utils import pod as podutil
@@ -88,10 +90,8 @@ class Dealer:
         rater: Rater,
         usage: UsageStore | None = None,
         assume_workers: int = 8,
-        recorder: "EventRecorder | None" = None,
+        recorder: EventRecorder | None = None,
     ):
-        from nanotpu.k8s.events import EventRecorder
-
         self.client = client
         self.rater = rater
         self.usage = usage or UsageStore()
@@ -304,8 +304,6 @@ class Dealer:
         """Apply the plan, write annotations (optimistic retry), post the
         binding. Raises BindError with accounting rolled back on failure.
         Emits a K8s Event either way (TPUAssigned / FailedBinding)."""
-        from nanotpu.k8s import events
-
         try:
             bound = self._bind(node_name, pod)
         except BindError as e:

@@ -7,18 +7,23 @@ debugging placement got only pod logs. Here events are first-class —
 `kubectl describe pod` shows why a pod landed where it did (node, chip
 ids, policy) or why binding failed.
 
-Emission must never break scheduling: API failures are swallowed and
-logged. Repeats of the same (object, reason, message) are aggregated the
-way client-go's correlator does it: the FIRST occurrence creates the
-Event object, every repeat PUTs the SAME object back with ``count``
-bumped and ``lastTimestamp`` advanced — a retry storm costs one etcd
-object, not N. The aggregation cache is LRU-bounded (client-go uses 4096
-keys too) so a long-running scheduler cannot leak memory through it.
+Emission must never break OR SLOW scheduling: ``event()`` only enqueues —
+a daemon thread does the API writes (client-go's broadcaster works the
+same way; a hung /events endpoint must not stall the bind hot path), the
+queue is bounded (overflow drops the event with a log line), and API
+failures are swallowed and logged. Repeats of the same (object, reason,
+message) are aggregated the way client-go's correlator does it: the FIRST
+occurrence creates the Event object, every repeat PUTs the SAME object
+back with ``count`` bumped and ``lastTimestamp`` advanced — a retry storm
+costs one etcd object, not N. The aggregation cache is LRU-bounded
+(client-go uses 4096 keys too) so a long-running scheduler cannot leak
+memory through it.
 """
 
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
 from collections import OrderedDict
@@ -37,10 +42,14 @@ REASON_FAILED_BINDING = "FailedBinding"
 #: Aggregation keys kept (client-go's EventAggregator LRU size).
 AGGREGATE_KEYS_MAX = 4096
 
+#: Pending emissions held while the API is slow; beyond this, drop.
+QUEUE_MAX = 1024
+
 
 class EventRecorder:
-    """Posts v1 core Events through the clientset, with update-in-place
-    count aggregation. Thread-safe; never raises."""
+    """Posts v1 core Events through the clientset from a background
+    thread, with update-in-place count aggregation. Thread-safe; never
+    raises; never blocks the caller on the API."""
 
     def __init__(self, client, component: str = COMPONENT):
         self.client = client
@@ -49,9 +58,15 @@ class EventRecorder:
         # key -> (event name, count, firstTimestamp), LRU-ordered
         self._entries: OrderedDict[tuple, tuple[str, int, str]] = OrderedDict()
         self._seq = 0
+        self._q: "queue.Queue" = queue.Queue(maxsize=QUEUE_MAX)
+        self._worker = threading.Thread(
+            target=self._drain, daemon=True, name="events"
+        )
+        self._worker.start()
 
     def event(self, pod: Pod, etype: str, reason: str, message: str) -> None:
-        """etype is "Normal" or "Warning" (v1 Event.type)."""
+        """etype is "Normal" or "Warning" (v1 Event.type). Non-blocking:
+        aggregation bookkeeping happens here, the API write on the worker."""
         key = (pod.uid, reason, message)
         now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         with self._lock:
@@ -84,16 +99,38 @@ class EventRecorder:
             "reportingComponent": self.component,
         }
         try:
-            if count == 1:
-                self.client.create_event(pod.namespace, body)
-            else:
-                try:
-                    self.client.update_event(pod.namespace, name, body)
-                except ApiError:
-                    # the original object may be gone (event TTL/GC) —
-                    # recreate rather than lose the signal
-                    self.client.create_event(pod.namespace, body)
-        except ApiError as e:
-            log.warning("event %s/%s dropped: %s", reason, pod.key(), e)
-        except Exception:  # pragma: no cover - never let events kill a verb
-            log.exception("event %s/%s dropped", reason, pod.key())
+            self._q.put_nowait((pod.namespace, name, count, body))
+        except queue.Full:
+            log.warning("event queue full; dropped %s for %s", reason, pod.key())
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until everything enqueued so far has been posted (tests,
+        shutdown). Returns False on timeout."""
+        done = threading.Event()
+        try:
+            self._q.put_nowait(done)
+        except queue.Full:
+            return False
+        return done.wait(timeout)
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if isinstance(item, threading.Event):  # flush marker
+                item.set()
+                continue
+            namespace, name, count, body = item
+            try:
+                if count == 1:
+                    self.client.create_event(namespace, body)
+                else:
+                    try:
+                        self.client.update_event(namespace, name, body)
+                    except ApiError:
+                        # the original object may be gone (event TTL/GC) —
+                        # recreate rather than lose the signal
+                        self.client.create_event(namespace, body)
+            except ApiError as e:
+                log.warning("event %s dropped: %s", name, e)
+            except Exception:  # pragma: no cover - worker must never die
+                log.exception("event %s dropped", name)

@@ -46,6 +46,7 @@ def test_bind_success_emits_assigned_event():
     pod = _pod(client)
     dealer.assume(["tpu-node-0"], pod)
     bound = dealer.bind("tpu-node-0", pod)
+    assert dealer.recorder.flush()  # emission is async; wait for the worker
 
     ev = [e for e in client.events if e["reason"] == REASON_ASSIGNED]
     assert len(ev) == 1
@@ -65,6 +66,7 @@ def test_bind_failure_emits_warning():
     pod = _pod(client, percent=800)  # node only has 400
     with pytest.raises(BindError):
         dealer.bind("tpu-node-0", pod)
+    assert dealer.recorder.flush()
     ev = [e for e in client.events if e["reason"] == REASON_FAILED_BINDING]
     assert len(ev) == 1
     assert ev[0]["type"] == "Warning"
@@ -80,6 +82,7 @@ def test_repeat_events_aggregate_in_place():
     for _ in range(3):
         with pytest.raises(BindError):
             dealer.bind("tpu-node-0", pod)
+    assert dealer.recorder.flush()
     failed = [e for e in client.events if e["reason"] == REASON_FAILED_BINDING]
     assert len(failed) == 1
     assert failed[0]["count"] == 3
@@ -92,8 +95,10 @@ def test_aggregation_recreates_after_event_gc():
     rec = EventRecorder(client)
     pod = _pod(client)
     rec.event(pod, "Warning", "X", "same message")
+    assert rec.flush()
     client.events.clear()  # simulate apiserver event TTL expiry
     rec.event(pod, "Warning", "X", "same message")
+    assert rec.flush()
     assert len(client.events) == 1
     assert client.events[0]["count"] == 2
 
@@ -121,6 +126,7 @@ def test_event_api_failure_never_breaks_bind():
     dealer.assume(["tpu-node-0"], pod)
     bound = dealer.bind("tpu-node-0", pod)  # must not raise
     assert bound.raw["spec"]["nodeName"] == "tpu-node-0"
+    assert dealer.recorder.flush()
     assert client.events == []
 
 
@@ -130,5 +136,6 @@ def test_distinct_messages_get_distinct_objects():
     pod = _pod(client)
     rec.event(pod, "Normal", "X", "message one")
     rec.event(pod, "Normal", "X", "message two")
+    assert rec.flush()
     names = [e["metadata"]["name"] for e in client.events]
     assert len(client.events) == 2 and len(set(names)) == 2

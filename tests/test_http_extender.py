@@ -127,6 +127,62 @@ class TestMalformedInput:
 
 
 class TestOperationalEndpoints:
+    def test_malformed_content_length_rejected(self, app):
+        """Negative or absurd Content-Length must 400 immediately, not park
+        the handler thread waiting for bytes that never arrive."""
+        import socket
+
+        client, dealer, api, base = app
+        host, port = base.replace("http://", "").split(":")
+        for bad in ("-1", "-5", str(64 * 1024 * 1024 * 1024), "banana"):
+            with socket.create_connection((host, int(port)), timeout=5) as s:
+                s.sendall(
+                    (
+                        "POST /scheduler/filter HTTP/1.1\r\nHost: x\r\n"
+                        f"Content-Length: {bad}\r\n\r\n"
+                    ).encode()
+                )
+                resp = s.recv(65536)
+                assert b"400" in resp.split(b"\r\n", 1)[0], (bad, resp)
+
+    def test_chunked_framing_rejected_explicitly(self, app):
+        """Transfer-Encoding: chunked is not implemented — it must 411
+        rather than dispatch an empty body and desync on the chunk bytes."""
+        import socket
+
+        client, dealer, api, base = app
+        host, port = base.replace("http://", "").split(":")
+        with socket.create_connection((host, int(port)), timeout=5) as s:
+            s.sendall(
+                b"POST /scheduler/filter HTTP/1.1\r\nHost: x\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+            )
+            resp = s.recv(65536)
+            assert b"411" in resp.split(b"\r\n", 1)[0]
+
+    def test_header_count_bounded(self, app):
+        import socket
+
+        client, dealer, api, base = app
+        host, port = base.replace("http://", "").split(":")
+        with socket.create_connection((host, int(port)), timeout=5) as s:
+            headers = "".join(f"X-H{i}: v\r\n" for i in range(200))
+            s.sendall(
+                (f"GET /healthz HTTP/1.1\r\nHost: x\r\n{headers}\r\n").encode()
+            )
+            resp = s.recv(65536)
+            assert b"400" in resp.split(b"\r\n", 1)[0]
+
+    def test_malformed_request_line_rejected(self, app):
+        import socket
+
+        client, dealer, api, base = app
+        host, port = base.replace("http://", "").split(":")
+        with socket.create_connection((host, int(port)), timeout=5) as s:
+            s.sendall(b"NOT-HTTP\r\n\r\n")
+            resp = s.recv(65536)
+            assert b"400" in resp.split(b"\r\n", 1)[0]
+
     def test_version_health_status(self, app):
         _, _, _, base = app
         code, body = get(base, "/version")
