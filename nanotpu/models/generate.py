@@ -150,15 +150,41 @@ def decode_step(params, token: jax.Array, cfg: LlamaConfig, cache: KVCache):
     return _run(params, token[:, None], cfg, cache)
 
 
+def apply_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Mask all but each row's k highest logits to -inf. Static-shaped:
+    lax.top_k gives the kth value, a compare gives the mask."""
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]  # [B, 1]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def apply_top_p(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest set of tokens whose cumulative
+    probability reaches p (the top token always survives). Static-shaped:
+    one sort + exclusive cumsum, then a threshold compare on the original
+    layout — no gather/scatter."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # desc
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum_before = jnp.cumsum(probs, axis=-1) - probs  # exclusive cumsum
+    # the top token is kept unconditionally (cum_before < p alone would
+    # mask EVERYTHING for p <= 0, degrading to uniform-random sampling)
+    keep = (cum_before < p) | (jnp.arange(logits.shape[-1]) == 0)
+    # lowest kept logit per row is the admission threshold
+    threshold = jnp.min(
+        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < threshold, NEG_INF, logits)
+
+
 def generate(
     params, prompt: jax.Array, cfg: LlamaConfig, max_new_tokens: int,
-    temperature: float = 0.0, rng: jax.Array | None = None,
-    max_len: int | None = None,
+    temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+    rng: jax.Array | None = None, max_len: int | None = None,
 ) -> jax.Array:
-    """Greedy (temperature=0) or sampled generation.
+    """Greedy (temperature=0) or sampled generation, with optional top-k
+    and/or nucleus (top-p) filtering when temperature > 0.
 
     prompt [B, S] -> generated tokens [B, max_new_tokens]. Jit-friendly:
-    call under ``jax.jit`` with static cfg/max_new_tokens.
+    call under ``jax.jit`` with static cfg/max_new_tokens/top_k/top_p.
     """
     B, S = prompt.shape
     max_len = max_len or min(cfg.max_seq_len, S + max_new_tokens)
@@ -173,9 +199,13 @@ def generate(
     def sample(logits, key):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / temperature, axis=-1).astype(
-            jnp.int32
-        )
+        logits = logits / temperature
+        # filter order follows the common convention: k first, then p
+        if top_k:
+            logits = apply_top_k(logits, top_k)
+        if top_p < 1.0:
+            logits = apply_top_p(logits, top_p)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     first = sample(logits, first_key)
 

@@ -110,3 +110,55 @@ def test_mixtral_decode_matches_forward():
         full, _aux = mixtral.forward(params, seq, cfg)
         logits, cache = gen.decode_step(params, nxt, cfg, cache)
         np.testing.assert_allclose(logits, full[:, -1], rtol=2e-4, atol=2e-4)
+
+
+def test_top_k_filter_keeps_only_k(setup):
+    """apply_top_k: samples can only come from each row's k best logits."""
+    logits = jnp.array([[5.0, 4.0, 3.0, 2.0, 1.0], [1.0, 2.0, 3.0, 4.0, 5.0]])
+    filtered = gen.apply_top_k(logits, 2)
+    assert (np.asarray(filtered[0, 2:]) <= gen.NEG_INF).all()
+    assert (np.asarray(filtered[1, :3]) <= gen.NEG_INF).all()
+    np.testing.assert_array_equal(np.asarray(filtered[0, :2]), [5.0, 4.0])
+
+
+def test_top_p_keeps_nucleus(setup):
+    """apply_top_p: smallest set reaching cumulative p survives; the top
+    token always survives even when p is tiny."""
+    # probs ~ [0.643, 0.237, 0.087, 0.032] for logits [3,2,1,0]
+    logits = jnp.array([[3.0, 2.0, 1.0, 0.0]])
+    keep_two = gen.apply_top_p(logits, 0.7)  # 0.643 alone < 0.7 -> need 2nd
+    assert np.isfinite(np.asarray(keep_two[0, :2])).all()
+    assert (np.asarray(keep_two[0, 2:]) <= gen.NEG_INF).all()
+    tiny = gen.apply_top_p(logits, 1e-9)
+    assert np.isfinite(np.asarray(tiny[0, 0]))
+    assert (np.asarray(tiny[0, 1:]) <= gen.NEG_INF).all()
+
+
+def test_top_k_1_sampling_equals_greedy(setup):
+    cfg, params = setup
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 5), 0, cfg.vocab_size)
+    greedy = gen.generate(params, prompt, cfg, 8)
+    k1 = gen.generate(
+        params, prompt, cfg, 8, temperature=1.5, top_k=1,
+        rng=jax.random.PRNGKey(9),
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_top_k_top_p_sampling_stays_in_candidate_set(setup):
+    """With top_k=3 every sampled token must be one of the 3 best by
+    logit at its step (checked against teacher-forced full forward)."""
+    cfg, params = setup
+    from nanotpu.models.llama import forward
+
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (1, 4), 0, cfg.vocab_size)
+    out = gen.generate(
+        params, prompt, cfg, 6, temperature=2.0, top_k=3, top_p=0.99,
+        rng=jax.random.PRNGKey(6),
+    )
+    seq = jnp.concatenate([prompt, out], axis=1)
+    logits = forward(params, seq[:, :-1], cfg)  # [1, S-1, V]
+    for i in range(6):
+        step_logits = np.asarray(logits[0, prompt.shape[1] - 1 + i])
+        top3 = set(np.argsort(step_logits)[-3:].tolist())
+        assert int(out[0, i]) in top3
