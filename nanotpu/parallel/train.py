@@ -169,6 +169,12 @@ _PRESETS = {
         vocab_size=512, dim=128, n_layers=2, n_heads=8, n_kv_heads=4,
         ffn_dim=256, max_seq_len=256, dtype="float32",
     ),
+    # the driver's flagship (__graft_entry__._flagship_config): ~0.5B that
+    # trains comfortably on one chip — the single-chip benchmark preset
+    ("llama", "0.5b"): dict(
+        vocab_size=32_768, dim=1024, n_layers=8, n_heads=16, n_kv_heads=4,
+        ffn_dim=4096, max_seq_len=2048, dtype="bfloat16",
+    ),
     ("llama", "8b"): dict(
         vocab_size=128_256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
         ffn_dim=14_336, max_seq_len=8192, dtype="bfloat16",
@@ -222,6 +228,13 @@ def main(argv: list[str] | None = None) -> int:
                         help=">1 pipelines llama layers over pp stages")
     parser.add_argument("--microbatches", type=int, default=0,
                         help="pipeline microbatches (0 = 2*pp)")
+    parser.add_argument("--attn", choices=["dense", "flash", "ring"],
+                        default="",
+                        help="attention impl override (flash = pallas "
+                             "kernel; ring is implied by --sp)")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize layer activations in backward "
+                             "(trades FLOPs for HBM)")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--save-every", type=int, default=100)
     parser.add_argument("--seed", type=int, default=0)
@@ -238,14 +251,26 @@ def main(argv: list[str] | None = None) -> int:
     key = (args.model, args.preset)
     if key not in _PRESETS:
         parser.error(f"no preset {key}; have {sorted(_PRESETS)}")
-    if args.pp > 1 and args.sp > 1:
+    if args.pp > 1 and (args.sp > 1 or args.attn == "ring"):
         # ring attention's sp shard_map cannot nest inside the pipeline's
         # pp-manual region (sdy rejects re-binding the parent's axes);
         # combine pp with dp/fsdp/tp instead, or sp with dp/tp
-        parser.error("--pp and --sp cannot be combined (nested shard_map)")
+        parser.error("--pp cannot combine with ring attention / --sp "
+                     "(nested shard_map)")
+    if args.sp > 1 and args.attn and args.attn != "ring":
+        parser.error(
+            f"--attn {args.attn} conflicts with --sp {args.sp}: sequence "
+            "parallelism requires the ring implementation"
+        )
     preset = dict(_PRESETS[key])
+    if args.attn:
+        preset["attn_impl"] = args.attn
     if args.sp > 1:
         preset["attn_impl"] = "ring"
+    if args.remat:
+        if args.model != "llama":
+            parser.error("--remat is wired for the dense llama stack only")
+        preset["remat"] = True
     if args.model == "llama":
         from nanotpu.models.llama import LlamaConfig
 
