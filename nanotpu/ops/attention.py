@@ -115,8 +115,16 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
 
     B, S, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
+    # clamp blocks for short sequences, but keep them TILE-ALIGNED: Mosaic
+    # requires sequence-dim blocks in sublane multiples (16 covers bf16's
+    # (16,128) tile and f32's (8,128)); min(block, S) with a ragged S like
+    # 255 fails to compile ("index ... must be a multiple of 8"). The k
+    # clamp rounds up to a multiple of block_q so the lcm-based padding
+    # below stays at max(bq, bk) — clamping bk straight to s_tile makes
+    # lcm(256, 304) = 4864, a 16x padding blowup for S just over block_q.
+    s_tile = ((S + 15) // 16) * 16
+    block_q = min(block_q, s_tile)
+    block_k = min(block_k, ((s_tile + block_q - 1) // block_q) * block_q)
     # pad the sequence to a common multiple of BOTH block sizes: the grid
     # needs block_q | S_pad, and the k-position math needs block_k | S_pad
     # (pallas clamps ragged final blocks with dynamic-slice semantics, which

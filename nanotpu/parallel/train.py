@@ -169,9 +169,9 @@ _PRESETS = {
         vocab_size=512, dim=128, n_layers=2, n_heads=8, n_kv_heads=4,
         ffn_dim=256, max_seq_len=256, dtype="float32",
     ),
-    # the driver's flagship (__graft_entry__._flagship_config): ~0.5B that
+    # the driver's flagship (__graft_entry__._flagship_config): ~0.19B that
     # trains comfortably on one chip — the single-chip benchmark preset
-    ("llama", "0.5b"): dict(
+    ("llama", "flagship"): dict(
         vocab_size=32_768, dim=1024, n_layers=8, n_heads=16, n_kv_heads=4,
         ffn_dim=4096, max_seq_len=2048, dtype="bfloat16",
     ),

@@ -28,6 +28,15 @@ class TestFlashKernel:
         )
         np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=2e-5)
 
+    def test_short_ragged_seq_keeps_tile_aligned_blocks(self):
+        # S=255 < default blocks: blocks must clamp to a tile-aligned 256,
+        # not to the ragged 255 (Mosaic rejects non-multiple-of-sublane
+        # sequence blocks on real TPU). Numerics checked in interpret mode.
+        q, k, v = qkv(jax.random.PRNGKey(8), S=255)
+        dense = _xla_attention(q, k, v, True)
+        flash = flash_attention(q, k, v, True, 256, 512, True)
+        np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=2e-5)
+
     def test_uneven_blocks(self):
         # S=96 with block 64: ragged final block both in q and k loops
         q, k, v = qkv(jax.random.PRNGKey(1), S=96)

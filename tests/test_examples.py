@@ -1,0 +1,88 @@
+"""The example Jobs in examples/ stay consistent with the code's resource
+vocabulary and gang contract (they are user-facing documentation that must
+not drift)."""
+
+from pathlib import Path
+
+import yaml
+
+from nanotpu import types
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+
+
+def _docs(name):
+    return [d for d in yaml.safe_load_all((EXAMPLES / name).read_text()) if d]
+
+
+def _jobs():
+    for path in sorted(EXAMPLES.glob("*.yaml")):
+        for doc in _docs(path.name):
+            if doc.get("kind") == "Job":
+                yield path.name, doc
+
+
+def test_examples_exist_and_parse():
+    names = sorted(p.name for p in EXAMPLES.glob("*.yaml"))
+    assert "llama3-8b-v5p16.yaml" in names
+    assert "mixtral-8x7b-v5p64.yaml" in names
+    assert "llama3-long-context-sp.yaml" in names
+    for name in names:
+        assert _docs(name), name
+
+
+def test_gang_jobs_are_internally_consistent():
+    """gang-size annotation == completions == parallelism, every TPU
+    container requests chip-percent, and the distributed-env wiring
+    (GANG_SIZE, COORDINATOR_SERVICE) matches the gang."""
+    seen = 0
+    for name, job in _jobs():
+        spec = job["spec"]
+        tmpl = spec["template"]
+        annotations = tmpl["metadata"]["annotations"]
+        if types.ANNOTATION_GANG_NAME not in annotations:
+            continue
+        seen += 1
+        size = int(annotations[types.ANNOTATION_GANG_SIZE])
+        assert spec["completions"] == size, name
+        assert spec["parallelism"] == size, name
+        containers = tmpl["spec"]["containers"]
+        assert any(
+            types.RESOURCE_TPU_PERCENT in (c.get("resources") or {}).get("limits", {})
+            for c in containers
+        ), name
+        env = {
+            e["name"]: e.get("value")
+            for c in containers
+            for e in c.get("env", [])
+        }
+        assert int(env["GANG_SIZE"]) == size, name
+        assert env["COORDINATOR_SERVICE"], name
+    assert seen >= 3  # llama3-8b, mixtral, long-context
+
+
+def test_long_context_example_sp_divides_seq():
+    (name, job), = [
+        (n, j) for n, j in _jobs() if "long-context" in n
+    ]
+    cmd = job["spec"]["template"]["spec"]["containers"][0]["command"]
+    flags = dict(
+        f.split("=", 1) for f in cmd if f.startswith("--") and "=" in f
+    )
+    sp = int(flags["--sp"])
+    seq = int(flags["--seq"])
+    # the model sees seq-1 tokens; they must split evenly over sp shards
+    assert (seq - 1) % sp == 0
+    # chips per worker x workers must cover the sp x dp mesh
+    size = int(
+        job["spec"]["template"]["metadata"]["annotations"][
+            types.ANNOTATION_GANG_SIZE
+        ]
+    )
+    percent = int(
+        job["spec"]["template"]["spec"]["containers"][0]["resources"][
+            "limits"
+        ][types.RESOURCE_TPU_PERCENT]
+    )
+    chips = size * percent // types.PERCENT_PER_CHIP
+    assert chips % sp == 0

@@ -68,3 +68,35 @@ def test_restore_empty_dir_returns_none(tmp_path):
 def test_unknown_preset_errors():
     with pytest.raises(SystemExit):
         train_lib.main(["--model", "llama", "--preset", "nope"])
+
+
+class TestFlagValidation:
+    """The readable parser.error paths for invalid parallelism combos —
+    without these the same mistakes die deep inside shard_map/XLA."""
+
+    def _run(self, *argv):
+        from nanotpu.parallel.train import main
+
+        with pytest.raises(SystemExit):
+            main(["--model", "llama", "--preset", "tiny", "--steps", "1",
+                  *argv])
+
+    def test_pp_rejects_explicit_ring(self):
+        self._run("--pp", "2", "--attn", "ring")
+
+    def test_pp_rejects_sp(self):
+        self._run("--pp", "2", "--sp", "2")
+
+    def test_sp_rejects_contradictory_attn(self):
+        self._run("--sp", "2", "--attn", "flash", "--seq", "65")
+        self._run("--sp", "2", "--attn", "dense", "--seq", "65")
+
+    def test_remat_rejected_for_mixtral(self):
+        from nanotpu.parallel.train import main
+
+        with pytest.raises(SystemExit):
+            main(["--model", "mixtral", "--preset", "tiny", "--steps", "1",
+                  "--remat"])
+
+    def test_seq_too_short_for_sp(self):
+        self._run("--sp", "8", "--seq", "5")
