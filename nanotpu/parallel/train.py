@@ -235,6 +235,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize layer activations in backward "
                              "(trades FLOPs for HBM)")
+    parser.add_argument("--profile-dir", default="",
+                        help="capture a jax.profiler trace of the steady-"
+                             "state steps (view with tensorboard/xprof)")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--save-every", type=int, default=100)
     parser.add_argument("--seed", type=int, default=0)
@@ -367,17 +370,29 @@ def main(argv: list[str] | None = None) -> int:
     rng = jax.random.PRNGKey(args.seed + 1)
     t0 = time.perf_counter()
     start_step = int(jax.device_get(state.step))
-    for i in range(start_step, start_step + args.steps):
-        rng, k = jax.random.split(rng)
-        tokens = jax.random.randint(k, (batch, seq), 0, cfg.vocab_size)
-        state, loss_val = step_fn(state, tokens)
-        if i == start_step:  # exclude compile from throughput
-            loss_val.block_until_ready()
-            t0 = time.perf_counter()
-        log.info("step %d loss %.4f", i + 1, float(loss_val))
-        if args.checkpoint_dir and (i + 1) % args.save_every == 0:
-            save_checkpoint(args.checkpoint_dir, state)
-    jax.block_until_ready(state.params)
+    profiling = False
+    try:
+        for i in range(start_step, start_step + args.steps):
+            rng, k = jax.random.split(rng)
+            tokens = jax.random.randint(k, (batch, seq), 0, cfg.vocab_size)
+            state, loss_val = step_fn(state, tokens)
+            if i == start_step:  # exclude compile from throughput
+                loss_val.block_until_ready()
+                t0 = time.perf_counter()
+                if args.profile_dir:
+                    # trace steady-state steps only: the compile step would
+                    # dwarf the per-step timeline the trace is for
+                    jax.profiler.start_trace(args.profile_dir)
+                    profiling = True
+            log.info("step %d loss %.4f", i + 1, float(loss_val))
+            if args.checkpoint_dir and (i + 1) % args.save_every == 0:
+                save_checkpoint(args.checkpoint_dir, state)
+        jax.block_until_ready(state.params)
+    finally:
+        # a crashed run is exactly when the trace matters — always flush it
+        if profiling:
+            jax.profiler.stop_trace()
+            log.info("profile trace written to %s", args.profile_dir)
     steady = args.steps - 1  # first step is compile, excluded from timing
     if steady > 0:
         tok_s = steady * batch * seq / max(time.perf_counter() - t0, 1e-9)

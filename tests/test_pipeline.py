@@ -62,6 +62,17 @@ def test_pipelined_forward_composes_with_tp(params, tokens):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_pipelined_forward_composes_with_fsdp(params, tokens):
+    """ZeRO-style param sharding inside the stage: the stacked layer leaves
+    carry P("pp", "fsdp", ...) so each stage's params are all-gathered by
+    XLA within the pp-manual region."""
+    mesh = make_mesh(fsdp=2, pp=2, tp=2)
+    want = llama.forward(params, tokens, CFG)
+    got = pipelined_forward(stack_layers(params), tokens, CFG, mesh, 4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_pipelined_grads_match_plain(params, tokens):
     mesh = make_mesh(pp=4, dp=2)
     loss_pp = make_pipelined_loss(mesh, n_micro=4)
