@@ -237,7 +237,9 @@ def main(argv: list[str] | None = None) -> int:
                              "(trades FLOPs for HBM)")
     parser.add_argument("--profile-dir", default="",
                         help="capture a jax.profiler trace of the steady-"
-                             "state steps (view with tensorboard/xprof)")
+                             "state steps (view with tensorboard/xprof; "
+                             "needs --steps >= 2, the compile step is "
+                             "excluded)")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--save-every", type=int, default=100)
     parser.add_argument("--seed", type=int, default=0)
@@ -379,7 +381,15 @@ def main(argv: list[str] | None = None) -> int:
             if i == start_step:  # exclude compile from throughput
                 loss_val.block_until_ready()
                 t0 = time.perf_counter()
-                if args.profile_dir:
+                if args.profile_dir and args.steps < 2:
+                    # the trace starts AFTER the compile step; with one
+                    # step there is nothing to capture — say so instead of
+                    # writing an empty timeline that claims success
+                    log.warning(
+                        "--profile-dir ignored: needs --steps >= 2 "
+                        "(the first step is compile and is excluded)"
+                    )
+                elif args.profile_dir:
                     # trace steady-state steps only: the compile step would
                     # dwarf the per-step timeline the trace is for
                     jax.profiler.start_trace(args.profile_dir)

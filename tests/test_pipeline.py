@@ -63,12 +63,23 @@ def test_pipelined_forward_composes_with_tp(params, tokens):
 
 
 def test_pipelined_forward_composes_with_fsdp(params, tokens):
-    """ZeRO-style param sharding inside the stage: the stacked layer leaves
-    carry P("pp", "fsdp", ...) so each stage's params are all-gathered by
-    XLA within the pp-manual region."""
+    """ZeRO-style param sharding inside the stage: params are PLACED with
+    P("pp", "fsdp", ...) shardings (not just passed replicated), so each
+    stage's weights really are fsdp-sharded and XLA must all-gather them
+    within the pp-manual region."""
+    from nanotpu.parallel.mesh import shardings_for
+
     mesh = make_mesh(fsdp=2, pp=2, tp=2)
     want = llama.forward(params, tokens, CFG)
-    got = pipelined_forward(stack_layers(params), tokens, CFG, mesh, 4)
+    placed = jax.device_put(
+        stack_layers(params), shardings_for(mesh, llama_pp_param_specs(CFG))
+    )
+    assert any(
+        leaf.sharding.shard_shape(leaf.shape)[1] * 2 == leaf.shape[1]
+        for leaf in jax.tree_util.tree_leaves(placed["layers"])
+        if leaf.ndim >= 2
+    ), "no layer leaf is actually fsdp-sharded on dim 1"
+    got = pipelined_forward(placed, tokens, CFG, mesh, 4)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
 

@@ -1,0 +1,186 @@
+"""RestClientset against a stub apiserver over a real socket: request
+shapes, error mapping (404/409), bearer auth, bind subresource, events,
+label selectors, and watch-stream reconnection — the production client the
+reference left entirely untested (SURVEY §4)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from nanotpu.k8s.client import ApiError, ConflictError, NotFoundError
+from nanotpu.k8s.objects import Pod, make_container, make_pod
+from nanotpu.k8s.rest import RestClientset
+
+
+class StubApiServer:
+    """Just enough of /api/v1 for the clientset: a dict of pods, a request
+    log, scripted failures, and a watch stream that ends after N events
+    (so reconnection is observable)."""
+
+    def __init__(self):
+        self.pods: dict[str, dict] = {}
+        self.events: list[dict] = []
+        self.requests: list[tuple[str, str, str]] = []  # method, path, auth
+        self.watch_batches: list[list[dict]] = []
+        self.watch_connects = 0
+        self.fail_next: tuple[int, str] | None = None
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, payload=b"{}"):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _body(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(length)) if length else {}
+
+            def _handle(self):
+                outer.requests.append(
+                    (self.command, self.path, self.headers.get("Authorization", ""))
+                )
+                if outer.fail_next:
+                    code, msg = outer.fail_next
+                    outer.fail_next = None
+                    return self._reply(code, json.dumps({"message": msg}).encode())
+                if self.path.endswith("?watch=true"):
+                    outer.watch_connects += 1
+                    batch = (
+                        outer.watch_batches.pop(0) if outer.watch_batches else []
+                    )
+                    self.send_response(200)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    for evt in batch:
+                        line = (json.dumps(evt) + "\n").encode()
+                        self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                    return
+                parts = self.path.split("?")[0].strip("/").split("/")
+                # /api/v1/namespaces/{ns}/pods/{name}[/binding]
+                if "pods" in parts and "namespaces" in parts:
+                    ns = parts[parts.index("namespaces") + 1]
+                    name = parts[parts.index("pods") + 1] if len(parts) > parts.index("pods") + 1 else ""
+                    key = f"{ns}/{name}"
+                    if parts[-1] == "binding":
+                        if key not in outer.pods:
+                            return self._reply(404, b'{"message": "no pod"}')
+                        outer.pods[key].setdefault("spec", {})["nodeName"] = (
+                            self._body()["target"]["name"]
+                        )
+                        return self._reply(201)
+                    if self.command == "GET":
+                        if key not in outer.pods:
+                            return self._reply(404, b'{"message": "no pod"}')
+                        return self._reply(200, json.dumps(outer.pods[key]).encode())
+                    if self.command == "PUT":
+                        outer.pods[key] = self._body()
+                        return self._reply(200, json.dumps(outer.pods[key]).encode())
+                if parts[-1] == "pods" and self.command == "GET":  # list
+                    return self._reply(
+                        200, json.dumps({"items": list(outer.pods.values())}).encode()
+                    )
+                if parts[-1] == "events" and self.command == "POST":
+                    outer.events.append(self._body())
+                    return self._reply(201)
+                return self._reply(404, b'{"message": "no route"}')
+
+            do_GET = do_PUT = do_POST = _handle
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def close(self):
+        self.server.shutdown()
+
+
+@pytest.fixture
+def stub():
+    s = StubApiServer()
+    yield s
+    s.close()
+
+
+def _pod_raw(name="p1"):
+    return make_pod(name, containers=[make_container("c", {})]).raw
+
+
+def test_get_put_bind_roundtrip_with_auth(stub):
+    client = RestClientset(stub.url, token="tok-123")
+    stub.pods["default/p1"] = _pod_raw()
+    pod = client.get_pod("default", "p1")
+    assert pod.name == "p1"
+    pod.raw["metadata"]["labels"] = {"x": "y"}
+    client.update_pod(pod)
+    assert stub.pods["default/p1"]["metadata"]["labels"] == {"x": "y"}
+    client.bind_pod("default", "p1", "node-7")
+    assert stub.pods["default/p1"]["spec"]["nodeName"] == "node-7"
+    assert all(auth == "Bearer tok-123" for _, _, auth in stub.requests)
+
+
+def test_error_mapping(stub):
+    client = RestClientset(stub.url)
+    with pytest.raises(NotFoundError):
+        client.get_pod("default", "missing")
+    stub.pods["default/p1"] = _pod_raw()
+    stub.fail_next = (409, "please apply your changes to the latest version")
+    with pytest.raises(ConflictError):
+        client.update_pod(Pod(_pod_raw()))
+    stub.fail_next = (500, "boom")
+    with pytest.raises(ApiError) as e:
+        client.get_pod("default", "p1")
+    assert e.value.code == 500
+
+
+def test_list_pods_label_selector_encoding(stub):
+    client = RestClientset(stub.url)
+    client.list_pods({"tpu.io/assume": "true"})
+    # '/' is legal in a query string (RFC 3986) and quote() keeps it; '='
+    # inside the value must be escaped so the selector parses
+    assert any(
+        "labelSelector=tpu.io/assume%3Dtrue" in path
+        for _, path, _ in stub.requests
+    )
+
+
+def test_create_event_posts_v1_event(stub):
+    client = RestClientset(stub.url)
+    client.create_event("default", {"reason": "TPUAssigned", "metadata": {"name": "e1"}})
+    assert stub.events and stub.events[0]["kind"] == "Event"
+    assert stub.events[0]["reason"] == "TPUAssigned"
+
+
+def test_watch_reconnects_after_stream_end(stub):
+    """The apiserver ends every watch at its request timeout; the client
+    must transparently re-establish (a dead stream would silently stop all
+    reconciliation)."""
+    stub.watch_batches = [
+        [{"type": "ADDED", "object": _pod_raw("a")}],
+        [{"type": "MODIFIED", "object": _pod_raw("a")}],
+    ]
+    client = RestClientset(stub.url)
+    watch = client.watch_pods()
+    first = watch.poll(timeout=5)
+    assert first and first.type == "ADDED" and first.obj.name == "a"
+    # stream ended after one event; the second arrives on the NEXT connect
+    second = None
+    deadline = time.time() + 10
+    while second is None and time.time() < deadline:
+        second = watch.poll(timeout=0.5)
+    assert second and second.type == "MODIFIED"
+    assert stub.watch_connects >= 2
+    watch.stop()
