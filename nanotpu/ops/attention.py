@@ -1,17 +1,26 @@
-"""Flash attention as a Pallas TPU kernel, with an XLA fallback.
+"""Flash attention as Pallas TPU kernels (forward AND backward), with an
+XLA fallback.
 
-Forward pass is a classic online-softmax blockwise kernel: grid over
-(batch, heads, q-blocks), inner ``fori_loop`` over k-blocks keeping a running
-max / denominator in VMEM scratch so the full [S, S] logits matrix never
-materializes in HBM. Block sizes honor the MXU/VPU tiling constraints
-(last dim 128; see /opt/skills/guides/pallas_guide.md §Tiling).
+Forward is a classic online-softmax blockwise kernel: grid over
+(batch*heads, q-blocks), inner ``fori_loop`` over k-blocks keeping a
+running max / denominator in VMEM so the full [S, S] logits matrix never
+materializes in HBM; it additionally emits the per-row log-sum-exp.
 
-Backward uses recomputation through the XLA path under ``jax.custom_vjp`` —
-numerically identical, O(S^2) memory only inside the fused backward matmuls
-(XLA's own attention fusion), which keeps training correct while the Pallas
-backward kernel lands later.
+Backward is the FlashAttention-2 recipe as two kernels that REBUILD the
+probabilities from the saved LSE instead of storing them:
 
-On non-TPU backends the kernel runs in interpreter mode only under tests;
+* dkv kernel — grid over k-blocks, loop over q-blocks:
+  ``p = exp(q k^T scale - lse)``, ``dv += p^T dO``,
+  ``ds = p (dO v^T - D)``, ``dk += ds^T q`` with ``D = rowsum(dO * O)``.
+* dq kernel — grid over q-blocks, loop over k-blocks: ``dq += ds k``.
+
+Memory stays O(S d) per head (q/k/v/o/lse residuals) — the previous
+XLA-recompute backward materialized the [S, S] probabilities and OOMed at
+exactly the long sequence lengths the forward kernel exists for.
+
+Block sizes honor the MXU/VPU tiling constraints (last dim 128, sequence
+blocks in sublane multiples; see /opt/skills/guides/pallas_guide.md).
+On non-TPU backends the kernels run in interpreter mode only under tests;
 production code paths fall back to the fused-XLA implementation.
 """
 
@@ -29,6 +38,9 @@ import jax.numpy as jnp
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
+#: Per-row aux vectors (lse, D) are stored [B*H, 8, S]: broadcast over 8
+#: sublanes purely to satisfy Mosaic's (8, 128) block-tiling constraint.
+LSE_SUBLANES = 8
 
 
 def _xla_attention(q, k, v, causal: bool):
@@ -43,10 +55,12 @@ def _xla_attention(q, k, v, causal: bool):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_len: int,
-                  causal: bool, scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int,
+                  seq_len: int, causal: bool, scale: float):
     """One (batch*head, q-block) program: loop over k blocks with online
-    softmax. Refs are [1, block_q, d] for q/o and [1, S, d] for k/v."""
+    softmax. Refs are [1, block_q, d] for q/o, [1, S, d] for k/v, and
+    [1, LSE_SUBLANES, block_q] for the log-sum-exp output (present only
+    when the caller needs the backward residual)."""
     from jax.experimental import pallas as pl
 
     _, block_q, d = q_ref.shape
@@ -106,12 +120,45 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_len: int,
     m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30)
     o_ref[0] = out.astype(o_ref.dtype)
+    if lse_ref is not None:
+        # lse = m + log(l); fully-masked/padded rows keep NEG_INF so the
+        # backward rebuild exp(logits - lse) can zero them via masking.
+        # Broadcast over LSE_SUBLANES: Mosaic requires the last two block
+        # dims (8, 128)-tiled, so per-row vectors ride as [.., 8, block_q]
+        lse = jnp.where(
+            l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF
+        )
+        lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :], lse_ref.shape[1:])
+
+
+def _blocks_for(S: int, block_q: int, block_k: int) -> tuple[int, int, int]:
+    """Tile-aligned block clamp + padded length (shared by fwd and bwd so
+    residual layouts always agree).
+
+    Invariants Mosaic demands on real TPU (interpret mode checks none of
+    them): sequence blocks in sublane multiples of 16, and — because the
+    lse/D aux vectors put the sequence on the LANE dim — block_q must be a
+    multiple of 128 or the full padded extent. block_k is rounded to a
+    multiple of block_q so the padding target is simply block_k.
+    """
+    s_tile = ((S + 15) // 16) * 16
+    if s_tile <= 128:
+        # one full-extent block: any smaller lane block would be rejected
+        return s_tile, s_tile, s_tile
+    block_q = ((min(block_q, s_tile) + 127) // 128) * 128
+    block_k = ((min(block_k, s_tile) + block_q - 1) // block_q) * block_q
+    blk = math.lcm(block_q, block_k)  # == block_k by construction
+    S_pad = ((S + blk - 1) // blk) * blk
+    return block_q, block_k, S_pad
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
+                   interpret: bool, need_lse: bool = False):
+    """Returns (out [B,S,H,D], lse) where lse is the sublane-broadcast
+    [B*H, LSE_SUBLANES, S_pad] f32 residual when ``need_lse`` (the
+    backward's input layout), else None — inference forwards skip the
+    extra HBM write entirely."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     B, S, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
@@ -122,16 +169,12 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     # clamp rounds up to a multiple of block_q so the lcm-based padding
     # below stays at max(bq, bk) — clamping bk straight to s_tile makes
     # lcm(256, 304) = 4864, a 16x padding blowup for S just over block_q.
-    s_tile = ((S + 15) // 16) * 16
-    block_q = min(block_q, s_tile)
-    block_k = min(block_k, ((s_tile + block_q - 1) // block_q) * block_q)
-    # pad the sequence to a common multiple of BOTH block sizes: the grid
-    # needs block_q | S_pad, and the k-position math needs block_k | S_pad
-    # (pallas clamps ragged final blocks with dynamic-slice semantics, which
-    # would shift positions); padded k positions are masked via seq_len,
-    # padded q rows sliced off
-    blk = math.lcm(block_q, block_k)
-    S_pad = ((S + blk - 1) // blk) * blk
+    # Padding goes to a common multiple of BOTH block sizes: the grid needs
+    # block_q | S_pad, the k-position math needs block_k | S_pad (pallas
+    # clamps ragged final blocks with dynamic-slice semantics, which would
+    # shift positions); padded k positions are masked via seq_len, padded q
+    # rows sliced off.
+    block_q, block_k, S_pad = _blocks_for(S, block_q, block_k)
     if S_pad != S:
         pad = [(0, 0), (0, S_pad - S), (0, 0), (0, 0)]
         q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
@@ -143,7 +186,16 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, seq_len=S, causal=causal, scale=scale
     )
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B * H, S_pad, D), q.dtype)]
+    if need_lse:
+        out_specs.append(
+            pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda b, i: (b, 0, i))
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((B * H, LSE_SUBLANES, S_pad), jnp.float32)
+        )
+    result = pl.pallas_call(
         kernel,
         grid=(B * H, S_pad // block_q),
         in_specs=[
@@ -151,12 +203,176 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, S_pad, D), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(qf, kf, vf)
+    out, lse = (result if need_lse else (result[0], None))
     out = out.reshape(B, H, S_pad, D).transpose(0, 2, 1, 3)
-    return out[:, :S] if S_pad != S else out
+    return (out[:, :S] if S_pad != S else out), lse
+
+
+def _rebuild_p(q_blk, k_blk, lse_blk, q_pos, k_pos, seq_len, causal, scale):
+    """Recompute the probability block from saved LSE. Validity masking
+    (padding + causality) zeroes rows whose lse is the NEG_INF sentinel —
+    exp(logits - NEG_INF) would overflow otherwise."""
+    logits = jax.lax.dot_general(
+        q_blk, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    valid = (k_pos < seq_len) & (q_pos < seq_len)
+    if causal:
+        valid = valid & (q_pos >= k_pos)
+    return jnp.where(valid, jnp.exp(logits - lse_blk[:, None]), 0.0)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
+                         *, block_k, seq_len, causal, scale):
+    """dq for one q block: loop over (causally relevant) k blocks.
+    ds = p * (dO v^T - D); dq = scale * ds k."""
+    from jax.experimental import pallas as pl
+
+    _, block_q, d = q_ref.shape
+    qi = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0]   # [block_q] (sublane-broadcast storage)
+    dvec = d_ref[0, 0]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(kb, acc):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        p = _rebuild_p(q, k_blk, lse, q_pos, k_pos, seq_len, causal, scale)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dvec[:, None])
+        return acc + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    num_kb = pl.cdiv(seq_len, block_k)
+    if causal:
+        num_kb = jnp.minimum(num_kb, pl.cdiv((qi + 1) * block_q, block_k))
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    dq = jax.lax.fori_loop(0, num_kb, body, acc0) * scale
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
+                          dk_ref, dv_ref, *, block_q, seq_len, causal, scale):
+    """dk/dv for one k block: loop over (causally relevant) q blocks.
+    dv = p^T dO; dk = scale * ds^T q."""
+    from jax.experimental import pallas as pl
+
+    _, block_k, d = k_ref.shape
+    ki = pl.program_id(1)
+    k_blk = k_ref[0]
+    v_blk = v_ref[0]
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :]
+        lse_blk = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        d_blk = d_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        p = _rebuild_p(q_blk, k_blk, lse_blk, q_pos, k_pos, seq_len, causal, scale)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - d_blk[:, None])
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_acc, dv_acc
+
+    num_qb = pl.cdiv(seq_len, block_q)
+    start_qb = (ki * block_k) // block_q if causal else 0
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, (zeros, zeros))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
+                    interpret):
+    """Pallas backward: returns (dq, dk, dv) shaped like q/k/v."""
+    from jax.experimental import pallas as pl
+
+    B, S, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    block_q, block_k, S_pad = _blocks_for(S, block_q, block_k)
+    if S_pad != S:
+        pad = [(0, 0), (0, S_pad - S), (0, 0), (0, 0)]
+        q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
+        out, g = jnp.pad(out, pad), jnp.pad(g, pad)
+    flat = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, S_pad, D)  # noqa: E731
+    qf, kf, vf, of, gf = flat(q), flat(k), flat(v), flat(out), flat(g)
+    # D_i = rowsum(dO * O): tiny elementwise reduce, no reason for a kernel;
+    # broadcast over sublanes like lse (Mosaic block-tiling, LSE_SUBLANES)
+    dvec = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    dvec = jnp.broadcast_to(
+        dvec[:, None, :], (B * H, LSE_SUBLANES, S_pad)
+    )
+
+    row = pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0))
+    row1 = pl.BlockSpec((1, LSE_SUBLANES, S_pad), lambda b, i: (b, 0, 0))
+    qblk = pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0))
+    qblk1 = pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda b, i: (b, 0, i))
+    kblk = pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_k=block_k, seq_len=S,
+            causal=causal, scale=scale,
+        ),
+        grid=(B * H, S_pad // block_q),
+        in_specs=[qblk, row, row, qblk, qblk1, qblk1],
+        out_specs=qblk,
+        out_shape=jax.ShapeDtypeStruct((B * H, S_pad, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, dvec)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, block_q=block_q, seq_len=S,
+            causal=causal, scale=scale,
+        ),
+        grid=(B * H, S_pad // block_k),
+        in_specs=[row, kblk, kblk, row, row1, row1],
+        out_specs=[kblk, kblk],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S_pad, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, S_pad, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, dvec)
+
+    unflat = lambda x: x.reshape(B, H, S_pad, D).transpose(0, 2, 1, 3)  # noqa: E731
+    dq, dk, dv = unflat(dq), unflat(dk), unflat(dv)
+    if S_pad != S:
+        dq, dk, dv = dq[:, :S], dk[:, :S], dv[:, :S]
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -184,18 +400,30 @@ def _use_pallas(interpret: bool | None) -> bool:
 
 def _flash_impl(q, k, v, causal, block_q, block_k, interpret):
     if _use_pallas(interpret):
-        return _flash_forward(q, k, v, causal, block_q, block_k, bool(interpret))
+        out, _ = _flash_forward(q, k, v, causal, block_q, block_k, bool(interpret))
+        return out
     return _xla_attention(q, k, v, causal)
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_impl(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    if _use_pallas(interpret):
+        out, lse = _flash_forward(
+            q, k, v, causal, block_q, block_k, bool(interpret), need_lse=True
+        )
+        return out, (q, k, v, out, lse)
+    out = _xla_attention(q, k, v, causal)
+    # fallback backward recomputes from q/k/v only — saving out here would
+    # pin an extra [B,S,H,D] activation through the whole backward
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    # recompute through the XLA path; same math, same gradients
+    q, k, v, out, lse = residuals
+    if lse is not None:
+        return _flash_backward(
+            q, k, v, out, lse, g, causal, block_q, block_k, bool(interpret)
+        )
+    # XLA fallback path: recompute through the dense implementation
     _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal), q, k, v)
     return vjp(g)
 
