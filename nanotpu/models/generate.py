@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from nanotpu.models.llama import (
     LlamaConfig,
     apply_rope,
+    embed_lookup,
+    linear,
     mlp,
     rms_norm,
     rope_freqs,
@@ -90,9 +92,9 @@ def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start):
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     attn = layer["attn"]
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ attn["wq"]).reshape(B, S, H, hd)
-    k = (h @ attn["wk"]).reshape(B, S, KV, hd)
-    v = (h @ attn["wv"]).reshape(B, S, KV, hd)
+    q = linear(h, attn["wq"]).reshape(B, S, H, hd)
+    k = linear(h, attn["wk"]).reshape(B, S, KV, hd)
+    v = linear(h, attn["wv"]).reshape(B, S, KV, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     k_cache = jax.lax.dynamic_update_slice(
@@ -102,7 +104,7 @@ def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start):
         v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
     )
     out = _attend_cached(q, k_cache, v_cache, start + S)
-    x = x + out.reshape(B, S, H * hd) @ attn["wo"]
+    x = x + linear(out.reshape(B, S, H * hd), attn["wo"])
     if "moe" in layer:
         # NOTE: expert capacity is computed over the tokens in THIS call
         # (B*S), not the full sequence — matches the full forward only when
@@ -125,7 +127,7 @@ def _run(params, tokens, cfg, cache: KVCache):
     start = cache.length
     positions = start + jnp.arange(S, dtype=jnp.int32)
     cos, sin = rope_freqs(cfg, positions)
-    x = params["embed"][tokens]
+    x = embed_lookup(params["embed"], tokens, jnp.dtype(cfg.dtype))
     ks, vs = [], []
     for i, layer in enumerate(params["layers"]):
         x, k_l, v_l = _layer_with_cache(
@@ -134,7 +136,7 @@ def _run(params, tokens, cfg, cache: KVCache):
         ks.append(k_l)
         vs.append(v_l)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, -1] @ params["lm_head"]).astype(jnp.float32)  # [B, V]
+    logits = linear(x[:, -1], params["lm_head"]).astype(jnp.float32)  # [B, V]
     new_cache = KVCache(tuple(ks), tuple(vs), start + S)
     return logits, new_cache
 

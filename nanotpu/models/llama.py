@@ -111,6 +111,23 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> dict:
 
 # -- building blocks -------------------------------------------------------
 
+def linear(x: jax.Array, w) -> jax.Array:
+    """Matmul that dispatches on int8-quantized weights (serving path,
+    nanotpu.models.quant) — everything else in the model stays unaware of
+    quantization."""
+    from nanotpu.models.quant import QArray, matmul
+
+    if isinstance(w, QArray):
+        return matmul(x, w)
+    return x @ w
+
+
+def embed_lookup(w, tokens: jax.Array, dtype=None) -> jax.Array:
+    from nanotpu.models.quant import embedding_lookup
+
+    return embedding_lookup(w, tokens, dtype)
+
+
 def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     """fp32 accumulation regardless of activation dtype."""
     orig = x.dtype
@@ -159,9 +176,9 @@ def attention(params: dict, x: jax.Array, cfg: LlamaConfig,
               cos: jax.Array, sin: jax.Array) -> jax.Array:
     B, S, _ = x.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    q = (x @ params["wq"]).reshape(B, S, H, hd)
-    k = (x @ params["wk"]).reshape(B, S, KV, hd)
-    v = (x @ params["wv"]).reshape(B, S, KV, hd)
+    q = linear(x, params["wq"]).reshape(B, S, H, hd)
+    k = linear(x, params["wk"]).reshape(B, S, KV, hd)
+    v = linear(x, params["wv"]).reshape(B, S, KV, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if cfg.attn_impl == "ring":
@@ -173,7 +190,7 @@ def attention(params: dict, x: jax.Array, cfg: LlamaConfig,
         from nanotpu.parallel.ring_attention import ring_attention_sharded
 
         out = ring_attention_sharded(q, k, v, causal=True)
-        return out.reshape(B, S, H * hd) @ params["wo"]
+        return linear(out.reshape(B, S, H * hd), params["wo"])
     # GQA: repeat kv heads to full head count (XLA turns this into a
     # broadcast inside the einsum, no materialized copy)
     if KV != H:
@@ -186,12 +203,15 @@ def attention(params: dict, x: jax.Array, cfg: LlamaConfig,
         out = flash_attention(q, k, v, causal=True)
     else:
         out = _dense_attention(q, k, v, causal=True)
-    return out.reshape(B, S, H * hd) @ params["wo"]
+    return linear(out.reshape(B, S, H * hd), params["wo"])
 
 
 def mlp(params: dict, x: jax.Array) -> jax.Array:
     """SwiGLU."""
-    return (jax.nn.silu(x @ params["w_gate"]) * (x @ params["w_up"])) @ params["w_down"]
+    return linear(
+        jax.nn.silu(linear(x, params["w_gate"])) * linear(x, params["w_up"]),
+        params["w_down"],
+    )
 
 
 def decoder_layer(params: dict, x: jax.Array, cfg: LlamaConfig,
@@ -210,7 +230,7 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     if positions is None:
         positions = jnp.arange(S, dtype=jnp.int32)
     cos, sin = rope_freqs(cfg, positions)
-    x = params["embed"][tokens]
+    x = embed_lookup(params["embed"], tokens, _dtype(cfg))
     layer_fn = decoder_layer
     if cfg.remat:
         layer_fn = jax.checkpoint(
@@ -220,7 +240,7 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     for layer_params in params["layers"]:
         x = layer_fn(layer_params, x, cfg, cos, sin)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return linear(x, params["lm_head"]).astype(jnp.float32)
 
 
 def loss_fn(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:

@@ -26,9 +26,22 @@ from nanotpu.models.llama import (
     LlamaConfig,
     apply_rope,
     attention,
+    embed_lookup,
+    linear,
     rms_norm,
     rope_freqs,
 )
+
+
+def _w(w, dtype):
+    """Expert weights ride int8 in HBM when quantized (nanotpu.models.quant,
+    per-expert scales); the einsums below consume the upcast view — XLA
+    fuses the dequant into the contraction under jit."""
+    from nanotpu.models.quant import QArray, dequantize
+
+    if isinstance(w, QArray):
+        return dequantize(w, dtype)
+    return w
 
 
 @dataclasses.dataclass(frozen=True)
@@ -185,9 +198,12 @@ def moe_block(params: dict, x: jax.Array, cfg: MixtralConfig) -> tuple[jax.Array
     # dispatch tokens into per-expert buffers: [E, C, D]
     expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
     # per-expert SwiGLU, batched over E on the MXU
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_gate"]))
-    up = jnp.einsum("ecd,edf->ecf", expert_in, params["w_up"])
-    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, params["w_down"])
+    dt = x.dtype
+    gate = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", expert_in, _w(params["w_gate"], dt))
+    )
+    up = jnp.einsum("ecd,edf->ecf", expert_in, _w(params["w_up"], dt))
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, _w(params["w_down"], dt))
     # combine back with routing weights: [T, D]
     out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
     return out.reshape(B, S, D), aux
@@ -221,13 +237,13 @@ def forward(
     if positions is None:
         positions = jnp.arange(S, dtype=jnp.int32)
     cos, sin = rope_freqs(cfg.as_llama(), positions)
-    x = params["embed"][tokens]
+    x = embed_lookup(params["embed"], tokens, jnp.dtype(cfg.dtype))
     aux_total = jnp.zeros((), jnp.float32)
     for layer in params["layers"]:
         x, aux = decoder_layer(layer, x, cfg, cos, sin)
         aux_total = aux_total + aux
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32), aux_total
+    return linear(x, params["lm_head"]).astype(jnp.float32), aux_total
 
 
 def loss_fn(params: dict, tokens: jax.Array, cfg: MixtralConfig) -> jax.Array:

@@ -1,0 +1,110 @@
+"""Weight-only int8 quantization for serving.
+
+Autoregressive decode is HBM-bandwidth-bound: every generated token re-reads
+every weight matrix, so halving the bytes per weight nearly halves the
+decode step time regardless of FLOPs. Weights are quantized per OUTPUT
+channel (symmetric, int8): ``w ≈ q * s`` with ``q`` int8 [in, out] and
+``s`` f32 [1, out] — per-channel scales keep the error independent across
+output features, which matters for the wide lm_head.
+
+The compute path stays bf16/f32: ``x @ dequant(q)`` reads int8 from HBM and
+upcasts on-chip (the MXU multiplies at full rate; the win is bandwidth, not
+arithmetic). Activations are NOT quantized — this is the standard
+weight-only recipe that preserves quality with no calibration data.
+
+QArray is a pytree, so quantized params ride through jit/shardings like any
+other tree. ``nanotpu.models.llama.linear`` dispatches on it, which is the
+single hook the model and KV-cache decode paths need.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QArray(NamedTuple):
+    """Symmetric per-output-channel int8 weight: ``w ≈ q * s``."""
+
+    q: jax.Array  # int8, same shape as the original weight
+    s: jax.Array  # f32, shape broadcastable: original.shape with -2 axes = 1
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):  # the dtype compute sees after dequant
+        return jnp.bfloat16
+
+
+def quantize(w: jax.Array) -> QArray:
+    """Quantize one weight (last axis = output channels). The amax reduces
+    only the CONTRACTION axis (-2): stacked expert weights [E, d, f] get
+    per-expert scales [E, 1, f] instead of one scale smeared across all
+    experts; plain [in, out] matrices reduce to [1, out] as usual."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+    return QArray(q=q, s=s)
+
+
+def dequantize(w: QArray, dtype=jnp.bfloat16) -> jax.Array:
+    return (w.q.astype(jnp.float32) * w.s).astype(dtype)
+
+
+def matmul(x: jax.Array, w: QArray) -> jax.Array:
+    """x @ (q * s): int8 read from HBM, upcast on-chip, scale folded in
+    AFTER the matmul (one multiply per output element instead of per
+    weight — XLA fuses it into the matmul epilogue)."""
+    y = jnp.matmul(x, w.q.astype(x.dtype))
+    return y * w.s.astype(x.dtype)
+
+
+def embedding_lookup(
+    w: QArray | jax.Array, tokens: jax.Array, dtype=None,
+) -> jax.Array:
+    """Row gather for (possibly quantized) embedding tables. The embedding
+    is quantized per EMBEDDING DIM (its last axis), so gathered rows
+    rescale with the same broadcast. ``dtype`` sets the activation dtype
+    the model runs in (defaults to bfloat16 for quantized tables)."""
+    if isinstance(w, QArray):
+        dt = dtype or jnp.bfloat16
+        return w.q[tokens].astype(dt) * w.s[0].astype(dt)
+    return w[tokens]
+
+
+#: Weight names that stay unquantized even though they are 2D. (1D leaves
+#: — the norm gains — are already excluded by the ndim guard.) The MoE
+#: router is deliberately f32: routing argmax is sensitive to logit noise
+#: and the matrix is tiny, so quantizing it risks quality for no bandwidth.
+_SKIP = {"router"}
+
+
+def quantize_params(params) -> dict:
+    """Quantize every matmul weight in a llama/mixtral param tree."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {
+                k: (node[k] if k in _SKIP else walk(node[k])) for k in node
+            }
+        if isinstance(node, list):
+            return [walk(x) for x in node]
+        if getattr(node, "ndim", 0) >= 2:
+            return quantize(node)
+        return node
+
+    return walk(params)
+
+
+def param_bytes(params) -> int:
+    """Total bytes of all leaves (int8 counts 1/elem) — the HBM the decode
+    loop must stream per token."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(params)
+    )

@@ -1,0 +1,123 @@
+"""Weight-only int8 quantization: error bounds, pytree behavior, and
+end-to-end quantized decoding quality vs the bf16 model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanotpu.models import generate as gen
+from nanotpu.models import llama, quant
+
+CFG = llama.LlamaConfig(
+    vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=128, max_seq_len=128, dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def test_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 128), jnp.float32)
+    q = quant.quantize(w)
+    back = quant.dequantize(q, jnp.float32)
+    # symmetric int8: error <= scale/2 per element; scale = amax/127
+    amax = np.abs(np.asarray(w)).max(axis=0, keepdims=True)
+    assert np.all(np.abs(np.asarray(back) - np.asarray(w)) <= amax / 127.0)
+
+
+def test_matmul_matches_dequant_matmul():
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(3), (64, 32), jnp.float32)
+    q = quant.quantize(w)
+    want = x @ quant.dequantize(q, jnp.float32)
+    got = quant.matmul(x, q)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantize_params_structure(params):
+    qp = quant.quantize_params(params)
+    # matmul weights quantized, norms untouched
+    assert isinstance(qp["layers"][0]["attn"]["wq"], quant.QArray)
+    assert isinstance(qp["embed"], quant.QArray)
+    assert isinstance(qp["lm_head"], quant.QArray)
+    assert qp["layers"][0]["attn_norm"].dtype == jnp.float32
+    assert not isinstance(qp["final_norm"], quant.QArray)
+    # ~4x smaller for f32 source weights (int8 + tiny scales + f32 norms)
+    assert quant.param_bytes(qp) < 0.3 * quant.param_bytes(params)
+    # still a pytree jit can close over / take as argument
+    leaves = jax.tree_util.tree_leaves(qp)
+    assert any(leaf.dtype == jnp.int8 for leaf in leaves)
+
+
+def test_quantized_forward_close(params):
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, CFG.vocab_size)
+    full = llama.forward(params, tokens, CFG)
+    qlog = llama.forward(quant.quantize_params(params), tokens, CFG)
+    # logits drift a little; softmax ranking of the top token should not
+    probs_full = jax.nn.softmax(full, axis=-1)
+    probs_q = jax.nn.softmax(qlog, axis=-1)
+    tv = 0.5 * jnp.abs(probs_full - probs_q).sum(-1).mean()
+    assert float(tv) < 0.05, f"total variation {float(tv)}"
+
+
+def test_quantized_generation_runs_and_tracks_full(params):
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 8), 0, CFG.vocab_size)
+    full = gen.generate(params, prompt, CFG, 12)
+    quantized = gen.generate(quant.quantize_params(params), prompt, CFG, 12)
+    assert quantized.shape == full.shape
+    # greedy paths agree for most steps at this scale (int8 weight-only)
+    agree = float((quantized == full).mean())
+    assert agree >= 0.75, f"only {agree:.0%} of greedy tokens agree"
+
+
+def test_quantized_decode_matches_quantized_forward(params):
+    """Cache path and full forward must agree EXACTLY on the same
+    quantized params (quantization must not break cache equivalence)."""
+    qp = quant.quantize_params(params)
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (1, 12), 0, CFG.vocab_size)
+    full_logits = llama.forward(qp, prompt, CFG)
+    pre_logits, _ = gen.prefill(qp, prompt, CFG, max_len=16)
+    np.testing.assert_allclose(
+        np.asarray(pre_logits), np.asarray(full_logits[:, -1]),
+        rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_mixtral_quantized_forward_and_decode():
+    """MoE trees quantize too: per-EXPERT scales on the stacked [E, d, f]
+    weights, router left f32, and both the full forward and the KV-cache
+    decode paths consume the quantized tree."""
+    from nanotpu.models import mixtral
+
+    cfg = mixtral.MixtralConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=96, n_experts=4, top_k=2, capacity_factor=4.0,
+        max_seq_len=64, dtype="float32",
+    )
+    params = mixtral.init_params(jax.random.PRNGKey(0), cfg)
+    qp = quant.quantize_params(params)
+    wg = qp["layers"][0]["moe"]["w_gate"]
+    assert isinstance(wg, quant.QArray)
+    assert wg.s.shape == (cfg.n_experts, 1, cfg.ffn_dim)  # per-expert scales
+    assert not isinstance(qp["layers"][0]["moe"]["router"], quant.QArray)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size)
+    full, _ = mixtral.forward(params, tokens, cfg)
+    qlog, _ = mixtral.forward(qp, tokens, cfg)
+    tv = 0.5 * jnp.abs(
+        jax.nn.softmax(full, -1) - jax.nn.softmax(qlog, -1)
+    ).sum(-1).mean()
+    assert float(tv) < 0.05, f"total variation {float(tv)}"
+
+    # cache path: prefill on the quantized tree (mixtral decode reuses the
+    # llama cache layer via the "moe" key; MixtralConfig carries top_k)
+    pre_logits, _ = gen.prefill(qp, tokens, cfg, max_len=16)
+    np.testing.assert_allclose(
+        np.asarray(pre_logits), np.asarray(qlog[:, -1]),
+        rtol=2e-4, atol=2e-4,
+    )
