@@ -81,13 +81,22 @@ def _attend_cached(q, k_cache, v_cache, valid_len):
     return out.reshape(B, S, H, hd)
 
 
-def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start):
+def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start,
+                      full_prefill=False):
     """One decoder layer over new tokens x [B,S,D], updating this layer's
     cache slice at [start, start+S). Returns (x, k_cache, v_cache).
 
     Works for dense (Llama: ``mlp``/``mlp_norm``) and MoE (Mixtral:
     ``moe``/``moe_norm``) layers — attention is identical, only the FFN
-    half differs (routing aux loss is irrelevant at inference)."""
+    half differs (routing aux loss is irrelevant at inference).
+
+    ``full_prefill`` (static) marks the cache-was-empty case: attention is
+    plain causal self-attention over the prompt, so configs with
+    ``attn_impl="flash"`` run it through the flash kernel instead of
+    attending against the whole [max_len] cache buffer — no [S, max_len]
+    logits materialize, which is what makes long-prompt prefill fit (and
+    it's faster). Other attn_impls keep the cached path: the selector the
+    config documents stays in charge."""
     B, S, _ = x.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     attn = layer["attn"]
@@ -103,7 +112,18 @@ def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start):
     v_cache = jax.lax.dynamic_update_slice(
         v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
     )
-    out = _attend_cached(q, k_cache, v_cache, start + S)
+    if full_prefill and cfg.attn_impl == "flash":
+        from nanotpu.ops.attention import flash_attention
+
+        rep = H // KV
+        out = flash_attention(
+            q,
+            jnp.repeat(k, rep, axis=2),
+            jnp.repeat(v, rep, axis=2),
+            True,
+        )
+    else:
+        out = _attend_cached(q, k_cache, v_cache, start + S)
     x = x + linear(out.reshape(B, S, H * hd), attn["wo"])
     if "moe" in layer:
         # NOTE: expert capacity is computed over the tokens in THIS call
@@ -121,7 +141,7 @@ def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start):
     return x, k_cache, v_cache
 
 
-def _run(params, tokens, cfg, cache: KVCache):
+def _run(params, tokens, cfg, cache: KVCache, full_prefill: bool = False):
     """Shared prefill/step body: tokens [B,S] appended at cache.length."""
     B, S = tokens.shape
     start = cache.length
@@ -131,7 +151,8 @@ def _run(params, tokens, cfg, cache: KVCache):
     ks, vs = [], []
     for i, layer in enumerate(params["layers"]):
         x, k_l, v_l = _layer_with_cache(
-            layer, x, cfg, cos, sin, cache.k[i], cache.v[i], start
+            layer, x, cfg, cos, sin, cache.k[i], cache.v[i], start,
+            full_prefill=full_prefill,
         )
         ks.append(k_l)
         vs.append(v_l)
@@ -142,9 +163,11 @@ def _run(params, tokens, cfg, cache: KVCache):
 
 
 def prefill(params, prompt: jax.Array, cfg: LlamaConfig, max_len: int):
-    """prompt [B,S] -> (last-token logits [B,V], primed cache)."""
+    """prompt [B,S] -> (last-token logits [B,V], primed cache). The cache
+    starts empty, so attention is pure causal self-attention over the
+    prompt and runs through the flash kernel (see _layer_with_cache)."""
     cache = KVCache.create(cfg, prompt.shape[0], max_len)
-    return _run(params, prompt, cfg, cache)
+    return _run(params, prompt, cfg, cache, full_prefill=True)
 
 
 def decode_step(params, token: jax.Array, cfg: LlamaConfig, cache: KVCache):

@@ -9,10 +9,9 @@ import pytest
 from nanotpu.models import generate as gen
 from nanotpu.models import llama, quant
 
-CFG = llama.LlamaConfig(
-    vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
-    ffn_dim=128, max_seq_len=128, dtype="float32",
-)
+import dataclasses
+
+CFG = dataclasses.replace(llama.LlamaConfig.tiny(), max_seq_len=128)
 
 
 @pytest.fixture(scope="module")
