@@ -204,12 +204,18 @@ def generate(
     params, prompt: jax.Array, cfg: LlamaConfig, max_new_tokens: int,
     temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
     rng: jax.Array | None = None, max_len: int | None = None,
+    eos_id: int = -1,
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled generation, with optional top-k
     and/or nucleus (top-p) filtering when temperature > 0.
 
     prompt [B, S] -> generated tokens [B, max_new_tokens]. Jit-friendly:
-    call under ``jax.jit`` with static cfg/max_new_tokens/top_k/top_p.
+    call under ``jax.jit`` with static cfg/max_new_tokens/top_k/top_p/
+    eos_id. ``eos_id >= 0`` enables stop-token semantics: once a sequence
+    emits eos, every later position repeats eos (shapes stay static — the
+    scan still runs, finished rows just stop changing; callers truncate at
+    the first eos). Finished rows keep feeding eos to the model, which is
+    harmless because their outputs are overwritten anyway.
     """
     B, S = prompt.shape
     max_len = max_len or min(cfg.max_seq_len, S + max_new_tokens)
@@ -233,18 +239,22 @@ def generate(
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     first = sample(logits, first_key)
+    done0 = (first == eos_id) if eos_id >= 0 else jnp.zeros((B,), jnp.bool_)
 
     def step(carry, key):
-        token, cache = carry
+        token, cache, done = carry
         logits, cache = decode_step(params, token, cfg, cache)
         nxt = sample(logits, key)
-        return (nxt, cache), nxt
+        if eos_id >= 0:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, cache, done), nxt
 
     # N-1 decode steps: prefill already produced the first token
     keys = jax.random.split(scan_key, max(max_new_tokens - 1, 1))
     if max_new_tokens == 1:
         return first[:, None]
-    (_, _), rest = jax.lax.scan(step, (first, cache), keys)
+    (_, _, _), rest = jax.lax.scan(step, (first, cache, done0), keys)
     return jnp.concatenate(
         [first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1
     )  # [B, max_new_tokens]
