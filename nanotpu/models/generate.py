@@ -141,8 +141,12 @@ def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start,
     return x, k_cache, v_cache
 
 
-def _run(params, tokens, cfg, cache: KVCache, full_prefill: bool = False):
-    """Shared prefill/step body: tokens [B,S] appended at cache.length."""
+def _run(params, tokens, cfg, cache: KVCache, full_prefill: bool = False,
+         return_all: bool = False):
+    """Shared prefill/step body: tokens [B,S] appended at cache.length.
+    ``return_all`` returns logits for every fed position [B,S,V] (the
+    speculative-decoding verify forward needs them all), else last-token
+    logits [B,V]."""
     B, S = tokens.shape
     start = cache.length
     positions = start + jnp.arange(S, dtype=jnp.int32)
@@ -157,7 +161,8 @@ def _run(params, tokens, cfg, cache: KVCache, full_prefill: bool = False):
         ks.append(k_l)
         vs.append(v_l)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = linear(x[:, -1], params["lm_head"]).astype(jnp.float32)  # [B, V]
+    x_out = x if return_all else x[:, -1]
+    logits = linear(x_out, params["lm_head"]).astype(jnp.float32)
     new_cache = KVCache(tuple(ks), tuple(vs), start + S)
     return logits, new_cache
 

@@ -1,0 +1,123 @@
+"""Greedy speculative decoding: a small draft model proposes K tokens per
+cycle, the target model verifies all of them in ONE batched forward.
+
+The greedy variant is OUTPUT-EQUIVALENT to plain greedy decoding on the
+target model — the draft only changes how many sequential target passes are
+needed, never the tokens: a cycle accepts the longest prefix of draft
+proposals that match the target's own greedy choices and then takes the
+target's token at the first mismatch, so every emitted token is the
+target's greedy token. Speedup is (accepted+1) tokens per target forward,
+set entirely by draft quality; a bad draft degrades to ~1 (plain decoding
+with draft overhead), never to wrong outputs.
+
+Precision caveat (exactness verified f32-on-TPU and f32-on-CPU by tests):
+in bf16 the verify forward runs the same positions at a different matmul
+shape (S=K+1 vs S=1), so near-tie logits can argmax differently than
+step-by-step decoding — the output is still a faithful greedy decode of
+the target under the verify pass's numerics, just not guaranteed bitwise
+identical to the one-token-at-a-time sequence. Every production
+speculative decoder in low precision shares this property.
+
+TPU-native mechanics:
+
+* Everything is ONE ``lax.while_loop`` over cycles — dynamic trip count
+  (good drafts finish in fewer cycles) with fully static shapes inside.
+* Cache rollback is free: ``KVCache.length`` is the only truth. Rejected
+  positions leave stale k/v entries behind, which is safe because attends
+  mask beyond ``length`` and the next cycle's writes start at ``length``,
+  overwriting exactly the stale region.
+* Multi-row batches advance by the MINIMUM acceptance across rows: rows
+  that matched further simply re-verify those tokens next cycle (greedy is
+  deterministic, so they re-emit identically). Conservative but correct.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nanotpu.models.generate import KVCache, _run, prefill
+
+
+def speculative_generate(
+    params, draft_params, prompt: jax.Array, cfg, draft_cfg,
+    max_new_tokens: int, draft_tokens: int = 4,
+    max_len: int | None = None,
+) -> jax.Array:
+    """Greedy generation of ``max_new_tokens`` from the target ``params``,
+    accelerated by ``draft_params``. Returns [B, max_new_tokens] tokens
+    identical to ``generate(params, ..., temperature=0)``.
+
+    ``draft_tokens`` (K, static) is the speculation depth per cycle.
+    """
+    B, S = prompt.shape
+    K = draft_tokens
+    N = max_new_tokens
+    max_len = max_len or min(cfg.max_seq_len, S + N + K + 1)
+    if S + N + K + 1 > max_len:
+        raise ValueError(
+            f"prompt {S} + new {N} + speculation {K + 1} exceeds "
+            f"max_len {max_len} (the verify forward may overshoot by K)"
+        )
+
+    # both models prefill the prompt; the target's last-token logits give
+    # the first emitted token
+    t_logits, t_cache = prefill(params, prompt, cfg, max_len)
+    _, d_cache = prefill(draft_params, prompt, draft_cfg, max_len)
+    first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B]
+
+    # emit buffer padded by K+1 so the final cycle's full write stays
+    # in bounds; only [:N] is returned
+    out0 = jnp.zeros((B, N + K + 1), jnp.int32)
+    out0 = out0.at[:, 0].set(first)
+
+    def cond(carry):
+        _, _, _, n, _ = carry
+        return n < N
+
+    def body(carry):
+        t_cache, d_cache, out, n, cur = carry
+
+        # -- draft K proposals (K+1 steps: the extra step feeds d_K so its
+        #    cache entry exists if every proposal is accepted) -------------
+        def draft_scan(carry, _):
+            dc, tok = carry
+            logits, dc = _run(draft_params, tok[:, None], draft_cfg, dc)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (dc, nxt), nxt
+
+        (d_cache, _), drafts = lax.scan(
+            draft_scan, (d_cache, cur), None, length=K + 1
+        )
+        drafts = jnp.moveaxis(drafts, 0, 1)  # [B, K+1]; d1..dK, dK+1 unused
+
+        # -- target verifies cur + d1..dK in one forward -------------------
+        verify_tokens = jnp.concatenate([cur[:, None], drafts[:, :K]], axis=1)
+        v_logits, t_cache = _run(
+            params, verify_tokens, cfg, t_cache, return_all=True
+        )  # [B, K+1, V]
+        greedy = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+
+        # a = leading proposals that equal the target's own choices
+        matches = drafts[:, :K] == greedy[:, :K]  # [B, K]
+        a_rows = jnp.cumprod(matches.astype(jnp.int32), axis=1).sum(axis=1)
+        a = jnp.min(a_rows)  # shared advance (min over rows)
+
+        # emitted tokens this cycle are greedy[:, :a+1]; writing the whole
+        # K+1 vector is fine — positions beyond a are re-written by later
+        # cycles before they can be read
+        out = lax.dynamic_update_slice(out, greedy, (0, n))
+
+        cur = lax.dynamic_index_in_dim(greedy, a, axis=1, keepdims=False)
+        n = n + a + 1
+        # rollback: keep only the accepted prefix; stale entries beyond are
+        # overwritten by the next cycle's writes at `length`
+        t_cache = t_cache._replace(length=t_cache.length - (K + 1) + a + 1)
+        d_cache = d_cache._replace(length=d_cache.length - (K + 1) + a + 1)
+        return t_cache, d_cache, out, n, cur
+
+    _, _, out, _, _ = lax.while_loop(
+        cond, body, (t_cache, d_cache, out0, jnp.ones((), jnp.int32), first)
+    )
+    return out[:, :N]

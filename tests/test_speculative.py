@@ -1,0 +1,78 @@
+"""Greedy speculative decoding must emit EXACTLY the target model's greedy
+tokens — the draft only changes how many target forwards it takes."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanotpu.models import generate as gen
+from nanotpu.models import llama
+from nanotpu.models.speculative import speculative_generate
+
+CFG = dataclasses.replace(llama.LlamaConfig.tiny(), max_seq_len=128)
+DRAFT_CFG = dataclasses.replace(CFG, n_layers=1)
+
+
+@pytest.fixture(scope="module")
+def models():
+    target = llama.init_params(jax.random.PRNGKey(0), CFG)
+    draft = llama.init_params(jax.random.PRNGKey(42), DRAFT_CFG)
+    return target, draft
+
+
+@pytest.mark.parametrize("K", [1, 3, 4])
+def test_exact_greedy_equivalence_bad_draft(models, K):
+    """A random (terrible) draft must still yield the target's exact greedy
+    tokens — speculation can only cost speed, never correctness."""
+    target, draft = models
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, CFG.vocab_size)
+    want = gen.generate(target, prompt, CFG, 12)
+    got = speculative_generate(
+        target, draft, prompt, CFG, DRAFT_CFG, 12, draft_tokens=K
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_exact_greedy_equivalence_perfect_draft(models):
+    """Draft == target: every proposal is accepted, output still exact."""
+    target, _ = models
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 5), 0, CFG.vocab_size)
+    want = gen.generate(target, prompt, CFG, 16)
+    got = speculative_generate(
+        target, target, prompt, CFG, CFG, 16, draft_tokens=4
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_batched_rows_stay_exact(models):
+    target, draft = models
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (4, 7), 0, CFG.vocab_size)
+    want = gen.generate(target, prompt, CFG, 10)
+    got = speculative_generate(
+        target, draft, prompt, CFG, DRAFT_CFG, 10, draft_tokens=3
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_jittable(models):
+    target, draft = models
+    prompt = jnp.ones((1, 4), jnp.int32)
+    f = jax.jit(
+        lambda t, d, p: speculative_generate(t, d, p, CFG, DRAFT_CFG, 8, 2)
+    )
+    out = f(target, draft, prompt)
+    want = gen.generate(target, prompt, CFG, 8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
+def test_overflow_rejected(models):
+    target, draft = models
+    prompt = jnp.ones((1, 100), jnp.int32)
+    with pytest.raises(ValueError, match="exceeds"):
+        speculative_generate(
+            target, draft, prompt, CFG, DRAFT_CFG, 30, draft_tokens=4,
+            max_len=120,
+        )
