@@ -150,6 +150,9 @@ class Controller:
                 self.dealer.remove_node(event.obj.name)
             elif event.type == "ADDED":
                 self.dealer.observe_node(event.obj)
+            elif event.type == "MODIFIED":
+                # resize/relabel detection (the reference ignored these)
+                self.dealer.refresh_node(event.obj)
 
     def _resync_loop(self) -> None:
         """Periodic full reconcile: re-list pods and nodes, enqueue every TPU
@@ -161,10 +164,12 @@ class Controller:
                     if podutil.is_tpu_sharing_pod(pod):
                         self._remember(pod)
                         self._enqueue(pod)
-                live_nodes = {n.name for n in self.client.list_nodes()}
+                live = {n.name: n for n in self.client.list_nodes()}
                 for name in self.dealer.node_names():
-                    if name not in live_nodes:
+                    if name not in live:
                         self.dealer.remove_node(name)
+                for node in live.values():  # catch resizes a dropped
+                    self.dealer.refresh_node(node)  # watch event missed
             except ApiError as e:
                 log.warning("resync failed: %s", e)
 

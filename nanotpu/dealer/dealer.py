@@ -227,6 +227,48 @@ class Dealer:
             self._non_tpu.discard(name)
         self.usage.forget_node(name)
 
+    def refresh_node(self, node: Node) -> bool:
+        """Node MODIFIED handler: when capacity or topology labels drift
+        from the tracked view, rebuild the NodeInfo and replay this node's
+        tracked pods onto the fresh accounting. (The reference never
+        noticed resizes — SURVEY bug list 'NodeMaps never evicts
+        deleted/resized nodes'.) Returns True when a rebuild happened."""
+        if not nodeutil.is_tpu_node(node):
+            # the node stopped advertising TPU capacity entirely
+            with self._lock:
+                known = node.name in self._nodes
+            if known:
+                self.remove_node(node.name)
+            return known
+        with self._lock:
+            info = self._nodes.get(node.name)
+        if info is not None and NodeInfo.fingerprint_of(node) == info.fingerprint():
+            return False
+        # rebuild needed: node is new, REGAINED capacity (remove_node left
+        # its pods tracked — a device-plugin restart does exactly this), or
+        # drifted. Replay this node's ANNOTATED pods onto fresh accounting.
+        # Reservation-only pods (mid-bind, no chip annotations yet) stay in
+        # the map untouched — the owning bind thread finishes and detects
+        # the rebuild itself (see _bind's is-current check).
+        with self._lock:
+            self._nodes.pop(node.name, None)
+            self._non_tpu.discard(node.name)
+            replay = [
+                p for p in self._pods.values()
+                if p.node_name == node.name
+                and podutil.get_assigned_chips(p) is not None
+            ]
+            for p in replay:
+                self._pods.pop(p.uid, None)
+        self._node_info(node.name, node)
+        for p in replay:
+            self._learn_bound_pod(p)
+        log.info(
+            "node %s rebuilt (new/resized/relabeled): replayed %d pods",
+            node.name, len(replay),
+        )
+        return info is not None
+
     def node_names(self) -> list[str]:
         with self._lock:
             return sorted(self._nodes)
@@ -373,6 +415,17 @@ class Dealer:
             raise BindError(
                 f"pod {pod.key()} was released while bind was in flight"
             )
+        # a refresh_node may have rebuilt this node's accounting while the
+        # API writes were in flight — our chips then live on the orphaned
+        # NodeInfo. The pod is annotated now, so replaying it moves the
+        # accounting onto the current object.
+        with self._lock:
+            current = self._nodes.get(node_name)
+        if current is not None and current is not info:
+            with self._lock:
+                still_tracked = self._pods.pop(pod.uid, None) is not None
+            if still_tracked:
+                self._learn_bound_pod(annotated)
         return annotated
 
     def _write_annotations(self, pod: Pod, plan: Plan) -> Pod:

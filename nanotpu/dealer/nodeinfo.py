@@ -22,21 +22,43 @@ from nanotpu.utils import node as nodeutil
 class NodeInfo:
     """Chip accounting for one node, with a demand-hash plan cache."""
 
-    def __init__(self, node: Node):
-        self.name = node.name
-        self.lock = threading.RLock()
+    @staticmethod
+    def fingerprint_of(node: Node) -> tuple:
+        """Everything placement depends on, computed WITHOUT building chip
+        state — refresh paths compare this against live NodeInfos, so it
+        must be cheap (the resync loop calls it for every node)."""
         chip_count = nodeutil.get_chip_count(node)
         generation = node.labels.get(types.LABEL_TPU_GENERATION, "v5p")
         topo = node.labels.get(
             types.LABEL_TPU_TOPOLOGY, DEFAULT_HOST_TOPOLOGY.get(generation)
         )
+        return (
+            chip_count, generation, topo,
+            node.labels.get(types.LABEL_TPU_SLICE, ""),
+            node.labels.get(types.LABEL_TPU_SLICE_COORDS, ""),
+        )
+
+    def __init__(self, node: Node):
+        self.name = node.name
+        self.lock = threading.RLock()
+        (
+            chip_count, generation, topo, self.slice_name, self.slice_coords,
+        ) = self.fingerprint_of(node)
         self.generation = generation
-        self.slice_name = node.labels.get(types.LABEL_TPU_SLICE, "")
-        self.slice_coords = node.labels.get(types.LABEL_TPU_SLICE_COORDS, "")
+        self.topology = topo
+        self.chip_count = chip_count
         self.chips = ChipSet.for_node(chip_count, topo, generation)
         self.chips.key = self.name
         #: demand hash -> Plan (node.go:20,44-57)
         self._plan_cache: dict[str, Plan] = {}
+
+    def fingerprint(self) -> tuple:
+        """Everything placement depends on; a drift means the NodeInfo must
+        be rebuilt (node resize / relabel detection)."""
+        return (
+            self.chip_count, self.generation, self.topology,
+            self.slice_name, self.slice_coords,
+        )
 
     # -- verbs -------------------------------------------------------------
     def assume(self, demand: Demand, rater: Rater) -> Plan | None:

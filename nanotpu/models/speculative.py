@@ -43,11 +43,12 @@ from nanotpu.models.generate import KVCache, _run, prefill
 def speculative_generate(
     params, draft_params, prompt: jax.Array, cfg, draft_cfg,
     max_new_tokens: int, draft_tokens: int = 4,
-    max_len: int | None = None,
+    max_len: int | None = None, eos_id: int = -1,
 ) -> jax.Array:
     """Greedy generation of ``max_new_tokens`` from the target ``params``,
     accelerated by ``draft_params``. Returns [B, max_new_tokens] tokens
-    identical to ``generate(params, ..., temperature=0)``.
+    identical to ``generate(params, ..., temperature=0)`` (same ``eos_id``
+    semantics: positions after a row's first eos repeat eos).
 
     ``draft_tokens`` (K, static) is the speculation depth per cycle.
     """
@@ -120,4 +121,13 @@ def speculative_generate(
     _, _, out, _, _ = lax.while_loop(
         cond, body, (t_cache, d_cache, out0, jnp.ones((), jnp.int32), first)
     )
-    return out[:, :N]
+    out = out[:, :N]
+    if eos_id >= 0:
+        # the emitted sequence equals the target's greedy sequence, so the
+        # first eos lands at the same position generate() would stop at —
+        # masking everything after it reproduces generate's eos semantics
+        # exactly (cycles past eos computed tokens that are discarded here)
+        is_eos = (out == eos_id).astype(jnp.int32)
+        after_first = (jnp.cumsum(is_eos, axis=1) - is_eos) > 0
+        out = jnp.where(after_first, eos_id, out)
+    return out

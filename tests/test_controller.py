@@ -99,3 +99,182 @@ class TestReconcile:
         st = dealer.status()["nodes"]["v5p-host-0"]
         assert st["available_percent"] == 300
         ctrl.stop()
+
+
+class TestNodeResize:
+    """Node MODIFIED events with capacity/topology drift rebuild the
+    dealer's accounting — the reference ignored resizes entirely (SURVEY
+    bug list: 'NodeMaps never evicts deleted/resized nodes')."""
+
+    def _cluster(self, percent=400):
+        from nanotpu import types
+        from nanotpu.k8s.client import FakeClientset
+        from nanotpu.k8s.objects import make_node
+
+        client = FakeClientset()
+        client.create_node(
+            make_node(
+                "n0",
+                {types.RESOURCE_TPU_PERCENT: percent},
+                labels={
+                    types.LABEL_TPU_GENERATION: "v5p",
+                    types.LABEL_TPU_TOPOLOGY: "2x2x1",
+                    types.LABEL_TPU_ENABLE: types.LABEL_TPU_ENABLE_VALUE,
+                },
+            )
+        )
+        return client
+
+    def test_unchanged_node_is_noop(self):
+        from nanotpu.allocator.rater import make_rater
+        from nanotpu.dealer import Dealer
+
+        client = self._cluster()
+        dealer = Dealer(client, make_rater("binpack"))
+        before = dealer._nodes["n0"]
+        assert dealer.refresh_node(client.get_node("n0")) is False
+        assert dealer._nodes["n0"] is before  # same object: no rebuild
+
+    def test_resize_rebuilds_and_replays_bound_pods(self):
+        from nanotpu import types
+        from nanotpu.allocator.rater import make_rater
+        from nanotpu.dealer import Dealer
+        from nanotpu.k8s.objects import make_container, make_pod
+
+        client = self._cluster(percent=400)
+        dealer = Dealer(client, make_rater("binpack"))
+        pod = client.create_pod(
+            make_pod("p0", containers=[
+                make_container("c", {types.RESOURCE_TPU_PERCENT: 200})
+            ])
+        )
+        dealer.assume(["n0"], pod)
+        dealer.bind("n0", pod)
+        assert dealer._nodes["n0"].chip_count == 4
+
+        # the pool doubles: 4 -> 8 chips (device plugin re-registration)
+        node = client.get_node("n0")
+        node.raw["status"]["capacity"][types.RESOURCE_TPU_PERCENT] = "800"
+        node.raw["metadata"]["labels"][types.LABEL_TPU_TOPOLOGY] = "2x2x2"
+        client.update_node(node)
+        assert dealer.refresh_node(client.get_node("n0")) is True
+        info = dealer._nodes["n0"]
+        assert info.chip_count == 8
+        # the bound pod's 2 chips survived the rebuild
+        assert dealer.occupancy() == pytest.approx(200 / 800)
+        assert "n0" in dealer.status()["nodes"]
+
+    def test_node_losing_tpu_capacity_is_evicted(self):
+        from nanotpu import types
+        from nanotpu.allocator.rater import make_rater
+        from nanotpu.dealer import Dealer
+
+        client = self._cluster()
+        dealer = Dealer(client, make_rater("binpack"))
+        node = client.get_node("n0")
+        del node.raw["status"]["capacity"][types.RESOURCE_TPU_PERCENT]
+        # kubelet publishes capacity AND allocatable; both must drop
+        node.raw["status"].get("allocatable", {}).pop(
+            types.RESOURCE_TPU_PERCENT, None
+        )
+        client.update_node(node)
+        assert dealer.refresh_node(client.get_node("n0")) is True
+        assert "n0" not in dealer.node_names()
+
+    def test_controller_modified_event_triggers_refresh(self):
+        from nanotpu import types
+        from nanotpu.allocator.rater import make_rater
+        from nanotpu.controller.controller import Controller
+        from nanotpu.dealer import Dealer
+
+        client = self._cluster(percent=400)
+        dealer = Dealer(client, make_rater("binpack"))
+        ctrl = Controller(client, dealer, resync_period_s=0)
+        ctrl.start()
+        try:
+            node = client.get_node("n0")
+            node.raw["status"]["capacity"][types.RESOURCE_TPU_PERCENT] = "800"
+            client.update_node(node)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if dealer._nodes.get("n0") and dealer._nodes["n0"].chip_count == 8:
+                    break
+                time.sleep(0.02)
+            assert dealer._nodes["n0"].chip_count == 8
+        finally:
+            ctrl.stop()
+
+    def test_transient_capacity_loss_then_regain_replays_pods(self):
+        """Device-plugin restart: capacity vanishes (node evicted, pods
+        still tracked) then reappears — the rebuild must replay tracked
+        pods or the node is silently overcommitted forever."""
+        from nanotpu import types
+        from nanotpu.allocator.rater import make_rater
+        from nanotpu.dealer import Dealer
+        from nanotpu.k8s.objects import make_container, make_pod
+
+        client = self._cluster(percent=400)
+        dealer = Dealer(client, make_rater("binpack"))
+        pod = client.create_pod(
+            make_pod("p0", containers=[
+                make_container("c", {types.RESOURCE_TPU_PERCENT: 200})
+            ])
+        )
+        dealer.assume(["n0"], pod)
+        dealer.bind("n0", pod)
+
+        node = client.get_node("n0")
+        cap = node.raw["status"]["capacity"].pop(types.RESOURCE_TPU_PERCENT)
+        node.raw["status"].get("allocatable", {}).pop(
+            types.RESOURCE_TPU_PERCENT, None
+        )
+        client.update_node(node)
+        assert dealer.refresh_node(client.get_node("n0")) is True
+        assert "n0" not in dealer.node_names()
+
+        node = client.get_node("n0")
+        node.raw["status"]["capacity"][types.RESOURCE_TPU_PERCENT] = cap
+        client.update_node(node)
+        dealer.refresh_node(client.get_node("n0"))
+        assert "n0" in dealer.node_names()
+        # the bound pod's chips are accounted again — NOT a fresh 0% node
+        assert dealer.occupancy() == pytest.approx(200 / 400)
+
+    def test_refresh_racing_inflight_bind_keeps_accounting(self):
+        """A resize landing while a bind's API writes are in flight must
+        not lose the bind's chips: the bind detects the rebuilt NodeInfo
+        and replays itself onto it."""
+        from nanotpu import types
+        from nanotpu.allocator.rater import make_rater
+        from nanotpu.dealer import Dealer
+        from nanotpu.k8s.objects import make_container, make_pod
+
+        client = self._cluster(percent=400)
+        dealer = Dealer(client, make_rater("binpack"))
+        pod = client.create_pod(
+            make_pod("p0", containers=[
+                make_container("c", {types.RESOURCE_TPU_PERCENT: 200})
+            ])
+        )
+        dealer.assume(["n0"], pod)
+
+        fired = []
+
+        def resize_mid_bind(_pod):
+            # runs inside _write_annotations: chips held on the OLD info,
+            # reservation inserted, annotations not yet written
+            if fired:
+                return
+            fired.append(True)
+            node = client.get_node("n0")
+            node.raw["status"]["capacity"][types.RESOURCE_TPU_PERCENT] = "800"
+            node.raw["metadata"]["labels"][types.LABEL_TPU_TOPOLOGY] = "2x2x2"
+            client.update_node(node)
+            dealer.refresh_node(client.get_node("n0"))
+
+        client.before_update_pod = resize_mid_bind
+        dealer.bind("n0", pod)
+        info = dealer._nodes["n0"]
+        assert info.chip_count == 8  # the refreshed node won
+        # and the bind's 2 chips are accounted on it
+        assert dealer.occupancy() == pytest.approx(200 / 800)

@@ -76,3 +76,17 @@ def test_overflow_rejected(models):
             target, draft, prompt, CFG, DRAFT_CFG, 30, draft_tokens=4,
             max_len=120,
         )
+
+
+def test_eos_matches_generate(models):
+    """Speculative with eos_id reproduces generate's eos semantics exactly:
+    identical tokens before the first eos, eos repeated after."""
+    target, draft = models
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 6), 0, CFG.vocab_size)
+    free = gen.generate(target, prompt, CFG, 12)
+    eos = int(np.asarray(free)[0, 4])  # a token greedy actually emits
+    want = gen.generate(target, prompt, CFG, 12, eos_id=eos)
+    got = speculative_generate(
+        target, draft, prompt, CFG, DRAFT_CFG, 12, draft_tokens=3, eos_id=eos
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
