@@ -120,3 +120,28 @@ def test_mixtral_quantized_forward_and_decode():
         np.asarray(pre_logits), np.asarray(qlog[:, -1]),
         rtol=2e-4, atol=2e-4,
     )
+
+
+def test_quantized_params_checkpoint_roundtrip(tmp_path, params):
+    """Quantized trees ride through orbax (deploy story: quantize once,
+    ship the int8 checkpoint): int8 payloads and scales survive exactly."""
+    import orbax.checkpoint as ocp
+
+    qp = quant.quantize_params(params)
+    path = str(tmp_path / "ckpt")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, qp, force=True)
+        target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, qp)
+        back = ckptr.restore(path, target)
+    def check(a, b):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # tree_map also asserts the restored tree STRUCTURE matches
+    jax.tree_util.tree_map(check, qp, back)
+    # and it still generates
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (1, 6), 0, CFG.vocab_size)
+    np.testing.assert_array_equal(
+        np.asarray(gen.generate(back, prompt, CFG, 6)),
+        np.asarray(gen.generate(qp, prompt, CFG, 6)),
+    )
