@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass, field
-from functools import lru_cache
 
 from nanotpu import types
 from nanotpu.topology import Torus
@@ -106,15 +105,18 @@ class Demand:
     def hash(self) -> str:
         """Plan-cache key: first 8 hex chars of sha256 (allocate.go:72-75).
 
-        Memoized — Assume recomputes it once per candidate node, and the
-        Demand is frozen, so the digest is computed at most once per
-        distinct demand shape."""
-        # tuple() coercion: callers may construct Demand with list fields
-        # (the frozen dataclass doesn't coerce), which lru_cache can't key
-        return _demand_hash(tuple(self.container_names), tuple(self.percents))
+        Memoized on the instance — Assume/Score call this once per
+        candidate node (256x per verb on a large pool), and even a
+        cache lookup plus tuple coercion showed up in profiles."""
+        h = getattr(self, "_hash", None)
+        if h is None:
+            # tuple() coercion: callers may construct Demand with list
+            # fields (the frozen dataclass doesn't coerce)
+            h = _demand_hash(tuple(self.container_names), tuple(self.percents))
+            object.__setattr__(self, "_hash", h)  # frozen dataclass memo
+        return h
 
 
-@lru_cache(maxsize=65536)
 def _demand_hash(container_names: tuple[str, ...], percents: tuple[int, ...]) -> str:
     payload = ",".join(
         f"{n}={p}" for n, p in zip(container_names, percents)
