@@ -156,22 +156,44 @@ class Controller:
 
     def _resync_loop(self) -> None:
         """Periodic full reconcile: re-list pods and nodes, enqueue every TPU
-        pod, evict dealer nodes that no longer exist. Catches anything a
-        dropped watch missed."""
+        pod, release dealer-tracked pods that vanished, evict dealer nodes
+        that no longer exist. Catches anything a dropped watch missed."""
         while not self._stop.wait(self.resync_period_s):
             try:
-                for pod in self.client.list_pods():
-                    if podutil.is_tpu_sharing_pod(pod):
-                        self._remember(pod)
-                        self._enqueue(pod)
-                live = {n.name: n for n in self.client.list_nodes()}
-                for name in self.dealer.node_names():
-                    if name not in live:
-                        self.dealer.remove_node(name)
-                for node in live.values():  # catch resizes a dropped
-                    self.dealer.refresh_node(node)  # watch event missed
+                self.resync_once()
             except ApiError as e:
                 log.warning("resync failed: %s", e)
+
+    def resync_once(self) -> None:
+        # snapshot BEFORE the list: a pod bound after the list was taken is
+        # tracked but legitimately missing from the (older) list — only pods
+        # tracked before AND absent after are genuinely gone
+        pre = {p.uid: p for p in self.dealer.tracked_pods()}
+        live_pods = self.client.list_pods()
+        for pod in live_pods:
+            if podutil.is_tpu_sharing_pod(pod):
+                self._remember(pod)
+                self._enqueue(pod)
+        live_uids = {p.uid for p in live_pods}
+        for uid, pod in pre.items():
+            if uid not in live_uids:
+                # DELETED while the pod watch was down: without this diff
+                # its chips stay allocated until scheduler restart (the
+                # missed-DELETE leak; client-go informers get the delta
+                # from their re-list, controller.go:89-123)
+                log.info(
+                    "resync: tracked pod %s vanished from the cluster; "
+                    "releasing", pod.key(),
+                )
+                self.dealer.forget(pod)
+                with self._cache_lock:
+                    self._pod_cache.pop(pod.key(), None)
+        live = {n.name: n for n in self.client.list_nodes()}
+        for name in self.dealer.node_names():
+            if name not in live:
+                self.dealer.remove_node(name)
+        for node in live.values():  # catch resizes a dropped
+            self.dealer.refresh_node(node)  # watch event missed
 
     # -- work side ---------------------------------------------------------
     def _worker(self) -> None:

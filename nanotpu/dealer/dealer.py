@@ -103,6 +103,12 @@ class Dealer:
         self._nodes: dict[str, NodeInfo] = {}
         self._non_tpu: set[str] = set()  # negative cache for _node_info
         self._pods: dict[str, Pod] = {}  # uid -> annotated pod (PodMaps)
+        # uid -> the NodeInfo INSTANCE holding this pod's chip accounting.
+        # A node rebuild (refresh_node) swaps the instance in _nodes; this
+        # map is what lets release/bind tell "my chips are on the current
+        # object" from "my chips are stranded on an orphaned one" — the
+        # identity check that makes the refresh/bind handoff race-free.
+        self._accounted: dict[str, NodeInfo] = {}
         # released-uid tombstones, insertion-ordered for LRU bounding
         # (ReleasedPodMap analogue)
         self._released: dict[str, None] = {}
@@ -138,24 +144,19 @@ class Dealer:
         """Fold an externally-bound pod into chip accounting (replay path,
         dealer.go:279-299 + syncPod Allocate, controller.go:210-243).
 
-        The map insert happens (as a reservation) BEFORE chip accounting so
-        two concurrent syncs of the same pod cannot both allocate — a race
-        the check-then-act version had for fractional demands."""
+        The uid check, the chip allocation, and the map insert are ONE
+        critical section, so two concurrent syncs of the same pod cannot
+        both allocate, and a concurrent refresh_node cannot interleave a
+        replay between our check and our commit. Blocking work (the
+        apiserver GET for an unknown node) happens before the lock."""
         with self._lock:
             if pod.uid in self._pods or pod.uid in self._released:
                 return False
-            self._pods[pod.uid] = pod  # reserve
-
-        def unreserve():
-            with self._lock:
-                self._pods.pop(pod.uid, None)
-
-        info = self._node_info(pod.node_name)
+        info = self._node_info(pod.node_name)  # may GET; no locks held
         if info is None:
             log.warning(
                 "pod %s bound to unknown node %s", pod.key(), pod.node_name
             )
-            unreserve()
             return False
         plan = plan_from_pod(pod)
         if plan is None:
@@ -163,19 +164,30 @@ class Dealer:
                 "pod %s has assume label but missing/corrupt chip annotations; "
                 "leaving unaccounted", pod.key(),
             )
-            unreserve()
             return False
-        try:
-            info.allocate(plan)
-        except ValueError as e:
-            log.error("replaying pod %s onto %s failed: %s", pod.key(), info.name, e)
-            unreserve()
-            return False
-        gang = podutil.gang_of(pod)
-        if gang:
-            self.gangs.record_bound(
-                f"{pod.namespace}/{gang[0]}", gang[1], pod.uid, pod.node_name
-            )
+        with self._lock:
+            if pod.uid in self._pods or pod.uid in self._released:
+                return False  # lost to a concurrent sync / bind / release
+            current = self._nodes.get(pod.node_name)
+            if current is not None:
+                info = current  # node rebuilt while we resolved the plan
+            try:
+                info.allocate(plan)
+            except ValueError as e:
+                log.error(
+                    "replaying pod %s onto %s failed: %s", pod.key(), info.name, e
+                )
+                return False
+            self._pods[pod.uid] = pod
+            self._accounted[pod.uid] = info
+            # gang membership under the same lock as the commit: recording
+            # after a concurrent release() completed would leave a phantom
+            # member that forget_pod never clears (same rule as _bind)
+            gang = podutil.gang_of(pod)
+            if gang:
+                self.gangs.record_bound(
+                    f"{pod.namespace}/{gang[0]}", gang[1], pod.uid, pod.node_name
+                )
         return True
 
     # -- node registry -----------------------------------------------------
@@ -212,7 +224,31 @@ class Dealer:
             if existing is not None:
                 return existing
             self._nodes[name] = new_info
+        # a node can reappear with pods still tracked (node object deleted
+        # and re-created while its pods kept running): their chips live on
+        # the orphaned NodeInfo — migrate them or the fresh instance reads
+        # fully free and double-books (r1 review finding)
+        self._replay_tracked(name)
         return new_info
+
+    def _replay_tracked(self, name: str) -> None:
+        """Migrate tracked pods of node ``name`` whose accounting lives on
+        an orphaned NodeInfo instance onto the current one."""
+        with self._lock:
+            current = self._nodes.get(name)
+            if current is None:
+                return
+            stranded = [
+                p for p in self._pods.values()
+                if p.node_name == name
+                and self._accounted.get(p.uid) is not current
+                and podutil.get_assigned_chips(p) is not None
+            ]
+            for p in stranded:
+                self._pods.pop(p.uid, None)
+                self._accounted.pop(p.uid, None)
+        for p in stranded:
+            self._learn_bound_pod(p)
 
     def observe_node(self, node: Node) -> None:
         """Materialize per-node state for a newly seen/changed node."""
@@ -240,38 +276,39 @@ class Dealer:
             if known:
                 self.remove_node(node.name)
             return known
-        with self._lock:
-            info = self._nodes.get(node.name)
-        if info is not None and NodeInfo.fingerprint_of(node) == info.fingerprint():
-            return False
         # rebuild needed: node is new, REGAINED capacity (remove_node left
         # its pods tracked — a device-plugin restart does exactly this), or
         # drifted. Replay this node's ANNOTATED pods onto fresh accounting.
         # Reservation-only pods (mid-bind, no chip annotations yet) stay in
         # the map untouched — the owning bind thread finishes and detects
-        # the rebuild itself (see _bind's is-current check).
+        # the rebuild itself (see _bind's is-current check). The swap and
+        # the un-tracking are one critical section so no other thread can
+        # see the new NodeInfo while a replayed pod is half-migrated.
         with self._lock:
-            self._nodes.pop(node.name, None)
+            info = self._nodes.get(node.name)
+            if (
+                info is not None
+                and NodeInfo.fingerprint_of(node) == info.fingerprint()
+            ):
+                return False
+            self._nodes[node.name] = NodeInfo(node)
             self._non_tpu.discard(node.name)
-            replay = [
-                p for p in self._pods.values()
-                if p.node_name == node.name
-                and podutil.get_assigned_chips(p) is not None
-            ]
-            for p in replay:
-                self._pods.pop(p.uid, None)
-        self._node_info(node.name, node)
-        for p in replay:
-            self._learn_bound_pod(p)
-        log.info(
-            "node %s rebuilt (new/resized/relabeled): replayed %d pods",
-            node.name, len(replay),
-        )
+        self._replay_tracked(node.name)
+        log.info("node %s rebuilt (new/resized/relabeled)", node.name)
         return info is not None
 
     def node_names(self) -> list[str]:
         with self._lock:
             return sorted(self._nodes)
+
+    def tracked_pods(self) -> list[Pod]:
+        """Snapshot of every pod the dealer currently accounts (bound by us
+        or learned). The resync loop diffs this against the live pod list to
+        release pods DELETED while the watch was down — the informer
+        re-list delta the reference got from client-go
+        (controller.go:89-123)."""
+        with self._lock:
+            return list(self._pods.values())
 
     # -- Assume (Filter verb): dealer.go:89-136 ----------------------------
     def assume(
@@ -400,32 +437,38 @@ class Dealer:
             # tombstoned the uid, but couldn't return the chips (the reserved
             # pod carried no annotations) — undo the allocation here
             raced = pod.uid not in self._pods
+            needs_replay = False
             if not raced:
-                self._pods[pod.uid] = annotated
-                # gang membership must be recorded under the same lock as the
-                # raced check: recording after release() completed would leave
-                # a phantom member that forget_pod never clears
-                gang = podutil.gang_of(pod)
-                if gang:
-                    self.gangs.record_bound(
-                        f"{pod.namespace}/{gang[0]}", gang[1], pod.uid, node_name
-                    )
+                current = self._nodes.get(node_name)
+                if current is None or current is info:
+                    self._pods[pod.uid] = annotated
+                    self._accounted[pod.uid] = info
+                    # gang membership must be recorded under the same lock as
+                    # the raced check: recording after release() completed
+                    # would leave a phantom member forget_pod never clears
+                    gang = podutil.gang_of(pod)
+                    if gang:
+                        self.gangs.record_bound(
+                            f"{pod.namespace}/{gang[0]}", gang[1], pod.uid,
+                            node_name,
+                        )
+                else:
+                    # a refresh_node rebuilt this node while the API writes
+                    # were in flight — our chips live on the orphaned
+                    # NodeInfo. The pod is annotated now; migrate via the
+                    # replay path (outside the lock). Un-track first so the
+                    # replay's uid check passes; refresh cannot double-replay
+                    # because the decision happens under this same lock.
+                    self._pods.pop(pod.uid, None)
+                    self._accounted.pop(pod.uid, None)
+                    needs_replay = True
         if raced:
             info.unbind(plan)
             raise BindError(
                 f"pod {pod.key()} was released while bind was in flight"
             )
-        # a refresh_node may have rebuilt this node's accounting while the
-        # API writes were in flight — our chips then live on the orphaned
-        # NodeInfo. The pod is annotated now, so replaying it moves the
-        # accounting onto the current object.
-        with self._lock:
-            current = self._nodes.get(node_name)
-        if current is not None and current is not info:
-            with self._lock:
-                still_tracked = self._pods.pop(pod.uid, None) is not None
-            if still_tracked:
-                self._learn_bound_pod(annotated)
+        if needs_replay:
+            self._learn_bound_pod(annotated)
         return annotated
 
     def _write_annotations(self, pod: Pod, plan: Plan) -> Pod:
@@ -462,28 +505,41 @@ class Dealer:
         never subtracted — e.g. a pod that completed before our boot, which
         _warm_from_cluster deliberately skipped — over-committing the node.
         """
+        released = False
         with self._lock:
             if pod.uid in self._released:
                 return False
             tracked = self._pods.pop(pod.uid, None)
+            accounted = self._accounted.pop(pod.uid, None)
             self._mark_released(pod.uid)
+            if tracked is not None:
+                plan = plan_from_pod(tracked)
+                if plan is None:
+                    if accounted is not None:
+                        # annotated + accounted but now unreconstructible:
+                        # genuine corruption. (A mid-bind reservation has no
+                        # annotations AND no accounting — the bind thread's
+                        # raced check returns those chips, not us.)
+                        log.error(
+                            "release: pod %s has no reconstructible plan",
+                            pod.key(),
+                        )
+                else:
+                    node = tracked.node_name or pod.node_name
+                    # release on the instance that holds the chips; an
+                    # orphaned instance (node deleted) is harmless garbage
+                    info = accounted or self._nodes.get(node)
+                    if info is not None:
+                        try:
+                            info.release(plan)
+                            released = True
+                        except ValueError as e:
+                            log.error(
+                                "release of %s on %s failed: %s",
+                                pod.key(), node, e,
+                            )
         self.gangs.forget_pod(pod.uid)
-        if tracked is None:
-            return False
-        plan = plan_from_pod(tracked)
-        if plan is None:
-            log.error("release: pod %s has no reconstructible plan", pod.key())
-            return False
-        node = tracked.node_name or pod.node_name
-        info = self._node_info(node)
-        if info is None:
-            return False
-        try:
-            info.release(plan)
-        except ValueError as e:
-            log.error("release of %s on %s failed: %s", pod.key(), node, e)
-            return False
-        return True
+        return released
 
     def forget(self, pod: Pod) -> None:
         """Delete event: release if still accounted, and keep the released

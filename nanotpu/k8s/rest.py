@@ -174,25 +174,40 @@ class RestClientset:
 
     # -- watches -----------------------------------------------------------
     def _watch(self, path: str, wrap) -> Watch:
-        """Long-lived watch that RECONNECTS: the API server closes every
-        watch at its request timeout, and client-go informers transparently
-        re-establish — a stream that dies permanently would silently stop
-        all reconciliation (pods never released, nodes filling forever).
-        Only Watch.stop() by the consumer ends the loop."""
+        """Long-lived watch that RECONNECTS **from the last observed
+        resourceVersion**: the API server closes every watch at its request
+        timeout, and client-go informers transparently re-establish from
+        where they left off — a reconnect from "now" silently drops every
+        event in the gap (the missed-DELETE chip leak). On ``410 Gone`` (the
+        recorded version aged out of etcd) the client re-lists, replays the
+        current objects as ADDED (the informer store-replace analogue —
+        missed DELETEs in that gap are caught by the controller's resync
+        diff), and resumes from the list's fresh resourceVersion. Only
+        Watch.stop() by the consumer ends the loop."""
         watch = Watch()
+
+        def watch_req(rv: str) -> urllib.request.Request:
+            query = "watch=true&allowWatchBookmarks=true"
+            if rv:
+                query += f"&resourceVersion={rv}"
+            req = urllib.request.Request(f"{self.base_url}{path}?{query}")
+            if self.token:
+                req.add_header("Authorization", f"Bearer {self.token}")
+            return req
 
         def run():
             backoff = 1.0
+            rv = ""
             while not watch._stopped.is_set():
-                req = urllib.request.Request(self.base_url + path)
-                if self.token:
-                    req.add_header("Authorization", f"Bearer {self.token}")
+                gone = False
+                srv_err = False
                 try:
                     # read timeout so a half-open TCP connection (silent NAT
                     # drop) raises instead of blocking the watch forever; a
                     # healthy-but-quiet watch also recycles, which is cheap
                     with urllib.request.urlopen(
-                        req, context=self._ctx, timeout=WATCH_READ_TIMEOUT_S
+                        watch_req(rv), context=self._ctx,
+                        timeout=WATCH_READ_TIMEOUT_S,
                     ) as resp:
                         backoff = 1.0
                         for line in resp:
@@ -201,13 +216,67 @@ class RestClientset:
                             if not line.strip():
                                 continue
                             evt = json.loads(line)
-                            watch.push(
-                                WatchEvent(
-                                    evt.get("type", ""), wrap(evt.get("object", {}))
+                            etype = evt.get("type", "")
+                            obj = evt.get("object") or {}
+                            if etype == "ERROR":
+                                # Status object; code 410 = rv expired
+                                gone = obj.get("code") == 410
+                                srv_err = not gone
+                                log.warning(
+                                    "watch %s server error: %s", path,
+                                    obj.get("message", obj),
                                 )
+                                break
+                            new_rv = (obj.get("metadata") or {}).get(
+                                "resourceVersion"
                             )
+                            if new_rv:
+                                rv = new_rv
+                            if etype == "BOOKMARK":
+                                continue  # rv checkpoint only, no object
+                            watch.push(WatchEvent(etype, wrap(obj)))
+                except urllib.error.HTTPError as e:
+                    if e.code == 410:
+                        gone = True
+                    else:
+                        log.warning(
+                            "watch %s dropped (%s); reconnecting", path, e
+                        )
+                        if watch._stopped.wait(backoff):
+                            return
+                        backoff = min(backoff * 2, 30.0)
+                        continue
                 except Exception as e:
                     log.warning("watch %s dropped (%s); reconnecting", path, e)
+                    if watch._stopped.wait(backoff):
+                        return
+                    backoff = min(backoff * 2, 30.0)
+                    continue
+                if gone:
+                    try:
+                        out = self._request("GET", path)
+                    except ApiError as e:
+                        log.warning(
+                            "re-list after 410 on %s failed: %s", path, e
+                        )
+                        rv = ""  # fall back to watching from "now"
+                        if watch._stopped.wait(backoff):
+                            return
+                        backoff = min(backoff * 2, 30.0)
+                        continue
+                    rv = (out.get("metadata") or {}).get("resourceVersion") or ""
+                    items = out.get("items", [])
+                    for item in items:
+                        if watch._stopped.is_set():
+                            return
+                        watch.push(WatchEvent("ADDED", wrap(item)))
+                    log.info(
+                        "watch %s resumed after 410 at rv=%s "
+                        "(%d objects replayed)", path, rv, len(items),
+                    )
+                elif srv_err:
+                    # a persistently erroring stream must not turn into a
+                    # tight reconnect loop against a degraded apiserver
                     if watch._stopped.wait(backoff):
                         return
                     backoff = min(backoff * 2, 30.0)
@@ -216,7 +285,7 @@ class RestClientset:
         return watch
 
     def watch_pods(self) -> Watch:
-        return self._watch("/api/v1/pods?watch=true", Pod)
+        return self._watch("/api/v1/pods", Pod)
 
     def watch_nodes(self) -> Watch:
-        return self._watch("/api/v1/nodes?watch=true", Node)
+        return self._watch("/api/v1/nodes", Node)

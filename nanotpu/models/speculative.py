@@ -55,11 +55,16 @@ def speculative_generate(
     B, S = prompt.shape
     K = draft_tokens
     N = max_new_tokens
-    max_len = max_len or min(cfg.max_seq_len, S + N + K + 1)
-    if S + N + K + 1 > max_len:
+    # tight capacity bound: the last cycle enters at cache length
+    # <= S+N-2 (length tracks S+n-1, and the loop runs while n < N) and
+    # writes K+1 entries, so no write lands past index S+N+K-2 — capacity
+    # S+N+K-1 suffices (the emit buffer's K+1 pad is a separate array)
+    need = S + N + K - 1
+    max_len = max_len or min(cfg.max_seq_len, need)
+    if need > max_len:
         raise ValueError(
-            f"prompt {S} + new {N} + speculation {K + 1} exceeds "
-            f"max_len {max_len} (the verify forward may overshoot by K)"
+            f"prompt {S} + new {N} + speculation overshoot {K - 1} exceeds "
+            f"max_len {max_len}"
         )
 
     # both models prefill the prompt; the target's last-token logits give

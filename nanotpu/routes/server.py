@@ -163,6 +163,8 @@ _STATUS_LINE = {
     200: b"HTTP/1.1 200 OK\r\n",
     400: b"HTTP/1.1 400 Bad Request\r\n",
     404: b"HTTP/1.1 404 Not Found\r\n",
+    411: b"HTTP/1.1 411 Length Required\r\n",
+    414: b"HTTP/1.1 414 URI Too Long\r\n",
     500: b"HTTP/1.1 500 Internal Server Error\r\n",
 }
 
@@ -203,6 +205,13 @@ class _Handler(socketserver.StreamRequestHandler):
             line = self.rfile.readline(8192)
             if not line or line in (b"\r\n", b"\n"):
                 return
+            if len(line) >= 8192 and not line.endswith(b"\n"):
+                # overflowed readline: the continuation would be parsed as
+                # a fresh line, desyncing keep-alive framing (stdlib's
+                # _MAXLINE -> 414/400 behavior)
+                self._write(414, "application/json",
+                            '{"error": "request line too long"}', False)
+                return
             try:
                 method, path, version = line.decode("latin-1").split()
             except ValueError:
@@ -217,6 +226,13 @@ class _Handler(socketserver.StreamRequestHandler):
                 h = self.rfile.readline(8192)
                 if h in (b"\r\n", b"\n", b""):
                     break
+                if len(h) >= 8192 and not h.endswith(b"\n"):
+                    # a header line longer than the cap would be split and
+                    # its tail parsed as a separate header (a Content-Length
+                    # buried past the cap would be lost, desyncing framing)
+                    self._write(400, "application/json",
+                                '{"error": "header line too long"}', False)
+                    return
                 n_headers += 1
                 if n_headers > self.MAX_HEADERS:
                     self._write(400, "application/json",

@@ -101,6 +101,67 @@ class TestReconcile:
         ctrl.stop()
 
 
+class TestMissedDelete:
+    """A pod DELETED while the pod watch is down must still be released:
+    the resync loop diffs dealer-tracked pods against the live list (the
+    client-go informer re-list delta, controller.go:89-123). Without this,
+    the chips leak until scheduler restart (VERDICT r1 weak #1)."""
+
+    def test_pod_deleted_during_watch_outage_released_by_resync(self):
+        client = make_mock_cluster(1)
+        dealer = Dealer(client, make_rater("binpack"))
+        ctrl = Controller(client, dealer, resync_period_s=0.2)
+        ctrl.start()
+        try:
+            pod = client.create_pod(tpu_pod("leaky", 300))
+            dealer.bind("v5p-host-0", pod)
+            assert (
+                dealer.status()["nodes"]["v5p-host-0"]["available_percent"]
+                == 100
+            )
+            # sever the pod watch: every event in this window is lost
+            client._pod_watches.clear()
+            client.delete_pod("default", "leaky")
+            # no DELETED event was delivered — only the resync diff can
+            # return the chips
+            assert wait_for(
+                lambda: dealer.status()["nodes"]["v5p-host-0"][
+                    "available_percent"
+                ] == 400,
+                timeout=5,
+            )
+            assert dealer.status()["assumed_pods"] == 0
+        finally:
+            ctrl.stop()
+
+    def test_resync_does_not_release_freshly_bound_pod(self):
+        """A pod bound while the resync's list is in flight is tracked but
+        absent from the (older) list — the pre-list snapshot must protect
+        it from being treated as vanished."""
+        client = make_mock_cluster(1)
+        dealer = Dealer(client, make_rater("binpack"))
+        ctrl = Controller(client, dealer, resync_period_s=0)
+        pod = client.create_pod(tpu_pod("fresh", 200))
+        original_list = client.list_pods
+
+        def list_then_bind(label_selector=None):
+            # stale list: taken before the pod became visible...
+            out = [p for p in original_list(label_selector) if p.name != "fresh"]
+            # ...while the bind lands before the diff runs
+            if not client.bindings:
+                dealer.bind("v5p-host-0", pod)
+            return out
+
+        client.list_pods = list_then_bind
+        ctrl.resync_once()
+        client.list_pods = original_list
+        # the freshly bound pod must still be tracked and accounted
+        assert dealer.status()["assumed_pods"] == 1
+        assert (
+            dealer.status()["nodes"]["v5p-host-0"]["available_percent"] == 200
+        )
+
+
 class TestNodeResize:
     """Node MODIFIED events with capacity/topology drift rebuild the
     dealer's accounting — the reference ignored resizes entirely (SURVEY
@@ -238,6 +299,43 @@ class TestNodeResize:
         dealer.refresh_node(client.get_node("n0"))
         assert "n0" in dealer.node_names()
         # the bound pod's chips are accounted again — NOT a fresh 0% node
+        assert dealer.occupancy() == pytest.approx(200 / 400)
+
+    def test_node_deleted_then_readded_replays_tracked_pods(self):
+        """Node object deleted and re-created while its pods keep running
+        (apiserver flap): the fresh NodeInfo must not read fully free — the
+        tracked pods' chips migrate onto it (r1 review finding: the
+        fingerprint short-circuit used to block the replay forever)."""
+        from nanotpu import types
+        from nanotpu.allocator.rater import make_rater
+        from nanotpu.dealer import Dealer
+        from nanotpu.k8s.objects import make_container, make_pod, plain_copy
+
+        client = self._cluster(percent=400)
+        dealer = Dealer(client, make_rater("binpack"))
+        pod = client.create_pod(
+            make_pod("p0", containers=[
+                make_container("c", {types.RESOURCE_TPU_PERCENT: 200})
+            ])
+        )
+        dealer.assume(["n0"], pod)
+        dealer.bind("n0", pod)
+        raw = plain_copy(client.get_node("n0").raw)
+
+        client.delete_node("n0")
+        dealer.remove_node("n0")
+        assert "n0" not in dealer.node_names()
+        assert dealer.status()["assumed_pods"] == 1  # pods stay tracked
+
+        from nanotpu.k8s.objects import Node
+
+        client.create_node(Node(raw))
+        dealer.observe_node(client.get_node("n0"))
+        assert "n0" in dealer.node_names()
+        # the running pod's 2 chips are accounted on the fresh instance
+        assert dealer.occupancy() == pytest.approx(200 / 400)
+        # and a later refresh (fingerprint match) stays a no-op
+        assert dealer.refresh_node(client.get_node("n0")) is False
         assert dealer.occupancy() == pytest.approx(200 / 400)
 
     def test_refresh_racing_inflight_bind_keeps_accounting(self):

@@ -173,6 +173,36 @@ class TestOperationalEndpoints:
             resp = s.recv(65536)
             assert b"400" in resp.split(b"\r\n", 1)[0]
 
+    def test_header_line_too_long_rejected(self, app):
+        """A header line past the 8KB readline cap would be split, its tail
+        parsed as a separate header (losing e.g. a Content-Length buried
+        past the cap) and desyncing keep-alive framing — must 400."""
+        import socket
+
+        client, dealer, api, base = app
+        host, port = base.replace("http://", "").split(":")
+        with socket.create_connection((host, int(port)), timeout=5) as s:
+            s.sendall(
+                (
+                    "GET /healthz HTTP/1.1\r\nHost: x\r\n"
+                    f"X-Long: {'a' * 20000}\r\n\r\n"
+                ).encode()
+            )
+            resp = s.recv(65536)
+            assert b"400" in resp.split(b"\r\n", 1)[0]
+
+    def test_request_line_too_long_rejected(self, app):
+        import socket
+
+        client, dealer, api, base = app
+        host, port = base.replace("http://", "").split(":")
+        with socket.create_connection((host, int(port)), timeout=5) as s:
+            s.sendall(
+                (f"GET /{'x' * 20000} HTTP/1.1\r\nHost: x\r\n\r\n").encode()
+            )
+            resp = s.recv(65536)
+            assert b"414" in resp.split(b"\r\n", 1)[0]
+
     def test_malformed_request_line_rejected(self, app):
         import socket
 

@@ -26,6 +26,9 @@ class StubApiServer:
         self.requests: list[tuple[str, str, str]] = []  # method, path, auth
         self.watch_batches: list[list[dict]] = []
         self.watch_connects = 0
+        self.watch_paths: list[str] = []
+        self.watch_fail_next: int | None = None  # HTTP code for next watch
+        self.list_rv = "1000"  # resourceVersion stamped on list responses
         self.fail_next: tuple[int, str] | None = None
         outer = self
 
@@ -52,8 +55,15 @@ class StubApiServer:
                     code, msg = outer.fail_next
                     outer.fail_next = None
                     return self._reply(code, json.dumps({"message": msg}).encode())
-                if self.path.endswith("?watch=true"):
+                if "watch=true" in self.path:
                     outer.watch_connects += 1
+                    outer.watch_paths.append(self.path)
+                    if outer.watch_fail_next:
+                        code = outer.watch_fail_next
+                        outer.watch_fail_next = None
+                        return self._reply(
+                            code, json.dumps({"message": "expired"}).encode()
+                        )
                     batch = (
                         outer.watch_batches.pop(0) if outer.watch_batches else []
                     )
@@ -88,7 +98,11 @@ class StubApiServer:
                         return self._reply(200, json.dumps(outer.pods[key]).encode())
                 if parts[-1] == "pods" and self.command == "GET":  # list
                     return self._reply(
-                        200, json.dumps({"items": list(outer.pods.values())}).encode()
+                        200,
+                        json.dumps({
+                            "metadata": {"resourceVersion": outer.list_rv},
+                            "items": list(outer.pods.values()),
+                        }).encode(),
                     )
                 if parts[-1] == "events" and self.command == "POST":
                     outer.events.append(self._body())
@@ -162,6 +176,80 @@ def test_create_event_posts_v1_event(stub):
     client.create_event("default", {"reason": "TPUAssigned", "metadata": {"name": "e1"}})
     assert stub.events and stub.events[0]["kind"] == "Event"
     assert stub.events[0]["reason"] == "TPUAssigned"
+
+
+def test_watch_resumes_from_last_resource_version(stub):
+    """Reconnects must carry ?resourceVersion=<last observed> — a reconnect
+    from "now" silently drops every event in the gap (the missed-DELETE
+    chip leak, VERDICT r1 #1)."""
+    raw = _pod_raw("a")
+    raw["metadata"]["resourceVersion"] = "41"
+    stub.watch_batches = [[{"type": "ADDED", "object": raw}], []]
+    client = RestClientset(stub.url)
+    watch = client.watch_pods()
+    first = watch.poll(timeout=5)
+    assert first and first.type == "ADDED"
+    deadline = time.time() + 10
+    while stub.watch_connects < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    watch.stop()
+    assert stub.watch_connects >= 2
+    assert "resourceVersion" not in stub.watch_paths[0]
+    assert "resourceVersion=41" in stub.watch_paths[1]
+
+
+def test_watch_bookmark_advances_rv_without_surfacing(stub):
+    bm = {
+        "type": "BOOKMARK",
+        "object": {"kind": "Pod", "metadata": {"resourceVersion": "77"}},
+    }
+    stub.watch_batches = [[bm], []]
+    client = RestClientset(stub.url)
+    watch = client.watch_pods()
+    deadline = time.time() + 10
+    while stub.watch_connects < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert watch.poll(timeout=0.1) is None  # bookmarks are not events
+    watch.stop()
+    assert any("resourceVersion=77" in p for p in stub.watch_paths[1:])
+
+
+def test_watch_410_relists_and_resumes(stub):
+    """Expired resourceVersion (HTTP 410 Gone): re-list, replay the current
+    objects as ADDED (informer store-replace analogue), resume the watch
+    from the list's fresh resourceVersion."""
+    stub.pods["default/p1"] = _pod_raw("p1")
+    stub.list_rv = "2000"
+    stub.watch_fail_next = 410
+    client = RestClientset(stub.url)
+    watch = client.watch_pods()
+    replayed = watch.poll(timeout=10)
+    assert replayed and replayed.type == "ADDED" and replayed.obj.name == "p1"
+    deadline = time.time() + 10
+    while stub.watch_connects < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    watch.stop()
+    assert any("resourceVersion=2000" in p for p in stub.watch_paths[1:])
+
+
+def test_watch_error_event_410_triggers_relist(stub):
+    """The in-stream variant: an ERROR event whose Status carries code 410
+    must behave like HTTP 410 — re-list and resume."""
+    stub.pods["default/p1"] = _pod_raw("p1")
+    stub.list_rv = "3000"
+    stub.watch_batches = [
+        [{"type": "ERROR",
+          "object": {"kind": "Status", "code": 410, "message": "too old"}}],
+    ]
+    client = RestClientset(stub.url)
+    watch = client.watch_pods()
+    replayed = watch.poll(timeout=10)
+    assert replayed and replayed.type == "ADDED" and replayed.obj.name == "p1"
+    deadline = time.time() + 10
+    while stub.watch_connects < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    watch.stop()
+    assert any("resourceVersion=3000" in p for p in stub.watch_paths[1:])
 
 
 def test_watch_reconnects_after_stream_end(stub):
