@@ -224,29 +224,35 @@ class Dealer:
             if existing is not None:
                 return existing
             self._nodes[name] = new_info
-        # a node can reappear with pods still tracked (node object deleted
-        # and re-created while its pods kept running): their chips live on
-        # the orphaned NodeInfo — migrate them or the fresh instance reads
-        # fully free and double-books (r1 review finding)
-        self._replay_tracked(name)
+            # a node can reappear with pods still tracked (node object
+            # deleted and re-created while its pods kept running): their
+            # chips live on the orphaned NodeInfo — migrate them INSIDE the
+            # same critical section, or a concurrent bind sees the fresh
+            # instance as fully free and double-books (r1 review finding)
+            self._replay_tracked(name)
         return new_info
 
     def _replay_tracked(self, name: str) -> None:
         """Migrate tracked pods of node ``name`` whose accounting lives on
-        an orphaned NodeInfo instance onto the current one."""
-        with self._lock:
-            current = self._nodes.get(name)
-            if current is None:
-                return
-            stranded = [
-                p for p in self._pods.values()
-                if p.node_name == name
-                and self._accounted.get(p.uid) is not current
-                and podutil.get_assigned_chips(p) is not None
-            ]
-            for p in stranded:
-                self._pods.pop(p.uid, None)
-                self._accounted.pop(p.uid, None)
+        an orphaned NodeInfo instance onto the current one.
+
+        Caller MUST hold ``self._lock`` (it is an RLock; the nested
+        ``_learn_bound_pod`` commits re-enter it), so no other thread can
+        observe the fresh NodeInfo with the migration half done. Nothing in
+        here blocks: the node is already in the map, so ``_node_info``
+        inside the replay never hits the apiserver."""
+        current = self._nodes.get(name)
+        if current is None:
+            return
+        stranded = [
+            p for p in self._pods.values()
+            if p.node_name == name
+            and self._accounted.get(p.uid) is not current
+            and podutil.get_assigned_chips(p) is not None
+        ]
+        for p in stranded:
+            self._pods.pop(p.uid, None)
+            self._accounted.pop(p.uid, None)
         for p in stranded:
             self._learn_bound_pod(p)
 
@@ -293,7 +299,7 @@ class Dealer:
                 return False
             self._nodes[node.name] = NodeInfo(node)
             self._non_tpu.discard(node.name)
-        self._replay_tracked(node.name)
+            self._replay_tracked(node.name)
         log.info("node %s rebuilt (new/resized/relabeled)", node.name)
         return info is not None
 

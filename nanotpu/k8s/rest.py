@@ -274,6 +274,12 @@ class RestClientset:
                         "watch %s resumed after 410 at rv=%s "
                         "(%d objects replayed)", path, rv, len(items),
                     )
+                    # throttle: a watch cache lagging the list revision 410s
+                    # every reconnect — without a pause this becomes a tight
+                    # full-LIST loop against an already-degraded apiserver
+                    if watch._stopped.wait(min(backoff, 5.0)):
+                        return
+                    backoff = min(backoff * 2, 30.0)
                 elif srv_err:
                     # a persistently erroring stream must not turn into a
                     # tight reconnect loop against a degraded apiserver

@@ -1,0 +1,3 @@
+from nanotpu.serving.engine import Engine, Request, SlotCache
+
+__all__ = ["Engine", "Request", "SlotCache"]

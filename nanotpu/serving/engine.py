@@ -1,0 +1,474 @@
+"""Continuous-batching serving engine over the KV-cache decode path.
+
+The reference has no serving stack at all (it schedules pods; SURVEY §2
+"absent in reference"), but BASELINE's fractional-inference story
+(``examples/fractional-inference.yaml``) needs a server for the scheduled
+pod to run — this is it, designed TPU-first:
+
+* **Slot-based batch, static shapes.** The cache is [SLOTS, max_len] per
+  layer, allocated once. A request is admitted into a free slot at prefill
+  and evicted at eos/max-new; the decode step always runs the full slot
+  batch (inactive rows compute garbage that is never read) so XLA compiles
+  exactly one decode program for the lifetime of the engine.
+* **Per-row cache lengths.** Unlike :class:`nanotpu.models.generate.KVCache`
+  (one scalar ``length``), every slot has its own frontier: rope positions,
+  cache writes, and attention masks are all per-row, which is what lets
+  requests at different depths share one step (the continuous-batching
+  core). Writes use a vmapped dynamic-slice (lowers to scatter at S=1).
+* **Sampling on device.** The step samples inside the jit (per-row
+  temperature; engine-wide top-k/top-p) and returns only the [SLOTS] token
+  vector — one tiny transfer per step, no logits round-trip.
+* **Prefill via the flash path.** Admission reuses
+  :func:`nanotpu.models.generate.prefill` (cache-empty prefills route
+  through the Pallas flash kernel when ``attn_impl="flash"``), padded to a
+  small set of bucket lengths so compile count stays bounded; the row is
+  then inserted into the slot cache with a donated dynamic-slice (no copy
+  of the other slots).
+* **int8 composes for free**: ``linear`` dispatches on QArray leaves, so an
+  engine built from ``quantize_params(params)`` runs weight-only int8.
+
+MoE caveat: expert capacity in ``moe_block`` is computed over the tokens in
+one call; for serving use a ``capacity_factor`` high enough that no token
+drops (C >= SLOTS * top_k at S=1), or routing depends on co-batched rows.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import math
+import threading
+import time
+from collections import deque
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanotpu.models.generate import (
+    NEG_INF,
+    apply_top_k,
+    apply_top_p,
+    prefill,
+)
+from nanotpu.models.llama import (
+    apply_rope,
+    embed_lookup,
+    linear,
+    mlp,
+    rms_norm,
+    rope_freqs,
+)
+
+log = logging.getLogger("nanotpu.serving")
+
+#: Prompt lengths are padded up to one of these before prefill so the
+#: number of compiled prefill programs is bounded (one per bucket).
+DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
+
+
+class SlotCache(NamedTuple):
+    """Per-layer k/v [SLOTS, max_len, KV, hd] + per-row valid lengths."""
+
+    k: tuple
+    v: tuple
+    lengths: jax.Array  # [SLOTS] int32
+
+    @staticmethod
+    def create(cfg, slots: int, max_len: int, dtype=None) -> "SlotCache":
+        shape = (slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+        dt = dtype or jnp.dtype(cfg.dtype)
+        return SlotCache(
+            k=tuple(jnp.zeros(shape, dt) for _ in range(cfg.n_layers)),
+            v=tuple(jnp.zeros(shape, dt) for _ in range(cfg.n_layers)),
+            lengths=jnp.zeros((slots,), jnp.int32),
+        )
+
+
+def _attend_rows(q, k_cache, v_cache, frontier):
+    """q [B,1,H,hd] against cache [B,T,KV,hd]; row b attends positions
+    < frontier[b]. GQA stays unexpanded (broadcast inside the einsum)."""
+    B, S, H, hd = q.shape
+    KV, T = k_cache.shape[2], k_cache.shape[1]
+    rep = H // KV
+    qg = q.reshape(B, S, KV, rep, hd)
+    logits = jnp.einsum("bsgrd,btgd->bgrst", qg, k_cache).astype(jnp.float32)
+    logits = logits * (1.0 / math.sqrt(hd))
+    mask = jnp.arange(T)[None, :] < frontier[:, None]  # [B, T]
+    logits = jnp.where(mask[:, None, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bgrst,btgd->bsgrd", probs, v_cache)
+    return out.reshape(B, S, H, hd)
+
+
+def _write_rows(cache_arr, new, offsets):
+    """Write new [B,1,KV,hd] into cache_arr [B,T,KV,hd] at per-row offsets
+    (vmapped dynamic-slice: each slot's frontier differs — the thing the
+    single-scalar KVCache cannot express)."""
+
+    def one(row, tok, off):
+        return jax.lax.dynamic_update_slice(row, tok.astype(row.dtype), (off, 0, 0))
+
+    return jax.vmap(one)(cache_arr, new, offsets)
+
+
+def serving_step(params, cfg, cache: SlotCache, tokens, active, temps, key,
+                 top_k: int = 0, top_p: float = 1.0):
+    """One decode step for the whole slot batch.
+
+    tokens/active/temps: [SLOTS]; returns (next_tokens [SLOTS], cache with
+    active rows advanced by one). Sampling happens on device: greedy where
+    temps <= 0, temperature/top-k/top-p sampling elsewhere.
+    """
+    B = tokens.shape[0]
+    positions = cache.lengths[:, None]  # [B,1] per-row rope position
+    cos, sin = rope_freqs(cfg, positions)
+    x = embed_lookup(params["embed"], tokens[:, None], jnp.dtype(cfg.dtype))
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    frontier = cache.lengths + 1  # the new token sees itself
+    ks, vs = [], []
+    for i, layer in enumerate(params["layers"]):
+        attn = layer["attn"]
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = linear(h, attn["wq"]).reshape(B, 1, H, hd)
+        k = linear(h, attn["wk"]).reshape(B, 1, KV, hd)
+        v = linear(h, attn["wv"]).reshape(B, 1, KV, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_cache = _write_rows(cache.k[i], k, cache.lengths)
+        v_cache = _write_rows(cache.v[i], v, cache.lengths)
+        out = _attend_rows(q, k_cache, v_cache, frontier)
+        x = x + linear(out.reshape(B, 1, H * hd), attn["wo"])
+        if "moe" in layer:
+            from nanotpu.models.mixtral import moe_block
+
+            ffn_out, _aux = moe_block(
+                layer["moe"], rms_norm(x, layer["moe_norm"], cfg.norm_eps), cfg
+            )
+        else:
+            ffn_out = mlp(
+                layer["mlp"], rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+            )
+        x = x + ffn_out
+        ks.append(k_cache)
+        vs.append(v_cache)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = linear(x[:, -1], params["lm_head"]).astype(jnp.float32)  # [B,V]
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    sl = logits / jnp.maximum(temps, 1e-6)[:, None]
+    if top_k:
+        sl = apply_top_k(sl, top_k)
+    if top_p < 1.0:
+        sl = apply_top_p(sl, top_p)
+    sampled = jax.random.categorical(key, sl, axis=-1).astype(jnp.int32)
+    nxt = jnp.where(temps > 0, sampled, greedy)
+
+    new_lengths = cache.lengths + active.astype(jnp.int32)
+    return nxt, SlotCache(tuple(ks), tuple(vs), new_lengths)
+
+
+def prefill_request(params, cfg, prompt_padded, true_len, max_len,
+                    temp, key, top_k: int = 0, top_p: float = 1.0):
+    """Prefill one request (B=1, padded prompt) and sample its first token.
+
+    Returns (first_token scalar, k rows, v rows) where rows are per-layer
+    [1, max_len, KV, hd] ready for :func:`insert_request`. The pad region's
+    k/v are garbage but sit at positions >= true_len, beyond the row's
+    frontier — never attended."""
+    from nanotpu.models.generate import _run, KVCache
+
+    cache = KVCache.create(cfg, 1, max_len)
+    logits_all, cache = _run(
+        params, prompt_padded, cfg, cache, full_prefill=True, return_all=True
+    )  # [1, S_pad, V]
+    logits = jax.lax.dynamic_index_in_dim(
+        logits_all, true_len - 1, axis=1, keepdims=False
+    )  # [1, V]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    sl = logits / jnp.maximum(temp, 1e-6)
+    if top_k:
+        sl = apply_top_k(sl, top_k)
+    if top_p < 1.0:
+        sl = apply_top_p(sl, top_p)
+    sampled = jax.random.categorical(key, sl, axis=-1).astype(jnp.int32)
+    first = jnp.where(temp > 0, sampled, greedy)[0]
+    return first, cache.k, cache.v
+
+
+def insert_request(cache: SlotCache, ks, vs, slot, length):
+    """Drop a prefilled row into ``slot``: per-layer dynamic-slice on axis 0
+    (donated by the jit wrapper, so no copy of the other slots)."""
+    new_k = tuple(
+        jax.lax.dynamic_update_slice(ck, rk.astype(ck.dtype), (slot, 0, 0, 0))
+        for ck, rk in zip(cache.k, ks)
+    )
+    new_v = tuple(
+        jax.lax.dynamic_update_slice(cv, rv.astype(cv.dtype), (slot, 0, 0, 0))
+        for cv, rv in zip(cache.v, vs)
+    )
+    return SlotCache(new_k, new_v, cache.lengths.at[slot].set(length))
+
+
+class Request:
+    """One generation request; wait() blocks until completion."""
+
+    _ids = itertools.count()
+
+    def __init__(self, tokens: list[int], max_new_tokens: int,
+                 temperature: float = 0.0):
+        self.id = next(self._ids)
+        self.prompt = list(tokens)
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.out: list[int] = []
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: float | None = None
+        self.done_at: float | None = None
+        self.error: str | None = None
+        self._done = threading.Event()
+
+    # -- results -----------------------------------------------------------
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+    @property
+    def ttft_s(self) -> float | None:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def latency_s(self) -> float | None:
+        if self.done_at is None:
+            return None
+        return self.done_at - self.submitted_at
+
+    def _finish(self, error: str | None = None) -> None:
+        self.error = error
+        self.done_at = time.perf_counter()
+        self._done.set()
+
+
+class Engine:
+    """Continuous-batching engine: one background loop interleaves
+    admission prefills with whole-batch decode steps.
+
+    ``slots`` bounds concurrent requests; extras queue. ``eos_id >= 0``
+    stops a row early. ``top_k``/``top_p`` apply engine-wide to sampled
+    (temperature > 0) rows; temperature is per-request.
+    """
+
+    def __init__(self, params, cfg, slots: int = 8, max_len: int | None = None,
+                 buckets: tuple = DEFAULT_BUCKETS, eos_id: int = -1,
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.max_len = max_len or cfg.max_seq_len
+        self.buckets = tuple(b for b in sorted(buckets) if b <= self.max_len)
+        if not self.buckets or self.buckets[-1] < self.max_len:
+            self.buckets = self.buckets + (self.max_len,)
+        self.eos_id = eos_id
+        self.top_k = top_k
+        self.top_p = top_p
+
+        self._key = jax.random.PRNGKey(seed)
+        self._cache = SlotCache.create(cfg, slots, self.max_len)
+        self._slot_req: list[Request | None] = [None] * slots
+        self._tokens = np.zeros((slots,), np.int32)  # last token per slot
+        self._temps = np.zeros((slots,), np.float32)
+        self._queue: deque[Request] = deque()
+        self._cv = threading.Condition()
+        self._stop = False
+
+        # stats (served by /metrics and /v1/stats)
+        self.requests_total = 0
+        self.tokens_total = 0
+        self.ttft_samples: deque[float] = deque(maxlen=4096)
+        self.latency_samples: deque[float] = deque(maxlen=4096)
+
+        # one compiled step for the engine's lifetime; cache donated so the
+        # update is in place (HBM holds ONE slot cache, not two)
+        self._step = jax.jit(
+            lambda params, cache, tokens, active, temps, key: serving_step(
+                params, cfg, cache, tokens, active, temps, key,
+                top_k=self.top_k, top_p=self.top_p,
+            ),
+            donate_argnums=(1,),
+        )
+        self._insert = jax.jit(insert_request, donate_argnums=(0,))
+        self._prefill = jax.jit(
+            lambda params, padded, true_len, temp, key: prefill_request(
+                params, cfg, padded, true_len, self.max_len, temp, key,
+                top_k=self.top_k, top_p=self.top_p,
+            ),
+        )
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="serving-engine"
+        )
+        self._thread.start()
+
+    # -- public API --------------------------------------------------------
+    def submit(self, tokens: list[int], max_new_tokens: int,
+               temperature: float = 0.0) -> Request:
+        req = Request(tokens, max_new_tokens, temperature)
+        if not tokens or max_new_tokens < 1:
+            req._finish("empty prompt or max_new_tokens < 1")
+            return req
+        if len(tokens) >= self.max_len:
+            req._finish(
+                f"prompt length {len(tokens)} >= engine max_len {self.max_len}"
+            )
+            return req
+        with self._cv:
+            self._queue.append(req)
+            self.requests_total += 1
+            self._cv.notify()
+        return req
+
+    def generate(self, tokens: list[int], max_new_tokens: int,
+                 temperature: float = 0.0, timeout: float = 600.0) -> list[int]:
+        """Blocking convenience wrapper."""
+        req = self.submit(tokens, max_new_tokens, temperature)
+        if not req.wait(timeout):
+            raise TimeoutError(f"request {req.id} timed out")
+        if req.error:
+            raise RuntimeError(req.error)
+        return req.out
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=10)
+
+    def stats(self) -> dict:
+        with self._cv:
+            queued = len(self._queue)
+        active = sum(1 for r in self._slot_req if r is not None)
+        ttft = sorted(self.ttft_samples)
+        lat = sorted(self.latency_samples)
+
+        def pct(xs, p):
+            return xs[min(len(xs) - 1, int(p * len(xs)))] if xs else None
+
+        return {
+            "slots": self.slots,
+            "active": active,
+            "queued": queued,
+            "requests_total": self.requests_total,
+            "tokens_total": self.tokens_total,
+            "ttft_p50_ms": pct(ttft, 0.5) and round(pct(ttft, 0.5) * 1e3, 2),
+            "ttft_p99_ms": pct(ttft, 0.99) and round(pct(ttft, 0.99) * 1e3, 2),
+            "latency_p50_ms": pct(lat, 0.5) and round(pct(lat, 0.5) * 1e3, 2),
+        }
+
+    # -- engine loop -------------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _admit_one(self) -> bool:
+        """Pop one queued request into a free slot (one prefill per cycle
+        keeps decode steps flowing for already-admitted rows)."""
+        slot = next(
+            (i for i, r in enumerate(self._slot_req) if r is None), None
+        )
+        if slot is None:
+            return False
+        with self._cv:
+            if not self._queue:
+                return False
+            req = self._queue.popleft()
+        S = len(req.prompt)
+        # cap generation to the cache row
+        req.max_new_tokens = min(req.max_new_tokens, self.max_len - S)
+        bucket = self._bucket(S)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :S] = req.prompt
+        first, ks, vs = self._prefill(
+            self.params, jnp.asarray(padded), jnp.int32(S),
+            jnp.float32(req.temperature), self._next_key(),
+        )
+        self._cache = self._insert(self._cache, ks, vs, jnp.int32(slot),
+                                   jnp.int32(S))
+        tok = int(first)
+        req.first_token_at = time.perf_counter()
+        self.ttft_samples.append(req.ttft_s)
+        req.out.append(tok)
+        self.tokens_total += 1
+        if len(req.out) >= req.max_new_tokens or (
+            self.eos_id >= 0 and tok == self.eos_id
+        ):
+            req._finish()
+            self.latency_samples.append(req.latency_s)
+            return True
+        self._slot_req[slot] = req
+        self._tokens[slot] = tok
+        self._temps[slot] = req.temperature
+        return True
+
+    def _decode_cycle(self) -> None:
+        active_mask = np.array(
+            [r is not None for r in self._slot_req], np.bool_
+        )
+        nxt, self._cache = self._step(
+            self.params, self._cache, jnp.asarray(self._tokens),
+            jnp.asarray(active_mask), jnp.asarray(self._temps),
+            self._next_key(),
+        )
+        nxt = np.asarray(nxt)  # the one host sync per step
+        now = time.perf_counter()
+        for i, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            tok = int(nxt[i])
+            req.out.append(tok)
+            self.tokens_total += 1
+            self._tokens[i] = tok
+            if len(req.out) >= req.max_new_tokens or (
+                self.eos_id >= 0 and tok == self.eos_id
+            ):
+                req.done_at = now
+                req._finish()
+                self.latency_samples.append(req.latency_s)
+                self._slot_req[i] = None
+                self._temps[i] = 0.0
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while (
+                    not self._stop
+                    and not self._queue
+                    and all(r is None for r in self._slot_req)
+                ):
+                    self._cv.wait()
+                if self._stop:
+                    for r in self._slot_req:
+                        if r is not None:
+                            r._finish("engine stopped")
+                    for r in self._queue:
+                        r._finish("engine stopped")
+                    self._queue.clear()
+                    return
+            try:
+                # continuous batching: one admission prefill per cycle, then
+                # a decode step for every active row
+                self._admit_one()
+                if any(r is not None for r in self._slot_req):
+                    self._decode_cycle()
+            except Exception as e:  # fail requests, keep the engine alive
+                log.exception("engine cycle failed")
+                for i, r in enumerate(self._slot_req):
+                    if r is not None:
+                        r._finish(f"engine error: {e}")
+                        self._slot_req[i] = None

@@ -1,0 +1,177 @@
+"""HTTP front end for the serving engine (POST /v1/generate).
+
+Reuses the scheduler's hand-rolled HTTP/1.1 handler (``routes/server.py``
+``serve()`` takes any object with ``dispatch``); per-request handler
+threads block on the engine future, the engine batches across them.
+
+Run:  python -m nanotpu.serving.server --preset tiny --port 8100
+      curl -d '{"tokens": [1,2,3], "max_new_tokens": 8}' localhost:8100/v1/generate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import threading
+import traceback
+
+from nanotpu.metrics.registry import Registry
+from nanotpu.serving.engine import Engine
+
+log = logging.getLogger("nanotpu.serving.http")
+
+#: TTFT/latency buckets (seconds) tuned for decode: 5ms to 60s.
+SERVE_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0
+)
+
+
+class ServingAPI:
+    """dispatch() in the SchedulerAPI shape so routes.server.serve() and the
+    tests' socketless dispatch both work."""
+
+    def __init__(self, engine: Engine, registry: Registry | None = None,
+                 request_timeout_s: float = 600.0):
+        self.engine = engine
+        self.registry = registry or Registry()
+        self.request_timeout_s = request_timeout_s
+        r = self.registry
+        self.req_total = r.counter(
+            "nanotpu_serve_requests_total", "Generation requests"
+        )
+        self.tok_total = r.counter(
+            "nanotpu_serve_tokens_total", "Generated tokens"
+        )
+        self.ttft = r.histogram(
+            "nanotpu_serve_ttft_seconds", "Time to first token",
+            buckets=SERVE_BUCKETS,
+        )
+        self.latency = r.histogram(
+            "nanotpu_serve_latency_seconds", "Whole-request latency",
+            buckets=SERVE_BUCKETS,
+        )
+        self.active = r.gauge(
+            "nanotpu_serve_active_slots", "Requests currently decoding"
+        )
+        self.active.set_function(
+            lambda: sum(1 for x in engine._slot_req if x is not None)
+        )
+
+    def dispatch(self, method: str, path: str, body: bytes) -> tuple[int, str, str]:
+        try:
+            if method == "POST" and path == "/v1/generate":
+                return self._generate(body)
+            if method == "GET" and path == "/v1/stats":
+                return 200, "application/json", json.dumps(self.engine.stats())
+            if method == "GET" and path == "/healthz":
+                return 200, "text/plain", "ok"
+            if method == "GET" and path == "/metrics":
+                return 200, "text/plain; version=0.0.4", self.registry.render()
+            return 404, "application/json", json.dumps(
+                {"error": f"no route {path}"}
+            )
+        except Exception:
+            log.exception("unhandled error on %s %s", method, path)
+            return 500, "application/json", json.dumps(
+                {"error": traceback.format_exc(limit=3)}
+            )
+
+    def _generate(self, body: bytes) -> tuple[int, str, str]:
+        try:
+            args = json.loads(body or b"{}")
+        except json.JSONDecodeError as e:
+            return 400, "application/json", json.dumps(
+                {"error": f"malformed JSON: {e}"}
+            )
+        tokens = args.get("tokens")
+        if not isinstance(tokens, list) or not all(
+            isinstance(t, int) for t in tokens
+        ):
+            return 400, "application/json", json.dumps(
+                {"error": "'tokens' must be a list of ints"}
+            )
+        max_new = args.get("max_new_tokens", 16)
+        temperature = float(args.get("temperature", 0.0))
+        if not isinstance(max_new, int) or max_new < 1:
+            return 400, "application/json", json.dumps(
+                {"error": "'max_new_tokens' must be a positive int"}
+            )
+        req = self.engine.submit(tokens, max_new, temperature)
+        self.req_total.inc()
+        if not req.wait(self.request_timeout_s):
+            return 500, "application/json", json.dumps(
+                {"error": "request timed out"}
+            )
+        if req.error:
+            return 400, "application/json", json.dumps({"error": req.error})
+        self.tok_total.inc(len(req.out))
+        if req.ttft_s is not None:
+            self.ttft.observe(req.ttft_s)
+        if req.latency_s is not None:
+            self.latency.observe(req.latency_s)
+        return 200, "application/json", json.dumps({
+            "id": req.id,
+            "tokens": req.out,
+            "ttft_ms": round(req.ttft_s * 1e3, 2) if req.ttft_s else None,
+            "latency_ms": round(req.latency_s * 1e3, 2) if req.latency_s else None,
+        })
+
+
+def build_engine(preset: str, slots: int, max_len: int, quantize: bool,
+                 attn: str = "auto", eos_id: int = -1) -> Engine:
+    import jax
+
+    from nanotpu.models.llama import LlamaConfig, init_params
+
+    if preset == "flagship":
+        cfg = LlamaConfig(
+            vocab_size=32768, dim=1024, n_layers=12, n_heads=16,
+            n_kv_heads=8, ffn_dim=2816, max_seq_len=max_len,
+            attn_impl=("flash" if attn == "auto" else attn),
+        )
+    elif preset == "tiny":
+        import dataclasses
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), max_seq_len=max_len)
+    else:
+        raise SystemExit(f"unknown preset {preset}")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    if quantize:
+        from nanotpu.models.quant import quantize_params
+
+        params = quantize_params(params)
+    return Engine(params, cfg, slots=slots, max_len=max_len, eos_id=eos_id)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("nanotpu-serve")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("--preset", default="flagship")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--max-len", type=int, default=2048)
+    p.add_argument("--int8", action="store_true", help="weight-only int8")
+    p.add_argument("--eos-id", type=int, default=-1)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    engine = build_engine(
+        args.preset, args.slots, args.max_len, args.int8, eos_id=args.eos_id
+    )
+    api = ServingAPI(engine)
+    from nanotpu.routes.server import serve
+
+    server = serve(api, args.port)
+    log.info("serving on :%d (%d slots, max_len %d)", args.port, args.slots,
+             args.max_len)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.shutdown()
+    engine.stop()
+
+
+if __name__ == "__main__":
+    main()
