@@ -53,7 +53,9 @@ class HttpClient:
         return head
 
     def post(self, path: str, payload) -> dict | list:
-        body = json.dumps(payload).encode()
+        """payload: dict/list, or pre-serialized bytes (filter and
+        priorities carry the SAME ExtenderArgs — serialize once)."""
+        body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
         self.sock.sendall(
             (
                 f"POST {path} HTTP/1.1\r\nHost: x\r\n"
@@ -108,7 +110,7 @@ def run_once() -> tuple[list[float], float, int, float]:
                 },
             )
         )
-        args = {"Pod": pod.raw, "NodeNames": node_names}
+        args = json.dumps({"Pod": pod.raw, "NodeNames": node_names}).encode()
         t0 = time.perf_counter()
         filt = conn.post("/scheduler/filter", args)
         prio = conn.post("/scheduler/priorities", args)

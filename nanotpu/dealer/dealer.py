@@ -29,7 +29,8 @@ from concurrent.futures import ThreadPoolExecutor
 from nanotpu import types
 from nanotpu.allocator.core import Demand, Plan
 from nanotpu.allocator.rater import Rater
-from nanotpu.dealer.gang import GangTracker, gang_affinity_bonus
+from nanotpu.dealer.batch import BatchScorer
+from nanotpu.dealer.gang import GangScorer, GangTracker
 from nanotpu.dealer.nodeinfo import NodeInfo
 from nanotpu.dealer.usage import UsageStore
 from nanotpu.k8s import events
@@ -116,6 +117,13 @@ class Dealer:
             max_workers=assume_workers, thread_name_prefix="assume"
         )
         self.gangs = GangTracker()
+        # candidate-list tuple -> (scorer, known names, non-TPU names,
+        # nodes epoch). kube-scheduler sends the same list every cycle, so
+        # an epoch-validated hit costs one tuple compare (the batched
+        # Filter hot path).
+        self._batch_cache: dict[tuple, tuple] = {}
+        #: bumped on any structural _nodes change; invalidates _batch_cache
+        self._nodes_epoch = 0
         self._warm_from_cluster()
 
     # -- boot-time state reconstruction (dealer.go:58-72) ------------------
@@ -210,12 +218,14 @@ class Dealer:
             except NotFoundError:
                 with self._lock:
                     self._non_tpu.add(name)
+                    self._nodes_epoch += 1
                 return None
             except ApiError:
                 return None
         if not nodeutil.is_tpu_node(node):
             with self._lock:
                 self._non_tpu.add(name)
+                self._nodes_epoch += 1
             return None
         new_info = NodeInfo(node)
         with self._lock:
@@ -224,6 +234,7 @@ class Dealer:
             if existing is not None:
                 return existing
             self._nodes[name] = new_info
+            self._nodes_epoch += 1
             # a node can reappear with pods still tracked (node object
             # deleted and re-created while its pods kept running): their
             # chips live on the orphaned NodeInfo — migrate them INSIDE the
@@ -260,6 +271,7 @@ class Dealer:
         """Materialize per-node state for a newly seen/changed node."""
         with self._lock:
             self._non_tpu.discard(node.name)
+            self._nodes_epoch += 1
         self._node_info(node.name, node)
 
     def remove_node(self, name: str) -> None:
@@ -267,6 +279,7 @@ class Dealer:
         with self._lock:
             self._nodes.pop(name, None)
             self._non_tpu.discard(name)
+            self._nodes_epoch += 1
         self.usage.forget_node(name)
 
     def refresh_node(self, node: Node) -> bool:
@@ -299,6 +312,7 @@ class Dealer:
                 return False
             self._nodes[node.name] = NodeInfo(node)
             self._non_tpu.discard(node.name)
+            self._nodes_epoch += 1
             self._replay_tracked(node.name)
         log.info("node %s rebuilt (new/resized/relabeled)", node.name)
         return info is not None
@@ -316,6 +330,45 @@ class Dealer:
         with self._lock:
             return list(self._pods.values())
 
+    # -- batched scoring fast path -----------------------------------------
+    #: rater name -> prefer_used flag for the native batch engine; raters
+    #: outside this map (random, sample) use the per-node path.
+    _BATCH_POLICIES = {types.POLICY_BINPACK: True, types.POLICY_SPREAD: False}
+
+    def _batch_plan(self, node_names: list[str]):
+        """(scorer, ordered known names, non-TPU names, prefer_used) when
+        every candidate is already materialized and the pool is uniform;
+        None -> per-node path (cold candidates need apiserver GETs, or
+        mixed topologies)."""
+        prefer = self._BATCH_POLICIES.get(self.rater.name)
+        if prefer is None:
+            return None
+        key = tuple(node_names)
+        with self._lock:
+            epoch = self._nodes_epoch
+            entry = self._batch_cache.get(key)
+        if entry is not None and entry[3] == epoch:
+            return entry[0], entry[1], entry[2], prefer
+        with self._lock:
+            pairs = [(n, self._nodes.get(n)) for n in node_names]
+            non_tpu = {
+                n for n, info in pairs if info is None and n in self._non_tpu
+            }
+            epoch = self._nodes_epoch
+        if any(info is None and n not in non_tpu for n, info in pairs):
+            return None  # cold candidates: take the warming per-node path
+        known = [(n, info) for n, info in pairs if info is not None]
+        names_key = tuple(n for n, _ in known)
+        infos = [info for _, info in known]
+        scorer = BatchScorer.build(infos)
+        if scorer is None:
+            return None
+        with self._lock:
+            self._batch_cache[key] = (scorer, names_key, non_tpu, epoch)
+            while len(self._batch_cache) > 8:
+                self._batch_cache.pop(next(iter(self._batch_cache)))
+        return scorer, names_key, non_tpu, prefer
+
     # -- Assume (Filter verb): dealer.go:89-136 ----------------------------
     def assume(
         self, node_names: list[str], pod: Pod
@@ -328,6 +381,24 @@ class Dealer:
                 f"must be whole chips)"
                 for n in node_names
             }
+
+        batch = self._batch_plan(node_names)
+        if batch is not None:
+            scorer, names_key, non_tpu, prefer = batch
+            # pass the gang context even though Filter ignores scores: the
+            # native result is memoized, so the immediately following
+            # Prioritize (same pod, same state) reuses this exact call
+            feasible, _ = scorer.run(
+                demand, prefer, self._gang_member_slices(pod) or None
+            )
+            ok = [n for n, f in zip(names_key, feasible) if f]
+            failed = {
+                n: "insufficient TPU capacity for demand"
+                for n, f in zip(names_key, feasible)
+                if not f
+            }
+            failed.update({n: "not a TPU node" for n in non_tpu})
+            return ok, failed
 
         def try_node(name: str) -> tuple[str, str | None]:
             info = self._node_info(name)
@@ -357,11 +428,9 @@ class Dealer:
         failed = {n: err for n, err in results if err is not None}
         return ok, failed
 
-    # -- Score (Prioritize verb): dealer.go:138-153 ------------------------
-    def score(self, node_names: list[str], pod: Pod) -> list[tuple[str, int]]:
-        demand = Demand.from_pod(pod)
-        if not demand.is_valid():
-            return [(n, types.SCORE_MIN) for n in node_names]
+    def _gang_member_slices(self, pod: Pod) -> list[tuple[str, str]]:
+        """(slice name, coords) of nodes hosting the pod's bound gang
+        members; empty for non-gang pods."""
         gang = podutil.gang_of(pod)
         member_slices: list[tuple[str, str]] = []
         if gang:
@@ -369,6 +438,28 @@ class Dealer:
                 member = self._node_info(node)
                 if member is not None:
                     member_slices.append((member.slice_name, member.slice_coords))
+        return member_slices
+
+    # -- Score (Prioritize verb): dealer.go:138-153 ------------------------
+    def score(self, node_names: list[str], pod: Pod) -> list[tuple[str, int]]:
+        demand = Demand.from_pod(pod)
+        if not demand.is_valid():
+            return [(n, types.SCORE_MIN) for n in node_names]
+        member_slices = self._gang_member_slices(pod)
+
+        batch = self._batch_plan(node_names)
+        if batch is not None:
+            bscorer, names_key, _non_tpu, prefer = batch
+            _, scores = bscorer.run(demand, prefer, member_slices or None)
+            by_name = dict(zip(names_key, scores))
+            return [
+                (n, by_name.get(n, types.SCORE_MIN)) for n in node_names
+            ]
+
+        scorer: GangScorer | None = None
+        if member_slices:
+            # O(members) once; each candidate's bonus is then O(1)
+            scorer = GangScorer(member_slices)
         out = []
         for name in node_names:
             info = self._node_info(name)
@@ -376,10 +467,8 @@ class Dealer:
                 out.append((name, types.SCORE_MIN))
                 continue
             score = info.score(demand, self.rater)
-            if member_slices:
-                bonus = gang_affinity_bonus(
-                    info.slice_name, info.slice_coords, member_slices
-                )
+            if scorer is not None:
+                bonus = scorer.bonus(info.slice_name, info.slice_coords)
                 score = min(types.SCORE_MAX, score + bonus)
             out.append((name, score))
         return out

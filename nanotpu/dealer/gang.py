@@ -94,27 +94,87 @@ def gang_affinity_bonus(
     ``member_slices``: (slice name, "x,y,z" coords) of nodes hosting bound
     members. Unlabeled topology degrades to slice-name matching only.
     """
-    if not member_slices:
-        return 0
-    same_slice = [
-        coords for slc, coords in member_slices if slc and slc == candidate_slice
-    ]
-    if not candidate_slice or not same_slice:
-        return 0
-    base = GANG_BONUS // 2
-    try:
-        cand = parse_slice_coords(candidate_coords) if candidate_coords else None
-        members = [parse_slice_coords(c) for c in same_slice if c]
-    except ValueError:
-        cand, members = None, []
-    if cand is None or not members:
-        return base
-    # compactness of the union of hosts on a PLAIN (non-wrapping) host grid:
-    # the grid is inferred from the coords' bounding box, so assuming
-    # wraparound would make the two most distant hosts look adjacent
-    coords = members + [cand]
-    compact = _grid_compactness(coords)
-    return base + int(round((GANG_BONUS - base) * compact))
+    return GangScorer(member_slices).bonus(candidate_slice, candidate_coords)
+
+
+class GangScorer:
+    """Per-Prioritize-call gang bonus with O(1) per candidate.
+
+    The naive bonus recomputes grid compactness of (members + candidate)
+    from scratch for every candidate — O(members) set work x fan-out, which
+    profiled at ~40% of the 256-host scheduling cycle. The member set is
+    FIXED for the duration of one Prioritize call, so this precomputes, per
+    slice, the members' occupied-cell set and their internal link count
+    once; a candidate then costs six set lookups:
+
+        links(M + {c}) = links(M) + sum_d [c+d in M] + [c-d in M]   (c not in M)
+
+    (+direction adjacency convention counts each link once). Semantics are
+    identical to :func:`gang_affinity_bonus` — equivalence is test-pinned.
+    """
+
+    _DIRS = ((1, 0, 0), (0, 1, 0), (0, 0, 1))
+
+    def __init__(self, member_slices: list[tuple[str, str]]):
+        self.empty = not member_slices
+        # slice -> (cells set, internal links, had_unparsable_coords)
+        self._slices: dict[str, tuple[set, int]] = {}
+        by_slice: dict[str, list[str]] = {}
+        for slc, coords in member_slices:
+            if slc:
+                by_slice.setdefault(slc, []).append(coords)
+        for slc, coord_strs in by_slice.items():
+            try:
+                cells = {parse_slice_coords(c) for c in coord_strs if c}
+            except ValueError:
+                cells = set()
+            links = sum(
+                1
+                for (x, y, z) in cells
+                for d in self._DIRS
+                if (x + d[0], y + d[1], z + d[2]) in cells
+            )
+            self._slices[slc] = (cells, links)
+
+    def bonus(self, candidate_slice: str, candidate_coords: str) -> int:
+        if self.empty:
+            return 0
+        entry = self._slices.get(candidate_slice) if candidate_slice else None
+        if entry is None:
+            return 0  # different slice than every bound member: DCN hop
+        base = GANG_BONUS // 2
+        cells, links = entry
+        if not cells:
+            return base  # members' coords unlabeled/unparsable
+        try:
+            cand = (
+                parse_slice_coords(candidate_coords)
+                if candidate_coords else None
+            )
+        except ValueError:
+            cand = None
+        if cand is None:
+            return base
+        if cand in cells:
+            # colocating with a bound member is zero ICI hops: maximal
+            # (same dedup rule as _grid_compactness)
+            k, total = len(cells), links
+        else:
+            x, y, z = cand
+            total = links + sum(
+                ((x + dx, y + dy, z + dz) in cells)
+                + ((x - dx, y - dy, z - dz) in cells)
+                for dx, dy, dz in self._DIRS
+            )
+            k = len(cells) + 1
+        if k <= 1:
+            compact = 1.0
+        else:
+            from nanotpu.topology import _max_links_for_volume
+
+            best = _max_links_for_volume(k)
+            compact = min(total / best, 1.0) if best else 1.0
+        return base + int(round((GANG_BONUS - base) * compact))
 
 
 def _grid_compactness(coords: list[Coord]) -> float:

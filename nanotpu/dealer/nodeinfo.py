@@ -51,6 +51,9 @@ class NodeInfo:
         self.chips.key = self.name
         #: demand hash -> Plan (node.go:20,44-57)
         self._plan_cache: dict[str, Plan] = {}
+        #: bumped on every chip-state mutation; the batch scorer
+        #: (dealer/batch.py) uses it to refresh only changed rows
+        self.version = 0
 
     def fingerprint(self) -> tuple:
         """Everything placement depends on; a drift means the NodeInfo must
@@ -94,6 +97,7 @@ class NodeInfo:
                 return None
             self.chips.allocate(plan)
             self._plan_cache.clear()
+            self.version += 1
             return plan
 
     def unbind(self, plan: Plan) -> None:
@@ -102,6 +106,7 @@ class NodeInfo:
         with self.lock:
             self.chips.release(plan)
             self._plan_cache.clear()
+            self.version += 1
 
     def allocate(self, plan: Plan) -> None:
         """Account an externally-learned placement (reconciler/boot replay,
@@ -109,12 +114,14 @@ class NodeInfo:
         with self.lock:
             self.chips.allocate(plan)
             self._plan_cache.clear()
+            self.version += 1
 
     def release(self, plan: Plan) -> None:
         """Return a completed pod's chips (node.go:91-94)."""
         with self.lock:
             self.chips.release(plan)
             self._plan_cache.clear()
+            self.version += 1
 
     # -- metrics ingestion -------------------------------------------------
     def set_chip_load(self, chip: int, load: float) -> None:
@@ -123,6 +130,7 @@ class NodeInfo:
                 self.chips.chips[chip].load = max(0.0, min(1.0, load))
                 # load shifts rater scores; cached plans are stale
                 self._plan_cache.clear()
+                self.version += 1
 
     # -- introspection -----------------------------------------------------
     def status(self) -> dict:

@@ -30,7 +30,7 @@ _SRC = os.path.join(_REPO_ROOT, "native", "allocator.cc")
 _LIB = os.path.join(_PKG_DIR, "libnanotpu_alloc.so")
 
 #: must match nanotpu_abi_version() in allocator.cc
-ABI_VERSION = 2
+ABI_VERSION = 3
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -113,6 +113,26 @@ def _load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_int32),  # out_assign
             ctypes.POINTER(ctypes.c_int32),  # out_counts
         ]
+        lib.nanotpu_score_batch.restype = ctypes.c_int32
+        lib.nanotpu_score_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),  # dims[3]
+            ctypes.c_int32,  # n_nodes
+            ctypes.POINTER(ctypes.c_int32),  # free [n*chips]
+            ctypes.POINTER(ctypes.c_int32),  # total [n*chips]
+            ctypes.POINTER(ctypes.c_double),  # load [n*chips]
+            ctypes.c_int32,  # n_demands
+            ctypes.POINTER(ctypes.c_int32),  # demands
+            ctypes.c_int32,  # prefer_used
+            ctypes.c_int32,  # percent_per_chip
+            ctypes.POINTER(ctypes.c_int32),  # node_slice [n] (nullable)
+            ctypes.POINTER(ctypes.c_int32),  # node_coords [n*3] (nullable)
+            ctypes.POINTER(ctypes.c_uint8),  # node_coord_ok [n] (nullable)
+            ctypes.c_int32,  # n_slices
+            ctypes.POINTER(ctypes.c_int32),  # slice_cells [3*total] (nullable)
+            ctypes.POINTER(ctypes.c_int32),  # slice_cell_off [n_slices+1]
+            ctypes.POINTER(ctypes.c_uint8),  # out_feasible [n]
+            ctypes.POINTER(ctypes.c_int32),  # out_score [n]
+        ]
         _lib = lib
         return _lib
 
@@ -123,6 +143,53 @@ def available() -> bool:
 
 class NativeUnavailable(Exception):
     """The native path cannot handle this input; use the Python engine."""
+
+
+def score_batch(
+    dims: tuple[int, int, int],
+    n_nodes: int,
+    free_flat,
+    total_flat,
+    load_flat,
+    demands: list[int],
+    prefer_used: bool,
+    percent_per_chip: int,
+    gang=None,
+):
+    """Feasibility + final score for every node of a uniform pool in ONE
+    native call (Filter/Prioritize fan-out without per-node overhead).
+
+    ``free_flat``/``total_flat`` are ctypes ``c_int32 * (n*chips)`` arrays,
+    ``load_flat`` is ``c_double * (n*chips)`` — callers keep them
+    persistent and update rows in place (see dealer.batch.BatchScorer).
+    ``gang``: None, or a tuple ``(node_slice, node_coords, node_coord_ok,
+    n_slices, slice_cells, slice_cell_off)`` of ctypes arrays encoding the
+    gang members' host cells per slice.
+
+    Returns (feasible: ctypes u8 array, score: ctypes i32 array); raises
+    :class:`NativeUnavailable` when the caller should fall back.
+    """
+    lib = _load()
+    if lib is None:
+        raise NativeUnavailable("native allocator unavailable")
+    nd = len(demands)
+    c_dims = (ctypes.c_int32 * 3)(*dims)
+    c_demands = (ctypes.c_int32 * max(nd, 1))(*demands)
+    out_feasible = (ctypes.c_uint8 * max(n_nodes, 1))()
+    out_score = (ctypes.c_int32 * max(n_nodes, 1))()
+    if gang is None:
+        g = (None, None, None, 0, None, None)
+    else:
+        g = gang
+    rc = lib.nanotpu_score_batch(
+        c_dims, n_nodes, free_flat, total_flat, load_flat, nd, c_demands,
+        1 if prefer_used else 0, percent_per_chip,
+        g[0], g[1], g[2], g[3], g[4], g[5],
+        out_feasible, out_score,
+    )
+    if rc != OK:
+        raise NativeUnavailable(f"native score_batch error {rc}")
+    return out_feasible, out_score
 
 
 def choose(

@@ -54,6 +54,7 @@ def make_mesh(
 def make_hybrid_mesh(
     dcn_dp: int = 0, dp: int = 1, fsdp: int = 1, tp: int = 1, sp: int = 1,
     ep: int = 1, pp: int = 1, devices: list | None = None,
+    slice_of=None,
 ) -> Mesh:
     """Multi-slice mesh: ``dcn_dp`` spans slices over DCN, the remaining
     axes stay inside a slice so their collectives ride ICI.
@@ -67,11 +68,13 @@ def make_hybrid_mesh(
     ``dcn_dp=0`` auto-detects: one slice -> plain :func:`make_mesh`; N
     slices -> dcn_dp=N. Slice membership comes from ``device.slice_index``
     (multi-slice TPU runtimes expose it; hosts without it are one slice).
+    ``slice_of`` overrides the membership function — the multi-slice dry
+    run uses it to partition virtual CPU devices into synthetic slices.
     """
     devices = list(devices if devices is not None else jax.devices())
-    slice_ids = sorted(
-        {getattr(d, "slice_index", 0) for d in devices}
-    )
+    if slice_of is None:
+        slice_of = lambda d: getattr(d, "slice_index", 0)  # noqa: E731
+    slice_ids = sorted({slice_of(d) for d in devices})
     n_slices = len(slice_ids)
     if dcn_dp == 0:
         dcn_dp = n_slices
@@ -88,7 +91,7 @@ def make_hybrid_mesh(
     per_slice = dp * pp * fsdp * tp * sp * ep
     by_slice = {s: [] for s in slice_ids}
     for d in devices:
-        by_slice[getattr(d, "slice_index", 0)].append(d)
+        by_slice[slice_of(d)].append(d)
     for s, ds in by_slice.items():
         if len(ds) != per_slice:
             raise ValueError(

@@ -50,7 +50,9 @@ def _extract(args: dict[str, Any]) -> tuple[Pod, list[str]]:
         node_names = [n for n in node_names if n]
     if not isinstance(node_names, list):
         raise VerbError("ExtenderArgs.NodeNames must be a list")
-    return Pod(pod_raw), [str(n) for n in node_names]
+    if not all(type(n) is str for n in node_names):  # rare: coerce
+        node_names = [str(n) for n in node_names]
+    return Pod(pod_raw), node_names
 
 
 class Predicate:

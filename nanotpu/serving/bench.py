@@ -1,0 +1,96 @@
+"""Serving-engine benchmark: mixed-length request replay on the real chip.
+
+Prints ONE JSON line with engine throughput and TTFT/latency percentiles.
+The workload: a burst of mixed-length prompts plus a trailing arrival
+stream, so the engine exercises both the full-batch steady state and
+continuous admission mid-decode.
+
+  python -m nanotpu.serving.bench                 # bf16 flagship
+  python -m nanotpu.serving.bench --int8          # weight-only int8
+  python -m nanotpu.serving.bench --preset tiny   # CPU smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import time
+
+from nanotpu.serving.server import build_engine
+
+
+def percentile(xs: list[float], p: float) -> float | None:
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+
+def run(preset: str, slots: int, max_len: int, int8: bool, requests: int,
+        max_new: int, seed: int = 0) -> dict:
+    rng = random.Random(seed)
+    engine = build_engine(preset, slots, max_len, int8)
+    cfg = engine.cfg
+    lengths = [64, 128, 256, 512, 1024]
+    lengths = [l for l in lengths if l < max_len - max_new] or [8]
+
+    def mk_prompt(n):
+        return [rng.randrange(1, cfg.vocab_size) for _ in range(n)]
+
+    # warmup: compile prefill per bucket + the decode chunks, untimed
+    for l in lengths:
+        engine.generate(mk_prompt(l), 2)
+    engine.wait_warm(600)
+
+    t0 = time.perf_counter()
+    reqs = []
+    # half the requests burst at t=0 (queue > slots: tests admission under
+    # load), the rest trickle in while earlier ones decode
+    burst = requests // 2
+    for i in range(burst):
+        reqs.append(engine.submit(mk_prompt(rng.choice(lengths)), max_new))
+    for i in range(requests - burst):
+        time.sleep(0.02)
+        reqs.append(engine.submit(mk_prompt(rng.choice(lengths)), max_new))
+    for r in reqs:
+        assert r.wait(1200), f"request {r.id} timed out"
+        assert r.error is None, r.error
+    wall = time.perf_counter() - t0
+    engine.stop()
+
+    gen_tokens = sum(len(r.out) for r in reqs)
+    ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
+    lats = [r.latency_s for r in reqs if r.latency_s is not None]
+    return {
+        "preset": preset,
+        "int8": int8,
+        "slots": slots,
+        "requests": requests,
+        "max_new_tokens": max_new,
+        "prompt_lengths": lengths,
+        "wall_s": round(wall, 3),
+        "decode_tokens_per_s": round(gen_tokens / wall, 1),
+        "ttft_p50_ms": round(percentile(ttfts, 0.5) * 1e3, 1),
+        "ttft_p99_ms": round(percentile(ttfts, 0.99) * 1e3, 1),
+        "latency_p50_ms": round(percentile(lats, 0.5) * 1e3, 1),
+        "latency_p99_ms": round(percentile(lats, 0.99) * 1e3, 1),
+    }
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("nanotpu-serve-bench")
+    p.add_argument("--preset", default="flagship")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--max-len", type=int, default=2048)
+    p.add_argument("--int8", action="store_true")
+    p.add_argument("--requests", type=int, default=48)
+    p.add_argument("--max-new", type=int, default=128)
+    args = p.parse_args(argv)
+    out = run(args.preset, args.slots, args.max_len, args.int8,
+              args.requests, args.max_new)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

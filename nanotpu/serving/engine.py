@@ -45,6 +45,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from nanotpu.models.generate import (
     NEG_INF,
@@ -169,6 +170,46 @@ def serving_step(params, cfg, cache: SlotCache, tokens, active, temps, key,
     return nxt, SlotCache(tuple(ks), tuple(vs), new_lengths)
 
 
+def serving_chunk(params, cfg, cache: SlotCache, tokens, done, temps,
+                  remaining, key, n_steps: int, eos_id: int = -1,
+                  top_k: int = 0, top_p: float = 1.0):
+    """``n_steps`` decode steps in ONE device program (lax.scan).
+
+    The single-step loop costs ~6 host<->device round trips per emitted
+    token (uploads, dispatch, PRNG split, token fetch) — fatal when the
+    chip sits behind a network tunnel and merely wasteful on PCIe. The
+    chunk carries tokens/done/key on device and returns [n_steps, SLOTS]
+    tokens in one fetch: round trips per token drop by n_steps x SLOTS.
+
+    Per-row freezes stay on device so the cache never advances past a
+    stop: ``done`` rows re-feed their token and don't advance ``lengths``;
+    a row freezes when it emits ``eos_id`` or its ``remaining`` budget
+    (tokens still owed) hits zero.
+
+    Returns (cache, tokens, done, remaining, key, toks[n_steps, SLOTS]).
+    """
+
+    def body(carry, _):
+        cache, tok, done, rem, key = carry
+        key, sub = jax.random.split(key)
+        active = ~done
+        nxt, cache = serving_step(
+            params, cfg, cache, tok, active, temps, sub,
+            top_k=top_k, top_p=top_p,
+        )
+        nxt = jnp.where(done, tok, nxt)  # frozen rows hold their token
+        rem = rem - active.astype(jnp.int32)
+        done = done | (rem <= 0)
+        if eos_id >= 0:
+            done = done | (nxt == eos_id)
+        return (cache, nxt, done, rem, key), nxt
+
+    (cache, tokens, done, remaining, key), toks = lax.scan(
+        body, (cache, tokens, done, remaining, key), None, length=n_steps
+    )
+    return cache, tokens, done, remaining, key, toks
+
+
 def prefill_request(params, cfg, prompt_padded, true_len, max_len,
                     temp, key, top_k: int = 0, top_p: float = 1.0):
     """Prefill one request (B=1, padded prompt) and sample its first token.
@@ -262,7 +303,8 @@ class Engine:
 
     def __init__(self, params, cfg, slots: int = 8, max_len: int | None = None,
                  buckets: tuple = DEFAULT_BUCKETS, eos_id: int = -1,
-                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 chunk_steps: int = 32, chunk_steps_max: int = 96):
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -273,12 +315,27 @@ class Engine:
         self.eos_id = eos_id
         self.top_k = top_k
         self.top_p = top_p
+        #: decode steps per device round trip (see serving_chunk). The
+        #: small chunk keeps admission latency low while requests queue;
+        #: the large one amortizes a high-latency link (a tunneled chip
+        #: pays ~100ms per sync) when every row has a long runway.
+        self.chunk_steps = max(1, chunk_steps)
+        self.chunk_steps_max = max(self.chunk_steps, chunk_steps_max)
 
-        self._key = jax.random.PRNGKey(seed)
         self._cache = SlotCache.create(cfg, slots, self.max_len)
         self._slot_req: list[Request | None] = [None] * slots
+        # host mirrors of per-row decode state; re-uploaded when _dirty
         self._tokens = np.zeros((slots,), np.int32)  # last token per slot
         self._temps = np.zeros((slots,), np.float32)
+        self._done = np.ones((slots,), np.bool_)  # empty slots are frozen
+        self._remaining = np.zeros((slots,), np.int32)
+        self._dirty = True
+        # device-resident copies, carried across chunks
+        self._d_tokens = None
+        self._d_temps = None
+        self._d_done = None
+        self._d_remaining = None
+        self._d_key = jax.random.PRNGKey(seed)
         self._queue: deque[Request] = deque()
         self._cv = threading.Condition()
         self._stop = False
@@ -289,15 +346,52 @@ class Engine:
         self.ttft_samples: deque[float] = deque(maxlen=4096)
         self.latency_samples: deque[float] = deque(maxlen=4096)
 
-        # one compiled step for the engine's lifetime; cache donated so the
+        # compiled chunks (small now, large lazily); cache donated so the
         # update is in place (HBM holds ONE slot cache, not two)
-        self._step = jax.jit(
-            lambda params, cache, tokens, active, temps, key: serving_step(
-                params, cfg, cache, tokens, active, temps, key,
-                top_k=self.top_k, top_p=self.top_p,
-            ),
-            donate_argnums=(1,),
-        )
+        def make_chunk(n_steps):
+            return jax.jit(
+                lambda params, cache, tokens, done, temps, rem, key:
+                serving_chunk(
+                    params, cfg, cache, tokens, done, temps, rem, key,
+                    n_steps=n_steps, eos_id=self.eos_id,
+                    top_k=self.top_k, top_p=self.top_p,
+                ),
+                donate_argnums=(1,),
+            )
+
+        self._chunk = make_chunk(self.chunk_steps)
+        # the large chunk compiles in the BACKGROUND (ahead-of-time, on
+        # shape structs — no second cache allocation) so its first use
+        # never stalls the engine loop: an XLA compile is seconds on a big
+        # model, and blocking _decode_cycle would freeze every active row.
+        # Until it is ready the engine simply keeps using the small chunk.
+        self._chunk_large = None
+        self._chunk_large_ready = threading.Event()
+
+        def compile_large():
+            try:
+                sds = lambda x: jax.ShapeDtypeStruct(  # noqa: E731
+                    jnp.shape(x), jnp.result_type(x)
+                )
+                i32 = jax.ShapeDtypeStruct((slots,), jnp.int32)
+                compiled = make_chunk(self.chunk_steps_max).lower(
+                    jax.tree_util.tree_map(sds, self.params),
+                    jax.tree_util.tree_map(sds, self._cache),
+                    i32,  # tokens
+                    jax.ShapeDtypeStruct((slots,), jnp.bool_),  # done
+                    jax.ShapeDtypeStruct((slots,), jnp.float32),  # temps
+                    i32,  # remaining
+                    sds(self._d_key),  # key
+                ).compile()
+                self._chunk_large = compiled
+            except Exception:
+                log.exception("large-chunk compile failed; small chunk only")
+            finally:
+                self._chunk_large_ready.set()
+
+        threading.Thread(
+            target=compile_large, daemon=True, name="chunk-compile"
+        ).start()
         self._insert = jax.jit(insert_request, donate_argnums=(0,))
         self._prefill = jax.jit(
             lambda params, padded, true_len, temp, key: prefill_request(
@@ -338,6 +432,11 @@ class Engine:
             raise RuntimeError(req.error)
         return req.out
 
+    def wait_warm(self, timeout: float | None = None) -> bool:
+        """Block until the background large-chunk compile finished (bench
+        harnesses call this so the compile never lands in a timed window)."""
+        return self._chunk_large_ready.wait(timeout)
+
     def stop(self) -> None:
         with self._cv:
             self._stop = True
@@ -373,75 +472,124 @@ class Engine:
         return self.buckets[-1]
 
     def _next_key(self):
-        self._key, sub = jax.random.split(self._key)
+        self._d_key, sub = jax.random.split(self._d_key)
         return sub
 
-    def _admit_one(self) -> bool:
-        """Pop one queued request into a free slot (one prefill per cycle
-        keeps decode steps flowing for already-admitted rows)."""
-        slot = next(
-            (i for i, r in enumerate(self._slot_req) if r is None), None
-        )
-        if slot is None:
-            return False
-        with self._cv:
-            if not self._queue:
-                return False
-            req = self._queue.popleft()
-        S = len(req.prompt)
-        # cap generation to the cache row
-        req.max_new_tokens = min(req.max_new_tokens, self.max_len - S)
-        bucket = self._bucket(S)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :S] = req.prompt
-        first, ks, vs = self._prefill(
-            self.params, jnp.asarray(padded), jnp.int32(S),
-            jnp.float32(req.temperature), self._next_key(),
-        )
-        self._cache = self._insert(self._cache, ks, vs, jnp.int32(slot),
-                                   jnp.int32(S))
-        tok = int(first)
-        req.first_token_at = time.perf_counter()
-        self.ttft_samples.append(req.ttft_s)
-        req.out.append(tok)
-        self.tokens_total += 1
-        if len(req.out) >= req.max_new_tokens or (
-            self.eos_id >= 0 and tok == self.eos_id
-        ):
-            req._finish()
-            self.latency_samples.append(req.latency_s)
-            return True
-        self._slot_req[slot] = req
-        self._tokens[slot] = tok
-        self._temps[slot] = req.temperature
-        return True
+    def _admit_all(self) -> None:
+        """Move queued requests into free slots.
 
-    def _decode_cycle(self) -> None:
-        active_mask = np.array(
-            [r is not None for r in self._slot_req], np.bool_
-        )
-        nxt, self._cache = self._step(
-            self.params, self._cache, jnp.asarray(self._tokens),
-            jnp.asarray(active_mask), jnp.asarray(self._temps),
-            self._next_key(),
-        )
-        nxt = np.asarray(nxt)  # the one host sync per step
+        Prefills are DISPATCHED per request (async, cheap) but their first
+        tokens are fetched with ONE stacked sync at the end — on a
+        high-latency link a per-admission int(first) sync would cost a
+        full round trip per request."""
+        admitted: list[tuple[Request, int, jax.Array]] = []
+        while True:
+            slot = next(
+                (i for i, r in enumerate(self._slot_req) if r is None
+                 and all(a[1] != i for a in admitted)),
+                None,
+            )
+            if slot is None:
+                break
+            with self._cv:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+            S = len(req.prompt)
+            # cap generation to the cache row
+            req.max_new_tokens = min(req.max_new_tokens, self.max_len - S)
+            bucket = self._bucket(S)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :S] = req.prompt
+            first, ks, vs = self._prefill(
+                self.params, jnp.asarray(padded), jnp.int32(S),
+                jnp.float32(req.temperature), self._next_key(),
+            )
+            self._cache = self._insert(self._cache, ks, vs, jnp.int32(slot),
+                                       jnp.int32(S))
+            admitted.append((req, slot, first))
+        if not admitted:
+            return
+        firsts = np.asarray(jnp.stack([f for _, _, f in admitted]))
         now = time.perf_counter()
-        for i, req in enumerate(self._slot_req):
-            if req is None:
-                continue
-            tok = int(nxt[i])
+        for (req, slot, _), tok in zip(admitted, firsts):
+            tok = int(tok)
+            req.first_token_at = now
+            self.ttft_samples.append(req.ttft_s)
             req.out.append(tok)
             self.tokens_total += 1
-            self._tokens[i] = tok
             if len(req.out) >= req.max_new_tokens or (
                 self.eos_id >= 0 and tok == self.eos_id
             ):
+                req._finish()
+                self.latency_samples.append(req.latency_s)
+                continue
+            self._slot_req[slot] = req
+            self._tokens[slot] = tok
+            self._temps[slot] = req.temperature
+            self._done[slot] = False
+            self._remaining[slot] = req.max_new_tokens - 1  # first already out
+            self._dirty = True
+
+    def _decode_cycle(self) -> None:
+        """One chunk of decode steps, then host-side bookkeeping.
+
+        The device carries tokens/done/remaining between chunks; host
+        mirrors are uploaded only when admission/eviction changed them
+        (``_dirty``). The chunk's [n_steps, SLOTS] token block comes back
+        in one fetch — the only mandatory round trip."""
+        if self._dirty:
+            self._d_tokens = jnp.asarray(self._tokens)
+            self._d_temps = jnp.asarray(self._temps)
+            self._d_done = jnp.asarray(self._done)
+            self._d_remaining = jnp.asarray(self._remaining)
+            self._dirty = False
+        # Chunk policy: an oversized chunk is harmless to CORRECTNESS
+        # (rows freeze on device at eos/max-new; extra steps compute
+        # discarded garbage), so the only reason to run a small chunk is
+        # admission latency — a finished row can only be refilled at a
+        # sync. Queue empty -> large chunk (amortize the link RTT);
+        # requests waiting -> small chunk (free slots turn over quickly).
+        with self._cv:
+            queued = bool(self._queue)
+        chunk = self._chunk
+        if not queued and self._chunk_large is not None:
+            chunk = self._chunk_large
+        (
+            self._cache, self._d_tokens, self._d_done, self._d_remaining,
+            self._d_key, toks,
+        ) = chunk(
+            self.params, self._cache, self._d_tokens, self._d_done,
+            self._d_temps, self._d_remaining, self._d_key,
+        )
+        toks = np.asarray(toks)  # [n_steps, SLOTS]; the one host sync
+        now = time.perf_counter()
+        # every row's carried token (frozen rows hold theirs) — keeps the
+        # host mirror upload-ready for the next admission
+        self._tokens = toks[-1].astype(np.int32).copy()
+        for i, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            # replay the device's freeze logic to pick the real tokens
+            for k in range(toks.shape[0]):
+                if self._done[i]:
+                    break
+                tok = int(toks[k, i])
+                req.out.append(tok)
+                self.tokens_total += 1
+                self._remaining[i] -= 1
+                if self._remaining[i] <= 0 or (
+                    self.eos_id >= 0 and tok == self.eos_id
+                ):
+                    self._done[i] = True
+            if self._done[i]:
                 req.done_at = now
                 req._finish()
                 self.latency_samples.append(req.latency_s)
                 self._slot_req[i] = None
                 self._temps[i] = 0.0
+                # device `done` is already True for this row — eviction
+                # alone doesn't require a re-upload
 
     def _loop(self) -> None:
         while True:
@@ -461,9 +609,9 @@ class Engine:
                     self._queue.clear()
                     return
             try:
-                # continuous batching: one admission prefill per cycle, then
-                # a decode step for every active row
-                self._admit_one()
+                # continuous batching: fill every free slot, then run one
+                # decode chunk for the active rows
+                self._admit_all()
                 if any(r is not None for r in self._slot_req):
                     self._decode_cycle()
             except Exception as e:  # fail requests, keep the engine alive
@@ -472,3 +620,6 @@ class Engine:
                     if r is not None:
                         r._finish(f"engine error: {e}")
                         self._slot_req[i] = None
+                        self._done[i] = True
+                        self._temps[i] = 0.0
+                self._dirty = True

@@ -21,8 +21,17 @@
 
 #include <cstdint>
 #include <algorithm>
+#include <cmath>
 #include <tuple>
 #include <vector>
+
+// Error/result codes (shared by the public entry points below).
+enum {
+  NANOTPU_OK = 1,
+  NANOTPU_INFEASIBLE = 0,
+  NANOTPU_ERR_TOO_BIG = -1,
+  NANOTPU_ERR_BAD_ARGS = -2,
+};
 
 namespace {
 
@@ -172,20 +181,197 @@ uint64_t grow_connected(const Adjacency& adj, int seed, int k, uint64_t allowed)
 
 int min_bit(uint64_t mask) { return __builtin_ctzll(mask); }
 
+// Core per-node placement (the body of nanotpu_choose, reusable by the
+// batch entry point). Fills out_masks[i] with the chip bitmask assigned to
+// demand i. Returns NANOTPU_OK or NANOTPU_INFEASIBLE.
+int choose_node(const Torus& t, const Adjacency& adj,
+                const int32_t* free_percent, const int32_t* total_percent,
+                const double* load, int32_t n_demands, const int32_t* demands,
+                int32_t prefer_used, int32_t percent_per_chip,
+                uint64_t* out_masks) {
+  std::vector<int32_t> free_(free_percent, free_percent + t.n);
+
+  // demand order: index list stable-sorted by percent descending
+  std::vector<int> order(n_demands);
+  for (int i = 0; i < n_demands; ++i) order[i] = i;
+  std::stable_sort(order.begin(), order.end(), [&](int l, int r) {
+    return demands[l] > demands[r];
+  });
+
+  for (int i = 0; i < n_demands; ++i) out_masks[i] = 0;
+
+  auto boundary_contact = [&](uint64_t box) {
+    int contact = 0;
+    uint64_t rest = box;
+    while (rest) {
+      int c = __builtin_ctzll(rest);
+      rest &= rest - 1;
+      uint64_t outside = adj.nbr[c] & ~box;
+      while (outside) {
+        int nb = __builtin_ctzll(outside);
+        outside &= outside - 1;
+        if (free_[nb] < total_percent[nb]) ++contact;
+      }
+    }
+    return contact;
+  };
+
+  for (int i : order) {
+    int percent = demands[i];
+    if (percent <= 0) continue;
+    if (percent >= percent_per_chip) {
+      int k = percent / percent_per_chip;
+      uint64_t fully_free = 0;
+      for (int c = 0; c < t.n; ++c)
+        if (free_[c] == total_percent[c]) fully_free |= 1ULL << c;
+      std::vector<uint64_t> candidates;
+      for (uint64_t box : placements_for(t, k))
+        if ((box & ~fully_free) == 0) candidates.push_back(box);
+      if (candidates.empty()) {
+        uint64_t ff = fully_free;
+        while (ff) {
+          int seed = __builtin_ctzll(ff);
+          ff &= ff - 1;
+          uint64_t grown = grow_connected(adj, seed, k, fully_free);
+          if (grown &&
+              std::find(candidates.begin(), candidates.end(), grown) ==
+                  candidates.end())
+            candidates.push_back(grown);
+        }
+      }
+      if (candidates.empty()) return NANOTPU_INFEASIBLE;
+      uint64_t best = candidates[0];
+      if (prefer_used) {
+        int bc = boundary_contact(best), bm = min_bit(best);
+        for (size_t j = 1; j < candidates.size(); ++j) {
+          int c2 = boundary_contact(candidates[j]), m2 = min_bit(candidates[j]);
+          if (c2 > bc || (c2 == bc && m2 < bm)) {
+            best = candidates[j]; bc = c2; bm = m2;
+          }
+        }
+      } else {
+        int bc = boundary_contact(best), bm = min_bit(best);
+        for (size_t j = 1; j < candidates.size(); ++j) {
+          int c2 = boundary_contact(candidates[j]), m2 = min_bit(candidates[j]);
+          if (c2 < bc || (c2 == bc && m2 < bm)) {
+            best = candidates[j]; bc = c2; bm = m2;
+          }
+        }
+      }
+      uint64_t rest = best;
+      while (rest) {
+        int c = __builtin_ctzll(rest);
+        rest &= rest - 1;
+        free_[c] = 0;
+      }
+      out_masks[i] = best;
+    } else {
+      int pick = -1;
+      double pick_uf = 0.0, pick_load = 0.0;
+      for (int c = 0; c < t.n; ++c) {
+        if (free_[c] < percent) continue;
+        double uf = total_percent[c]
+                        ? 1.0 - static_cast<double>(free_[c]) / total_percent[c]
+                        : 0.0;
+        if (pick < 0) {
+          pick = c; pick_uf = uf; pick_load = load[c];
+          continue;
+        }
+        if (prefer_used) {
+          if (uf > pick_uf ||
+              (uf == pick_uf && load[c] < pick_load)) {
+            pick = c; pick_uf = uf; pick_load = load[c];
+          }
+        } else {
+          if (uf < pick_uf ||
+              (uf == pick_uf && load[c] < pick_load)) {
+            pick = c; pick_uf = uf; pick_load = load[c];
+          }
+        }
+      }
+      if (pick < 0) return NANOTPU_INFEASIBLE;
+      free_[pick] -= percent;
+      out_masks[i] = 1ULL << pick;
+    }
+  }
+  return NANOTPU_OK;
+}
+
+// topology.py _max_links_for_volume: max internal nearest-neighbor links of
+// any k-cell 3D polycube, via greedy lexicographic fill of every box base.
+int compute_max_links(int k) {
+  if (k <= 1) return 0;
+  int best = 0;
+  for (int a = 1; a <= k; ++a) {
+    for (int b = a; b <= k; ++b) {
+      int c = (k + a * b - 1) / (a * b);
+      int links = 0;
+      std::vector<uint8_t> cells(a * b * c, 0);
+      auto idx = [&](int x, int y, int z) { return (z * b + y) * a + x; };
+      int placed = 0;
+      for (int z = 0; z < c && placed < k; ++z)
+        for (int y = 0; y < b && placed < k; ++y)
+          for (int x = 0; x < a && placed < k; ++x) {
+            if (x > 0 && cells[idx(x - 1, y, z)]) ++links;
+            if (y > 0 && cells[idx(x, y - 1, z)]) ++links;
+            if (z > 0 && cells[idx(x, y, z - 1)]) ++links;
+            cells[idx(x, y, z)] = 1;
+            ++placed;
+          }
+      best = std::max(best, links);
+      if (a * b >= k) break;
+    }
+  }
+  return best;
+}
+
+int max_links_for_volume(int k) {
+  // whole table built once under C++11's thread-safe magic-static init:
+  // concurrent verb threads call in here with the GIL released (ctypes),
+  // so a lazily-written per-entry cache would be a data race
+  static const std::vector<int> table = [] {
+    std::vector<int> t(kMaxChips + 2, 0);
+    for (int i = 2; i <= kMaxChips + 1; ++i) t[i] = compute_max_links(i);
+    return t;
+  }();
+  if (k <= 1) return 0;
+  if (k <= kMaxChips + 1) return table[k];
+  return compute_max_links(k);
+}
+
+// topology.py Torus.compactness: internal torus ICI links of the set over
+// the best achievable for that volume, capped at 1.0.
+double set_compactness(const Torus& t, const Adjacency& adj, uint64_t mask) {
+  int k = __builtin_popcountll(mask);
+  if (k <= 1) return 1.0;
+  int twice_links = 0;  // adjacency is symmetric: each link counted twice
+  uint64_t rest = mask;
+  while (rest) {
+    int c = __builtin_ctzll(rest);
+    rest &= rest - 1;
+    twice_links += __builtin_popcountll(adj.nbr[c] & mask);
+  }
+  int links = twice_links / 2;
+  int best = max_links_for_volume(k);
+  if (best == 0) return 1.0;
+  double ratio = static_cast<double>(links) / best;
+  return ratio < 1.0 ? ratio : 1.0;
+}
+
+// rater.py clamp_score: int() truncates toward zero, then clamp [0, 100].
+int clamp_score(double s) {
+  int v = static_cast<int>(s);
+  if (v < 0) return 0;
+  if (v > 100) return 100;
+  return v;
+}
+
 }  // namespace
 
 extern "C" {
 
-// Error/result codes.
-enum {
-  NANOTPU_OK = 1,
-  NANOTPU_INFEASIBLE = 0,
-  NANOTPU_ERR_TOO_BIG = -1,
-  NANOTPU_ERR_BAD_ARGS = -2,
-};
-
 // ABI version so the ctypes loader can reject stale builds.
-int32_t nanotpu_abi_version() { return 2; }
+int32_t nanotpu_abi_version() { return 3; }
 
 // Place `n_demands` container demands onto one node's torus.
 //
@@ -220,122 +406,196 @@ int32_t nanotpu_choose(const int32_t dims[3],
   if (t.n <= 0 || t.n > kMaxChips) return NANOTPU_ERR_TOO_BIG;
   Adjacency adj(t);
 
-  std::vector<int32_t> free_(free_percent, free_percent + t.n);
-
-  // demand order: index list stable-sorted by percent descending
-  std::vector<int> order(n_demands);
-  for (int i = 0; i < n_demands; ++i) order[i] = i;
-  std::stable_sort(order.begin(), order.end(), [&](int l, int r) {
-    return demands[l] > demands[r];
-  });
-
-  std::vector<std::vector<int>> assignments(n_demands);
-
-  auto boundary_contact = [&](uint64_t box) {
-    int contact = 0;
-    uint64_t rest = box;
-    while (rest) {
-      int c = __builtin_ctzll(rest);
-      rest &= rest - 1;
-      uint64_t outside = adj.nbr[c] & ~box;
-      while (outside) {
-        int nb = __builtin_ctzll(outside);
-        outside &= outside - 1;
-        if (free_[nb] < total_percent[nb]) ++contact;
-      }
-    }
-    return contact;
-  };
-
-  for (int i : order) {
-    int percent = demands[i];
-    if (percent <= 0) continue;
-    if (percent >= percent_per_chip) {
-      int k = percent / percent_per_chip;
-      uint64_t fully_free = 0;
-      for (int c = 0; c < t.n; ++c)
-        if (free_[c] == total_percent[c]) fully_free |= 1ULL << c;
-      // candidates: sub-boxes inside fully_free, else grown connected sets
-      std::vector<uint64_t> candidates;
-      for (uint64_t box : placements_for(t, k))
-        if ((box & ~fully_free) == 0) candidates.push_back(box);
-      if (candidates.empty()) {
-        uint64_t ff = fully_free;
-        while (ff) {
-          int seed = __builtin_ctzll(ff);
-          ff &= ff - 1;
-          uint64_t grown = grow_connected(adj, seed, k, fully_free);
-          if (grown &&
-              std::find(candidates.begin(), candidates.end(), grown) ==
-                  candidates.end())
-            candidates.push_back(grown);
-        }
-      }
-      if (candidates.empty()) return NANOTPU_INFEASIBLE;
-      uint64_t best = candidates[0];
-      if (prefer_used) {
-        // max(key=(contact, -min_chip)), first occurrence wins ties
-        int bc = boundary_contact(best), bm = min_bit(best);
-        for (size_t j = 1; j < candidates.size(); ++j) {
-          int c2 = boundary_contact(candidates[j]), m2 = min_bit(candidates[j]);
-          if (c2 > bc || (c2 == bc && m2 < bm)) {
-            best = candidates[j]; bc = c2; bm = m2;
-          }
-        }
-      } else {
-        // min(key=(contact, min_chip)), first occurrence wins ties
-        int bc = boundary_contact(best), bm = min_bit(best);
-        for (size_t j = 1; j < candidates.size(); ++j) {
-          int c2 = boundary_contact(candidates[j]), m2 = min_bit(candidates[j]);
-          if (c2 < bc || (c2 == bc && m2 < bm)) {
-            best = candidates[j]; bc = c2; bm = m2;
-          }
-        }
-      }
-      uint64_t rest = best;
-      while (rest) {
-        int c = __builtin_ctzll(rest);
-        rest &= rest - 1;
-        free_[c] = 0;
-        assignments[i].push_back(c);  // ctzll scan is ascending == sorted
-      }
-    } else {
-      int pick = -1;
-      double pick_uf = 0.0, pick_load = 0.0;
-      for (int c = 0; c < t.n; ++c) {
-        if (free_[c] < percent) continue;
-        double uf = total_percent[c]
-                        ? 1.0 - static_cast<double>(free_[c]) / total_percent[c]
-                        : 0.0;
-        if (pick < 0) {
-          pick = c; pick_uf = uf; pick_load = load[c];
-          continue;
-        }
-        if (prefer_used) {
-          // max(key=(used_frac, -load, -c)): scan ascending, replace on
-          // strictly-greater key (lower c wins ties automatically)
-          if (uf > pick_uf ||
-              (uf == pick_uf && load[c] < pick_load)) {
-            pick = c; pick_uf = uf; pick_load = load[c];
-          }
-        } else {
-          // min(key=(used_frac, load, c))
-          if (uf < pick_uf ||
-              (uf == pick_uf && load[c] < pick_load)) {
-            pick = c; pick_uf = uf; pick_load = load[c];
-          }
-        }
-      }
-      if (pick < 0) return NANOTPU_INFEASIBLE;
-      free_[pick] -= percent;
-      assignments[i].push_back(pick);
-    }
-  }
+  std::vector<uint64_t> masks(std::max<int32_t>(n_demands, 1), 0);
+  int rc = choose_node(t, adj, free_percent, total_percent, load, n_demands,
+                       demands, prefer_used, percent_per_chip, masks.data());
+  if (rc != NANOTPU_OK) return rc;
 
   int32_t* cursor = out_assign;
   for (int i = 0; i < n_demands; ++i) {
-    out_counts[i] = static_cast<int32_t>(assignments[i].size());
-    for (int c : assignments[i]) *cursor++ = c;
+    int32_t count = 0;
+    uint64_t rest = masks[i];
+    while (rest) {
+      int c = __builtin_ctzll(rest);  // ascending scan == sorted ids
+      rest &= rest - 1;
+      *cursor++ = c;
+      ++count;
+    }
+    out_counts[i] = count;
+  }
+  return NANOTPU_OK;
+}
+
+// Score EVERY candidate node of a uniform pool in one call — the Filter/
+// Prioritize fan-out without per-node Python or ctypes overhead (the
+// reference ran a 4-goroutine pool over per-node work, dealer.go:107-134).
+//
+//   dims[3], percent_per_chip   shared by all nodes (uniform pool)
+//   n_nodes                     candidate count
+//   free/total (i32), load (f64)   flattened [n_nodes * chips_per_node]
+//   demands[n_demands]          the pod's per-container chip-percents
+//   prefer_used                 1 = binpack, 0 = spread (also picks the
+//                               Rate formula, rater.py Binpack/Spread.rate)
+//   gang inputs (all may be null when the pod is not in a gang):
+//     node_slice[n_nodes]       index into the member-slice tables, -1 if
+//                               the node's slice hosts no gang member
+//     node_coords[n_nodes*3] / node_coord_ok[n_nodes]
+//                               parsed host coords (ok=0: unparsable)
+//     n_slices, slice_cells[3*total], slice_cell_off[n_slices+1]
+//                               per-slice DEDUPED member host cells
+//   out_feasible[n_nodes]       1 if a placement exists
+//   out_score[n_nodes]          rater score + compactness band + gang
+//                               bonus, clamped to [0, 100] (SCORE_MIN for
+//                               infeasible nodes)
+//
+// Parity: out_feasible matches NodeInfo.assume != None and out_score
+// matches Dealer.score per node — fuzz-enforced in tests/test_native.py.
+int32_t nanotpu_score_batch(const int32_t dims[3],
+                            int32_t n_nodes,
+                            const int32_t* free_percent,
+                            const int32_t* total_percent,
+                            const double* load,
+                            int32_t n_demands,
+                            const int32_t* demands,
+                            int32_t prefer_used,
+                            int32_t percent_per_chip,
+                            const int32_t* node_slice,
+                            const int32_t* node_coords,
+                            const uint8_t* node_coord_ok,
+                            int32_t n_slices,
+                            const int32_t* slice_cells,
+                            const int32_t* slice_cell_off,
+                            uint8_t* out_feasible,
+                            int32_t* out_score) {
+  if (!dims || !free_percent || !total_percent || !load || !demands ||
+      !out_feasible || !out_score || n_nodes < 0 || n_demands < 0 ||
+      percent_per_chip <= 0)
+    return NANOTPU_ERR_BAD_ARGS;
+  Torus t(dims);
+  if (t.n <= 0 || t.n > kMaxChips) return NANOTPU_ERR_TOO_BIG;
+  Adjacency adj(t);
+
+  // precompute per-slice member internal links (+direction convention on a
+  // PLAIN grid — gang.py GangScorer)
+  struct SliceInfo { std::vector<int32_t> cells; int links; };
+  std::vector<SliceInfo> slices;
+  if (n_slices > 0 && slice_cells && slice_cell_off) {
+    slices.resize(n_slices);
+    for (int s = 0; s < n_slices; ++s) {
+      int lo = slice_cell_off[s], hi = slice_cell_off[s + 1];
+      auto& si = slices[s];
+      for (int i = lo; i < hi; ++i) {
+        si.cells.push_back(slice_cells[3 * i]);
+        si.cells.push_back(slice_cells[3 * i + 1]);
+        si.cells.push_back(slice_cells[3 * i + 2]);
+      }
+      int links = 0;
+      int m = (hi - lo);
+      auto has = [&](int x, int y, int z) {
+        for (int j = 0; j < m; ++j)
+          if (si.cells[3 * j] == x && si.cells[3 * j + 1] == y &&
+              si.cells[3 * j + 2] == z)
+            return true;
+        return false;
+      };
+      for (int j = 0; j < m; ++j) {
+        int x = si.cells[3 * j], y = si.cells[3 * j + 1], z = si.cells[3 * j + 2];
+        if (has(x + 1, y, z)) ++links;
+        if (has(x, y + 1, z)) ++links;
+        if (has(x, y, z + 1)) ++links;
+      }
+      si.links = links;
+    }
+  }
+
+  // gang bonus for one node (gang.py GangScorer.bonus); 0 when the node's
+  // slice hosts no member. Applied to infeasible nodes too: Dealer.score
+  // adds the bonus onto SCORE_MIN for them (parity quirk — kube-scheduler
+  // only ranks Filter-passing nodes, so it is harmless there).
+  auto gang_bonus = [&](int nidx) -> int {
+    if (!node_slice || slices.empty()) return 0;
+    int s = node_slice[nidx];
+    if (s < 0 || s >= (int)slices.size()) return 0;
+    const SliceInfo& si = slices[s];
+    const int kBase = 15;  // GANG_BONUS // 2
+    int m = (int)si.cells.size() / 3;
+    if (m == 0 || !node_coord_ok || !node_coord_ok[nidx] || !node_coords)
+      return kBase;
+    int x = node_coords[3 * nidx], y = node_coords[3 * nidx + 1],
+        z = node_coords[3 * nidx + 2];
+    bool colocated = false;
+    int add = 0;
+    for (int j = 0; j < m; ++j) {
+      int cx = si.cells[3 * j], cy = si.cells[3 * j + 1],
+          cz = si.cells[3 * j + 2];
+      if (cx == x && cy == y && cz == z) { colocated = true; break; }
+      int dx = cx - x, dy = cy - y, dz = cz - z;
+      if ((dx == 1 || dx == -1) && dy == 0 && dz == 0) ++add;
+      else if (dx == 0 && (dy == 1 || dy == -1) && dz == 0) ++add;
+      else if (dx == 0 && dy == 0 && (dz == 1 || dz == -1)) ++add;
+    }
+    int k2, links2;
+    if (colocated) { k2 = m; links2 = si.links; }
+    else { k2 = m + 1; links2 = si.links + add; }
+    double compact2;
+    if (k2 <= 1) compact2 = 1.0;
+    else {
+      int best2 = max_links_for_volume(k2);
+      compact2 = best2 ? std::min((double)links2 / best2, 1.0) : 1.0;
+    }
+    // int(round(x)): banker's rounding, like Python round()
+    return kBase + (int)__builtin_nearbyint(15.0 * compact2);
+  };
+
+  std::vector<uint64_t> masks(std::max<int32_t>(n_demands, 1), 0);
+  for (int nidx = 0; nidx < n_nodes; ++nidx) {
+    const int32_t* free_n = free_percent + (size_t)nidx * t.n;
+    const int32_t* total_n = total_percent + (size_t)nidx * t.n;
+    const double* load_n = load + (size_t)nidx * t.n;
+    int rc = choose_node(t, adj, free_n, total_n, load_n, n_demands, demands,
+                         prefer_used, percent_per_chip, masks.data());
+    if (rc == NANOTPU_INFEASIBLE) {
+      out_feasible[nidx] = 0;
+      int score = 0 + gang_bonus(nidx);  // SCORE_MIN + bonus
+      out_score[nidx] = score > 100 ? 100 : score;
+      continue;
+    }
+    if (rc != NANOTPU_OK) return rc;
+    out_feasible[nidx] = 1;
+
+    // Rate on the PRE-assignment state (rater.py Binpack/Spread.rate)
+    long total_sum = 0, used_sum = 0, avail = 0;
+    int free_chips = 0;
+    double load_sum = 0.0;
+    for (int c = 0; c < t.n; ++c) {
+      total_sum += total_n[c];
+      used_sum += total_n[c] - free_n[c];
+      avail += free_n[c];
+      if (free_n[c] == total_n[c]) ++free_chips;
+      load_sum += load_n[c];
+    }
+    double mean_load = t.n ? load_sum / t.n : 0.0;
+    int base;
+    if (prefer_used) {
+      double usage = total_sum ? (double)used_sum / total_sum : 0.0;
+      base = clamp_score(usage * 100.0 - mean_load * 50.0);
+    } else {
+      double denom = total_sum ? (double)total_sum : 1.0;
+      double score = 60.0 * ((double)free_chips / (t.n ? t.n : 1)) +
+                     40.0 * ((double)avail / denom);
+      base = clamp_score(score - mean_load * 50.0);
+    }
+
+    // compactness band over the union of assigned chips (rater._finalize;
+    // COMPACTNESS_BAND = 10)
+    uint64_t all_mask = 0;
+    for (int i = 0; i < n_demands; ++i) all_mask |= masks[i];
+    double compact = all_mask ? set_compactness(t, adj, all_mask) : 1.0;
+    int score = clamp_score(std::min(base, 100 - 10) + compact * 10.0);
+
+    score += gang_bonus(nidx);
+    if (score > 100) score = 100;
+    out_score[nidx] = score;
   }
   return NANOTPU_OK;
 }

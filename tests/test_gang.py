@@ -71,6 +71,37 @@ class TestAffinityScoring:
         members2 = [("slice-0", "0,0,0"), ("slice-0", "1,0,0")]
         assert gang_affinity_bonus("slice-0", "0,0,0", members2) == GANG_BONUS
 
+    def test_scorer_matches_from_scratch_compactness(self):
+        """GangScorer's incremental link count must equal recomputing grid
+        compactness of (members + candidate) from scratch — the original
+        algorithm, inlined here as the oracle (fuzzed)."""
+        import random
+
+        from nanotpu.dealer.gang import GangScorer, _grid_compactness
+        from nanotpu.topology import parse_slice_coords
+
+        rng = random.Random(7)
+        for _ in range(300):
+            n_members = rng.randrange(1, 12)
+            members = [
+                (
+                    "slice-0",
+                    f"{rng.randrange(4)},{rng.randrange(4)},{rng.randrange(2)}",
+                )
+                for _ in range(n_members)
+            ]
+            cand = f"{rng.randrange(4)},{rng.randrange(4)},{rng.randrange(2)}"
+
+            base = GANG_BONUS // 2
+            coords = [parse_slice_coords(c) for _, c in members] + [
+                parse_slice_coords(cand)
+            ]
+            expect = base + int(
+                round((GANG_BONUS - base) * _grid_compactness(coords))
+            )
+            got = GangScorer(members).bonus("slice-0", cand)
+            assert got == expect, (members, cand)
+
     def test_tracker_lifecycle(self):
         t = GangTracker()
         t.record_bound("g", 4, "u1", "n1")

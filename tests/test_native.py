@@ -122,6 +122,162 @@ class TestParityFuzz:
         assert native_choose(chips, demand, True) == [[], []]
 
 
+class TestScoreBatchParity:
+    """nanotpu_score_batch (one call over all candidates) must agree with
+    the per-node path — NodeInfo.assume feasibility, rater score +
+    compactness, and the gang affinity bonus — for every node."""
+
+    def _make_infos(self, rng, n_nodes, dims):
+        from nanotpu.dealer.nodeinfo import NodeInfo
+        from nanotpu.k8s.objects import make_node
+
+        chip_count = dims[0] * dims[1] * dims[2]
+        infos = []
+        for i in range(n_nodes):
+            node = make_node(
+                f"bn-{i}",
+                {types.RESOURCE_TPU_PERCENT: chip_count * 100},
+                labels={
+                    types.LABEL_TPU_GENERATION: "v5p",
+                    types.LABEL_TPU_TOPOLOGY: "x".join(map(str, dims)),
+                    types.LABEL_TPU_ENABLE: types.LABEL_TPU_ENABLE_VALUE,
+                    types.LABEL_TPU_SLICE: f"slice-{i % 3}",
+                    types.LABEL_TPU_SLICE_COORDS: (
+                        f"{rng.randrange(4)},{rng.randrange(4)},0"
+                    ),
+                },
+            )
+            info = NodeInfo(node)
+            # randomize occupancy/load in place (bump version like the
+            # real mutation paths do)
+            with info.lock:
+                for chip in info.chips.chips:
+                    r = rng.random()
+                    if r < 0.45:
+                        chip.percent_free = chip.percent_total
+                    elif r < 0.6:
+                        chip.percent_free = 0
+                    else:
+                        chip.percent_free = rng.randrange(
+                            1, types.PERCENT_PER_CHIP
+                        )
+                    chip.load = rng.choice([0.0, 0.0, round(rng.random(), 3)])
+                info.version += 1
+            infos.append(info)
+        return infos
+
+    @pytest.mark.parametrize("policy", ["binpack", "spread"])
+    def test_fuzz_matches_per_node_path(self, policy):
+        from nanotpu.dealer.batch import BatchScorer
+        from nanotpu.dealer.gang import GangScorer
+
+        rng = random.Random(20260730 + len(policy))
+        rater = make_rater(policy)
+        prefer = policy == "binpack"
+        for trial in range(60):
+            dims = rng.choice([(2, 2, 1), (2, 4, 1), (2, 2, 2), (4, 4, 1)])
+            n_nodes = rng.randrange(2, 9)
+            infos = self._make_infos(rng, n_nodes, dims)
+            scorer = BatchScorer.build(infos)
+            assert scorer is not None
+            demand = random_demand(rng, dims[0] * dims[1] * dims[2])
+            if not demand.is_valid():
+                continue
+            # random gang member set (sometimes empty)
+            member_slices = []
+            if rng.random() < 0.6:
+                for _ in range(rng.randrange(1, 5)):
+                    member_slices.append((
+                        f"slice-{rng.randrange(3)}",
+                        f"{rng.randrange(4)},{rng.randrange(4)},0",
+                    ))
+            feas, scores = scorer.run(
+                demand, prefer, member_slices or None
+            )
+            gs = GangScorer(member_slices) if member_slices else None
+            for idx, info in enumerate(infos):
+                plan = info.assume(demand, rater)
+                assert feas[idx] == (plan is not None), (
+                    trial, idx, demand.percents
+                )
+                expect = info.score(demand, rater)
+                if gs is not None:
+                    expect = min(
+                        types.SCORE_MAX,
+                        expect + gs.bonus(info.slice_name, info.slice_coords),
+                    )
+                assert scores[idx] == expect, (
+                    trial, idx, demand.percents, member_slices,
+                    [c.percent_free for c in info.chips.chips],
+                    [c.load for c in info.chips.chips],
+                )
+
+    def test_refresh_tracks_mutations(self):
+        from nanotpu.dealer.batch import BatchScorer
+
+        rng = random.Random(7)
+        infos = self._make_infos(rng, 3, (2, 2, 1))
+        rater = make_rater("binpack")
+        scorer = BatchScorer.build(infos)
+        demand = Demand(container_names=["c"], percents=[100])
+        feas1, s1 = scorer.run(demand, True)
+        # mutate one node through the real path and re-run
+        plan = infos[0].bind(demand, rater)
+        assert plan is not None
+        feas2, s2 = scorer.run(demand, True)
+        assert feas2[0] == (infos[0].assume(demand, rater) is not None)
+        assert s2[0] == infos[0].score(demand, rater)
+        # untouched nodes unchanged
+        assert (feas1[1], s1[1]) == (feas2[1], s2[1])
+
+
+class TestDealerBatchPath:
+    """Dealer.assume/score through the batched path must equal the forced
+    per-node path on the same cluster state."""
+
+    def test_end_to_end_equivalence(self):
+        from nanotpu.allocator.rater import make_rater
+        from nanotpu.cmd.main import make_mock_cluster
+        from nanotpu.dealer import Dealer
+        from nanotpu.k8s.objects import make_container, make_pod
+
+        client = make_mock_cluster(8, 4)
+        dealer = Dealer(client, make_rater("binpack"))
+        nodes = [f"v5p-host-{i}" for i in range(8)]
+        rng = random.Random(3)
+        for i in range(6):
+            pod = client.create_pod(
+                make_pod(
+                    f"eq-{i}",
+                    containers=[make_container(
+                        "c", {types.RESOURCE_TPU_PERCENT: rng.choice(
+                            [50, 100, 200]
+                        )}
+                    )],
+                    annotations={
+                        types.ANNOTATION_GANG_NAME: "g",
+                        types.ANNOTATION_GANG_SIZE: "6",
+                    },
+                )
+            )
+            fast_ok, fast_failed = dealer.assume(nodes, pod)
+            fast_scores = dealer.score(nodes, pod)
+            # force the per-node path
+            saved = dealer._BATCH_POLICIES
+            dealer._BATCH_POLICIES = {}
+            try:
+                slow_ok, slow_failed = dealer.assume(nodes, pod)
+                slow_scores = dealer.score(nodes, pod)
+            finally:
+                dealer._BATCH_POLICIES = saved
+            assert fast_ok == slow_ok
+            assert fast_failed == slow_failed
+            assert fast_scores == slow_scores
+            if fast_ok:
+                best = max(fast_ok, key=lambda n: dict(fast_scores)[n])
+                dealer.bind(best, pod)
+
+
 class TestDispatch:
     def test_rater_uses_native_and_matches(self):
         """Binpack/Spread.choose (which dispatch through the native engine)
