@@ -205,6 +205,20 @@ def apply_top_p(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(logits < threshold, NEG_INF, logits)
 
 
+def warp_logits(logits: jax.Array, temperature: float, top_k: int = 0,
+                top_p: float = 1.0) -> jax.Array:
+    """Shared sampling warp: temperature, then top-k, then nucleus (the
+    common filter order). generate() and speculative decoding both use
+    THIS function — the rejection-sampling equivalence guarantee depends
+    on one definition of the warped target distribution."""
+    logits = logits / temperature
+    if top_k:
+        logits = apply_top_k(logits, top_k)
+    if top_p < 1.0:
+        logits = apply_top_p(logits, top_p)
+    return logits
+
+
 def generate(
     params, prompt: jax.Array, cfg: LlamaConfig, max_new_tokens: int,
     temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
@@ -235,12 +249,7 @@ def generate(
     def sample(logits, key):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / temperature
-        # filter order follows the common convention: k first, then p
-        if top_k:
-            logits = apply_top_k(logits, top_k)
-        if top_p < 1.0:
-            logits = apply_top_p(logits, top_p)
+        logits = warp_logits(logits, temperature, top_k, top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     first = sample(logits, first_key)

@@ -191,6 +191,15 @@ def attention(params: dict, x: jax.Array, cfg: LlamaConfig,
 
         out = ring_attention_sharded(q, k, v, causal=True)
         return linear(out.reshape(B, S, H * hd), params["wo"])
+    if cfg.attn_impl == "ring_manual":
+        # already INSIDE a manual region that owns the sp axis (the pp
+        # pipeline's joint {"pp","sp"} shard_map): x/cos/sin are the LOCAL
+        # sequence shard, so call the per-shard ring directly — a nested
+        # shard_map would try to re-bind the parent's axes (sdy rejects it)
+        from nanotpu.parallel.ring_attention import ring_attention
+
+        out = ring_attention(q, k, v, axis_name="sp", causal=True)
+        return linear(out.reshape(B, S, H * hd), params["wo"])
     # GQA: repeat kv heads to full head count (XLA turns this into a
     # broadcast inside the einsum, no materialized copy)
     if KV != H:

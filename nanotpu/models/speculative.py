@@ -37,18 +37,68 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from nanotpu.models.generate import KVCache, _run, prefill
+from nanotpu.models.generate import KVCache, _run, prefill, warp_logits
+
+
+def _warp(logits, temperature: float, top_k: int, top_p: float):
+    """generate()'s warp chain as probabilities: the acceptance test must
+    compare the SAME warped distributions on both sides, and the emitted
+    distribution must be the one generate() samples."""
+    return jax.nn.softmax(
+        warp_logits(logits, temperature, top_k, top_p).astype(jnp.float32),
+        axis=-1,
+    )
+
+
+def rejection_step(p_probs, q_probs, drafts, accept_key, resample_key):
+    """One batched rejection-sampling decision per (row, position).
+
+    p_probs/q_probs: [B, K, V] warped target/draft distributions;
+    drafts: [B, K] tokens sampled from q. Returns (accepted [B, K] bool,
+    resampled [B, K] tokens from the residual norm(max(p - q, 0))).
+
+    The emitted process is EXACTLY p per position (Leviathan et al.):
+    accept x~q with prob min(1, p(x)/q(x)); on rejection sample from the
+    residual. q(x) > 0 for sampled x, so the ratio is well-defined; a
+    numerically all-zero residual (p ~= q) falls back to p itself.
+    """
+    B, K, V = p_probs.shape
+    p_x = jnp.take_along_axis(p_probs, drafts[..., None], axis=-1)[..., 0]
+    q_x = jnp.take_along_axis(q_probs, drafts[..., None], axis=-1)[..., 0]
+    u = jax.random.uniform(accept_key, (B, K))
+    accepted = u * q_x < p_x  # u < p/q without the division
+    residual = jnp.maximum(p_probs - q_probs, 0.0)
+    mass = residual.sum(axis=-1, keepdims=True)
+    residual = jnp.where(mass > 0, residual / jnp.maximum(mass, 1e-20), p_probs)
+    resampled = jax.random.categorical(
+        resample_key, jnp.log(jnp.maximum(residual, 1e-38)), axis=-1
+    ).astype(jnp.int32)
+    return accepted, resampled
 
 
 def speculative_generate(
     params, draft_params, prompt: jax.Array, cfg, draft_cfg,
     max_new_tokens: int, draft_tokens: int = 4,
     max_len: int | None = None, eos_id: int = -1,
-) -> jax.Array:
-    """Greedy generation of ``max_new_tokens`` from the target ``params``,
+    temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+    rng: jax.Array | None = None, return_stats: bool = False,
+):
+    """Generation of ``max_new_tokens`` from the target ``params``,
     accelerated by ``draft_params``. Returns [B, max_new_tokens] tokens
-    identical to ``generate(params, ..., temperature=0)`` (same ``eos_id``
-    semantics: positions after a row's first eos repeat eos).
+    (or ``(tokens, stats)`` with ``return_stats``; stats =
+    {accepted, drafted, cycles} for the acceptance rate).
+
+    ``temperature=0`` (default): greedy — OUTPUT-EQUIVALENT to
+    ``generate(params, ..., temperature=0)``, see below. ``temperature>0``:
+    standard speculative REJECTION sampling (accept draft token x~q with
+    prob min(1, p(x)/q(x)), else sample the residual norm(max(p-q, 0));
+    all-accepted cycles emit a bonus token from the target's K+1-th
+    distribution) — every emitted token is distributed EXACTLY as the
+    warped target distribution p, independent of draft quality, which only
+    sets the speedup. top_k/top_p warp p and q identically before the
+    acceptance test. Multi-row batches advance by the MINIMUM acceptance
+    across rows (re-drawn positions are fresh, valid samples of p, so
+    correctness is unaffected).
 
     ``draft_tokens`` (K, static) is the speculation depth per cycle.
     """
@@ -67,23 +117,34 @@ def speculative_generate(
             f"max_len {max_len}"
         )
 
+    sampled = temperature > 0.0
+    key = rng if rng is not None else jax.random.PRNGKey(0)
+
     # both models prefill the prompt; the target's last-token logits give
     # the first emitted token
     t_logits, t_cache = prefill(params, prompt, cfg, max_len)
     _, d_cache = prefill(draft_params, prompt, draft_cfg, max_len)
-    first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B]
+    if sampled:
+        key, sub = jax.random.split(key)
+        first = jax.random.categorical(
+            sub, jnp.log(jnp.maximum(_warp(t_logits, temperature, top_k, top_p), 1e-38)),
+            axis=-1,
+        ).astype(jnp.int32)
+    else:
+        first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B]
 
     # emit buffer padded by K+1 so the final cycle's full write stays
     # in bounds; only [:N] is returned
     out0 = jnp.zeros((B, N + K + 1), jnp.int32)
     out0 = out0.at[:, 0].set(first)
 
-    def cond(carry):
-        _, _, _, n, _ = carry
-        return n < N
+    zero = jnp.zeros((), jnp.int32)
 
-    def body(carry):
-        t_cache, d_cache, out, n, cur = carry
+    def cond(carry):
+        return carry[3] < N
+
+    def greedy_body(carry):
+        t_cache, d_cache, out, n, cur, _key, acc, cyc = carry
 
         # -- draft K proposals (K+1 steps: the extra step feeds d_K so its
         #    cache entry exists if every proposal is accepted) -------------
@@ -121,10 +182,73 @@ def speculative_generate(
         # overwritten by the next cycle's writes at `length`
         t_cache = t_cache._replace(length=t_cache.length - (K + 1) + a + 1)
         d_cache = d_cache._replace(length=d_cache.length - (K + 1) + a + 1)
-        return t_cache, d_cache, out, n, cur
+        return t_cache, d_cache, out, n, cur, _key, acc + a, cyc + 1
 
-    _, _, out, _, _ = lax.while_loop(
-        cond, body, (t_cache, d_cache, out0, jnp.ones((), jnp.int32), first)
+    def sampled_body(carry):
+        t_cache, d_cache, out, n, cur, key, acc, cyc = carry
+        key, k_draft, k_accept, k_resample, k_bonus = jax.random.split(key, 5)
+
+        # -- draft K proposals, keeping each step's warped distribution ----
+        def draft_scan(carry, step_key):
+            dc, tok = carry
+            logits, dc = _run(draft_params, tok[:, None], draft_cfg, dc)
+            q = _warp(logits, temperature, top_k, top_p)  # [B, V]
+            nxt = jax.random.categorical(
+                step_key, jnp.log(jnp.maximum(q, 1e-38)), axis=-1
+            ).astype(jnp.int32)
+            return (dc, nxt), (nxt, q)
+
+        draft_keys = jax.random.split(k_draft, K + 1)
+        (d_cache, _), (drafts, q_all) = lax.scan(
+            draft_scan, (d_cache, cur), draft_keys
+        )
+        drafts = jnp.moveaxis(drafts, 0, 1)  # [B, K+1]
+        q_probs = jnp.moveaxis(q_all, 0, 1)[:, :K]  # [B, K, V]
+
+        # -- target verifies cur + d1..dK in one forward -------------------
+        verify_tokens = jnp.concatenate([cur[:, None], drafts[:, :K]], axis=1)
+        v_logits, t_cache = _run(
+            params, verify_tokens, cfg, t_cache, return_all=True
+        )  # [B, K+1, V]
+        p_all = _warp(v_logits, temperature, top_k, top_p)  # [B, K+1, V]
+
+        accepted, resampled = rejection_step(
+            p_all[:, :K], q_probs, drafts[:, :K], k_accept, k_resample
+        )
+        a_rows = jnp.cumprod(accepted.astype(jnp.int32), axis=1).sum(axis=1)
+        a = jnp.min(a_rows)  # shared advance (min over rows)
+
+        # bonus: every row accepted all K -> draw from the target's K+1-th
+        # distribution (no residual: nothing was rejected there)
+        bonus = jax.random.categorical(
+            k_bonus, jnp.log(jnp.maximum(p_all[:, K], 1e-38)), axis=-1
+        ).astype(jnp.int32)
+
+        # token at emit position a: the row accepted further -> its draft;
+        # rejected exactly at a -> the residual resample; a == K -> bonus
+        draft_a = lax.dynamic_index_in_dim(drafts, a, 1, keepdims=False)
+        res_a = lax.dynamic_index_in_dim(
+            jnp.concatenate([resampled, resampled[:, -1:]], axis=1),
+            a, 1, keepdims=False,
+        )
+        tok_a = jnp.where(
+            a_rows > a, draft_a, jnp.where(a == K, bonus, res_a)
+        )
+        # positions < a are all-accepted drafts; positions beyond a are
+        # overwritten by later cycles before they can be read
+        emit = jnp.concatenate([drafts[:, :K], drafts[:, -1:]], axis=1)
+        emit = lax.dynamic_update_slice(emit, tok_a[:, None], (0, a))
+        out = lax.dynamic_update_slice(out, emit, (0, n))
+
+        n = n + a + 1
+        t_cache = t_cache._replace(length=t_cache.length - (K + 1) + a + 1)
+        d_cache = d_cache._replace(length=d_cache.length - (K + 1) + a + 1)
+        return t_cache, d_cache, out, n, tok_a, key, acc + a, cyc + 1
+
+    _, _, out, _, _, _, acc, cyc = lax.while_loop(
+        cond, sampled_body if sampled else greedy_body,
+        (t_cache, d_cache, out0, jnp.ones((), jnp.int32), first, key,
+         zero, zero),
     )
     out = out[:, :N]
     if eos_id >= 0:
@@ -135,4 +259,6 @@ def speculative_generate(
         is_eos = (out == eos_id).astype(jnp.int32)
         after_first = (jnp.cumsum(is_eos, axis=1) - is_eos) > 0
         out = jnp.where(after_first, eos_id, out)
+    if return_stats:
+        return out, {"accepted": acc, "drafted": cyc * K, "cycles": cyc}
     return out

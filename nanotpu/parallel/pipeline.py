@@ -30,8 +30,11 @@ Both model families pipeline through the same body: the dense llama stack
 (:func:`pipelined_forward`) and the Mixtral MoE stack
 (:func:`mixtral_pipelined_forward`), whose experts stay ep-sharded *inside*
 each stage — pp composes with ep because the MoE dispatch is plain einsums
-under auto axes, no nested manual region (unlike sp's ring, which cannot
-nest — see check_pp_divisibility).
+under auto axes, no nested manual region. sp's ring DOES need a manual
+region, so ``attn_impl="ring"`` switches the pipeline to ONE joint
+{"pp","sp"} region (nested shard_maps would re-bind the parent's axes,
+which sdy rejects): hidden states/rope enter sequence-sharded and the
+stage calls the per-shard ring directly — see _pipelined_backbone.
 """
 
 from __future__ import annotations
@@ -111,11 +114,6 @@ def check_pp_divisibility(cfg, mesh: Mesh, batch: int, n_micro: int) -> None:
         problems.append(
             f"n_micro {n_micro} < pp {pp} (pipeline can never fill)"
         )
-    if getattr(cfg, "attn_impl", "dense") == "ring":
-        problems.append(
-            'attn_impl="ring": the sp ring cannot nest inside the pp-manual '
-            "region (sdy rejects re-binding parent axes)"
-        )
     if problems:
         raise ValueError("pipeline misconfigured: " + ", ".join(problems))
 
@@ -192,8 +190,12 @@ def _pipeline_body(local_layers, xm, cos, sin, *, stage, cfg, n_micro):
         recv = lax.ppermute(y, "pp", perm)
         return (recv, out, aux_run), None
 
-    recv0 = _vary_over(jnp.zeros_like(xm[0]), "pp")
-    out0 = _vary_over(jnp.zeros_like(xm), "pp")
+    # derive carry inits from xm (not fresh zeros) so they inherit xm's
+    # FULL device-varying set — under the joint {"pp","sp"} region xm
+    # varies over sp, and a replicated-constant init would trip the scan's
+    # carry-varying check; XLA folds the *0 away
+    recv0 = _vary_over(xm[0] * 0, "pp")
+    out0 = _vary_over(xm * 0, "pp")
     aux0 = _vary_over(jnp.zeros((), jnp.float32), "pp")
     (_, out, aux_run), _ = lax.scan(tick, (recv0, out0, aux0), jnp.arange(ticks))
     # keep only the last stage's buffer and hand it to every rank (the sum
@@ -217,12 +219,35 @@ def _pipelined_backbone(
     x = params["embed"][tokens]
     xm = x.reshape(n_micro, B // n_micro, S, cfg.dim)
 
+    ring = getattr(cfg, "attn_impl", "dense") == "ring"
+    if ring:
+        # pp x sp composition: ONE joint manual region owning both axes.
+        # Hidden states and rope tables enter SEQUENCE-SHARDED over sp; the
+        # stage runs the per-shard ring (attn_impl="ring_manual") so no
+        # shard_map nests. dp/fsdp/tp stay auto inside, as before.
+        sp = mesh.shape.get("sp", 1)
+        if S % sp:
+            raise ValueError(
+                f"sequence {S} not divisible by sp={sp} for the ring"
+            )
+        import dataclasses
+
+        cfg_in = dataclasses.replace(cfg, attn_impl="ring_manual")
+        manual = {"pp", "sp"}
+        x_spec = P(None, None, "sp", None)  # [M, mB, S, D]
+        rope_spec = P("sp")  # [S, hd/2]
+        out_spec = (x_spec, P())
+    else:
+        cfg_in = cfg
+        manual = {"pp"}
+        x_spec, rope_spec, out_spec = P(), P(), (P(), P())
+
     body = jax.shard_map(
-        partial(_pipeline_body, stage=stage, cfg=cfg, n_micro=n_micro),
+        partial(_pipeline_body, stage=stage, cfg=cfg_in, n_micro=n_micro),
         mesh=mesh,
-        in_specs=(P("pp"), P(), P(), P()),
-        out_specs=(P(), P()),
-        axis_names={"pp"},
+        in_specs=(P("pp"), x_spec, rope_spec, rope_spec),
+        out_specs=out_spec,
+        axis_names=manual,
     )
     hm, aux = body(params["layers"], xm, cos, sin)
     h = hm.reshape(B, S, cfg.dim)

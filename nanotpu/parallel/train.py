@@ -256,12 +256,6 @@ def main(argv: list[str] | None = None) -> int:
     key = (args.model, args.preset)
     if key not in _PRESETS:
         parser.error(f"no preset {key}; have {sorted(_PRESETS)}")
-    if args.pp > 1 and (args.sp > 1 or args.attn == "ring"):
-        # ring attention's sp shard_map cannot nest inside the pipeline's
-        # pp-manual region (sdy rejects re-binding the parent's axes);
-        # combine pp with dp/fsdp/tp instead, or sp with dp/tp
-        parser.error("--pp cannot combine with ring attention / --sp "
-                     "(nested shard_map)")
     if args.sp > 1 and args.attn and args.attn != "ring":
         parser.error(
             f"--attn {args.attn} conflicts with --sp {args.sp}: sequence "
@@ -373,11 +367,15 @@ def main(argv: list[str] | None = None) -> int:
     t0 = time.perf_counter()
     start_step = int(jax.device_get(state.step))
     profiling = False
+    losses: list = []  # device scalars; fetched AFTER the loop — a
+    # float() per step is a blocking device round trip that serializes the
+    # pipeline (on a tunneled chip it was ~25% of the step time)
     try:
         for i in range(start_step, start_step + args.steps):
             rng, k = jax.random.split(rng)
             tokens = jax.random.randint(k, (batch, seq), 0, cfg.vocab_size)
             state, loss_val = step_fn(state, tokens)
+            losses.append(loss_val)
             if i == start_step:  # exclude compile from throughput
                 loss_val.block_until_ready()
                 t0 = time.perf_counter()
@@ -394,18 +392,24 @@ def main(argv: list[str] | None = None) -> int:
                     # dwarf the per-step timeline the trace is for
                     jax.profiler.start_trace(args.profile_dir)
                     profiling = True
-            log.info("step %d loss %.4f", i + 1, float(loss_val))
             if args.checkpoint_dir and (i + 1) % args.save_every == 0:
                 save_checkpoint(args.checkpoint_dir, state)
         jax.block_until_ready(state.params)
+        t_end = time.perf_counter()
     finally:
-        # a crashed run is exactly when the trace matters — always flush it
+        # a crashed run is exactly when the trace AND the losses matter —
+        # always flush both (completed device scalars survive a crash)
         if profiling:
             jax.profiler.stop_trace()
             log.info("profile trace written to %s", args.profile_dir)
+        for i, lv in enumerate(losses):
+            try:
+                log.info("step %d loss %.4f", start_step + i + 1, float(lv))
+            except Exception:  # the step that crashed never produced one
+                break
     steady = args.steps - 1  # first step is compile, excluded from timing
     if steady > 0:
-        tok_s = steady * batch * seq / max(time.perf_counter() - t0, 1e-9)
+        tok_s = steady * batch * seq / max(t_end - t0, 1e-9)
         log.info("done: %d steps, %.0f tokens/s (steady-state)", args.steps, tok_s)
     else:
         log.info("done: 1 step (compile only; use --steps>=2 for throughput)")

@@ -58,6 +58,9 @@ class SchedulerAPI:
             "Cluster-wide TPU chip occupancy (allocated percent / capacity)",
         )
         self.occupancy_gauge.set_function(dealer.occupancy)
+        # shared sampling-profiler state (one sampler, concurrent scrapes join)
+        self._profile_lock = threading.Lock()
+        self._profile_run: dict | None = None
 
     # -- request dispatch --------------------------------------------------
     def dispatch(self, method: str, path: str, body: bytes) -> tuple[int, str, str]:
@@ -117,6 +120,7 @@ class SchedulerAPI:
 
     # -- pprof equivalents (pkg/routes/pprof.go) ---------------------------
     def _pprof(self, path: str) -> tuple[int, str, str]:
+        path, _, query = path.partition("?")
         if path.endswith("/goroutine") or path.endswith("/threads"):
             frames = sys._current_frames()
             out = []
@@ -124,29 +128,10 @@ class SchedulerAPI:
                 out.append(f"--- thread {tid} ---")
                 out.extend(s.rstrip() for s in traceback.format_stack(frame))
             return 200, "text/plain", "\n".join(out)
+        if path.endswith("/cmdline"):
+            return 200, "text/plain", "\x00".join(sys.argv)
         if path.endswith("/profile"):
-            # CPU profile over a short window. cProfile instruments only the
-            # calling thread, so this samples OTHER threads via their frames
-            # at intervals — a poor man's wall profiler that, unlike a naive
-            # cProfile.enable() here, actually sees verb-handler work.
-            samples: dict[str, int] = {}
-            deadline = time.time() + 1.0
-            me = threading.get_ident()
-            while time.time() < deadline:
-                for tid, frame in sys._current_frames().items():
-                    if tid == me:
-                        continue
-                    stack = traceback.extract_stack(frame)
-                    if stack:
-                        top = stack[-1]
-                        key = f"{top.filename}:{top.lineno} {top.name}"
-                        samples[key] = samples.get(key, 0) + 1
-                time.sleep(0.005)
-            lines = [
-                f"{count:6d} {where}"
-                for where, count in sorted(samples.items(), key=lambda kv: -kv[1])
-            ]
-            return 200, "text/plain", "samples (5ms interval, 1s window):\n" + "\n".join(lines[:60])
+            return self._pprof_profile(query)
         if path.endswith("/heap"):
             import tracemalloc
 
@@ -156,7 +141,87 @@ class SchedulerAPI:
             snap = tracemalloc.take_snapshot()
             lines = [str(s) for s in snap.statistics("lineno")[:40]]
             return 200, "text/plain", "\n".join(lines)
-        return 200, "text/plain", "pprof: /goroutine /profile /heap"
+        return 200, "text/plain", "pprof: /goroutine /profile?seconds=N&hz=M /heap /cmdline"
+
+    def _pprof_profile(self, query: str) -> tuple[int, str, str]:
+        """Wall-clock sampling profiler over every thread.
+
+        ``?seconds=N`` (default 1, max 60) and ``?hz=M`` (default 100, max
+        1000) parameterize the window. Output is flamegraph-collapsed
+        stacks ("frame;frame;frame count" — pipe into flamegraph.pl or
+        speedscope). The sampling runs on ONE shared daemon thread:
+        concurrent scrapes join the in-flight window instead of stacking
+        samplers, so a scrape mid-benchmark adds a bounded, fixed overhead
+        (a frame-graph walk per tick) rather than multiplying it.
+        """
+        params = dict(
+            kv.split("=", 1) for kv in query.split("&") if "=" in kv
+        )
+        try:
+            seconds = min(max(float(params.get("seconds", 1.0)), 0.05), 60.0)
+            hz = min(max(int(params.get("hz", 100)), 1), 1000)
+        except ValueError:
+            return 400, "application/json", json.dumps(
+                {"error": "seconds and hz must be numeric"}
+            )
+        with self._profile_lock:
+            run = self._profile_run
+            if run is None or run["done"].is_set():
+                run = {
+                    "done": threading.Event(),
+                    "result": None,
+                    "seconds": seconds,
+                    "hz": hz,
+                }
+                self._profile_run = run
+                threading.Thread(
+                    target=self._profile_worker, args=(run,),
+                    daemon=True, name="pprof-sampler",
+                ).start()
+        if not run["done"].wait(run["seconds"] + 10) or run["result"] is None:
+            return 500, "application/json", json.dumps(
+                {"error": "profile worker did not complete"}
+            )
+        return 200, "text/plain", run["result"]
+
+    def _profile_worker(self, run: dict) -> None:
+        interval = 1.0 / run["hz"]
+        deadline = time.time() + run["seconds"]
+        me = threading.get_ident()
+        stacks: dict[str, int] = {}
+        n_ticks = 0
+        try:
+            while time.time() < deadline:
+                for tid, frame in sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    parts = []
+                    f = frame
+                    while f is not None and len(parts) < 64:
+                        code = f.f_code
+                        parts.append(
+                            f"{code.co_name} "
+                            f"({code.co_filename.rsplit('/', 1)[-1]}"
+                            f":{f.f_lineno})"
+                        )
+                        f = f.f_back
+                    collapsed = ";".join(reversed(parts))
+                    stacks[collapsed] = stacks.get(collapsed, 0) + 1
+                n_ticks += 1
+                time.sleep(interval)
+        finally:
+            lines = [
+                f"{stack} {count}"
+                for stack, count in sorted(
+                    stacks.items(), key=lambda kv: -kv[1]
+                )
+            ]
+            run["result"] = (
+                f"# wall samples: {n_ticks} ticks @ {run['hz']} Hz over "
+                f"{run['seconds']}s; collapsed-stack format "
+                f"(flamegraph.pl compatible)\n" + "\n".join(lines)
+            )
+            run["done"].set()
 
 
 _STATUS_LINE = {

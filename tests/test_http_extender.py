@@ -253,3 +253,51 @@ class TestOperationalEndpoints:
         _, _, _, base = app
         code, body = get(base, "/debug/pprof/goroutine")
         assert code == 200 and "thread" in body
+
+    def test_pprof_profile_collapsed_stacks(self, app):
+        """Parameterized window/rate; flamegraph-collapsed output; the
+        sampler runs off the handler thread (shared worker)."""
+        _, _, _, base = app
+        code, body = get(base, "/debug/pprof/profile?seconds=0.2&hz=200")
+        assert code == 200
+        head = body.splitlines()[0]
+        assert "collapsed-stack" in head and "200 Hz" in head
+        # at least one stack line "frame;frame;... count"
+        data = [ln for ln in body.splitlines()[1:] if ln.strip()]
+        assert data, body
+        stack, _, count = data[0].rpartition(" ")
+        assert int(count) >= 1
+        assert ";" in stack or "(" in stack  # frames, not bare addresses
+
+    def test_pprof_profile_bad_params_rejected(self, app):
+        import urllib.error
+        import urllib.request
+
+        _, _, _, base = app
+        try:
+            urllib.request.urlopen(
+                base + "/debug/pprof/profile?seconds=banana", timeout=10
+            )
+            code = 200
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 400
+
+    def test_pprof_concurrent_scrapes_share_one_sampler(self, app):
+        """Two overlapping scrapes must join the same sampling window, not
+        stack a second sampler (a scrape during a latency benchmark must
+        not multiply its own overhead)."""
+        import threading as _t
+
+        client, dealer, api, base = app
+        results = []
+
+        def scrape():
+            results.append(get(base, "/debug/pprof/profile?seconds=0.4"))
+
+        t1, t2 = _t.Thread(target=scrape), _t.Thread(target=scrape)
+        t1.start(); t2.start(); t1.join(10); t2.join(10)
+        assert len(results) == 2
+        assert all(code == 200 for code, _ in results)
+        # both scrapes got the SAME window's report
+        assert results[0][1] == results[1][1]

@@ -211,8 +211,63 @@ def test_divisibility_errors():
         check_pp_divisibility(CFG, mesh, batch=6, n_micro=4)
     with pytest.raises(ValueError, match="never fill"):
         check_pp_divisibility(CFG, mesh, batch=8, n_micro=2)
-    with pytest.raises(ValueError, match="cannot nest"):
-        check_pp_divisibility(
-            dataclasses.replace(CFG, attn_impl="ring"), mesh,
-            batch=8, n_micro=4,
+
+
+class TestPpSpComposition:
+    """Ring attention INSIDE the pipeline: one joint {"pp","sp"} manual
+    region (nested shard_maps would re-bind parent axes; sdy rejects
+    them). Ring attention is exact, so the pipelined-ring forward must
+    match the plain dense forward."""
+
+    def _cfg(self):
+        return dataclasses.replace(
+            llama.LlamaConfig.tiny(), n_layers=4, max_seq_len=64,
+            attn_impl="ring",
+        )
+
+    def test_pp_sp_forward_matches_plain(self):
+        cfg = self._cfg()
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size
+        )
+        want = llama.forward(
+            params, tokens, dataclasses.replace(cfg, attn_impl="dense")
+        )
+        mesh = make_mesh(dp=2, pp=2, sp=2)
+        with mesh:
+            got = jax.jit(
+                lambda p, t: pipelined_forward(p, t, cfg, mesh, n_micro=2)
+            )(stack_layers(params), tokens)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
+    def test_pp_sp_train_step(self):
+        """Full sharded train step over dp x pp x sp: loss finite, params
+        move — long-context training on a pipelined model (VERDICT r1
+        missing #4)."""
+        cfg = self._cfg()
+        mesh = make_mesh(dp=2, pp=2, sp=2)
+        opt = train_lib.make_optimizer()
+        state = train_lib.init_train_state(
+            jax.random.PRNGKey(0), cfg, opt,
+            init_fn=lambda r, c: stack_layers(llama.init_params(r, c)),
+        )
+        specs = llama_pp_param_specs(cfg)
+        state = train_lib.place_state(state, cfg, mesh, param_specs=specs)
+        step = train_lib.build_train_step(
+            cfg, mesh, opt,
+            loss_fn=make_pipelined_loss(mesh, n_micro=2),
+            param_specs=specs,
+        )
+        # tokens [B, S+1]: the model sees S=32 (divisible by sp=2)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(2), (4, 33), 0, cfg.vocab_size
+        )
+        before = np.asarray(state.params["layers"]["attn"]["wq"][0])
+        state, loss = step(state, tokens)
+        assert jnp.isfinite(loss)
+        assert not np.allclose(
+            before, np.asarray(state.params["layers"]["attn"]["wq"][0])
         )

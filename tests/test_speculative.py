@@ -90,3 +90,99 @@ def test_eos_matches_generate(models):
         target, draft, prompt, CFG, DRAFT_CFG, 12, draft_tokens=3, eos_id=eos
     )
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestRejectionSampling:
+    """temperature > 0: speculative decoding via rejection sampling must
+    emit tokens distributed EXACTLY as the warped target distribution."""
+
+    def test_rejection_step_emits_target_distribution(self):
+        """Empirical check of the per-position primitive: the emitted
+        process q(x)*min(1,p/q) + P(reject)*residual must equal p."""
+        from nanotpu.models.speculative import rejection_step
+
+        rng = np.random.default_rng(0)
+        V = 8
+        p = rng.dirichlet(np.ones(V)).astype(np.float32)
+        q = rng.dirichlet(np.ones(V) * 0.5).astype(np.float32)
+        N = 20000
+        key = jax.random.PRNGKey(7)
+        kd, ka, kr = jax.random.split(key, 3)
+        # N independent single-position trials batched as rows
+        drafts = jax.random.categorical(
+            kd, jnp.log(jnp.asarray(q))[None, :].repeat(N, 0), axis=-1
+        ).astype(jnp.int32)[:, None]
+        pB = jnp.asarray(p)[None, None, :].repeat(N, 0)
+        qB = jnp.asarray(q)[None, None, :].repeat(N, 0)
+        accepted, resampled = jax.jit(rejection_step)(pB, qB, drafts, ka, kr)
+        emitted = np.where(
+            np.asarray(accepted)[:, 0],
+            np.asarray(drafts)[:, 0],
+            np.asarray(resampled)[:, 0],
+        )
+        freq = np.bincount(emitted, minlength=V) / N
+        tv = 0.5 * np.abs(freq - p).sum()
+        assert tv < 0.03, (tv, freq, p)
+
+    def test_sampled_output_matches_generate_distribution(self, models):
+        """Per-position marginals of sampled speculative decoding vs plain
+        sampled generate() at T=0.8 (f32, tiny model): total-variation
+        distance small. The lm_head is sharpened so the distribution
+        concentrates on a few tokens (a near-uniform 256-way distribution
+        would put the empirical-TV noise floor above any useful bound);
+        identical 64-row batches x seeds give ~1.5k samples per side."""
+        target, draft = models
+        # sharpen BOTH models' output distributions
+        target = {**target, "lm_head": target["lm_head"] * 25.0}
+        draft = {**draft, "lm_head": draft["lm_head"] * 25.0}
+        B = 64
+        prompt = jnp.tile(jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32), (B, 1))
+        T = 0.8
+        n_seeds = 24
+
+        spec = jax.jit(lambda r: speculative_generate(
+            target, draft, prompt, CFG, DRAFT_CFG, 3, draft_tokens=3,
+            temperature=T, rng=r,
+        ))
+        plain = jax.jit(lambda r: gen.generate(
+            target, prompt, CFG, 3, temperature=T, rng=r,
+        ))
+        spec_out = np.concatenate([
+            np.asarray(spec(jax.random.PRNGKey(i))) for i in range(n_seeds)
+        ])  # [B*n_seeds, 3]
+        plain_out = np.concatenate([
+            np.asarray(plain(jax.random.PRNGKey(10_000 + i)))
+            for i in range(n_seeds)
+        ])
+        V = CFG.vocab_size
+        for pos in range(3):
+            f_spec = np.bincount(spec_out[:, pos], minlength=V) / len(spec_out)
+            f_plain = np.bincount(plain_out[:, pos], minlength=V) / len(plain_out)
+            tv = 0.5 * np.abs(f_spec - f_plain).sum()
+            assert tv < 0.12, (pos, tv)
+
+    def test_acceptance_stats_and_perfect_draft_accepts_all(self, models):
+        target, _ = models
+        prompt = jnp.asarray([[2, 7, 2]], jnp.int32)
+        out, stats = speculative_generate(
+            target, target, prompt, CFG, CFG, 12, draft_tokens=4,
+            temperature=0.8, rng=jax.random.PRNGKey(5), return_stats=True,
+        )
+        assert out.shape == (1, 12)
+        accepted = int(stats["accepted"])
+        drafted = int(stats["drafted"])
+        assert 0 < accepted <= drafted
+        # draft == target: acceptance prob is min(1, p/q)=1 -> all accepted
+        assert accepted == drafted, stats
+
+    def test_sampled_respects_top_k_support(self, models):
+        """With top_k=1 both distributions collapse to greedy: sampled
+        speculative output must equal the greedy run exactly."""
+        target, draft = models
+        prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        want = gen.generate(target, prompt, CFG, 10, temperature=0.0)
+        got = speculative_generate(
+            target, draft, prompt, CFG, DRAFT_CFG, 10, draft_tokens=3,
+            temperature=0.7, top_k=1, rng=jax.random.PRNGKey(9),
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

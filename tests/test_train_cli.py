@@ -56,6 +56,15 @@ def test_cli_mixtral_tiny_runs():
     assert train_lib.main(["--model", "mixtral", "--steps", "1", "--seq", "32"]) == 0
 
 
+def test_cli_pp_sp_composition_runs():
+    """--pp 2 --sp 2: ring attention inside the pipeline (the joint
+    {"pp","sp"} manual region) through the real CLI."""
+    assert train_lib.main([
+        "--model", "llama", "--preset", "tiny", "--steps", "2",
+        "--pp", "2", "--sp", "2", "--seq", "33", "--batch", "4",
+    ]) == 0
+
+
 def test_restore_empty_dir_returns_none(tmp_path):
     from nanotpu.models.llama import LlamaConfig
 
@@ -80,12 +89,6 @@ class TestFlagValidation:
         with pytest.raises(SystemExit):
             main(["--model", "llama", "--preset", "tiny", "--steps", "1",
                   *argv])
-
-    def test_pp_rejects_explicit_ring(self):
-        self._run("--pp", "2", "--attn", "ring")
-
-    def test_pp_rejects_sp(self):
-        self._run("--pp", "2", "--sp", "2")
 
     def test_sp_rejects_contradictory_attn(self):
         self._run("--sp", "2", "--attn", "flash", "--seq", "65")
