@@ -32,11 +32,28 @@ import math
 import jax
 import jax.numpy as jnp
 
-#: Tuned on TPU v5e (chained-execution sweep, bf16, D=128): bq=256/bk=512
-#: beat 128/128 by 1.3x at S=2048 and 3.1x at S=8192 (57 TF/s, where the
-#: dense XLA path OOMs on the materialized [B,H,S,S] logits).
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 512
+#: Tuned on TPU v5e (end-to-end train-step sweeps, bf16): bq=bk=512 is the
+#: best all-round at S=2048-8192 (the earlier 256/512 default measured
+#: slower at S=2048 once per-step host syncs were removed from the bench).
+#: Override per-run with NANOTPU_FLASH_BQ / NANOTPU_FLASH_BK for sweeps.
+import os as _os
+
+
+def _env_block(name: str, default: int) -> int:
+    raw = _os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:  # a typo'd env var must not break unrelated imports
+        import logging
+
+        logging.getLogger("nanotpu.ops").warning(
+            "%s=%r is not an int; using default %d", name, raw, default
+        )
+        return default
+
+
+DEFAULT_BLOCK_Q = _env_block("NANOTPU_FLASH_BQ", 512)
+DEFAULT_BLOCK_K = _env_block("NANOTPU_FLASH_BK", 512)
 NEG_INF = -1e30
 #: Per-row aux vectors (lse, D) are stored [B*H, 8, S]: broadcast over 8
 #: sublanes purely to satisfy Mosaic's (8, 128) block-tiling constraint.

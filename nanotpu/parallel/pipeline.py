@@ -114,6 +114,19 @@ def check_pp_divisibility(cfg, mesh: Mesh, batch: int, n_micro: int) -> None:
         problems.append(
             f"n_micro {n_micro} < pp {pp} (pipeline can never fill)"
         )
+    if (
+        getattr(cfg, "attn_impl", "dense") == "ring"
+        and hasattr(cfg, "n_experts")
+    ):
+        # the dense pp x sp composition is supported (joint manual region);
+        # the MoE one is not yet: each sp shard would compute a different
+        # router aux for its sequence slice, and expert capacity would bind
+        # per (microbatch x sequence-shard) — needs an sp-pmean'd aux and
+        # validated capacity semantics before it can be trusted
+        problems.append(
+            "mixtral pp x sp (ring) unsupported: per-sequence-shard router "
+            "aux/capacity semantics not defined; use pp x ep or sp alone"
+        )
     if problems:
         raise ValueError("pipeline misconfigured: " + ", ".join(problems))
 

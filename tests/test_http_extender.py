@@ -291,13 +291,19 @@ class TestOperationalEndpoints:
 
         client, dealer, api, base = app
         results = []
+        # dispatch directly (no sockets): thread start skew is microseconds,
+        # far inside the 1s window, so the join is deterministic
+        barrier = _t.Barrier(2)
 
         def scrape():
-            results.append(get(base, "/debug/pprof/profile?seconds=0.4"))
+            barrier.wait()
+            results.append(
+                api.dispatch("GET", "/debug/pprof/profile?seconds=1", b"")
+            )
 
         t1, t2 = _t.Thread(target=scrape), _t.Thread(target=scrape)
-        t1.start(); t2.start(); t1.join(10); t2.join(10)
+        t1.start(); t2.start(); t1.join(15); t2.join(15)
         assert len(results) == 2
-        assert all(code == 200 for code, _ in results)
+        assert all(code == 200 for code, _, _ in results)
         # both scrapes got the SAME window's report
-        assert results[0][1] == results[1][1]
+        assert results[0][2] == results[1][2]
