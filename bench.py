@@ -102,7 +102,9 @@ def run_fanout(n_hosts: int = 256, n_pods: int = 256,
             make_pod(
                 name,
                 containers=[
-                    make_container("t", {types.RESOURCE_TPU_PERCENT: 200})
+                    make_container(
+                        "t", {types.RESOURCE_TPU_PERCENT: POD_PERCENT}
+                    )
                 ],
                 annotations={
                     types.ANNOTATION_GANG_NAME: f"job-{i % 16}",

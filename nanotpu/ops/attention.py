@@ -42,12 +42,15 @@ import os as _os
 def _env_block(name: str, default: int) -> int:
     raw = _os.environ.get(name, "")
     try:
-        return int(raw) if raw else default
+        value = int(raw) if raw else default
+        if value <= 0:
+            raise ValueError("block sizes must be positive")
+        return value
     except ValueError:  # a typo'd env var must not break unrelated imports
         import logging
 
         logging.getLogger("nanotpu.ops").warning(
-            "%s=%r is not an int; using default %d", name, raw, default
+            "%s=%r is not a positive int; using default %d", name, raw, default
         )
         return default
 

@@ -139,6 +139,36 @@ class TestEngineCorrectness:
         assert st["ttft_p50_ms"] is not None
 
 
+class TestMoEServing:
+    def test_mixtral_engine_matches_generate(self):
+        """The engine's MoE branch: co-batched Mixtral rows must match solo
+        generate() runs. capacity_factor is raised so routing never drops a
+        token — at S=1 decode, capacity binds per co-batched step, so a
+        drop would make outputs depend on WHICH rows share the batch."""
+        import dataclasses
+
+        from nanotpu.models import mixtral
+
+        cfg = dataclasses.replace(
+            mixtral.MixtralConfig.tiny(), capacity_factor=8.0
+        )
+        params = mixtral.init_params(jax.random.PRNGKey(0), cfg)
+        eng = Engine(params, cfg, slots=3, max_len=64, buckets=(16,))
+        try:
+            prompts = [[5, 6, 7], [9, 8], [1, 2, 3, 4, 5, 6]]
+            reqs = [eng.submit(p, 8) for p in prompts]
+            for r in reqs:
+                assert r.wait(60) and r.error is None
+            for p, r in zip(prompts, reqs):
+                want = generate(
+                    params, jnp.asarray([p], jnp.int32), cfg, 8,
+                    temperature=0.0,
+                )
+                assert r.out == np.asarray(want)[0].tolist(), p
+        finally:
+            eng.stop()
+
+
 class TestServingHTTP:
     def test_generate_roundtrip_and_metrics(self, tiny_model, engine):
         api = ServingAPI(engine)
