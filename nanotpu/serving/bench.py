@@ -28,9 +28,9 @@ def percentile(xs: list[float], p: float) -> float | None:
 
 
 def run(preset: str, slots: int, max_len: int, int8: bool, requests: int,
-        max_new: int, seed: int = 0) -> dict:
+        max_new: int, seed: int = 0, kv_int8: bool = False) -> dict:
     rng = random.Random(seed)
-    engine = build_engine(preset, slots, max_len, int8)
+    engine = build_engine(preset, slots, max_len, int8, kv_int8=kv_int8)
     cfg = engine.cfg
     lengths = [64, 128, 256, 512, 1024]
     lengths = [l for l in lengths if l < max_len - max_new] or [8]
@@ -65,6 +65,7 @@ def run(preset: str, slots: int, max_len: int, int8: bool, requests: int,
     return {
         "preset": preset,
         "int8": int8,
+        "kv_int8": kv_int8,
         "slots": slots,
         "requests": requests,
         "max_new_tokens": max_new,
@@ -84,11 +85,12 @@ def main(argv=None) -> None:
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--max-len", type=int, default=2048)
     p.add_argument("--int8", action="store_true")
+    p.add_argument("--kv-int8", action="store_true")
     p.add_argument("--requests", type=int, default=48)
     p.add_argument("--max-new", type=int, default=128)
     args = p.parse_args(argv)
     out = run(args.preset, args.slots, args.max_len, args.int8,
-              args.requests, args.max_new)
+              args.requests, args.max_new, kv_int8=args.kv_int8)
     print(json.dumps(out))
 
 

@@ -87,6 +87,50 @@ class SlotCache(NamedTuple):
         )
 
 
+class SlotCache8(NamedTuple):
+    """int8 variant of :class:`SlotCache`: k/v stored int8 with one f32
+    scale per (row, position, kv-head). Decode is KV-cache-bandwidth
+    bound, so halving the bytes per element (bf16 -> int8 + 1/hd scale)
+    roughly doubles the attention-read ceiling at long context; the
+    dequantize is an elementwise producer XLA fuses into the attention
+    dot, so no bf16 copy of the cache ever materializes in HBM."""
+
+    k: tuple  # per-layer int8 [SLOTS, max_len, KV, hd]
+    v: tuple
+    k_scale: tuple  # per-layer f32 [SLOTS, max_len, KV]
+    v_scale: tuple
+    lengths: jax.Array  # [SLOTS] int32
+
+    @staticmethod
+    def create(cfg, slots: int, max_len: int) -> "SlotCache8":
+        shape = (slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+        sshape = shape[:-1]
+        L = cfg.n_layers
+        return SlotCache8(
+            k=tuple(jnp.zeros(shape, jnp.int8) for _ in range(L)),
+            v=tuple(jnp.zeros(shape, jnp.int8) for _ in range(L)),
+            k_scale=tuple(jnp.zeros(sshape, jnp.float32) for _ in range(L)),
+            v_scale=tuple(jnp.zeros(sshape, jnp.float32) for _ in range(L)),
+            lengths=jnp.zeros((slots,), jnp.int32),
+        )
+
+
+def quantize_kv(x):
+    """x [..., hd] -> (int8 values, f32 scale [...]); symmetric per-vector
+    absmax quantization (the grain decode reads at: one (position, kv-head)
+    vector per cache entry)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype):
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def _attend_rows(q, k_cache, v_cache, frontier):
     """q [B,1,H,hd] against cache [B,T,KV,hd]; row b attends positions
     < frontier[b]. GQA stays unexpanded (broadcast inside the einsum)."""
@@ -104,17 +148,40 @@ def _attend_rows(q, k_cache, v_cache, frontier):
 
 
 def _write_rows(cache_arr, new, offsets):
-    """Write new [B,1,KV,hd] into cache_arr [B,T,KV,hd] at per-row offsets
+    """Write new [B, 1, ...] into cache_arr [B, T, ...] at per-row offsets
     (vmapped dynamic-slice: each slot's frontier differs — the thing the
-    single-scalar KVCache cannot express)."""
+    single-scalar KVCache cannot express). Rank-generic: serves both the
+    [T, KV, hd] value caches and the [T, KV] scale planes."""
 
-    def one(row, tok, off):
-        return jax.lax.dynamic_update_slice(row, tok.astype(row.dtype), (off, 0, 0))
+    def one(row, val, off):
+        start = (off,) + (jnp.int32(0),) * (row.ndim - 1)
+        return jax.lax.dynamic_update_slice(row, val.astype(row.dtype), start)
 
     return jax.vmap(one)(cache_arr, new, offsets)
 
 
-def serving_step(params, cfg, cache: SlotCache, tokens, active, temps, key,
+def _cache_update_and_views(cache, i, k, v, lengths, dtype):
+    """Write this step's k/v into layer i of either cache flavor; returns
+    (storage leaves to carry, dequantized full-cache views to attend)."""
+    if isinstance(cache, SlotCache8):
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        k_store = _write_rows(cache.k[i], kq, lengths)
+        ks_store = _write_rows(cache.k_scale[i], ks, lengths)
+        v_store = _write_rows(cache.v[i], vq, lengths)
+        vs_store = _write_rows(cache.v_scale[i], vs, lengths)
+        return (
+            (k_store, v_store, ks_store, vs_store),
+            dequantize_kv(k_store, ks_store, dtype),
+            dequantize_kv(v_store, vs_store, dtype),
+        )
+    k_store = _write_rows(cache.k[i], k, lengths)
+    v_store = _write_rows(cache.v[i], v, lengths)
+    return (k_store, v_store, None, None), k_store, v_store
+
+
+def serving_step(params, cfg, cache: "SlotCache | SlotCache8", tokens,
+                 active, temps, key,
                  top_k: int = 0, top_p: float = 1.0):
     """One decode step for the whole slot batch.
 
@@ -128,7 +195,7 @@ def serving_step(params, cfg, cache: SlotCache, tokens, active, temps, key,
     x = embed_lookup(params["embed"], tokens[:, None], jnp.dtype(cfg.dtype))
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     frontier = cache.lengths + 1  # the new token sees itself
-    ks, vs = [], []
+    ks, vs, kss, vss = [], [], [], []
     for i, layer in enumerate(params["layers"]):
         attn = layer["attn"]
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
@@ -137,9 +204,10 @@ def serving_step(params, cfg, cache: SlotCache, tokens, active, temps, key,
         v = linear(h, attn["wv"]).reshape(B, 1, KV, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_cache = _write_rows(cache.k[i], k, cache.lengths)
-        v_cache = _write_rows(cache.v[i], v, cache.lengths)
-        out = _attend_rows(q, k_cache, v_cache, frontier)
+        stored, k_view, v_view = _cache_update_and_views(
+            cache, i, k, v, cache.lengths, x.dtype
+        )
+        out = _attend_rows(q, k_view, v_view, frontier)
         x = x + linear(out.reshape(B, 1, H * hd), attn["wo"])
         if "moe" in layer:
             from nanotpu.models.mixtral import moe_block
@@ -152,8 +220,10 @@ def serving_step(params, cfg, cache: SlotCache, tokens, active, temps, key,
                 layer["mlp"], rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
             )
         x = x + ffn_out
-        ks.append(k_cache)
-        vs.append(v_cache)
+        ks.append(stored[0])
+        vs.append(stored[1])
+        kss.append(stored[2])
+        vss.append(stored[3])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = linear(x[:, -1], params["lm_head"]).astype(jnp.float32)  # [B,V]
 
@@ -167,10 +237,17 @@ def serving_step(params, cfg, cache: SlotCache, tokens, active, temps, key,
     nxt = jnp.where(temps > 0, sampled, greedy)
 
     new_lengths = cache.lengths + active.astype(jnp.int32)
-    return nxt, SlotCache(tuple(ks), tuple(vs), new_lengths)
+    if isinstance(cache, SlotCache8):
+        new_cache = SlotCache8(
+            tuple(ks), tuple(vs), tuple(kss), tuple(vss), new_lengths
+        )
+    else:
+        new_cache = SlotCache(tuple(ks), tuple(vs), new_lengths)
+    return nxt, new_cache
 
 
-def serving_chunk(params, cfg, cache: SlotCache, tokens, done, temps,
+def serving_chunk(params, cfg, cache: "SlotCache | SlotCache8", tokens,
+                  done, temps,
                   remaining, key, n_steps: int, eos_id: int = -1,
                   top_k: int = 0, top_p: float = 1.0):
     """``n_steps`` decode steps in ONE device program (lax.scan).
@@ -238,18 +315,36 @@ def prefill_request(params, cfg, prompt_padded, true_len, max_len,
     return first, cache.k, cache.v
 
 
-def insert_request(cache: SlotCache, ks, vs, slot, length):
+def insert_request(cache, ks, vs, slot, length):
     """Drop a prefilled row into ``slot``: per-layer dynamic-slice on axis 0
-    (donated by the jit wrapper, so no copy of the other slots)."""
-    new_k = tuple(
-        jax.lax.dynamic_update_slice(ck, rk.astype(ck.dtype), (slot, 0, 0, 0))
-        for ck, rk in zip(cache.k, ks)
-    )
-    new_v = tuple(
-        jax.lax.dynamic_update_slice(cv, rv.astype(cv.dtype), (slot, 0, 0, 0))
-        for cv, rv in zip(cache.v, vs)
-    )
-    return SlotCache(new_k, new_v, cache.lengths.at[slot].set(length))
+    (donated by the jit wrapper, so no copy of the other slots). For the
+    int8 cache the row is quantized here, once, at admission; positions
+    past the prompt quantize garbage that stays beyond the row frontier."""
+
+    def put4(cache_arr, row):
+        return jax.lax.dynamic_update_slice(
+            cache_arr, row.astype(cache_arr.dtype), (slot, 0, 0, 0)
+        )
+
+    def put3(cache_arr, row):
+        return jax.lax.dynamic_update_slice(
+            cache_arr, row.astype(cache_arr.dtype), (slot, 0, 0)
+        )
+
+    lengths = cache.lengths.at[slot].set(length)
+    if isinstance(cache, SlotCache8):
+        kq = [quantize_kv(rk) for rk in ks]
+        vq = [quantize_kv(rv) for rv in vs]
+        return SlotCache8(
+            tuple(put4(ck, q) for ck, (q, _) in zip(cache.k, kq)),
+            tuple(put4(cv, q) for cv, (q, _) in zip(cache.v, vq)),
+            tuple(put3(cs, s) for cs, (_, s) in zip(cache.k_scale, kq)),
+            tuple(put3(cs, s) for cs, (_, s) in zip(cache.v_scale, vq)),
+            lengths,
+        )
+    new_k = tuple(put4(ck, rk) for ck, rk in zip(cache.k, ks))
+    new_v = tuple(put4(cv, rv) for cv, rv in zip(cache.v, vs))
+    return SlotCache(new_k, new_v, lengths)
 
 
 class Request:
@@ -304,7 +399,8 @@ class Engine:
     def __init__(self, params, cfg, slots: int = 8, max_len: int | None = None,
                  buckets: tuple = DEFAULT_BUCKETS, eos_id: int = -1,
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-                 chunk_steps: int = 32, chunk_steps_max: int = 96):
+                 chunk_steps: int = 32, chunk_steps_max: int = 96,
+                 kv_int8: bool = False):
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -322,7 +418,11 @@ class Engine:
         self.chunk_steps = max(1, chunk_steps)
         self.chunk_steps_max = max(self.chunk_steps, chunk_steps_max)
 
-        self._cache = SlotCache.create(cfg, slots, self.max_len)
+        #: int8 KV cache: half the HBM bytes per cache read — the decode
+        #: bandwidth bottleneck — at ~0.4% per-element quantization error
+        self.kv_int8 = kv_int8
+        cache_cls = SlotCache8 if kv_int8 else SlotCache
+        self._cache = cache_cls.create(cfg, slots, self.max_len)
         self._slot_req: list[Request | None] = [None] * slots
         # host mirrors of per-row decode state; re-uploaded when _dirty
         self._tokens = np.zeros((slots,), np.int32)  # last token per slot

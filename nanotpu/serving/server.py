@@ -120,7 +120,8 @@ class ServingAPI:
 
 
 def build_engine(preset: str, slots: int, max_len: int, quantize: bool,
-                 attn: str = "auto", eos_id: int = -1) -> Engine:
+                 attn: str = "auto", eos_id: int = -1,
+                 kv_int8: bool = False) -> Engine:
     import jax
 
     from nanotpu.models.llama import LlamaConfig, init_params
@@ -142,7 +143,8 @@ def build_engine(preset: str, slots: int, max_len: int, quantize: bool,
         from nanotpu.models.quant import quantize_params
 
         params = quantize_params(params)
-    return Engine(params, cfg, slots=slots, max_len=max_len, eos_id=eos_id)
+    return Engine(params, cfg, slots=slots, max_len=max_len, eos_id=eos_id,
+                  kv_int8=kv_int8)
 
 
 def main(argv=None) -> None:
@@ -152,12 +154,15 @@ def main(argv=None) -> None:
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--max-len", type=int, default=2048)
     p.add_argument("--int8", action="store_true", help="weight-only int8")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="int8 KV cache (halves decode HBM reads)")
     p.add_argument("--eos-id", type=int, default=-1)
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     engine = build_engine(
-        args.preset, args.slots, args.max_len, args.int8, eos_id=args.eos_id
+        args.preset, args.slots, args.max_len, args.int8, eos_id=args.eos_id,
+        kv_int8=args.kv_int8,
     )
     api = ServingAPI(engine)
     from nanotpu.routes.server import serve

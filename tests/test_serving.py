@@ -139,6 +139,59 @@ class TestEngineCorrectness:
         assert st["ttft_p50_ms"] is not None
 
 
+class TestKvInt8:
+    def test_quantize_roundtrip_error_bound(self):
+        from nanotpu.serving.engine import dequantize_kv, quantize_kv
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 7, 2, 64), jnp.float32)
+        q, s = quantize_kv(x)
+        assert q.dtype == jnp.int8 and s.shape == (4, 7, 2)
+        back = dequantize_kv(q, s, jnp.float32)
+        # symmetric absmax int8: error <= scale/2 = absmax/254 per element
+        absmax = np.abs(np.asarray(x)).max(axis=-1, keepdims=True)
+        err = np.abs(np.asarray(back) - np.asarray(x))
+        assert (err <= absmax / 254 + 1e-6).all()
+
+    def test_engine_kv_int8_tracks_bf16_outputs(self, tiny_model):
+        """int8 KV cache is lossy (~0.4%/element): with a sharpened output
+        head, greedy decodes should agree with the exact engine at almost
+        every position; shapes/slot lifecycle must be identical."""
+        params, cfg = tiny_model
+        sharp = {**params, "lm_head": params["lm_head"] * 25.0}
+        prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5, 8, 9, 7, 9]]
+        outs = {}
+        for flag in (False, True):
+            eng = Engine(sharp, cfg, slots=2, max_len=64, buckets=(16,),
+                         kv_int8=flag)
+            try:
+                reqs = [eng.submit(p, 12) for p in prompts]
+                for r in reqs:
+                    assert r.wait(60) and r.error is None
+                outs[flag] = [r.out for r in reqs]
+            finally:
+                eng.stop()
+        agree = total = 0
+        for a, b in zip(outs[False], outs[True]):
+            assert len(a) == len(b) == 12
+            agree += sum(x == y for x, y in zip(a, b))
+            total += len(a)
+        assert agree / total >= 0.7, (agree / total, outs)
+
+    def test_kv_int8_cache_is_actually_int8(self, tiny_model):
+        from nanotpu.serving.engine import SlotCache8
+
+        params, cfg = tiny_model
+        eng = Engine(params, cfg, slots=2, max_len=32, buckets=(16,),
+                     kv_int8=True)
+        try:
+            eng.generate([1, 2, 3], 4)
+            assert isinstance(eng._cache, SlotCache8)
+            assert eng._cache.k[0].dtype == jnp.int8
+            assert eng._cache.k_scale[0].dtype == jnp.float32
+        finally:
+            eng.stop()
+
+
 class TestMoEServing:
     def test_mixtral_engine_matches_generate(self):
         """The engine's MoE branch: co-batched Mixtral rows must match solo
