@@ -32,33 +32,54 @@ class ChipResource:
     ``percent_free`` in [0, percent_total]; ``load`` is the live utilization
     in [0, 1] folded in from the metrics pipeline (RemainLoad analogue,
     allocate.go:173-195) — 0 when load-aware scheduling is off or stale.
+    ``hbm_*_mib`` is the second scheduled dimension (north-star resource
+    model); ``hbm_total_mib == 0`` means HBM is untracked on this chip and
+    every HBM request is accepted unaccounted.
     """
 
     percent_free: int = types.PERCENT_PER_CHIP
     percent_total: int = types.PERCENT_PER_CHIP
     load: float = 0.0
+    hbm_free_mib: int = 0
+    hbm_total_mib: int = 0
 
     @property
     def percent_used(self) -> int:
         return self.percent_total - self.percent_free
 
-    def can_allocate(self, percent: int) -> bool:
-        return 0 <= percent <= self.percent_free
+    def can_allocate(self, percent: int, hbm_mib: int = 0) -> bool:
+        if not 0 <= percent <= self.percent_free:
+            return False
+        if hbm_mib <= 0 or self.hbm_total_mib == 0:
+            return True
+        return hbm_mib <= self.hbm_free_mib
 
-    def sub(self, percent: int) -> None:
-        if not self.can_allocate(percent):
+    def sub(self, percent: int, hbm_mib: int = 0) -> None:
+        if not self.can_allocate(percent, hbm_mib):
             raise ValueError(
-                f"cannot allocate {percent}% from chip with {self.percent_free}% free"
+                f"cannot allocate {percent}% / {hbm_mib} MiB from chip with "
+                f"{self.percent_free}% / {self.hbm_free_mib} MiB free"
             )
         self.percent_free -= percent
+        if self.hbm_total_mib:
+            self.hbm_free_mib -= max(hbm_mib, 0)
 
-    def add(self, percent: int) -> None:
+    def add(self, percent: int, hbm_mib: int = 0) -> None:
         if percent < 0 or self.percent_free + percent > self.percent_total:
             raise ValueError(
                 f"cannot release {percent}% onto chip with {self.percent_free}%/"
                 f"{self.percent_total}%"
             )
+        if self.hbm_total_mib and (
+            hbm_mib < 0 or self.hbm_free_mib + hbm_mib > self.hbm_total_mib
+        ):
+            raise ValueError(
+                f"cannot release {hbm_mib} MiB onto chip with "
+                f"{self.hbm_free_mib}/{self.hbm_total_mib} MiB"
+            )
         self.percent_free += percent
+        if self.hbm_total_mib:
+            self.hbm_free_mib += max(hbm_mib, 0)
 
 
 @dataclass(frozen=True)
@@ -71,18 +92,26 @@ class Demand:
 
     percents: tuple[int, ...]
     container_names: tuple[str, ...] = ()
+    #: per-container HBM MiB reserved ON EACH assigned chip; empty tuple ==
+    #: no HBM requests (keeps old constructors and plan-cache hashes valid)
+    hbm_mib: tuple[int, ...] = ()
 
     @staticmethod
     def from_pod(pod) -> "Demand":
         from nanotpu.utils import pod as podutil
 
         containers = pod.containers
+        hbm = tuple(c.limit(types.RESOURCE_TPU_HBM) for c in containers)
         return Demand(
             percents=tuple(
                 podutil.get_tpu_percent_from_container(c) for c in containers
             ),
             container_names=tuple(c.name for c in containers),
+            hbm_mib=hbm if any(hbm) else (),
         )
+
+    def hbm_of(self, i: int) -> int:
+        return self.hbm_mib[i] if i < len(self.hbm_mib) else 0
 
     @property
     def total(self) -> int:
@@ -96,6 +125,11 @@ class Demand:
     def is_valid(self) -> bool:
         """Multi-chip demands must be whole multiples of one chip — '250%'
         has no placement semantics on TPU (no MIG/MPS analogue)."""
+        if self.hbm_mib and (
+            len(self.hbm_mib) != len(self.percents)
+            or any(h < 0 for h in self.hbm_mib)
+        ):
+            return False
         return all(
             p >= 0
             and (p <= types.PERCENT_PER_CHIP or p % types.PERCENT_PER_CHIP == 0)
@@ -112,15 +146,21 @@ class Demand:
         if h is None:
             # tuple() coercion: callers may construct Demand with list
             # fields (the frozen dataclass doesn't coerce)
-            h = _demand_hash(tuple(self.container_names), tuple(self.percents))
+            h = _demand_hash(
+                tuple(self.container_names), tuple(self.percents),
+                tuple(self.hbm_mib),
+            )
             object.__setattr__(self, "_hash", h)  # frozen dataclass memo
         return h
 
 
-def _demand_hash(container_names: tuple[str, ...], percents: tuple[int, ...]) -> str:
+def _demand_hash(container_names: tuple[str, ...], percents: tuple[int, ...],
+                 hbm_mib: tuple[int, ...] = ()) -> str:
     payload = ",".join(
         f"{n}={p}" for n, p in zip(container_names, percents)
     ) or ",".join(str(p) for p in percents)
+    if any(hbm_mib):  # keep pre-HBM hashes stable for HBM-less demands
+        payload += "|hbm:" + ",".join(str(h) for h in hbm_mib)
     return hashlib.sha256(payload.encode()).hexdigest()[:8]
 
 
@@ -161,7 +201,9 @@ class ChipSet:
 
     @staticmethod
     def for_node(chip_count: int, topology_spec: str | None = None, generation: str = "v5p") -> "ChipSet":
-        """Build from node capacity (NewNodeInfo path, node.go:25-42)."""
+        """Build from node capacity (NewNodeInfo path, node.go:25-42).
+        Per-chip HBM capacity comes from the generation table, making
+        ``tpu.io/hbm-mib`` a real scheduled dimension on known hardware."""
         if topology_spec:
             torus = Torus.from_spec(topology_spec, generation)
             if torus.num_chips != chip_count:
@@ -169,7 +211,14 @@ class ChipSet:
                 torus = Torus((chip_count, 1, 1), generation)
         else:
             torus = Torus((chip_count, 1, 1), generation)
-        return ChipSet(torus)
+        hbm = types.HBM_MIB_PER_CHIP.get(generation, 0)
+        return ChipSet(
+            torus,
+            [
+                ChipResource(hbm_free_mib=hbm, hbm_total_mib=hbm)
+                for _ in range(torus.num_chips)
+            ],
+        )
 
     def __len__(self) -> int:
         return len(self.chips)
@@ -197,39 +246,54 @@ class ChipSet:
         )
         if max_frac and max(free, default=0) < max_frac:
             return False
+        # HBM (optimistic): each TPU-demanding container needs SOME chip
+        # with its per-chip HBM request free (only on HBM-tracked chips)
+        if demand.hbm_mib:
+            max_hbm_free = max(
+                (
+                    c.hbm_free_mib if c.hbm_total_mib else float("inf")
+                    for c in self.chips
+                ),
+                default=0,
+            )
+            for i, p in enumerate(demand.percents):
+                if p > 0 and demand.hbm_of(i) > max_hbm_free:
+                    return False
         return True
 
     # -- mutation with undo log (fixes allocate.go:110-112 rollback bug) ---
     def allocate(self, plan: Plan) -> None:
-        undo: list[tuple[int, int]] = []
+        undo: list[tuple[int, int, int]] = []
         try:
             for i, chips in enumerate(plan.assignments):
                 percent = plan.demand.percents[i]
+                hbm = plan.demand.hbm_of(i)  # per assigned chip
                 if not chips:
                     continue
                 per_chip = self._per_chip_split(percent, len(chips))
                 for chip_id, p in zip(chips, per_chip):
-                    self.chips[chip_id].sub(p)
-                    undo.append((chip_id, p))
+                    self.chips[chip_id].sub(p, hbm)
+                    undo.append((chip_id, p, hbm))
         except (ValueError, IndexError):
-            for chip_id, p in reversed(undo):
-                self.chips[chip_id].add(p)
+            for chip_id, p, h in reversed(undo):
+                self.chips[chip_id].add(p, h)
             raise
 
     def release(self, plan: Plan) -> None:
-        undo: list[tuple[int, int]] = []
+        undo: list[tuple[int, int, int]] = []
         try:
             for i, chips in enumerate(plan.assignments):
                 percent = plan.demand.percents[i]
+                hbm = plan.demand.hbm_of(i)
                 if not chips:
                     continue
                 per_chip = self._per_chip_split(percent, len(chips))
                 for chip_id, p in zip(chips, per_chip):
-                    self.chips[chip_id].add(p)
-                    undo.append((chip_id, p))
+                    self.chips[chip_id].add(p, hbm)
+                    undo.append((chip_id, p, hbm))
         except (ValueError, IndexError):
-            for chip_id, p in reversed(undo):
-                self.chips[chip_id].sub(p)
+            for chip_id, p, h in reversed(undo):
+                self.chips[chip_id].sub(p, h)
             raise
 
     @staticmethod
@@ -286,6 +350,8 @@ class ChipSet:
                 "free": c.percent_free,
                 "total": c.percent_total,
                 "load": round(c.load, 4),
+                "hbm_free_mib": c.hbm_free_mib,
+                "hbm_total_mib": c.hbm_total_mib,
             }
             for i, c in enumerate(self.chips)
         ]

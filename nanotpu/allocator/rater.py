@@ -69,12 +69,24 @@ def _order_demands(demand: Demand) -> list[int]:
     )
 
 
-def _whole_chip_candidates(chips: ChipSet, free: list[int], k: int) -> list[frozenset[int]]:
+def _whole_chip_candidates(
+    chips: ChipSet, free: list[int], k: int,
+    hbm_free: list[int | None] | None = None, hbm_need: int = 0,
+) -> list[frozenset[int]]:
     """Fully-free candidate placements for k whole chips: axis-aligned
     sub-boxes when the volume admits one, else greedy connected sets grown
-    from every free seed (covers non-box volumes like 3 or 5 chips)."""
+    from every free seed (covers non-box volumes like 3 or 5 chips).
+    ``hbm_need`` additionally requires that much HBM free on every chip
+    (None entries in ``hbm_free`` = untracked, always eligible)."""
     fully_free = {
-        c for c in range(len(free)) if free[c] == chips.chips[c].percent_total
+        c for c in range(len(free))
+        if free[c] == chips.chips[c].percent_total
+        and (
+            not hbm_need
+            or hbm_free is None
+            or hbm_free[c] is None
+            or hbm_free[c] >= hbm_need
+        )
     }
     boxes = [
         box for box in chips.torus.placements_for(k) if box <= fully_free
@@ -117,6 +129,14 @@ def _choose(chips: ChipSet, demand: Demand, prefer_used: bool, rng_key: str | No
                 list(demand.percents),
                 prefer_used,
                 types.PERCENT_PER_CHIP,
+                # -1 == HBM untracked on that chip
+                hbm_free=[
+                    c.hbm_free_mib if c.hbm_total_mib else -1
+                    for c in chips.chips
+                ],
+                hbm_demand=[
+                    demand.hbm_of(i) for i in range(len(demand.percents))
+                ],
             )
         except native.NativeUnavailable:
             pass
@@ -127,6 +147,10 @@ def _choose_py(chips: ChipSet, demand: Demand, prefer_used: bool, rng_key: str |
     """Pure-Python placement engine — the reference implementation the
     native path must match. Assumes ``demand.is_valid()``."""
     free = [c.percent_free for c in chips.chips]
+    # None == HBM untracked on this chip (always eligible)
+    hbm_free: list[int | None] = [
+        c.hbm_free_mib if c.hbm_total_mib else None for c in chips.chips
+    ]
     assignments: list[list[int]] = [[] for _ in demand.percents]
 
     def used_frac(chip_id: int) -> float:
@@ -149,11 +173,12 @@ def _choose_py(chips: ChipSet, demand: Demand, prefer_used: bool, rng_key: str |
 
     for i in _order_demands(demand):
         percent = demand.percents[i]
+        hbm = demand.hbm_of(i)
         if percent <= 0:
             continue
         if percent >= types.PERCENT_PER_CHIP:
             k = percent // types.PERCENT_PER_CHIP
-            candidates = _whole_chip_candidates(chips, free, k)
+            candidates = _whole_chip_candidates(chips, free, k, hbm_free, hbm)
             if not candidates:
                 return None
             if rng_key is not None:
@@ -172,9 +197,15 @@ def _choose_py(chips: ChipSet, demand: Demand, prefer_used: bool, rng_key: str |
                 )
             for c in best:
                 free[c] = 0
+                if hbm and hbm_free[c] is not None:
+                    hbm_free[c] -= hbm
             assignments[i] = sorted(best)
         else:
-            feasible = [c for c in range(len(free)) if free[c] >= percent]
+            feasible = [
+                c for c in range(len(free))
+                if free[c] >= percent
+                and (not hbm or hbm_free[c] is None or hbm_free[c] >= hbm)
+            ]
             if not feasible:
                 return None
             if rng_key is not None:
@@ -192,6 +223,8 @@ def _choose_py(chips: ChipSet, demand: Demand, prefer_used: bool, rng_key: str |
                     key=lambda c: (used_frac(c), chips.chips[c].load, c),
                 )
             free[pick] -= percent
+            if hbm and hbm_free[pick] is not None:
+                hbm_free[pick] -= hbm
             assignments[i] = [pick]
     return assignments
 
@@ -271,23 +304,35 @@ class Sample:
         if not demand.is_valid():
             return None
         free = [c.percent_free for c in chips.chips]
+        hbm_free: list[int | None] = [
+            c.hbm_free_mib if c.hbm_total_mib else None for c in chips.chips
+        ]
         assignments: list[list[int]] = [[] for _ in demand.percents]
         for i, percent in enumerate(demand.percents):
+            hbm = demand.hbm_of(i)
             if percent <= 0:
                 continue
             if percent >= types.PERCENT_PER_CHIP:
                 k = percent // types.PERCENT_PER_CHIP
-                candidates = _whole_chip_candidates(chips, free, k)
+                candidates = _whole_chip_candidates(
+                    chips, free, k, hbm_free, hbm
+                )
                 if not candidates:
                     return None
                 box = candidates[0]
                 for c in box:
                     free[c] = 0
+                    if hbm and hbm_free[c] is not None:
+                        hbm_free[c] -= hbm
                 assignments[i] = sorted(box)
             else:
                 for c in range(len(free)):
-                    if free[c] >= percent:
+                    if free[c] >= percent and (
+                        not hbm or hbm_free[c] is None or hbm_free[c] >= hbm
+                    ):
                         free[c] -= percent
+                        if hbm and hbm_free[c] is not None:
+                            hbm_free[c] -= hbm
                         assignments[i] = [c]
                         break
                 else:

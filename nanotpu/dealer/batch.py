@@ -54,6 +54,7 @@ class BatchScorer:
         self.free = (ctypes.c_int32 * (n * c))()
         self.total = (ctypes.c_int32 * (n * c))()
         self.load = (ctypes.c_double * (n * c))()
+        self.hbm = (ctypes.c_int32 * (n * c))()  # -1 == untracked
         self.versions: list[int | None] = [None] * n
         #: bumped whenever _refresh copies any row; memo-key component
         self.state_rev = 0
@@ -96,6 +97,9 @@ class BatchScorer:
                     self.free[base + j] = chip.percent_free
                     self.total[base + j] = chip.percent_total
                     self.load[base + j] = chip.load
+                    self.hbm[base + j] = (
+                        chip.hbm_free_mib if chip.hbm_total_mib else -1
+                    )
                 self.versions[idx] = v
             changed = True
         if changed:
@@ -160,6 +164,10 @@ class BatchScorer:
                 self.dims, len(self.infos), self.free, self.total, self.load,
                 list(demand.percents), prefer_used, types.PERCENT_PER_CHIP,
                 gang,
+                hbm_flat=self.hbm,
+                hbm_demand=[
+                    demand.hbm_of(i) for i in range(len(demand.percents))
+                ],
             )
             n = len(self.infos)
             out = [bool(feas[i]) for i in range(n)], list(score[:n])

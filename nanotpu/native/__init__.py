@@ -30,7 +30,7 @@ _SRC = os.path.join(_REPO_ROOT, "native", "allocator.cc")
 _LIB = os.path.join(_PKG_DIR, "libnanotpu_alloc.so")
 
 #: must match nanotpu_abi_version() in allocator.cc
-ABI_VERSION = 3
+ABI_VERSION = 4
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -112,6 +112,8 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_int32,  # percent_per_chip
             ctypes.POINTER(ctypes.c_int32),  # out_assign
             ctypes.POINTER(ctypes.c_int32),  # out_counts
+            ctypes.POINTER(ctypes.c_int32),  # hbm_free (nullable; -1 untracked)
+            ctypes.POINTER(ctypes.c_int32),  # hbm_demand (nullable)
         ]
         lib.nanotpu_score_batch.restype = ctypes.c_int32
         lib.nanotpu_score_batch.argtypes = [
@@ -132,6 +134,8 @@ def _load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_int32),  # slice_cell_off [n_slices+1]
             ctypes.POINTER(ctypes.c_uint8),  # out_feasible [n]
             ctypes.POINTER(ctypes.c_int32),  # out_score [n]
+            ctypes.POINTER(ctypes.c_int32),  # hbm_free [n*chips] (nullable)
+            ctypes.POINTER(ctypes.c_int32),  # hbm_demand (nullable)
         ]
         _lib = lib
         return _lib
@@ -155,6 +159,8 @@ def score_batch(
     prefer_used: bool,
     percent_per_chip: int,
     gang=None,
+    hbm_flat=None,
+    hbm_demand: list[int] | None = None,
 ):
     """Feasibility + final score for every node of a uniform pool in ONE
     native call (Filter/Prioritize fan-out without per-node overhead).
@@ -181,11 +187,16 @@ def score_batch(
         g = (None, None, None, 0, None, None)
     else:
         g = gang
+    c_hbmd = (
+        (ctypes.c_int32 * max(nd, 1))(*hbm_demand)
+        if hbm_demand and any(hbm_demand) else None
+    )
     rc = lib.nanotpu_score_batch(
         c_dims, n_nodes, free_flat, total_flat, load_flat, nd, c_demands,
         1 if prefer_used else 0, percent_per_chip,
         g[0], g[1], g[2], g[3], g[4], g[5],
         out_feasible, out_score,
+        hbm_flat if c_hbmd is not None else None, c_hbmd,
     )
     if rc != OK:
         raise NativeUnavailable(f"native score_batch error {rc}")
@@ -200,9 +211,13 @@ def choose(
     demands: list[int],
     prefer_used: bool,
     percent_per_chip: int,
+    hbm_free: list[int] | None = None,
+    hbm_demand: list[int] | None = None,
 ) -> list[list[int]] | None:
-    """Native ``_choose``. Returns assignments or None (infeasible); raises
-    :class:`NativeUnavailable` when the caller should fall back to Python."""
+    """Native ``_choose``. ``hbm_free`` per chip (-1 == untracked) and
+    ``hbm_demand`` per container add the HBM dimension. Returns assignments
+    or None (infeasible); raises :class:`NativeUnavailable` when the caller
+    should fall back to Python."""
     lib = _load()
     if lib is None:
         raise NativeUnavailable("native allocator unavailable")
@@ -216,9 +231,18 @@ def choose(
     c_demands = (ctypes.c_int32 * max(nd, 1))(*demands)
     c_assign = (ctypes.c_int32 * out_cap)()
     c_counts = (ctypes.c_int32 * max(nd, 1))()
+    c_hbm = (
+        (ctypes.c_int32 * n)(*hbm_free)
+        if hbm_free and any(h >= 0 for h in hbm_free) else None
+    )
+    c_hbmd = (
+        (ctypes.c_int32 * max(nd, 1))(*hbm_demand)
+        if hbm_demand and any(hbm_demand) else None
+    )
     rc = lib.nanotpu_choose(
         c_dims, c_free, c_total, c_load, nd, c_demands,
         1 if prefer_used else 0, percent_per_chip, c_assign, c_counts,
+        c_hbm, c_hbmd,
     )
     if rc == INFEASIBLE:
         return None

@@ -29,10 +29,24 @@ from __future__ import annotations
 #: values < 100 mean a fractional (time-shared) chip, enforced by the agent.
 RESOURCE_TPU_PERCENT = "tpu.io/chip-percent"
 
-#: Optional secondary resources (advertised by the agent, used for demand
-#: shaping; the extender schedules on chip-percent, these ride along).
+#: Optional secondary resources (advertised by the agent).
+#: ``tpu.io/hbm-mib`` is a SCHEDULED dimension: the integer MiB of HBM the
+#: container reserves ON EACH CHIP of its allocation (fractional pods share
+#: a chip's HBM; the allocator rejects chips whose remaining HBM is below
+#: the request — the north-star "tpu-chip / tensorcore / HBM" model).
+#: tensorcore rides along for demand shaping only.
 RESOURCE_TPU_TENSORCORE = "tpu.io/tensorcore"
 RESOURCE_TPU_HBM = "tpu.io/hbm-mib"
+
+#: Per-chip HBM capacity by TPU generation (MiB). Public specs: v4 32 GB,
+#: v5p 95 GB, v5e 16 GB, v6e 32 GB. Used when the node does not label an
+#: explicit capacity.
+HBM_MIB_PER_CHIP = {
+    "v4": 32768,
+    "v5p": 97280,
+    "v5e": 16384,
+    "v6e": 32768,
+}
 
 #: Units of chip-percent that equal one physical chip.
 #: Reference: GPUPercentEachCard = 100 (pkg/types/types.go:10).

@@ -188,8 +188,15 @@ int choose_node(const Torus& t, const Adjacency& adj,
                 const int32_t* free_percent, const int32_t* total_percent,
                 const double* load, int32_t n_demands, const int32_t* demands,
                 int32_t prefer_used, int32_t percent_per_chip,
-                uint64_t* out_masks) {
+                uint64_t* out_masks,
+                const int32_t* hbm_free = nullptr,   // -1 == untracked
+                const int32_t* hbm_demand = nullptr) {
   std::vector<int32_t> free_(free_percent, free_percent + t.n);
+  // per-chip remaining HBM; INT32_MAX == untracked (always eligible)
+  std::vector<int64_t> hbm_(t.n, INT64_MAX);
+  if (hbm_free)
+    for (int c = 0; c < t.n; ++c)
+      if (hbm_free[c] >= 0) hbm_[c] = hbm_free[c];
 
   // demand order: index list stable-sorted by percent descending
   std::vector<int> order(n_demands);
@@ -218,12 +225,14 @@ int choose_node(const Torus& t, const Adjacency& adj,
 
   for (int i : order) {
     int percent = demands[i];
+    int hbm = hbm_demand ? hbm_demand[i] : 0;
     if (percent <= 0) continue;
     if (percent >= percent_per_chip) {
       int k = percent / percent_per_chip;
       uint64_t fully_free = 0;
       for (int c = 0; c < t.n; ++c)
-        if (free_[c] == total_percent[c]) fully_free |= 1ULL << c;
+        if (free_[c] == total_percent[c] && (hbm <= 0 || hbm_[c] >= hbm))
+          fully_free |= 1ULL << c;
       std::vector<uint64_t> candidates;
       for (uint64_t box : placements_for(t, k))
         if ((box & ~fully_free) == 0) candidates.push_back(box);
@@ -263,6 +272,7 @@ int choose_node(const Torus& t, const Adjacency& adj,
         int c = __builtin_ctzll(rest);
         rest &= rest - 1;
         free_[c] = 0;
+        if (hbm > 0 && hbm_[c] != INT64_MAX) hbm_[c] -= hbm;
       }
       out_masks[i] = best;
     } else {
@@ -270,6 +280,7 @@ int choose_node(const Torus& t, const Adjacency& adj,
       double pick_uf = 0.0, pick_load = 0.0;
       for (int c = 0; c < t.n; ++c) {
         if (free_[c] < percent) continue;
+        if (hbm > 0 && hbm_[c] < hbm) continue;
         double uf = total_percent[c]
                         ? 1.0 - static_cast<double>(free_[c]) / total_percent[c]
                         : 0.0;
@@ -291,6 +302,7 @@ int choose_node(const Torus& t, const Adjacency& adj,
       }
       if (pick < 0) return NANOTPU_INFEASIBLE;
       free_[pick] -= percent;
+      if (hbm > 0 && hbm_[pick] != INT64_MAX) hbm_[pick] -= hbm;
       out_masks[i] = 1ULL << pick;
     }
   }
@@ -371,7 +383,7 @@ int clamp_score(double s) {
 extern "C" {
 
 // ABI version so the ctypes loader can reject stale builds.
-int32_t nanotpu_abi_version() { return 3; }
+int32_t nanotpu_abi_version() { return 4; }
 
 // Place `n_demands` container demands onto one node's torus.
 //
@@ -398,7 +410,9 @@ int32_t nanotpu_choose(const int32_t dims[3],
                        int32_t prefer_used,
                        int32_t percent_per_chip,
                        int32_t* out_assign,
-                       int32_t* out_counts) {
+                       int32_t* out_counts,
+                       const int32_t* hbm_free,
+                       const int32_t* hbm_demand) {
   if (!dims || !free_percent || !total_percent || !load || !demands ||
       !out_assign || !out_counts || n_demands < 0 || percent_per_chip <= 0)
     return NANOTPU_ERR_BAD_ARGS;
@@ -408,7 +422,8 @@ int32_t nanotpu_choose(const int32_t dims[3],
 
   std::vector<uint64_t> masks(std::max<int32_t>(n_demands, 1), 0);
   int rc = choose_node(t, adj, free_percent, total_percent, load, n_demands,
-                       demands, prefer_used, percent_per_chip, masks.data());
+                       demands, prefer_used, percent_per_chip, masks.data(),
+                       hbm_free, hbm_demand);
   if (rc != NANOTPU_OK) return rc;
 
   int32_t* cursor = out_assign;
@@ -466,7 +481,9 @@ int32_t nanotpu_score_batch(const int32_t dims[3],
                             const int32_t* slice_cells,
                             const int32_t* slice_cell_off,
                             uint8_t* out_feasible,
-                            int32_t* out_score) {
+                            int32_t* out_score,
+                            const int32_t* hbm_free,
+                            const int32_t* hbm_demand) {
   if (!dims || !free_percent || !total_percent || !load || !demands ||
       !out_feasible || !out_score || n_nodes < 0 || n_demands < 0 ||
       percent_per_chip <= 0)
@@ -552,8 +569,11 @@ int32_t nanotpu_score_batch(const int32_t dims[3],
     const int32_t* free_n = free_percent + (size_t)nidx * t.n;
     const int32_t* total_n = total_percent + (size_t)nidx * t.n;
     const double* load_n = load + (size_t)nidx * t.n;
+    const int32_t* hbm_n =
+        hbm_free ? hbm_free + (size_t)nidx * t.n : nullptr;
     int rc = choose_node(t, adj, free_n, total_n, load_n, n_demands, demands,
-                         prefer_used, percent_per_chip, masks.data());
+                         prefer_used, percent_per_chip, masks.data(),
+                         hbm_n, hbm_demand);
     if (rc == NANOTPU_INFEASIBLE) {
       out_feasible[nidx] = 0;
       int score = 0 + gang_bonus(nidx);  // SCORE_MIN + bonus
