@@ -82,7 +82,7 @@ def _attend_cached(q, k_cache, v_cache, valid_len):
 
 
 def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start,
-                      full_prefill=False):
+                      full_prefill=False, mesh=None):
     """One decoder layer over new tokens x [B,S,D], updating this layer's
     cache slice at [start, start+S). Returns (x, k_cache, v_cache).
 
@@ -116,12 +116,21 @@ def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start,
         from nanotpu.ops.attention import flash_attention
 
         rep = H // KV
-        out = flash_attention(
-            q,
-            jnp.repeat(k, rep, axis=2),
-            jnp.repeat(v, rep, axis=2),
-            True,
-        )
+        kf = jnp.repeat(k, rep, axis=2)
+        vf = jnp.repeat(v, rep, axis=2)
+        if mesh is not None:
+            # a Pallas call does not partition under GSPMD — run it
+            # per-shard over tp (heads are embarrassingly parallel in
+            # flash attention; no cross-head communication exists)
+            from jax.sharding import PartitionSpec as P
+
+            spec = P(None, None, "tp", None)
+            out = jax.shard_map(
+                lambda q_, k_, v_: flash_attention(q_, k_, v_, True),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            )(q, kf, vf)
+        else:
+            out = flash_attention(q, kf, vf, True)
     else:
         out = _attend_cached(q, k_cache, v_cache, start + S)
     x = x + linear(out.reshape(B, S, H * hd), attn["wo"])
@@ -142,7 +151,7 @@ def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start,
 
 
 def _run(params, tokens, cfg, cache: KVCache, full_prefill: bool = False,
-         return_all: bool = False):
+         return_all: bool = False, mesh=None):
     """Shared prefill/step body: tokens [B,S] appended at cache.length.
     ``return_all`` returns logits for every fed position [B,S,V] (the
     speculative-decoding verify forward needs them all), else last-token
@@ -156,7 +165,7 @@ def _run(params, tokens, cfg, cache: KVCache, full_prefill: bool = False,
     for i, layer in enumerate(params["layers"]):
         x, k_l, v_l = _layer_with_cache(
             layer, x, cfg, cos, sin, cache.k[i], cache.v[i], start,
-            full_prefill=full_prefill,
+            full_prefill=full_prefill, mesh=mesh,
         )
         ks.append(k_l)
         vs.append(v_l)
@@ -167,17 +176,32 @@ def _run(params, tokens, cfg, cache: KVCache, full_prefill: bool = False,
     return logits, new_cache
 
 
-def prefill(params, prompt: jax.Array, cfg: LlamaConfig, max_len: int):
+def prefill(params, prompt: jax.Array, cfg: LlamaConfig, max_len: int,
+            mesh=None):
     """prompt [B,S] -> (last-token logits [B,V], primed cache). The cache
     starts empty, so attention is pure causal self-attention over the
-    prompt and runs through the flash kernel (see _layer_with_cache)."""
+    prompt and runs through the flash kernel (see _layer_with_cache).
+
+    ``mesh`` enables multi-chip decode (nanotpu.parallel.infer): the fresh
+    cache is pinned to the tp-over-kv-heads layout so every step's cache
+    reads stay collective-free."""
     cache = KVCache.create(cfg, prompt.shape[0], max_len)
-    return _run(params, prompt, cfg, cache, full_prefill=True)
+    if mesh is not None:
+        from nanotpu.parallel.infer import constrain_cache
+
+        cache = constrain_cache(cache, mesh)
+    return _run(params, prompt, cfg, cache, full_prefill=True, mesh=mesh)
 
 
-def decode_step(params, token: jax.Array, cfg: LlamaConfig, cache: KVCache):
-    """token [B] -> (logits [B,V], cache advanced by one)."""
-    return _run(params, token[:, None], cfg, cache)
+def decode_step(params, token: jax.Array, cfg: LlamaConfig, cache: KVCache,
+                mesh=None):
+    """token [B] -> (logits [B,V], cache advanced by one).
+
+    ``mesh`` is accepted for API symmetry with :func:`prefill` but the
+    cached decode path needs no explicit mesh plumbing: the step's layout
+    follows entirely from the (already pinned) cache and param shardings
+    via GSPMD propagation — only flash *prefill* consumes the mesh."""
+    return _run(params, token[:, None], cfg, cache, mesh=mesh)
 
 
 def apply_top_k(logits: jax.Array, k: int) -> jax.Array:
@@ -223,10 +247,15 @@ def generate(
     params, prompt: jax.Array, cfg: LlamaConfig, max_new_tokens: int,
     temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
     rng: jax.Array | None = None, max_len: int | None = None,
-    eos_id: int = -1,
+    eos_id: int = -1, mesh=None,
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled generation, with optional top-k
     and/or nucleus (top-p) filtering when temperature > 0.
+
+    ``mesh`` turns on multi-chip decode: pass params placed by
+    :func:`nanotpu.parallel.infer.place_params` and the KV cache shards its
+    head axis over ``tp`` (fsdp>1 gives ZeRO-style gathered weights). The
+    mesh is static — close over it (functools.partial) when jitting.
 
     prompt [B, S] -> generated tokens [B, max_new_tokens]. Jit-friendly:
     call under ``jax.jit`` with static cfg/max_new_tokens/top_k/top_p/
@@ -242,7 +271,7 @@ def generate(
         raise ValueError(
             f"prompt {S} + new {max_new_tokens} exceeds max_len {max_len}"
         )
-    logits, cache = prefill(params, prompt, cfg, max_len)
+    logits, cache = prefill(params, prompt, cfg, max_len, mesh=mesh)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     first_key, scan_key = jax.random.split(rng)  # never reuse a consumed key
 
@@ -257,7 +286,7 @@ def generate(
 
     def step(carry, key):
         token, cache, done = carry
-        logits, cache = decode_step(params, token, cfg, cache)
+        logits, cache = decode_step(params, token, cfg, cache, mesh=mesh)
         nxt = sample(logits, key)
         if eos_id >= 0:
             nxt = jnp.where(done, eos_id, nxt)

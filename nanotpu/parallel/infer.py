@@ -1,0 +1,154 @@
+"""Sharded inference: tp / fsdp-gathered decode placement.
+
+The north-star serving workloads (BASELINE.json: Llama-3-8B, Mixtral 8x7B)
+do not fit one chip — 8B bf16 weights are ~16 GB against a 16 GB v5e — so
+decode must run over a mesh. This module is the placement layer the decode
+paths (:mod:`nanotpu.models.generate`, :mod:`nanotpu.serving.engine`) share:
+
+* **params** reuse the training PartitionSpecs (tp over heads/ffn/vocab,
+  fsdp over the other matmul axis — :func:`nanotpu.parallel.mesh
+  .llama_param_specs`); an fsdp>1 inference mesh is the ZeRO-style
+  "fsdp-gathered" decode where XLA all-gathers each layer's weights on use.
+  int8 ``QArray`` weights place their per-output-channel scales with the
+  contraction axis of the spec dropped (the scale's size-1 axis cannot
+  shard).
+* **KV caches** shard the ``n_kv_heads`` axis over tp — the cache is the
+  decode-time HBM bottleneck, and the head axis is the one attention never
+  reduces over, so each tp shard attends its own heads with zero cache
+  collectives. Batch/slot and position axes stay unsharded (slots admit and
+  evict one row at a time; a sharded slot axis would turn every admission
+  into a cross-device scatter).
+* single-chip is the mesh=None special case everywhere — callers that never
+  pass a mesh get exactly the round-2 behavior.
+
+The reference has no model/serving code at all (SURVEY §2 "absent in
+reference": it schedules pods, pkg/dealer/dealer.go); this layer exists for
+the capability bar, not reference parity.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nanotpu.parallel.mesh import (
+    check_divisibility,
+    check_moe_divisibility,
+    llama_param_specs,
+    mixtral_param_specs,
+)
+
+
+def infer_param_specs(cfg):
+    """PartitionSpec tree for an inference param tree: the training specs
+    (tp x fsdp) apply unchanged — MoE configs (anything with ``n_experts``)
+    get the expert-sharded variant."""
+    if hasattr(cfg, "n_experts"):
+        return mixtral_param_specs(cfg)
+    return llama_param_specs(cfg)
+
+
+def check_infer_divisibility(cfg, mesh: Mesh) -> None:
+    if hasattr(cfg, "n_experts"):
+        check_moe_divisibility(cfg, mesh)
+    else:
+        check_divisibility(cfg, mesh)
+
+
+def _scale_spec(spec: P, ndim: int) -> P:
+    """Spec for a QArray's per-output-channel scale: the weight's spec with
+    the contraction axis (-2, which is size 1 in the scale) dropped."""
+    axes = list(spec) + [None] * (ndim - len(spec))
+    axes[ndim - 2] = None
+    return P(*axes)
+
+
+def place_params(params, cfg, mesh: Mesh):
+    """device_put a (possibly int8-quantized) param tree onto the mesh.
+
+    QArray leaves are placed member-wise: ``q`` under the weight's spec,
+    ``s`` under the spec minus its contraction axis."""
+    from nanotpu.models.quant import QArray
+
+    check_infer_divisibility(cfg, mesh)
+    specs = infer_param_specs(cfg)
+
+    def place(leaf, spec):
+        if isinstance(leaf, QArray):
+            return QArray(
+                q=jax.device_put(leaf.q, NamedSharding(mesh, spec)),
+                s=jax.device_put(
+                    leaf.s, NamedSharding(mesh, _scale_spec(spec, leaf.q.ndim))
+                ),
+            )
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(
+        place, params, specs,
+        is_leaf=lambda x: isinstance(x, QArray),
+    )
+
+
+#: Per-layer cache entry [B|SLOTS, max_len, n_kv_heads, head_dim]: kv heads
+#: over tp, everything else unsharded (see module docstring).
+KV_ENTRY_SPEC = P(None, None, "tp", None)
+#: int8 scale planes [B|SLOTS, max_len, n_kv_heads].
+KV_SCALE_SPEC = P(None, None, "tp")
+
+
+def kv_cache_specs(cfg) -> "object":
+    """Spec tree matching :class:`nanotpu.models.generate.KVCache`."""
+    from nanotpu.models.generate import KVCache
+
+    n = cfg.n_layers
+    return KVCache(
+        k=tuple(KV_ENTRY_SPEC for _ in range(n)),
+        v=tuple(KV_ENTRY_SPEC for _ in range(n)),
+        length=P(),
+    )
+
+
+def slot_cache_specs(cfg, kv_int8: bool = False) -> "object":
+    """Spec tree matching SlotCache / SlotCache8 (serving engine)."""
+    from nanotpu.serving.engine import SlotCache, SlotCache8
+
+    n = cfg.n_layers
+    ent = tuple(KV_ENTRY_SPEC for _ in range(n))
+    if kv_int8:
+        sc = tuple(KV_SCALE_SPEC for _ in range(n))
+        return SlotCache8(k=ent, v=ent, k_scale=sc, v_scale=sc, lengths=P())
+    return SlotCache(k=ent, v=ent, lengths=P())
+
+
+class _CfgView:
+    def __init__(self, n_layers: int):
+        self.n_layers = n_layers
+
+
+def _cache_specs_of(cache):
+    """Spec tree for any of the three cache flavors, by inspection."""
+    from nanotpu.serving.engine import SlotCache8
+
+    cfg_like = _CfgView(n_layers=len(cache.k))
+    if hasattr(cache, "lengths"):
+        return slot_cache_specs(cfg_like, kv_int8=isinstance(cache, SlotCache8))
+    return kv_cache_specs(cfg_like)
+
+
+def _apply_cache(cache, mesh: Mesh, op):
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: op(leaf, NamedSharding(mesh, spec)),
+        cache, _cache_specs_of(cache),
+    )
+
+
+def place_cache(cache, mesh: Mesh):
+    """device_put any of the three cache flavors onto the mesh."""
+    return _apply_cache(cache, mesh, jax.device_put)
+
+
+def constrain_cache(cache, mesh: Mesh):
+    """with_sharding_constraint for a cache built INSIDE a jitted function
+    (prefill creates its cache from zeros; without the pin XLA's propagation
+    chooses, usually correctly but not deterministically)."""
+    return _apply_cache(cache, mesh, jax.lax.with_sharding_constraint)

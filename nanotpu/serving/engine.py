@@ -288,18 +288,26 @@ def serving_chunk(params, cfg, cache: "SlotCache | SlotCache8", tokens,
 
 
 def prefill_request(params, cfg, prompt_padded, true_len, max_len,
-                    temp, key, top_k: int = 0, top_p: float = 1.0):
+                    temp, key, top_k: int = 0, top_p: float = 1.0,
+                    mesh=None):
     """Prefill one request (B=1, padded prompt) and sample its first token.
 
     Returns (first_token scalar, k rows, v rows) where rows are per-layer
     [1, max_len, KV, hd] ready for :func:`insert_request`. The pad region's
     k/v are garbage but sit at positions >= true_len, beyond the row's
-    frontier — never attended."""
+    frontier — never attended. ``mesh`` pins the fresh cache rows to the
+    tp-over-kv-heads layout so insertion into the (sharded) slot cache is
+    collective-free."""
     from nanotpu.models.generate import _run, KVCache
 
     cache = KVCache.create(cfg, 1, max_len)
+    if mesh is not None:
+        from nanotpu.parallel.infer import constrain_cache
+
+        cache = constrain_cache(cache, mesh)
     logits_all, cache = _run(
-        params, prompt_padded, cfg, cache, full_prefill=True, return_all=True
+        params, prompt_padded, cfg, cache, full_prefill=True,
+        return_all=True, mesh=mesh,
     )  # [1, S_pad, V]
     logits = jax.lax.dynamic_index_in_dim(
         logits_all, true_len - 1, axis=1, keepdims=False
@@ -400,7 +408,19 @@ class Engine:
                  buckets: tuple = DEFAULT_BUCKETS, eos_id: int = -1,
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
                  chunk_steps: int = 32, chunk_steps_max: int = 96,
-                 kv_int8: bool = False):
+                 kv_int8: bool = False, mesh=None):
+        #: multi-chip serving (nanotpu.parallel.infer): params placed
+        #: tp x fsdp, slot cache sharded tp-over-kv-heads, per-row control
+        #: vectors replicated. mesh=None is the single-chip path unchanged.
+        self.mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from nanotpu.parallel.infer import place_params
+
+            params = place_params(params, cfg, mesh)
+            self._repl = NamedSharding(mesh, PartitionSpec())
+        else:
+            self._repl = None
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -423,6 +443,10 @@ class Engine:
         self.kv_int8 = kv_int8
         cache_cls = SlotCache8 if kv_int8 else SlotCache
         self._cache = cache_cls.create(cfg, slots, self.max_len)
+        if mesh is not None:
+            from nanotpu.parallel.infer import place_cache
+
+            self._cache = place_cache(self._cache, mesh)
         self._slot_req: list[Request | None] = [None] * slots
         # host mirrors of per-row decode state; re-uploaded when _dirty
         self._tokens = np.zeros((slots,), np.int32)  # last token per slot
@@ -436,6 +460,8 @@ class Engine:
         self._d_done = None
         self._d_remaining = None
         self._d_key = jax.random.PRNGKey(seed)
+        if self._repl is not None:
+            self._d_key = jax.device_put(self._d_key, self._repl)
         self._queue: deque[Request] = deque()
         self._cv = threading.Condition()
         self._stop = False
@@ -448,6 +474,21 @@ class Engine:
 
         # compiled chunks (small now, large lazily); cache donated so the
         # update is in place (HBM holds ONE slot cache, not two)
+        # In mesh mode the chunk's carried outputs are PINNED (cache keeps
+        # its layout, control vectors stay replicated): the chunk's outputs
+        # feed back in as its next inputs, so without the pin GSPMD could
+        # pick a different carried sharding than the committed inputs have
+        # and the AOT-compiled large chunk would reject its own carry.
+        if mesh is not None:
+            from nanotpu.parallel.infer import slot_cache_specs
+            from nanotpu.parallel.mesh import shardings_for
+
+            cache_sh = shardings_for(mesh, slot_cache_specs(cfg, kv_int8))
+            out_sh = (cache_sh, self._repl, self._repl, self._repl,
+                      self._repl, self._repl)
+        else:
+            out_sh = None
+
         def make_chunk(n_steps):
             return jax.jit(
                 lambda params, cache, tokens, done, temps, rem, key:
@@ -457,6 +498,7 @@ class Engine:
                     top_k=self.top_k, top_p=self.top_p,
                 ),
                 donate_argnums=(1,),
+                out_shardings=out_sh,
             )
 
         self._chunk = make_chunk(self.chunk_steps)
@@ -470,16 +512,26 @@ class Engine:
 
         def compile_large():
             try:
+                # in mesh mode the SDS must carry the real input shardings:
+                # the compiled executable accepts exactly what it was
+                # lowered for, and the live params/cache are committed
                 sds = lambda x: jax.ShapeDtypeStruct(  # noqa: E731
-                    jnp.shape(x), jnp.result_type(x)
+                    jnp.shape(x), jnp.result_type(x),
+                    sharding=(x.sharding if mesh is not None else None),
                 )
-                i32 = jax.ShapeDtypeStruct((slots,), jnp.int32)
+                i32 = jax.ShapeDtypeStruct(
+                    (slots,), jnp.int32, sharding=self._repl
+                )
                 compiled = make_chunk(self.chunk_steps_max).lower(
                     jax.tree_util.tree_map(sds, self.params),
                     jax.tree_util.tree_map(sds, self._cache),
                     i32,  # tokens
-                    jax.ShapeDtypeStruct((slots,), jnp.bool_),  # done
-                    jax.ShapeDtypeStruct((slots,), jnp.float32),  # temps
+                    jax.ShapeDtypeStruct(
+                        (slots,), jnp.bool_, sharding=self._repl
+                    ),  # done
+                    jax.ShapeDtypeStruct(
+                        (slots,), jnp.float32, sharding=self._repl
+                    ),  # temps
                     i32,  # remaining
                     sds(self._d_key),  # key
                 ).compile()
@@ -492,11 +544,14 @@ class Engine:
         threading.Thread(
             target=compile_large, daemon=True, name="chunk-compile"
         ).start()
-        self._insert = jax.jit(insert_request, donate_argnums=(0,))
+        self._insert = jax.jit(
+            insert_request, donate_argnums=(0,),
+            out_shardings=(cache_sh if mesh is not None else None),
+        )
         self._prefill = jax.jit(
             lambda params, padded, true_len, temp, key: prefill_request(
                 params, cfg, padded, true_len, self.max_len, temp, key,
-                top_k=self.top_k, top_p=self.top_p,
+                top_k=self.top_k, top_p=self.top_p, mesh=mesh,
             ),
         )
         self._thread = threading.Thread(
@@ -639,10 +694,16 @@ class Engine:
         (``_dirty``). The chunk's [n_steps, SLOTS] token block comes back
         in one fetch — the only mandatory round trip."""
         if self._dirty:
-            self._d_tokens = jnp.asarray(self._tokens)
-            self._d_temps = jnp.asarray(self._temps)
-            self._d_done = jnp.asarray(self._done)
-            self._d_remaining = jnp.asarray(self._remaining)
+            # mesh mode commits the control vectors replicated so every
+            # chunk call (and the AOT large chunk) sees one sharding
+            up = (
+                (lambda a: jax.device_put(a, self._repl))
+                if self._repl is not None else jnp.asarray
+            )
+            self._d_tokens = up(self._tokens)
+            self._d_temps = up(self._temps)
+            self._d_done = up(self._done)
+            self._d_remaining = up(self._remaining)
             self._dirty = False
         # Chunk policy: an oversized chunk is harmless to CORRECTNESS
         # (rows freeze on device at eos/max-new; extra steps compute
