@@ -239,7 +239,12 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     if positions is None:
         positions = jnp.arange(S, dtype=jnp.int32)
     cos, sin = rope_freqs(cfg, positions)
-    x = embed_lookup(params["embed"], tokens, _dtype(cfg))
+    from nanotpu.parallel.mesh import constrain_activations, constrain_vocab_weight
+
+    x = embed_lookup(
+        constrain_vocab_weight(params["embed"], vocab_axis=0), tokens, _dtype(cfg)
+    )
+    x = constrain_activations(x)
     layer_fn = decoder_layer
     if cfg.remat:
         layer_fn = jax.checkpoint(
@@ -249,7 +254,10 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     for layer_params in params["layers"]:
         x = layer_fn(layer_params, x, cfg, cos, sin)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return linear(x, params["lm_head"]).astype(jnp.float32)
+    x = constrain_activations(x)
+    return linear(
+        x, constrain_vocab_weight(params["lm_head"], vocab_axis=1)
+    ).astype(jnp.float32)
 
 
 def loss_fn(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:

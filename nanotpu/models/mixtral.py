@@ -237,13 +237,22 @@ def forward(
     if positions is None:
         positions = jnp.arange(S, dtype=jnp.int32)
     cos, sin = rope_freqs(cfg.as_llama(), positions)
-    x = embed_lookup(params["embed"], tokens, jnp.dtype(cfg.dtype))
+    from nanotpu.parallel.mesh import constrain_activations, constrain_vocab_weight
+
+    x = embed_lookup(
+        constrain_vocab_weight(params["embed"], vocab_axis=0),
+        tokens, jnp.dtype(cfg.dtype),
+    )
+    x = constrain_activations(x)
     aux_total = jnp.zeros((), jnp.float32)
     for layer in params["layers"]:
         x, aux = decoder_layer(layer, x, cfg, cos, sin)
         aux_total = aux_total + aux
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return linear(x, params["lm_head"]).astype(jnp.float32), aux_total
+    x = constrain_activations(x)
+    return linear(
+        x, constrain_vocab_weight(params["lm_head"], vocab_axis=1)
+    ).astype(jnp.float32), aux_total
 
 
 def loss_fn(params: dict, tokens: jax.Array, cfg: MixtralConfig) -> jax.Array:

@@ -36,6 +36,7 @@ from nanotpu.parallel.mesh import (
     check_moe_divisibility,
     llama_param_specs,
     mixtral_param_specs,
+    qarray_scale_spec,
 )
 
 
@@ -55,14 +56,6 @@ def check_infer_divisibility(cfg, mesh: Mesh) -> None:
         check_divisibility(cfg, mesh)
 
 
-def _scale_spec(spec: P, ndim: int) -> P:
-    """Spec for a QArray's per-output-channel scale: the weight's spec with
-    the contraction axis (-2, which is size 1 in the scale) dropped."""
-    axes = list(spec) + [None] * (ndim - len(spec))
-    axes[ndim - 2] = None
-    return P(*axes)
-
-
 def place_params(params, cfg, mesh: Mesh):
     """device_put a (possibly int8-quantized) param tree onto the mesh.
 
@@ -78,7 +71,8 @@ def place_params(params, cfg, mesh: Mesh):
             return QArray(
                 q=jax.device_put(leaf.q, NamedSharding(mesh, spec)),
                 s=jax.device_put(
-                    leaf.s, NamedSharding(mesh, _scale_spec(spec, leaf.q.ndim))
+                    leaf.s,
+                    NamedSharding(mesh, qarray_scale_spec(spec, leaf.q.ndim)),
                 ),
             )
         return jax.device_put(leaf, NamedSharding(mesh, spec))

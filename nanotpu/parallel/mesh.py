@@ -106,6 +106,69 @@ def make_hybrid_mesh(
     return Mesh(arr, axis_names=("dp", "pp", "fsdp", "tp", "sp", "ep"))
 
 
+def _ctx_mesh_has(*axes) -> bool:
+    am = jax.sharding.get_abstract_mesh()
+    return am is not None and all(a in (am.axis_names or ()) for a in axes)
+
+
+def qarray_scale_spec(spec: P, ndim: int) -> P:
+    """Spec for a QArray's per-output-channel scale given its weight's
+    spec: the contraction axis (-2, size 1 in the scale) cannot shard and
+    is dropped. Single source of truth for the quantization grain's
+    sharding rule (used by inference placement and the vocab-weight
+    gather pins)."""
+    axes = list(spec) + [None] * (ndim - len(spec))
+    axes[ndim - 2] = None
+    return P(*axes)
+
+
+def constrain_vocab_weight(w, vocab_axis: int):
+    """Pin the embedding table / lm_head to a gathered-over-fsdp layout
+    (vocab stays tp-sharded, the feature axis replicates) under a context
+    mesh; no-op otherwise. ZeRO-3 semantics: the weight is STORED
+    P(tp, fsdp) and gathered at use.
+
+    Exists for the backward pass on the hybrid DCN mesh: with the feature
+    axis fsdp-sharded, the embed-gather output and the lm_head cotangent
+    come out feature-sharded in slice-major device order, which the SPMD
+    partitioner cannot convert to the batch-sharded activation layout
+    without 'involuntary full rematerialization'. Gathering the weight
+    keeps every [B, S, D] tensor batch-sharded on both passes; the
+    weight's own gradient transition (replicated feature -> fsdp shard) is
+    a plain reduce-scatter."""
+    if not _ctx_mesh_has("tp", "fsdp"):
+        return w
+    spec = P(*(("tp" if i == vocab_axis else None) for i in range(2)))
+    from nanotpu.models.quant import QArray
+
+    if isinstance(w, QArray):
+        return QArray(
+            q=jax.lax.with_sharding_constraint(w.q, spec),
+            s=jax.lax.with_sharding_constraint(
+                w.s, qarray_scale_spec(spec, w.q.ndim)
+            ),
+        )
+    return jax.lax.with_sharding_constraint(w, spec)
+
+
+def constrain_activations(x):
+    """Pin a [B, S, D] activation to the canonical layout — batch over
+    (dp, fsdp), sequence over sp, features replicated — when a context mesh
+    (jax.set_mesh) with the canonical axes is active; no-op otherwise.
+
+    Exists for the backward pass: the lm_head cotangent dx = dlogits @ W^T
+    arrives FEATURE-sharded (W is P('fsdp','tp')) and is accumulated with
+    the batch-sharded residual-stream cotangent. On a plain mesh XLA
+    reshards that cheaply; on the hybrid DCN mesh the slice-major device
+    order makes the two layouts non-convertible and the SPMD partitioner
+    falls back to 'involuntary full rematerialization' (replicate, then
+    re-partition) on every such tensor. Pinning the primal pins the
+    cotangent, so the flip never exists."""
+    if not _ctx_mesh_has("dp", "fsdp", "sp"):
+        return x
+    return jax.lax.with_sharding_constraint(x, P(("dp", "fsdp"), "sp", None))
+
+
 #: Token batches shard over every data-ish axis. The sequence dim stays
 #: unsharded here: token ids are tiny, their length is S+1 (the loss shift
 #: makes it indivisible by sp), and the sp sharding belongs to the

