@@ -16,6 +16,7 @@ import logging
 import os
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -35,6 +36,10 @@ SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 #: Socket read timeout for watch streams; a silent connection drop surfaces
 #: as a timeout and triggers reconnect instead of hanging reconciliation.
 WATCH_READ_TIMEOUT_S = 300
+#: A watch stream that stayed up this long was healthy — its eventual
+#: recycle (read timeout on a quiet cluster, apiserver request timeout,
+#: transient drop) must not inherit backoff escalated by earlier failures.
+HEALTHY_WATCH_S = 60.0
 
 
 class RestClientset:
@@ -201,6 +206,11 @@ class RestClientset:
             while not watch._stopped.is_set():
                 gone = False
                 srv_err = False
+                started = time.monotonic()
+
+                def stream_was_healthy() -> bool:
+                    return time.monotonic() - started >= HEALTHY_WATCH_S
+
                 try:
                     # read timeout so a half-open TCP connection (silent NAT
                     # drop) raises instead of blocking the watch forever; a
@@ -209,7 +219,6 @@ class RestClientset:
                         watch_req(rv), context=self._ctx,
                         timeout=WATCH_READ_TIMEOUT_S,
                     ) as resp:
-                        backoff = 1.0
                         for line in resp:
                             if watch._stopped.is_set():
                                 return
@@ -227,6 +236,13 @@ class RestClientset:
                                     obj.get("message", obj),
                                 )
                                 break
+                            # the stream delivered a real event: only NOW is
+                            # the watch healthy. Resetting on connect alone
+                            # turned a watch cache persistently lagging the
+                            # list rv (connect ok -> instant ERROR 410) into
+                            # a steady ~1s full-LIST loop against an already
+                            # degraded apiserver.
+                            backoff = 1.0
                             new_rv = (obj.get("metadata") or {}).get(
                                 "resourceVersion"
                             )
@@ -242,16 +258,27 @@ class RestClientset:
                         log.warning(
                             "watch %s dropped (%s); reconnecting", path, e
                         )
+                        if stream_was_healthy():
+                            backoff = 1.0
                         if watch._stopped.wait(backoff):
                             return
                         backoff = min(backoff * 2, 30.0)
                         continue
                 except Exception as e:
                     log.warning("watch %s dropped (%s); reconnecting", path, e)
+                    # a quiet-cluster read timeout lands here: the stream
+                    # was healthy, just eventless — reconnect promptly
+                    if stream_was_healthy():
+                        backoff = 1.0
                     if watch._stopped.wait(backoff):
                         return
                     backoff = min(backoff * 2, 30.0)
                     continue
+                if stream_was_healthy():
+                    # long-lived stream ended (apiserver request timeout or
+                    # a late ERROR event): whatever failure escalated the
+                    # backoff earlier is long gone
+                    backoff = 1.0
                 if gone:
                     try:
                         out = self._request("GET", path)
@@ -274,10 +301,12 @@ class RestClientset:
                         "watch %s resumed after 410 at rv=%s "
                         "(%d objects replayed)", path, rv, len(items),
                     )
-                    # throttle: a watch cache lagging the list revision 410s
-                    # every reconnect — without a pause this becomes a tight
-                    # full-LIST loop against an already-degraded apiserver
-                    if watch._stopped.wait(min(backoff, 5.0)):
+                    # throttle with escalation: a watch cache lagging the
+                    # list revision 410s every reconnect; backoff only resets
+                    # once the stream delivers an event, so repeated
+                    # list-and-replay cycles space out 1s -> 30s instead of
+                    # hammering a degraded apiserver with full LISTs
+                    if watch._stopped.wait(backoff):
                         return
                     backoff = min(backoff * 2, 30.0)
                 elif srv_err:

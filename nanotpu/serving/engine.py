@@ -572,6 +572,11 @@ class Engine:
             )
             return req
         with self._cv:
+            if self._stop:
+                # the loop thread is dead (or dying): enqueueing would
+                # strand the request until its caller's timeout
+                req._finish("engine stopped")
+                return req
             self._queue.append(req)
             self.requests_total += 1
             self._cv.notify()
@@ -599,11 +604,14 @@ class Engine:
         self._thread.join(timeout=10)
 
     def stats(self) -> dict:
+        # snapshot the sample deques under the same lock the engine loop
+        # appends under — sorting a deque another thread mutates raises
+        # RuntimeError, which would 500 /v1/stats under live traffic
         with self._cv:
             queued = len(self._queue)
+            ttft = sorted(self.ttft_samples)
+            lat = sorted(self.latency_samples)
         active = sum(1 for r in self._slot_req if r is not None)
-        ttft = sorted(self.ttft_samples)
-        lat = sorted(self.latency_samples)
 
         def pct(xs, p):
             return xs[min(len(xs) - 1, int(p * len(xs)))] if xs else None
@@ -670,14 +678,16 @@ class Engine:
         for (req, slot, _), tok in zip(admitted, firsts):
             tok = int(tok)
             req.first_token_at = now
-            self.ttft_samples.append(req.ttft_s)
+            with self._cv:  # stats() sorts these concurrently
+                self.ttft_samples.append(req.ttft_s)
             req.out.append(tok)
             self.tokens_total += 1
             if len(req.out) >= req.max_new_tokens or (
                 self.eos_id >= 0 and tok == self.eos_id
             ):
                 req._finish()
-                self.latency_samples.append(req.latency_s)
+                with self._cv:
+                    self.latency_samples.append(req.latency_s)
                 continue
             self._slot_req[slot] = req
             self._tokens[slot] = tok
@@ -746,7 +756,8 @@ class Engine:
             if self._done[i]:
                 req.done_at = now
                 req._finish()
-                self.latency_samples.append(req.latency_s)
+                with self._cv:  # stats() sorts these concurrently
+                    self.latency_samples.append(req.latency_s)
                 self._slot_req[i] = None
                 self._temps[i] = 0.0
                 # device `done` is already True for this row — eviction

@@ -36,6 +36,10 @@ TOPOLOGIES = [
 ]
 
 
+#: Fuzz HBM capacity, deliberately small so random demands straddle it.
+FUZZ_HBM_TOTAL = 1000
+
+
 def random_chipset(rng: random.Random, dims) -> ChipSet:
     torus = Torus(dims)
     chips = []
@@ -47,17 +51,30 @@ def random_chipset(rng: random.Random, dims) -> ChipSet:
             free = 0  # fully used
         else:
             free = rng.randrange(1, types.PERCENT_PER_CHIP)
+        # mixed HBM tracking: ~30% untracked chips (total == 0, always
+        # eligible), the rest tracked with randomized free amounts
+        if rng.random() < 0.3:
+            hbm_total = hbm_free = 0
+        else:
+            hbm_total = FUZZ_HBM_TOTAL
+            hbm_free = rng.choice(
+                [FUZZ_HBM_TOTAL, rng.randrange(0, FUZZ_HBM_TOTAL + 1)]
+            )
         chips.append(
             ChipResource(
                 percent_free=free,
                 percent_total=types.PERCENT_PER_CHIP,
                 load=rng.choice([0.0, 0.0, rng.random()]),
+                hbm_free_mib=hbm_free,
+                hbm_total_mib=hbm_total,
             )
         )
     return ChipSet(torus, chips, key="fuzz")
 
 
-def random_demand(rng: random.Random, n_chips: int) -> Demand:
+def random_demand(
+    rng: random.Random, n_chips: int, hbm_max: int | None = None
+) -> Demand:
     n_containers = rng.randrange(1, 4)
     percents = []
     for _ in range(n_containers):
@@ -69,8 +86,22 @@ def random_demand(rng: random.Random, n_chips: int) -> Demand:
         else:
             k = rng.randrange(1, max(2, n_chips // 2) + 1)
             percents.append(k * types.PERCENT_PER_CHIP)
+    # ~half the demands carry an HBM dimension; values straddle the chip
+    # capacity (hbm_max ~= 1.2x total) so feasibility genuinely flips on
+    # the HBM gate
+    hbm = ()
+    if rng.random() < 0.5:
+        cap = hbm_max if hbm_max is not None else int(FUZZ_HBM_TOTAL * 1.2)
+        hbm = tuple(
+            rng.choice([0, rng.randrange(1, cap)])
+            for _ in range(n_containers)
+        )
+        if not any(hbm):
+            hbm = ()
     return Demand(
-        container_names=[f"c{i}" for i in range(n_containers)], percents=percents
+        container_names=[f"c{i}" for i in range(n_containers)],
+        percents=percents,
+        hbm_mib=hbm,
     )
 
 
@@ -83,6 +114,10 @@ def native_choose(chips: ChipSet, demand: Demand, prefer_used: bool):
         list(demand.percents),
         prefer_used,
         types.PERCENT_PER_CHIP,
+        hbm_free=[
+            c.hbm_free_mib if c.hbm_total_mib else -1 for c in chips.chips
+        ],
+        hbm_demand=[demand.hbm_of(i) for i in range(len(demand.percents))],
     )
 
 
@@ -162,6 +197,16 @@ class TestScoreBatchParity:
                             1, types.PERCENT_PER_CHIP
                         )
                     chip.load = rng.choice([0.0, 0.0, round(rng.random(), 3)])
+                    # mixed HBM: some chips untracked, tracked ones get a
+                    # randomized free amount below the generation total
+                    if rng.random() < 0.25:
+                        chip.hbm_total_mib = 0
+                        chip.hbm_free_mib = 0
+                    elif chip.hbm_total_mib:
+                        chip.hbm_free_mib = rng.choice([
+                            chip.hbm_total_mib,
+                            rng.randrange(0, chip.hbm_total_mib + 1),
+                        ])
                 info.version += 1
             infos.append(info)
         return infos
@@ -180,7 +225,10 @@ class TestScoreBatchParity:
             infos = self._make_infos(rng, n_nodes, dims)
             scorer = BatchScorer.build(infos)
             assert scorer is not None
-            demand = random_demand(rng, dims[0] * dims[1] * dims[2])
+            demand = random_demand(
+                rng, dims[0] * dims[1] * dims[2],
+                hbm_max=int(types.HBM_MIB_PER_CHIP["v5p"] * 1.2),
+            )
             if not demand.is_valid():
                 continue
             # random gang member set (sometimes empty)
@@ -309,3 +357,65 @@ class TestDispatch:
         plan = make_rater("binpack").choose(chips, demand)
         assert plan is not None
         assert len(plan.assignments[0]) == 1
+
+
+class TestHbmAccounting:
+    """Pure-Python HBM feasibility + sub/add symmetry (the second
+    scheduled dimension, ADVICE r2: previously untested)."""
+
+    def test_sub_add_roundtrip_restores_state(self):
+        chip = ChipResource(hbm_free_mib=1000, hbm_total_mib=1000)
+        chip.sub(50, 300)
+        assert (chip.percent_free, chip.hbm_free_mib) == (50, 700)
+        chip.sub(25, 700)
+        assert (chip.percent_free, chip.hbm_free_mib) == (25, 0)
+        chip.add(25, 700)
+        chip.add(50, 300)
+        assert (chip.percent_free, chip.hbm_free_mib) == (100, 1000)
+
+    def test_hbm_infeasible_rejected(self):
+        chip = ChipResource(hbm_free_mib=100, hbm_total_mib=1000)
+        assert not chip.can_allocate(10, 101)
+        assert chip.can_allocate(10, 100)
+        with pytest.raises(ValueError):
+            chip.sub(10, 101)
+
+    def test_untracked_chip_ignores_hbm(self):
+        chip = ChipResource()  # hbm_total_mib == 0 -> untracked
+        assert chip.can_allocate(10, 10**9)
+        chip.sub(10, 10**9)
+        assert chip.hbm_free_mib == 0
+        chip.add(10, 10**9)
+        assert (chip.percent_free, chip.hbm_free_mib) == (100, 0)
+
+    def test_over_release_rejected(self):
+        chip = ChipResource(hbm_free_mib=900, hbm_total_mib=1000)
+        with pytest.raises(ValueError):
+            chip.add(0, 200)
+
+    def test_choose_gates_on_hbm_not_just_percent(self):
+        """Two fully-free chips, one HBM-poor: the placement must land on
+        the HBM-rich chip in both engines."""
+        torus = Torus((2, 1, 1))
+        chips = ChipSet(torus, [
+            ChipResource(hbm_free_mib=100, hbm_total_mib=1000),
+            ChipResource(hbm_free_mib=1000, hbm_total_mib=1000),
+        ], key="hbm")
+        demand = Demand(
+            container_names=["c"], percents=[100], hbm_mib=(500,)
+        )
+        py = _choose_py(chips, demand, True)
+        assert py == [[1]]
+        assert native_choose(chips, demand, True) == py
+
+    def test_hbm_feasibility_exhausted_is_infeasible(self):
+        torus = Torus((2, 1, 1))
+        chips = ChipSet(torus, [
+            ChipResource(hbm_free_mib=100, hbm_total_mib=1000),
+            ChipResource(hbm_free_mib=100, hbm_total_mib=1000),
+        ], key="hbm2")
+        demand = Demand(
+            container_names=["c"], percents=[100], hbm_mib=(500,)
+        )
+        assert _choose_py(chips, demand, True) is None
+        assert native_choose(chips, demand, True) is None

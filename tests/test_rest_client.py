@@ -272,3 +272,33 @@ def test_watch_reconnects_after_stream_end(stub):
     assert second and second.type == "MODIFIED"
     assert stub.watch_connects >= 2
     watch.stop()
+
+
+def test_persistent_410_backoff_escalates(stub):
+    """A watch cache permanently lagging the list rv (connect ok -> instant
+    ERROR 410, no events) must back the full-LIST-and-replay loop off
+    toward 30s instead of settling into a ~1s loop (ADVICE r2: backoff
+    used to reset on every successful connect, before any event arrived)."""
+    stub.pods["default/p1"] = _pod_raw("p1")
+    stub.list_rv = "4000"
+    err = {"type": "ERROR",
+           "object": {"kind": "Status", "code": 410, "message": "too old"}}
+    stub.watch_batches = [[dict(err)] for _ in range(50)]
+    client = RestClientset(stub.url)
+    watch = client.watch_pods()
+    delays = []
+    real_wait = watch._stopped.wait
+    watch._stopped.wait = lambda timeout=None: (
+        delays.append(timeout), real_wait(0.02)
+    )[1]
+    deadline = time.time() + 10
+    while len(delays) < 5 and time.time() < deadline:
+        time.sleep(0.02)
+    watch.stop()
+    assert len(delays) >= 5
+    # skip delays[0] (patch may have missed the very first wait): the
+    # relist waits must be non-decreasing and actually grow — a reset back
+    # to 1.0 between 410 cycles would flunk both
+    window = delays[1:5]
+    assert window == sorted(window), delays
+    assert window[-1] > window[0], delays

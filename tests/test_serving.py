@@ -289,3 +289,17 @@ class TestServingHTTP:
             assert out["tokens"] == ref_greedy(
                 params, cfg, [i + 1, i + 2, i + 3], 5
             )
+
+
+def test_submit_after_stop_fails_fast(tiny_model):
+    """submit() on a stopped engine must fail the request immediately
+    instead of stranding it in a dead loop's queue until the caller's
+    timeout (ADVICE r2)."""
+    params, cfg = tiny_model
+    eng = Engine(params, cfg, slots=2, max_len=64, buckets=(16,))
+    eng.stop()
+    t0 = time.time()
+    req = eng.submit([1, 2, 3], 5)
+    assert req.wait(1.0)
+    assert req.error == "engine stopped"
+    assert time.time() - t0 < 1.0
