@@ -98,17 +98,30 @@ class Demand:
 
     @staticmethod
     def from_pod(pod) -> "Demand":
+        """Demand vector from container limits, memoized on the Pod object:
+        every verb re-derives the demand, and quantity parsing across the
+        containers is a measurable slice of a 256-host scheduling cycle.
+        Safe because container resource limits are immutable for a pod's
+        lifetime (the annotation writes at bind touch metadata only)."""
+        cached = getattr(pod, "_demand_memo", None)
+        if cached is not None:
+            return cached
         from nanotpu.utils import pod as podutil
 
         containers = pod.containers
         hbm = tuple(c.limit(types.RESOURCE_TPU_HBM) for c in containers)
-        return Demand(
+        demand = Demand(
             percents=tuple(
                 podutil.get_tpu_percent_from_container(c) for c in containers
             ),
             container_names=tuple(c.name for c in containers),
             hbm_mib=hbm if any(hbm) else (),
         )
+        try:
+            pod._demand_memo = demand
+        except AttributeError:  # slotted/foreign pod-like object
+            pass
+        return demand
 
     def hbm_of(self, i: int) -> int:
         return self.hbm_mib[i] if i < len(self.hbm_mib) else 0

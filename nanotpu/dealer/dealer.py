@@ -117,6 +117,12 @@ class Dealer:
             max_workers=assume_workers, thread_name_prefix="assume"
         )
         self.gangs = GangTracker()
+        #: (gang key, gangs.rev, member slices) memo — see _gang_member_slices
+        self._gms_cache: tuple | None = None
+        #: pod uid -> Demand. Bind re-fetches the pod from the apiserver, so
+        #: the fresh object misses Demand.from_pod's per-object memo even
+        #: though container resource limits are immutable for a pod's life.
+        self._demand_uid: dict[str, Demand] = {}
         # candidate-list tuple -> (scorer, known names, non-TPU names,
         # nodes epoch). kube-scheduler sends the same list every cycle, so
         # an epoch-validated hit costs one tuple compare (the batched
@@ -370,11 +376,21 @@ class Dealer:
         return scorer, names_key, non_tpu, prefer
 
     # -- Assume (Filter verb): dealer.go:89-136 ----------------------------
+    def _demand_of(self, pod: Pod) -> Demand:
+        cached = self._demand_uid.get(pod.uid)
+        if cached is not None:
+            return cached
+        demand = Demand.from_pod(pod)
+        if len(self._demand_uid) > 4096:  # long-running scheduler guard
+            self._demand_uid.clear()
+        self._demand_uid[pod.uid] = demand
+        return demand
+
     def assume(
         self, node_names: list[str], pod: Pod
     ) -> tuple[list[str], dict[str, str]]:
         """Partition candidate nodes into (schedulable, {node: reason})."""
-        demand = Demand.from_pod(pod)
+        demand = self._demand_of(pod)
         if not demand.is_valid():
             return [], {
                 n: f"invalid demand {demand.percents} (multi-chip requests "
@@ -430,19 +446,31 @@ class Dealer:
 
     def _gang_member_slices(self, pod: Pod) -> list[tuple[str, str]]:
         """(slice name, coords) of nodes hosting the pod's bound gang
-        members; empty for non-gang pods."""
+        members; empty for non-gang pods. Memoized on the gang tracker's
+        revision: Filter and Prioritize of one cycle (and every sibling pod
+        until the next bind) share the lookup."""
         gang = podutil.gang_of(pod)
+        if not gang:
+            return []
+        key = f"{pod.namespace}/{gang[0]}"
+        # the memo must also see node-set changes: a member node deleted or
+        # resized/relabeled (remove_node/refresh_node) changes the slice
+        # geometry this caches without touching gang membership
+        rev = (self.gangs.rev, self._nodes_epoch)
+        cached = self._gms_cache
+        if cached is not None and cached[0] == key and cached[1] == rev:
+            return cached[2]
         member_slices: list[tuple[str, str]] = []
-        if gang:
-            for node in self.gangs.bound_nodes(f"{pod.namespace}/{gang[0]}"):
-                member = self._node_info(node)
-                if member is not None:
-                    member_slices.append((member.slice_name, member.slice_coords))
+        for node in self.gangs.bound_nodes(key):
+            member = self._node_info(node)
+            if member is not None:
+                member_slices.append((member.slice_name, member.slice_coords))
+        self._gms_cache = (key, rev, member_slices)
         return member_slices
 
     # -- Score (Prioritize verb): dealer.go:138-153 ------------------------
     def score(self, node_names: list[str], pod: Pod) -> list[tuple[str, int]]:
-        demand = Demand.from_pod(pod)
+        demand = self._demand_of(pod)
         if not demand.is_valid():
             return [(n, types.SCORE_MIN) for n in node_names]
         member_slices = self._gang_member_slices(pod)
@@ -451,6 +479,10 @@ class Dealer:
         if batch is not None:
             bscorer, names_key, _non_tpu, prefer = batch
             _, scores = bscorer.run(demand, prefer, member_slices or None)
+            if len(names_key) == len(node_names) and list(names_key) == node_names:
+                # all candidates are known TPU nodes (the common case):
+                # scores are already in candidate order
+                return list(zip(node_names, scores))
             by_name = dict(zip(names_key, scores))
             return [
                 (n, by_name.get(n, types.SCORE_MIN)) for n in node_names
@@ -499,7 +531,7 @@ class Dealer:
         info = self._node_info(node_name)
         if info is None:
             raise BindError(f"node {node_name} is not a known TPU node")
-        demand = Demand.from_pod(pod)
+        demand = self._demand_of(pod)
         plan = info.bind(demand, self.rater)
         if plan is None:
             raise BindError(

@@ -52,6 +52,9 @@ class GangTracker:
         self._lock = threading.Lock()
         self._gangs: dict[str, _Gang] = {}
         self._by_uid: dict[str, str] = {}  # uid -> gang name
+        #: bumped on every membership change; consumers key memoized
+        #: member-derived state (Dealer._gang_member_slices) on it
+        self.rev = 0
 
     def record_bound(self, gang: str, size: int, uid: str, node: str) -> None:
         with self._lock:
@@ -59,6 +62,7 @@ class GangTracker:
             g.size = max(g.size, size)
             g.members[uid] = node
             self._by_uid[uid] = gang
+            self.rev += 1
 
     def forget_pod(self, uid: str) -> None:
         with self._lock:
@@ -70,6 +74,7 @@ class GangTracker:
                 g.members.pop(uid, None)
                 if not g.members:
                     self._gangs.pop(gang, None)
+            self.rev += 1
 
     def bound_nodes(self, gang: str) -> list[str]:
         with self._lock:

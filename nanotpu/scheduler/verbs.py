@@ -17,9 +17,11 @@ DoS-by-request on the scheduling path we do not replicate.
 
 from __future__ import annotations
 
+import json
 import logging
 from typing import Any
 
+from nanotpu.allocator.core import Demand
 from nanotpu.dealer import BindError, Dealer
 from nanotpu.k8s.client import ApiError, NotFoundError
 from nanotpu.k8s.objects import Pod
@@ -35,6 +37,13 @@ class VerbError(Exception):
 def _extract(args: dict[str, Any]) -> tuple[Pod, list[str]]:
     if not isinstance(args, dict):
         raise VerbError("ExtenderArgs must be a JSON object")
+    # Filter and Prioritize carry byte-identical ExtenderArgs for the same
+    # pod (nodeCacheCapable), and the route layer re-serves the parsed dict
+    # (SchedulerAPI parse cache) — stash the extraction on it so the second
+    # verb also reuses the Pod object (whose Demand memoizes, core.py)
+    cached = args.get("__nanotpu_extracted")
+    if cached is not None:
+        return cached
     pod_raw = args.get("Pod") or args.get("pod")
     if not isinstance(pod_raw, dict):
         raise VerbError("ExtenderArgs.Pod missing")
@@ -52,7 +61,9 @@ def _extract(args: dict[str, Any]) -> tuple[Pod, list[str]]:
         raise VerbError("ExtenderArgs.NodeNames must be a list")
     if not all(type(n) is str for n in node_names):  # rare: coerce
         node_names = [str(n) for n in node_names]
-    return Pod(pod_raw), node_names
+    out = (Pod(pod_raw), node_names)
+    args["__nanotpu_extracted"] = out
+    return out
 
 
 class Predicate:
@@ -62,14 +73,49 @@ class Predicate:
 
     def __init__(self, dealer: Dealer):
         self.dealer = dealer
+        #: name -> '"<json-escaped name>"' and (name, reason) -> the
+        #: FailedNodes entry '"name":"reason"'. Candidate names and failure
+        #: reasons repeat every scheduling cycle; joining cached fragments
+        #: beats generic json.dumps of a 256-entry result ~4x.
+        self._qname: dict[str, str] = {}
+        self._qfail: dict[tuple[str, str], str] = {}
 
     def handle(self, args: dict[str, Any]) -> dict[str, Any]:
         pod, node_names = _extract(args)
-        if not podutil.is_tpu_sharing_pod(pod):
+        # demand.total > 0 == is_tpu_sharing_pod (pod.go:27-29), via the
+        # pod-memoized Demand so the quantity parse happens once per pod,
+        # not once per verb per gate
+        if Demand.from_pod(pod).total <= 0:
             # not ours: pass every node through untouched
             return {"NodeNames": node_names, "FailedNodes": {}, "Error": ""}
         ok, failed = self.dealer.assume(node_names, pod)
         return {"NodeNames": ok, "FailedNodes": failed, "Error": ""}
+
+    def render(self, result: dict[str, Any]) -> str:
+        if len(self._qname) > 8192 or len(self._qfail) > 8192:
+            self._qname.clear()
+            self._qfail.clear()
+        qn = self._qname
+        names = []
+        for n in result["NodeNames"]:
+            q = qn.get(n)
+            if q is None:
+                q = qn[n] = json.dumps(n)
+            names.append(q)
+        qf = self._qfail
+        fails = []
+        for n, reason in result["FailedNodes"].items():
+            q = qf.get((n, reason))
+            if q is None:
+                q = qf[(n, reason)] = (
+                    f"{json.dumps(n)}:{json.dumps(reason)}"
+                )
+            fails.append(q)
+        err = json.dumps(result.get("Error") or "")
+        return (
+            f'{{"NodeNames":[{",".join(names)}],'
+            f'"FailedNodes":{{{",".join(fails)}}},"Error":{err}}}'
+        )
 
 
 class Prioritize:
@@ -79,15 +125,31 @@ class Prioritize:
 
     def __init__(self, dealer: Dealer):
         self.dealer = dealer
+        #: host -> '{"Host":"<json-escaped>","Score":' — the fixed prefix of
+        #: every HostPriority entry. Node names repeat across every
+        #: scheduling cycle (nodeCacheCapable), and generic json.dumps of
+        #: 256 dicts was the single largest server-side cost of the verb.
+        self._frags: dict[str, str] = {}
 
-    def handle(self, args: dict[str, Any]) -> list[dict[str, Any]]:
+    def handle(self, args: dict[str, Any]) -> list[tuple[str, int]]:
         pod, node_names = _extract(args)
-        if not podutil.is_tpu_sharing_pod(pod):
-            return [{"Host": n, "Score": 0} for n in node_names]
-        return [
-            {"Host": name, "Score": score}
-            for name, score in self.dealer.score(node_names, pod)
-        ]
+        if Demand.from_pod(pod).total <= 0:
+            return [(n, 0) for n in node_names]
+        return self.dealer.score(node_names, pod)
+
+    def render(self, result: list[tuple[str, int]]) -> str:
+        """HostPriorityList JSON from pre-serialized per-host fragments."""
+        frags = self._frags
+        if len(frags) > 8192:  # unbounded node-name churn guard
+            frags.clear()
+        parts = []
+        for host, score in result:
+            f = frags.get(host)
+            if f is None:
+                f = '{"Host":%s,"Score":' % json.dumps(host)
+                frags[host] = f
+            parts.append(f"{f}{score}}}")
+        return f"[{','.join(parts)}]"
 
 
 class Bind:

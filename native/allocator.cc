@@ -181,6 +181,21 @@ uint64_t grow_connected(const Adjacency& adj, int seed, int k, uint64_t allowed)
 
 int min_bit(uint64_t mask) { return __builtin_ctzll(mask); }
 
+// Whole-chip placement candidates by volume k, computed once per API call:
+// they depend only on the torus, and the batch entry point would otherwise
+// re-enumerate shapes x origins (with heap churn) for every one of its
+// n_nodes choose_node calls.
+struct PlacementCache {
+  const Torus& t;
+  std::vector<std::vector<uint64_t>> by_k;  // index k; empty == not built
+  explicit PlacementCache(const Torus& torus) : t(torus) {}
+  const std::vector<uint64_t>& get(int k) {
+    if ((int)by_k.size() <= k) by_k.resize(k + 1);
+    if (by_k[k].empty()) by_k[k] = placements_for(t, k);
+    return by_k[k];
+  }
+};
+
 // Core per-node placement (the body of nanotpu_choose, reusable by the
 // batch entry point). Fills out_masks[i] with the chip bitmask assigned to
 // demand i. Returns NANOTPU_OK or NANOTPU_INFEASIBLE.
@@ -190,13 +205,18 @@ int choose_node(const Torus& t, const Adjacency& adj,
                 int32_t prefer_used, int32_t percent_per_chip,
                 uint64_t* out_masks,
                 const int32_t* hbm_free = nullptr,   // -1 == untracked
-                const int32_t* hbm_demand = nullptr) {
-  std::vector<int32_t> free_(free_percent, free_percent + t.n);
-  // per-chip remaining HBM; INT32_MAX == untracked (always eligible)
-  std::vector<int64_t> hbm_(t.n, INT64_MAX);
-  if (hbm_free)
-    for (int c = 0; c < t.n; ++c)
-      if (hbm_free[c] >= 0) hbm_[c] = hbm_free[c];
+                const int32_t* hbm_demand = nullptr,
+                PlacementCache* placements = nullptr) {
+  // stack scratch: t.n <= kMaxChips (checked by every caller), and the
+  // batch path calls this once per candidate node — per-node heap
+  // allocations were a measurable slice of the 256-host Filter
+  int32_t free_[kMaxChips];
+  int64_t hbm_[kMaxChips];
+  for (int c = 0; c < t.n; ++c) {
+    free_[c] = free_percent[c];
+    // per-chip remaining HBM; INT64_MAX == untracked (always eligible)
+    hbm_[c] = (hbm_free && hbm_free[c] >= 0) ? hbm_free[c] : INT64_MAX;
+  }
 
   // demand order: index list stable-sorted by percent descending
   std::vector<int> order(n_demands);
@@ -204,6 +224,8 @@ int choose_node(const Torus& t, const Adjacency& adj,
   std::stable_sort(order.begin(), order.end(), [&](int l, int r) {
     return demands[l] > demands[r];
   });
+  PlacementCache local(t);
+  if (!placements) placements = &local;
 
   for (int i = 0; i < n_demands; ++i) out_masks[i] = 0;
 
@@ -234,7 +256,7 @@ int choose_node(const Torus& t, const Adjacency& adj,
         if (free_[c] == total_percent[c] && (hbm <= 0 || hbm_[c] >= hbm))
           fully_free |= 1ULL << c;
       std::vector<uint64_t> candidates;
-      for (uint64_t box : placements_for(t, k))
+      for (uint64_t box : placements->get(k))
         if ((box & ~fully_free) == 0) candidates.push_back(box);
       if (candidates.empty()) {
         uint64_t ff = fully_free;
@@ -565,6 +587,7 @@ int32_t nanotpu_score_batch(const int32_t dims[3],
   };
 
   std::vector<uint64_t> masks(std::max<int32_t>(n_demands, 1), 0);
+  PlacementCache placements(t);  // shared across every candidate node
   for (int nidx = 0; nidx < n_nodes; ++nidx) {
     const int32_t* free_n = free_percent + (size_t)nidx * t.n;
     const int32_t* total_n = total_percent + (size_t)nidx * t.n;
@@ -573,7 +596,7 @@ int32_t nanotpu_score_batch(const int32_t dims[3],
         hbm_free ? hbm_free + (size_t)nidx * t.n : nullptr;
     int rc = choose_node(t, adj, free_n, total_n, load_n, n_demands, demands,
                          prefer_used, percent_per_chip, masks.data(),
-                         hbm_n, hbm_demand);
+                         hbm_n, hbm_demand, &placements);
     if (rc == NANOTPU_INFEASIBLE) {
       out_feasible[nidx] = 0;
       int score = 0 + gang_bonus(nidx);  // SCORE_MIN + bonus

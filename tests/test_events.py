@@ -104,13 +104,22 @@ def test_aggregation_recreates_after_event_gc():
 
 
 def test_aggregation_cache_is_bounded():
+    """The LRU key cache stays capped. Aggregation now happens on the
+    worker (the hot path only enqueues), so feed in under-queue-size
+    chunks with a flush between — the cap must hold after every chunk."""
     from nanotpu.k8s import events as events_mod
 
     client = _cluster()
     rec = EventRecorder(client)
     pod = _pod(client)
-    for i in range(events_mod.AGGREGATE_KEYS_MAX + 50):
-        rec.event(pod, "Normal", "X", f"message {i}")
+    total = events_mod.AGGREGATE_KEYS_MAX + 50
+    chunk = events_mod.QUEUE_MAX // 2
+    sent = 0
+    while sent < total:
+        for i in range(sent, min(sent + chunk, total)):
+            rec.event(pod, "Normal", "X", f"message {i}")
+        assert rec.flush(10)
+        sent = min(sent + chunk, total)
     assert len(rec._entries) == events_mod.AGGREGATE_KEYS_MAX
 
 
