@@ -24,13 +24,14 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 from nanotpu import types
 from nanotpu.allocator.core import Demand, Plan
 from nanotpu.allocator.rater import Rater
 from nanotpu.dealer.batch import BatchScorer
-from nanotpu.dealer.gang import GangScorer, GangTracker
+from nanotpu.dealer.gang import GangBarrier, GangScorer, GangTracker
 from nanotpu.dealer.nodeinfo import NodeInfo
 from nanotpu.dealer.usage import UsageStore
 from nanotpu.k8s import events
@@ -59,6 +60,24 @@ RELEASED_TOMBSTONES_MAX = 100_000
 
 class BindError(Exception):
     """Bind failed; chip accounting has been rolled back."""
+
+
+class _Reservation:
+    """A strict-gang member's applied-but-uncommitted chip reservation.
+
+    Registered with the Dealer for the (up to gang-timeout) park window so
+    node rebuilds migrate it like a tracked pod: refresh_node re-applies
+    the plan on the fresh NodeInfo; remove_node (or a failed re-apply)
+    marks it invalid and the parked bind fails instead of double-booking.
+    """
+
+    __slots__ = ("node_name", "info", "plan", "valid")
+
+    def __init__(self, node_name: str, info, plan: Plan):
+        self.node_name = node_name
+        self.info = info
+        self.plan = plan
+        self.valid = True
 
 
 def plan_from_pod(pod: Pod) -> Plan | None:
@@ -116,9 +135,13 @@ class Dealer:
         self._pool = ThreadPoolExecutor(
             max_workers=assume_workers, thread_name_prefix="assume"
         )
-        self.gangs = GangTracker()
+        self.gangs = GangTracker(on_gang_empty=self._drop_gang_barrier)
         #: (gang key, gangs.rev, member slices) memo — see _gang_member_slices
         self._gms_cache: tuple | None = None
+        #: gang key -> GangBarrier for strict (all-or-nothing) gangs
+        self._gang_barriers: dict[str, GangBarrier] = {}
+        #: uid -> parked strict-gang reservation (see _Reservation)
+        self._reserved: dict[str, _Reservation] = {}
         #: pod uid -> Demand. Bind re-fetches the pod from the apiserver, so
         #: the fresh object misses Demand.from_pod's per-object memo even
         #: though container resource limits are immutable for a pod's life.
@@ -286,6 +309,11 @@ class Dealer:
             self._nodes.pop(name, None)
             self._non_tpu.discard(name)
             self._nodes_epoch += 1
+            for res in self._reserved.values():
+                # parked strict-gang reservations on this node are gone;
+                # their binds must fail rather than commit to a dead node
+                if res.node_name == name:
+                    res.valid = False
         self.usage.forget_node(name)
 
     def refresh_node(self, node: Node) -> bool:
@@ -320,8 +348,30 @@ class Dealer:
             self._non_tpu.discard(node.name)
             self._nodes_epoch += 1
             self._replay_tracked(node.name)
+            self._migrate_reservations(node.name)
         log.info("node %s rebuilt (new/resized/relabeled)", node.name)
         return info is not None
+
+    def _migrate_reservations(self, node_name: str) -> None:
+        """Re-apply parked strict-gang reservations onto a rebuilt
+        NodeInfo (caller holds the dealer lock). A plan the resized node
+        can no longer honor marks the reservation invalid — the parked
+        bind then fails instead of committing chips another pod may hold."""
+        current = self._nodes.get(node_name)
+        for uid, res in self._reserved.items():
+            if res.node_name != node_name or not res.valid:
+                continue
+            if current is None or res.info is current:
+                continue
+            try:
+                current.allocate(res.plan)
+                res.info = current
+            except ValueError:
+                res.valid = False
+                log.warning(
+                    "parked reservation for pod uid %s lost in %s rebuild",
+                    uid, node_name,
+                )
 
     def node_names(self) -> list[str]:
         with self._lock:
@@ -511,7 +561,29 @@ class Dealer:
         binding. Raises BindError with accounting rolled back on failure.
         Emits a K8s Event either way (TPUAssigned / FailedBinding)."""
         try:
-            bound = self._bind(node_name, pod)
+            # idempotent-retry guard: the scheduler can re-issue a bind it
+            # abandoned (its extender httpTimeout elapsed) that committed
+            # server-side; a second reservation for the same uid would
+            # double-book
+            with self._lock:
+                existing = self._pods.get(pod.uid)
+            if existing is not None:
+                prev = existing.node_name
+                if prev == node_name:
+                    log.info(
+                        "bind of %s to %s is already committed; idempotent "
+                        "success", pod.key(), node_name,
+                    )
+                    return existing
+                raise BindError(
+                    f"pod {pod.key()} is already "
+                    + (f"bound to {prev}" if prev else "mid-bind")
+                )
+            gang = podutil.gang_of(pod)
+            if gang and gang[1] > 1 and podutil.gang_is_strict(pod):
+                bound = self._bind_strict(node_name, pod, gang)
+            else:
+                bound = self._bind(node_name, pod)
         except BindError as e:
             self.recorder.event(
                 pod, "Warning", events.REASON_FAILED_BINDING, str(e)
@@ -528,6 +600,12 @@ class Dealer:
         return bound
 
     def _bind(self, node_name: str, pod: Pod) -> Pod:
+        info, plan = self._reserve(node_name, pod)
+        return self._commit_reserved(info, plan, node_name, pod)
+
+    def _reserve(self, node_name: str, pod: Pod):
+        """Apply the pod's chip reservation on the node (no API writes).
+        Returns (NodeInfo, Plan); raises BindError when infeasible."""
         info = self._node_info(node_name)
         if info is None:
             raise BindError(f"node {node_name} is not a known TPU node")
@@ -537,6 +615,111 @@ class Dealer:
             raise BindError(
                 f"no feasible plan for pod {pod.key()} on node {node_name}"
             )
+        return info, plan
+
+    def _drop_gang_barrier(self, gang_key: str) -> None:
+        """GangTracker on_gang_empty hook: a forgotten gang's barrier must
+        not leave ``open=True`` behind for a re-submitted same-named gang
+        (that would silently bypass the all-or-nothing guarantee)."""
+        with self._lock:
+            self._gang_barriers.pop(gang_key, None)
+
+    def _bind_strict(self, node_name: str, pod: Pod,
+                     gang: tuple[str, int]) -> Pod:
+        """All-or-nothing gang bind (tpu.io/gang-policy: strict): reserve,
+        register the reservation (so node rebuilds migrate it), then park
+        at the gang's barrier until ``barrier.size`` members hold
+        reservations (bound members count); a timeout rolls this pod's
+        reservation back and fails the bind with a clear message — the
+        scheduler retries, and chips never stay reserved for an incomplete
+        gang. See nanotpu.dealer.gang module docstring for why this is
+        opt-in, and deploy/kube-scheduler-config.yaml: the extender
+        httpTimeout must exceed the gang timeout or the scheduler abandons
+        parked binds that later commit server-side."""
+        key = f"{pod.namespace}/{gang[0]}"
+        with self._lock:
+            barrier = self._gang_barriers.get(key)
+            if barrier is None:
+                barrier = self._gang_barriers[key] = GangBarrier(gang[1])
+            barrier.users += 1
+        try:
+            return self._park_and_commit(barrier, key, node_name, pod)
+        finally:
+            with self._lock:
+                barrier.users -= 1
+                # eager cleanup of a closed, idle barrier (every member
+                # timed out): no unbounded growth, and no prune that could
+                # orphan a concurrently-fetched barrier (users guards that)
+                if (
+                    barrier.users == 0
+                    and not barrier.parked
+                    and not barrier.open
+                    and self._gang_barriers.get(key) is barrier
+                ):
+                    self._gang_barriers.pop(key, None)
+
+    def _park_and_commit(self, barrier: GangBarrier, key: str,
+                         node_name: str, pod: Pod) -> Pod:
+        info, plan = self._reserve(node_name, pod)
+        with barrier.cv:
+            if pod.uid in barrier.parked:
+                info.unbind(plan)
+                raise BindError(
+                    f"bind of {pod.key()} is already parked at gang {key}'s "
+                    "barrier"
+                )
+            barrier.parked.add(pod.uid)
+        with self._lock:
+            self._reserved[pod.uid] = _Reservation(node_name, info, plan)
+        timeout = podutil.gang_timeout(pod)
+        deadline = time.monotonic() + timeout
+        try:
+            with barrier.cv:
+                if not barrier.open and (
+                    self.gangs.bound_count(key) + len(barrier.parked)
+                    >= barrier.size
+                ):
+                    barrier.open = True
+                    barrier.cv.notify_all()
+                while not barrier.open:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        have = (
+                            self.gangs.bound_count(key) + len(barrier.parked)
+                        )
+                        raise BindError(
+                            f"gang {key} barrier timeout: {have} of "
+                            f"{barrier.size} members held reservations "
+                            f"within {timeout:g}s; reservation for "
+                            f"{pod.key()} rolled back"
+                        )
+                    barrier.cv.wait(remaining)
+        except BindError:
+            with barrier.cv:
+                barrier.parked.discard(pod.uid)
+            with self._lock:
+                res = self._reserved.pop(pod.uid, None)
+            if res is not None and res.valid:
+                res.info.unbind(res.plan)
+            raise
+        with barrier.cv:
+            barrier.parked.discard(pod.uid)
+        with self._lock:
+            res = self._reserved.pop(pod.uid, None)
+        if res is None or not res.valid:
+            # node rebuilt/removed while parked and the plan no longer fits
+            # (or the pod was forgotten): nothing to roll back — the chips
+            # live on an orphaned NodeInfo or were never re-applied
+            raise BindError(
+                f"node {node_name} changed while {pod.key()} awaited gang "
+                f"{key}'s barrier; reservation lost, bind must retry"
+            )
+        return self._commit_reserved(res.info, res.plan, node_name, pod)
+
+    def _commit_reserved(self, info, plan: Plan, node_name: str,
+                         pod: Pod) -> Pod:
+        """API writes + bookkeeping for an applied reservation (the second
+        half of a bind; rolls the reservation back on write failure)."""
         # register BEFORE the API writes: update_pod fires a MODIFIED event
         # (assume=true) that the reconciler races to allocate — the map entry
         # is what makes _learn_bound_pod a no-op for this pod

@@ -107,6 +107,22 @@ ANNOTATION_GANG_NAME = "tpu.io/gang-name"
 #: Total number of pods in the gang (int as string).
 ANNOTATION_GANG_SIZE = "tpu.io/gang-size"
 
+#: Gang co-scheduling mode: "soft" (default — ICI-affinity scoring only) or
+#: "strict" (all-or-nothing: Bind holds each member's chip reservation until
+#: gang-size members hold one, or rolls it back on timeout).
+ANNOTATION_GANG_POLICY = "tpu.io/gang-policy"
+GANG_POLICY_SOFT = "soft"
+GANG_POLICY_STRICT = "strict"
+
+#: Per-pod override (seconds, int/float as string) for how long a strict
+#: gang Bind may park awaiting the rest of the gang.
+ANNOTATION_GANG_TIMEOUT = "tpu.io/gang-timeout-seconds"
+
+#: Default strict-barrier park timeout. Bounded so a gang that never
+#: completes (quota, node failure) cannot wedge binds forever — the
+#: reservation rolls back and kube-scheduler retries the pod.
+GANG_BARRIER_TIMEOUT_S = 30.0
+
 # --------------------------------------------------------------------------
 # Placement-policy names (CLI flag values).
 # Reference: PriorityBinPack/PrioritySpread (pkg/types/types.go:18-21);

@@ -138,3 +138,21 @@ def gang_of(pod: Pod) -> tuple[str, int] | None:
     except ValueError:
         size = 0
     return name, max(size, 0)
+
+
+def gang_is_strict(pod: Pod) -> bool:
+    """True when the pod opts into all-or-nothing gang binding."""
+    return (
+        pod.annotations.get(types.ANNOTATION_GANG_POLICY, "").strip().lower()
+        == types.GANG_POLICY_STRICT
+    )
+
+
+def gang_timeout(pod: Pod) -> float:
+    """Strict-barrier park timeout for this pod (seconds, clamped > 0)."""
+    raw = pod.annotations.get(types.ANNOTATION_GANG_TIMEOUT)
+    try:
+        val = float(raw) if raw else types.GANG_BARRIER_TIMEOUT_S
+    except ValueError:
+        val = types.GANG_BARRIER_TIMEOUT_S
+    return max(val, 0.1)

@@ -159,3 +159,251 @@ class TestDealerGangFlow:
         d2 = Dealer(pool, make_rater("binpack"))  # fresh boot, same cluster
         assert d2.status()["gangs"]["default/g3"]["bound"] == 1
         assert d2.gangs.bound_nodes("default/g3") == ["s0-h01"]
+
+
+def strict_pod(name, gang, size, percent=200, timeout=None):
+    ann = {
+        types.ANNOTATION_GANG_NAME: gang,
+        types.ANNOTATION_GANG_SIZE: str(size),
+        types.ANNOTATION_GANG_POLICY: types.GANG_POLICY_STRICT,
+    }
+    if timeout is not None:
+        ann[types.ANNOTATION_GANG_TIMEOUT] = str(timeout)
+    return make_pod(
+        name,
+        containers=[make_container("w", {types.RESOURCE_TPU_PERCENT: percent})],
+        annotations=ann,
+    )
+
+
+class TestStrictGangBarrier:
+    """Opt-in all-or-nothing gang binding (VERDICT r2 missing #5):
+    tpu.io/gang-policy: strict parks each member's Bind until gang-size
+    members hold reservations; timeouts roll back, so an incomplete gang
+    converges to 'not at all'."""
+
+    def _cluster(self, n_hosts=16):
+        from nanotpu.cmd.main import make_mock_cluster
+
+        client = make_mock_cluster(n_hosts, 4)
+        return client, Dealer(client, make_rater("binpack"))
+
+    def _bind_async(self, dealer, client, pods):
+        """Launch one bind thread per (pod, node); returns (threads,
+        results dict name->'ok'|error-string)."""
+        import threading
+
+        results = {}
+
+        def one(pod, node):
+            try:
+                dealer.bind(node, pod)
+                results[pod.name] = "ok"
+            except Exception as e:
+                results[pod.name] = str(e)
+
+        threads = []
+        for pod, node in pods:
+            t = threading.Thread(target=one, args=(pod, node), daemon=True)
+            t.start()
+            threads.append(t)
+        return threads, results
+
+    def test_eight_expert_pods_bind_atomically(self):
+        """BASELINE config[4] shape: 8 Mixtral expert pods (2 chips each).
+        With 7 members parked nothing commits; the 8th opens the barrier
+        and ALL commit."""
+        import time
+
+        client, dealer = self._cluster()
+        pods = [
+            client.create_pod(strict_pod(f"expert-{i}", "mixtral", 8,
+                                         timeout=30))
+            for i in range(8)
+        ]
+        nodes = [f"v5p-host-{i}" for i in range(16)]
+        # drive the real cycle: each pod's Filter runs AFTER the previous
+        # member's bind applied its reservation (kube-scheduler's next
+        # scheduling cycle starts once the prior bind goroutine launched),
+        # so placement sees the parked members' chips as taken
+        threads, results = [], {}
+        for i, pod in enumerate(pods[:7]):
+            ok, _ = dealer.assume(nodes, pod)
+            scores = dict(dealer.score(nodes, pod))
+            target = max(ok, key=lambda n: scores[n])
+            t, r = self._bind_async(dealer, client, [(pod, target)])
+            threads += t
+            results.update(r)
+            # wait for this member's reservation to land before the next
+            # member's filter (its bind thread reserves, then parks)
+            deadline = time.time() + 5
+            while (
+                dealer.occupancy() < (i + 1) * 2 / 64 - 1e-9
+                and not results
+                and time.time() < deadline
+            ):
+                time.sleep(0.01)
+        time.sleep(0.3)
+        # nothing committed: no annotations written, no gang members bound
+        assert results == {}, f"commits before barrier opened: {results}"
+        assert dealer.gangs.bound_count("default/mixtral") == 0
+        for pod in pods[:7]:
+            fresh = client.get_pod("default", pod.name)
+            assert types.ANNOTATION_ASSUME not in fresh.annotations
+        # ...but chips ARE reserved while parked (7 pods x 200%)
+        assert dealer.occupancy() == pytest.approx(14 / 64)
+        ok, _ = dealer.assume(nodes, pods[7])
+        scores = dict(dealer.score(nodes, pods[7]))
+        t8, r8 = self._bind_async(
+            dealer, client, [(pods[7], max(ok, key=lambda n: scores[n]))]
+        )
+        for t in threads + t8:
+            t.join(20)
+        results.update(r8)
+        assert all(v == "ok" for v in results.values()), results
+        assert dealer.gangs.bound_count("default/mixtral") == 8
+        assert dealer.occupancy() == pytest.approx(16 / 64)
+        for pod in pods:
+            fresh = client.get_pod("default", pod.name)
+            assert fresh.annotations.get(types.ANNOTATION_ASSUME) == "true"
+
+    def test_incomplete_gang_times_out_without_deadlock(self):
+        """Only 3 of 8 members ever bind: every parked bind fails within
+        its timeout with a clear error, reservations roll back to zero,
+        and the dealer still binds unrelated pods afterwards."""
+        import time
+
+        client, dealer = self._cluster()
+        pods = [
+            client.create_pod(strict_pod(f"lone-{i}", "partial", 8,
+                                         timeout=0.8))
+            for i in range(3)
+        ]
+        # distinct hosts (placement choice is not under test here)
+        targets = [f"v5p-host-{i}" for i in range(3)]
+        t0 = time.time()
+        threads, results = self._bind_async(
+            dealer, client, list(zip(pods, targets))
+        )
+        for t in threads:
+            t.join(10)
+            assert not t.is_alive(), "parked bind never returned (deadlock)"
+        assert time.time() - t0 < 8
+        assert len(results) == 3
+        for name, err in results.items():
+            assert "barrier timeout" in err, (name, err)
+            assert "rolled back" in err
+        # all reservations rolled back; nothing bound, nothing annotated
+        assert dealer.occupancy() == 0.0
+        assert dealer.gangs.bound_count("default/partial") == 0
+        # the dealer is healthy: a plain pod binds immediately
+        plain = client.create_pod(gang_pod("after", "other", 1, percent=100))
+        dealer.bind("v5p-host-0", plain)
+        assert dealer.occupancy() == pytest.approx(1 / 64)
+
+    def test_completed_gang_replacement_binds_straight_through(self):
+        """Once a gang completed, a replacement member (pod restart) must
+        not park: bound members already satisfy the barrier."""
+        client, dealer = self._cluster()
+        pods = [
+            client.create_pod(strict_pod(f"m-{i}", "done", 2, timeout=30))
+            for i in range(2)
+        ]
+        threads, results = self._bind_async(
+            dealer, client,
+            [(pods[0], "v5p-host-0"), (pods[1], "v5p-host-1")],
+        )
+        for t in threads:
+            t.join(10)
+        assert all(v == "ok" for v in results.values()), results
+        repl = client.create_pod(strict_pod("m-0b", "done", 2, timeout=5))
+        dealer.bind("v5p-host-2", repl)  # returns without parking
+        assert dealer.gangs.bound_count("default/done") == 3
+
+    def test_soft_gang_unaffected(self):
+        """Without the strict annotation a lone gang member still binds
+        immediately (the r1/r2 default semantics)."""
+        client, dealer = self._cluster(4)
+        pod = client.create_pod(gang_pod("soft-0", "softy", 8, percent=100))
+        dealer.bind("v5p-host-0", pod)
+        assert dealer.gangs.bound_count("default/softy") == 1
+
+    def test_resubmitted_gang_does_not_inherit_open_barrier(self):
+        """Gang completes, job is released/forgotten, SAME gang name is
+        re-submitted: the barrier must be closed again (a stale open=True
+        would silently bypass all-or-nothing)."""
+        client, dealer = self._cluster(4)
+        pods = [
+            client.create_pod(strict_pod(f"g1-{i}", "re", 2, timeout=30))
+            for i in range(2)
+        ]
+        threads, results = self._bind_async(
+            dealer, client, [(pods[0], "v5p-host-0"), (pods[1], "v5p-host-1")]
+        )
+        for t in threads:
+            t.join(10)
+        assert all(v == "ok" for v in results.values()), results
+        # the job finishes: release both members
+        for pod in pods:
+            bound = client.get_pod("default", pod.name)
+            bound.raw["status"] = {"phase": "Succeeded"}
+            dealer.release(bound)
+        assert dealer.gangs.bound_count("default/re") == 0
+        # resubmit gang "re": a lone member must PARK (and time out), not
+        # sail through a stale open barrier
+        lone = client.create_pod(strict_pod("g2-0", "re", 2, timeout=0.6))
+        import time
+
+        t0 = time.time()
+        try:
+            dealer.bind("v5p-host-0", lone)
+            committed = True
+        except Exception as e:
+            committed = False
+            assert "barrier timeout" in str(e)
+        assert not committed, "stale open barrier bypassed strict binding"
+        assert time.time() - t0 >= 0.5
+        assert dealer.occupancy() == 0.0
+
+    def test_node_removed_while_parked_fails_cleanly(self):
+        """A member parked at the barrier loses its node: its bind must
+        fail (not double-book) and the gang's other member also rolls back
+        on timeout."""
+        import time
+
+        client, dealer = self._cluster(4)
+        p0 = client.create_pod(strict_pod("nr-0", "nrg", 3, timeout=3))
+        threads, results = self._bind_async(dealer, client, [(p0, "v5p-host-1")])
+        deadline = time.time() + 5
+        while dealer.occupancy() == 0.0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert dealer.occupancy() > 0  # reservation applied
+        dealer.remove_node("v5p-host-1")  # node dies mid-park
+        # second member arrives, third never does -> barrier can't open;
+        # p0's reservation is already invalid
+        p1 = client.create_pod(strict_pod("nr-1", "nrg", 3, timeout=1))
+        t2, r2 = self._bind_async(dealer, client, [(p1, "v5p-host-2")])
+        for t in threads + t2:
+            t.join(10)
+            assert not t.is_alive()
+        results.update(r2)
+        assert len(results) == 2
+        assert any(
+            "changed while" in e or "barrier timeout" in e
+            for e in results.values()
+        ), results
+        assert all(v != "ok" for v in results.values()), results
+        assert dealer.occupancy() == 0.0
+
+    def test_bind_retry_is_idempotent(self):
+        """A retried bind for an already-committed pod (scheduler abandoned
+        the first HTTP call) must succeed without reserving twice."""
+        client, dealer = self._cluster(4)
+        pod = client.create_pod(gang_pod("idem", "ig", 1, percent=100))
+        dealer.bind("v5p-host-0", pod)
+        occ = dealer.occupancy()
+        again = dealer.bind("v5p-host-0", pod)  # no error, no double-book
+        assert dealer.occupancy() == occ
+        assert again.annotations.get(types.ANNOTATION_ASSUME) == "true"
+        with pytest.raises(Exception, match="already"):
+            dealer.bind("v5p-host-1", pod)
