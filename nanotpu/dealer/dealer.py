@@ -71,13 +71,14 @@ class _Reservation:
     marks it invalid and the parked bind fails instead of double-booking.
     """
 
-    __slots__ = ("node_name", "info", "plan", "valid")
+    __slots__ = ("node_name", "info", "plan", "valid", "gang_key")
 
-    def __init__(self, node_name: str, info, plan: Plan):
+    def __init__(self, node_name: str, info, plan: Plan, gang_key: str):
         self.node_name = node_name
         self.info = info
         self.plan = plan
         self.valid = True
+        self.gang_key = gang_key
 
 
 def plan_from_pod(pod: Pod) -> Plan | None:
@@ -309,11 +310,11 @@ class Dealer:
             self._nodes.pop(name, None)
             self._non_tpu.discard(name)
             self._nodes_epoch += 1
-            for res in self._reserved.values():
+            for uid, res in self._reserved.items():
                 # parked strict-gang reservations on this node are gone;
                 # their binds must fail rather than commit to a dead node
-                if res.node_name == name:
-                    res.valid = False
+                if res.node_name == name and res.valid:
+                    self._invalidate_reservation(uid, res)
         self.usage.forget_node(name)
 
     def refresh_node(self, node: Node) -> bool:
@@ -367,7 +368,7 @@ class Dealer:
                 current.allocate(res.plan)
                 res.info = current
             except ValueError:
-                res.valid = False
+                self._invalidate_reservation(uid, res)
                 log.warning(
                     "parked reservation for pod uid %s lost in %s rebuild",
                     uid, node_name,
@@ -624,6 +625,18 @@ class Dealer:
         with self._lock:
             self._gang_barriers.pop(gang_key, None)
 
+    def _invalidate_reservation(self, uid: str, res: _Reservation) -> None:
+        """Mark a parked reservation dead AND stop it counting toward its
+        gang's barrier threshold (caller holds the dealer lock). Leaving
+        the uid parked would let the barrier open one REAL member short —
+        a partial commit, the exact thing strict mode forbids."""
+        res.valid = False
+        barrier = self._gang_barriers.get(res.gang_key)
+        if barrier is not None:
+            with barrier.cv:
+                barrier.parked.discard(uid)
+                barrier.cv.notify_all()
+
     def _bind_strict(self, node_name: str, pod: Pod,
                      gang: tuple[str, int]) -> Pod:
         """All-or-nothing gang bind (tpu.io/gang-policy: strict): reserve,
@@ -670,7 +683,7 @@ class Dealer:
                 )
             barrier.parked.add(pod.uid)
         with self._lock:
-            self._reserved[pod.uid] = _Reservation(node_name, info, plan)
+            self._reserved[pod.uid] = _Reservation(node_name, info, plan, key)
         timeout = podutil.gang_timeout(pod)
         deadline = time.monotonic() + timeout
         try:
@@ -682,6 +695,11 @@ class Dealer:
                     barrier.open = True
                     barrier.cv.notify_all()
                 while not barrier.open:
+                    if pod.uid not in barrier.parked:
+                        # de-parked by _invalidate_reservation (node died
+                        # mid-park): fail now, not at the timeout — the
+                        # post-loop validity check raises the right error
+                        break
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         have = (
@@ -704,17 +722,21 @@ class Dealer:
             raise
         with barrier.cv:
             barrier.parked.discard(pod.uid)
+            opened = barrier.open
         with self._lock:
             res = self._reserved.pop(pod.uid, None)
-        if res is None or not res.valid:
-            # node rebuilt/removed while parked and the plan no longer fits
-            # (or the pod was forgotten): nothing to roll back — the chips
-            # live on an orphaned NodeInfo or were never re-applied
-            raise BindError(
-                f"node {node_name} changed while {pod.key()} awaited gang "
-                f"{key}'s barrier; reservation lost, bind must retry"
-            )
-        return self._commit_reserved(res.info, res.plan, node_name, pod)
+        if res is not None and res.valid and opened:
+            return self._commit_reserved(res.info, res.plan, node_name, pod)
+        if res is not None and res.valid:
+            # de-parked without the barrier opening (defensive): roll back
+            res.info.unbind(res.plan)
+        # node rebuilt/removed while parked and the plan no longer fits
+        # (or the pod was forgotten): nothing to roll back — the chips
+        # live on an orphaned NodeInfo or were never re-applied
+        raise BindError(
+            f"node {node_name} changed while {pod.key()} awaited gang "
+            f"{key}'s barrier; reservation lost, bind must retry"
+        )
 
     def _commit_reserved(self, info, plan: Plan, node_name: str,
                          pod: Pod) -> Pod:

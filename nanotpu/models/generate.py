@@ -135,14 +135,16 @@ def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start,
         out = _attend_cached(q, k_cache, v_cache, start + S)
     x = x + linear(out.reshape(B, S, H * hd), attn["wo"])
     if "moe" in layer:
-        # NOTE: expert capacity is computed over the tokens in THIS call
-        # (B*S), not the full sequence — matches the full forward only when
-        # capacity doesn't bind. For inference use a capacity_factor high
-        # enough that no token drops (C >= B*top_k covers the worst case).
+        # Decode steps (S == 1) route at full capacity so co-batched rows
+        # stay independent (C = B*top_k, tiny). Prefill keeps the
+        # capacity_factor semantics of the full forward: capacity there is
+        # computed over THIS call's B*S tokens, which matches forward()
+        # exactly for the engine's B=1 prefills.
         from nanotpu.models.mixtral import moe_block
 
         ffn_out, _aux = moe_block(
-            layer["moe"], rms_norm(x, layer["moe_norm"], cfg.norm_eps), cfg
+            layer["moe"], rms_norm(x, layer["moe_norm"], cfg.norm_eps), cfg,
+            full_capacity=(S == 1),
         )
     else:
         ffn_out = mlp(layer["mlp"], rms_norm(x, layer["mlp_norm"], cfg.norm_eps))

@@ -133,18 +133,22 @@ def init_params(rng: jax.Array, cfg: MixtralConfig) -> dict:
 
 
 def route_topk(
-    logits: jax.Array, cfg: MixtralConfig
+    logits: jax.Array, cfg: MixtralConfig, capacity: int | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Top-k routing with capacity.
 
     logits [T, E] fp32 -> (dispatch [T, E, C] bool-ish, combine [T, E, C]
-    fp32, aux_loss scalar). C = ceil(capacity_factor * T * k / E). Tokens
-    beyond an expert's capacity are dropped (their combine weights are 0 and
-    the residual stream passes through — standard Switch behavior).
+    fp32, aux_loss scalar). C = ceil(capacity_factor * T * k / E), or the
+    explicit ``capacity`` override. Tokens beyond an expert's capacity are
+    dropped (their combine weights are 0 and the residual stream passes
+    through — standard Switch behavior).
     """
     T, E = logits.shape
     k = cfg.top_k
-    C = max(1, int(math.ceil(cfg.capacity_factor * T * k / E)))
+    if capacity is not None:
+        C = max(1, capacity)
+    else:
+        C = max(1, int(math.ceil(cfg.capacity_factor * T * k / E)))
     probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
 
     # aux load-balancing loss (Switch eq.4): E * sum_e f_e * p_e
@@ -186,14 +190,25 @@ def route_topk(
     return dispatch, combine, aux
 
 
-def moe_block(params: dict, x: jax.Array, cfg: MixtralConfig) -> tuple[jax.Array, jax.Array]:
+def moe_block(params: dict, x: jax.Array, cfg: MixtralConfig,
+              full_capacity: bool = False) -> tuple[jax.Array, jax.Array]:
     """x [B, S, D] -> (out [B, S, D], aux loss). Dense dispatch/combine
-    einsums; expert matmuls batched on the E axis (ep-shardable)."""
+    einsums; expert matmuls batched on the E axis (ep-shardable).
+
+    ``full_capacity`` sets C = T * top_k — enough buffer for every token's
+    every choice, so no token can be dropped and each row's routing is
+    independent of its batch-mates. The decode paths (T = co-batched rows,
+    one token each) use it: a serving slot's output must equal its solo
+    run regardless of who shares the step. Never use it for long-sequence
+    prefill/training, where the [T, E, T*k] dispatch tensor would dwarf
+    the activations and capacity pressure is the intended regularizer."""
     B, S, D = x.shape
     T = B * S
     flat = x.reshape(T, D)
     logits = flat.astype(jnp.float32) @ params["router"]  # [T, E]
-    dispatch, combine, aux = route_topk(logits, cfg)
+    dispatch, combine, aux = route_topk(
+        logits, cfg, capacity=T * cfg.top_k if full_capacity else None
+    )
     dispatch = dispatch.astype(x.dtype)
     # dispatch tokens into per-expert buffers: [E, C, D]
     expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)

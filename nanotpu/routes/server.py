@@ -347,7 +347,19 @@ class _Handler(socketserver.StreamRequestHandler):
                 return
             body = self.rfile.read(length) if length else b""
             code, ctype, payload = self.api.dispatch(method, path, body)
-            self._write(code, ctype, payload, keep_alive)
+            if isinstance(payload, str):
+                self._write(code, ctype, payload, keep_alive)
+            else:
+                # an iterator payload streams: chunked transfer encoding on
+                # HTTP/1.1 (keep-alive framing stays intact); HTTP/1.0
+                # clients cannot parse chunked framing, so they get a raw
+                # stream delimited by connection close
+                framed = version == "HTTP/1.1"
+                self._write_chunked(
+                    code, ctype, payload, keep_alive and framed, framed
+                )
+                if not framed:
+                    return
             if not keep_alive:
                 return
 
@@ -363,6 +375,39 @@ class _Handler(socketserver.StreamRequestHandler):
         # one write: headers + body leave in a single segment
         self.wfile.write(head + data)
         self.wfile.flush()
+
+    def _write_chunked(self, code: int, ctype: str, chunks,
+                       keep_alive: bool, framed: bool = True):
+        """Stream an iterator of str/bytes chunks, flushing each as it is
+        produced (TTFT is the point). ``framed`` uses HTTP/1.1 chunked
+        transfer encoding; unframed (HTTP/1.0) writes the raw stream and
+        the caller closes the connection to delimit it."""
+        head = (
+            _STATUS_LINE.get(code)
+            or f"HTTP/1.1 {code} Status\r\n".encode()
+        ) + (
+            f"Content-Type: {ctype}\r\n"
+            + ("Transfer-Encoding: chunked\r\n" if framed else "")
+            + f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n"
+        ).encode()
+        self.wfile.write(head)
+        self.wfile.flush()
+        try:
+            for chunk in chunks:
+                data = chunk.encode() if isinstance(chunk, str) else chunk
+                if not data:
+                    continue
+                if framed:
+                    data = f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                self.wfile.write(data)
+                self.wfile.flush()
+        finally:
+            close = getattr(chunks, "close", None)
+            if close is not None:
+                close()  # release the generator's request resources
+        if framed:
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
 
 
 class _Server(socketserver.ThreadingTCPServer):

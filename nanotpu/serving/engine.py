@@ -27,9 +27,13 @@ pod to run — this is it, designed TPU-first:
 * **int8 composes for free**: ``linear`` dispatches on QArray leaves, so an
   engine built from ``quantize_params(params)`` runs weight-only int8.
 
-MoE caveat: expert capacity in ``moe_block`` is computed over the tokens in
-one call; for serving use a ``capacity_factor`` high enough that no token
-drops (C >= SLOTS * top_k at S=1), or routing depends on co-batched rows.
+MoE serving routes decode steps at **full expert capacity** (C = SLOTS *
+top_k — tiny at S=1): no token is ever dropped, so each slot's routing is
+independent of its batch-mates at any ``capacity_factor``. Prefill keeps
+Switch capacity semantics with C computed over the padded bucket length —
+looser than an unpadded run (nearly drop-free for prompts much shorter
+than their bucket), the memory-bounded choice for long prompts where a
+drop-free dispatch tensor would be O(T^2).
 """
 
 from __future__ import annotations
@@ -212,8 +216,11 @@ def serving_step(params, cfg, cache: "SlotCache | SlotCache8", tokens,
         if "moe" in layer:
             from nanotpu.models.mixtral import moe_block
 
+            # full capacity at S=1: every slot routes independently of its
+            # batch-mates (C = SLOTS * top_k is tiny at decode shapes)
             ffn_out, _aux = moe_block(
-                layer["moe"], rms_norm(x, layer["moe_norm"], cfg.norm_eps), cfg
+                layer["moe"], rms_norm(x, layer["moe_norm"], cfg.norm_eps),
+                cfg, full_capacity=True,
             )
         else:
             ffn_out = mlp(
@@ -372,10 +379,38 @@ class Request:
         self.done_at: float | None = None
         self.error: str | None = None
         self._done = threading.Event()
+        #: signaled by the engine loop whenever new tokens landed in
+        #: ``out`` (once per decode chunk per row, not per token) — the
+        #: stream() consumers' wakeup
+        self._progress = threading.Condition()
 
     # -- results -----------------------------------------------------------
     def wait(self, timeout: float | None = None) -> bool:
         return self._done.wait(timeout)
+
+    def stream(self, timeout: float | None = None):
+        """Yield lists of new tokens as the engine emits them (one batch
+        per decode-chunk boundary), returning when the request completes.
+        ``timeout`` bounds the wait for EACH batch; no progress within it
+        raises TimeoutError. Check ``self.error`` after exhaustion."""
+        cursor = 0
+        while True:
+            with self._progress:
+                while cursor >= len(self.out) and not self._done.is_set():
+                    if not self._progress.wait(timeout):
+                        raise TimeoutError(
+                            f"request {self.id}: no progress in {timeout}s"
+                        )
+                batch = list(self.out[cursor:])
+            cursor += len(batch)
+            if batch:
+                yield batch
+            if self._done.is_set() and cursor >= len(self.out):
+                return
+
+    def _notify_progress(self) -> None:
+        with self._progress:
+            self._progress.notify_all()
 
     @property
     def ttft_s(self) -> float | None:
@@ -393,6 +428,7 @@ class Request:
         self.error = error
         self.done_at = time.perf_counter()
         self._done.set()
+        self._notify_progress()
 
 
 class Engine:
@@ -689,6 +725,7 @@ class Engine:
                 with self._cv:
                     self.latency_samples.append(req.latency_s)
                 continue
+            req._notify_progress()  # first token is streamable immediately
             self._slot_req[slot] = req
             self._tokens[slot] = tok
             self._temps[slot] = req.temperature
@@ -762,6 +799,9 @@ class Engine:
                 self._temps[i] = 0.0
                 # device `done` is already True for this row — eviction
                 # alone doesn't require a re-upload
+            else:
+                # one wakeup per chunk per row for stream() consumers
+                req._notify_progress()
 
     def _loop(self) -> None:
         while True:

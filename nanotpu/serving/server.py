@@ -6,6 +6,8 @@ threads block on the engine future, the engine batches across them.
 
 Run:  python -m nanotpu.serving.server --preset tiny --port 8100
       curl -d '{"tokens": [1,2,3], "max_new_tokens": 8}' localhost:8100/v1/generate
+      curl -N -d '{"tokens": [1,2,3], "max_new_tokens": 64, "stream": true}' \
+           localhost:8100/v1/generate     # SSE token streaming
 """
 
 from __future__ import annotations
@@ -100,6 +102,8 @@ class ServingAPI:
             )
         req = self.engine.submit(tokens, max_new, temperature)
         self.req_total.inc()
+        if args.get("stream"):
+            return 200, "text/event-stream", self._sse_events(req)
         if not req.wait(self.request_timeout_s):
             return 500, "application/json", json.dumps(
                 {"error": "request timed out"}
@@ -107,16 +111,47 @@ class ServingAPI:
         if req.error:
             return 400, "application/json", json.dumps({"error": req.error})
         self.tok_total.inc(len(req.out))
+        stats = self._completion_stats(req)
+        stats["tokens"] = req.out
+        return 200, "application/json", json.dumps(stats)
+
+    def _completion_stats(self, req) -> dict:
+        """Observe the latency histograms and build the shared completion
+        fields (the JSON and SSE paths must not drift)."""
         if req.ttft_s is not None:
             self.ttft.observe(req.ttft_s)
         if req.latency_s is not None:
             self.latency.observe(req.latency_s)
-        return 200, "application/json", json.dumps({
+        return {
             "id": req.id,
-            "tokens": req.out,
-            "ttft_ms": round(req.ttft_s * 1e3, 2) if req.ttft_s else None,
-            "latency_ms": round(req.latency_s * 1e3, 2) if req.latency_s else None,
-        })
+            "ttft_ms": (
+                round(req.ttft_s * 1e3, 2) if req.ttft_s is not None else None
+            ),
+            "latency_ms": (
+                round(req.latency_s * 1e3, 2)
+                if req.latency_s is not None else None
+            ),
+        }
+
+    def _sse_events(self, req):
+        """SSE generator: one ``data:`` event per decode-chunk batch of
+        tokens (the engine's natural streaming boundary), then a final
+        event carrying completion stats — TTFT is user-visible because the
+        first event leaves as soon as the prefill's token lands, not when
+        the whole generation finishes. ({"stream": true} on /v1/generate.)"""
+        try:
+            for batch in req.stream(self.request_timeout_s):
+                self.tok_total.inc(len(batch))
+                yield f"data: {json.dumps({'id': req.id, 'tokens': batch})}\n\n"
+        except TimeoutError:
+            yield f"data: {json.dumps({'id': req.id, 'error': 'request timed out'})}\n\n"
+            return
+        if req.error:
+            yield f"data: {json.dumps({'id': req.id, 'error': req.error})}\n\n"
+            return
+        stats = self._completion_stats(req)
+        stats.update(done=True, n_tokens=len(req.out))
+        yield f"data: {json.dumps(stats)}\n\n"
 
 
 def build_engine(preset: str, slots: int, max_len: int, quantize: bool,

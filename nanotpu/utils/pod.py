@@ -149,10 +149,17 @@ def gang_is_strict(pod: Pod) -> bool:
 
 
 def gang_timeout(pod: Pod) -> float:
-    """Strict-barrier park timeout for this pod (seconds, clamped > 0)."""
+    """Strict-barrier park timeout for this pod, clamped to a finite
+    [0.1, 3600] s: "nan" would busy-spin Condition.wait forever and "inf"
+    overflows it to an exception that escapes the rollback path — either
+    way a reservation would leak on a wedged bind thread."""
+    import math
+
     raw = pod.annotations.get(types.ANNOTATION_GANG_TIMEOUT)
     try:
         val = float(raw) if raw else types.GANG_BARRIER_TIMEOUT_S
     except ValueError:
         val = types.GANG_BARRIER_TIMEOUT_S
-    return max(val, 0.1)
+    if not math.isfinite(val):
+        val = types.GANG_BARRIER_TIMEOUT_S
+    return min(max(val, 0.1), 3600.0)
