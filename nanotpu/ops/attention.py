@@ -246,6 +246,21 @@ def _rebuild_p(q_blk, k_blk, lse_blk, q_pos, k_pos, seq_len, causal, scale):
     return jnp.where(valid, jnp.exp(logits - lse_blk[:, None]), 0.0)
 
 
+def _bwd_pair(q_blk, k_blk, v_blk, do_blk, lse_blk, d_blk, q_pos, k_pos,
+              seq_len, causal, scale):
+    """Shared per-(q block, k block) backward math — the single source of
+    truth for all three backward kernels (two-pass dq/dkv and the fused
+    one), so masking or ds changes cannot diverge between regimes.
+    Returns (p, ds): dv += p^T dO; dk += ds^T q; dq += ds k."""
+    p = _rebuild_p(q_blk, k_blk, lse_blk, q_pos, k_pos, seq_len, causal, scale)
+    dp = jax.lax.dot_general(
+        do_blk, v_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - d_blk[:, None])
+    return p, ds
+
+
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
                          *, block_k, seq_len, causal, scale):
     """dq for one q block: loop over (causally relevant) k blocks.
@@ -268,12 +283,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
         k_pos = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        p = _rebuild_p(q, k_blk, lse, q_pos, k_pos, seq_len, causal, scale)
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        _p, ds = _bwd_pair(
+            q, k_blk, v_blk, do, lse, dvec, q_pos, k_pos,
+            seq_len, causal, scale,
         )
-        ds = p * (dp - dvec[:, None])
         return acc + jax.lax.dot_general(
             ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -310,16 +323,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
         q_pos = qb * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
-        p = _rebuild_p(q_blk, k_blk, lse_blk, q_pos, k_pos, seq_len, causal, scale)
+        p, ds = _bwd_pair(
+            q_blk, k_blk, v_blk, do_blk, lse_blk, d_blk, q_pos, k_pos,
+            seq_len, causal, scale,
+        )
         dv_acc = dv_acc + jax.lax.dot_general(
             p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
-            do_blk, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - d_blk[:, None])
         dk_acc = dk_acc + jax.lax.dot_general(
             ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -332,6 +343,94 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
     dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, (zeros, zeros))
     dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
+                            dq_ref, dk_ref, dv_ref,
+                            *, block_q, block_k, seq_len, causal, scale):
+    """Single-pass backward: one program per (batch*head) walks all
+    (k block, causally-relevant q block) pairs ONCE, so P and dP are
+    computed a single time each — 5 matmuls per pair against the
+    two-pass kernels' 7 (both passes re-derive P, and the dq pass
+    re-derives dP). dq accumulates in-place in the f32 output block
+    (VMEM) across k blocks; dk/dv accumulate in registers per k block."""
+    from jax.experimental import pallas as pl
+
+    _, S_pad, d = q_ref.shape
+    dq_ref[0] = jnp.zeros((S_pad, d), jnp.float32)
+    num_kb = S_pad // block_k
+    num_qb = S_pad // block_q
+
+    def kb_body(kb, _):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        k_pos = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        ) + kb * block_k
+
+        def qb_body(qb, carry):
+            dk_acc, dv_acc = carry
+            q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :]
+            do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :]
+            lse_blk = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+            d_blk = d_ref[0, 0, pl.ds(qb * block_q, block_q)]
+            q_pos = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            ) + qb * block_q
+            p, ds = _bwd_pair(
+                q_blk, k_blk, v_blk, do_blk, lse_blk, d_blk, q_pos, k_pos,
+                seq_len, causal, scale,
+            )
+            dv_acc = dv_acc + jax.lax.dot_general(
+                p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dk_acc = dk_acc + jax.lax.dot_general(
+                ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dq_blk = dq_ref[0, pl.ds(qb * block_q, block_q), :]
+            dq_ref[0, pl.ds(qb * block_q, block_q), :] = (
+                dq_blk + jax.lax.dot_general(
+                    ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale
+            )
+            return dk_acc, dv_acc
+
+        start_qb = (kb * block_k) // block_q if causal else 0
+        zeros = jnp.zeros((block_k, d), jnp.float32)
+        dk, dv = jax.lax.fori_loop(start_qb, num_qb, qb_body, (zeros, zeros))
+        dk_ref[0, pl.ds(kb * block_k, block_k), :] = (
+            dk * scale
+        ).astype(dk_ref.dtype)
+        dv_ref[0, pl.ds(kb * block_k, block_k), :] = dv.astype(dv_ref.dtype)
+        return 0
+
+    jax.lax.fori_loop(0, num_kb, kb_body, 0)
+
+
+def _env_threshold(name: str, default: int) -> int:
+    """Non-negative int env override; 0 disables the gated feature."""
+    raw = _os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        import logging
+
+        logging.getLogger("nanotpu.ops").warning(
+            "%s=%r is not an int; using default %d", name, raw, default
+        )
+        return default
+
+
+#: Above this padded sequence length the fused backward's whole-sequence
+#: VMEM working set stops fitting comfortably; fall back to the two-pass
+#: kernels (ring attention owns the genuinely long-context regime anyway).
+#: NANOTPU_FLASH_FUSED_BWD_MAX_S=0 disables the fused path entirely.
+FUSED_BWD_MAX_S = max(
+    _env_threshold("NANOTPU_FLASH_FUSED_BWD_MAX_S", 4096), 0
+)
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
@@ -354,6 +453,30 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
     dvec = jnp.broadcast_to(
         dvec[:, None, :], (B * H, LSE_SUBLANES, S_pad)
     )
+
+    if S_pad <= FUSED_BWD_MAX_S:
+        rowf = pl.BlockSpec((1, S_pad, D), lambda b: (b, 0, 0))
+        row1f = pl.BlockSpec((1, LSE_SUBLANES, S_pad), lambda b: (b, 0, 0))
+        dq32, dk, dv = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_fused_kernel, block_q=block_q, block_k=block_k,
+                seq_len=S, causal=causal, scale=scale,
+            ),
+            grid=(B * H,),
+            in_specs=[rowf, rowf, rowf, rowf, row1f, row1f],
+            out_specs=[rowf, rowf, rowf],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, S_pad, D), jnp.float32),
+                jax.ShapeDtypeStruct((B * H, S_pad, D), k.dtype),
+                jax.ShapeDtypeStruct((B * H, S_pad, D), v.dtype),
+            ],
+            interpret=interpret,
+        )(qf, kf, vf, gf, lse, dvec)
+        unflat = lambda x: x.reshape(B, H, S_pad, D).transpose(0, 2, 1, 3)  # noqa: E731
+        dq, dk, dv = unflat(dq32.astype(q.dtype)), unflat(dk), unflat(dv)
+        if S_pad != S:
+            dq, dk, dv = dq[:, :S], dk[:, :S], dv[:, :S]
+        return dq, dk, dv
 
     row = pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0))
     row1 = pl.BlockSpec((1, LSE_SUBLANES, S_pad), lambda b, i: (b, 0, 0))

@@ -102,3 +102,48 @@ class TestShardedTrainStep:
         mesh = make_mesh(tp=8)
         with pytest.raises(ValueError, match="indivisible"):
             check_divisibility(CFG, mesh)  # tiny cfg: 2 kv heads % 8 != 0
+
+
+class TestChunkedCrossEntropy:
+    """loss_fn computes CE in CE_CHUNK sequence chunks when the length
+    divides (the naive loss materializes [B,S,V] f32 logits AND their
+    cotangent — the allocation that kept B=32 off a 16 GB chip)."""
+
+    def test_chunked_matches_naive_loss_and_grads(self):
+        import numpy as np
+
+        from nanotpu.models import llama
+
+        cfg = llama.LlamaConfig(
+            vocab_size=128, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+            ffn_dim=64, max_seq_len=1024, dtype="float32",
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        # S = 512 = 2 * CE_CHUNK -> chunked path
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 513), 0, 128)
+
+        def naive(p):
+            logits = llama.forward(p, tokens[:, :-1], cfg)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(
+                logp, tokens[:, 1:][..., None], axis=-1
+            )[..., 0].mean()
+
+        l1, g1 = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, tokens, cfg)
+        )(params)
+        l2, g2 = jax.value_and_grad(naive)(params)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        diffs = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), g1, g2
+        )
+        assert max(jax.tree_util.tree_leaves(diffs)) < 1e-5
+
+    def test_indivisible_length_uses_naive_path(self):
+        from nanotpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 40), 0, 256)
+        loss = llama.loss_fn(params, tokens, cfg)  # S=39, no chunking
+        assert jnp.isfinite(loss)

@@ -103,3 +103,36 @@ class TestFlagValidation:
 
     def test_seq_too_short_for_sp(self):
         self._run("--sp", "8", "--seq", "5")
+
+
+def test_cli_fused_steps_runs_and_steps_count(tmp_path):
+    """--fuse-steps K trains K optimizer steps per device program; the
+    checkpointed step counter must reflect ALL steps, not calls."""
+    from nanotpu.parallel.train import main, restore_checkpoint, init_train_state, make_optimizer
+    import jax
+
+    ckpt = tmp_path / "ck"
+    rc = main([
+        "--model", "llama", "--preset", "tiny", "--steps", "8",
+        "--fuse-steps", "4", "--batch", "2", "--seq", "32",
+        "--checkpoint-dir", str(ckpt), "--save-every", "8",
+    ])
+    assert rc == 0
+    from nanotpu.models.llama import LlamaConfig
+    from nanotpu.parallel.train import _PRESETS
+
+    cfg = LlamaConfig(**_PRESETS[("llama", "tiny")])
+    tmpl = init_train_state(jax.random.PRNGKey(0), cfg, make_optimizer())
+    restored = restore_checkpoint(str(ckpt), tmpl)
+    assert restored is not None
+    assert int(jax.device_get(restored.step)) == 8
+
+
+def test_cli_fuse_steps_must_divide(capsys):
+    from nanotpu.parallel.train import main
+
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit):
+        main(["--model", "llama", "--preset", "tiny", "--steps", "10",
+              "--fuse-steps", "4"])
