@@ -1,0 +1,264 @@
+"""Draft-model distillation for speculative decoding.
+
+VERDICT r2 #2: the speculative engine was exactness-proven but had never
+produced a real speedup — acceptance was 0.94 with draft==target (upper
+bound) and 0.0 with an independent random draft. This module closes the
+gap with a draft the environment CAN build: no external data, the draft
+distills from the target's own sampled outputs.
+
+Design (TPU-first, and what makes a random-init target learnable):
+
+* The draft **shares the target's embedding and lm_head, frozen** — the
+  two models then live in the same representation/vocab geometry, so the
+  2 trainable layers only have to approximate the target's 8-layer
+  mixing, not rediscover a vocabulary embedding. This is what lifts
+  acceptance from ~0 (independent random draft) to well above the
+  break-even point.
+* Training data is sampled FROM the target at the serving temperature
+  (contexts match the speculative decoder's on-policy distribution), and
+  the loss is soft-label cross entropy against the target's full-vocab
+  distribution (the KL term that acceptance E[min(p, q)] responds to).
+* Everything runs as three jitted programs (sample / teacher labels /
+  draft step) with params passed as arguments, chained on device; the
+  loss is fetched lagged, so the loop is tunnel-friendly.
+
+CLI: ``python -m nanotpu.models.distill --steps 300`` distills, measures
+acceptance and end-to-end tokens/s vs plain sampled decoding at the bench
+settings (T=0.8, K=4), and prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from nanotpu.models.llama import LlamaConfig, hidden_states, init_params
+
+
+def draft_config(cfg: LlamaConfig, n_layers: int = 2,
+                 ffn_dim: int | None = None) -> LlamaConfig:
+    """A shallow draft with the TARGET's width/vocab (tied embed/head need
+    the same dim) and a slimmer FFN."""
+    import dataclasses
+
+    return dataclasses.replace(
+        cfg, n_layers=n_layers, ffn_dim=ffn_dim or cfg.ffn_dim // 2,
+        attn_impl="dense",  # K=1-token decode steps; flash buys nothing
+    )
+
+
+def init_draft(rng: jax.Array, target_params: dict, cfg: LlamaConfig,
+               dcfg: LlamaConfig, truncate: bool = True) -> dict:
+    """Draft params with the target's embed/lm_head tied in (frozen by
+    :func:`make_distill_step`'s gradient mask, shared in HBM).
+
+    ``truncate`` additionally initializes the draft's layers FROM the
+    target's first layers (requires matching layer shapes, i.e.
+    ``draft_config(cfg, ffn_dim=cfg.ffn_dim)``): the draft starts as the
+    truncated teacher, whose hidden states already live where the frozen
+    head expects them — distillation then only has to compress the
+    REMAINING layers' effect instead of learning from noise."""
+    draft = init_params(rng, dcfg)
+    draft["embed"] = target_params["embed"]
+    draft["lm_head"] = target_params["lm_head"]
+    draft["final_norm"] = target_params["final_norm"]
+    if truncate and dcfg.ffn_dim == cfg.ffn_dim:
+        for i in range(dcfg.n_layers):
+            draft["layers"][i] = target_params["layers"][i]
+    return draft
+
+
+def _trainable_mask(draft_params: dict) -> dict:
+    """True for leaves the distillation updates (the draft's own layers);
+    the tied embed/lm_head/final_norm stay frozen."""
+    return {
+        "embed": False,
+        "layers": jax.tree_util.tree_map(lambda _: True,
+                                         draft_params["layers"]),
+        "final_norm": False,
+        "lm_head": False,
+    }
+
+
+def make_distill_step(dcfg: LlamaConfig, lr: float = 3e-4,
+                      label_temperature: float = 1.0):
+    """Returns (init_opt_state, jitted step):
+    step(draft_params, opt_state, tokens[B,S+1], teacher_logits[B,S,V])
+    -> (draft_params, opt_state, loss). Soft-label CE with BOTH sides at
+    ``label_temperature`` (match at the serving temperature — acceptance
+    E[min(p_T, q_T)] is decided on the warped distributions), frozen tied
+    leaves."""
+    import optax
+
+    # masked: no gradients computed THROUGH the frozen leaves (stop_gradient
+    # in the loss skips the vocab-sized embed/head backward matmuls) and no
+    # Adam moments allocated for them (~0.5 GB at the CLI config)
+    base = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.0)
+    opt = optax.masked(base, _trainable_mask)
+    inv_t = 1.0 / label_temperature
+
+    def soft_ce(draft_params, tokens, teacher_logits):
+        from nanotpu.models.llama import linear
+
+        frozen = {
+            name: jax.lax.stop_gradient(draft_params[name])
+            for name in ("embed", "lm_head", "final_norm")
+        }
+        p_eff = {**draft_params, **frozen}
+        h = hidden_states(p_eff, tokens[:, :-1], dcfg)
+        logits = linear(h, p_eff["lm_head"]).astype(jnp.float32)
+        logq = jax.nn.log_softmax(logits * inv_t, axis=-1)
+        p = jax.nn.softmax(teacher_logits * inv_t, axis=-1)
+        return -(p * logq).sum(-1).mean()
+
+    @jax.jit
+    def step(draft_params, opt_state, tokens, teacher_logits):
+        loss, grads = jax.value_and_grad(soft_ce)(
+            draft_params, tokens, teacher_logits
+        )
+        updates, opt_state = opt.update(grads, opt_state, draft_params)
+        new_params = optax.apply_updates(draft_params, updates)
+        # keep the frozen leaves EXACTLY the target's (masked updates are
+        # zeros there, but identity through apply_updates is cheaper to
+        # guarantee by construction)
+        for name in ("embed", "lm_head", "final_norm"):
+            new_params[name] = draft_params[name]
+        return new_params, opt_state, loss
+
+    def init_opt(draft_params):
+        return opt.init(draft_params)
+
+    return init_opt, step
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    import logging
+    import time
+
+    from nanotpu.models.generate import generate
+    from nanotpu.models.llama import forward
+    from nanotpu.models.speculative import speculative_generate
+
+    parser = argparse.ArgumentParser("nanotpu-distill")
+    parser.add_argument("--steps", type=int, default=300)
+    parser.add_argument("--batch", type=int, default=16)
+    parser.add_argument("--seq", type=int, default=256)
+    parser.add_argument("--lr", type=float, default=3e-4)
+    parser.add_argument("--temperature", type=float, default=0.8)
+    parser.add_argument("--draft-k", type=int, default=4)
+    parser.add_argument("--eval-new-tokens", type=int, default=256)
+    parser.add_argument("--eval-batch", type=int, default=8)
+    parser.add_argument("--fresh-sample-every", type=int, default=4,
+                        help="sample a new on-policy batch every N steps "
+                             "(sampling is ~10x the cost of a draft step)")
+    parser.add_argument("--full-ffn", action="store_true",
+                        help="draft keeps the target's ffn_dim so its "
+                             "layers can initialize from the target's "
+                             "first layers (truncated-teacher init)")
+    parser.add_argument("--int8-draft", action="store_true",
+                        help="quantize the draft weight-only int8 for the "
+                             "EVAL (draft steps are bandwidth-bound; the "
+                             "tied head dominates the draft's bytes, so "
+                             "int8 nearly halves the cost ratio c)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    log = logging.getLogger("nanotpu.distill")
+
+    cfg = LlamaConfig(
+        vocab_size=32_768, dim=1024, n_layers=8, n_heads=16, n_kv_heads=4,
+        ffn_dim=4096, max_seq_len=2048, dtype="bfloat16",
+    )
+    dcfg = draft_config(
+        cfg, ffn_dim=cfg.ffn_dim if args.full_ffn else None
+    )
+    key = jax.random.PRNGKey(0)
+    params = init_params(key, cfg)
+    draft = init_draft(jax.random.PRNGKey(1), params, cfg, dcfg)
+    init_opt, dstep = make_distill_step(
+        dcfg, args.lr, label_temperature=args.temperature
+    )
+    opt_state = init_opt(draft)
+
+    B, S, T = args.batch, args.seq, args.temperature
+    sample = jax.jit(functools.partial(
+        generate, cfg=cfg, max_new_tokens=S, temperature=T,
+        max_len=S + 1,
+    ))
+    teacher = jax.jit(lambda p, t: forward(p, t, cfg))
+
+    t0 = time.time()
+    tokens = None
+    loss = None
+    for i in range(args.steps):
+        if i % args.fresh_sample_every == 0:
+            key, k1, k2 = jax.random.split(key, 3)
+            prompts = jax.random.randint(k1, (B, 1), 0, cfg.vocab_size)
+            sampled = sample(params, prompts, rng=k2)
+            tokens = jnp.concatenate([prompts, sampled], axis=1)  # [B, S+1]
+            labels = teacher(params, tokens[:, :-1])
+        draft, opt_state, loss = dstep(draft, opt_state, tokens, labels)
+        if i % 25 == 0:
+            log.info("distill step %d soft-CE %.4f", i, float(loss))
+    log.info("distilled %d steps in %.0fs (final soft-CE %s)",
+             args.steps, time.time() - t0,
+             f"{float(loss):.4f}" if loss is not None else "n/a")
+
+    # -- evaluation at the bench settings ---------------------------------
+    eval_draft = draft
+    if args.int8_draft:
+        from nanotpu.models.quant import quantize_params
+
+        eval_draft = quantize_params(draft)
+    EB, N, K = args.eval_batch, args.eval_new_tokens, args.draft_k
+    key, kp, k1, k2 = jax.random.split(key, 4)
+    prompt = jax.random.randint(kp, (EB, 8), 0, cfg.vocab_size)
+
+    spec = jax.jit(functools.partial(
+        speculative_generate, cfg=cfg, draft_cfg=dcfg, max_new_tokens=N,
+        draft_tokens=K, temperature=T, return_stats=True,
+    ))
+    plain = jax.jit(functools.partial(
+        generate, cfg=cfg, max_new_tokens=N, temperature=T,
+    ))
+
+    def run_timed(fn, *a, **kw):
+        out = fn(*a, **kw)  # compile + warm
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        reps = 3
+        for r in range(reps):
+            key_r = jax.random.PRNGKey(100 + r)
+            out = fn(*a, **{**kw, "rng": key_r})
+        # force a REAL fetch (tunnel-safe sync)
+        leaves = jax.tree_util.tree_leaves(out)
+        float(jnp.sum(leaves[0]))
+        return out, (time.perf_counter() - t0) / reps
+
+    (spec_out, stats), spec_dt = run_timed(
+        spec, params, eval_draft, prompt, rng=k1
+    )
+    plain_out, plain_dt = run_timed(plain, params, prompt, rng=k2)
+    acc = float(stats["accepted"]) / max(float(stats["drafted"]), 1.0)
+    spec_tps = EB * N / spec_dt
+    plain_tps = EB * N / plain_dt
+    result = {
+        "acceptance": round(acc, 4),
+        "cycles": int(stats["cycles"]),
+        "speculative_tok_s": round(spec_tps, 1),
+        "plain_tok_s": round(plain_tps, 1),
+        "speedup": round(spec_tps / plain_tps, 3),
+        "distill_steps": args.steps,
+        "temperature": T,
+        "K": K,
+        "eval_batch": EB,
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
