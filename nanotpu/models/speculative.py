@@ -143,11 +143,28 @@ def speculative_generate(
     def cond(carry):
         return carry[3] < N
 
+    def _extend_draft_cache_if_full_accept(d_cache, drafts, a, n):
+        """When every proposal was accepted the next cycle starts from the
+        bonus token, whose draft context includes d_K — a token the K-step
+        scan never fed. Materialize d_K's cache entry only in that case
+        (lax.cond): paying a K+1-th draft step EVERY cycle costs 1/(K+1)
+        of the draft budget for an entry most cycles roll back. Also
+        skipped when this was the FINAL cycle (``n`` is the POST-advance
+        emit count, the loop's continuation variable): the loop is about
+        to exit and the entry would never be read."""
+        return lax.cond(
+            (a == jnp.int32(K)) & (n < N),
+            lambda dc: _run(draft_params, drafts[:, -1:], draft_cfg, dc)[1],
+            lambda dc: dc,
+            d_cache,
+        )
+
     def greedy_body(carry):
         t_cache, d_cache, out, n, cur, _key, acc, cyc = carry
+        d_base = d_cache.length
+        t_base = t_cache.length
 
-        # -- draft K proposals (K+1 steps: the extra step feeds d_K so its
-        #    cache entry exists if every proposal is accepted) -------------
+        # -- draft K proposals --------------------------------------------
         def draft_scan(carry, _):
             dc, tok = carry
             logits, dc = _run(draft_params, tok[:, None], draft_cfg, dc)
@@ -155,19 +172,19 @@ def speculative_generate(
             return (dc, nxt), nxt
 
         (d_cache, _), drafts = lax.scan(
-            draft_scan, (d_cache, cur), None, length=K + 1
+            draft_scan, (d_cache, cur), None, length=K
         )
-        drafts = jnp.moveaxis(drafts, 0, 1)  # [B, K+1]; d1..dK, dK+1 unused
+        drafts = jnp.moveaxis(drafts, 0, 1)  # [B, K]: d1..dK
 
         # -- target verifies cur + d1..dK in one forward -------------------
-        verify_tokens = jnp.concatenate([cur[:, None], drafts[:, :K]], axis=1)
+        verify_tokens = jnp.concatenate([cur[:, None], drafts], axis=1)
         v_logits, t_cache = _run(
             params, verify_tokens, cfg, t_cache, return_all=True
         )  # [B, K+1, V]
         greedy = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)  # [B, K+1]
 
         # a = leading proposals that equal the target's own choices
-        matches = drafts[:, :K] == greedy[:, :K]  # [B, K]
+        matches = drafts == greedy[:, :K]  # [B, K]
         a_rows = jnp.cumprod(matches.astype(jnp.int32), axis=1).sum(axis=1)
         a = jnp.min(a_rows)  # shared advance (min over rows)
 
@@ -178,14 +195,17 @@ def speculative_generate(
 
         cur = lax.dynamic_index_in_dim(greedy, a, axis=1, keepdims=False)
         n = n + a + 1
+        d_cache = _extend_draft_cache_if_full_accept(d_cache, drafts, a, n)
         # rollback: keep only the accepted prefix; stale entries beyond are
         # overwritten by the next cycle's writes at `length`
-        t_cache = t_cache._replace(length=t_cache.length - (K + 1) + a + 1)
-        d_cache = d_cache._replace(length=d_cache.length - (K + 1) + a + 1)
+        t_cache = t_cache._replace(length=t_base + a + 1)
+        d_cache = d_cache._replace(length=d_base + a + 1)
         return t_cache, d_cache, out, n, cur, _key, acc + a, cyc + 1
 
     def sampled_body(carry):
         t_cache, d_cache, out, n, cur, key, acc, cyc = carry
+        d_base = d_cache.length
+        t_base = t_cache.length
         key, k_draft, k_accept, k_resample, k_bonus = jax.random.split(key, 5)
 
         # -- draft K proposals, keeping each step's warped distribution ----
@@ -198,22 +218,22 @@ def speculative_generate(
             ).astype(jnp.int32)
             return (dc, nxt), (nxt, q)
 
-        draft_keys = jax.random.split(k_draft, K + 1)
+        draft_keys = jax.random.split(k_draft, K)
         (d_cache, _), (drafts, q_all) = lax.scan(
             draft_scan, (d_cache, cur), draft_keys
         )
-        drafts = jnp.moveaxis(drafts, 0, 1)  # [B, K+1]
-        q_probs = jnp.moveaxis(q_all, 0, 1)[:, :K]  # [B, K, V]
+        drafts = jnp.moveaxis(drafts, 0, 1)  # [B, K]
+        q_probs = jnp.moveaxis(q_all, 0, 1)  # [B, K, V]
 
         # -- target verifies cur + d1..dK in one forward -------------------
-        verify_tokens = jnp.concatenate([cur[:, None], drafts[:, :K]], axis=1)
+        verify_tokens = jnp.concatenate([cur[:, None], drafts], axis=1)
         v_logits, t_cache = _run(
             params, verify_tokens, cfg, t_cache, return_all=True
         )  # [B, K+1, V]
         p_all = _warp(v_logits, temperature, top_k, top_p)  # [B, K+1, V]
 
         accepted, resampled = rejection_step(
-            p_all[:, :K], q_probs, drafts[:, :K], k_accept, k_resample
+            p_all[:, :K], q_probs, drafts, k_accept, k_resample
         )
         a_rows = jnp.cumprod(accepted.astype(jnp.int32), axis=1).sum(axis=1)
         a = jnp.min(a_rows)  # shared advance (min over rows)
@@ -226,7 +246,10 @@ def speculative_generate(
 
         # token at emit position a: the row accepted further -> its draft;
         # rejected exactly at a -> the residual resample; a == K -> bonus
-        draft_a = lax.dynamic_index_in_dim(drafts, a, 1, keepdims=False)
+        draft_a = lax.dynamic_index_in_dim(
+            jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
+            a, 1, keepdims=False,
+        )
         res_a = lax.dynamic_index_in_dim(
             jnp.concatenate([resampled, resampled[:, -1:]], axis=1),
             a, 1, keepdims=False,
@@ -236,13 +259,14 @@ def speculative_generate(
         )
         # positions < a are all-accepted drafts; positions beyond a are
         # overwritten by later cycles before they can be read
-        emit = jnp.concatenate([drafts[:, :K], drafts[:, -1:]], axis=1)
+        emit = jnp.concatenate([drafts, drafts[:, -1:]], axis=1)
         emit = lax.dynamic_update_slice(emit, tok_a[:, None], (0, a))
         out = lax.dynamic_update_slice(out, emit, (0, n))
 
         n = n + a + 1
-        t_cache = t_cache._replace(length=t_cache.length - (K + 1) + a + 1)
-        d_cache = d_cache._replace(length=d_cache.length - (K + 1) + a + 1)
+        d_cache = _extend_draft_cache_if_full_accept(d_cache, drafts, a, n)
+        t_cache = t_cache._replace(length=t_base + a + 1)
+        d_cache = d_cache._replace(length=d_base + a + 1)
         return t_cache, d_cache, out, n, tok_a, key, acc + a, cyc + 1
 
     _, _, out, _, _, _, acc, cyc = lax.while_loop(

@@ -186,3 +186,23 @@ class TestRejectionSampling:
             temperature=0.7, top_k=1, rng=jax.random.PRNGKey(9),
         )
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_full_accept_advances_k_plus_1_per_cycle(models):
+    """draft == target accepts everything: each cycle must emit K+1 tokens
+    (K drafts + bonus), which exercises the lax.cond that materializes the
+    K-th draft token's cache entry ONLY on full-accept cycles — a wrong or
+    missing entry would desync the draft on the next cycle and inflate the
+    cycle count."""
+    target, _ = models
+    prompt = jnp.asarray([[5, 3, 1]], jnp.int32)
+    _, stats = speculative_generate(
+        target, target, prompt, CFG, CFG, max_new_tokens=40, draft_tokens=4,
+        return_stats=True,
+    )
+    assert int(stats["cycles"]) == 8  # ceil(40 / (K+1))
+    _, stats2 = speculative_generate(
+        target, target, prompt, CFG, CFG, max_new_tokens=40, draft_tokens=4,
+        temperature=0.7, return_stats=True, rng=jax.random.PRNGKey(3),
+    )
+    assert float(stats2["accepted"]) / float(stats2["drafted"]) > 0.8
