@@ -137,7 +137,11 @@ def main(argv=None) -> int:
     import argparse
     import json
     import logging
+    import os
     import time
+
+    import optax
+    import orbax.checkpoint as ocp
 
     from nanotpu.models.generate import generate
     from nanotpu.models.llama import forward
@@ -159,6 +163,18 @@ def main(argv=None) -> int:
                         help="draft keeps the target's ffn_dim so its "
                              "layers can initialize from the target's "
                              "first layers (truncated-teacher init)")
+    parser.add_argument("--lr-decay", action="store_true",
+                        help="cosine-decay the learning rate to 10%% over "
+                             "the run (the flat schedule oscillates on "
+                             "long distillations)")
+    parser.add_argument("--eval-ks", default="",
+                        help="comma-separated speculation depths to eval "
+                             "(default: just --draft-k)")
+    parser.add_argument("--save-draft", default="",
+                        help="orbax dir to save the distilled draft")
+    parser.add_argument("--load-draft", default="",
+                        help="orbax dir to load a draft instead of "
+                             "distilling (--steps then typically 0)")
     parser.add_argument("--int8-draft", action="store_true",
                         help="quantize the draft weight-only int8 for the "
                              "EVAL (draft steps are bandwidth-bound; the "
@@ -178,10 +194,24 @@ def main(argv=None) -> int:
     key = jax.random.PRNGKey(0)
     params = init_params(key, cfg)
     draft = init_draft(jax.random.PRNGKey(1), params, cfg, dcfg)
+    lr = args.lr
+    if args.lr_decay and args.steps > 0:
+        lr = optax.cosine_decay_schedule(args.lr, args.steps, alpha=0.1)
     init_opt, dstep = make_distill_step(
-        dcfg, args.lr, label_temperature=args.temperature
+        dcfg, lr, label_temperature=args.temperature
     )
     opt_state = init_opt(draft)
+    if args.load_draft:
+        if args.steps:
+            parser.error(
+                "--load-draft evaluates a saved draft; pass --steps 0 "
+                "(further training would silently mutate the checkpoint's "
+                "weights under a fresh optimizer state)"
+            )
+        target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, draft)
+        with ocp.StandardCheckpointer() as ckptr:
+            draft = ckptr.restore(os.path.abspath(args.load_draft), target)
+        log.info("loaded draft from %s", args.load_draft)
 
     B, S, T = args.batch, args.seq, args.temperature
     sample = jax.jit(functools.partial(
@@ -206,6 +236,10 @@ def main(argv=None) -> int:
     log.info("distilled %d steps in %.0fs (final soft-CE %s)",
              args.steps, time.time() - t0,
              f"{float(loss):.4f}" if loss is not None else "n/a")
+    if args.save_draft:
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.abspath(args.save_draft), draft, force=True)
+        log.info("saved draft to %s", args.save_draft)
 
     # -- evaluation at the bench settings ---------------------------------
     eval_draft = draft
@@ -213,14 +247,12 @@ def main(argv=None) -> int:
         from nanotpu.models.quant import quantize_params
 
         eval_draft = quantize_params(draft)
-    EB, N, K = args.eval_batch, args.eval_new_tokens, args.draft_k
+    EB, N = args.eval_batch, args.eval_new_tokens
+    ks = ([int(x) for x in args.eval_ks.split(",") if x]
+          or [args.draft_k])
     key, kp, k1, k2 = jax.random.split(key, 4)
     prompt = jax.random.randint(kp, (EB, 8), 0, cfg.vocab_size)
 
-    spec = jax.jit(functools.partial(
-        speculative_generate, cfg=cfg, draft_cfg=dcfg, max_new_tokens=N,
-        draft_tokens=K, temperature=T, return_stats=True,
-    ))
     plain = jax.jit(functools.partial(
         generate, cfg=cfg, max_new_tokens=N, temperature=T,
     ))
@@ -238,24 +270,31 @@ def main(argv=None) -> int:
         float(jnp.sum(leaves[0]))
         return out, (time.perf_counter() - t0) / reps
 
-    (spec_out, stats), spec_dt = run_timed(
-        spec, params, eval_draft, prompt, rng=k1
-    )
     plain_out, plain_dt = run_timed(plain, params, prompt, rng=k2)
-    acc = float(stats["accepted"]) / max(float(stats["drafted"]), 1.0)
-    spec_tps = EB * N / spec_dt
     plain_tps = EB * N / plain_dt
     result = {
-        "acceptance": round(acc, 4),
-        "cycles": int(stats["cycles"]),
-        "speculative_tok_s": round(spec_tps, 1),
         "plain_tok_s": round(plain_tps, 1),
-        "speedup": round(spec_tps / plain_tps, 3),
         "distill_steps": args.steps,
         "temperature": T,
-        "K": K,
         "eval_batch": EB,
+        "per_k": {},
     }
+    for K in ks:
+        spec = jax.jit(functools.partial(
+            speculative_generate, cfg=cfg, draft_cfg=dcfg, max_new_tokens=N,
+            draft_tokens=K, temperature=T, return_stats=True,
+        ))
+        (spec_out, stats), spec_dt = run_timed(
+            spec, params, eval_draft, prompt, rng=k1
+        )
+        acc = float(stats["accepted"]) / max(float(stats["drafted"]), 1.0)
+        spec_tps = EB * N / spec_dt
+        result["per_k"][K] = {
+            "acceptance": round(acc, 4),
+            "cycles": int(stats["cycles"]),
+            "speculative_tok_s": round(spec_tps, 1),
+            "speedup": round(spec_tps / plain_tps, 3),
+        }
     print(json.dumps(result))
     return 0
 
