@@ -171,30 +171,35 @@ def run_fanout(n_hosts: int = 256, n_pods: int = 256,
 
     gc.collect()
     gc.disable()
-    started = time.perf_counter()
-    for i, name, pod, args in prepared:
-        if i == 0:  # warmup pods above are scheduled but not timed
-            gc.collect()
-            started = time.perf_counter()
-        t0 = time.perf_counter()
-        filt = conn.post_raw("/scheduler/filter", args)
-        prio = conn.post_raw("/scheduler/priorities", args)
-        best = _scan_best(prio, _scan_feasible(filt))
-        if i % 32 == 0:
-            _check_scan(filt, prio, best)
-        result = conn.post(
-            "/scheduler/bind",
-            {"PodName": name, "PodNamespace": "default",
-             "PodUID": pod.uid, "Node": best},
-        )
-        assert result["Error"] == "", result
-        if i >= 0:
-            lats.append(time.perf_counter() - t0)
-    elapsed = time.perf_counter() - started
-    gc.enable()
+    try:
+        started = time.perf_counter()
+        for i, name, pod, args in prepared:
+            if i == 0:  # warmup pods above are scheduled but not timed
+                gc.collect()
+                started = time.perf_counter()
+            t0 = time.perf_counter()
+            filt = conn.post_raw("/scheduler/filter", args)
+            prio = conn.post_raw("/scheduler/priorities", args)
+            best = _scan_best(prio, _scan_feasible(filt))
+            if i % 32 == 0:
+                _check_scan(filt, prio, best)
+            result = conn.post(
+                "/scheduler/bind",
+                {"PodName": name, "PodNamespace": "default",
+                 "PodUID": pod.uid, "Node": best},
+            )
+            assert result["Error"] == "", result
+            if i >= 0:
+                lats.append(time.perf_counter() - t0)
+        elapsed = time.perf_counter() - started
+    finally:
+        # exception-safe: a failed assert/cross-check must not leave the
+        # collector disabled — nor a live server thread and socket — for
+        # whatever runs next in this process
+        gc.enable()
+        conn.close()
+        server.shutdown()
     gc.collect()
-    conn.close()
-    server.shutdown()
     p50 = statistics.median(lats)
     return {
         "fanout_hosts": n_hosts,

@@ -654,6 +654,16 @@ class Dealer:
             barrier = self._gang_barriers.get(key)
             if barrier is None:
                 barrier = self._gang_barriers[key] = GangBarrier(gang[1])
+            else:
+                # the threshold is the LARGEST size any member declares: a
+                # first arriver with a typoed smaller size must not leave
+                # the barrier undersized (it would open before the real
+                # gang is complete — a partial commit). Raising size only
+                # ever tightens the open condition, so no waiter needs a
+                # wakeup. Lock order: dealer lock -> barrier.cv, same as
+                # _invalidate_reservation.
+                with barrier.cv:
+                    barrier.size = max(barrier.size, gang[1])
             barrier.users += 1
         try:
             return self._park_and_commit(barrier, key, node_name, pod)

@@ -127,8 +127,10 @@ class GangBarrier:
 
     def __init__(self, size: int):
         self.cv = threading.Condition()
-        #: first-declared gang size — the barrier threshold. One member
-        #: with a typoed smaller size must not open the barrier early.
+        #: the barrier threshold — the LARGEST size any member has
+        #: declared (Dealer raises it under ``cv`` as members arrive).
+        #: One member with a typoed smaller size must not open the
+        #: barrier early, regardless of arrival order.
         self.size = size
         self.parked: set[str] = set()
         self.open = False

@@ -235,8 +235,11 @@ def main(argv=None) -> int:
     t0 = time.time()
     tokens = None
     loss = None
+    # clamp like --eval-pairs: 0 would ZeroDivisionError on the modulo and
+    # negatives would silently never resample after step 0
+    fresh_every = max(1, args.fresh_sample_every)
     for i in range(args.steps):
-        if i % args.fresh_sample_every == 0:
+        if i % fresh_every == 0:
             key, k1, k2 = jax.random.split(key, 3)
             prompts = jax.random.randint(k1, (B, 1), 0, cfg.vocab_size)
             sampled = sample(params, prompts, rng=k2)

@@ -39,18 +39,22 @@ import jax.numpy as jnp
 import os as _os
 
 
-def _env_block(name: str, default: int) -> int:
+def _env_block(name: str, default: int, min_value: int | None = 1) -> int:
+    """Int env override with a lower bound (block sizes need >= 1;
+    ``min_value=None`` accepts any int — thresholds clamp at the call
+    site so a negative keeps meaning 'disable', not 'use default')."""
     raw = _os.environ.get(name, "")
     try:
         value = int(raw) if raw else default
-        if value <= 0:
-            raise ValueError("block sizes must be positive")
+        if min_value is not None and value < min_value:
+            raise ValueError("below the minimum")
         return value
     except ValueError:  # a typo'd env var must not break unrelated imports
         import logging
 
         logging.getLogger("nanotpu.ops").warning(
-            "%s=%r is not a positive int; using default %d", name, raw, default
+            "%s=%r is not a valid int for this knob; using default %d",
+            name, raw, default,
         )
         return default
 
@@ -410,26 +414,12 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
     jax.lax.fori_loop(0, num_kb, kb_body, 0)
 
 
-def _env_threshold(name: str, default: int) -> int:
-    """Non-negative int env override; 0 disables the gated feature."""
-    raw = _os.environ.get(name, "")
-    try:
-        return int(raw) if raw else default
-    except ValueError:
-        import logging
-
-        logging.getLogger("nanotpu.ops").warning(
-            "%s=%r is not an int; using default %d", name, raw, default
-        )
-        return default
-
-
 #: Above this padded sequence length the fused backward's whole-sequence
 #: VMEM working set stops fitting comfortably; fall back to the two-pass
 #: kernels (ring attention owns the genuinely long-context regime anyway).
-#: NANOTPU_FLASH_FUSED_BWD_MAX_S=0 disables the fused path entirely.
+#: NANOTPU_FLASH_FUSED_BWD_MAX_S=0 (or negative) disables the fused path.
 FUSED_BWD_MAX_S = max(
-    _env_threshold("NANOTPU_FLASH_FUSED_BWD_MAX_S", 4096), 0
+    _env_block("NANOTPU_FLASH_FUSED_BWD_MAX_S", 4096, min_value=None), 0
 )
 
 

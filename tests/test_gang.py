@@ -320,6 +320,34 @@ class TestStrictGangBarrier:
         dealer.bind("v5p-host-2", repl)  # returns without parking
         assert dealer.gangs.bound_count("default/done") == 3
 
+    def test_typoed_smaller_first_size_does_not_open_early(self):
+        """ADVICE r3: the barrier threshold is the LARGEST declared size,
+        not the first arriver's. A first member with a typoed size=2 in a
+        real gang of 3 must not open the barrier at 2 parked members (a
+        partial commit)."""
+        import time
+
+        client, dealer = self._cluster(4)
+        typo = client.create_pod(strict_pod("t-0", "typo", 2, timeout=30))
+        good = [
+            client.create_pod(strict_pod(f"t-{i}", "typo", 3, timeout=30))
+            for i in (1, 2)
+        ]
+        threads, results = self._bind_async(
+            dealer, client,
+            [(typo, "v5p-host-0"), (good[0], "v5p-host-1")],
+        )
+        time.sleep(0.4)
+        # 2 members parked but one declared size 3: nothing may commit
+        assert results == {}, f"barrier opened undersized: {results}"
+        assert dealer.gangs.bound_count("default/typo") == 0
+        t3, r3 = self._bind_async(dealer, client, [(good[1], "v5p-host-2")])
+        for t in threads + t3:
+            t.join(10)
+        results.update(r3)
+        assert all(v == "ok" for v in results.values()), results
+        assert dealer.gangs.bound_count("default/typo") == 3
+
     def test_soft_gang_unaffected(self):
         """Without the strict annotation a lone gang member still binds
         immediately (the r1/r2 default semantics)."""
