@@ -1,0 +1,5 @@
+from nanotpu.data.synthetic import (  # noqa: F401
+    ideal_ce,
+    markov_batch,
+    markov_table,
+)

@@ -1,0 +1,101 @@
+"""Structured synthetic corpora, generated on device.
+
+The reference repo has no data loader at all (it schedules pods —
+SURVEY.md §2); the training stack here needs token streams, and until r3
+the trainer consumed uniform-random tokens. Uniform noise is the WORST
+case for anything that exploits predictability: a model trained on it
+learns nothing (its conditionals stay uniform), so a distilled draft has
+no structure to capture and speculative decoding cannot win (BASELINE.md
+r3: best 0.89x on a random-init target). This module provides the
+opposite regime — a corpus whose conditionals are sharply predictable —
+so "train the target until its conditionals are predictable, then
+distill" is a *measurable* experiment rather than a prediction.
+
+Design: a first-order Markov chain over the model's own vocabulary.
+Each token has ``n_succ`` fixed successor tokens (a ``[V, n_succ]``
+table drawn once from a seed) with fixed logits, e.g. ``[2, 1, 0, -1]``
+-> probabilities ``[0.64, 0.24, 0.09, 0.03]`` and a per-token entropy of
+~0.95 nats (:func:`ideal_ce` — the CE floor a trained model approaches).
+Bigram structure is deliberately chosen over anything cleverer (modular
+arithmetic, long-range templates): transformers learn token-successor
+statistics almost immediately, the embedding table alone can encode
+them, and — crucially for the speculative experiment — a 2-layer draft
+sharing the target's embed/head learns the SAME structure, which is the
+regime where production drafts reach the 0.7+ acceptance that makes
+speculation pay.
+
+TPU-native mechanics: the table uploads once (V x n_succ int32, ~512 KB
+at the flagship vocab); batch generation is one jitted ``lax.scan`` over
+sequence positions (gather + categorical per step — microseconds each),
+so the training loop ships only PRNG keys over the host<->device link,
+never token buffers. The table is passed as an ARGUMENT to the jitted
+sampler, not closed over (closure-captured arrays break the tunnel's
+remote compile — see BASELINE.md's measurement notes).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: successor logits: ~0.95 nats/token of irreducible entropy, most mass
+#: on one continuation — "templated text" sharpness, not degenerate
+DEFAULT_SUCC_LOGITS = (2.0, 1.0, 0.0, -1.0)
+
+
+def markov_table(
+    vocab_size: int,
+    n_succ: int = len(DEFAULT_SUCC_LOGITS),
+    seed: int = 0,
+) -> jax.Array:
+    """The corpus definition: ``[V, n_succ]`` int32 successor ids, drawn
+    once from ``seed`` (numpy — reproducible across hosts/backends, so
+    the trainer and the distill eval can rebuild the identical corpus
+    from the seed alone)."""
+    rng = np.random.default_rng(seed)
+    table = rng.integers(
+        0, vocab_size, size=(vocab_size, n_succ), dtype=np.int32
+    )
+    return jnp.asarray(table)
+
+
+def ideal_ce(succ_logits=DEFAULT_SUCC_LOGITS) -> float:
+    """Per-token entropy of the chain in nats — the cross-entropy floor a
+    perfectly trained model converges to (vs ln(V) ~= 10.4 for uniform
+    noise at the flagship vocab)."""
+    z = np.asarray(succ_logits, np.float64)
+    p = np.exp(z - z.max())
+    p /= p.sum()
+    return float(-(p * np.log(p)).sum())
+
+
+def markov_batch(
+    key: jax.Array,
+    table: jax.Array,
+    shape: tuple[int, ...],
+    succ_logits=DEFAULT_SUCC_LOGITS,
+) -> jax.Array:
+    """Sample token sequences of ``shape = (..., S)`` from the chain, all
+    on device. Jit-friendly (static shape, one scan); pass ``table`` as a
+    jit argument. Sequence starts are uniform-random tokens (the one
+    unpredictable position per row)."""
+    *lead, S = shape
+    B = math.prod(lead) if lead else 1
+    logits = jnp.asarray(succ_logits, jnp.float32)
+    k_start, k_steps = jax.random.split(key)
+    state = jax.random.randint(k_start, (B,), 0, table.shape[0])
+
+    def step(state, k):
+        choice = jax.random.categorical(
+            k, jnp.broadcast_to(logits, (B, logits.shape[0])), axis=-1
+        )
+        nxt = table[state, choice]
+        return nxt, nxt
+
+    _, rest = jax.lax.scan(step, state, jax.random.split(k_steps, S - 1))
+    tokens = jnp.concatenate([state[:, None], jnp.moveaxis(rest, 0, 1)],
+                             axis=1)
+    return tokens.reshape(*lead, S) if lead else tokens[0]

@@ -61,6 +61,9 @@ class BatchScorer:
         # (demand hash, state_rev, gang sig) -> (feasible, scores): Filter
         # and the immediately following Prioritize share one native call
         self._memo: tuple | None = None
+        #: (names_key, qnames blob/off, prio blob/off, fail blob/off,
+        #: out buffer) — pre-baked JSON fragments for the native renderers
+        self._renderer: tuple | None = None
         # gang sig -> encoded ctypes arrays (a gang's member set only
         # changes when one of its pods binds; re-encoding per verb wastes
         # ~0.1ms at 256 hosts)
@@ -138,6 +141,37 @@ class BatchScorer:
             n_slices, c_cells, c_off,
         )
 
+    def _run_locked(self, demand, prefer_used: bool, member_slices):
+        """Native call under self._lock; returns the memoized
+        (feasible ctypes u8, score ctypes i32) buffers — valid only while
+        the lock is held OR until the next state change (the memo keeps
+        them alive; a fresh call allocates fresh buffers)."""
+        self._refresh()
+        gang_sig = tuple(member_slices) if member_slices else None
+        key = (demand.hash(), prefer_used, self.state_rev, gang_sig)
+        if self._memo is not None and self._memo[0] == key:
+            return self._memo[1], self._memo[2]
+        gang = None
+        if member_slices:
+            if gang_sig in self._gang_cache:
+                gang = self._gang_cache[gang_sig]
+            else:
+                gang = self._gang_arrays(member_slices)
+                self._gang_cache[gang_sig] = gang
+                while len(self._gang_cache) > 64:
+                    self._gang_cache.pop(next(iter(self._gang_cache)))
+        feas, score = native.score_batch(
+            self.dims, len(self.infos), self.free, self.total, self.load,
+            list(demand.percents), prefer_used, types.PERCENT_PER_CHIP,
+            gang,
+            hbm_flat=self.hbm,
+            hbm_demand=[
+                demand.hbm_of(i) for i in range(len(demand.percents))
+            ],
+        )
+        self._memo = (key, feas, score)
+        return feas, score
+
     def run(
         self,
         demand,
@@ -146,30 +180,88 @@ class BatchScorer:
     ) -> tuple[list[bool], list[int]]:
         """(feasible per node, final score per node) in candidate order."""
         with self._lock:
-            self._refresh()
-            gang_sig = tuple(member_slices) if member_slices else None
-            key = (demand.hash(), prefer_used, self.state_rev, gang_sig)
-            if self._memo is not None and self._memo[0] == key:
-                return self._memo[1], self._memo[2]
-            gang = None
-            if member_slices:
-                if gang_sig in self._gang_cache:
-                    gang = self._gang_cache[gang_sig]
-                else:
-                    gang = self._gang_arrays(member_slices)
-                    self._gang_cache[gang_sig] = gang
-                    while len(self._gang_cache) > 64:
-                        self._gang_cache.pop(next(iter(self._gang_cache)))
-            feas, score = native.score_batch(
-                self.dims, len(self.infos), self.free, self.total, self.load,
-                list(demand.percents), prefer_used, types.PERCENT_PER_CHIP,
-                gang,
-                hbm_flat=self.hbm,
-                hbm_demand=[
-                    demand.hbm_of(i) for i in range(len(demand.percents))
-                ],
-            )
+            feas, score = self._run_locked(demand, prefer_used, member_slices)
             n = len(self.infos)
-            out = [bool(feas[i]) for i in range(n)], list(score[:n])
-            self._memo = (key, out[0], out[1])
-            return out
+            return [bool(feas[i]) for i in range(n)], list(score[:n])
+
+    # -- fused score+render (the Filter/Prioritize fan-out fast path) ------
+
+    def ensure_renderer(self, names_key: tuple[str, ...]) -> bool:
+        """Build the pre-baked JSON fragment blobs for this candidate
+        order once (names repeat every scheduling cycle). Returns False
+        when the native renderer is unavailable."""
+        if self._renderer is not None:
+            return self._renderer[0] == names_key or self._build_renderer(
+                names_key
+            )
+        return self._build_renderer(names_key)
+
+    def _build_renderer(self, names_key: tuple[str, ...]) -> bool:
+        if not native.available():
+            return False
+        n = len(names_key)
+        if n != len(self.infos):
+            return False
+        import json as _json
+
+        qnames = [_json.dumps(nm).encode() for nm in names_key]
+        prio = [b'{"Host":%s,"Score":' % q for q in qnames]
+        fail = [
+            b'%s:"insufficient TPU capacity for demand"' % q for q in qnames
+        ]
+
+        def blob(parts):
+            off = (ctypes.c_int32 * (n + 1))()
+            total = 0
+            for i, p in enumerate(parts):
+                off[i] = total
+                total += len(p)
+            off[n] = total
+            return b"".join(parts), off
+
+        q_blob, q_off = blob(qnames)
+        p_blob, p_off = blob(prio)
+        f_blob, f_off = blob(fail)
+        # output capacity: every candidate in whichever list is larger,
+        # plus digits/braces slack per entry and fixed wrapper text
+        cap = max(len(p_blob), len(q_blob) + len(f_blob)) + 16 * n + 64
+        out_buf = ctypes.create_string_buffer(cap)
+        self._renderer = (
+            names_key, q_blob, q_off, p_blob, p_off, f_blob, f_off, out_buf
+        )
+        return True
+
+    def priorities_payload(
+        self, demand, prefer_used: bool, member_slices=None
+    ) -> bytes | None:
+        """The full HostPriorityList response body, scored and rendered in
+        native code. None -> caller uses the list-based path."""
+        r = self._renderer
+        if r is None:
+            return None
+        with self._lock:
+            _, score = self._run_locked(demand, prefer_used, member_slices)
+            try:
+                return native.render_priorities(
+                    r[3], r[4], score, len(self.infos), r[7]
+                )
+            except native.NativeUnavailable:
+                return None
+
+    def filter_payload(
+        self, demand, prefer_used: bool, member_slices=None
+    ) -> bytes | None:
+        """The full ExtenderFilterResult response body (candidates only —
+        the caller handles non-pool nodes), scored and rendered in native
+        code. None -> caller uses the list-based path."""
+        r = self._renderer
+        if r is None:
+            return None
+        with self._lock:
+            feas, _ = self._run_locked(demand, prefer_used, member_slices)
+            try:
+                return native.render_filter(
+                    r[1], r[2], r[5], r[6], feas, len(self.infos), b"", r[7]
+                )
+            except native.NativeUnavailable:
+                return None

@@ -426,6 +426,52 @@ class Dealer:
                 self._batch_cache.pop(next(iter(self._batch_cache)))
         return scorer, names_key, non_tpu, prefer
 
+    # -- fused verb fast paths ---------------------------------------------
+    #
+    # Filter/Prioritize at large fan-out: one native call scores every
+    # candidate AND renders the full response JSON from pre-baked per-name
+    # fragments (native/allocator.cc nanotpu_render_*). Only the uniform
+    # all-known-candidates case qualifies; anything else returns None and
+    # the verb takes the assume()/score() path. Result parity with that
+    # path is pinned by tests/test_http_extender.py and the bench's
+    # every-32nd-cycle cross-check.
+
+    def _payload_plan(self, node_names: list[str], pod: Pod):
+        demand = self._demand_of(pod)
+        if not demand.is_valid():
+            return None
+        batch = self._batch_plan(node_names)
+        if batch is None:
+            return None
+        scorer, names_key, non_tpu, prefer = batch
+        if non_tpu or len(names_key) != len(node_names):
+            return None  # non-pool candidates: the list path handles them
+        if not scorer.ensure_renderer(names_key):
+            return None
+        return scorer, demand, prefer
+
+    def filter_payload(self, node_names: list[str], pod: Pod) -> bytes | None:
+        """ExtenderFilterResult JSON bytes, or None -> use assume()."""
+        plan = self._payload_plan(node_names, pod)
+        if plan is None:
+            return None
+        scorer, demand, prefer = plan
+        return scorer.filter_payload(
+            demand, prefer, self._gang_member_slices(pod) or None
+        )
+
+    def priorities_payload(
+        self, node_names: list[str], pod: Pod
+    ) -> bytes | None:
+        """HostPriorityList JSON bytes, or None -> use score()."""
+        plan = self._payload_plan(node_names, pod)
+        if plan is None:
+            return None
+        scorer, demand, prefer = plan
+        return scorer.priorities_payload(
+            demand, prefer, self._gang_member_slices(pod) or None
+        )
+
     # -- Assume (Filter verb): dealer.go:89-136 ----------------------------
     def _demand_of(self, pod: Pod) -> Demand:
         cached = self._demand_uid.get(pod.uid)

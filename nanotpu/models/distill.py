@@ -187,6 +187,20 @@ def main(argv=None) -> int:
     parser.add_argument("--load-draft", default="",
                         help="orbax dir to load a draft instead of "
                              "distilling (--steps then typically 0)")
+    parser.add_argument("--target-ckpt", default="",
+                        help="orbax checkpoint dir from nanotpu.parallel."
+                             "train: distill against this TRAINED target "
+                             "instead of a random init (r3's measured "
+                             "ceiling of 0.89x was blamed on the random "
+                             "target's unlearnable conditionals — this "
+                             "flag is how that claim gets tested)")
+    parser.add_argument("--prompt-data", choices=["random", "markov"],
+                        default="random",
+                        help="eval prompt distribution; 'markov' draws "
+                             "on-corpus prompts (nanotpu.data synthetic "
+                             "chain, --data-seed) so a corpus-trained "
+                             "target decodes in its trained regime")
+    parser.add_argument("--data-seed", type=int, default=0)
     parser.add_argument("--int8-draft", action="store_true",
                         help="quantize the draft weight-only int8 for the "
                              "EVAL (draft steps are bandwidth-bound; the "
@@ -205,6 +219,24 @@ def main(argv=None) -> int:
     )
     key = jax.random.PRNGKey(0)
     params = init_params(key, cfg)
+    if args.target_ckpt:
+        from nanotpu.parallel.train import (
+            init_train_state,
+            make_optimizer,
+            restore_checkpoint,
+        )
+
+        # abstract template (eval_shape): restore wants structure+shapes,
+        # not a second materialized copy of params + optimizer moments
+        template = jax.eval_shape(
+            lambda k: init_train_state(k, cfg, make_optimizer()), key
+        )
+        restored = restore_checkpoint(args.target_ckpt, template)
+        if restored is None:
+            parser.error(f"no checkpoint under {args.target_ckpt}")
+        params = jax.tree_util.tree_map(jnp.asarray, restored.params)
+        log.info("loaded trained target from %s (step %d)",
+                 args.target_ckpt, int(restored.step))
     draft = init_draft(jax.random.PRNGKey(1), params, cfg, dcfg)
     lr = args.lr
     if args.lr_decay and args.steps > 0:
@@ -266,7 +298,16 @@ def main(argv=None) -> int:
     ks = ([int(x) for x in args.eval_ks.split(",") if x]
           or [args.draft_k])
     key, kp, k1, k2 = jax.random.split(key, 4)
-    prompt = jax.random.randint(kp, (EB, 8), 0, cfg.vocab_size)
+    if args.prompt_data == "markov":
+        from nanotpu.data.synthetic import markov_batch, markov_table
+
+        tab = jax.device_put(markov_table(cfg.vocab_size,
+                                          seed=args.data_seed))
+        prompt = jax.jit(functools.partial(
+            markov_batch, shape=(EB, 8)
+        ))(kp, tab)
+    else:
+        prompt = jax.random.randint(kp, (EB, 8), 0, cfg.vocab_size)
 
     plain = jax.jit(functools.partial(
         generate, cfg=cfg, max_new_tokens=N, temperature=T,

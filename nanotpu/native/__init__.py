@@ -30,7 +30,7 @@ _SRC = os.path.join(_REPO_ROOT, "native", "allocator.cc")
 _LIB = os.path.join(_PKG_DIR, "libnanotpu_alloc.so")
 
 #: must match nanotpu_abi_version() in allocator.cc
-ABI_VERSION = 4
+ABI_VERSION = 5
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -137,6 +137,28 @@ def _load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_int32),  # hbm_free [n*chips] (nullable)
             ctypes.POINTER(ctypes.c_int32),  # hbm_demand (nullable)
         ]
+        lib.nanotpu_render_priorities.restype = ctypes.c_int32
+        lib.nanotpu_render_priorities.argtypes = [
+            ctypes.c_char_p,  # frags blob
+            ctypes.POINTER(ctypes.c_int32),  # frag_off [n+1]
+            ctypes.POINTER(ctypes.c_int32),  # scores [n]
+            ctypes.c_int32,  # n
+            ctypes.c_char_p,  # out
+            ctypes.c_int32,  # out_cap
+        ]
+        lib.nanotpu_render_filter.restype = ctypes.c_int32
+        lib.nanotpu_render_filter.argtypes = [
+            ctypes.c_char_p,  # qnames blob
+            ctypes.POINTER(ctypes.c_int32),  # qoff [n+1]
+            ctypes.c_char_p,  # fail_frags blob
+            ctypes.POINTER(ctypes.c_int32),  # fail_off [n+1]
+            ctypes.POINTER(ctypes.c_uint8),  # feasible [n]
+            ctypes.c_int32,  # n
+            ctypes.c_char_p,  # extra
+            ctypes.c_int32,  # extra_len
+            ctypes.c_char_p,  # out
+            ctypes.c_int32,  # out_cap
+        ]
         _lib = lib
         return _lib
 
@@ -201,6 +223,43 @@ def score_batch(
     if rc != OK:
         raise NativeUnavailable(f"native score_batch error {rc}")
     return out_feasible, out_score
+
+
+def render_priorities(frags: bytes, frag_off, scores, n: int,
+                      out_buf) -> bytes:
+    """Render a HostPriorityList JSON payload from pre-baked per-node
+    fragments (``{"Host":"<name>","Score":``) and the score buffer
+    ``nanotpu_score_batch`` filled. ``frag_off`` is ``c_int32 * (n+1)``,
+    ``out_buf`` a caller-owned ``create_string_buffer`` (reused across
+    calls under the caller's lock). Raises :class:`NativeUnavailable` when
+    the caller should fall back to the Python render."""
+    lib = _load()
+    if lib is None:
+        raise NativeUnavailable("native allocator unavailable")
+    w = lib.nanotpu_render_priorities(
+        frags, frag_off, scores, n, out_buf, len(out_buf)
+    )
+    if w < 0:
+        raise NativeUnavailable(f"native render error {w}")
+    return ctypes.string_at(out_buf, w)
+
+
+def render_filter(qnames: bytes, qoff, fail_frags: bytes, fail_off,
+                  feasible, n: int, extra: bytes, out_buf) -> bytes:
+    """Render an ExtenderFilterResult JSON payload: feasible candidates'
+    quoted names into NodeNames, the rest's pre-baked
+    ``"<name>":"<reason>"`` entries into FailedNodes, plus ``extra``
+    (comma-joined non-pool entries, usually empty)."""
+    lib = _load()
+    if lib is None:
+        raise NativeUnavailable("native allocator unavailable")
+    w = lib.nanotpu_render_filter(
+        qnames, qoff, fail_frags, fail_off, feasible, n,
+        extra or None, len(extra), out_buf, len(out_buf)
+    )
+    if w < 0:
+        raise NativeUnavailable(f"native render error {w}")
+    return ctypes.string_at(out_buf, w)
 
 
 def choose(

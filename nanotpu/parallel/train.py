@@ -279,6 +279,17 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--save-every", type=int, default=100)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--data", choices=["random", "markov"],
+                        default="random",
+                        help="training stream: 'random' = uniform noise "
+                             "(throughput benching); 'markov' = the "
+                             "seeded synthetic corpus (nanotpu.data) "
+                             "whose conditionals a model can actually "
+                             "learn — the regime speculative decoding "
+                             "needs")
+    parser.add_argument("--data-seed", type=int, default=0,
+                        help="corpus seed (--data markov); the distill "
+                             "eval rebuilds the same corpus from it")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(message)s")
     log = logging.getLogger("nanotpu.train")
@@ -425,11 +436,24 @@ def main(argv: list[str] | None = None) -> int:
     # between steps (measured ~70 ms/step through a tunnel)
     gen_chunk = min(args.steps, max(64 // fuse * fuse, fuse))
     tokens_buf, buf_base = None, -1
-    gen = jax.jit(
-        lambda k: jax.random.randint(
-            k, (gen_chunk, batch, seq), 0, cfg.vocab_size
+    if args.data == "markov":
+        from nanotpu.data.synthetic import markov_batch, markov_table
+
+        # table as a jit ARGUMENT (uploaded once), never a closure —
+        # closure-captured arrays break the tunnel's remote compile
+        markov_tab = jax.device_put(markov_table(
+            cfg.vocab_size, seed=args.data_seed
+        ))
+        gen_markov = jax.jit(partial(
+            markov_batch, shape=(gen_chunk, batch, seq)
+        ))
+        gen = lambda k: gen_markov(k, markov_tab)  # noqa: E731
+    else:
+        gen = jax.jit(
+            lambda k: jax.random.randint(
+                k, (gen_chunk, batch, seq), 0, cfg.vocab_size
+            )
         )
-    )
     try:
         for i in range(start_step, start_step + args.steps, fuse):
             j = i - start_step

@@ -91,6 +91,18 @@ class Predicate:
         ok, failed = self.dealer.assume(node_names, pod)
         return {"NodeNames": ok, "FailedNodes": failed, "Error": ""}
 
+    def fast(self, args: dict[str, Any]) -> bytes | None:
+        """Fully-rendered response bytes via the dealer's fused native
+        score+render path; None -> the route layer runs handle()+render()
+        (which also reports any VerbError properly)."""
+        try:
+            pod, node_names = _extract(args)
+        except VerbError:
+            return None
+        if Demand.from_pod(pod).total <= 0:
+            return None
+        return self.dealer.filter_payload(node_names, pod)
+
     def render(self, result: dict[str, Any]) -> str:
         if len(self._qname) > 8192 or len(self._qfail) > 8192:
             self._qname.clear()
@@ -136,6 +148,16 @@ class Prioritize:
         if Demand.from_pod(pod).total <= 0:
             return [(n, 0) for n in node_names]
         return self.dealer.score(node_names, pod)
+
+    def fast(self, args: dict[str, Any]) -> bytes | None:
+        """See Predicate.fast."""
+        try:
+            pod, node_names = _extract(args)
+        except VerbError:
+            return None
+        if Demand.from_pod(pod).total <= 0:
+            return None
+        return self.dealer.priorities_payload(node_names, pod)
 
     def render(self, result: list[tuple[str, int]]) -> str:
         """HostPriorityList JSON from pre-serialized per-host fragments."""

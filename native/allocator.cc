@@ -20,6 +20,7 @@
 // 64). Larger sets return NANOTPU_ERR_TOO_BIG and callers fall back.
 
 #include <cstdint>
+#include <cstring>
 #include <algorithm>
 #include <cmath>
 #include <tuple>
@@ -405,7 +406,7 @@ int clamp_score(double s) {
 extern "C" {
 
 // ABI version so the ctypes loader can reject stale builds.
-int32_t nanotpu_abi_version() { return 4; }
+int32_t nanotpu_abi_version() { return 5; }
 
 // Place `n_demands` container demands onto one node's torus.
 //
@@ -641,6 +642,130 @@ int32_t nanotpu_score_batch(const int32_t dims[3],
     out_score[nidx] = score;
   }
   return NANOTPU_OK;
+}
+
+// -- wire-format renderers ---------------------------------------------
+//
+// The 256-candidate Prioritize/Filter responses repeat the same node
+// names every scheduling cycle (nodeCacheCapable); Python-side caching of
+// per-name fragments got the render to ~30-50us, but at the fan-out bench
+// rate that is still a visible slice of the verb. These render the full
+// response JSON from pre-baked fragment blobs + the score/feasibility
+// buffers nanotpu_score_batch just filled: a memcpy loop plus integer
+// formatting. Fragment bytes are produced (and JSON-escaped) by Python,
+// so no JSON quoting logic lives here.
+
+namespace {
+
+// Appends base-10 digits of v; returns chars written (v is a clamped
+// score, so it fits easily; handle negatives for safety).
+int write_int(char* dst, int32_t v) {
+  char tmp[12];
+  int n = 0;
+  uint32_t u = v < 0 ? (uint32_t)(-(int64_t)v) : (uint32_t)v;
+  do {
+    tmp[n++] = (char)('0' + u % 10);
+    u /= 10;
+  } while (u);
+  int w = 0;
+  if (v < 0) dst[w++] = '-';
+  for (int i = n - 1; i >= 0; --i) dst[w++] = tmp[i];
+  return w;
+}
+
+}  // namespace
+
+// HostPriorityList: `[frag0<score0>},frag1<score1>},...]` where fragment
+// i is `{"Host":"<name>","Score":`. frag_off has n+1 entries. Returns
+// bytes written, or NANOTPU_ERR_BAD_ARGS / NANOTPU_ERR_TOO_BIG (buffer
+// too small — caller falls back to the Python render).
+int32_t nanotpu_render_priorities(const char* frags,
+                                  const int32_t* frag_off,
+                                  const int32_t* scores,
+                                  int32_t n,
+                                  char* out,
+                                  int32_t out_cap) {
+  if (!frags || !frag_off || !scores || !out || n < 0 || out_cap < 2)
+    return NANOTPU_ERR_BAD_ARGS;
+  int32_t w = 0;
+  out[w++] = '[';
+  for (int32_t i = 0; i < n; ++i) {
+    int32_t lo = frag_off[i], hi = frag_off[i + 1];
+    if (lo < 0 || hi < lo) return NANOTPU_ERR_BAD_ARGS;
+    // worst case: fragment + 11 digit chars + '}' + ','
+    if (w + (hi - lo) + 13 > out_cap) return NANOTPU_ERR_TOO_BIG;
+    if (i) out[w++] = ',';
+    memcpy(out + w, frags + lo, (size_t)(hi - lo));
+    w += hi - lo;
+    w += write_int(out + w, scores[i]);
+    out[w++] = '}';
+  }
+  if (w + 1 > out_cap) return NANOTPU_ERR_TOO_BIG;
+  out[w++] = ']';
+  return w;
+}
+
+// ExtenderFilterResult: `{"NodeNames":[<qnames where feasible>],
+// "FailedNodes":{<fail_frags where infeasible><extra>},"Error":""}`.
+// qnames fragment i is the quoted name `"<name>"`; fail fragment i is
+// the full entry `"<name>":"<reason>"`. `extra` is a pre-rendered
+// comma-joined run of additional FailedNodes entries (no leading comma)
+// for candidates outside the scored pool.
+int32_t nanotpu_render_filter(const char* qnames,
+                              const int32_t* qoff,
+                              const char* fail_frags,
+                              const int32_t* fail_off,
+                              const uint8_t* feasible,
+                              int32_t n,
+                              const char* extra,
+                              int32_t extra_len,
+                              char* out,
+                              int32_t out_cap) {
+  if (!qnames || !qoff || !fail_frags || !fail_off || !feasible || !out ||
+      n < 0 || extra_len < 0 || (extra_len > 0 && !extra))
+    return NANOTPU_ERR_BAD_ARGS;
+  static const char kHead[] = "{\"NodeNames\":[";
+  static const char kMid[] = "],\"FailedNodes\":{";
+  static const char kTail[] = "},\"Error\":\"\"}";
+  int32_t w = 0;
+  if (w + (int32_t)sizeof(kHead) > out_cap) return NANOTPU_ERR_TOO_BIG;
+  memcpy(out + w, kHead, sizeof(kHead) - 1);
+  w += sizeof(kHead) - 1;
+  bool first = true;
+  for (int32_t i = 0; i < n; ++i) {
+    if (!feasible[i]) continue;
+    int32_t lo = qoff[i], hi = qoff[i + 1];
+    if (lo < 0 || hi < lo) return NANOTPU_ERR_BAD_ARGS;
+    if (w + (hi - lo) + 2 > out_cap) return NANOTPU_ERR_TOO_BIG;
+    if (!first) out[w++] = ',';
+    first = false;
+    memcpy(out + w, qnames + lo, (size_t)(hi - lo));
+    w += hi - lo;
+  }
+  if (w + (int32_t)sizeof(kMid) > out_cap) return NANOTPU_ERR_TOO_BIG;
+  memcpy(out + w, kMid, sizeof(kMid) - 1);
+  w += sizeof(kMid) - 1;
+  first = true;
+  for (int32_t i = 0; i < n; ++i) {
+    if (feasible[i]) continue;
+    int32_t lo = fail_off[i], hi = fail_off[i + 1];
+    if (lo < 0 || hi < lo) return NANOTPU_ERR_BAD_ARGS;
+    if (w + (hi - lo) + 2 > out_cap) return NANOTPU_ERR_TOO_BIG;
+    if (!first) out[w++] = ',';
+    first = false;
+    memcpy(out + w, fail_frags + lo, (size_t)(hi - lo));
+    w += hi - lo;
+  }
+  if (extra_len) {
+    if (w + extra_len + 2 > out_cap) return NANOTPU_ERR_TOO_BIG;
+    if (!first) out[w++] = ',';
+    memcpy(out + w, extra, (size_t)extra_len);
+    w += extra_len;
+  }
+  if (w + (int32_t)sizeof(kTail) > out_cap) return NANOTPU_ERR_TOO_BIG;
+  memcpy(out + w, kTail, sizeof(kTail) - 1);
+  w += sizeof(kTail) - 1;
+  return w;
 }
 
 }  // extern "C"

@@ -307,3 +307,101 @@ class TestOperationalEndpoints:
         assert all(code == 200 for code, _, _ in results)
         # both scrapes got the SAME window's report
         assert results[0][2] == results[1][2]
+
+
+class TestFusedNativeFastPath:
+    """r4: Filter/Prioritize responses rendered straight from the native
+    score buffers (dealer.filter_payload / priorities_payload) must be
+    byte-compatible with the handle()+render() path, and the pre-tokenized
+    NodeNames parse must agree with json.loads on every shape."""
+
+    def _uniform_app(self):
+        e = Extender(make_mock_cluster(8))
+        return e
+
+    def test_fast_path_fires_and_matches_slow_path(self):
+        import json as _json
+
+        e = self._uniform_app()
+        try:
+            nodes = [f"v5p-host-{i}" for i in range(8)]
+            for i in range(10):
+                pod = e.client.create_pod(make_pod(
+                    f"fp-{i}",
+                    containers=[make_container(
+                        "m", {types.RESOURCE_TPU_PERCENT: 200})],
+                    annotations={types.ANNOTATION_GANG_NAME: "g",
+                                 types.ANNOTATION_GANG_SIZE: "10"},
+                ))
+                args = {"Pod": pod.raw, "NodeNames": nodes}
+                fast_f = e.api.predicate.fast(dict(args))
+                assert fast_f is not None, "filter fast path did not fire"
+                slow_f = e.api.predicate.render(
+                    e.api.predicate.handle(dict(args)))
+                assert _json.loads(fast_f) == _json.loads(slow_f)
+                fast_p = e.api.prioritize.fast(dict(args))
+                assert fast_p is not None, "priorities fast path dead"
+                slow_p = e.api.prioritize.render(
+                    e.api.prioritize.handle(dict(args)))
+                assert _json.loads(fast_p) == _json.loads(slow_p)
+                best = max(_json.loads(fast_p),
+                           key=lambda p: p["Score"])["Host"]
+                assert e.post("/scheduler/bind", {
+                    "PodName": pod.name, "PodNamespace": "default",
+                    "PodUID": pod.uid, "Node": best,
+                })["Error"] == ""
+        finally:
+            e.close()
+
+    def test_fast_path_declines_mixed_candidates(self):
+        """An unknown candidate name must push the verb onto the list
+        path (whose FailedNodes carries the 'not a TPU node' reason)."""
+        e = self._uniform_app()
+        try:
+            pod = e.client.create_pod(make_pod(
+                "fp-mixed",
+                containers=[make_container(
+                    "m", {types.RESOURCE_TPU_PERCENT: 100})],
+            ))
+            args = {"Pod": pod.raw,
+                    "NodeNames": ["v5p-host-0", "no-such-node"]}
+            assert e.api.predicate.fast(dict(args)) is None
+            filt = e.post("/scheduler/filter", args)
+            assert filt["FailedNodes"]["no-such-node"] == "not a TPU node"
+        finally:
+            e.close()
+
+    def test_parse_args_fast_path_shapes(self):
+        """Pre-tokenized NodeNames parse vs json.loads across tricky
+        payload shapes, including ones that must fall back."""
+        import json as _json
+
+        e = self._uniform_app()
+        try:
+            names = [f"v5p-host-{i}" for i in range(8)]
+            bodies = [
+                _json.dumps({"Pod": {"metadata": {"name": "a"}},
+                             "NodeNames": names}),
+                # same span again (cache hit)
+                _json.dumps({"Pod": {"metadata": {"name": "b"}},
+                             "NodeNames": names}),
+                # empty list
+                _json.dumps({"Pod": {}, "NodeNames": []}),
+                # name containing ']' breaks the span scan -> fallback
+                _json.dumps({"Pod": {}, "NodeNames": ["weird]name", "x"]}),
+                # the key string inside a pod VALUE -> count guard
+                _json.dumps({"Pod": {"metadata": {"annotations": {
+                    "note": '"NodeNames":["fake"]'}}},
+                    "NodeNames": names}),
+                # nested occurrence only (no top-level key)
+                _json.dumps({"Pod": {"NodeNames": ["inner"]}}),
+                # lowercase variant (fallback; _extract handles it)
+                _json.dumps({"Pod": {}, "nodeNames": names}),
+                # non-string entries -> fallback, still parsed correctly
+                _json.dumps({"Pod": {}, "NodeNames": [1, 2]}),
+            ]
+            for body in bodies:
+                got = e.api._parse_args(body.encode())
+                assert got == _json.loads(body), body
+        finally:
+            e.close()

@@ -1,0 +1,108 @@
+"""Seeded synthetic Markov corpus (nanotpu.data): the structured stream
+the speculative-decoding experiment trains on (VERDICT r3 #1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanotpu.data.synthetic import (
+    DEFAULT_SUCC_LOGITS,
+    ideal_ce,
+    markov_batch,
+    markov_table,
+)
+
+
+def test_table_seeded_and_shaped():
+    t1 = markov_table(512, seed=7)
+    t2 = markov_table(512, seed=7)
+    t3 = markov_table(512, seed=8)
+    assert t1.shape == (512, 4) and t1.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    assert not np.array_equal(np.asarray(t1), np.asarray(t3))
+    assert int(jnp.min(t1)) >= 0 and int(jnp.max(t1)) < 512
+
+
+def test_batch_shape_range_and_determinism():
+    tab = markov_table(256, seed=0)
+    gen = jax.jit(lambda k, t: markov_batch(k, t, (3, 2, 17)))
+    out = gen(jax.random.PRNGKey(1), tab)
+    assert out.shape == (3, 2, 17) and out.dtype == jnp.int32
+    assert int(jnp.min(out)) >= 0 and int(jnp.max(out)) < 256
+    again = gen(jax.random.PRNGKey(1), tab)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(again))
+
+
+def test_every_transition_is_a_table_successor():
+    tab = np.asarray(markov_table(128, seed=3))
+    out = np.asarray(markov_batch(jax.random.PRNGKey(2), jnp.asarray(tab),
+                                  (8, 65)))
+    for row in out:
+        for a, b in zip(row[:-1], row[1:]):
+            assert b in tab[a], (a, b, tab[a])
+
+
+def test_transition_frequencies_match_logits():
+    """Empirical successor-choice frequencies ~ softmax(DEFAULT logits):
+    the corpus really has the designed ~0.95-nat conditionals."""
+    tab = np.asarray(markov_table(64, seed=5))
+    out = np.asarray(markov_batch(jax.random.PRNGKey(4), jnp.asarray(tab),
+                                  (64, 257)))
+    z = np.asarray(DEFAULT_SUCC_LOGITS, np.float64)
+    want = np.exp(z - z.max())
+    want /= want.sum()
+    counts = np.zeros(4)
+    skipped = 0
+    for row in out:
+        for a, b in zip(row[:-1], row[1:]):
+            succ = tab[a]
+            idx = np.nonzero(succ == b)[0]
+            # duplicate successors in a row are ambiguous; count the first
+            counts[idx[0]] += 1
+    freq = counts / counts.sum()
+    np.testing.assert_allclose(freq, want, atol=0.02)
+
+
+def test_ideal_ce_value():
+    # softmax([2,1,0,-1]) entropy, and it is far below uniform over 32k
+    assert ideal_ce() == pytest.approx(0.9475, abs=1e-3)
+    assert ideal_ce() < 0.1 * np.log(32_768)
+
+
+def test_train_cli_learns_markov_but_not_noise():
+    """--data markov must drop the tiny model's loss well below the
+    uniform floor ln(V); --data random must not (the structured stream is
+    actually reaching the optimizer)."""
+    import logging
+
+    from nanotpu.parallel.train import main
+
+    losses = {}
+    for data in ("markov", "random"):
+        records = []
+
+        class Grab(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        h = Grab()
+        logger = logging.getLogger("nanotpu.train")
+        logger.addHandler(h)
+        old_level = logger.level
+        logger.setLevel(logging.INFO)
+        try:
+            assert main([
+                "--model", "llama", "--preset", "tiny", "--steps", "100",
+                "--batch", "8", "--seq", "64", "--data", data,
+                "--data-seed", "11",
+            ]) == 0
+        finally:
+            logger.removeHandler(h)
+            logger.setLevel(old_level)
+        steps = [float(m.rsplit(" ", 1)[1]) for m in records
+                 if m.startswith("step ")]
+        losses[data] = steps[-1]
+    uniform = float(np.log(512))  # tiny preset vocab
+    assert losses["markov"] < uniform - 1.0, losses
+    assert losses["random"] > uniform - 0.5, losses
