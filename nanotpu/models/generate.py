@@ -153,11 +153,15 @@ def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start,
 
 
 def _run(params, tokens, cfg, cache: KVCache, full_prefill: bool = False,
-         return_all: bool = False, mesh=None):
+         return_all: bool = False, mesh=None, head: bool = True):
     """Shared prefill/step body: tokens [B,S] appended at cache.length.
     ``return_all`` returns logits for every fed position [B,S,V] (the
     speculative-decoding verify forward needs them all), else last-token
-    logits [B,V]."""
+    logits [B,V]. ``head=False`` skips the final norm + lm_head and
+    returns ``(None, cache)`` — for callers that only prime the cache
+    (e.g. a speculative draft's admission prefill), where the discarded
+    [S, D] x [D, V] projection can cost more than the shallow draft's
+    whole transformer."""
     B, S = tokens.shape
     start = cache.length
     positions = start + jnp.arange(S, dtype=jnp.int32)
@@ -171,10 +175,12 @@ def _run(params, tokens, cfg, cache: KVCache, full_prefill: bool = False,
         )
         ks.append(k_l)
         vs.append(v_l)
+    new_cache = KVCache(tuple(ks), tuple(vs), start + S)
+    if not head:
+        return None, new_cache
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     x_out = x if return_all else x[:, -1]
     logits = linear(x_out, params["lm_head"]).astype(jnp.float32)
-    new_cache = KVCache(tuple(ks), tuple(vs), start + S)
     return logits, new_cache
 
 
