@@ -82,7 +82,7 @@ def _attend_cached(q, k_cache, v_cache, valid_len):
 
 
 def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start,
-                      full_prefill=False, mesh=None):
+                      full_prefill=False, mesh=None, drop_acc=None):
     """One decoder layer over new tokens x [B,S,D], updating this layer's
     cache slice at [start, start+S). Returns (x, k_cache, v_cache).
 
@@ -144,7 +144,7 @@ def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start,
 
         ffn_out, _aux = moe_block(
             layer["moe"], rms_norm(x, layer["moe_norm"], cfg.norm_eps), cfg,
-            full_capacity=(S == 1),
+            full_capacity=(S == 1), drop_acc=drop_acc,
         )
     else:
         ffn_out = mlp(layer["mlp"], rms_norm(x, layer["mlp_norm"], cfg.norm_eps))
@@ -153,7 +153,8 @@ def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start,
 
 
 def _run(params, tokens, cfg, cache: KVCache, full_prefill: bool = False,
-         return_all: bool = False, mesh=None, head: bool = True):
+         return_all: bool = False, mesh=None, head: bool = True,
+         drop_acc=None):
     """Shared prefill/step body: tokens [B,S] appended at cache.length.
     ``return_all`` returns logits for every fed position [B,S,V] (the
     speculative-decoding verify forward needs them all), else last-token
@@ -171,7 +172,7 @@ def _run(params, tokens, cfg, cache: KVCache, full_prefill: bool = False,
     for i, layer in enumerate(params["layers"]):
         x, k_l, v_l = _layer_with_cache(
             layer, x, cfg, cos, sin, cache.k[i], cache.v[i], start,
-            full_prefill=full_prefill, mesh=mesh,
+            full_prefill=full_prefill, mesh=mesh, drop_acc=drop_acc,
         )
         ks.append(k_l)
         vs.append(v_l)

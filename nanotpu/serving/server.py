@@ -60,6 +60,15 @@ class ServingAPI:
         self.active.set_function(
             lambda: sum(1 for x in engine._slot_req if x is not None)
         )
+        self.moe_dropped = r.gauge(
+            "nanotpu_serve_moe_prefill_dropped_tokens_total",
+            "MoE tokens dropped by expert capacity during admission "
+            "prefills (monotone; decode routes at full capacity and "
+            "cannot drop)",
+        )
+        self.moe_dropped.set_function(
+            lambda: engine.moe_prefill_dropped_total
+        )
 
     def dispatch(self, method: str, path: str, body: bytes) -> tuple[int, str, str]:
         try:
