@@ -186,20 +186,24 @@ def _run(params, tokens, cfg, cache: KVCache, full_prefill: bool = False,
 
 
 def prefill(params, prompt: jax.Array, cfg: LlamaConfig, max_len: int,
-            mesh=None):
+            mesh=None, head: bool = True):
     """prompt [B,S] -> (last-token logits [B,V], primed cache). The cache
     starts empty, so attention is pure causal self-attention over the
     prompt and runs through the flash kernel (see _layer_with_cache).
 
     ``mesh`` enables multi-chip decode (nanotpu.parallel.infer): the fresh
     cache is pinned to the tp-over-kv-heads layout so every step's cache
-    reads stay collective-free."""
+    reads stay collective-free. ``head=False`` returns (None, cache) —
+    for cache-priming-only callers like a speculative draft's prefill,
+    whose discarded [S, D] x [D, V] projection can cost more than the
+    shallow draft itself."""
     cache = KVCache.create(cfg, prompt.shape[0], max_len)
     if mesh is not None:
         from nanotpu.parallel.infer import constrain_cache
 
         cache = constrain_cache(cache, mesh)
-    return _run(params, prompt, cfg, cache, full_prefill=True, mesh=mesh)
+    return _run(params, prompt, cfg, cache, full_prefill=True, mesh=mesh,
+                head=head)
 
 
 def decode_step(params, token: jax.Array, cfg: LlamaConfig, cache: KVCache,

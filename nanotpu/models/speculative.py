@@ -132,9 +132,12 @@ def speculative_generate(
     key = rng if rng is not None else jax.random.PRNGKey(0)
 
     # both models prefill the prompt; the target's last-token logits give
-    # the first emitted token
+    # the first emitted token. The draft's prefill only primes its cache
+    # (head=False: its discarded full-vocab projection would cost more
+    # than the shallow draft's whole transformer on long prompts)
     t_logits, t_cache = prefill(params, prompt, cfg, max_len, mesh=mesh)
-    _, d_cache = prefill(draft_params, prompt, draft_cfg, max_len, mesh=mesh)
+    _, d_cache = prefill(draft_params, prompt, draft_cfg, max_len,
+                         mesh=mesh, head=False)
     if sampled:
         key, sub = jax.random.split(key)
         first = jax.random.categorical(

@@ -197,6 +197,17 @@ _PRESETS = {
         vocab_size=32_768, dim=1024, n_layers=8, n_heads=16, n_kv_heads=4,
         ffn_dim=4096, max_seq_len=2048, dtype="bfloat16",
     ),
+    # head_dim control for the MFU-ceiling question (VERDICT r3 ask #5):
+    # IDENTICAL parameter count to flagship (wq 1024x1024, wk/wv 1024x256)
+    # but 8 heads of hd=128 instead of 16 of hd=64 — the QK/PV dots then
+    # contract/emit the MXU's full 128 lanes. If the flagship's ~54% 6ND
+    # is a model-shape ceiling (hd=64 half-fills the lanes), this preset
+    # must measure materially higher; if it doesn't, the ceiling story is
+    # wrong and the residual is a scheduling gap.
+    ("llama", "flagship-hd128"): dict(
+        vocab_size=32_768, dim=1024, n_layers=8, n_heads=8, n_kv_heads=2,
+        ffn_dim=4096, max_seq_len=2048, dtype="bfloat16",
+    ),
     ("llama", "8b"): dict(
         vocab_size=128_256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
         ffn_dim=14_336, max_seq_len=8192, dtype="bfloat16",

@@ -1029,14 +1029,20 @@ class Engine:
                 req = self._queue.popleft()
             S = len(req.prompt)
             # cap generation to the cache row; speculative mode reserves
-            # K+1 extra positions for the last cycle's write overshoot
+            # K+1 extra positions for the last cycle's write overshoot.
+            # The floor of 1 keeps a near-max_len prompt's behavior at
+            # the plain engine's boundary semantics (one prefill token,
+            # no decode cycles) instead of a negative budget; it cannot
+            # overflow the row — a 1-token budget freezes before any
+            # speculative cycle writes, and a frozen row's (clamped)
+            # writes land only in its own never-read tail
             slack = (
                 self.draft_tokens + 1 if self.draft_params is not None
                 else 0
             )
-            req.max_new_tokens = min(
+            req.max_new_tokens = max(1, min(
                 req.max_new_tokens, self.max_len - S - slack
-            )
+            ))
             bucket = self._bucket(S)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :S] = req.prompt
