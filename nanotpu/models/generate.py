@@ -115,22 +115,22 @@ def _layer_with_cache(layer, x, cfg, cos, sin, k_cache, v_cache, start,
     if full_prefill and cfg.attn_impl == "flash":
         from nanotpu.ops.attention import flash_attention
 
-        rep = H // KV
-        kf = jnp.repeat(k, rep, axis=2)
-        vf = jnp.repeat(v, rep, axis=2)
+        # GQA-native kernel: k/v enter at kv-head granularity (no repeat)
         if mesh is not None:
             # a Pallas call does not partition under GSPMD — run it
             # per-shard over tp (heads are embarrassingly parallel in
-            # flash attention; no cross-head communication exists)
+            # flash attention; no cross-head communication exists; kv
+            # heads shard over tp exactly like q heads, so the per-shard
+            # group ratio H/KV is unchanged)
             from jax.sharding import PartitionSpec as P
 
             spec = P(None, None, "tp", None)
             out = jax.shard_map(
                 lambda q_, k_, v_: flash_attention(q_, k_, v_, True),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            )(q, kf, vf)
+            )(q, k, v)
         else:
-            out = flash_attention(q, kf, vf, True)
+            out = flash_attention(q, k, v, True)
     else:
         out = _attend_cached(q, k_cache, v_cache, start + S)
     x = x + linear(out.reshape(B, S, H * hd), attn["wo"])

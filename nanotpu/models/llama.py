@@ -205,17 +205,21 @@ def attention(params: dict, x: jax.Array, cfg: LlamaConfig,
 
         out = ring_attention(q, k, v, axis_name="sp", causal=True)
         return linear(out.reshape(B, S, H * hd), params["wo"])
-    # GQA: repeat kv heads to full head count (XLA turns this into a
-    # broadcast inside the einsum, no materialized copy)
-    if KV != H:
-        rep = H // KV
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
     if cfg.attn_impl == "flash":
+        # GQA-native kernel: k/v stay at kv-head granularity, the
+        # BlockSpec index maps route each q head to its kv head — the
+        # repeat materialization (rep x kv bytes, HBM write + re-read in
+        # forward AND backward) never exists
         from nanotpu.ops.attention import flash_attention
 
         out = flash_attention(q, k, v, causal=True)
     else:
+        # GQA: repeat kv heads to full head count (XLA turns this into a
+        # broadcast inside the einsum, no materialized copy)
+        if KV != H:
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         out = _dense_attention(q, k, v, causal=True)
     return linear(out.reshape(B, S, H * hd), params["wo"])
 

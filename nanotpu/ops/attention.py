@@ -68,8 +68,17 @@ LSE_SUBLANES = 8
 
 
 def _xla_attention(q, k, v, causal: bool):
-    """Reference dense path (XLA fuses + tiles this fine for moderate S)."""
+    """Reference dense path (XLA fuses + tiles this fine for moderate S).
+    Accepts GQA k/v ([B, S, KV, D], KV | H) like the kernel path."""
     B, S, H, D = q.shape
+    if k.shape[2] != H:
+        if H % k.shape[2]:
+            raise ValueError(
+                f"n_kv_heads {k.shape[2]} must divide n_heads {H}"
+            )
+        rep = H // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scale = 1.0 / math.sqrt(D)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
@@ -77,6 +86,18 @@ def _xla_attention(q, k, v, causal: bool):
         logits = jnp.where(mask[None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _kv_of(b, H: int, KV: int):
+    """Grid-axis-0 (= flattened batch*q-head index) -> flattened
+    batch*kv-head index, used in the k/v BlockSpec index maps: GQA reads
+    the UNEXPANDED kv buffer, so the jnp.repeat materialization (rep x
+    the kv bytes, written then re-read) never exists. Head order matches
+    jnp.repeat(axis=2): q head h serves kv head h // rep."""
+    if H == KV:
+        return b
+    rep = H // KV
+    return b // H * KV + b % H // rep
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int,
@@ -185,6 +206,11 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     from jax.experimental import pallas as pl
 
     B, S, H, D = q.shape
+    KV = k.shape[2]
+    if H % KV:
+        # loud failure: _kv_of with a non-dividing KV computes an
+        # out-of-range kv block index (garbage reads, no error)
+        raise ValueError(f"n_kv_heads {KV} must divide n_heads {H}")
     scale = 1.0 / math.sqrt(D)
     # clamp blocks for short sequences, but keep them TILE-ALIGNED: Mosaic
     # requires sequence-dim blocks in sublane multiples (16 covers bf16's
@@ -202,10 +228,12 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     if S_pad != S:
         pad = [(0, 0), (0, S_pad - S), (0, 0), (0, 0)]
         q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
-    # flatten batch*heads into the grid's first axis; move seq next to d
+    # flatten batch*heads into the grid's first axis; move seq next to d.
+    # k/v stay at KV-head granularity — the BlockSpec index map routes
+    # each q-head's program to its kv head (_kv_of), no expansion
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, S_pad, D)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * H, S_pad, D)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * H, S_pad, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * KV, S_pad, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * KV, S_pad, D)
 
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, seq_len=S, causal=causal, scale=scale
@@ -224,8 +252,10 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         grid=(B * H, S_pad // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S_pad, D),
+                         lambda b, i: (_kv_of(b, H, KV), 0, 0)),
+            pl.BlockSpec((1, S_pad, D),
+                         lambda b, i: (_kv_of(b, H, KV), 0, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -425,17 +455,26 @@ FUSED_BWD_MAX_S = max(
 
 def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
                     interpret):
-    """Pallas backward: returns (dq, dk, dv) shaped like q/k/v."""
+    """Pallas backward: returns (dq, dk, dv) shaped like q/k/v — for GQA
+    inputs (k/v at KV < H heads) the kernels still READ the unexpanded
+    buffers via the _kv_of index maps, while dk/dv are produced at q-head
+    granularity (each program owns its (batch, q-head) output block — a
+    KV-granular output would race across the rep q-heads that share a kv
+    head) and reduced over the group outside, which is exactly the sum
+    autodiff-of-repeat used to do, minus the materialized repeat."""
     from jax.experimental import pallas as pl
 
     B, S, H, D = q.shape
+    KV = k.shape[2]
     scale = 1.0 / math.sqrt(D)
     block_q, block_k, S_pad = _blocks_for(S, block_q, block_k)
     if S_pad != S:
         pad = [(0, 0), (0, S_pad - S), (0, 0), (0, 0)]
         q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
         out, g = jnp.pad(out, pad), jnp.pad(g, pad)
-    flat = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, S_pad, D)  # noqa: E731
+    flat = lambda x: x.transpose(0, 2, 1, 3).reshape(  # noqa: E731
+        B * x.shape[2], S_pad, D
+    )
     qf, kf, vf, of, gf = flat(q), flat(k), flat(v), flat(out), flat(g)
     # D_i = rowsum(dO * O): tiny elementwise reduce, no reason for a kernel;
     # broadcast over sublanes like lse (Mosaic block-tiling, LSE_SUBLANES)
@@ -444,8 +483,20 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
         dvec[:, None, :], (B * H, LSE_SUBLANES, S_pad)
     )
 
+    unflat = lambda x: x.reshape(B, H, S_pad, D).transpose(0, 2, 1, 3)  # noqa: E731
+
+    def group_sum(dkv):
+        """[B, S_pad, H, D] q-head-granular kv grads -> the primal's
+        [B, S_pad, KV, D] (sum over each kv head's rep q heads)."""
+        if KV == H:
+            return dkv
+        return dkv.reshape(B, S_pad, KV, H // KV, D).sum(axis=3)
+
     if S_pad <= FUSED_BWD_MAX_S:
         rowf = pl.BlockSpec((1, S_pad, D), lambda b: (b, 0, 0))
+        rowf_kv = pl.BlockSpec(
+            (1, S_pad, D), lambda b: (_kv_of(b, H, KV), 0, 0)
+        )
         row1f = pl.BlockSpec((1, LSE_SUBLANES, S_pad), lambda b: (b, 0, 0))
         dq32, dk, dv = pl.pallas_call(
             functools.partial(
@@ -453,7 +504,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
                 seq_len=S, causal=causal, scale=scale,
             ),
             grid=(B * H,),
-            in_specs=[rowf, rowf, rowf, rowf, row1f, row1f],
+            in_specs=[rowf, rowf_kv, rowf_kv, rowf, row1f, row1f],
             out_specs=[rowf, rowf, rowf],
             out_shape=[
                 jax.ShapeDtypeStruct((B * H, S_pad, D), jnp.float32),
@@ -462,17 +513,23 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
             ],
             interpret=interpret,
         )(qf, kf, vf, gf, lse, dvec)
-        unflat = lambda x: x.reshape(B, H, S_pad, D).transpose(0, 2, 1, 3)  # noqa: E731
-        dq, dk, dv = unflat(dq32.astype(q.dtype)), unflat(dk), unflat(dv)
+        dq = unflat(dq32.astype(q.dtype))
+        dk, dv = group_sum(unflat(dk)), group_sum(unflat(dv))
         if S_pad != S:
             dq, dk, dv = dq[:, :S], dk[:, :S], dv[:, :S]
         return dq, dk, dv
 
+    row_kv = pl.BlockSpec(
+        (1, S_pad, D), lambda b, i: (_kv_of(b, H, KV), 0, 0)
+    )
     row = pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0))
     row1 = pl.BlockSpec((1, LSE_SUBLANES, S_pad), lambda b, i: (b, 0, 0))
     qblk = pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0))
     qblk1 = pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda b, i: (b, 0, i))
     kblk = pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0))
+    kblk_kv = pl.BlockSpec(
+        (1, block_k, D), lambda b, i: (_kv_of(b, H, KV), i, 0)
+    )
 
     dq = pl.pallas_call(
         functools.partial(
@@ -480,7 +537,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
             causal=causal, scale=scale,
         ),
         grid=(B * H, S_pad // block_q),
-        in_specs=[qblk, row, row, qblk, qblk1, qblk1],
+        in_specs=[qblk, row_kv, row_kv, qblk, qblk1, qblk1],
         out_specs=qblk,
         out_shape=jax.ShapeDtypeStruct((B * H, S_pad, D), q.dtype),
         interpret=interpret,
@@ -492,7 +549,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
             causal=causal, scale=scale,
         ),
         grid=(B * H, S_pad // block_k),
-        in_specs=[row, kblk, kblk, row, row1, row1],
+        in_specs=[row, kblk_kv, kblk_kv, row, row1, row1],
         out_specs=[kblk, kblk],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S_pad, D), k.dtype),
@@ -501,8 +558,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
         interpret=interpret,
     )(qf, kf, vf, gf, lse, dvec)
 
-    unflat = lambda x: x.reshape(B, H, S_pad, D).transpose(0, 2, 1, 3)  # noqa: E731
-    dq, dk, dv = unflat(dq), unflat(dk), unflat(dv)
+    dq = unflat(dq)
+    dk, dv = group_sum(unflat(dk)), group_sum(unflat(dv))
     if S_pad != S:
         dq, dk, dv = dq[:, :S], dk[:, :S], dv[:, :S]
     return dq, dk, dv
@@ -514,7 +571,13 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
     interpret: bool | None = None,
 ):
-    """q/k/v: [B, S, H, D] (kv heads already expanded) -> [B, S, H, D].
+    """q: [B, S, H, D]; k/v: [B, S, KV, D] with KV | H -> [B, S, H, D].
+
+    GQA-native: KV < H needs NO expansion — the kernels route each q
+    head's reads to its kv head via the BlockSpec index map (_kv_of), so
+    the ``jnp.repeat`` copies (rep x the kv bytes, written to HBM and
+    read back by the kernel, in forward AND backward) never exist.
+    KV == H is the classic multi-head case.
 
     Uses the Pallas kernel on TPU backends, XLA fallback elsewhere (or set
     ``interpret=True`` to run the kernel in interpreter mode for tests).

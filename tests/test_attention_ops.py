@@ -157,3 +157,102 @@ class TestFlashBackward:
         for a, b in zip(gf, gd):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
+
+
+class TestGqaNativeKernel:
+    """r4: the kernels read UNEXPANDED kv buffers ([B, S, KV, D], KV | H)
+    via BlockSpec index maps — forward and gradients must match feeding
+    the repeat-expanded kv, and dk/dv must come back at KV granularity
+    (the group sum autodiff-of-repeat used to do)."""
+
+    def _gqa(self, key, S=200, B=2, H=8, KV=2, D=32):
+        ks = jax.random.split(key, 3)
+        q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
+        return q, k, v
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_expanded(self, causal):
+        q, k, v = self._gqa(jax.random.PRNGKey(10))
+        rep = q.shape[2] // k.shape[2]
+        got = flash_attention(q, k, v, causal, 64, 64, True)
+        want = flash_attention(
+            q, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2),
+            causal, 64, 64, True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    @pytest.mark.parametrize("S", [96, 255])
+    def test_grads_match_dense_gqa(self, S):
+        """Grad parity vs the dense path on unexpanded kv (the dense
+        reference repeats internally; autodiff of its repeat produces the
+        KV-granular sums the kernel's group_sum must reproduce)."""
+        q, k, v = self._gqa(jax.random.PRNGKey(11), S=S)
+        gf = jax.grad(
+            lambda q, k, v: (
+                flash_attention(q, k, v, True, 64, 64, True) ** 2
+            ).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gd = jax.grad(
+            lambda q, k, v: (_xla_attention(q, k, v, True) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        assert gf[1].shape == k.shape and gf[2].shape == v.shape
+        for name, a, b in zip("dq dk dv".split(), gf, gd):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-4,
+                err_msg=name,
+            )
+
+    def test_fused_bwd_path_gqa(self):
+        """The single-pass fused backward (S_pad <= FUSED_BWD_MAX_S uses
+        it by default at these sizes) with GQA index maps."""
+        q, k, v = self._gqa(jax.random.PRNGKey(12), S=128)
+        gf = jax.grad(
+            lambda q, k, v: (
+                flash_attention(q, k, v, True, 128, 128, True) ** 2
+            ).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gd = jax.grad(
+            lambda q, k, v: (_xla_attention(q, k, v, True) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=3e-4)
+
+    def test_two_pass_bwd_path_gqa(self, monkeypatch):
+        """The TWO-PASS backward's GQA index maps (row_kv/kblk_kv): at
+        default settings every small-S test takes the fused single-pass
+        path, so this pins FUSED_BWD_MAX_S=0 to force the dq + dkv
+        kernels — a wrong index map there would otherwise pass CI."""
+        from nanotpu.ops import attention as att
+
+        monkeypatch.setattr(att, "FUSED_BWD_MAX_S", 0)
+        q, k, v = self._gqa(jax.random.PRNGKey(13), S=200)
+        gf = jax.grad(
+            lambda q, k, v: (
+                flash_attention(q, k, v, True, 64, 128, True) ** 2
+            ).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gd = jax.grad(
+            lambda q, k, v: (_xla_attention(q, k, v, True) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=3e-4)
+
+    def test_non_dividing_kv_heads_raise(self):
+        q = jnp.zeros((1, 32, 8, 16), jnp.float32)
+        kv = jnp.zeros((1, 32, 3, 16), jnp.float32)
+        with pytest.raises(ValueError, match="must divide"):
+            flash_attention(q, kv, kv, True, 32, 32, True)
+        with pytest.raises(ValueError, match="must divide"):
+            _xla_attention(q, kv, kv, True)

@@ -189,6 +189,45 @@ class TestShardedEngine:
             eng.stop()
 
 
+class TestShardedSpeculativeEngine:
+    def test_speculative_engine_on_mesh_matches_solo_generate(self, tiny):
+        """Per-row speculative serving UNDER A MESH (draft cache sharded,
+        spec chunk compiled with real input shardings): greedy rows still
+        pin exactly to solo generate()."""
+        import dataclasses
+
+        from nanotpu.models.distill import init_draft
+
+        params, cfg = tiny
+        dcfg = dataclasses.replace(cfg, n_layers=1)
+        draft = init_draft(jax.random.PRNGKey(9), params, cfg, dcfg)
+        mesh = make_mesh(tp=2, fsdp=2, devices=jax.devices()[:4])
+        eng = Engine(params, cfg, slots=3, max_len=128, buckets=(16, 32),
+                     mesh=mesh, chunk_steps=4, chunk_steps_max=8,
+                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3)
+        try:
+            prompts = [[3, 1, 4, 1, 5], [7, 7, 7], [42]]
+            reqs = [eng.submit(p, 10) for p in prompts]
+            for p, r in zip(prompts, reqs):
+                assert r.wait(180) and r.error is None
+                exp = np.asarray(
+                    generate(params, jnp.asarray([p], jnp.int32), cfg, 10)
+                )[0].tolist()
+                assert r.out == exp, p
+            # draft slot cache sharded over tp on the kv-head axis too
+            dk0 = eng._d_cache.k[0]
+            assert all(
+                s.data.shape[2] == dcfg.n_kv_heads // 2
+                for s in dk0.addressable_shards
+            )
+            # the AOT large speculative chunk accepts the sharded carry
+            assert eng.wait_warm(180) and eng._chunk_large is not None
+            r = eng.submit([5, 5, 5], 16)
+            assert r.wait(180) and r.error is None
+        finally:
+            eng.stop()
+
+
 class TestNorthStar8B:
     def test_8b_bf16_decode_compiles_tp8_and_fits_v5e(self):
         """The real 8b preset (bf16, S=8192 cache) AOT-compiles at tp=8 and
