@@ -1,0 +1,107 @@
+"""Runnable walkthrough: per-row speculative serving end to end.
+
+Small enough for a 1-core CPU in under a minute, but the exact pipeline
+the v5e numbers in BASELINE.md come from (there: the flagship preset,
+4000 corpus steps, 600 distill steps — 1.36x at 2 slots with the int8
+draft):
+
+1. train a tiny target on the seeded synthetic Markov corpus until its
+   conditionals are predictable (the regime speculation needs);
+2. build a draft that shares the target's embedding/head and initializes
+   from its first layer (truncated-teacher), then distill it on the
+   target's own samples;
+3. serve with ``Engine(draft_params=...)`` — the draft proposes K tokens
+   per cycle, the target verifies the whole slot batch in ONE forward,
+   and every slot advances by its own acceptance;
+4. check the contract: greedy requests emit exactly what the engine
+   produces WITHOUT the draft (speculation changes speed, never tokens).
+
+Run:
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python examples/speculative_serving.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from nanotpu.data.synthetic import ideal_ce, markov_batch, markov_table
+from nanotpu.models.distill import draft_config, init_draft, make_distill_step
+from nanotpu.models.llama import LlamaConfig, forward, init_params, loss_fn
+from nanotpu.parallel.train import make_optimizer
+from nanotpu.serving.engine import Engine
+
+
+def main() -> int:
+    cfg = LlamaConfig(
+        vocab_size=512, dim=128, n_layers=2, n_heads=8, n_kv_heads=4,
+        ffn_dim=256, max_seq_len=256, dtype="float32",
+    )
+
+    # -- 1. target learns the corpus --------------------------------------
+    import optax
+
+    table = markov_table(cfg.vocab_size, seed=11)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    opt = make_optimizer(lr=1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    key = jax.random.PRNGKey(1)
+    loss = None
+    for i in range(120):
+        key, k = jax.random.split(key)
+        tokens = markov_batch(k, table, (8, 65))
+        params, opt_state, loss = step(params, opt_state, tokens)
+    print(f"target CE {float(loss):.3f} (corpus floor ~{ideal_ce():.3f}, "
+          f"uniform {np.log(cfg.vocab_size):.3f})")
+
+    # -- 2. distill a 1-layer draft from the target -----------------------
+    dcfg = draft_config(cfg, n_layers=1, ffn_dim=cfg.ffn_dim)
+    draft = init_draft(jax.random.PRNGKey(2), params, cfg, dcfg)
+    init_opt, dstep = make_distill_step(dcfg, lr=5e-3, label_temperature=0.8)
+    d_opt = init_opt(draft)
+    for i in range(40):
+        key, k = jax.random.split(key)
+        tokens = markov_batch(k, table, (8, 33))
+        labels = forward(params, tokens[:, :-1], cfg)
+        draft, d_opt, dloss = dstep(draft, d_opt, tokens, labels)
+    print(f"distill soft-CE {float(dloss):.3f}")
+
+    # -- 3 + 4. serve speculatively; greedy rows must match plain ---------
+    prompts = [
+        np.asarray(markov_batch(jax.random.PRNGKey(40 + i), table, (8,)))
+        .tolist()
+        for i in range(3)
+    ]
+
+    def serve(draft_on):
+        kw = dict(slots=3, max_len=128, buckets=(16,))
+        if draft_on:
+            kw.update(draft_params=draft, draft_cfg=dcfg, draft_tokens=3)
+        eng = Engine(params, cfg, **kw)
+        try:
+            reqs = [eng.submit(p, 16) for p in prompts]
+            for r in reqs:
+                assert r.wait(300) and r.error is None, r.error
+            return [r.out for r in reqs]
+        finally:
+            eng.stop()
+
+    plain = serve(False)
+    spec = serve(True)
+    assert spec == plain, "speculation changed greedy tokens"
+    print("3 greedy requests: speculative == plain, token for token")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -86,3 +86,24 @@ def test_long_context_example_sp_divides_seq():
     )
     chips = size * percent // types.PERCENT_PER_CHIP
     assert chips % sp == 0
+
+
+def test_speculative_serving_example_runs():
+    """The speculative-serving walkthrough is runnable documentation:
+    train-on-corpus -> distill -> per-row speculative engine -> exact
+    greedy parity. Run it for real (tiny shapes, CPU)."""
+    import os
+    import subprocess
+    import sys
+
+    # pin the child to CPU: conftest's force only covers THIS process,
+    # and the site hook would otherwise point the child at the tunneled
+    # TPU (slow, shared, flaky — see test_multiprocess.py)
+    out = subprocess.run(
+        [sys.executable, str(EXAMPLES / "speculative_serving.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "speculative == plain" in out.stdout
