@@ -3,3 +3,9 @@ from nanotpu.data.synthetic import (  # noqa: F401
     markov_batch,
     markov_table,
 )
+from nanotpu.data.tokens import (  # noqa: F401
+    batches,
+    open_tokens,
+    sample_chunk,
+    write_tokens,
+)

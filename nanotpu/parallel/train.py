@@ -290,17 +290,26 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--save-every", type=int, default=100)
     parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--data", choices=["random", "markov"],
+    parser.add_argument("--data", choices=["random", "markov", "file"],
                         default="random",
                         help="training stream: 'random' = uniform noise "
                              "(throughput benching); 'markov' = the "
                              "seeded synthetic corpus (nanotpu.data) "
                              "whose conditionals a model can actually "
                              "learn — the regime speculative decoding "
-                             "needs")
+                             "needs; 'file' = a flat token file "
+                             "(--data-path, nanotpu.data.tokens)")
     parser.add_argument("--data-seed", type=int, default=0,
-                        help="corpus seed (--data markov); the distill "
-                             "eval rebuilds the same corpus from it")
+                        help="corpus seed (--data markov/file); the "
+                             "distill eval rebuilds a markov corpus "
+                             "from it, and file sampling is a pure "
+                             "function of (seed, batch index) so resume "
+                             "needs no loader state")
+    parser.add_argument("--data-path", default="",
+                        help="token file for --data file (uint16 ids; "
+                             "--data-dtype uint32 for vocab > 65536)")
+    parser.add_argument("--data-dtype", choices=["uint16", "uint32"],
+                        default="uint16")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(message)s")
     log = logging.getLogger("nanotpu.train")
@@ -446,8 +455,41 @@ def main(argv: list[str] | None = None) -> int:
     # per-step split+randint dispatches add host->device latency gaps
     # between steps (measured ~70 ms/step through a tunnel)
     gen_chunk = min(args.steps, max(64 // fuse * fuse, fuse))
+    if args.data == "file":
+        # FIXED chunk size, independent of --steps: the (seed, chunk
+        # index) -> batch mapping must not depend on how long any one
+        # run happens to be, or a resume with a different --steps would
+        # draw a different stream (host sampling beyond the run's needs
+        # costs microseconds)
+        gen_chunk = max(64 // fuse * fuse, fuse)
     tokens_buf, buf_base = None, -1
-    if args.data == "markov":
+    if args.data == "file":
+        import numpy as _np
+
+        from nanotpu.data.tokens import open_tokens, sample_chunk
+
+        if not args.data_path:
+            parser.error("--data file requires --data-path")
+        corpus = open_tokens(
+            args.data_path, dtype=_np.dtype(args.data_dtype)
+        )
+
+        def gen(_k, index):
+            # host-sampled rows, ONE device upload per gen_chunk steps;
+            # sampling is a pure function of (seed, ABSOLUTE chunk
+            # index) — a resumed run regenerates exactly the batches it
+            # would have seen, with no loader state in the checkpoint.
+            # Vocab bound checked per chunk (the data actually trained
+            # on), not via a full-corpus scan at startup.
+            rows = sample_chunk(
+                corpus, gen_chunk, batch, seq, args.data_seed, index
+            )
+            if int(rows.max(initial=0)) >= cfg.vocab_size:
+                raise ValueError(
+                    f"--data-path has token ids >= vocab {cfg.vocab_size}"
+                )
+            return jnp.asarray(rows)
+    elif args.data == "markov":
         from nanotpu.data.synthetic import markov_batch, markov_table
 
         # table as a jit ARGUMENT (uploaded once), never a closure —
@@ -458,21 +500,24 @@ def main(argv: list[str] | None = None) -> int:
         gen_markov = jax.jit(partial(
             markov_batch, shape=(gen_chunk, batch, seq)
         ))
-        gen = lambda k: gen_markov(k, markov_tab)  # noqa: E731
+        gen = lambda k, index: gen_markov(k, markov_tab)  # noqa: E731
     else:
-        gen = jax.jit(
+        gen_random = jax.jit(
             lambda k: jax.random.randint(
                 k, (gen_chunk, batch, seq), 0, cfg.vocab_size
             )
         )
+        gen = lambda k, index: gen_random(k)  # noqa: E731
     try:
         for i in range(start_step, start_step + args.steps, fuse):
-            j = i - start_step
-            if j // gen_chunk != buf_base:
-                buf_base = j // gen_chunk
+            # ABSOLUTE chunk indexing: a resumed file-data run picks up
+            # at the exact chunk it left off (start_step and gen_chunk
+            # are both multiples of fuse, so the offsets stay aligned)
+            if i // gen_chunk != buf_base:
+                buf_base = i // gen_chunk
                 rng, k = jax.random.split(rng)
-                tokens_buf = gen(k)
-            off = j % gen_chunk
+                tokens_buf = gen(k, buf_base)
+            off = i % gen_chunk
             tokens = (
                 tokens_buf[off] if fuse == 1
                 else tokens_buf[off:off + fuse]

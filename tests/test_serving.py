@@ -580,3 +580,43 @@ class TestMoEDropCounter:
             assert eng.moe_prefill_dropped_total == 0
         finally:
             eng.stop()
+
+
+class TestSpeculativeMoEServing:
+    def test_moe_target_dense_draft_greedy_exact(self):
+        """Speculative serving composes with a MoE target: a DENSE draft
+        (tied to the Mixtral target's embed/head) proposes, the MoE
+        target verifies at full expert capacity — greedy rows still equal
+        the plain engine's output per slot."""
+        from nanotpu.models import mixtral
+        from nanotpu.models.distill import init_draft
+        from nanotpu.models.llama import LlamaConfig
+
+        cfg = mixtral.MixtralConfig.tiny()
+        params = mixtral.init_params(jax.random.PRNGKey(0), cfg)
+        # dense draft in the target's geometry (embed/head shapes match)
+        dcfg = LlamaConfig(
+            vocab_size=cfg.vocab_size, dim=cfg.dim, n_layers=1,
+            n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads,
+            ffn_dim=cfg.ffn_dim, max_seq_len=cfg.max_seq_len,
+            dtype=cfg.dtype,
+        )
+        draft = init_draft(jax.random.PRNGKey(1), params, cfg, dcfg,
+                           truncate=False)
+        prompts = [[5, 6, 7], [9, 8], [1, 2, 3, 4, 5, 6]]
+
+        def run(with_draft):
+            kw = dict(slots=3, max_len=64, buckets=(16,))
+            if with_draft:
+                kw.update(draft_params=draft, draft_cfg=dcfg,
+                          draft_tokens=3)
+            eng = Engine(params, cfg, **kw)
+            try:
+                reqs = [eng.submit(p, 8) for p in prompts]
+                for r in reqs:
+                    assert r.wait(120) and r.error is None, r.error
+                return [r.out for r in reqs]
+            finally:
+                eng.stop()
+
+        assert run(True) == run(False)

@@ -106,3 +106,167 @@ def test_train_cli_learns_markov_but_not_noise():
     uniform = float(np.log(512))  # tiny preset vocab
     assert losses["markov"] < uniform - 1.0, losses
     assert losses["random"] > uniform - 0.5, losses
+
+
+class TestTokenFileLoader:
+    """Flat token-file dataset (nanotpu.data.tokens): memmap + stateless
+    chunk sampling, the real-corpus counterpart of the device streams."""
+
+    def _file(self, tmp_path, n=5000, vocab=512, seed=3):
+        from nanotpu.data.tokens import write_tokens
+
+        rng = np.random.default_rng(seed)
+        toks = rng.integers(0, vocab, size=n)
+        p = str(tmp_path / "corpus.bin")
+        write_tokens(p, toks, vocab_size=vocab)
+        return p, toks
+
+    def test_roundtrip_and_width(self, tmp_path):
+        from nanotpu.data.tokens import open_tokens, write_tokens
+
+        p, toks = self._file(tmp_path)
+        data = open_tokens(p)
+        assert data.dtype == np.uint16
+        np.testing.assert_array_equal(np.asarray(data), toks)
+        # large vocab -> uint32
+        p2 = str(tmp_path / "big.bin")
+        write_tokens(p2, [70000, 3], vocab_size=100_000)
+        big = open_tokens(p2, dtype=np.uint32)
+        np.testing.assert_array_equal(np.asarray(big), [70000, 3])
+
+    def test_sampling_stateless_and_in_corpus(self, tmp_path):
+        from nanotpu.data.tokens import open_tokens, sample_chunk
+
+        p, toks = self._file(tmp_path)
+        data = open_tokens(p)
+        a = sample_chunk(data, 2, 4, 33, seed=7, index=5)
+        b = sample_chunk(data, 2, 4, 33, seed=7, index=5)
+        np.testing.assert_array_equal(a, b)  # resume determinism
+        c = sample_chunk(data, 2, 4, 33, seed=7, index=6)
+        assert not np.array_equal(a, c)
+        assert a.shape == (2, 4, 33) and a.dtype == np.int32
+        # every row is a contiguous window of the corpus
+        for row in a.reshape(-1, 33):
+            starts = np.where(toks == row[0])[0]
+            found = any(
+                np.array_equal(toks[s:s + 33], row)
+                for s in starts if s + 33 <= len(toks)
+            )
+            assert found
+
+    def test_train_cli_learns_from_file(self, tmp_path):
+        """--data file: the tiny model must learn a REPETITIVE corpus
+        (loss well under uniform) — proof the file bytes actually reach
+        the optimizer."""
+        import logging
+
+        from nanotpu.data.tokens import write_tokens
+        from nanotpu.parallel.train import main
+
+        # a highly learnable corpus: a repeated 16-token phrase
+        phrase = np.arange(16) % 512
+        toks = np.tile(phrase, 800)
+        p = str(tmp_path / "phrases.bin")
+        write_tokens(p, toks, vocab_size=512)
+
+        records = []
+
+        class Grab(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        logger = logging.getLogger("nanotpu.train")
+        logger.addHandler(Grab())
+        old = logger.level
+        logger.setLevel(logging.INFO)
+        try:
+            assert main([
+                "--model", "llama", "--preset", "tiny", "--steps", "60",
+                "--batch", "8", "--seq", "64", "--data", "file",
+                "--data-path", p, "--data-seed", "5",
+            ]) == 0
+        finally:
+            logger.removeHandler(logger.handlers[-1])
+            logger.setLevel(old)
+        losses = [float(m.rsplit(" ", 1)[1]) for m in records
+                  if m.startswith("step ")]
+        assert losses[-1] < 1.0, losses[-5:]  # a cycle is near-memorizable
+
+    def test_bad_inputs_loud(self, tmp_path):
+        from nanotpu.data.tokens import (
+            open_tokens,
+            sample_chunk,
+            write_tokens,
+        )
+
+        with pytest.raises(ValueError, match="out of range"):
+            write_tokens(str(tmp_path / "x.bin"), [700], vocab_size=512)
+        p = str(tmp_path / "odd.bin")
+        open(p, "wb").write(b"\x01\x02\x03")
+        with pytest.raises(ValueError, match="whole number"):
+            open_tokens(p)
+        p2, _ = self._file(tmp_path, n=10)
+        data = open_tokens(p2)
+        with pytest.raises(ValueError, match="< seq"):
+            sample_chunk(data, 1, 1, 64, seed=0, index=0)
+
+
+    def test_train_resume_continues_the_sample_stream(self, tmp_path):
+        """Stateless resume, end to end: a checkpointed run resumed for
+        the remaining steps must consume the SAME chunk sequence a
+        single uninterrupted run does (the gen index is the absolute
+        step, not a per-run counter)."""
+        from nanotpu.data.tokens import open_tokens, sample_chunk, write_tokens
+        from nanotpu.parallel.train import main
+
+        rng = np.random.default_rng(0)
+        p = str(tmp_path / "c.bin")
+        write_tokens(p, rng.integers(0, 512, size=20000), vocab_size=512)
+
+        # the trainer's own sampling: assert chunk index advances with
+        # the absolute step by reproducing what a resumed run reads
+        data = open_tokens(p)
+        full = [sample_chunk(data, 4, 2, 17, seed=9, index=i)
+                for i in range(4)]
+        resumed = [sample_chunk(data, 4, 2, 17, seed=9, index=i)
+                   for i in range(2, 4)]
+        np.testing.assert_array_equal(full[2], resumed[0])
+        np.testing.assert_array_equal(full[3], resumed[1])
+
+        # and through the CLI: train 8 steps with a checkpoint, resume
+        # for 8 more; losses of the resumed half must equal steps 8-16 of
+        # an uninterrupted 16-step run (same params AND same data stream)
+        import logging
+
+        def run(steps, ckpt):
+            records = []
+
+            class Grab(logging.Handler):
+                def emit(self, record):
+                    records.append(record.getMessage())
+
+            logger = logging.getLogger("nanotpu.train")
+            h = Grab()
+            logger.addHandler(h)
+            old = logger.level
+            logger.setLevel(logging.INFO)
+            try:
+                assert main([
+                    "--model", "llama", "--preset", "tiny",
+                    "--steps", str(steps), "--batch", "4", "--seq", "32",
+                    "--data", "file", "--data-path", p,
+                    "--checkpoint-dir", ckpt, "--save-every", "8",
+                ]) == 0
+            finally:
+                logger.removeHandler(h)
+                logger.setLevel(old)
+            return {
+                int(m.split()[1]): m.rsplit(" ", 1)[1]
+                for m in records if m.startswith("step ")
+            }
+
+        solo = run(16, str(tmp_path / "ck_solo"))
+        run(8, str(tmp_path / "ck_split"))
+        second = run(8, str(tmp_path / "ck_split"))
+        for s in range(9, 17):
+            assert second[s] == solo[s], (s, second[s], solo[s])
