@@ -620,3 +620,29 @@ class TestSpeculativeMoEServing:
                 eng.stop()
 
         assert run(True) == run(False)
+
+
+def test_speculative_composes_with_kv_int8(tiny_model):
+    """kv_int8 target cache + speculative draft: the verify forward
+    quantizes its K+1 writes per row like any other step; greedy rows
+    must still track the plain-generate reference (a small agreement
+    slack because int8 KV noise can flip a near-tie argmax on a tiny
+    random model — currently 8/8 with these seeds)."""
+    params, cfg = tiny_model
+    draft, dcfg = TestSpeculativeServing()._draft(params, cfg)
+    eng = Engine(params, cfg, slots=2, max_len=64, buckets=(16,),
+                 kv_int8=True, draft_params=draft, draft_cfg=dcfg,
+                 draft_tokens=3)
+    try:
+        prompt = [1, 2, 3, 4]
+        r = eng.submit(prompt, 8)
+        assert r.wait(120) and r.error is None
+        exp = ref_greedy(params, cfg, prompt, 8)
+        agree = sum(a == b for a, b in zip(r.out, exp))
+        assert agree >= 6, (r.out, exp)
+        assert eng._cache.k[0].dtype == jnp.int8
+        # the draft's cache stays bf16/f32 by design (rounding error next
+        # to the target's)
+        assert eng._d_cache.k[0].dtype != jnp.int8
+    finally:
+        eng.stop()
