@@ -200,7 +200,10 @@ def run_fanout(n_hosts: int = 256, n_pods: int = 256,
             result = conn.post_raw(
                 "/scheduler/bind", bind_prefix + best.encode() + b'"}'
             )
-            assert result == b'{"Error":""}', result
+            # substring, not byte-equality: the bind succeeded iff Error
+            # is empty; key order/separators of the render are not the
+            # bench's contract (the every-32nd cross-check parses fully)
+            assert b'"Error":""' in result, result
             if i % 32 == 0:
                 _check_scan(filt, prio, best)
                 assert json.loads(result)["Error"] == ""

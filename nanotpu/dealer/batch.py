@@ -190,13 +190,14 @@ class BatchScorer:
         """Build the pre-baked JSON fragment blobs for this candidate
         order once (names repeat every scheduling cycle). Returns False
         when the native renderer is unavailable."""
-        if self._renderer is not None:
-            return self._renderer[0] == names_key or self._build_renderer(
-                names_key
-            )
-        return self._build_renderer(names_key)
+        with self._lock:
+            if self._renderer is not None and self._renderer[0] == names_key:
+                return True
+            return self._build_renderer(names_key)
 
     def _build_renderer(self, names_key: tuple[str, ...]) -> bool:
+        # caller holds self._lock: the publish of self._renderer must not
+        # race filter_payload/priorities_payload's capture of it
         if not native.available():
             return False
         n = len(names_key)
@@ -206,9 +207,8 @@ class BatchScorer:
 
         qnames = [_json.dumps(nm).encode() for nm in names_key]
         prio = [b'{"Host":%s,"Score":' % q for q in qnames]
-        fail = [
-            b'%s:"insufficient TPU capacity for demand"' % q for q in qnames
-        ]
+        reason = _json.dumps(types.REASON_NO_CAPACITY).encode()
+        fail = [b"%s:%s" % (q, reason) for q in qnames]
 
         def blob(parts):
             off = (ctypes.c_int32 * (n + 1))()
@@ -236,10 +236,10 @@ class BatchScorer:
     ) -> bytes | None:
         """The full HostPriorityList response body, scored and rendered in
         native code. None -> caller uses the list-based path."""
-        r = self._renderer
-        if r is None:
-            return None
         with self._lock:
+            r = self._renderer  # captured under lock: rebuilds can't race
+            if r is None:
+                return None
             _, score = self._run_locked(demand, prefer_used, member_slices)
             try:
                 return native.render_priorities(
@@ -254,10 +254,10 @@ class BatchScorer:
         """The full ExtenderFilterResult response body (candidates only —
         the caller handles non-pool nodes), scored and rendered in native
         code. None -> caller uses the list-based path."""
-        r = self._renderer
-        if r is None:
-            return None
         with self._lock:
+            r = self._renderer  # captured under lock: rebuilds can't race
+            if r is None:
+                return None
             feas, _ = self._run_locked(demand, prefer_used, member_slices)
             try:
                 return native.render_filter(

@@ -506,7 +506,7 @@ class Dealer:
             )
             ok = [n for n, f in zip(names_key, feasible) if f]
             failed = {
-                n: "insufficient TPU capacity for demand"
+                n: types.REASON_NO_CAPACITY
                 for n, f in zip(names_key, feasible)
                 if not f
             }
@@ -519,7 +519,7 @@ class Dealer:
                 return name, "not a TPU node"
             plan = info.assume(demand, self.rater)
             if plan is None:
-                return name, "insufficient TPU capacity for demand"
+                return name, types.REASON_NO_CAPACITY
             return name, None
 
         # Pool only when several candidates are UNKNOWN: their _node_info

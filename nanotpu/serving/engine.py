@@ -158,7 +158,15 @@ def _write_rows(cache_arr, new, offsets):
     """Write new [B, 1, ...] into cache_arr [B, T, ...] at per-row offsets
     (vmapped dynamic-slice: each slot's frontier differs — the thing the
     single-scalar KVCache cannot express). Rank-generic: serves both the
-    [T, KV, hd] value caches and the [T, KV] scale planes."""
+    [T, KV, hd] value caches and the [T, KV] scale planes.
+
+    INVARIANT (never-read-after-freeze): when a row's offset is within
+    S-1 of max_len — only possible for FROZEN rows, since active rows are
+    admitted with >= S positions of slack — dynamic_update_slice clamps
+    the start backward and this write CORRUPTS the row's still-valid
+    cache prefix. That is safe solely because frozen rows are evicted and
+    never attended again. Any future prefix-reuse / slot-resume feature
+    must mask frozen rows' writes instead of relying on the clamp."""
 
     def one(row, val, off):
         start = (off,) + (jnp.int32(0),) * (row.ndim - 1)
@@ -197,7 +205,10 @@ def _rows_forward(params, cfg, cache: "SlotCache | SlotCache8", tokens,
     each row's current frontier regardless of ``advance`` — positions
     beyond the advanced length are stale and get overwritten by the next
     write at that row's length, exactly the speculative rollback
-    semantics of nanotpu.models.speculative."""
+    semantics of nanotpu.models.speculative. Frozen rows (advance 0 via
+    the caller's active mask) still WRITE S positions at their frontier —
+    near max_len the write clamps backward over valid prefix; see the
+    never-read-after-freeze invariant on _write_rows."""
     B, S = tokens.shape
     positions = cache.lengths[:, None] + jnp.arange(S)[None, :]  # [B,S]
     cos, sin = rope_freqs(cfg, positions)

@@ -52,6 +52,12 @@ HBM_MIB_PER_CHIP = {
 #: Reference: GPUPercentEachCard = 100 (pkg/types/types.go:10).
 PERCENT_PER_CHIP = 100
 
+#: FailedNodes reason for an infeasible candidate. One constant because
+#: TWO paths emit it — the fused native render (dealer/batch.py bakes it
+#: into pre-rendered fragments) and the assume() slow path (dealer.py) —
+#: and they must stay byte-identical for response parity.
+REASON_NO_CAPACITY = "insufficient TPU capacity for demand"
+
 # --------------------------------------------------------------------------
 # Pod annotations / labels written at Bind time and consumed by the agent.
 # Reference: pkg/types/types.go:12-15.

@@ -27,3 +27,39 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import faulthandler
+import signal
+
+import pytest
+
+
+@pytest.fixture
+def watchdog():
+    """Hard wall-clock bound for tests that park threads on live sockets.
+
+    pytest-timeout is not installed in this image, so a
+    ``@pytest.mark.timeout`` would be a silent no-op (pytest.ini now makes
+    that an error). This fixture is the real mechanism: SIGALRM interrupts
+    the main thread even while it is blocked in ``Thread.join`` or a
+    socket read, dumps every thread's traceback for the post-mortem, and
+    raises — the same "signal" method pytest-timeout uses on POSIX.
+
+    Usage: ``watchdog(300)`` at the top of the test. Disarmed on teardown.
+    """
+    prev_handler = []
+
+    def arm(seconds):
+        def fire(signum, frame):
+            faulthandler.dump_traceback()
+            raise TimeoutError(
+                f"watchdog: test exceeded {seconds}s wall clock"
+            )
+
+        prev_handler.append(signal.signal(signal.SIGALRM, fire))
+        signal.alarm(seconds)
+
+    yield arm
+    signal.alarm(0)
+    if prev_handler:
+        signal.signal(signal.SIGALRM, prev_handler[0])

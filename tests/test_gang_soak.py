@@ -60,8 +60,21 @@ def _plain_pod(client, i):
     ))
 
 
-@pytest.mark.timeout(300)
-def test_config3_scale_soak_atomicity_and_latency():
+def test_watchdog_fires_on_hung_thread(watchdog):
+    """The soak's runaway guard must actually fire: park the main thread
+    in a join on a never-finishing thread (exactly how a hung gang
+    barrier would present) and require the watchdog to interrupt it."""
+    watchdog(1)
+    hang = threading.Thread(target=lambda: time.sleep(60), daemon=True)
+    hang.start()
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="watchdog"):
+        hang.join(30)
+    assert time.monotonic() - t0 < 10
+
+
+def test_config3_scale_soak_atomicity_and_latency(watchdog):
+    watchdog(300)
     # v5p-64 pool + headroom for the plain traffic: 24 hosts x 4 chips
     client = make_mock_cluster(24, 4)
     dealer = Dealer(client, make_rater("binpack"))
