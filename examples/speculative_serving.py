@@ -86,7 +86,7 @@ def main() -> int:
     def serve(draft_on):
         kw = dict(slots=3, max_len=128, buckets=(16,))
         if draft_on:
-            kw.update(draft_params=draft, draft_cfg=dcfg, draft_tokens=3)
+            kw.update(draft_params=draft, draft_cfg=dcfg, draft_tokens=3, spec_policy="always")
         eng = Engine(params, cfg, **kw)
         try:
             reqs = [eng.submit(p, 16) for p in prompts]

@@ -685,7 +685,8 @@ class Engine:
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
                  chunk_steps: int = 32, chunk_steps_max: int = 96,
                  kv_int8: bool = False, mesh=None,
-                 draft_params=None, draft_cfg=None, draft_tokens: int = 4):
+                 draft_params=None, draft_cfg=None, draft_tokens: int = 4,
+                 spec_policy="auto"):
         #: multi-chip serving (nanotpu.parallel.infer): params placed
         #: tp x fsdp, slot cache sharded tp-over-kv-heads, per-row control
         #: vectors replicated. mesh=None is the single-chip path unchanged.
@@ -743,6 +744,49 @@ class Engine:
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.draft_tokens = draft_tokens
+        #: occupancy-adaptive speculation (VERDICT r4 missing #1). The r4
+        #: v5e sweep measured the regime split: speculation pays clearly
+        #: at small batch (1.36-1.52x at B=2), regresses around B=4
+        #: (0.83-0.84x), and hovers at parity at B=8 — the plain batched
+        #: step's weight reads amortize and verify cost wins. A fixed
+        #: draft_tokens for every occupancy bakes that mistake in; the
+        #: policy picks per SYNC, from the live active-slot count:
+        #:   "auto"   -> speculate (K=draft_tokens) only at <=2 active
+        #:               rows; plain chunks above (the measured default)
+        #:   "always" -> speculate at every occupancy (the r4 behavior;
+        #:               what the exactness tests pin)
+        #:   "off"    -> plain chunks only (draft stays idle)
+        #:   [(max_active, K), ...] -> explicit rules: first rule whose
+        #:               max_active >= active rows decides K; no rule ->
+        #:               plain. K must be <= draft_tokens (admission
+        #:               slack reserves draft_tokens+1 positions).
+        #: Plain phases leave the draft cache behind the target's
+        #: frontier; on the next switch to speculation the engine
+        #: re-primes stale rows through the existing bucketed draft
+        #: prefill (one small forward per row, only on regime changes).
+        if draft_params is None or spec_policy == "off":
+            rules: list[tuple[int, int]] = []
+        elif spec_policy == "always":
+            rules = [(slots, draft_tokens)]
+        elif spec_policy == "auto":
+            rules = [(2, draft_tokens)]
+        else:
+            rules = sorted((int(m), int(k)) for m, k in spec_policy)
+            for _, k in rules:
+                if not 1 <= k <= draft_tokens:
+                    raise ValueError(
+                        f"spec_policy K={k} outside [1, draft_tokens="
+                        f"{draft_tokens}]"
+                    )
+        self.spec_rules = rules
+        #: slots whose draft-cache row trails the target (plain chunks ran
+        #: while they were active); re-primed before the next spec chunk
+        self._draft_stale: set[int] = set()
+        # speculation observability (stats()/operators): cycles run and
+        # tokens they emitted — mean tokens/cycle - 1 is the realized
+        # acceptance x K
+        self.spec_cycles_total = 0
+        self.spec_cycle_tokens_total = 0
         self._d_cache = None
         if draft_params is not None:
             if draft_cfg is None:
@@ -804,65 +848,66 @@ class Engine:
 
             cache_sh = shardings_for(mesh, slot_cache_specs(cfg, kv_int8))
             r = self._repl
+            out_sh_plain = (cache_sh, r, r, r, r, r)
             if draft_params is not None:
                 d_cache_sh = shardings_for(
                     mesh, slot_cache_specs(draft_cfg, False)
                 )
-                out_sh = (cache_sh, d_cache_sh, r, r, r, r, r, r)
+                out_sh_spec = (cache_sh, d_cache_sh, r, r, r, r, r, r)
             else:
-                out_sh = (cache_sh, r, r, r, r, r)
+                out_sh_spec = None
         else:
-            out_sh = None
+            out_sh_plain = out_sh_spec = None
+        dcfg = draft_cfg
 
-        if draft_params is None:
-            def make_chunk(n_steps):
+        # draft params ride as a jit ARGUMENT (closure-captured big
+        # trees break remote compiles over a tunneled chip)
+        def make_chunk(n_units, k: int):
+            """Compiled-chunk factory: k == 0 -> plain decode chunk of
+            ``n_units`` steps; k > 0 -> speculative chunk of ``n_units``
+            CYCLES proposing k tokens each (see the chunk_steps docstring
+            for why speculative budgets count cycles, not tokens)."""
+            if k == 0:
                 return jax.jit(
                     lambda params, cache, tokens, done, temps, rem, key:
                     serving_chunk(
                         params, cfg, cache, tokens, done, temps, rem, key,
-                        n_steps=n_steps, eos_id=self.eos_id,
+                        n_steps=n_units, eos_id=self.eos_id,
                         top_k=self.top_k, top_p=self.top_p,
                     ),
                     donate_argnums=(1,),
-                    out_shardings=out_sh,
+                    out_shardings=out_sh_plain,
                 )
-
-            #: decode steps (or speculative cycles) the compiled chunks run
-            self._chunk_units = (
-                self.chunk_steps, self.chunk_steps_max
+            return jax.jit(
+                lambda params, dparams, cache, d_cache, tokens, done,
+                temps, rem, key:
+                speculative_serving_chunk(
+                    params, dparams, cfg, dcfg, cache, d_cache, tokens,
+                    done, temps, rem, key, n_cycles=n_units,
+                    draft_tokens=k, eos_id=self.eos_id,
+                    top_k=self.top_k, top_p=self.top_p,
+                ),
+                donate_argnums=(2, 3),
+                out_shardings=out_sh_spec,
             )
-        else:
-            # chunk budgets count CYCLES here — see the chunk_steps
-            # attribute docstring for the rationale
-            n_small = self.chunk_steps
-            n_large = self.chunk_steps_max
-            dcfg = draft_cfg
 
-            # draft params ride as a jit ARGUMENT (closure-captured big
-            # trees break remote compiles over a tunneled chip)
-            def make_chunk(n_cycles):
-                return jax.jit(
-                    lambda params, dparams, cache, d_cache, tokens, done,
-                    temps, rem, key:
-                    speculative_serving_chunk(
-                        params, dparams, cfg, dcfg, cache, d_cache, tokens,
-                        done, temps, rem, key, n_cycles=n_cycles,
-                        draft_tokens=draft_tokens, eos_id=self.eos_id,
-                        top_k=self.top_k, top_p=self.top_p,
-                    ),
-                    donate_argnums=(2, 3),
-                    out_shardings=out_sh,
-                )
-
-            self._chunk_units = (n_small, n_large)
-
-        self._chunk = make_chunk(self._chunk_units[0])
-        # the large chunk compiles in the BACKGROUND (ahead-of-time, on
-        # shape structs — no second cache allocation) so its first use
+        #: decode steps / speculative cycles per compiled chunk
+        self._chunk_units = (self.chunk_steps, self.chunk_steps_max)
+        #: the K variants the policy can select, plus 0 (plain) when any
+        #: occupancy falls through the rules (or no draft at all)
+        variant_ks = sorted({k for _, k in rules})
+        if not rules or rules[-1][0] < slots:
+            variant_ks = [0] + variant_ks
+        self._variant_ks = variant_ks
+        self._chunk_small = {
+            k: make_chunk(self._chunk_units[0], k) for k in variant_ks
+        }
+        # the large chunks compile in the BACKGROUND (ahead-of-time, on
+        # shape structs — no second cache allocation) so their first use
         # never stalls the engine loop: an XLA compile is seconds on a big
         # model, and blocking _decode_cycle would freeze every active row.
-        # Until it is ready the engine simply keeps using the small chunk.
-        self._chunk_large = None
+        # Until a variant is ready the engine uses its small chunk.
+        self._chunk_large: dict[int, object] = {}
         self._chunk_large_ready = threading.Event()
 
         def compile_large():
@@ -877,15 +922,7 @@ class Engine:
                 i32 = jax.ShapeDtypeStruct(
                     (slots,), jnp.int32, sharding=self._repl
                 )
-                args = [jax.tree_util.tree_map(sds, self.params)]
-                if self.draft_params is not None:
-                    args.append(
-                        jax.tree_util.tree_map(sds, self.draft_params)
-                    )
-                args.append(jax.tree_util.tree_map(sds, self._cache))
-                if self._d_cache is not None:
-                    args.append(jax.tree_util.tree_map(sds, self._d_cache))
-                args += [
+                ctrl = [
                     i32,  # tokens
                     jax.ShapeDtypeStruct(
                         (slots,), jnp.bool_, sharding=self._repl
@@ -896,18 +933,37 @@ class Engine:
                     i32,  # remaining
                     sds(self._d_key),  # key
                 ]
-                compiled = make_chunk(self._chunk_units[1]).lower(
-                    *args
-                ).compile()
-                self._chunk_large = compiled
+                p_sds = jax.tree_util.tree_map(sds, self.params)
+                c_sds = jax.tree_util.tree_map(sds, self._cache)
+                for k in self._variant_ks:
+                    args = [p_sds]
+                    if k > 0:
+                        args.append(
+                            jax.tree_util.tree_map(sds, self.draft_params)
+                        )
+                    args.append(c_sds)
+                    if k > 0:
+                        args.append(
+                            jax.tree_util.tree_map(sds, self._d_cache)
+                        )
+                    args += ctrl
+                    self._chunk_large[k] = make_chunk(
+                        self._chunk_units[1], k
+                    ).lower(*args).compile()
+                if self.draft_params is not None and rules:
+                    # warm every draft-prefill bucket shape: a re-prime
+                    # can hit a bucket admission never used (context
+                    # grows mid-request past the prompt's bucket), and a
+                    # synchronous jit compile inside the engine loop
+                    # would stall every active row for seconds
+                    for b in self.buckets:
+                        self._prefill_draft(
+                            self.draft_params, jnp.zeros((1, b), jnp.int32)
+                        )
             except Exception:
                 log.exception("large-chunk compile failed; small chunk only")
             finally:
                 self._chunk_large_ready.set()
-
-        threading.Thread(
-            target=compile_large, daemon=True, name="chunk-compile"
-        ).start()
         self._insert = jax.jit(
             insert_request, donate_argnums=(0,),
             out_shardings=(cache_sh if mesh is not None else None),
@@ -933,6 +989,11 @@ class Engine:
                     d_cache_sh if mesh is not None else None
                 ),
             )
+        # started HERE, not where compile_large is defined: the warm loop
+        # inside it reads self._prefill_draft, which must exist first
+        threading.Thread(
+            target=compile_large, daemon=True, name="chunk-compile"
+        ).start()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="serving-engine"
         )
@@ -1005,6 +1066,16 @@ class Engine:
             "ttft_p50_ms": pct(ttft, 0.5) and round(pct(ttft, 0.5) * 1e3, 2),
             "ttft_p99_ms": pct(ttft, 0.99) and round(pct(ttft, 0.99) * 1e3, 2),
             "latency_p50_ms": pct(lat, 0.5) and round(pct(lat, 0.5) * 1e3, 2),
+            # speculation observability: mean emitted tokens per
+            # speculative cycle (1 + realized acceptance x K); None until
+            # a speculative chunk has run
+            "spec_cycles_total": self.spec_cycles_total,
+            "spec_tokens_per_cycle": (
+                round(
+                    self.spec_cycle_tokens_total / self.spec_cycles_total, 3
+                )
+                if self.spec_cycles_total else None
+            ),
         }
 
     # -- engine loop -------------------------------------------------------
@@ -1067,12 +1138,24 @@ class Engine:
             self._cache = self._insert(self._cache, ks, vs, jnp.int32(slot),
                                        jnp.int32(S))
             if self._d_cache is not None:
-                dks, dvs = self._prefill_draft(
-                    self.draft_params, jnp.asarray(padded)
-                )
-                self._d_cache = self._insert_d(
-                    self._d_cache, dks, dvs, jnp.int32(slot), jnp.int32(S)
-                )
+                # prime the draft row only when the post-admission
+                # occupancy could speculate; plain-regime admissions skip
+                # the draft forward (the row would go stale after the
+                # very next plain chunk) and regime entry re-primes it
+                occ_after = sum(
+                    1 for r in self._slot_req if r is not None
+                ) + len(admitted) + 1
+                if self._policy_k(occ_after) > 0:
+                    dks, dvs = self._prefill_draft(
+                        self.draft_params, jnp.asarray(padded)
+                    )
+                    self._d_cache = self._insert_d(
+                        self._d_cache, dks, dvs, jnp.int32(slot),
+                        jnp.int32(S)
+                    )
+                    self._draft_stale.discard(slot)  # freshly primed
+                else:
+                    self._draft_stale.add(slot)
             admitted.append((req, slot, first, drops))
         if not admitted:
             return
@@ -1104,6 +1187,43 @@ class Engine:
             self._remaining[slot] = req.max_new_tokens - 1  # first already out
             self._dirty = True
 
+    def _policy_k(self, n_active: int) -> int:
+        """Speculation depth for a chunk at ``n_active`` occupied slots:
+        the first rule covering the count decides; none -> 0 (plain)."""
+        for max_active, rule_k in self.spec_rules:
+            if n_active <= max_active:
+                return rule_k
+        return 0
+
+    def _reprime_draft(self) -> None:
+        """Catch stale draft-cache rows up to the target's frontier.
+
+        A plain-chunk phase advances only the target cache; before the
+        next speculative chunk each surviving row's draft cache must hold
+        k/v for the same context. The full token sequence is on the host
+        (prompt + emitted), so this is exactly the admission-time draft
+        prefill re-run at the row's current length: one bucketed draft
+        forward + insert per stale row, dispatched async, only when the
+        policy switches regimes. Numeric wobble between a prefilled and
+        an incrementally-built draft row only perturbs PROPOSALS — never
+        emitted tokens, which acceptance pins to the target."""
+        for i in sorted(self._draft_stale):
+            self._draft_stale.discard(i)
+            req = self._slot_req[i]
+            if req is None or self._done[i]:
+                continue
+            seq = req.prompt + req.out
+            t_len = len(seq) - 1  # the last token is the next input
+            bucket = self._bucket(t_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :t_len] = seq[:t_len]
+            dks, dvs = self._prefill_draft(
+                self.draft_params, jnp.asarray(padded)
+            )
+            self._d_cache = self._insert_d(
+                self._d_cache, dks, dvs, jnp.int32(i), jnp.int32(t_len)
+            )
+
     def _decode_cycle(self) -> None:
         """One chunk of decode steps, then host-side bookkeeping.
 
@@ -1131,10 +1251,18 @@ class Engine:
         # requests waiting -> small chunk (free slots turn over quickly).
         with self._cv:
             queued = bool(self._queue)
-        chunk = self._chunk
-        if not queued and self._chunk_large is not None:
-            chunk = self._chunk_large
-        if self.draft_params is not None:
+        # occupancy-adaptive speculation: the first rule covering the live
+        # active-slot count decides K for THIS chunk; no rule -> plain.
+        # Selection happens only here, at a sync boundary, so a request
+        # can cross regimes mid-stream (the invariance test pins that
+        # greedy outputs don't notice).
+        k = self._policy_k(sum(r is not None for r in self._slot_req))
+        if k > 0 and self._draft_stale:
+            self._reprime_draft()
+        chunk = self._chunk_small[k]
+        if not queued:
+            chunk = self._chunk_large.get(k, chunk)
+        if k > 0:
             (
                 self._cache, self._d_cache, self._d_tokens, self._d_done,
                 self._d_remaining, self._d_key, emits, counts,
@@ -1145,6 +1273,8 @@ class Engine:
             )
             emits = np.asarray(emits)    # [n_cycles, SLOTS, K+1]
             counts = np.asarray(counts)  # [n_cycles, SLOTS]
+            self.spec_cycles_total += int((counts > 0).sum())
+            self.spec_cycle_tokens_total += int(counts.sum())
             # flatten each row's valid tokens into the serving_chunk
             # [n_steps, SLOTS] layout the shared replay below consumes;
             # short rows pad by repeating their last token with count 0
@@ -1159,6 +1289,12 @@ class Engine:
                 self._d_temps, self._d_remaining, self._d_key,
             )
             toks = np.asarray(toks)  # [n_steps, SLOTS]; the one host sync
+            if self.spec_rules:
+                # a plain chunk advanced the target cache but not the
+                # draft's: these rows need a re-prime before speculating
+                self._draft_stale.update(
+                    i for i, r in enumerate(self._slot_req) if r is not None
+                )
         now = time.perf_counter()
 
         def row_tokens(i):
@@ -1201,6 +1337,7 @@ class Engine:
                     self.latency_samples.append(req.latency_s)
                 self._slot_req[i] = None
                 self._temps[i] = 0.0
+                self._draft_stale.discard(i)  # evicted; nothing to re-prime
                 # device `done` is already True for this row — eviction
                 # alone doesn't require a re-upload
             else:

@@ -450,7 +450,7 @@ class TestSpeculativeServing:
         draft, dcfg = self._draft(params, cfg)
         eng = Engine(params, cfg, slots=4, max_len=128,
                      buckets=(16, 32, 64),
-                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3)
+                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3, spec_policy="always")
         try:
             prompts = [
                 [1, 2, 3],
@@ -475,7 +475,7 @@ class TestSpeculativeServing:
         params, cfg = tiny_model
         dcfg = dataclasses.replace(cfg)
         eng = Engine(params, cfg, slots=3, max_len=128, buckets=(16, 32),
-                     draft_params=params, draft_cfg=dcfg, draft_tokens=4)
+                     draft_params=params, draft_cfg=dcfg, draft_tokens=4, spec_policy="always")
         try:
             prompts = [[3, 1, 4], [2, 7, 1, 8, 2, 8], [9]]
             reqs = [eng.submit(p, 11) for p in prompts]
@@ -493,7 +493,7 @@ class TestSpeculativeServing:
         params, cfg = tiny_model
         draft, dcfg = self._draft(params, cfg)
         eng = Engine(params, cfg, slots=3, max_len=128, buckets=(16, 32),
-                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3,
+                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3, spec_policy="always",
                      seed=5)
         try:
             sampled = [eng.submit([4, 2], 13, temperature=0.9)
@@ -517,7 +517,7 @@ class TestSpeculativeServing:
         eos = ref[7]  # force an eos mid-stream
         eng = Engine(params, cfg, slots=2, max_len=128, buckets=(16,),
                      eos_id=eos, draft_params=draft, draft_cfg=dcfg,
-                     draft_tokens=3)
+                     draft_tokens=3, spec_policy="always")
         try:
             stopped = eng.submit([6, 6, 6], 24)
             other_prompt = [1, 2, 3, 4]
@@ -609,7 +609,7 @@ class TestSpeculativeMoEServing:
             kw = dict(slots=3, max_len=64, buckets=(16,))
             if with_draft:
                 kw.update(draft_params=draft, draft_cfg=dcfg,
-                          draft_tokens=3)
+                          draft_tokens=3, spec_policy="always")
             eng = Engine(params, cfg, **kw)
             try:
                 reqs = [eng.submit(p, 8) for p in prompts]
@@ -632,7 +632,7 @@ def test_speculative_composes_with_kv_int8(tiny_model):
     draft, dcfg = TestSpeculativeServing()._draft(params, cfg)
     eng = Engine(params, cfg, slots=2, max_len=64, buckets=(16,),
                  kv_int8=True, draft_params=draft, draft_cfg=dcfg,
-                 draft_tokens=3)
+                 draft_tokens=3, spec_policy="always")
     try:
         prompt = [1, 2, 3, 4]
         r = eng.submit(prompt, 8)
@@ -646,3 +646,104 @@ def test_speculative_composes_with_kv_int8(tiny_model):
         assert eng._d_cache.k[0].dtype != jnp.int8
     finally:
         eng.stop()
+
+
+class TestAdaptiveSpeculation:
+    """Occupancy-adaptive speculation policy (VERDICT r4 missing #1): the
+    engine picks plain vs speculative chunks — and K — per sync from the
+    live active-slot count, re-priming stale draft rows on regime entry."""
+
+    def _draft(self, params, cfg, n_layers=1):
+        import dataclasses
+
+        from nanotpu.models.distill import init_draft
+
+        dcfg = dataclasses.replace(cfg, n_layers=n_layers)
+        return init_draft(jax.random.PRNGKey(9), params, cfg, dcfg), dcfg
+
+    def test_policy_k_selection(self, tiny_model):
+        params, cfg = tiny_model
+        draft, dcfg = self._draft(params, cfg)
+        eng = Engine(params, cfg, slots=8, max_len=128, buckets=(16,),
+                     draft_params=draft, draft_cfg=dcfg, draft_tokens=4,
+                     spec_policy=[(2, 4), (6, 2)])
+        try:
+            assert [eng._policy_k(n) for n in (1, 2, 3, 6, 7, 8)] == \
+                [4, 4, 2, 2, 0, 0]
+            assert sorted(eng._chunk_small) == [0, 2, 4]
+        finally:
+            eng.stop()
+
+    def test_auto_default_speculates_only_at_small_batch(self, tiny_model):
+        params, cfg = tiny_model
+        draft, dcfg = self._draft(params, cfg)
+        eng = Engine(params, cfg, slots=4, max_len=128, buckets=(16,),
+                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3)
+        try:
+            assert eng.spec_rules == [(2, 3)]
+            assert eng._policy_k(1) == 3
+            assert eng._policy_k(2) == 3
+            assert eng._policy_k(3) == 0
+            assert sorted(eng._chunk_small) == [0, 3]
+        finally:
+            eng.stop()
+
+    def test_bad_policy_k_rejected(self, tiny_model):
+        params, cfg = tiny_model
+        draft, dcfg = self._draft(params, cfg)
+        with pytest.raises(ValueError, match="draft_tokens"):
+            Engine(params, cfg, slots=2, max_len=128, buckets=(16,),
+                   draft_params=draft, draft_cfg=dcfg, draft_tokens=2,
+                   spec_policy=[(2, 5)])
+
+    def test_greedy_invariant_across_policy_switch(self, tiny_model):
+        """The load-bearing exactness claim: a request that starts under
+        plain chunks (2 active > max_active=1), loses its neighbor, and
+        finishes under speculative chunks — crossing the re-prime path —
+        emits exactly its solo greedy sequence. Both regimes and the
+        re-prime are asserted to have actually run."""
+        params, cfg = tiny_model
+        draft, dcfg = self._draft(params, cfg)
+        eng = Engine(params, cfg, slots=2, max_len=128, buckets=(16, 32),
+                     chunk_steps=4, chunk_steps_max=8,
+                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3,
+                     spec_policy=[(1, 3)])
+        reprimes = []
+        orig = eng._reprime_draft
+
+        def spy():
+            reprimes.append(sorted(eng._draft_stale))
+            orig()
+
+        eng._reprime_draft = spy
+        try:
+            long_req = eng.submit([5, 3, 1], 40)      # crosses the switch
+            short_req = eng.submit([2, 7, 1, 8], 6)   # holds slot 2 briefly
+            assert short_req.wait(120) and short_req.error is None
+            assert long_req.wait(120) and long_req.error is None
+            assert short_req.out == ref_greedy(params, cfg, [2, 7, 1, 8], 6)
+            assert long_req.out == ref_greedy(params, cfg, [5, 3, 1], 40)
+            assert eng.spec_cycles_total > 0, "speculative regime never ran"
+            assert reprimes, "re-prime path never exercised"
+        finally:
+            eng.stop()
+
+    def test_switch_with_kv_int8_target(self, tiny_model):
+        """Adaptive switching composes with the int8 KV cache: the plain
+        and speculative chunks share one quantized target cache."""
+        params, cfg = tiny_model
+        draft, dcfg = self._draft(params, cfg)
+        eng = Engine(params, cfg, slots=2, max_len=64, buckets=(16,),
+                     chunk_steps=4, chunk_steps_max=4, kv_int8=True,
+                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3,
+                     spec_policy=[(1, 3)])
+        try:
+            a = eng.submit([1, 2, 3, 4], 24)
+            b = eng.submit([9, 8], 5)
+            assert b.wait(120) and b.error is None
+            assert a.wait(120) and a.error is None
+            assert len(a.out) == 24
+            assert all(0 <= t < cfg.vocab_size for t in a.out)
+            assert eng.spec_cycles_total > 0
+        finally:
+            eng.stop()

@@ -204,7 +204,7 @@ class TestShardedSpeculativeEngine:
         mesh = make_mesh(tp=2, fsdp=2, devices=jax.devices()[:4])
         eng = Engine(params, cfg, slots=3, max_len=128, buckets=(16, 32),
                      mesh=mesh, chunk_steps=4, chunk_steps_max=8,
-                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3)
+                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3, spec_policy="always")
         try:
             prompts = [[3, 1, 4, 1, 5], [7, 7, 7], [42]]
             reqs = [eng.submit(p, 10) for p in prompts]
