@@ -69,23 +69,10 @@ LSE_SUBLANES = 8
 
 def _xla_attention(q, k, v, causal: bool):
     """Reference dense path (XLA fuses + tiles this fine for moderate S).
-    Accepts GQA k/v ([B, S, KV, D], KV | H) like the kernel path."""
-    B, S, H, D = q.shape
-    if k.shape[2] != H:
-        if H % k.shape[2]:
-            raise ValueError(
-                f"n_kv_heads {k.shape[2]} must divide n_heads {H}"
-            )
-        rep = H // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    scale = 1.0 / math.sqrt(D)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-        logits = jnp.where(mask[None, None], logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    Accepts GQA k/v ([B, S, KV, D], KV | H) like the kernel path.
+    Delegates to the (out, lse) variant — ONE dense reference to
+    maintain; XLA drops the unused lse."""
+    return _xla_attention_lse(q, k, v, causal)[0]
 
 
 def _kv_of(b, H: int, KV: int):
@@ -176,6 +163,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int,
         lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :], lse_ref.shape[1:])
 
 
+def _vma_kw(*arrays) -> dict:
+    """Inside a shard_map manual region (ring attention) a pallas_call's
+    output structs must carry the inputs' varying-mesh-axes type or
+    check_vma rejects the call; at top level vma is empty and the plain
+    struct is unchanged."""
+    try:
+        vma = frozenset().union(*(jax.typeof(a).vma for a in arrays))
+        return {"vma": vma} if vma else {}
+    except AttributeError:
+        return {}
+
+
 def _blocks_for(S: int, block_q: int, block_k: int) -> tuple[int, int, int]:
     """Tile-aligned block clamp + padded length (shared by fwd and bwd so
     residual layouts always agree).
@@ -238,14 +237,17 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, seq_len=S, causal=causal, scale=scale
     )
+    vma_kw = _vma_kw(q, k, v)
     out_specs = [pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0))]
-    out_shape = [jax.ShapeDtypeStruct((B * H, S_pad, D), q.dtype)]
+    out_shape = [jax.ShapeDtypeStruct((B * H, S_pad, D), q.dtype, **vma_kw)]
     if need_lse:
         out_specs.append(
             pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda b, i: (b, 0, i))
         )
         out_shape.append(
-            jax.ShapeDtypeStruct((B * H, LSE_SUBLANES, S_pad), jnp.float32)
+            jax.ShapeDtypeStruct(
+                (B * H, LSE_SUBLANES, S_pad), jnp.float32, **vma_kw
+            )
         )
     result = pl.pallas_call(
         kernel,
@@ -454,7 +456,7 @@ FUSED_BWD_MAX_S = max(
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
-                    interpret):
+                    interpret, g_lse=None):
     """Pallas backward: returns (dq, dk, dv) shaped like q/k/v — for GQA
     inputs (k/v at KV < H heads) the kernels still READ the unexpanded
     buffers via the _kv_of index maps, while dk/dv are produced at q-head
@@ -467,6 +469,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
     B, S, H, D = q.shape
     KV = k.shape[2]
     scale = 1.0 / math.sqrt(D)
+    vma_kw = _vma_kw(q, k, v, g)
     block_q, block_k, S_pad = _blocks_for(S, block_q, block_k)
     if S_pad != S:
         pad = [(0, 0), (0, S_pad - S), (0, 0), (0, 0)]
@@ -479,6 +482,15 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
     # D_i = rowsum(dO * O): tiny elementwise reduce, no reason for a kernel;
     # broadcast over sublanes like lse (Mosaic block-tiling, LSE_SUBLANES)
     dvec = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        # lse as a differentiated OUTPUT (flash_attention_lse): its row
+        # cotangent enters every ds identically to -D_i, since
+        # d lse_i / d s_ij = p_ij gives ds = p*(dp - D + g_lse). Folding
+        # it here reuses all three backward kernels unchanged.
+        g_lse_f = g_lse.astype(jnp.float32).reshape(B * H, S)
+        if S_pad != S:
+            g_lse_f = jnp.pad(g_lse_f, [(0, 0), (0, S_pad - S)])
+        dvec = dvec - g_lse_f
     dvec = jnp.broadcast_to(
         dvec[:, None, :], (B * H, LSE_SUBLANES, S_pad)
     )
@@ -507,9 +519,9 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
             in_specs=[rowf, rowf_kv, rowf_kv, rowf, row1f, row1f],
             out_specs=[rowf, rowf, rowf],
             out_shape=[
-                jax.ShapeDtypeStruct((B * H, S_pad, D), jnp.float32),
-                jax.ShapeDtypeStruct((B * H, S_pad, D), k.dtype),
-                jax.ShapeDtypeStruct((B * H, S_pad, D), v.dtype),
+                jax.ShapeDtypeStruct((B * H, S_pad, D), jnp.float32, **vma_kw),
+                jax.ShapeDtypeStruct((B * H, S_pad, D), k.dtype, **vma_kw),
+                jax.ShapeDtypeStruct((B * H, S_pad, D), v.dtype, **vma_kw),
             ],
             interpret=interpret,
         )(qf, kf, vf, gf, lse, dvec)
@@ -539,7 +551,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
         grid=(B * H, S_pad // block_q),
         in_specs=[qblk, row_kv, row_kv, qblk, qblk1, qblk1],
         out_specs=qblk,
-        out_shape=jax.ShapeDtypeStruct((B * H, S_pad, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B * H, S_pad, D), q.dtype, **vma_kw),
         interpret=interpret,
     )(qf, kf, vf, gf, lse, dvec)
 
@@ -552,8 +564,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
         in_specs=[row, kblk_kv, kblk_kv, row, row1, row1],
         out_specs=[kblk, kblk],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, S_pad, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, S_pad, D), v.dtype),
+            jax.ShapeDtypeStruct((B * H, S_pad, D), k.dtype, **vma_kw),
+            jax.ShapeDtypeStruct((B * H, S_pad, D), v.dtype, **vma_kw),
         ],
         interpret=interpret,
     )(qf, kf, vf, gf, lse, dvec)
@@ -625,3 +637,93 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# -- flash attention with the log-sum-exp as a differentiated output --------
+#
+# Ring attention (nanotpu.parallel.ring_attention) merges per-block partial
+# attentions with LSE weighting, so each block attend must RETURN its lse —
+# and gradients must flow through it (the merge weights depend on it). The
+# pair (out, lse) is a complete online-softmax merge state: merging two
+# blocks is out = c1*out1 + c2*out2 with c_i = exp(lse_i - logaddexp), the
+# same math the forward kernel's running (m, l) carries express.
+
+
+def _xla_attention_lse(q, k, v, causal: bool):
+    """Dense reference returning (out [B,S,H,D], lse [B,H,S] f32)."""
+    B, S, H, D = q.shape
+    if k.shape[2] != H:
+        if H % k.shape[2]:
+            raise ValueError(
+                f"n_kv_heads {k.shape[2]} must divide n_heads {H}"
+            )
+        rep = H // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)
+    m_safe = jnp.where(m == NEG_INF, 0.0, m)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(logits == NEG_INF, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", (p / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype), v
+    )
+    lse = jnp.where(l > 0.0, m_safe + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_lse(
+    q, k, v, causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool | None = None,
+):
+    """:func:`flash_attention` that also returns the per-row log-sum-exp.
+
+    Returns (out [B, S, H, D], lse [B, H, S] f32); fully-masked rows hold
+    the NEG_INF sentinel. Same GQA contract as flash_attention (k/v at
+    KV | H heads, read unexpanded). The lse output is differentiable —
+    its cotangent folds into the backward's D vector (ds picks up
+    ``+ p * g_lse``), so all three backward kernels serve unchanged."""
+    if _use_pallas(interpret):
+        out, lse_store = _flash_forward(
+            q, k, v, causal, block_q, block_k, bool(interpret), need_lse=True
+        )
+        B, S, H, _ = q.shape
+        lse = lse_store[:, 0, :S].reshape(B, H, S)
+        return out, lse
+    return _xla_attention_lse(q, k, v, causal)
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    if _use_pallas(interpret):
+        out, lse_store = _flash_forward(
+            q, k, v, causal, block_q, block_k, bool(interpret), need_lse=True
+        )
+        B, S, H, _ = q.shape
+        lse = lse_store[:, 0, :S].reshape(B, H, S)
+        return (out, lse), (q, k, v, out, lse_store)
+    out, lse = _xla_attention_lse(q, k, v, causal)
+    return (out, lse), (q, k, v, None, None)
+
+
+def _flash_lse_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v, out, lse_store = residuals
+    g_out, g_lse = g
+    if lse_store is not None:
+        return _flash_backward(
+            q, k, v, out, lse_store, g_out, causal, block_q, block_k,
+            bool(interpret), g_lse=g_lse,
+        )
+    _, vjp = jax.vjp(
+        lambda q, k, v: _xla_attention_lse(q, k, v, causal), q, k, v
+    )
+    return vjp((g_out, g_lse))
+
+
+flash_attention_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)

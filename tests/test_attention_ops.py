@@ -256,3 +256,112 @@ class TestGqaNativeKernel:
             flash_attention(q, kv, kv, True, 32, 32, True)
         with pytest.raises(ValueError, match="must divide"):
             _xla_attention(q, kv, kv, True)
+
+
+class TestFlashAttentionLse:
+    """flash_attention_lse: the (out, lse) contract ring attention merges
+    on, including gradients THROUGH the lse output (its cotangent folds
+    into the backward's D vector — the one new term vs flash_attention)."""
+
+    def test_lse_matches_dense(self):
+        from nanotpu.ops.attention import _xla_attention_lse, flash_attention_lse
+
+        q, k, v = qkv(jax.random.PRNGKey(11), B=1, S=96, H=2, D=32)
+        ref_o, ref_lse = _xla_attention_lse(q, k, v, True)
+        out, lse = flash_attention_lse(q, k, v, True, 64, 64, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_o), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=2e-5)
+
+    def test_lse_cotangent_reaches_qkv(self):
+        """A loss that reads ONLY the lse output must produce the same
+        q/k/v grads through the kernel backward as through the dense
+        path — this exercises the g_lse -> D-vector fold in isolation."""
+        from nanotpu.ops.attention import _xla_attention_lse, flash_attention_lse
+
+        q, k, v = qkv(jax.random.PRNGKey(12), B=1, S=64, H=2, D=32)
+
+        def loss_kernel(q, k, v):
+            out, lse = flash_attention_lse(q, k, v, True, 64, 64, True)
+            return (lse ** 2).sum() + (out ** 2).sum()
+
+        def loss_dense(q, k, v):
+            out, lse = _xla_attention_lse(q, k, v, True)
+            return (lse ** 2).sum() + (out ** 2).sum()
+
+        g = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+
+class TestRingFlash:
+    """The flash-kernel inner attend inside ring attention (VERDICT r4
+    missing #2): outputs and gradients must match the dense ring path in
+    every regime the lax.switch selects (past/self/future blocks)."""
+
+    def _gqa_qkv(self, key, B=1, S=128, H=4, KV=2, D=32):
+        ks = jax.random.split(key, 3)
+        q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32) * 0.3
+        k = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32) * 0.3
+        v = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32) * 0.3
+        return q, k, v
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_flash_matches_dense_ring(self, causal):
+        mesh = make_mesh(sp=4, devices=jax.devices()[:4])
+        q, k, v = self._gqa_qkv(jax.random.PRNGKey(21))
+        dense = ring_attention_sharded(q, k, v, mesh, causal=causal,
+                                       impl="dense")
+        flash = ring_attention_sharded(q, k, v, mesh, causal=causal,
+                                       impl="flash")
+        np.testing.assert_allclose(
+            np.asarray(flash), np.asarray(dense), atol=2e-5
+        )
+
+    def test_flash_grads_match_dense_ring(self):
+        mesh = make_mesh(sp=4, devices=jax.devices()[:4])
+        q, k, v = self._gqa_qkv(jax.random.PRNGKey(22))
+
+        def loss(impl):
+            def f(q, k, v):
+                return (ring_attention_sharded(
+                    q, k, v, mesh, causal=True, impl=impl) ** 2).sum()
+            return jax.grad(f, argnums=(0, 1, 2))
+
+        g_f = loss("flash")(q, k, v)
+        g_d = loss("dense")(q, k, v)
+        for a, b in zip(g_f, g_d):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+    def test_interpret_kernels_in_ring(self):
+        """The actual Pallas kernels (interpreter mode) inside the ring:
+        needs a fully-manual sp-only mesh with check_vma off (the HLO
+        interpreter rejects vma-typed avals; the compiled TPU path keeps
+        the checker on and is exercised by the single-chip microbench)."""
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("sp",))
+        q, k, v = self._gqa_qkv(jax.random.PRNGKey(23), S=256)
+        dense = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                       impl="dense", check_vma=False)
+        flash = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                       impl="flash", interpret=True,
+                                       check_vma=False)
+        np.testing.assert_allclose(
+            np.asarray(flash), np.asarray(dense), atol=2e-5
+        )
+
+        def loss(q, k, v):
+            return (ring_attention_sharded(
+                q, k, v, mesh, causal=True, impl="flash", interpret=True,
+                check_vma=False) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (ring_attention_sharded(
+                q, k, v, mesh, causal=True, impl="dense",
+                check_vma=False) ** 2).sum()
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
