@@ -107,12 +107,13 @@ def ring_attention(
     reference). On non-TPU backends the flash path transparently uses
     the dense-XLA (out, lse) fallback inside flash_attention_lse unless
     ``interpret=True`` forces the kernels in interpreter mode."""
+    if impl not in ("flash", "dense"):
+        raise ValueError(f"unknown ring attention impl: {impl!r}")
     B, Sblk, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
     n = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
 
-    causal_mask = jnp.tril(jnp.ones((Sblk, Sblk), jnp.bool_))
     perm = [(i, (i + 1) % n) for i in range(n)]  # send k/v to the next rank
 
     from nanotpu.ops.attention import flash_attention_lse
@@ -121,6 +122,7 @@ def ring_attention(
         """(out, lse) of q against one visiting block."""
         if impl == "dense":
             if causal:
+                causal_mask = jnp.tril(jnp.ones((Sblk, Sblk), jnp.bool_))
                 mask = (src < rank) | ((src == rank) & causal_mask)
             else:
                 mask = None

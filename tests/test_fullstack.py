@@ -1,0 +1,266 @@
+"""One-thread full-stack certification (VERDICT r4 ask #4): the north
+star's "schedule and bind a multi-pod JAX job with no GPU in the loop"
+loop, driven end to end at the repo's own abstraction boundaries.
+
+Chain under test — every link consumes the PREVIOUS link's real output,
+so the test fails if any contract drifts:
+
+  1. mock cluster -> strict-gang Filter/Prioritize/Bind over LIVE HTTP
+     (the kube-scheduler extender wire contract, README.md:44-57 of the
+     reference);
+  2. per-node agents watch the SAME clientset and ingest the bind
+     annotations (``tpu.io/container-<name>``) into their backlogs;
+  3. a kubelet-shaped ``Allocate`` over real gRPC unix sockets returns
+     container envs — ``TPU_VISIBLE_CHIPS`` must be the exact chips the
+     scheduler chose (annotation wins over the slot ids kubelet offered);
+  4. the Indexed-Job env contract (COORDINATOR_SERVICE / GANG_SIZE /
+     JOB_COMPLETION_INDEX, examples/llama3-8b-v5p16.yaml) is derived
+     from the pod's OWN gang annotations plus the agent's Allocate envs;
+  5. both "containers" launch as real OS processes, join one
+     jax.distributed cluster from that env, and run a data-parallel
+     train step together (CPU backend — the chain, not the chip, is
+     under test).
+
+The reference outsources links 2-3 to its out-of-repo companion agent
+(/root/reference/README.md:30-34) and has no harness that can run links
+1-5 in one thread; each link here is individually covered by
+test_http_extender / test_agent / test_multiprocess, and this test pins
+the chain.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import grpc
+import pytest
+
+from nanotpu import types
+from nanotpu.agent import deviceplugin_v1beta1_pb2 as pb
+from nanotpu.agent.agent import NodeAgent
+from nanotpu.agent.deviceplugin_grpc import DevicePluginStub
+from nanotpu.agent.discovery import HostTopology
+from nanotpu.agent.plugin import device_id
+from nanotpu.k8s.client import FakeClientset
+from nanotpu.k8s.objects import make_container, make_pod
+from nanotpu.utils import pod as podutil
+
+from harness import Extender, v5p_node
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GANG = "llama-train"
+N_PODS = 2
+CHIPS_PER_POD = 4  # whole v5p host each
+
+CHILD = r"""
+import os, sys
+
+# Link 4/5: the pod container boots from the agent-provided env alone.
+chips = os.environ["TPU_VISIBLE_CHIPS"]
+assert os.environ["NANOTPU_ALLOC_SOURCE"].startswith("annotation:"), (
+    "agent fell back to kubelet slots; scheduler's placement was dropped"
+)
+assert os.environ["NANOTPU_CHIP_PERCENT"] == "400"
+
+from nanotpu.parallel import distributed
+
+info = distributed.process_info_from_env()
+assert info is not None, "Indexed-Job gang env not detected"
+assert info.num_processes == 2
+assert distributed.initialize(info) is True
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+assert jax.process_count() == 2
+
+from jax.sharding import NamedSharding
+from nanotpu.models.llama import LlamaConfig
+from nanotpu.parallel import train as train_lib
+from nanotpu.parallel.mesh import BATCH_SPEC, make_mesh
+
+cfg = LlamaConfig(
+    vocab_size=128, dim=32, n_layers=1, n_heads=2, n_kv_heads=1,
+    ffn_dim=64, max_seq_len=64, dtype="float32",
+)
+mesh = make_mesh(dp=2)
+opt = train_lib.make_optimizer()
+state = train_lib.init_train_state(jax.random.PRNGKey(0), cfg, opt)
+state = train_lib.place_state(state, cfg, mesh)
+step = train_lib.build_train_step(cfg, mesh, opt)
+
+sharding = NamedSharding(mesh, BATCH_SPEC)
+local = (np.arange(33, dtype=np.int32)[None, :] + jax.process_index()) % 128
+tokens = jax.make_array_from_process_local_data(sharding, local, (2, 33))
+state, loss = step(state, tokens)
+loss.block_until_ready()
+assert jnp.isfinite(loss)
+print(f"FULLSTACK rank={info.process_id} chips={chips} "
+      f"loss={float(loss):.6f}", flush=True)
+"""
+
+
+def _gang_pod(i):
+    return make_pod(
+        f"worker-{i}",
+        containers=[
+            make_container("train", {types.RESOURCE_TPU_PERCENT: 400})
+        ],
+        annotations={
+            types.ANNOTATION_GANG_NAME: GANG,
+            types.ANNOTATION_GANG_SIZE: str(N_PODS),
+            types.ANNOTATION_GANG_POLICY: types.GANG_POLICY_STRICT,
+            types.ANNOTATION_GANG_TIMEOUT: "60",
+        },
+    )
+
+
+def test_schedule_allocate_train_one_thread(tmp_path, watchdog):
+    watchdog(420)
+    # ---- link 1: mock cluster + strict-gang schedule over live HTTP ----
+    client = FakeClientset()
+    nodes = ["tpu-host-0", "tpu-host-1"]
+    for i, name in enumerate(nodes):
+        client.create_node(v5p_node(name, slice_name="slice-0",
+                                    coords=f"{i},0,0"))
+    ext = Extender(client, types.POLICY_BINPACK)
+    try:
+        pods = [client.create_pod(_gang_pod(i)) for i in range(N_PODS)]
+        # strict gang: each member's bind PARKS until gang-size members
+        # hold reservations -> drive both scheduling cycles concurrently,
+        # exactly as kube-scheduler's bind goroutines would.
+        errors: dict[str, str] = {}
+        threads = []
+        for pod in pods:
+            def run(p=pod):
+                try:
+                    ext.schedule(p, nodes)
+                except Exception as e:  # surfaced after join
+                    errors[p.name] = str(e)
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=90)
+            assert not t.is_alive(), "strict-gang bind never completed"
+        assert not errors, errors
+
+        # the bind annotations are the scheduler's only output — read them
+        # back as the agent will see them
+        want_chips: dict[str, str] = {}  # pod name -> "0,1,2,3"
+        pod_node: dict[str, str] = {}
+        for pod in pods:
+            bound = client.get_pod("default", pod.name)
+            assert podutil.is_assumed(bound)
+            chips = podutil.get_assigned_chips(bound)["train"]
+            assert len(chips) == CHIPS_PER_POD
+            want_chips[pod.name] = ",".join(str(c) for c in chips)
+            pod_node[pod.name] = bound.node_name
+        # whole-host pods of one strict gang: one pod per host
+        assert sorted(pod_node.values()) == sorted(nodes)
+
+        # ---- links 2+3: per-node agents, kubelet-shaped gRPC Allocate --
+        host = HostTopology(generation="v5p", topology="2x2x1", n_chips=4)
+        agents, envs_by_pod = [], {}
+        try:
+            for node in nodes:
+                d = tmp_path / node
+                d.mkdir()
+                agent = NodeAgent(node, client=client, host_topo=host,
+                                  plugin_dir=str(d), metrics_port=0)
+                agent.start(register=False)
+                agents.append(agent)
+            for agent in agents:
+                deadline = time.monotonic() + 10
+                while len(agent.backlog) < 1:
+                    assert time.monotonic() < deadline, (
+                        f"agent on {agent.node_name} never saw its pod"
+                    )
+                    time.sleep(0.05)
+            for agent in agents:
+                (pod_name,) = [
+                    p for p, n in pod_node.items() if n == agent.node_name
+                ]
+                channel = grpc.insecure_channel(
+                    f"unix://{agent.socket_path}"
+                )
+                stub = DevicePluginStub(channel)
+                # kubelet offers 400 arbitrary slots; the annotation must
+                # override their chip spread
+                offered = [
+                    device_id(c, s) for c in range(4) for s in range(100)
+                ]
+                resp = stub.Allocate(pb.AllocateRequest(
+                    container_requests=[
+                        pb.ContainerAllocateRequest(devicesIDs=offered)
+                    ]
+                ))
+                cr = resp.container_responses[0]
+                channel.close()
+                assert cr.envs["TPU_VISIBLE_CHIPS"] == want_chips[pod_name]
+                assert cr.envs["NANOTPU_ALLOC_SOURCE"].startswith(
+                    f"annotation:default/{pod_name}"
+                )
+                envs_by_pod[pod_name] = dict(cr.envs)
+        finally:
+            for agent in agents:
+                agent.stop()
+    finally:
+        ext.close()
+
+    # ---- links 4+5: Indexed-Job env from the pod's own annotations +
+    # the agent's Allocate envs; run the distributed train step ----------
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "child.py"
+    script.write_text(CHILD)
+    procs = []
+    for i, pod in enumerate(pods):
+        bound = client.get_pod("default", pod.name)
+        env = dict(os.environ)
+        env.update(envs_by_pod[pod.name])
+        env.update({
+            "COORDINATOR_SERVICE": f"127.0.0.1:{port}",
+            # GANG_SIZE from the pod's own scheduler-facing annotation —
+            # the manifest wires the same fieldRef (llama3-8b-v5p16.yaml)
+            "GANG_SIZE": bound.annotations[types.ANNOTATION_GANG_SIZE],
+            "JOB_COMPLETION_INDEX": str(i),
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PYTHONPATH": REPO,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed train step timed out")
+        assert p.returncode == 0, f"rank failed:\nstdout:{out}\nstderr:{err}"
+        outs.append(out)
+    lines = [
+        line for out in outs for line in out.splitlines()
+        if line.startswith("FULLSTACK")
+    ]
+    assert len(lines) == N_PODS
+    fields = [dict(kv.split("=") for kv in ln.split()[1:]) for ln in lines]
+    # dp all-reduce: both processes computed the SAME global loss
+    assert fields[0]["loss"] == fields[1]["loss"], lines
+    # each container ran on EXACTLY the chips the scheduler annotated
+    by_rank = {f["rank"]: f["chips"] for f in fields}
+    for i, pod in enumerate(pods):
+        assert by_rank[str(i)] == want_chips[pod.name], (lines, want_chips)
