@@ -1,0 +1,221 @@
+"""Same-session interleaved serving sweep (VERDICT r4 asks #2/#8).
+
+One process, one chip, one session: every engine configuration is
+measured back-to-back in round-robin order within each repetition, so
+plain-vs-speculative-vs-adaptive ratios never compare across sessions
+(the shared v5e's throughput swings >10x on minute scales — r4 weak #3).
+
+Configurations (all persistent engines, compiled once, warmed before
+any timed window):
+  bf16 suite:  plain | fixed K=2 (always) | fixed K=6 (always) | adaptive
+               ("auto": K=6 at <=2 active rows, plain above)
+  int8 suite (--int8): the deployment stack a v5e operator would run —
+               int8 weight-only target + int8 KV cache + int8 draft:
+               plain | fixed K=6 | adaptive
+
+The adaptive bar (VERDICT ask #2): at every occupancy B,
+adaptive >= max(plain, best-fixed-K) - noise. Occupancy is driven by
+submitting B concurrent requests to ONE slots=8 engine — the policy's
+actual operating regime (a server provisioned for peak, running at B).
+
+Run (TPU):
+    python examples/serving_sweep.py --target-ckpt ckpt_markov \
+        --draft draft_markov --bs 1,2,4,8 --reps 5 [--int8]
+
+Emits one JSON object with per-(B, config) medians + spread + host-load
+context, mirroring bench.py's attributability fields.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--target-ckpt", default="")
+    p.add_argument("--draft", default="", help="orbax draft dir")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny random-init target/draft, no checkpoints — "
+                        "exercises the whole harness on CPU in ~a minute")
+    p.add_argument("--draft-layers", type=int, default=2)
+    p.add_argument("--full-ffn", action="store_true")
+    p.add_argument("--bs", default="1,2,4,8")
+    p.add_argument("--reps", type=int, default=5)
+    p.add_argument("--new-tokens", type=int, default=256)
+    p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--data-seed", type=int, default=0)
+    p.add_argument("--int8", action="store_true",
+                   help="run the int8-everywhere suite instead of bf16")
+    p.add_argument("--out", default="")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    from nanotpu.data.synthetic import markov_batch, markov_table
+    from nanotpu.models.distill import draft_config, init_draft
+    from nanotpu.models.llama import LlamaConfig, init_params
+    from nanotpu.parallel.train import restore_checkpoint, make_optimizer, \
+        init_train_state
+    from nanotpu.serving.engine import Engine
+
+
+    if args.smoke:
+        cfg = LlamaConfig(
+            vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=128, max_seq_len=2048, dtype="float32",
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        dcfg = draft_config(cfg, n_layers=1)
+        draft = init_draft(jax.random.PRNGKey(1), params, cfg, dcfg)
+    else:
+        assert args.target_ckpt and args.draft, (
+            "--target-ckpt and --draft required (or --smoke)"
+        )
+        cfg = LlamaConfig(
+            vocab_size=32_768, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, ffn_dim=4096, max_seq_len=2048, dtype="bfloat16",
+        )
+        template = jax.eval_shape(
+            lambda k: init_train_state(k, cfg, make_optimizer()),
+            jax.random.PRNGKey(0),
+        )
+        restored = restore_checkpoint(
+            os.path.abspath(args.target_ckpt), template
+        )
+        assert restored is not None, f"no checkpoint under {args.target_ckpt}"
+        params = jax.tree_util.tree_map(jnp.asarray, restored.params)
+        print(f"target from {args.target_ckpt} step {int(restored.step)}",
+              file=sys.stderr)
+
+        dcfg = draft_config(cfg, n_layers=args.draft_layers,
+                            ffn_dim=cfg.ffn_dim if args.full_ffn else None)
+        import orbax.checkpoint as ocp
+
+        d_template = jax.eval_shape(
+            lambda k: init_draft(k, params, cfg, dcfg), jax.random.PRNGKey(0)
+        )
+        with ocp.StandardCheckpointer() as ckptr:
+            draft = ckptr.restore(os.path.abspath(args.draft), d_template)
+        draft = jax.tree_util.tree_map(jnp.asarray, draft)
+
+    max_len = args.prompt_len + args.new_tokens + 8
+    kw = dict(slots=args.slots, max_len=max_len,
+              buckets=(16,), chunk_steps=8, chunk_steps_max=64)
+    if args.int8:
+        from nanotpu.models.quant import quantize_params
+
+        tgt = quantize_params(params)
+        dq = quantize_params(draft)
+        engines = {
+            "plain-int8": Engine(tgt, cfg, kv_int8=True, **kw),
+            "k6-int8": Engine(tgt, cfg, kv_int8=True, draft_params=dq,
+                              draft_cfg=dcfg, draft_tokens=6,
+                              spec_policy="always", **kw),
+            "auto-int8": Engine(tgt, cfg, kv_int8=True, draft_params=dq,
+                                draft_cfg=dcfg, draft_tokens=6,
+                                spec_policy="auto", **kw),
+        }
+    else:
+        engines = {
+            "plain": Engine(params, cfg, **kw),
+            "k2": Engine(params, cfg, draft_params=draft, draft_cfg=dcfg,
+                         draft_tokens=2, spec_policy="always", **kw),
+            "k6": Engine(params, cfg, draft_params=draft, draft_cfg=dcfg,
+                         draft_tokens=6, spec_policy="always", **kw),
+            "auto": Engine(params, cfg, draft_params=draft, draft_cfg=dcfg,
+                           draft_tokens=6, spec_policy="auto", **kw),
+        }
+    for name, eng in engines.items():
+        assert eng.wait_warm(600), f"{name}: large chunk never compiled"
+        print(f"{name} warm", file=sys.stderr)
+
+    table = markov_table(cfg.vocab_size, seed=args.data_seed)
+    key = jax.random.PRNGKey(1234)
+
+    def run_batch(eng, prompts, who=""):
+        t0 = time.monotonic()
+        reqs = [eng.submit(p, args.new_tokens,
+                           temperature=args.temperature) for p in prompts]
+        for r in reqs:
+            assert r.wait(600), f"{who}: request timed out"
+            assert r.error is None, f"{who}: {r.error}"
+        dt = time.monotonic() - t0
+        toks = sum(len(r.out) for r in reqs)
+        return toks / dt
+
+    bs = [int(b) for b in args.bs.split(",")]
+    results = {f"{b}": {n: [] for n in engines} for b in bs}
+    # warm every (engine, B) pair once outside the timed windows: the
+    # first batch at a new occupancy can hit cold prefill buckets
+    for b in bs:
+        for name, eng in engines.items():
+            key, k = jax.random.split(key)
+            pr = np.asarray(markov_batch(k, table, (b, args.prompt_len)))
+            run_batch(eng, [row.tolist() for row in pr],
+                      who=f"warm B={b} {name}")
+    t_start = time.time()
+    load0 = os.getloadavg()
+    for b in bs:
+        for rep in range(args.reps):
+            for name, eng in engines.items():
+                key, k = jax.random.split(key)
+                pr = np.asarray(
+                    markov_batch(k, table, (b, args.prompt_len))
+                )
+                tps = run_batch(eng, [row.tolist() for row in pr],
+                                who=f"B={b} rep={rep} {name}")
+                results[f"{b}"][name].append(round(tps, 1))
+                print(f"B={b} rep={rep} {name}: {tps:.1f} tok/s",
+                      file=sys.stderr)
+    for eng in engines.values():
+        eng.stop()
+
+    summary = {}
+    for b, per in results.items():
+        summary[b] = {
+            n: {
+                "median_tok_s": statistics.median(v),
+                "min": min(v), "max": max(v), "reps": v,
+            } for n, v in per.items()
+        }
+        fixed = [summary[b][n]["median_tok_s"] for n in per
+                 if n not in ("auto", "auto-int8")]
+        auto_key = "auto-int8" if args.int8 else "auto"
+        if auto_key in per:
+            summary[b]["adaptive_vs_best_fixed"] = round(
+                summary[b][auto_key]["median_tok_s"] / max(fixed), 3
+            )
+    out = {
+        "suite": "int8" if args.int8 else "bf16",
+        "temperature": args.temperature,
+        "new_tokens": args.new_tokens,
+        "slots": args.slots,
+        "reps": args.reps,
+        "interleaved": "round-robin per rep, one session, one process",
+        "loadavg_start": load0, "loadavg_end": os.getloadavg(),
+        "t_start": t_start, "t_end": time.time(),
+        "results": summary,
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -207,7 +207,11 @@ def main(argv=None) -> int:
                              "tied head dominates the draft's bytes, so "
                              "int8 nearly halves the cost ratio c)")
     args = parser.parse_args(argv)
-    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    # force=True: jax/absl have already installed a root handler at
+    # WARNING by the time main() runs, which turns a plain basicConfig
+    # into a no-op and silently swallows every distill-progress line
+    logging.basicConfig(level=logging.INFO, format="%(message)s",
+                        force=True)
     log = logging.getLogger("nanotpu.distill")
 
     cfg = LlamaConfig(

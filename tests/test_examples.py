@@ -107,3 +107,30 @@ def test_speculative_serving_example_runs():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "speculative == plain" in out.stdout
+
+
+def test_serving_sweep_smoke_runs():
+    """The interleaved serving sweep harness (the script that produces
+    BASELINE.md's adaptive-policy and int8-stack rows) stays runnable:
+    --smoke builds tiny random-init models and drives every engine
+    flavor through the full measurement loop, emitting the same JSON
+    shape as a real v5e run."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, str(EXAMPLES / "serving_sweep.py"), "--smoke",
+         "--bs", "1,2", "--reps", "1", "--new-tokens", "8"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout.splitlines()[-1])
+    assert doc["suite"] == "bf16"
+    for b in ("1", "2"):
+        assert set(doc["results"][b]) >= {"plain", "k2", "k6", "auto",
+                                          "adaptive_vs_best_fixed"}
+    assert doc["loadavg_start"] and doc["t_end"] > doc["t_start"]
