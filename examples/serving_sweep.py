@@ -7,11 +7,13 @@ plain-vs-speculative-vs-adaptive ratios never compare across sessions
 
 Configurations (all persistent engines, compiled once, warmed before
 any timed window):
-  bf16 suite:  plain | fixed K=2 (always) | fixed K=6 (always) | adaptive
-               ("auto": K=6 at <=2 active rows, plain above)
+  bf16 suite:  plain | fixed K=2 (always) | fixed K=6 (always) |
+               "auto" (static rule: K=6 at <=2 active rows, plain
+               above) | "measured" (bandit: argmax of the engine's own
+               EWMA tokens/s per occupancy bucket)
   int8 suite (--int8): the deployment stack a v5e operator would run —
                int8 weight-only target + int8 KV cache + int8 draft:
-               plain | fixed K=6 | adaptive
+               plain | fixed K=6 | measured
 
 The adaptive bar (VERDICT ask #2): at every occupancy B,
 adaptive >= max(plain, best-fixed-K) - noise. Occupancy is driven by
@@ -119,28 +121,43 @@ def main(argv=None) -> int:
 
         tgt = quantize_params(params)
         dq = quantize_params(draft)
-        engines = {
-            "plain-int8": Engine(tgt, cfg, kv_int8=True, **kw),
-            "k6-int8": Engine(tgt, cfg, kv_int8=True, draft_params=dq,
-                              draft_cfg=dcfg, draft_tokens=6,
-                              spec_policy="always", **kw),
-            "auto-int8": Engine(tgt, cfg, kv_int8=True, draft_params=dq,
-                                draft_cfg=dcfg, draft_tokens=6,
-                                spec_policy="auto", **kw),
+        specs = {
+            "plain-int8": lambda: Engine(tgt, cfg, kv_int8=True, **kw),
+            "k6-int8": lambda: Engine(
+                tgt, cfg, kv_int8=True, draft_params=dq, draft_cfg=dcfg,
+                draft_tokens=6, spec_policy="always", **kw),
+            "measured-int8": lambda: Engine(
+                tgt, cfg, kv_int8=True, draft_params=dq, draft_cfg=dcfg,
+                draft_tokens=6, spec_policy="measured", **kw),
         }
     else:
-        engines = {
-            "plain": Engine(params, cfg, **kw),
-            "k2": Engine(params, cfg, draft_params=draft, draft_cfg=dcfg,
-                         draft_tokens=2, spec_policy="always", **kw),
-            "k6": Engine(params, cfg, draft_params=draft, draft_cfg=dcfg,
-                         draft_tokens=6, spec_policy="always", **kw),
-            "auto": Engine(params, cfg, draft_params=draft, draft_cfg=dcfg,
-                           draft_tokens=6, spec_policy="auto", **kw),
+        specs = {
+            "plain": lambda: Engine(params, cfg, **kw),
+            "k2": lambda: Engine(
+                params, cfg, draft_params=draft, draft_cfg=dcfg,
+                draft_tokens=2, spec_policy="always", **kw),
+            "k6": lambda: Engine(
+                params, cfg, draft_params=draft, draft_cfg=dcfg,
+                draft_tokens=6, spec_policy="always", **kw),
+            "auto": lambda: Engine(
+                params, cfg, draft_params=draft, draft_cfg=dcfg,
+                draft_tokens=6, spec_policy="auto", **kw),
+            "measured": lambda: Engine(
+                params, cfg, draft_params=draft, draft_cfg=dcfg,
+                draft_tokens=6, spec_policy="measured", **kw),
         }
-    for name, eng in engines.items():
-        assert eng.wait_warm(600), f"{name}: large chunk never compiled"
-        print(f"{name} warm", file=sys.stderr)
+    # engines are built AND warmed one at a time: a constructor kicks off
+    # a background large-chunk compile thread, and several engines'
+    # compile threads hammering the (tunneled) backend concurrently has
+    # been observed to wedge — serialize the heavy compilation instead
+    engines = {}
+    for name, build in specs.items():
+        t0 = time.monotonic()
+        eng = build()
+        assert eng.wait_warm(900), f"{name}: large chunk never compiled"
+        engines[name] = eng
+        print(f"{name} warm in {time.monotonic() - t0:.0f}s",
+              file=sys.stderr)
 
     table = markov_table(cfg.vocab_size, seed=args.data_seed)
     key = jax.random.PRNGKey(1234)
@@ -180,6 +197,11 @@ def main(argv=None) -> int:
                 results[f"{b}"][name].append(round(tps, 1))
                 print(f"B={b} rep={rep} {name}: {tps:.1f} tok/s",
                       file=sys.stderr)
+    bandit_tables = {
+        name: table
+        for name, eng in engines.items()
+        if (table := eng.stats().get("spec_bandit_tok_s")) is not None
+    }
     for eng in engines.values():
         eng.stop()
 
@@ -191,13 +213,14 @@ def main(argv=None) -> int:
                 "min": min(v), "max": max(v), "reps": v,
             } for n, v in per.items()
         }
+        adaptive = ("auto", "measured", "measured-int8")
         fixed = [summary[b][n]["median_tok_s"] for n in per
-                 if n not in ("auto", "auto-int8")]
-        auto_key = "auto-int8" if args.int8 else "auto"
-        if auto_key in per:
-            summary[b]["adaptive_vs_best_fixed"] = round(
-                summary[b][auto_key]["median_tok_s"] / max(fixed), 3
-            )
+                 if n not in adaptive]
+        for name in adaptive:
+            if name in per:
+                summary[b][f"{name}_vs_best_fixed"] = round(
+                    summary[b][name]["median_tok_s"] / max(fixed), 3
+                )
     out = {
         "suite": "int8" if args.int8 else "bf16",
         "temperature": args.temperature,
@@ -208,6 +231,7 @@ def main(argv=None) -> int:
         "loadavg_start": load0, "loadavg_end": os.getloadavg(),
         "t_start": t_start, "t_end": time.time(),
         "results": summary,
+        "bandit_tables": bandit_tables,
     }
     line = json.dumps(out)
     print(line)

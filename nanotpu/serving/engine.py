@@ -764,8 +764,23 @@ class Engine:
         #: frontier; on the next switch to speculation the engine
         #: re-primes stale rows through the existing bucketed draft
         #: prefill (one small forward per row, only on regime changes).
+        #: "measured": pick plain-vs-speculative per sync from the
+        #: engine's OWN observed tokens/s at the current occupancy bucket
+        #: (r5: the r4-measured occupancy boundary turned out to be
+        #: session-dependent — a later draft/chip state measured K=6
+        #: winning at EVERY occupancy, where "auto"'s static <=2 rule
+        #: left 24% at B=4. The regime boundary is a property of the
+        #: (draft, target, link, chip) tuple, so measure it in place:
+        #: each arm (plain / K) gets an EWMA of realized tokens/s per
+        #: occupancy bucket; under-sampled arms are explored first, then
+        #: the loser is re-probed every PROBE_EVERY syncs to track chip
+        #: drift.) Greedy outputs are invariant across arms, so probing
+        #: never perturbs emitted tokens.
+        self._measured = spec_policy == "measured" and draft_params is not None
         if draft_params is None or spec_policy == "off":
             rules: list[tuple[int, int]] = []
+        elif spec_policy == "measured":
+            rules = [(slots, draft_tokens)]
         elif spec_policy == "always":
             rules = [(slots, draft_tokens)]
         elif spec_policy == "auto":
@@ -779,6 +794,11 @@ class Engine:
                         f"{draft_tokens}]"
                     )
         self.spec_rules = rules
+        #: measured-policy state: occupancy bucket -> {k: EWMA tokens/s},
+        #: sample counts, and a per-bucket sync counter for re-probes
+        self._bandit_rate: dict[int, dict[int, float | None]] = {}
+        self._bandit_n: dict[int, dict[int, int]] = {}
+        self._bandit_t: dict[int, int] = {}
         #: slots whose draft-cache row trails the target (plain chunks ran
         #: while they were active); re-primed before the next spec chunk
         self._draft_stale: set[int] = set()
@@ -896,7 +916,9 @@ class Engine:
         #: the K variants the policy can select, plus 0 (plain) when any
         #: occupancy falls through the rules (or no draft at all)
         variant_ks = sorted({k for _, k in rules})
-        if not rules or rules[-1][0] < slots:
+        if not rules or rules[-1][0] < slots or self._measured:
+            # measured mode always needs the plain arm compiled: the
+            # bandit chooses between plain and speculative chunks live
             variant_ks = [0] + variant_ks
         self._variant_ks = variant_ks
         self._chunk_small = {
@@ -1051,6 +1073,11 @@ class Engine:
             queued = len(self._queue)
             ttft = sorted(self.ttft_samples)
             lat = sorted(self.latency_samples)
+            # deep-copied under the lock: the engine loop inserts new
+            # occupancy buckets via setdefault mid-iteration otherwise
+            bandit = {
+                b: dict(arms) for b, arms in self._bandit_rate.items()
+            }
         active = sum(1 for r in self._slot_req if r is not None)
 
         def pct(xs, p):
@@ -1075,6 +1102,19 @@ class Engine:
                     self.spec_cycle_tokens_total / self.spec_cycles_total, 3
                 )
                 if self.spec_cycles_total else None
+            ),
+            # measured policy: the live per-bucket arm table (EWMA
+            # tokens/s per speculation depth), so operators can see WHY
+            # the engine is choosing plain or speculative chunks
+            "spec_bandit_tok_s": (
+                {
+                    str(b): {
+                        str(k): (r if r is None else round(r, 1))
+                        for k, r in arms.items()
+                    }
+                    for b, arms in bandit.items()
+                }
+                if self._measured else None
             ),
         }
 
@@ -1145,7 +1185,11 @@ class Engine:
                 occ_after = sum(
                     1 for r in self._slot_req if r is not None
                 ) + len(admitted) + 1
-                if self._policy_k(occ_after) > 0:
+                # measured mode always primes: the bandit may pick the
+                # speculative arm at any occupancy, and one bucketed
+                # draft forward at admission is cheaper than a re-prime
+                # round trip mid-stream
+                if self._measured or self._policy_k(occ_after) > 0:
                     dks, dvs = self._prefill_draft(
                         self.draft_params, jnp.asarray(padded)
                     )
@@ -1190,10 +1234,74 @@ class Engine:
     def _policy_k(self, n_active: int) -> int:
         """Speculation depth for a chunk at ``n_active`` occupied slots:
         the first rule covering the count decides; none -> 0 (plain)."""
+        if self._measured:
+            return self._bandit_pick(n_active)
         for max_active, rule_k in self.spec_rules:
             if n_active <= max_active:
                 return rule_k
         return 0
+
+    #: EWMA weight of one new tokens/s sample; ~last 6 chunks dominate
+    BANDIT_ALPHA = 0.3
+    #: per-arm samples required before exploitation starts
+    BANDIT_MIN_SAMPLES = 3
+    #: re-probe a losing arm every N syncs per bucket (tracks chip
+    #: drift). Chip-state throughput swings faster than a long probe
+    #: period can track: at 32 a bucket that sees ~30 syncs per minute
+    #: never re-probed at all and exploited a stale warm-phase estimate
+    BANDIT_PROBE_EVERY = 12
+
+    @staticmethod
+    def _bandit_bucket(n_active: int) -> int:
+        """Occupancy bucket: 1, 2, 3-4, 5-8, 9-16, ... (powers of two).
+        The spec-vs-plain tradeoff moves with how well the batched verify
+        amortizes, which is roughly log-scaled in active rows."""
+        b = 1
+        while b < n_active:
+            b *= 2
+        return b
+
+    def _bandit_pick(self, n_active: int) -> int:
+        """Measured policy: explore under-sampled arms, then exploit the
+        best EWMA tokens/s for this occupancy bucket, re-probing losers
+        every BANDIT_PROBE_EVERY syncs. Greedy outputs are invariant
+        across arms, so exploration never changes emitted tokens."""
+        b = self._bandit_bucket(n_active)
+        with self._cv:
+            # bucket insertion is the only structural mutation of the
+            # table; stats() snapshots it under the same lock (per-key
+            # value updates never resize a dict and are iteration-safe)
+            rate = self._bandit_rate.setdefault(
+                b, {k: None for k in self._variant_ks}
+            )
+            n = self._bandit_n.setdefault(
+                b, {k: 0 for k in self._variant_ks}
+            )
+        for k in self._variant_ks:
+            if n[k] < self.BANDIT_MIN_SAMPLES:
+                return k
+        t = self._bandit_t.get(b, 0) + 1
+        self._bandit_t[b] = t
+        best = max(rate, key=lambda k: rate[k])
+        if t % self.BANDIT_PROBE_EVERY == 0:
+            # stalest loser gets a fresh sample
+            losers = [k for k in self._variant_ks if k != best]
+            if losers:
+                return min(losers, key=lambda k: n[k])
+        return best
+
+    def _bandit_update(self, n_active: int, k: int, tokens: int,
+                       dt: float) -> None:
+        if not self._measured or tokens <= 0 or dt <= 0:
+            return
+        b = self._bandit_bucket(n_active)
+        r = tokens / dt
+        cur = self._bandit_rate[b][k]
+        self._bandit_rate[b][k] = (
+            r if cur is None
+            else (1 - self.BANDIT_ALPHA) * cur + self.BANDIT_ALPHA * r
+        )
+        self._bandit_n[b][k] += 1
 
     def _reprime_draft(self) -> None:
         """Catch stale draft-cache rows up to the target's frontier.
@@ -1256,9 +1364,17 @@ class Engine:
         # Selection happens only here, at a sync boundary, so a request
         # can cross regimes mid-stream (the invariance test pins that
         # greedy outputs don't notice).
-        k = self._policy_k(sum(r is not None for r in self._slot_req))
+        n_active = sum(r is not None for r in self._slot_req)
+        k = self._policy_k(n_active)
         if k > 0 and self._draft_stale:
             self._reprime_draft()
+        # timed AFTER the re-prime: the bandit estimates each arm's
+        # steady-state tokens/s, and charging the (transient, switch-only)
+        # re-prime round trip into the speculative arm's sample was
+        # measured to systematically sink it — every periodic probe after
+        # a plain phase paid the re-prime, so the spec arm never looked
+        # good at B=1 even when it was 1.5x faster sustained
+        t_chunk = time.perf_counter()
         chunk = self._chunk_small[k]
         if not queued:
             chunk = self._chunk_large.get(k, chunk)
@@ -1296,6 +1412,8 @@ class Engine:
                     i for i, r in enumerate(self._slot_req) if r is not None
                 )
         now = time.perf_counter()
+        dt_chunk = now - t_chunk
+        toks_before = self.tokens_total
 
         def row_tokens(i):
             """This chunk's emitted tokens for slot i, in order (frozen
@@ -1343,6 +1461,9 @@ class Engine:
             else:
                 # one wakeup per chunk per row for stream() consumers
                 req._notify_progress()
+        self._bandit_update(
+            n_active, k, self.tokens_total - toks_before, dt_chunk
+        )
 
     def _loop(self) -> None:
         while True:

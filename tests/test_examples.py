@@ -131,6 +131,8 @@ def test_serving_sweep_smoke_runs():
     doc = json.loads(out.stdout.splitlines()[-1])
     assert doc["suite"] == "bf16"
     for b in ("1", "2"):
-        assert set(doc["results"][b]) >= {"plain", "k2", "k6", "auto",
-                                          "adaptive_vs_best_fixed"}
+        assert set(doc["results"][b]) >= {
+            "plain", "k2", "k6", "auto", "measured",
+            "auto_vs_best_fixed", "measured_vs_best_fixed",
+        }
     assert doc["loadavg_start"] and doc["t_end"] > doc["t_start"]

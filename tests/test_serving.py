@@ -747,3 +747,91 @@ class TestAdaptiveSpeculation:
             assert eng.spec_cycles_total > 0
         finally:
             eng.stop()
+
+
+class TestMeasuredPolicy:
+    """spec_policy="measured" (r5): the engine picks plain-vs-speculative
+    per sync from its OWN observed tokens/s per occupancy bucket — the r4
+    static boundary proved session-dependent (a later draft/chip state
+    measured K=6 winning at every occupancy where "auto" ran plain)."""
+
+    def _draft(self, params, cfg, n_layers=1):
+        import dataclasses
+
+        from nanotpu.models.distill import init_draft
+
+        dcfg = dataclasses.replace(cfg, n_layers=n_layers)
+        return init_draft(jax.random.PRNGKey(9), params, cfg, dcfg), dcfg
+
+    def test_compiles_plain_and_spec_arms(self, tiny_model):
+        params, cfg = tiny_model
+        draft, dcfg = self._draft(params, cfg)
+        eng = Engine(params, cfg, slots=4, max_len=128, buckets=(16,),
+                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3,
+                     spec_policy="measured")
+        try:
+            assert eng._measured
+            assert sorted(eng._chunk_small) == [0, 3]
+            assert eng.stats()["spec_bandit_tok_s"] == {}
+        finally:
+            eng.stop()
+
+    def test_bandit_explores_then_exploits_and_reprobes(self, tiny_model):
+        """Pure selection logic, no chip timing: both arms are explored
+        MIN_SAMPLES times, the faster arm is then exploited, and every
+        PROBE_EVERY syncs the loser gets one fresh sample."""
+        params, cfg = tiny_model
+        draft, dcfg = self._draft(params, cfg)
+        eng = Engine(params, cfg, slots=4, max_len=128, buckets=(16,),
+                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3,
+                     spec_policy="measured")
+        try:
+            m = eng.BANDIT_MIN_SAMPLES
+            # exploration phase: arm order follows _variant_ks until each
+            # has MIN_SAMPLES
+            seen = []
+            for _ in range(2 * m):
+                k = eng._bandit_pick(2)
+                seen.append(k)
+                eng._bandit_update(2, k, tokens=8,
+                                   dt=0.1 if k == 3 else 0.2)
+            assert seen.count(0) == m and seen.count(3) == m
+            # exploitation: arm 3 measured 2x faster
+            picks = [eng._bandit_pick(2)
+                     for _ in range(eng.BANDIT_PROBE_EVERY - 1)]
+            assert set(picks) == {3}
+            assert eng._bandit_pick(2) == 0  # the periodic loser probe
+            # drift: feed the probe a dramatically better plain rate
+            # repeatedly and the bandit flips arms
+            for _ in range(12):
+                eng._bandit_update(2, 0, tokens=64, dt=0.1)
+            assert eng._bandit_pick(2) == 0
+            # buckets are independent: occupancy 4 starts exploring fresh
+            assert eng._bandit_pick(4) == 0 and eng._bandit_bucket(3) == 4
+            tab = eng.stats()["spec_bandit_tok_s"]
+            assert "2" in tab and set(tab["2"]) == {"0", "3"}
+        finally:
+            eng.stop()
+
+    def test_measured_greedy_invariant(self, tiny_model):
+        """Arm switches driven by live timing measurements never change
+        greedy outputs; both arms actually run."""
+        params, cfg = tiny_model
+        draft, dcfg = self._draft(params, cfg)
+        eng = Engine(params, cfg, slots=2, max_len=128, buckets=(16, 32),
+                     chunk_steps=2, chunk_steps_max=4,
+                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3,
+                     spec_policy="measured")
+        try:
+            a = eng.submit([5, 3, 1], 40)
+            b = eng.submit([2, 7, 1, 8], 6)
+            assert b.wait(120) and b.error is None
+            assert a.wait(120) and a.error is None
+            assert b.out == ref_greedy(params, cfg, [2, 7, 1, 8], 6)
+            assert a.out == ref_greedy(params, cfg, [5, 3, 1], 40)
+            assert eng.spec_cycles_total > 0, "spec arm never ran"
+            rates = eng._bandit_rate
+            assert any(n for b_ in eng._bandit_n.values()
+                       for n in b_.values()), rates
+        finally:
+            eng.stop()
