@@ -3,7 +3,7 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native test bench image clean
+.PHONY: all native test bench sim-smoke image clean
 
 all: native test
 
@@ -15,6 +15,13 @@ test: native
 
 bench: native
 	python bench.py
+
+# 30 virtual seconds, all five BASELINE configs, every fault armed, run
+# TWICE: exits nonzero on any invariant violation or determinism breach
+# (docs/simulation.md). Fast enough for every PR.
+sim-smoke:
+	python -m nanotpu.sim --scenario examples/sim/smoke.json --seed 0 \
+		--check-determinism
 
 image:
 	docker build -t $(IMAGE):$(TAG) .

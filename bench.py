@@ -25,6 +25,7 @@ from nanotpu.cmd.main import make_mock_cluster
 from nanotpu.dealer import Dealer
 from nanotpu.k8s.objects import make_container, make_pod
 from nanotpu.metrics.registry import Registry
+from nanotpu.metrics.stats import percentile
 from nanotpu.routes.server import SchedulerAPI, serve
 
 N_HOSTS = 16
@@ -247,7 +248,7 @@ def run_fanout(n_hosts: int = 256, n_pods: int = 256,
         conn.close()
         server.shutdown()
     gc.collect()
-    p50 = statistics.median(lats)
+    p50 = percentile(lats, 0.50)
     return {
         "fanout_hosts": n_hosts,
         "fanout_pods_per_s": round(n_pods / elapsed, 1),
@@ -383,11 +384,10 @@ def run() -> dict:
         bound = min(bound, rep_bound)
         occupancy = min(occupancy, rep_occ)
 
-    import math as _math
-
-    p50 = statistics.median(latencies)
-    n = len(latencies)
-    p99 = sorted(latencies)[min(n - 1, _math.ceil(0.99 * n) - 1)]
+    # exact nearest-rank percentiles, shared with the sim report
+    # (nanotpu/metrics/stats.py) so "p99" means the same thing in both
+    p50 = percentile(latencies, 0.50)
+    p99 = percentile(latencies, 0.99)
     rates.sort()
     out = {
         "metric": "chip_occupancy_binpack_v5p64_pct",

@@ -23,7 +23,6 @@ from nanotpu import types
 from nanotpu.allocator.rater import make_rater
 from nanotpu.dealer import Dealer
 from nanotpu.k8s.client import FakeClientset
-from nanotpu.k8s.objects import make_node
 from nanotpu.metrics.registry import Registry
 from nanotpu.routes.server import SchedulerAPI, serve
 
@@ -31,26 +30,20 @@ log = logging.getLogger("nanotpu.main")
 
 
 def make_mock_cluster(n_nodes: int, chips_per_node: int = 4) -> FakeClientset:
-    """A v5p pool: n hosts of 2x2x1 chips, slice-annotated for gang placement."""
-    client = FakeClientset()
-    # hosts arranged on a square-ish host grid inside one slice
-    side = max(1, int(n_nodes ** 0.5))
-    for i in range(n_nodes):
-        hx, hy = i % side, i // side
-        client.create_node(
-            make_node(
-                f"v5p-host-{i}",
-                {types.RESOURCE_TPU_PERCENT: chips_per_node * types.PERCENT_PER_CHIP},
-                labels={
-                    types.LABEL_TPU_GENERATION: "v5p",
-                    types.LABEL_TPU_TOPOLOGY: "2x2x1",
-                    types.LABEL_TPU_SLICE: "slice-0",
-                    types.LABEL_TPU_SLICE_COORDS: f"{hx},{hy},0",
-                    types.LABEL_TPU_ENABLE: types.LABEL_TPU_ENABLE_VALUE,
-                },
-            )
-        )
-    return client
+    """A v5p pool: n hosts of 2x2x1 chips, slice-annotated for gang
+    placement. Thin wrapper over the shared fleet factory
+    (:mod:`nanotpu.sim.fleet`) kept for its flag-friendly signature; the
+    node set is bit-identical to what this function always built."""
+    from nanotpu.sim.fleet import make_fleet
+
+    return make_fleet({
+        "pools": [{
+            "generation": "v5p",
+            "hosts": n_nodes,
+            "chips_per_host": chips_per_node,
+            "prefix": "v5p-host",
+        }]
+    })
 
 
 def build_app(argv: list[str] | None = None):

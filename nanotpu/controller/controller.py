@@ -120,39 +120,51 @@ class Controller:
         for event in self._pod_watch:
             if self._stop.is_set():
                 break
-            pod = event.obj
-            if not podutil.is_tpu_sharing_pod(pod):
-                continue
-            if event.type == "ADDED":
-                self._remember(pod)
+            self.handle_pod_event(event)
+
+    def handle_pod_event(self, event) -> None:
+        """One pod watch event through the reconciler's dispatch rules.
+
+        Public so a deterministic driver (nanotpu.sim) can feed the REAL
+        event logic without the watch threads; ``start()`` routes its own
+        watch stream through here too, so there is exactly one dispatch."""
+        pod = event.obj
+        if not podutil.is_tpu_sharing_pod(pod):
+            return
+        if event.type == "ADDED":
+            self._remember(pod)
+            self._enqueue(pod)
+        elif event.type == "MODIFIED":
+            old = self._known(pod.key())
+            self._remember(pod)
+            # enqueue only on the two meaningful transitions
+            # (controller.go:289-335)
+            if podutil.is_completed_pod(pod):
                 self._enqueue(pod)
-            elif event.type == "MODIFIED":
-                old = self._known(pod.key())
-                self._remember(pod)
-                # enqueue only on the two meaningful transitions
-                # (controller.go:289-335)
-                if podutil.is_completed_pod(pod):
-                    self._enqueue(pod)
-                elif old is None and podutil.is_assumed(pod):
-                    self._enqueue(pod)
-                elif podutil.is_assumed(pod) and old is not None and not podutil.is_assumed(old):
-                    self._enqueue(pod)
-            elif event.type == "DELETED":
-                with self._cache_lock:
-                    self._pod_cache.pop(pod.key(), None)
-                self.dealer.forget(pod)
+            elif old is None and podutil.is_assumed(pod):
+                self._enqueue(pod)
+            elif podutil.is_assumed(pod) and old is not None and not podutil.is_assumed(old):
+                self._enqueue(pod)
+        elif event.type == "DELETED":
+            with self._cache_lock:
+                self._pod_cache.pop(pod.key(), None)
+            self.dealer.forget(pod)
 
     def _node_loop(self) -> None:
         for event in self._node_watch:
             if self._stop.is_set():
                 break
-            if event.type == "DELETED":
-                self.dealer.remove_node(event.obj.name)
-            elif event.type == "ADDED":
-                self.dealer.observe_node(event.obj)
-            elif event.type == "MODIFIED":
-                # resize/relabel detection (the reference ignored these)
-                self.dealer.refresh_node(event.obj)
+            self.handle_node_event(event)
+
+    def handle_node_event(self, event) -> None:
+        """One node watch event (see handle_pod_event for why public)."""
+        if event.type == "DELETED":
+            self.dealer.remove_node(event.obj.name)
+        elif event.type == "ADDED":
+            self.dealer.observe_node(event.obj)
+        elif event.type == "MODIFIED":
+            # resize/relabel detection (the reference ignored these)
+            self.dealer.refresh_node(event.obj)
 
     def _resync_loop(self) -> None:
         """Periodic full reconcile: re-list pods and nodes, enqueue every TPU
@@ -196,30 +208,65 @@ class Controller:
             self.dealer.refresh_node(node)  # watch event missed
 
     # -- work side ---------------------------------------------------------
+    def drain_sync(self) -> int:
+        """Synchronously process every queued pod sync in the caller's
+        thread; retries happen inline instead of through timers, so the
+        processing order is a pure function of the enqueue order. This is
+        the deterministic counterpart of the worker threads — the sim
+        drives a never-``start()``ed controller entirely through
+        ``handle_*_event`` + this. Returns the number of syncs run."""
+        processed = 0
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return processed
+            try:
+                if item is not None and self._process_item(
+                    item,
+                    lambda ns, n, a: self._queue.put((ns, n, a + 1)),
+                ):
+                    processed += 1
+            finally:
+                self._queue.task_done()
+
+    def _process_item(self, item, requeue) -> bool:
+        """One queued sync, shared by ``_worker`` and ``drain_sync`` so the
+        retry cap and drop semantics live in exactly one place; only the
+        requeue strategy differs (timer backoff vs inline re-put).
+        ``requeue(namespace, name, attempt)`` receives the FAILED attempt
+        number and must enqueue attempt + 1. Returns True iff the sync ran."""
+        namespace, name, attempt = item
+        try:
+            self._sync_pod(namespace, name)
+            return True
+        except Exception as e:  # transient: retry via the caller's strategy
+            if attempt + 1 > MAX_SYNC_RETRIES:
+                log.error(
+                    "dropping pod %s/%s after %d attempts: %s",
+                    namespace, name, attempt, e,
+                )
+                return False
+            requeue(namespace, name, attempt)
+            return False
+
+    def _requeue_backoff(self, namespace: str, name: str, attempt: int) -> None:
+        delay = min(BACKOFF_BASE_S * (2 ** attempt), BACKOFF_MAX_S)
+        timer = threading.Timer(
+            delay,
+            self._queue.put,
+            args=((namespace, name, attempt + 1),),
+        )
+        timer.daemon = True
+        timer.start()
+
     def _worker(self) -> None:
         while not self._stop.is_set():
             item = self._queue.get()
             try:
                 if item is None:
                     return
-                namespace, name, attempt = item
-                try:
-                    self._sync_pod(namespace, name)
-                except Exception as e:  # transient: backoff retry
-                    if attempt + 1 > MAX_SYNC_RETRIES:
-                        log.error(
-                            "dropping pod %s/%s after %d attempts: %s",
-                            namespace, name, attempt, e,
-                        )
-                        continue
-                    delay = min(BACKOFF_BASE_S * (2 ** attempt), BACKOFF_MAX_S)
-                    timer = threading.Timer(
-                        delay,
-                        self._queue.put,
-                        args=((namespace, name, attempt + 1),),
-                    )
-                    timer.daemon = True
-                    timer.start()
+                self._process_item(item, self._requeue_backoff)
             finally:
                 self._queue.task_done()
 

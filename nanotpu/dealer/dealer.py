@@ -972,3 +972,26 @@ class Dealer:
         used = sum(i.chips.percent_used() for i in infos)
         total = sum(i.chips.percent_total() for i in infos)
         return used / total if total else 0.0
+
+    def debug_snapshot(self) -> dict:
+        """Deep-introspection view for harnesses and invariant checkers
+        (nanotpu.sim): tracked/reserved uids, uid -> accounting node, and
+        the LIVE NodeInfo objects keyed by node name. The maps are copies
+        (safe to iterate), the NodeInfos are the real instances — callers
+        that inspect chip state must tolerate concurrent verbs, or (like
+        the single-threaded sim) guarantee none are in flight."""
+        with self._lock:
+            return {
+                "tracked_uids": sorted(self._pods),
+                "reserved_uids": sorted(self._reserved),
+                "accounted": {
+                    uid: info.name for uid, info in self._accounted.items()
+                },
+                "node_infos": dict(self._nodes),
+            }
+
+    def close(self) -> None:
+        """Release the assume thread pool. Only needed by harnesses that
+        churn dealers (the sim's agent-restart fault builds a fresh dealer
+        per restart); a live scheduler keeps one dealer for its lifetime."""
+        self._pool.shutdown(wait=False)

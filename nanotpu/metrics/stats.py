@@ -1,0 +1,44 @@
+"""Exact sample statistics for reports (sim, bench).
+
+:class:`~nanotpu.metrics.registry.Histogram` serves Prometheus exposition,
+where bucketed quantiles are the right trade; reports want EXACT
+percentiles over the full sample set (bench.py's p99 convention:
+``sorted(xs)[ceil(0.99 * n) - 1]``). One implementation here so the sim
+report, bench, and any future trajectory tooling agree on what "p99"
+means.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def percentile(samples: list[float], p: float) -> float | None:
+    """Exact p-quantile (0 < p <= 1) by the nearest-rank method; None on an
+    empty sample set."""
+    if not samples:
+        return None
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, max(0, math.ceil(p * len(xs)) - 1))]
+
+
+def summarize(samples: list[float], scale: float = 1.0,
+              digits: int = 3) -> dict | None:
+    """p50/p95/p99/mean/max/count summary, values scaled (e.g. s -> ms)
+    and rounded for stable JSON. None when there are no samples."""
+    if not samples:
+        return None
+    xs = sorted(samples)
+    n = len(xs)
+
+    def r(v: float) -> float:
+        return round(v * scale, digits)
+
+    return {
+        "count": n,
+        "p50": r(percentile(xs, 0.50)),
+        "p95": r(percentile(xs, 0.95)),
+        "p99": r(percentile(xs, 0.99)),
+        "mean": r(sum(xs) / n),
+        "max": r(xs[-1]),
+    }

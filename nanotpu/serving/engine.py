@@ -778,6 +778,21 @@ class Engine:
         #: never perturbs emitted tokens.
         self._measured = spec_policy == "measured" and draft_params is not None
         if draft_params is None or spec_policy == "off":
+            if draft_params is None and spec_policy != "off":
+                # ADVICE r5: "measured" is the documented production
+                # policy, and an operator who requests it but miswires the
+                # draft would otherwise silently run plain-only decoding.
+                # "auto" is the constructor DEFAULT, so a plain engine
+                # built with no speculation settings at all logs at INFO
+                # only — a WARNING there would be unconditional noise
+                log.log(
+                    logging.INFO if spec_policy == "auto" else logging.WARNING,
+                    "spec_policy=%r requested but draft_params is None: "
+                    "speculative decoding is DISABLED, falling back to "
+                    "plain decoding (pass draft_params+draft_cfg, or "
+                    "spec_policy='off' to silence this)",
+                    spec_policy,
+                )
             rules: list[tuple[int, int]] = []
         elif spec_policy == "measured":
             rules = [(slots, draft_tokens)]
@@ -1267,28 +1282,30 @@ class Engine:
         every BANDIT_PROBE_EVERY syncs. Greedy outputs are invariant
         across arms, so exploration never changes emitted tokens."""
         b = self._bandit_bucket(n_active)
+        # the whole pick runs under the lock stats() snapshots with
+        # (ADVICE r5): the writes are cheap scalar ops, and leaning on the
+        # GIL for the _bandit_t read-modify-write would break the moment a
+        # second policy-consulting thread (or a free-threaded runtime)
+        # shows up
         with self._cv:
-            # bucket insertion is the only structural mutation of the
-            # table; stats() snapshots it under the same lock (per-key
-            # value updates never resize a dict and are iteration-safe)
             rate = self._bandit_rate.setdefault(
                 b, {k: None for k in self._variant_ks}
             )
             n = self._bandit_n.setdefault(
                 b, {k: 0 for k in self._variant_ks}
             )
-        for k in self._variant_ks:
-            if n[k] < self.BANDIT_MIN_SAMPLES:
-                return k
-        t = self._bandit_t.get(b, 0) + 1
-        self._bandit_t[b] = t
-        best = max(rate, key=lambda k: rate[k])
-        if t % self.BANDIT_PROBE_EVERY == 0:
-            # stalest loser gets a fresh sample
-            losers = [k for k in self._variant_ks if k != best]
-            if losers:
-                return min(losers, key=lambda k: n[k])
-        return best
+            for k in self._variant_ks:
+                if n[k] < self.BANDIT_MIN_SAMPLES:
+                    return k
+            t = self._bandit_t.get(b, 0) + 1
+            self._bandit_t[b] = t
+            best = max(rate, key=lambda k: rate[k])
+            if t % self.BANDIT_PROBE_EVERY == 0:
+                # stalest loser gets a fresh sample
+                losers = [k for k in self._variant_ks if k != best]
+                if losers:
+                    return min(losers, key=lambda k: n[k])
+            return best
 
     def _bandit_update(self, n_active: int, k: int, tokens: int,
                        dt: float) -> None:
@@ -1296,12 +1313,13 @@ class Engine:
             return
         b = self._bandit_bucket(n_active)
         r = tokens / dt
-        cur = self._bandit_rate[b][k]
-        self._bandit_rate[b][k] = (
-            r if cur is None
-            else (1 - self.BANDIT_ALPHA) * cur + self.BANDIT_ALPHA * r
-        )
-        self._bandit_n[b][k] += 1
+        with self._cv:  # stats() deep-copies the arm table under this lock
+            cur = self._bandit_rate[b][k]
+            self._bandit_rate[b][k] = (
+                r if cur is None
+                else (1 - self.BANDIT_ALPHA) * cur + self.BANDIT_ALPHA * r
+            )
+            self._bandit_n[b][k] += 1
 
     def _reprime_draft(self) -> None:
         """Catch stale draft-cache rows up to the target's frontier.

@@ -1,0 +1,31 @@
+"""Deterministic cluster simulator + fault-injection harness.
+
+Placement-policy work lives or dies on trace-driven simulation (Tesserae,
+arxiv 2508.04953; Gavel, arxiv 2008.09213 — both evaluate every policy in a
+cluster simulator before touching hardware). This package is that substrate
+for nanotpu: a seeded discrete-event simulator that drives the REAL
+:class:`~nanotpu.dealer.Dealer`, the real scheduler verbs
+(:mod:`nanotpu.scheduler.verbs`), and the real
+:class:`~nanotpu.controller.controller.Controller` — no re-implementation of
+allocation logic — against synthetic fleets (a single v4 host up to
+v5p-512 torus pools), Poisson or trace-file pod arrivals covering all five
+BASELINE configs, pod lifetimes/departures, and a fault-injection layer
+(node flap, dropped/duplicate informer events, bind-API failures, delayed
+metric sync, agent restart).
+
+Everything is single-threaded and seeded: two runs of the same scenario and
+seed produce byte-identical reports (see docs/simulation.md for the
+determinism contract), so a policy regression reproduces from one JSON
+trace. An invariant checker (no chip oversubscription, no orphaned
+reservations, annotations round-trip through the :mod:`nanotpu.types`
+codec) runs after every event.
+
+Entry point::
+
+    python -m nanotpu.sim --scenario examples/sim/smoke.json --seed 0
+"""
+
+from nanotpu.sim.core import Simulator, run_scenario
+from nanotpu.sim.scenario import load_scenario
+
+__all__ = ["Simulator", "run_scenario", "load_scenario"]

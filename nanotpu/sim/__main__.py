@@ -1,0 +1,116 @@
+"""CLI: ``python -m nanotpu.sim --scenario examples/sim/smoke.json --seed 0``.
+
+stdout carries exactly one JSON report (canonical serialization, sorted
+keys). Without ``--timing`` the report is byte-identical across runs of
+the same (scenario, seed) — the determinism contract CI leans on;
+``--timing`` adds wall-clock Filter/Prioritize/Bind percentiles (real
+time, not reproducible). A human summary — including the wall-clock
+p50/p99 either way — goes to stderr.
+
+Exit codes: 0 healthy; 1 invariant violations (or determinism breach
+under ``--check-determinism``); 2 bad usage/scenario.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from nanotpu.sim.core import Simulator
+from nanotpu.sim.report import render, strip_timing
+from nanotpu.sim.scenario import load_scenario
+
+
+def _summary_line(report: dict, timing: dict) -> str:
+    occ = report["occupancy_pct"]
+    frag = report["fragmentation"]
+    inv = report["invariants"]
+    lat = timing.get("latency_ms", {})
+
+    def p(verb, q):
+        s = lat.get(verb) or {}
+        v = s.get(q)
+        return f"{v:.3f}" if isinstance(v, (int, float)) else "n/a"
+
+    return (
+        f"sim {report['scenario']!r} seed={report['seed']}: "
+        f"occupancy mean {occ['mean']}% peak {occ['peak']}% "
+        f"final {occ['final']}%; fragmentation mean {frag['mean']}; "
+        f"{report['pods']['bound']}/{report['pods']['arrived']} pods bound; "
+        f"filter p50/p99 {p('filter', 'p50')}/{p('filter', 'p99')} ms, "
+        f"bind p50/p99 {p('bind', 'p50')}/{p('bind', 'p99')} ms; "
+        f"invariants: {inv['violations']} violations / {inv['checks']} checks"
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m nanotpu.sim",
+        description="deterministic cluster simulator (docs/simulation.md)",
+    )
+    parser.add_argument("--scenario", required=True, help="scenario JSON path")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--timing", action="store_true",
+        help="embed wall-clock verb latencies in the report "
+        "(breaks byte-reproducibility of stdout, by design)",
+    )
+    parser.add_argument(
+        "--check-determinism", action="store_true",
+        help="run the scenario twice and fail unless the deterministic "
+        "reports are byte-identical",
+    )
+    parser.add_argument(
+        "--horizon-s", type=float, default=None,
+        help="override the scenario horizon (shorter smoke runs)",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        scenario = load_scenario(args.scenario)
+        if args.horizon_s is not None:
+            if args.horizon_s <= 0:
+                raise ValueError(
+                    f"--horizon-s must be > 0, got {args.horizon_s}"
+                )
+            scenario["horizon_s"] = float(args.horizon_s)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    # timing is always COLLECTED (the stderr summary wants it); it lands
+    # in stdout's JSON only behind --timing
+    report = Simulator(scenario, args.seed).run(include_timing=True)
+    timing = report.get("timing", {})
+    out = report if args.timing else strip_timing(report)
+
+    rc = 0
+    if args.check_determinism:
+        again = strip_timing(
+            Simulator(scenario, args.seed).run(include_timing=False)
+        )
+        if render(strip_timing(report)) != render(again):
+            print(
+                "DETERMINISM BREACH: two runs of the same (scenario, seed) "
+                "diverged — diff the digests:\n"
+                f"  run 1: {report['digest']}\n  run 2: {again['digest']}",
+                file=sys.stderr,
+            )
+            rc = 1
+        else:
+            print(
+                f"determinism check passed ({report['digest']})",
+                file=sys.stderr,
+            )
+    print(render(out))
+    print(_summary_line(report, timing), file=sys.stderr)
+    if report["invariants"]["violations"]:
+        for v in report["invariants"]["first"]:
+            print(f"violation[{v['kind']}]: {v['detail']}", file=sys.stderr)
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

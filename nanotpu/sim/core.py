@@ -1,0 +1,548 @@
+"""The discrete-event simulator: virtual clock over the REAL extender stack.
+
+One thread, one seeded RNG tree, one event heap. Every component under test
+is the production object — :class:`~nanotpu.dealer.Dealer`,
+:class:`~nanotpu.scheduler.verbs.Predicate`/``Prioritize``/``Bind``, and
+:class:`~nanotpu.controller.controller.Controller` (driven through its
+deterministic stepping surface ``handle_pod_event`` / ``handle_node_event``
+/ ``drain_sync`` instead of its threads). The simulator owns only what a
+real cluster would: the virtual clock, pod arrivals/departures, the
+informer tap (where drop/duplicate faults live), and the fault schedule.
+
+Scheduling cycles replicate kube-scheduler's loop: Filter over every live
+node, Prioritize, pick the best score (ties broken by node name — the one
+place kube-scheduler randomizes and a deterministic sim must not), then
+Bind. Infeasible or failed pods go to a pending queue retried every
+``retry_every_s``.
+
+Determinism contract: two runs of (scenario, seed) produce byte-identical
+deterministic reports — see docs/simulation.md. Wall-clock verb latencies
+are collected on the side and surface only in the opt-in timing section.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import random
+import time
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.controller.controller import Controller
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.objects import Node, Pod, plain_copy
+from nanotpu.scheduler.verbs import Bind, Predicate, Prioritize
+from nanotpu.sim.faults import FaultPlan
+from nanotpu.sim.fleet import fleet_summary, make_fleet
+from nanotpu.sim.invariants import check_invariants, ground_truth_occupancy
+from nanotpu.sim.report import ReportBuilder, fragmentation_of
+from nanotpu.sim.scenario import normalize_scenario
+from nanotpu.sim.workload import (
+    Job,
+    build_job,
+    draw_lifetime,
+    poisson_arrivals,
+    trace_arrivals,
+)
+
+log = logging.getLogger("nanotpu.sim")
+
+#: delay before a gang killed by a node flap is resubmitted (a real job
+#: controller backs off before recreating workers)
+GANG_RESUBMIT_DELAY_S = 1.0
+
+#: bind retries within one arrival before the pod parks in pending
+BIND_RETRIES_PER_CYCLE = 2
+
+
+class Simulator:
+    def __init__(self, scenario: dict, seed: int = 0):
+        self.scenario = normalize_scenario(scenario)
+        self.seed = seed
+        # independent seeded streams so e.g. adding a fault cannot shift
+        # the arrival sequence out from under a regression bisect:
+        # rng_workload is consumed ONLY by the fixed arrival sequence;
+        # draws whose count depends on fault timing (departure-completion
+        # coins, gang resubmissions) live on rng_lifecycle so toggling a
+        # fault never changes WHICH jobs arrive or their shapes
+        base = seed * 1_000_003
+        self.rng_workload = random.Random(base + 1)
+        self.rng_fault = random.Random(base + 2)
+        self.rng_metric = random.Random(base + 3)
+        self.rng_lifecycle = random.Random(base + 4)
+
+        self.client = make_fleet(self.scenario["fleet"])
+        self.faults = FaultPlan(self.scenario["faults"], self.rng_fault)
+        self._bind_hook = self.faults.make_bind_hook()
+        self._build_stack()
+        # the informer tap: the sim owns the watches and feeds the REAL
+        # controller handlers, with the fault layer in between
+        self._pod_watch = self.client.watch_pods()
+        self._node_watch = self.client.watch_nodes()
+
+        self.report = ReportBuilder(self.scenario, seed)
+        self.now = 0.0
+        self._heap: list[tuple[float, int, object, object]] = []
+        self._seq = itertools.count()
+        self._uid_seq = itertools.count()
+        self.jobs: list[Job] = []
+        self._pod_job: dict[str, Job] = {}
+        self._pending: list[str] = []  # pod names awaiting re-schedule
+
+    # -- construction --------------------------------------------------------
+    def _build_stack(self) -> None:
+        """(Re)build dealer + verbs — boot and the agent-restart fault."""
+        self.dealer = Dealer(
+            self.client, make_rater(self.scenario["policy"]), assume_workers=2
+        )
+        self.predicate = Predicate(self.dealer)
+        self.prioritize = Prioritize(self.dealer)
+        self.bind_verb = Bind(self.dealer)
+        self.client.before_bind = self._bind_hook
+        if hasattr(self, "controller"):
+            self.controller.dealer = self.dealer
+        else:
+            # never start()ed: the sim steps it deterministically
+            self.controller = Controller(
+                self.client, self.dealer, resync_period_s=0
+            )
+
+    def _push(self, t: float, kind: str, payload=None) -> None:
+        heapq.heappush(self._heap, (t, next(self._seq), kind, payload))
+
+    def _uid(self) -> str:
+        return f"simuid-{next(self._uid_seq)}"
+
+    # -- the run loop --------------------------------------------------------
+    def run(self, include_timing: bool = False) -> dict:
+        wall0 = time.perf_counter()
+        horizon = self.scenario["horizon_s"]
+        self._schedule_static_events(horizon)
+        n_since_check = 0
+        every = max(1, self.scenario["invariant_every_events"])
+        while self._heap:
+            t, _, kind, payload = heapq.heappop(self._heap)
+            if t >= horizon:
+                break
+            self.now = t
+            self._dispatch(kind, payload)
+            self._pump_informers()
+            self.report.events_processed += 1
+            n_since_check += 1
+            if n_since_check >= every:
+                n_since_check = 0
+                self._check(converged=False)
+        self._settle(horizon)
+        self.report.fault_counts = dict(self.faults.counts)
+        self.report.pods["pending_final"] = len(self._pending)
+        return self.report.build(
+            include_timing=include_timing,
+            wall_s=time.perf_counter() - wall0,
+            fleet=fleet_summary(self.client),
+        )
+
+    def _schedule_static_events(self, horizon: float) -> None:
+        w = self.scenario["workload"]
+        if w["kind"] == "poisson":
+            for t, config in poisson_arrivals(w, horizon, self.rng_workload):
+                self._push(t, "arrival", {"config": config})
+        else:
+            for t, config, entry in trace_arrivals(w, horizon):
+                self._push(t, "arrival", {"config": config, "trace": entry})
+        for t in self.faults.flap_times(horizon):
+            self._push(t, "flap", None)
+        for t in self.faults.restart_times(horizon):
+            self._push(t, "agent_restart", None)
+        metric_every, metric_delay = self.faults.metric_cadence()
+        if metric_every > 0:
+            t = metric_every
+            while t < horizon:
+                self._push(t, "metric_sync", {"delay": metric_delay})
+                t += metric_every
+        for name, every in (
+            ("resync", self.scenario["resync_every_s"]),
+            ("sample", self.scenario["sample_every_s"]),
+            ("retry", self.scenario["retry_every_s"]),
+        ):
+            if every > 0:
+                t = every
+                while t < horizon:
+                    self._push(t, name, None)
+                    t += every
+
+    def _dispatch(self, kind: str, payload) -> None:
+        if kind == "arrival":
+            self._on_arrival(payload)
+        elif kind == "departure":
+            self._on_departure(payload)
+        elif kind == "flap":
+            self._on_flap()
+        elif kind == "flap_restore":
+            self._on_flap_restore(payload)
+        elif kind == "agent_restart":
+            self._on_agent_restart()
+        elif kind == "metric_sync":
+            self._on_metric_sync(payload)
+        elif kind == "metric_apply":
+            self._on_metric_apply(payload)
+        elif kind == "resync":
+            self._on_resync()
+        elif kind == "sample":
+            self._on_sample()
+        elif kind == "retry":
+            self._on_retry()
+        elif kind == "gang_resubmit":
+            self._on_gang_resubmit(payload)
+        else:  # pragma: no cover - event kinds are closed within this file
+            raise AssertionError(f"unknown event kind {kind}")
+
+    # -- informer tap --------------------------------------------------------
+    def _pump_informers(self) -> None:
+        """Deliver queued watch events to the real controller handlers,
+        applying drop/duplicate faults, then drain the sync workqueue."""
+        delivered = True
+        while delivered:
+            delivered = False
+            for watch, handler in (
+                (self._node_watch, self.controller.handle_node_event),
+                (self._pod_watch, self.controller.handle_pod_event),
+            ):
+                while True:
+                    event = watch.poll(timeout=0.0)
+                    if event is None:
+                        break
+                    delivered = True
+                    if self.faults.drop_event():
+                        self.report.journal(
+                            self.now,
+                            f"drop {event.type} {event.obj.name}",
+                        )
+                        continue
+                    handler(event)
+                    if self.faults.duplicate_event():
+                        self.report.journal(
+                            self.now, f"dup {event.type} {event.obj.name}"
+                        )
+                        handler(event)
+        self.controller.drain_sync()
+
+    # -- scheduling cycle ----------------------------------------------------
+    def _live_node_names(self) -> list[str]:
+        return sorted(n.name for n in self.client.list_nodes())
+
+    def _try_schedule(self, job: Job, pod: Pod) -> bool:
+        node_names = self._live_node_names()
+        if not node_names:
+            return False
+        args = {"Pod": pod.raw, "NodeNames": node_names}
+        t0 = time.perf_counter()
+        filt = self.predicate.handle(args)
+        self.report.observe_verb("filter", time.perf_counter() - t0)
+        feasible = set(filt["NodeNames"])
+        if not feasible:
+            return False
+        t0 = time.perf_counter()
+        scored = self.prioritize.handle(args)
+        self.report.observe_verb("prioritize", time.perf_counter() - t0)
+        ranked = sorted(
+            ((name, score) for name, score in scored if name in feasible),
+            key=lambda ns: (-ns[1], ns[0]),
+        )
+        for attempt, (best, _) in enumerate(ranked):
+            if attempt > BIND_RETRIES_PER_CYCLE:
+                break
+            t0 = time.perf_counter()
+            result = self.bind_verb.handle({
+                "PodName": pod.name,
+                "PodNamespace": pod.namespace,
+                "PodUID": pod.uid,
+                "Node": best,
+            })
+            self.report.observe_verb("bind", time.perf_counter() - t0)
+            if not result["Error"]:
+                job.bound_t[pod.name] = self.now
+                self.report.pods["bound"] += 1
+                self.report.config_count(job.config, "bound")
+                self.report.journal(self.now, f"bind {pod.name} -> {best}")
+                if job.gang and job.fully_bound():
+                    self.report.gang_waits_s.append(
+                        round(self.now - job.arrival_t, 6)
+                    )
+                    self.report.journal(
+                        self.now, f"gang-complete {job.gang}"
+                    )
+                return True
+            self.report.pods["bind_errors"] += 1
+            self.report.journal(
+                self.now, f"bind-error {pod.name} @ {best}"
+            )
+        return False
+
+    # -- event handlers ------------------------------------------------------
+    def _admit_job(self, job: Job) -> None:
+        self.jobs.append(job)
+        created: list[Pod] = []
+        for pod in job.pods:
+            created.append(self.client.create_pod(pod))
+            self._pod_job[pod.name] = job
+        job.pods = created  # keep the server-side copies (resourceVersion)
+        self.report.pods["arrived"] += job.size
+        self.report.config_count(job.config, "arrived", job.size)
+        self.report.journal(
+            self.now, f"arrive {job.config}-{job.id} x{job.size}"
+        )
+        for pod in job.pods:
+            if not self._try_schedule(job, pod):
+                self._pending.append(pod.name)
+        self._push(self.now + job.lifetime_s, "departure", job)
+
+    def _on_arrival(self, payload: dict) -> None:
+        w = self.scenario["workload"]
+        trace = payload.get("trace") or {}
+        # explicit trace overrides win even when falsy (lifetime_s: 0 ==
+        # depart immediately); only absence falls back to the scenario
+        life = trace.get("lifetime_s")
+        if life is None:
+            life = draw_lifetime(w["lifetime_s"], self.rng_workload)
+        gang_size = trace.get("gang_size")
+        replicas = trace.get("replicas")
+        job = build_job(
+            job_id=len(self.jobs),
+            config=payload["config"],
+            arrival_t=self.now,
+            lifetime_s=float(life),
+            rng=self.rng_workload,
+            uid_of=lambda name: self._uid(),
+            gang_size=int(w["gang_size"] if gang_size is None else gang_size),
+            replicas=int(w["replicas"] if replicas is None else replicas),
+        )
+        self._admit_job(job)
+
+    def _remove_pod(self, pod: Pod, complete_first: bool) -> None:
+        """Take one pod out of the cluster, optionally through the
+        Succeeded phase first (exercises release-on-completion as well as
+        release-on-delete)."""
+        if complete_first:
+            try:
+                fresh = self.client.get_pod(pod.namespace, pod.name)
+            except Exception:
+                return
+            fresh.raw.setdefault("status", {})["phase"] = "Succeeded"
+            self.client.update_pod(fresh)
+        try:
+            self.client.delete_pod(pod.namespace, pod.name)
+        except Exception:
+            return
+        if pod.name in self._pending:
+            self._pending.remove(pod.name)
+        self._pod_job.pop(pod.name, None)
+
+    def _on_departure(self, job: Job) -> None:
+        if job.departed:
+            return
+        job.departed = True
+        n = 0
+        for pod in job.pods:
+            if pod.name in self._pod_job:
+                self._remove_pod(
+                    pod, complete_first=self.rng_lifecycle.random() < 0.5
+                )
+                n += 1
+        self.report.pods["departed"] += n
+        self.report.config_count(job.config, "departed", n)
+        self.report.journal(self.now, f"depart {job.config}-{job.id} x{n}")
+
+    def _on_flap(self) -> None:
+        names = self._live_node_names()
+        if not names:
+            return
+        victim = self.rng_fault.choice(names)
+        raw = plain_copy(self.client.get_node(victim).raw)
+        self.faults.counts["node_flaps"] += 1
+        self.report.journal(self.now, f"flap {victim}")
+        self.client.delete_node(victim)
+        # evict the victim's pods; a gang that lost a member dies whole
+        # (a JAX job cannot run short) and is resubmitted
+        gangs_killed: list[Job] = []
+        for pod in self.client.list_pods():
+            if pod.node_name != victim or pod.name not in self._pod_job:
+                continue
+            job = self._pod_job[pod.name]
+            if job.gang and not job.departed and job not in gangs_killed:
+                gangs_killed.append(job)
+                continue
+            self._remove_pod(pod, complete_first=False)
+            self.faults.counts["pods_evicted"] += 1
+            self.report.pods["evicted"] += 1
+        for job in gangs_killed:
+            self._kill_gang(job)
+        self._push(self.now + self.faults.flap_down_s, "flap_restore", raw)
+
+    def _kill_gang(self, job: Job) -> None:
+        job.departed = True
+        for pod in job.pods:
+            if pod.name in self._pod_job:
+                self._remove_pod(pod, complete_first=False)
+                self.faults.counts["pods_evicted"] += 1
+                self.report.pods["evicted"] += 1
+        self.faults.counts["gangs_killed"] += 1
+        self.report.journal(self.now, f"gang-killed {job.gang}")
+        self._push(
+            self.now + GANG_RESUBMIT_DELAY_S, "gang_resubmit",
+            {"job": job, "incarnation": job.incarnation + 1},
+        )
+
+    def _on_gang_resubmit(self, payload: dict) -> None:
+        old: Job = payload["job"]
+        incarnation = payload.get("incarnation", 1)
+        w = self.scenario["workload"]
+        job = build_job(
+            job_id=old.id,
+            config=old.config,
+            arrival_t=self.now,
+            lifetime_s=draw_lifetime(w["lifetime_s"], self.rng_lifecycle),
+            rng=self.rng_lifecycle,
+            uid_of=lambda name: self._uid(),
+            gang_size=old.size,
+            incarnation=incarnation,
+        )
+        self._admit_job(job)
+
+    def _on_flap_restore(self, raw: dict) -> None:
+        name = (raw.get("metadata") or {}).get("name", "")
+        try:
+            self.client.get_node(name)
+            return  # already back (double restore cannot happen, defensive)
+        except Exception:
+            pass
+        self.client.create_node(Node(plain_copy(raw)))
+        self.report.journal(self.now, f"restore {name}")
+
+    def _on_agent_restart(self) -> None:
+        occ_before = self.dealer.occupancy()
+        self.dealer.close()
+        self._build_stack()
+        occ_after = self.dealer.occupancy()
+        # the rebuilt dealer must agree with the DURABLE state (live pod
+        # annotations), not with the old dealer's in-memory view — which
+        # may legitimately be stale mid-run (e.g. a dropped DELETE event
+        # the next resync would have repaired)
+        occ_truth = ground_truth_occupancy(self.dealer, self.client)
+        drift = abs(occ_after - occ_truth)
+        self.faults.counts["agent_restarts"] += 1
+        self.report.restart_occupancy_drift = max(
+            self.report.restart_occupancy_drift, drift
+        )
+        self.report.journal(
+            self.now,
+            f"agent-restart occ {occ_before:.6f} -> {occ_after:.6f} "
+            f"(truth {occ_truth:.6f})",
+        )
+        if drift > 1e-9:
+            self.report.violations.append({
+                "kind": "restart_occupancy_drift",
+                "detail": (
+                    f"annotation-replay restart rebuilt occupancy "
+                    f"{occ_after:.6f} but live annotations say "
+                    f"{occ_truth:.6f}"
+                ),
+            })
+
+    def _on_metric_sync(self, payload: dict) -> None:
+        self.faults.counts["metric_syncs"] += 1
+        samples = []
+        infos = self.dealer.debug_snapshot()["node_infos"]
+        for name in self._live_node_names():
+            info = infos.get(name)
+            if info is not None:
+                n_chips = len(info.chips.chips)
+            else:
+                # dealer doesn't know the node yet (e.g. its ADDED event
+                # was dropped): derive the chip count from capacity — a
+                # constant would undersample 8-chip generations (v5e/v6e)
+                node = self.client.get_node(name)
+                n_chips = (
+                    node.capacity(types.RESOURCE_TPU_PERCENT)
+                    // types.PERCENT_PER_CHIP
+                )
+            for chip in range(n_chips):
+                samples.append(
+                    (name, chip, round(self.rng_metric.random() * 0.9, 4))
+                )
+        delay = float(payload["delay"])
+        if delay > 0:
+            self.faults.counts["metric_samples_delayed"] += len(samples)
+        self._push(self.now + delay, "metric_apply", samples)
+
+    def _on_metric_apply(self, samples: list) -> None:
+        for node, chip, core in samples:
+            self.dealer.update_chip_usage(node, chip, core=core, now=self.now)
+
+    def _on_resync(self) -> None:
+        self.controller.resync_once()
+        self.controller.drain_sync()
+
+    def _on_sample(self) -> None:
+        occ = self.dealer.occupancy()
+        frag = fragmentation_of(self.dealer)
+        self.report.sample(occ, frag)
+        self.report.journal(
+            self.now, f"sample occ={occ:.6f} frag={frag:.4f}"
+        )
+
+    def _on_retry(self) -> None:
+        if not self._pending:
+            return
+        still: list[str] = []
+        for name in self._pending:
+            job = self._pod_job.get(name)
+            if job is None or job.departed:
+                continue  # departed before it ever placed
+            try:
+                pod = self.client.get_pod("default", name)
+            except Exception:
+                continue
+            self.report.pods["schedule_retries"] += 1
+            if not self._try_schedule(job, pod):
+                still.append(name)
+        self._pending = still
+
+    # -- invariants + settle -------------------------------------------------
+    def _check(self, converged: bool) -> None:
+        violations = check_invariants(
+            self.dealer, self.client, converged=converged
+        )
+        self.report.invariant_checks += 1
+        if violations:
+            self.report.violations.extend(violations)
+            self.report.journal(
+                self.now,
+                f"VIOLATIONS {len(violations)} "
+                + ",".join(sorted({v['kind'] for v in violations})),
+            )
+
+    def _settle(self, horizon: float) -> None:
+        """Stop the fault tap, deliver everything in flight, reconcile,
+        and run the convergence invariants + final sample."""
+        self.now = horizon
+        self.faults.armed = False
+        self._pump_informers()
+        self.controller.resync_once()
+        self.controller.drain_sync()
+        self._pump_informers()
+        self._check(converged=True)
+        self.report.final_occupancy = self.dealer.occupancy()
+        self.report.final_fragmentation = fragmentation_of(self.dealer)
+        self.report.journal(
+            horizon,
+            f"settle occ={self.report.final_occupancy:.6f} "
+            f"frag={self.report.final_fragmentation:.4f}",
+        )
+
+
+def run_scenario(scenario: dict, seed: int = 0,
+                 include_timing: bool = False) -> dict:
+    """One fresh simulator run (the programmatic entry point)."""
+    return Simulator(scenario, seed).run(include_timing=include_timing)

@@ -1,0 +1,110 @@
+"""Fault-injection layer: the failure taxonomy the scheduler must survive.
+
+Every fault is seeded and counted, so a failing scenario names exactly what
+it injected. The taxonomy (scenario ``faults`` section):
+
+* ``node_flap``     — a node object is DELETED mid-run (its pods evicted)
+  and re-created ``down_s`` later, exercising
+  ``Dealer.remove_node``/``observe_node`` and gang-member loss. Gangs that
+  lose a member are killed whole and resubmitted (a real JAX job dies with
+  any worker).
+* ``bind_failure``  — the pods/binding API call raises (injected through
+  ``FakeClientset.before_bind``); the dealer must roll chip accounting
+  back and the pod retries.
+* ``drop_event``    — an informer watch event is never delivered; the
+  controller's periodic resync must repair the divergence.
+* ``dup_event``     — an event is delivered twice; every handler must be
+  idempotent.
+* ``metric_sync``   — chip load samples arrive every ``every_s``, applied
+  ``delay_s`` late (delayed metric-sync): scoring must degrade, never
+  crash or drift accounting.
+* ``agent_restart`` — the Dealer is torn down and rebuilt from cluster
+  annotations at the listed times (``Dealer._warm_from_cluster`` replay);
+  occupancy must round-trip exactly.
+"""
+
+from __future__ import annotations
+
+import random
+
+from nanotpu.k8s.client import ApiError
+
+
+class FaultPlan:
+    """Seeded per-run fault decisions + injection counters."""
+
+    def __init__(self, spec: dict, rng: random.Random):
+        self.spec = spec
+        self.rng = rng
+        #: set False during the settle phase: convergence is only checkable
+        #: once the fault tap stops perturbing the event stream
+        self.armed = True
+        self.counts = {
+            "node_flaps": 0,
+            "pods_evicted": 0,
+            "gangs_killed": 0,
+            "events_dropped": 0,
+            "events_duplicated": 0,
+            "binds_failed_injected": 0,
+            "agent_restarts": 0,
+            "metric_syncs": 0,
+            "metric_samples_delayed": 0,
+        }
+
+    # -- schedule-time queries (used once, at sim setup) --------------------
+    def flap_times(self, horizon_s: float) -> list[float]:
+        every = float(self.spec["node_flap"].get("every_s", 0) or 0)
+        if every <= 0:
+            return []
+        # first flap at every_s, then periodic; jitter would add nothing —
+        # the flapped NODE is already drawn from the seeded rng
+        return [t * every for t in range(1, int(horizon_s / every) + 1)
+                if t * every < horizon_s]
+
+    @property
+    def flap_down_s(self) -> float:
+        return float(self.spec["node_flap"].get("down_s", 3.0))
+
+    def restart_times(self, horizon_s: float) -> list[float]:
+        return sorted(
+            float(t) for t in self.spec["agent_restart"].get("at_s", [])
+            if 0 < float(t) < horizon_s
+        )
+
+    def metric_cadence(self) -> tuple[float, float]:
+        """(every_s, delay_s); every_s <= 0 disables the metric pipeline."""
+        ms = self.spec["metric_sync"]
+        return float(ms.get("every_s", 0) or 0), float(ms.get("delay_s", 0.0))
+
+    # -- event-time decisions (seeded; order of calls is deterministic) -----
+    def drop_event(self) -> bool:
+        if not self.armed:
+            return False
+        if self.rng.random() < float(self.spec["drop_event"].get("prob", 0)):
+            self.counts["events_dropped"] += 1
+            return True
+        return False
+
+    def duplicate_event(self) -> bool:
+        if not self.armed:
+            return False
+        if self.rng.random() < float(self.spec["dup_event"].get("prob", 0)):
+            self.counts["events_duplicated"] += 1
+            return True
+        return False
+
+    def make_bind_hook(self):
+        """A ``FakeClientset.before_bind`` callable, or None when the
+        fault is disabled. Installed once per dealer incarnation."""
+        prob = float(self.spec["bind_failure"].get("prob", 0))
+        if prob <= 0:
+            return None
+
+        def hook(namespace: str, name: str, node: str) -> None:
+            if self.armed and self.rng.random() < prob:
+                self.counts["binds_failed_injected"] += 1
+                raise ApiError(
+                    f"injected bind failure for {namespace}/{name}", code=503
+                )
+
+        return hook

@@ -1,0 +1,113 @@
+"""Synthetic fleet builders: TPU node pools for the simulator and mocks.
+
+One source of truth for "what does a v5p pool look like as K8s nodes",
+shared by the simulator, ``nanotpu.cmd.main --mock`` and bench.py (which
+previously each hand-rolled node grids). A pool is hosts of one TPU
+generation partitioned into ICI slices; each slice lays its hosts on a
+square-ish grid (the ``tpu.io/slice-coords`` convention the gang scorer
+consumes, see :mod:`nanotpu.topology`).
+
+Sizes are expressed in HOSTS; chips per host default to the generation's
+host topology (v4/v5p: 4 chips as 2x2x1, v5e/v6e: 8 as 2x4x1). A v5p-512
+pool is therefore ``hosts=128`` (512 chips), e.g. 8 slices of 16 hosts
+(eight v5p-64 ICI domains).
+"""
+
+from __future__ import annotations
+
+from nanotpu import types
+from nanotpu.k8s.client import FakeClientset
+from nanotpu.k8s.objects import Node, make_node
+from nanotpu.topology import DEFAULT_HOST_TOPOLOGY, HOST_CHIPS
+
+
+def pool_nodes(
+    hosts: int,
+    generation: str = "v5p",
+    chips_per_host: int | None = None,
+    slice_hosts: int | None = None,
+    prefix: str | None = None,
+    slice_prefix: str = "slice",
+) -> list[Node]:
+    """Nodes of one pool: ``hosts`` hosts split into slices of
+    ``slice_hosts`` (default: one slice holds the whole pool). Host coords
+    inside a slice go on a ``side x ceil(n/side)`` grid with
+    ``side = int(sqrt(slice_hosts))`` — the same layout
+    ``cmd.main.make_mock_cluster`` always used, kept so existing mock
+    clusters and benches are bit-identical."""
+    if hosts < 1:
+        raise ValueError(f"pool needs at least 1 host, got {hosts}")
+    chips = chips_per_host or HOST_CHIPS.get(generation, 4)
+    topo = DEFAULT_HOST_TOPOLOGY.get(generation, "2x2x1")
+    per_slice = slice_hosts or hosts
+    if per_slice < 1:
+        raise ValueError(f"slice_hosts must be >= 1, got {slice_hosts}")
+    name_prefix = prefix or f"{generation}-host"
+    side = max(1, int(per_slice ** 0.5))
+    out: list[Node] = []
+    for i in range(hosts):
+        s, j = divmod(i, per_slice)
+        hx, hy = j % side, j // side
+        out.append(
+            make_node(
+                f"{name_prefix}-{i}",
+                {types.RESOURCE_TPU_PERCENT: chips * types.PERCENT_PER_CHIP},
+                labels={
+                    types.LABEL_TPU_GENERATION: generation,
+                    types.LABEL_TPU_TOPOLOGY: topo,
+                    types.LABEL_TPU_SLICE: f"{slice_prefix}-{s}",
+                    types.LABEL_TPU_SLICE_COORDS: f"{hx},{hy},0",
+                    types.LABEL_TPU_ENABLE: types.LABEL_TPU_ENABLE_VALUE,
+                },
+            )
+        )
+    return out
+
+
+def make_fleet(spec: dict, client: FakeClientset | None = None) -> FakeClientset:
+    """Build a FakeClientset from a fleet spec (scenario ``fleet`` section)::
+
+        {"pools": [
+            {"generation": "v5p", "hosts": 128, "slice_hosts": 16},
+            {"generation": "v4", "hosts": 2, "prefix": "v4-host"},
+        ]}
+
+    Pools are created in listed order; node names must not collide across
+    pools (give each pool a distinct ``prefix``).
+    """
+    client = client or FakeClientset()
+    pools = spec.get("pools")
+    if not pools:
+        raise ValueError("fleet spec needs a non-empty 'pools' list")
+    seen: set[str] = set()
+    for p, pool in enumerate(pools):
+        nodes = pool_nodes(
+            hosts=int(pool.get("hosts", 1)),
+            generation=pool.get("generation", "v5p"),
+            chips_per_host=pool.get("chips_per_host"),
+            slice_hosts=pool.get("slice_hosts"),
+            prefix=pool.get("prefix"),
+            slice_prefix=pool.get("slice_prefix", f"slice{p}" if p else "slice"),
+        )
+        for node in nodes:
+            if node.name in seen:
+                raise ValueError(
+                    f"fleet node name collision: {node.name!r} (give pool "
+                    f"{p} a distinct 'prefix')"
+                )
+            seen.add(node.name)
+            client.create_node(node)
+    return client
+
+
+def fleet_summary(client: FakeClientset) -> dict:
+    """Deterministic fleet digest for the report header."""
+    nodes = client.list_nodes()
+    chips = sum(
+        n.capacity(types.RESOURCE_TPU_PERCENT) // types.PERCENT_PER_CHIP
+        for n in nodes
+    )
+    slices = sorted(
+        {n.labels.get(types.LABEL_TPU_SLICE, "") for n in nodes} - {""}
+    )
+    return {"nodes": len(nodes), "chips": chips, "slices": len(slices)}

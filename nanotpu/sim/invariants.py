@@ -1,0 +1,188 @@
+"""Safety invariants checked after every simulated event.
+
+Three always-on families plus two convergence checks (only valid once the
+event stream has settled and a resync has run — mid-run the dealer may
+legitimately lag the cluster, e.g. a dropped DELETE not yet repaired):
+
+always:
+  * ``chip_oversubscribed``         — a NodeInfo chip's accounting left
+    [0, total] (dealer's own view corrupted)
+  * ``ground_truth_oversubscribed`` — live bound pods' annotations commit
+    more than 100% (or more HBM than exists) on some chip: the scheduler
+    double-booked, regardless of what the dealer thinks
+  * ``orphaned_reservation``        — a strict-gang chip reservation parked
+    with no bind in flight (single-threaded driver == always a leak)
+  * ``codec_roundtrip``             — an assumed pod's annotations don't
+    survive decode -> encode through :mod:`nanotpu.utils.pod`, or no Plan
+    reconstructs from them (an agent restart would lose the placement)
+
+converged:
+  * ``tracked_vanished``     — the dealer tracks a pod the cluster no
+    longer has
+  * ``accounting_mismatch``  — dealer per-chip usage != usage recomputed
+    from live pod annotations (the durable-checkpoint contract)
+"""
+
+from __future__ import annotations
+
+from nanotpu import types
+from nanotpu.allocator.core import ChipSet
+from nanotpu.dealer.dealer import plan_from_pod
+from nanotpu.utils import pod as podutil
+
+
+def _violation(kind: str, detail: str) -> dict:
+    return {"kind": kind, "detail": detail}
+
+
+def _ground_truth_usage(client) -> tuple[dict[str, dict[int, int]], list[dict]]:
+    """Per-node per-chip percent committed by live, bound, assumed,
+    non-completed pods' annotations — the durable K8s view the dealer must
+    agree with. Also returns codec violations found on the way."""
+    usage: dict[str, dict[int, int]] = {}
+    violations: list[dict] = []
+    for pod in client.list_pods():
+        if not podutil.is_assumed(pod) or not pod.node_name:
+            continue
+        if podutil.is_completed_pod(pod):
+            continue
+        chips = podutil.get_assigned_chips(pod)
+        if chips is None:
+            violations.append(_violation(
+                "codec_roundtrip",
+                f"pod {pod.key()} is assumed but its chip annotations do "
+                "not decode",
+            ))
+            continue
+        for cname, ids in chips.items():
+            # ids was decoded from this very annotation, so comparing its
+            # decode against ids would be vacuous; the real property is
+            # that the stored form IS the canonical encoding of what it
+            # decodes to — the dealer only ever writes encode_chips()
+            # output, so any drift (unsorted, duplicated, alternate
+            # sentinel spelling) means something else touched it and an
+            # agent restart would rewrite the annotation it re-learns from
+            stored = pod.annotations.get(
+                types.ANNOTATION_CONTAINER_FMT.format(name=cname), ""
+            )
+            if podutil.encode_chips(ids) != stored:
+                violations.append(_violation(
+                    "codec_roundtrip",
+                    f"pod {pod.key()} container {cname}: annotation "
+                    f"{stored!r} is not the canonical encoding of its own "
+                    f"decode {ids}",
+                ))
+        plan = plan_from_pod(pod)
+        if plan is None:
+            violations.append(_violation(
+                "codec_roundtrip",
+                f"pod {pod.key()}: no Plan reconstructs from annotations "
+                "(an agent restart would lose this placement)",
+            ))
+            continue
+        node_usage = usage.setdefault(pod.node_name, {})
+        for i, chip_ids in enumerate(plan.assignments):
+            if not chip_ids:
+                continue
+            split = ChipSet._per_chip_split(
+                plan.demand.percents[i], len(chip_ids)
+            )
+            for chip_id, p in zip(chip_ids, split):
+                node_usage[chip_id] = node_usage.get(chip_id, 0) + p
+    return usage, violations
+
+
+def ground_truth_occupancy(dealer, client) -> float:
+    """Fleet occupancy recomputed purely from live pod annotations over
+    the dealer's tracked chip capacity — what a dealer rebuilt from the
+    cluster (``_warm_from_cluster``) must report EXACTLY. The in-memory
+    dealer may legitimately lag this mid-run (a dropped DELETE event not
+    yet repaired by resync), which is why the agent-restart check compares
+    against this and not against the pre-restart dealer's view."""
+    truth, _ = _ground_truth_usage(client)
+    snap = dealer.debug_snapshot()
+    used = sum(sum(chips.values()) for chips in truth.values())
+    total = sum(
+        chip.percent_total
+        for info in snap["node_infos"].values()
+        for chip in info.chips.chips
+    )
+    return used / total if total else 0.0
+
+
+def check_invariants(dealer, client, converged: bool = False) -> list[dict]:
+    """All violated invariants (empty list == healthy). ``converged`` adds
+    the dealer-vs-cluster equality checks; only set it when no events are
+    in flight and a resync has just completed."""
+    violations: list[dict] = []
+    snap = dealer.debug_snapshot()
+
+    # dealer's own chip accounting stayed in range
+    for name in sorted(snap["node_infos"]):
+        info = snap["node_infos"][name]
+        for i, chip in enumerate(info.chips.chips):
+            if not 0 <= chip.percent_free <= chip.percent_total:
+                violations.append(_violation(
+                    "chip_oversubscribed",
+                    f"node {name} chip {i}: {chip.percent_free}% free of "
+                    f"{chip.percent_total}% total",
+                ))
+            if chip.hbm_total_mib and not (
+                0 <= chip.hbm_free_mib <= chip.hbm_total_mib
+            ):
+                violations.append(_violation(
+                    "chip_oversubscribed",
+                    f"node {name} chip {i}: {chip.hbm_free_mib} MiB HBM "
+                    f"free of {chip.hbm_total_mib}",
+                ))
+
+    # no reservation outlives its bind
+    for uid in snap["reserved_uids"]:
+        violations.append(_violation(
+            "orphaned_reservation",
+            f"pod uid {uid} holds a parked chip reservation with no bind "
+            "in flight",
+        ))
+
+    # the durable K8s view: annotations decode, and never double-book
+    truth, codec_violations = _ground_truth_usage(client)
+    violations.extend(codec_violations)
+    for node in sorted(truth):
+        for chip_id in sorted(truth[node]):
+            used = truth[node][chip_id]
+            if used > 100:
+                violations.append(_violation(
+                    "ground_truth_oversubscribed",
+                    f"node {node} chip {chip_id}: live pod annotations "
+                    f"commit {used}%",
+                ))
+
+    if converged:
+        live_uids = {p.uid for p in client.list_pods()}
+        for uid in snap["tracked_uids"]:
+            if uid not in live_uids:
+                violations.append(_violation(
+                    "tracked_vanished",
+                    f"dealer tracks pod uid {uid} which the cluster no "
+                    "longer has",
+                ))
+        for name in sorted(snap["node_infos"]):
+            info = snap["node_infos"][name]
+            node_truth = truth.get(name, {})
+            for i, chip in enumerate(info.chips.chips):
+                want = node_truth.get(i, 0)
+                if chip.percent_used != want:
+                    violations.append(_violation(
+                        "accounting_mismatch",
+                        f"node {name} chip {i}: dealer accounts "
+                        f"{chip.percent_used}% used, annotations say {want}%",
+                    ))
+        # annotated usage on nodes the dealer no longer knows is also a
+        # mismatch: those chips exist nowhere in the dealer's accounting
+        for node in sorted(set(truth) - set(snap["node_infos"])):
+            violations.append(_violation(
+                "accounting_mismatch",
+                f"live pods hold chips on node {node} which the dealer "
+                "does not track",
+            ))
+    return violations

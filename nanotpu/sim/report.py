@@ -1,0 +1,195 @@
+"""Structured sim report: metric aggregation + the determinism contract.
+
+The report splits in two:
+
+* the **deterministic section** — everything derived from virtual time,
+  chip counts, and seeded draws. Byte-identical across runs of the same
+  (scenario, seed); ``render()`` serializes it with sorted keys, and
+  ``digest`` is a sha256 over the per-event journal so even two reports
+  that happen to aggregate equal can be told apart from two identical
+  RUNS.
+* the **timing section** — wall-clock Filter/Prioritize/Bind latency
+  percentiles through the real verb objects. Real time is not
+  reproducible, so this section is opt-in (``--timing`` / include_timing)
+  and never feeds the digest.
+
+Fragmentation is two-level, matching how a gang actually lands: chips
+within a host must be ICI-contiguous on the host torus
+(:meth:`nanotpu.topology.Torus.compactness`), and a multi-host gang's
+hosts must be adjacent on the slice host-grid (the same
+``_grid_compactness`` the gang scorer awards its bonus with). Each level
+is a free-chip-weighted mean compactness of the FREE capacity; the fleet
+score is ``1 - intra * inter``, so 0.0 means every free chip sits in a
+contiguous block on a contiguous run of hosts (a new gang can land on
+ICI) and values toward 1.0 mean free capacity is scattered fragments no
+sub-torus demand can use. Host-level matters most: a 4-chip host's free
+set is almost always compact, but churn strews free HOSTS across the
+slice grid.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from nanotpu.dealer.gang import _grid_compactness
+from nanotpu.metrics.stats import summarize
+from nanotpu.topology import parse_slice_coords
+
+
+def fragmentation_of(dealer) -> float:
+    """Fleet ICI-fragmentation in [0, 1] from the dealer's live accounting
+    (0 == all free capacity contiguous; see module docstring)."""
+    snap = dealer.debug_snapshot()
+    intra_weighted = 0.0
+    total_free = 0
+    # slice name -> (free-host coords, free whole chips on them)
+    slices: dict[str, tuple[list, int]] = {}
+    for name in sorted(snap["node_infos"]):
+        info = snap["node_infos"][name]
+        free = frozenset(
+            i for i, c in enumerate(info.chips.chips)
+            if c.percent_free == c.percent_total
+        )
+        if not free:
+            continue
+        intra_weighted += info.chips.torus.compactness(free) * len(free)
+        total_free += len(free)
+        # nodes without slice labels are their own singleton slice
+        key = info.slice_name or f"__solo__{name}"
+        try:
+            coord = parse_slice_coords(info.slice_coords)
+        except Exception:
+            coord = (0, 0, 0)
+        coords, chips = slices.get(key, ([], 0))
+        coords.append(coord)
+        slices[key] = (coords, chips + len(free))
+    if total_free == 0:
+        return 0.0  # nothing free: nothing to fragment
+    inter_weighted = sum(
+        _grid_compactness(coords) * chips
+        for coords, chips in slices.values()
+    )
+    intra = intra_weighted / total_free
+    inter = inter_weighted / total_free
+    return round(1.0 - intra * inter, 4)
+
+
+class ReportBuilder:
+    """Accumulates sim observations; ``build()`` emits the final dict."""
+
+    def __init__(self, scenario: dict, seed: int):
+        self.scenario = scenario
+        self.seed = seed
+        self._journal = hashlib.sha256()
+        self._journal_lines = 0
+        self.events_processed = 0
+        self.pods = {
+            "arrived": 0, "bound": 0, "departed": 0, "evicted": 0,
+            "bind_errors": 0, "schedule_retries": 0, "pending_final": 0,
+        }
+        self.per_config: dict[str, dict[str, int]] = {}
+        self.gang_waits_s: list[float] = []
+        self.occupancy_samples: list[float] = []
+        self.fragmentation_samples: list[float] = []
+        self.verb_counts = {"filter": 0, "prioritize": 0, "bind": 0}
+        self.verb_wall_s: dict[str, list[float]] = {
+            "filter": [], "prioritize": [], "bind": [],
+        }
+        self.invariant_checks = 0
+        self.violations: list[dict] = []
+        self.fault_counts: dict[str, int] = {}
+        self.restart_occupancy_drift = 0.0
+        self.final_occupancy = 0.0
+        self.final_fragmentation = 0.0
+
+    # -- journal: the determinism witness -----------------------------------
+    def journal(self, t: float, what: str) -> None:
+        """One line per consequential event outcome. The digest of this
+        stream IS the determinism contract: any divergence in event order,
+        placement decision, or fault draw changes it."""
+        self._journal.update(f"{t:.6f} {what}\n".encode())
+        self._journal_lines += 1
+
+    def config_count(self, config: str, key: str, n: int = 1) -> None:
+        entry = self.per_config.setdefault(
+            config, {"arrived": 0, "bound": 0, "departed": 0}
+        )
+        entry[key] = entry.get(key, 0) + n
+
+    def sample(self, occupancy: float, fragmentation: float) -> None:
+        self.occupancy_samples.append(occupancy)
+        self.fragmentation_samples.append(fragmentation)
+
+    def observe_verb(self, verb: str, wall_s: float) -> None:
+        self.verb_counts[verb] += 1
+        self.verb_wall_s[verb].append(wall_s)
+
+    # -- final assembly -----------------------------------------------------
+    def build(self, include_timing: bool = False, wall_s: float = 0.0,
+              fleet: dict | None = None) -> dict:
+        occ = self.occupancy_samples
+        frag = self.fragmentation_samples
+        kinds: dict[str, int] = {}
+        for v in self.violations:
+            kinds[v["kind"]] = kinds.get(v["kind"], 0) + 1
+        report = {
+            "scenario": self.scenario["name"],
+            "policy": self.scenario["policy"],
+            "seed": self.seed,
+            "horizon_s": self.scenario["horizon_s"],
+            "fleet": fleet or {},
+            "events_processed": self.events_processed,
+            "pods": dict(self.pods),
+            "configs": {k: self.per_config[k] for k in sorted(self.per_config)},
+            "gangs": {
+                "jobs": len(self.gang_waits_s),
+                "wait_s": summarize(self.gang_waits_s),
+            },
+            "occupancy_pct": {
+                "mean": round(100 * sum(occ) / len(occ), 2) if occ else 0.0,
+                "peak": round(100 * max(occ), 2) if occ else 0.0,
+                "final": round(100 * self.final_occupancy, 2),
+            },
+            "fragmentation": {
+                "mean": round(sum(frag) / len(frag), 4) if frag else 0.0,
+                "peak": round(max(frag), 4) if frag else 0.0,
+                "final": self.final_fragmentation,
+            },
+            "verbs": dict(self.verb_counts),
+            "faults": dict(sorted(self.fault_counts.items())),
+            "restart_occupancy_drift_pct": round(
+                100 * self.restart_occupancy_drift, 6
+            ),
+            "invariants": {
+                "checks": self.invariant_checks,
+                "violations": len(self.violations),
+                "violation_kinds": dict(sorted(kinds.items())),
+                # first few, so a red run names its failure without logs
+                "first": self.violations[:5],
+            },
+            "digest": "sha256:" + self._journal.hexdigest(),
+            "journal_lines": self._journal_lines,
+        }
+        if include_timing:
+            report["timing"] = {
+                "note": "wall-clock; excluded from the determinism contract",
+                "wall_s": round(wall_s, 3),
+                "latency_ms": {
+                    verb: summarize(samples, scale=1e3)
+                    for verb, samples in self.verb_wall_s.items()
+                },
+            }
+        return report
+
+
+def render(report: dict) -> str:
+    """Canonical serialization: sorted keys, no float repr surprises
+    (every float in the report is pre-rounded)."""
+    return json.dumps(report, sort_keys=True, separators=(",", ":"))
+
+
+def strip_timing(report: dict) -> dict:
+    out = dict(report)
+    out.pop("timing", None)
+    return out

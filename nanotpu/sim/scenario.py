@@ -1,0 +1,132 @@
+"""Scenario schema: parse + validate the JSON that drives a simulation.
+
+A scenario file is the single reproducible artifact of a sim run: fleet,
+workload, fault plan, and cadence knobs. ``load_scenario`` normalizes every
+field to its default so the rest of the package never touches raw dicts
+defensively. Schema (see docs/simulation.md for the full field reference)::
+
+    {
+      "name": "smoke",
+      "fleet": {"pools": [{"generation": "v5p", "hosts": 16,
+                           "slice_hosts": 8}]},
+      "policy": "binpack",
+      "horizon_s": 30.0,
+      "workload": {
+        "kind": "poisson",           # or "trace"
+        "rate_per_s": 1.2,           # job arrival rate (poisson)
+        "mix": {"fractional": 0.3, "spread": 0.2, "multi_container": 0.2,
+                "gang_llama": 0.15, "mixtral": 0.15},
+        "lifetime_s": {"dist": "exp", "mean": 12.0},
+        "gang_size": 8,
+        "arrivals": []               # trace mode: explicit [{t, config, ...}]
+      },
+      "faults": {
+        "node_flap": {"every_s": 6.0, "down_s": 3.0},
+        "bind_failure": {"prob": 0.05},
+        "drop_event": {"prob": 0.03},
+        "dup_event": {"prob": 0.03},
+        "metric_sync": {"every_s": 2.0, "delay_s": 1.0},
+        "agent_restart": {"at_s": [15.0]}
+      },
+      "resync_every_s": 5.0,
+      "sample_every_s": 1.0,
+      "retry_every_s": 0.5,
+      "invariant_every_events": 1
+    }
+
+Omitted sections disable that feature (``faults: {}`` == fault-free run).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from nanotpu import types
+
+#: The five BASELINE.json config archetypes the workload generator knows.
+CONFIG_KINDS = (
+    "fractional", "spread", "multi_container", "gang_llama", "mixtral",
+)
+
+_POLICIES = (types.POLICY_BINPACK, types.POLICY_SPREAD)
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(f"bad scenario: {msg}")
+
+
+def normalize_scenario(raw: dict) -> dict:
+    """Validate ``raw`` and return a fully-defaulted copy."""
+    _require(isinstance(raw, dict), "scenario must be a JSON object")
+    fleet = raw.get("fleet") or {}
+    _require(bool(fleet.get("pools")), "fleet.pools is required")
+    policy = raw.get("policy", types.POLICY_BINPACK)
+    _require(
+        policy in _POLICIES,
+        f"policy {policy!r} not in {_POLICIES} (random is non-deterministic)",
+    )
+    horizon = float(raw.get("horizon_s", 30.0))
+    _require(horizon > 0, "horizon_s must be > 0")
+
+    w = dict(raw.get("workload") or {})
+    kind = w.setdefault("kind", "poisson")
+    _require(kind in ("poisson", "trace"), f"workload.kind {kind!r}")
+    if kind == "poisson":
+        w.setdefault("rate_per_s", 1.0)
+        _require(w["rate_per_s"] > 0, "workload.rate_per_s must be > 0")
+        mix = w.setdefault("mix", {k: 1.0 for k in CONFIG_KINDS})
+        _require(
+            mix and all(k in CONFIG_KINDS for k in mix),
+            f"workload.mix keys must be among {CONFIG_KINDS}",
+        )
+        _require(
+            sum(mix.values()) > 0 and all(v >= 0 for v in mix.values()),
+            "workload.mix weights must be >= 0 and not all zero",
+        )
+    else:
+        arrivals = w.setdefault("arrivals", [])
+        _require(isinstance(arrivals, list) and arrivals,
+                 "trace workload needs a non-empty arrivals list")
+        for a in arrivals:
+            _require(
+                a.get("config") in CONFIG_KINDS,
+                f"trace arrival config {a.get('config')!r}",
+            )
+            _require(float(a.get("t", -1)) >= 0, "trace arrival needs t >= 0")
+    life = w.setdefault("lifetime_s", {"dist": "exp", "mean": 15.0})
+    _require(
+        life.get("dist", "exp") in ("exp", "fixed"),
+        f"lifetime_s.dist {life.get('dist')!r}",
+    )
+    _require(float(life.get("mean", 0)) > 0, "lifetime_s.mean must be > 0")
+    w.setdefault("gang_size", 8)
+    w.setdefault("replicas", 4)
+
+    f = dict(raw.get("faults") or {})
+    for key in ("node_flap", "bind_failure", "drop_event", "dup_event",
+                "metric_sync", "agent_restart"):
+        f.setdefault(key, {})
+    for key in ("bind_failure", "drop_event", "dup_event"):
+        prob = float(f[key].get("prob", 0.0))
+        _require(0.0 <= prob <= 1.0, f"faults.{key}.prob must be in [0, 1]")
+
+    return {
+        "name": raw.get("name", "unnamed"),
+        "description": raw.get("description", ""),
+        "fleet": fleet,
+        "policy": policy,
+        "horizon_s": horizon,
+        "workload": w,
+        "faults": f,
+        "resync_every_s": float(raw.get("resync_every_s", 10.0)),
+        "sample_every_s": float(raw.get("sample_every_s", 1.0)),
+        "retry_every_s": float(raw.get("retry_every_s", 0.5)),
+        "invariant_every_events": int(raw.get("invariant_every_events", 1)),
+    }
+
+
+def load_scenario(path: str | Path) -> dict:
+    with open(path) as fh:
+        return normalize_scenario(json.load(fh))

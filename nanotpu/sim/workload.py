@@ -1,0 +1,171 @@
+"""Workload generators: jobs for all five BASELINE.json configs.
+
+A *job* is the arrival unit — one pod (fractional, multi-container) or a
+burst of pods created together (spread replicas, gang workers, Mixtral
+experts). Pod specs mirror tests/test_baseline_configs.py and bench.py so
+the sim exercises exactly the demand shapes the repo's headline metric is
+defined over:
+
+* ``fractional``       — 1 container, <100% of one chip (config 0)
+* ``spread``           — N replicas of one whole chip each (config 1)
+* ``multi_container``  — one pod, 2 containers x 1 chip, ICI-adjacent
+  placement (config 2)
+* ``gang_llama``       — gang of workers, 2 chips each, soft gang
+  annotations (config 3; strict gangs need concurrent binds, which a
+  deterministic single-threaded driver cannot park — see
+  docs/simulation.md)
+* ``mixtral``          — gang of 8 experts, 4 chips (one host) each
+  (config 4)
+
+Generators draw only from the ``random.Random`` they are handed, so the
+arrival stream is a pure function of (scenario, seed).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+
+from nanotpu import types
+from nanotpu.k8s.objects import Pod, make_container, make_pod
+from nanotpu.sim.scenario import CONFIG_KINDS
+
+#: Fractional chip-percent menu (config 0's gpu-percent=20 plus neighbors).
+FRACTIONAL_PERCENTS = (10, 20, 25, 40, 50)
+
+
+@dataclass
+class Job:
+    """One arrival unit and its lifecycle bookkeeping."""
+
+    id: int
+    config: str
+    arrival_t: float
+    lifetime_s: float
+    gang: str | None  # gang name annotation value, None for non-gang jobs
+    pods: list[Pod] = field(default_factory=list)
+    #: pod name -> bind virtual time (absent == not bound yet)
+    bound_t: dict[str, float] = field(default_factory=dict)
+    departed: bool = False
+    #: how many flap-kill resubmissions deep this job is (0 == original);
+    #: the next resubmission gets incarnation + 1 so repeated kills of the
+    #: same job id never reuse pod names or uids
+    incarnation: int = 0
+
+    @property
+    def size(self) -> int:
+        return len(self.pods)
+
+    def fully_bound(self) -> bool:
+        return len(self.bound_t) == len(self.pods)
+
+
+def _pod(name: str, uid: str, containers, annotations=None) -> Pod:
+    return make_pod(
+        name, uid=uid, containers=containers, annotations=annotations or {}
+    )
+
+
+def build_job(
+    job_id: int,
+    config: str,
+    arrival_t: float,
+    lifetime_s: float,
+    rng: random.Random,
+    uid_of,
+    gang_size: int = 8,
+    replicas: int = 4,
+    incarnation: int = 0,
+) -> Job:
+    """Materialize a job's pods. ``uid_of(pod_name)`` must return a unique
+    uid per call — K8s never reuses uids, and the dealer's released-uid
+    tombstones rely on that (a resubmitted gang with recycled uids would
+    silently leak chips)."""
+    if config not in CONFIG_KINDS:
+        raise ValueError(f"unknown workload config {config!r}")
+    tag = f"{config}-{job_id}" + (f"-r{incarnation}" if incarnation else "")
+    gang = None
+    pods: list[Pod] = []
+    if config == "fractional":
+        percent = rng.choice(FRACTIONAL_PERCENTS)
+        pods.append(_pod(
+            f"{tag}-0", uid_of(f"{tag}-0"),
+            [make_container("main", {types.RESOURCE_TPU_PERCENT: percent})],
+        ))
+    elif config == "spread":
+        for i in range(replicas):
+            pods.append(_pod(
+                f"{tag}-{i}", uid_of(f"{tag}-{i}"),
+                [make_container("srv", {types.RESOURCE_TPU_PERCENT: 100})],
+            ))
+    elif config == "multi_container":
+        pods.append(_pod(
+            f"{tag}-0", uid_of(f"{tag}-0"),
+            [
+                make_container("actor", {types.RESOURCE_TPU_PERCENT: 100}),
+                make_container("learner", {types.RESOURCE_TPU_PERCENT: 100}),
+            ],
+        ))
+    elif config == "gang_llama":
+        gang = f"llama3-{job_id}"
+        for i in range(gang_size):
+            pods.append(_pod(
+                f"{tag}-{i}", uid_of(f"{tag}-{i}"),
+                [make_container("trainer", {types.RESOURCE_TPU_PERCENT: 200})],
+                annotations={
+                    types.ANNOTATION_GANG_NAME: gang,
+                    types.ANNOTATION_GANG_SIZE: str(gang_size),
+                },
+            ))
+    elif config == "mixtral":
+        gang = f"mixtral-{job_id}"
+        for i in range(8):
+            pods.append(_pod(
+                f"{tag}-{i}", uid_of(f"{tag}-{i}"),
+                [make_container("expert", {types.RESOURCE_TPU_PERCENT: 400})],
+                annotations={
+                    types.ANNOTATION_GANG_NAME: gang,
+                    types.ANNOTATION_GANG_SIZE: "8",
+                },
+            ))
+    return Job(
+        id=job_id, config=config, arrival_t=arrival_t,
+        lifetime_s=lifetime_s, gang=gang, pods=pods,
+        incarnation=incarnation,
+    )
+
+
+def draw_lifetime(spec: dict, rng: random.Random) -> float:
+    mean = float(spec.get("mean", 15.0))
+    if spec.get("dist", "exp") == "fixed":
+        return mean
+    # floor keeps a job alive long enough to ever be observed by a sample
+    return max(0.25, rng.expovariate(1.0 / mean))
+
+
+def poisson_arrivals(workload: dict, horizon_s: float,
+                     rng: random.Random) -> list[tuple[float, str]]:
+    """(arrival time, config) stream over [0, horizon). Inter-arrival times
+    are exponential; configs drawn from the mix weights."""
+    mix = workload["mix"]
+    kinds = [k for k in CONFIG_KINDS if mix.get(k, 0) > 0]
+    weights = [float(mix[k]) for k in kinds]
+    rate = float(workload["rate_per_s"])
+    out: list[tuple[float, str]] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate)
+        if t >= horizon_s:
+            return out
+        out.append((t, rng.choices(kinds, weights=weights)[0]))
+
+
+def trace_arrivals(workload: dict, horizon_s: float) -> list[tuple[float, str, dict]]:
+    """Explicit trace entries, clipped to the horizon, sorted by time."""
+    out = []
+    for a in workload["arrivals"]:
+        t = float(a["t"])
+        if t < horizon_s and math.isfinite(t):
+            out.append((t, a["config"], a))
+    return sorted(out, key=lambda e: (e[0], e[1]))

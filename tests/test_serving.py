@@ -835,3 +835,42 @@ class TestMeasuredPolicy:
                        for n in b_.values()), rates
         finally:
             eng.stop()
+
+
+class TestSpecPolicyMisconfigWarning:
+    """ADVICE r5 low: a speculation policy other than "off" with no draft
+    silently degraded to plain-only decoding; the engine must say so."""
+
+    @pytest.mark.parametrize("policy,level", [
+        ("measured", "WARNING"), ("always", "WARNING"),
+        # "auto" is the constructor default: a plain engine with no
+        # speculation settings must not WARN, only note it at INFO
+        ("auto", "INFO"),
+    ])
+    def test_policy_without_draft_warns(self, tiny_model, caplog, policy,
+                                        level):
+        params, cfg = tiny_model
+        with caplog.at_level("INFO", logger="nanotpu.serving"):
+            eng = Engine(params, cfg, slots=2, max_len=64, buckets=(16,),
+                         spec_policy=policy)
+        try:
+            assert not eng._measured and eng.spec_rules == []
+            logged = [r for r in caplog.records
+                      if "draft_params is None" in r.getMessage()]
+            assert logged, f"no fallback log for spec_policy={policy!r}"
+            assert logged[0].levelname == level
+            assert repr(policy) in logged[0].getMessage()
+        finally:
+            eng.stop()
+
+    def test_off_without_draft_is_silent(self, tiny_model, caplog):
+        params, cfg = tiny_model
+        with caplog.at_level("WARNING", logger="nanotpu.serving"):
+            eng = Engine(params, cfg, slots=2, max_len=64, buckets=(16,),
+                         spec_policy="off")
+        try:
+            assert eng.spec_rules == []
+            assert not [r for r in caplog.records
+                        if "draft_params" in r.getMessage()]
+        finally:
+            eng.stop()

@@ -1,0 +1,488 @@
+"""nanotpu.sim: the deterministic cluster simulator + fault harness.
+
+Three layers under test: the invariant checker itself (seeded with
+deliberately-corrupt dealer state, each invariant must fire — a checker
+that cannot detect a planted bug proves nothing), the determinism
+contract (two runs of (scenario, seed) render byte-identical reports),
+and the end-to-end harness (all five BASELINE configs through the REAL
+Dealer/verbs/Controller with every fault armed, zero violations).
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.rater import Binpack
+from nanotpu.cmd.main import make_mock_cluster
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.client import FakeClientset
+from nanotpu.k8s.objects import make_container, make_node, make_pod
+from nanotpu.metrics.stats import percentile, summarize
+from nanotpu.sim import Simulator, load_scenario, run_scenario
+from nanotpu.sim.__main__ import main as sim_main
+from nanotpu.sim.fleet import fleet_summary, make_fleet, pool_nodes
+from nanotpu.sim.invariants import check_invariants, ground_truth_occupancy
+from nanotpu.sim.report import render, strip_timing
+from nanotpu.sim.scenario import CONFIG_KINDS, normalize_scenario
+from nanotpu.sim.workload import build_job
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples" / "sim"
+
+#: Fast inline scenario: all five configs, every fault armed, 8 hosts.
+SMALL = {
+    "name": "unit",
+    "fleet": {"pools": [{"generation": "v5p", "hosts": 8, "slice_hosts": 8}]},
+    "policy": "binpack",
+    "horizon_s": 12.0,
+    "workload": {
+        "kind": "poisson",
+        "rate_per_s": 1.5,
+        "mix": {k: 1.0 for k in CONFIG_KINDS},
+        "lifetime_s": {"dist": "exp", "mean": 6.0},
+        "gang_size": 4,
+        "replicas": 2,
+    },
+    "faults": {
+        "node_flap": {"every_s": 5.0, "down_s": 2.0},
+        "bind_failure": {"prob": 0.05},
+        "drop_event": {"prob": 0.05},
+        "dup_event": {"prob": 0.05},
+        "metric_sync": {"every_s": 3.0, "delay_s": 1.0},
+        "agent_restart": {"at_s": [7.0]},
+    },
+    "resync_every_s": 4.0,
+    "sample_every_s": 1.0,
+    "retry_every_s": 0.5,
+}
+
+
+def tpu_node(name="n1", chips=4):
+    return make_node(
+        name,
+        {types.RESOURCE_TPU_PERCENT: chips * types.PERCENT_PER_CHIP},
+        labels={
+            types.LABEL_TPU_GENERATION: "v5p",
+            types.LABEL_TPU_TOPOLOGY: "2x2x1",
+        },
+    )
+
+
+def tpu_pod(name, percents=(100,), **kw):
+    return make_pod(
+        name,
+        containers=[
+            make_container(f"c{i}", {types.RESOURCE_TPU_PERCENT: p})
+            for i, p in enumerate(percents)
+        ],
+        **kw,
+    )
+
+
+def bound_cluster():
+    """One node, one pod bound through the real Dealer path — the healthy
+    state every corruption below starts from."""
+    client = FakeClientset()
+    client.create_node(tpu_node("n1"))
+    dealer = Dealer(client, Binpack())
+    pod = tpu_pod("p1", (100,))
+    ok, _ = dealer.assume(["n1"], pod)
+    assert ok == ["n1"]
+    server = client.create_pod(pod)
+    assert dealer.bind("n1", server)
+    return client, dealer, client.get_pod("default", "p1")
+
+
+def kinds_of(violations):
+    return {v["kind"] for v in violations}
+
+
+class TestInvariantChecker:
+    """Seed each corruption the checker claims to catch; assert it fires
+    (and that the healthy state it grew from was clean)."""
+
+    def test_healthy_bound_state_is_clean(self):
+        client, dealer, _ = bound_cluster()
+        assert check_invariants(dealer, client, converged=True) == []
+
+    def test_chip_oversubscribed_fires_on_negative_free(self):
+        client, dealer, _ = bound_cluster()
+        info = dealer.debug_snapshot()["node_infos"]["n1"]
+        info.chips.chips[0].percent_free = -20
+        violations = check_invariants(dealer, client)
+        assert "chip_oversubscribed" in kinds_of(violations)
+
+    def test_chip_oversubscribed_fires_on_hbm_overflow(self):
+        client, dealer, _ = bound_cluster()
+        info = dealer.debug_snapshot()["node_infos"]["n1"]
+        chip = info.chips.chips[0]
+        if not chip.hbm_total_mib:  # pragma: no cover - v5p has HBM totals
+            pytest.skip("fleet has no HBM accounting")
+        chip.hbm_free_mib = chip.hbm_total_mib + 1
+        violations = check_invariants(dealer, client)
+        assert "chip_oversubscribed" in kinds_of(violations)
+
+    def test_orphaned_reservation_fires(self):
+        client, dealer, _ = bound_cluster()
+        dealer._reserved["ghost-uid"] = None  # a leaked strict-gang park
+        violations = check_invariants(dealer, client)
+        assert "orphaned_reservation" in kinds_of(violations)
+        assert any("ghost-uid" in v["detail"] for v in violations)
+
+    def test_ground_truth_oversubscription_fires(self):
+        """Two live pods whose annotations commit the same chip: the
+        durable K8s view is double-booked no matter what the dealer
+        thinks."""
+        client, dealer, p1 = bound_cluster()
+        stolen = p1.annotations["tpu.io/container-c0"]
+        twin = tpu_pod("p2", (100,))
+        twin.ensure_annotations()[types.ANNOTATION_ASSUME] = "true"
+        twin.ensure_annotations()["tpu.io/container-c0"] = stolen
+        twin.raw.setdefault("spec", {})["nodeName"] = "n1"
+        client.create_pod(twin)
+        violations = check_invariants(dealer, client)
+        assert "ground_truth_oversubscribed" in kinds_of(violations)
+
+    def test_codec_roundtrip_fires_on_garbage_annotation(self):
+        client, dealer, _ = bound_cluster()
+        bad = tpu_pod("p3", (100,))
+        bad.ensure_annotations()[types.ANNOTATION_ASSUME] = "true"
+        bad.ensure_annotations()["tpu.io/container-c0"] = "not,a[chip"
+        bad.raw.setdefault("spec", {})["nodeName"] = "n1"
+        client.create_pod(bad)
+        violations = check_invariants(dealer, client)
+        assert "codec_roundtrip" in kinds_of(violations)
+
+    def test_codec_roundtrip_fires_on_non_canonical_annotation(self):
+        """Parsable but non-canonical ("1,1,0": unsorted + duplicate)
+        still decodes fine, so only the canonical re-encode comparison
+        can catch the drift."""
+        client, dealer, _ = bound_cluster()
+        bad = tpu_pod("p4", (100,))
+        bad.ensure_annotations()[types.ANNOTATION_ASSUME] = "true"
+        bad.ensure_annotations()["tpu.io/container-c0"] = "1,1,0"
+        bad.raw.setdefault("spec", {})["nodeName"] = "n1"
+        client.create_pod(bad)
+        violations = check_invariants(dealer, client)
+        assert "codec_roundtrip" in kinds_of(violations)
+
+    def test_tracked_vanished_fires_after_unseen_delete(self):
+        client, dealer, p1 = bound_cluster()
+        client.delete_pod(p1.namespace, p1.name)  # dealer never told
+        violations = check_invariants(dealer, client, converged=True)
+        assert "tracked_vanished" in kinds_of(violations)
+
+    def test_accounting_mismatch_fires_on_drifted_chips(self):
+        client, dealer, _ = bound_cluster()
+        info = dealer.debug_snapshot()["node_infos"]["n1"]
+        for chip in info.chips.chips:
+            chip.percent_free = chip.percent_total  # dealer "forgot" p1
+        violations = check_invariants(dealer, client, converged=True)
+        assert "accounting_mismatch" in kinds_of(violations)
+
+    def test_converged_checks_stay_quiet_mid_run(self):
+        """The dealer legitimately lags the cluster mid-run (dropped
+        DELETE); the equality checks must only arm at convergence."""
+        client, dealer, p1 = bound_cluster()
+        client.delete_pod(p1.namespace, p1.name)
+        assert check_invariants(dealer, client, converged=False) == []
+
+    def test_ground_truth_occupancy_round_trips_restart(self):
+        """A dealer rebuilt from cluster annotations reports exactly the
+        annotation-derived occupancy — the agent-restart contract."""
+        client, dealer, _ = bound_cluster()
+        truth = ground_truth_occupancy(dealer, client)
+        assert truth == pytest.approx(0.25)  # 100% of one of 4 chips
+        reborn = Dealer(client, Binpack())
+        assert reborn.occupancy() == pytest.approx(truth)
+
+
+class TestDeterminism:
+    def test_same_seed_renders_byte_identical(self):
+        a = run_scenario(SMALL, seed=3)
+        b = run_scenario(SMALL, seed=3)
+        assert render(a) == render(b)
+        assert a["digest"] == b["digest"]
+
+    def test_different_seeds_diverge(self):
+        a = run_scenario(SMALL, seed=0)
+        b = run_scenario(SMALL, seed=1)
+        assert a["digest"] != b["digest"]
+
+    def test_faults_do_not_shift_the_arrival_stream(self):
+        """Stream isolation, the property bisects lean on: toggling the
+        whole fault plan must not change which jobs arrive, when, their
+        shapes, or their lifetimes (fault-dependent draws live on their
+        own seeded streams)."""
+        quiet = json.loads(json.dumps(SMALL))
+        quiet["faults"] = {}
+        noisy_sim = Simulator(SMALL, seed=5)
+        noisy_sim.run()
+        quiet_sim = Simulator(quiet, seed=5)
+        quiet_sim.run()
+
+        def arrivals(sim):
+            return [
+                (round(j.arrival_t, 6), j.config,
+                 round(j.lifetime_s, 6), j.size)
+                for j in sim.jobs if j.incarnation == 0
+            ]
+
+        assert arrivals(noisy_sim) == arrivals(quiet_sim)
+
+    def test_timing_section_never_feeds_digest(self):
+        a = run_scenario(SMALL, seed=2, include_timing=True)
+        b = run_scenario(SMALL, seed=2, include_timing=False)
+        assert "timing" in a and "timing" not in b
+        assert render(strip_timing(a)) == render(b)
+
+
+class TestEndToEnd:
+    def test_small_churn_all_configs_zero_violations(self):
+        report = run_scenario(SMALL, seed=0)
+        assert report["invariants"]["violations"] == 0, (
+            report["invariants"]["first"]
+        )
+        assert report["invariants"]["checks"] > 0
+        assert set(report["configs"]) == set(CONFIG_KINDS)
+        assert report["pods"]["bound"] > 0
+        assert 0 < report["occupancy_pct"]["peak"] <= 100
+        # every fault family actually injected something
+        f = report["faults"]
+        assert report["pods"]["evicted"] == f["pods_evicted"]
+        assert f["node_flaps"] > 0 and f["agent_restarts"] == 1
+        assert f["events_dropped"] + f["events_duplicated"] > 0
+        assert f["binds_failed_injected"] >= 0
+        assert f["metric_syncs"] > 0
+
+    def test_restart_without_drops_round_trips_exactly(self):
+        quiet = json.loads(json.dumps(SMALL))
+        quiet["faults"] = {"agent_restart": {"at_s": [6.0]}}
+        report = run_scenario(quiet, seed=0)
+        assert report["faults"]["agent_restarts"] == 1
+        assert report["restart_occupancy_drift_pct"] == 0.0
+        assert report["invariants"]["violations"] == 0
+
+    def test_fault_free_run_is_clean_and_faultless(self):
+        quiet = json.loads(json.dumps(SMALL))
+        quiet["faults"] = {}
+        report = run_scenario(quiet, seed=0)
+        assert report["invariants"]["violations"] == 0
+        assert all(v == 0 for v in report["faults"].values())
+        assert report["pods"]["bind_errors"] == 0
+
+    def test_trace_mode_replays_exact_arrivals(self):
+        scenario = load_scenario(EXAMPLES / "trace-replay.json")
+        report = run_scenario(scenario, seed=0)
+        assert report["pods"]["arrived"] == 19
+        assert report["pods"]["bound"] == 19
+        assert report["invariants"]["violations"] == 0
+
+
+class TestScenarioValidation:
+    def test_missing_fleet_rejected(self):
+        with pytest.raises(ValueError, match="fleet.pools"):
+            normalize_scenario({"workload": {}})
+
+    def test_nondeterministic_policy_rejected(self):
+        with pytest.raises(ValueError, match="policy"):
+            normalize_scenario(
+                {"fleet": {"pools": [{}]}, "policy": "random"}
+            )
+
+    def test_unknown_mix_config_rejected(self):
+        with pytest.raises(ValueError, match="mix"):
+            normalize_scenario({
+                "fleet": {"pools": [{}]},
+                "workload": {"mix": {"warp_drive": 1.0}},
+            })
+
+    def test_empty_trace_rejected(self):
+        with pytest.raises(ValueError, match="arrivals"):
+            normalize_scenario({
+                "fleet": {"pools": [{}]},
+                "workload": {"kind": "trace"},
+            })
+
+    def test_bad_fault_prob_rejected(self):
+        with pytest.raises(ValueError, match="prob"):
+            normalize_scenario({
+                "fleet": {"pools": [{}]},
+                "faults": {"drop_event": {"prob": 1.5}},
+            })
+
+
+class TestFleetFactory:
+    def test_v5p_512_pool_shape(self):
+        client = make_fleet({
+            "pools": [{"generation": "v5p", "hosts": 128, "slice_hosts": 16}]
+        })
+        summary = fleet_summary(client)
+        assert summary == {"nodes": 128, "chips": 512, "slices": 8}
+
+    def test_generation_defaults(self):
+        nodes = pool_nodes(2, generation="v5e")
+        assert len(nodes) == 2
+        n = nodes[0]
+        assert n.capacity(types.RESOURCE_TPU_PERCENT) == 800  # 8 chips
+        assert n.labels[types.LABEL_TPU_TOPOLOGY] == "2x4x1"
+
+    def test_name_collision_rejected(self):
+        with pytest.raises(ValueError, match="collision"):
+            make_fleet({"pools": [
+                {"hosts": 2, "prefix": "dup"},
+                {"hosts": 2, "prefix": "dup"},
+            ]})
+
+    def test_mock_cluster_parity(self):
+        """cmd.main.make_mock_cluster now wraps the shared factory; the
+        node set must be bit-identical to the hand-rolled original."""
+        client = make_mock_cluster(5)
+        nodes = {n.name: n for n in client.list_nodes()}
+        assert sorted(nodes) == [f"v5p-host-{i}" for i in range(5)]
+        n3 = nodes["v5p-host-3"]
+        assert n3.labels[types.LABEL_TPU_SLICE] == "slice-0"
+        # 5 hosts -> side 2: host 3 sits at (1, 1) on the host grid
+        assert n3.labels[types.LABEL_TPU_SLICE_COORDS] == "1,1,0"
+        assert n3.capacity(types.RESOURCE_TPU_PERCENT) == 400
+
+
+class TestWorkloadShapes:
+    """Job pods mirror the five BASELINE demand shapes exactly."""
+
+    def _job(self, config, **kw):
+        import random
+
+        return build_job(
+            job_id=0, config=config, arrival_t=0.0, lifetime_s=5.0,
+            rng=random.Random(0), uid_of=lambda n: f"uid-{n}", **kw
+        )
+
+    def test_fractional_is_sub_chip(self):
+        job = self._job("fractional")
+        (pod,) = job.pods
+        percent = pod.containers[0].limit(types.RESOURCE_TPU_PERCENT)
+        assert 0 < percent < types.PERCENT_PER_CHIP
+        assert job.gang is None
+
+    def test_spread_replicas(self):
+        job = self._job("spread", replicas=3)
+        assert job.size == 3
+        for pod in job.pods:
+            assert pod.containers[0].limit(types.RESOURCE_TPU_PERCENT) == 100
+
+    def test_multi_container(self):
+        (pod,) = self._job("multi_container").pods
+        assert [c.limit(types.RESOURCE_TPU_PERCENT)
+                for c in pod.containers] == [100, 100]
+
+    def test_gang_llama_annotations(self):
+        job = self._job("gang_llama", gang_size=4)
+        assert job.size == 4 and job.gang
+        for pod in job.pods:
+            assert pod.annotations[types.ANNOTATION_GANG_NAME] == job.gang
+            assert pod.annotations[types.ANNOTATION_GANG_SIZE] == "4"
+            assert pod.containers[0].limit(types.RESOURCE_TPU_PERCENT) == 200
+
+    def test_mixtral_expert_group(self):
+        job = self._job("mixtral")
+        assert job.size == 8
+        for pod in job.pods:
+            assert pod.containers[0].limit(types.RESOURCE_TPU_PERCENT) == 400
+
+    def test_resubmitted_gang_gets_fresh_uids_and_names(self):
+        first = self._job("gang_llama", gang_size=2)
+        again = self._job("gang_llama", gang_size=2, incarnation=1)
+        assert {p.name for p in first.pods}.isdisjoint(
+            p.name for p in again.pods
+        )
+        # the incarnation is carried on the Job so a SECOND flap-kill
+        # resubmits as -r2, not -r1 again (names/uids stay unique)
+        assert first.incarnation == 0 and again.incarnation == 1
+
+    def test_unknown_config_rejected(self):
+        with pytest.raises(ValueError, match="unknown workload config"):
+            self._job("warp_drive")
+
+
+class TestStatsHelpers:
+    def test_percentile_nearest_rank(self):
+        xs = [float(i) for i in range(1, 101)]
+        assert percentile(xs, 0.50) == 50.0
+        assert percentile(xs, 0.99) == 99.0
+        assert percentile(xs, 1.00) == 100.0
+        assert percentile([], 0.5) is None
+
+    def test_summarize_scales_and_rounds(self):
+        s = summarize([0.001, 0.002, 0.003], scale=1e3)
+        assert s["count"] == 3 and s["p50"] == 2.0 and s["max"] == 3.0
+        assert summarize([]) is None
+
+
+class TestCli:
+    def test_smoke_scenario_exits_zero(self, capsys):
+        rc = sim_main([
+            "--scenario", str(EXAMPLES / "smoke.json"),
+            "--seed", "0", "--horizon-s", "6",
+        ])
+        out = capsys.readouterr()
+        assert rc == 0
+        report = json.loads(out.out)
+        assert report["invariants"]["violations"] == 0
+        assert "timing" not in report  # determinism-safe by default
+        assert "occupancy mean" in out.err
+
+    def test_check_determinism_flag(self, capsys):
+        rc = sim_main([
+            "--scenario", str(EXAMPLES / "trace-replay.json"),
+            "--seed", "1", "--check-determinism",
+        ])
+        assert rc == 0
+        assert "determinism check passed" in capsys.readouterr().err
+
+    def test_bad_scenario_exits_two(self, tmp_path, capsys):
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"fleet": {}}')
+        assert sim_main(["--scenario", str(bad)]) == 2
+        missing = tmp_path / "nope.json"
+        assert sim_main(["--scenario", str(missing)]) == 2
+
+
+class TestExampleScenarios:
+    def test_all_example_scenarios_load(self):
+        paths = sorted(EXAMPLES.glob("*.json"))
+        assert len(paths) >= 3  # smoke, v5p512-churn, trace-replay
+        for path in paths:
+            scenario = load_scenario(path)
+            assert scenario["name"] != "unnamed", path.name
+
+    def test_smoke_covers_all_five_configs(self):
+        scenario = load_scenario(EXAMPLES / "smoke.json")
+        assert set(scenario["workload"]["mix"]) == set(CONFIG_KINDS)
+
+
+@pytest.mark.slow
+class TestChurnSweep:
+    """The acceptance-gate scenario at full length: a v5p-512 pool under
+    churn with every fault armed finishes clean and reproducibly."""
+
+    def test_v5p512_churn_full_horizon(self):
+        scenario = load_scenario(EXAMPLES / "v5p512-churn.json")
+        a = run_scenario(scenario, seed=0)
+        assert a["fleet"] == {"nodes": 128, "chips": 512, "slices": 8}
+        assert a["invariants"]["violations"] == 0, a["invariants"]["first"]
+        assert set(a["configs"]) == set(CONFIG_KINDS)
+        for config, counts in a["configs"].items():
+            assert counts["bound"] > 0, f"{config} never bound"
+        assert a["occupancy_pct"]["peak"] > 90
+        assert a["faults"]["node_flaps"] > 0
+        b = run_scenario(scenario, seed=0)
+        assert render(a) == render(b)
+
+    def test_spread_policy_full_horizon(self):
+        scenario = load_scenario(EXAMPLES / "v5p512-churn.json")
+        scenario["policy"] = types.POLICY_SPREAD
+        report = run_scenario(scenario, seed=0)
+        assert report["invariants"]["violations"] == 0
+        assert report["pods"]["bound"] > 0
