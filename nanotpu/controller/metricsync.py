@@ -190,6 +190,7 @@ class MetricSyncer:
             log.warning("metric sync list nodes failed: %s", e)
             return 0
         updated = 0
+        touched: set[str] = set()
         for node in nodes:
             if not nodeutil.is_tpu_enabled(node) or not nodeutil.is_tpu_node(node):
                 continue
@@ -205,11 +206,19 @@ class MetricSyncer:
                 if value is None:
                     continue
                 kwargs = {"core": value} if metric == METRIC_CORE else {"memory": value}
-                self.dealer.update_chip_usage(node.name, chip, **kwargs)
+                # publish deferred: one snapshot publish covers the whole
+                # sweep below instead of one per chip (O(nodes x chips)
+                # copy-on-write view clones per tick otherwise)
+                self.dealer.update_chip_usage(
+                    node.name, chip, publish=False, **kwargs
+                )
+                touched.add(node.name)
                 updated += 1
             if not errored:
                 # only a clean tick resets the log-throttle counter
                 self._errors.pop(node.name, None)
+        if touched:
+            self.dealer.publish_usage(tuple(sorted(touched)))
         return updated
 
     def _note_error(self, node: str, err: Exception) -> None:

@@ -33,6 +33,7 @@ from nanotpu.allocator.rater import Rater
 from nanotpu.dealer.batch import BatchScorer
 from nanotpu.dealer.gang import GangBarrier, GangScorer, GangTracker
 from nanotpu.dealer.nodeinfo import NodeInfo
+from nanotpu.dealer.perf import PerfCounters
 from nanotpu.dealer.usage import UsageStore
 from nanotpu.k8s import events
 from nanotpu.k8s.client import ApiError, Clientset, ConflictError, NotFoundError
@@ -60,6 +61,45 @@ RELEASED_TOMBSTONES_MAX = 100_000
 
 class BindError(Exception):
     """Bind failed; chip accounting has been rolled back."""
+
+
+class _Snapshot:
+    """One RCU-published, immutable view of the dealer's placement state.
+
+    Read verbs (Filter/Prioritize) consume whatever ``Dealer._published``
+    points at WITHOUT the dealer lock: the reference swap is atomic under
+    the GIL, ``nodes``/``non_tpu`` are never mutated after publication,
+    and each cached candidate-list view is a frozen
+    :class:`~nanotpu.dealer.batch.BatchScorer` whose row arrays are
+    written once. Writers build a successor snapshot after their commit
+    and swap it in (``Dealer._republish``) — readers never contend with
+    them and never trigger synchronous rebuilds; at worst they score
+    against the previous generation, the same staleness window the old
+    lock-and-probe path already had (kube-scheduler's bind re-checks
+    under the node lock either way).
+
+    ``views`` maps a candidate-name tuple to ``(scorer, known names,
+    non-TPU names, name->row index)`` — or ``None`` when that list cannot
+    take the batch path in this snapshot (cold/unknown candidates,
+    heterogeneous pool, native unavailable). Caching the None verdict is
+    sound because anything that could change it (a node materializing, a
+    topology change) is structural and structural publishes start with
+    empty views. Reader threads insert into ``views`` lazily; dict ops
+    are atomic under the GIL and a racing double-build is just wasted
+    work.
+    """
+
+    __slots__ = ("gen", "nodes", "non_tpu", "views")
+
+    def __init__(self, gen: int, nodes: dict, non_tpu: frozenset):
+        self.gen = gen
+        self.nodes = nodes
+        self.non_tpu = non_tpu
+        self.views: dict[tuple, tuple | None] = {}
+
+
+#: sentinel distinguishing "no cached view yet" from a cached None verdict
+_VIEW_MISSING = object()
 
 
 class _Reservation:
@@ -147,14 +187,26 @@ class Dealer:
         #: the fresh object misses Demand.from_pod's per-object memo even
         #: though container resource limits are immutable for a pod's life.
         self._demand_uid: dict[str, Demand] = {}
-        # candidate-list tuple -> (scorer, known names, non-TPU names,
-        # nodes epoch). kube-scheduler sends the same list every cycle, so
-        # an epoch-validated hit costs one tuple compare (the batched
-        # Filter hot path).
-        self._batch_cache: dict[tuple, tuple] = {}
-        #: bumped on any structural _nodes change; invalidates _batch_cache
+        #: bumped on any structural _nodes change; structural publishes
+        #: rebuild the snapshot's node mapping and drop its views
         self._nodes_epoch = 0
+        #: hot-path attribution (bench deltas + /metrics)
+        self.perf = PerfCounters()
+        #: RCU read state: the currently published snapshot, the epoch it
+        #: was built from, and the publisher serialization lock. Ordering
+        #: rule: _republish takes _publish_lock then briefly self._lock —
+        #: NEVER call it while holding self._lock.
+        self._publish_lock = threading.Lock()
+        self._published = _Snapshot(0, {}, frozenset())
+        self._pub_epoch = -1
+        #: bumped at the START of every _republish attempt, including ones
+        #: that end up skipped: lets a reader detect that a commit raced
+        #: its lazy view build (see _view_for's re-advance loop)
+        self._commit_seq = 0
+        self._publish_enabled = False
         self._warm_from_cluster()
+        self._publish_enabled = True
+        self._republish()
 
     # -- boot-time state reconstruction (dealer.go:58-72) ------------------
     def _warm_from_cluster(self) -> None:
@@ -303,6 +355,7 @@ class Dealer:
             self._non_tpu.discard(node.name)
             self._nodes_epoch += 1
         self._node_info(node.name, node)
+        self._republish()
 
     def remove_node(self, name: str) -> None:
         """Evict a deleted/resized node (missing in the reference)."""
@@ -316,6 +369,7 @@ class Dealer:
                 if res.node_name == name and res.valid:
                     self._invalidate_reservation(uid, res)
         self.usage.forget_node(name)
+        self._republish()
 
     def refresh_node(self, node: Node) -> bool:
         """Node MODIFIED handler: when capacity or topology labels drift
@@ -350,6 +404,7 @@ class Dealer:
             self._nodes_epoch += 1
             self._replay_tracked(node.name)
             self._migrate_reservations(node.name)
+        self._republish()
         log.info("node %s rebuilt (new/resized/relabeled)", node.name)
         return info is not None
 
@@ -387,6 +442,139 @@ class Dealer:
         with self._lock:
             return list(self._pods.values())
 
+    # -- RCU snapshot publication ------------------------------------------
+    def _republish(self, changed: tuple[str, ...] = ()) -> None:
+        """Swap in a fresh immutable snapshot after a state commit.
+
+        Chip-state-only publishes reuse the node mapping and ADVANCE every
+        cached candidate-list view (copy-on-write: only rows whose
+        NodeInfo.version moved are re-read — the common bind touches one).
+        ``changed`` names the nodes the commit touched, narrowing each
+        view's version probe to those rows (a full 256-row probe per bind
+        costs ~15% of the cycle); empty means "unknown, probe everything".
+        A publish whose probe finds nothing (e.g. the commit half of a
+        bind whose reserve half already published) keeps the old views —
+        and when NO view moved, the whole publish is skipped: readers
+        cannot observe a difference, and the memo/state_rev stay valid.
+        Structural publishes (node added/removed/rebuilt, tombstone
+        changes) copy the mapping and start with empty views; the next
+        read warms them. Publishers serialize on _publish_lock and hold
+        self._lock only for the epoch/mapping capture — never while
+        advancing views, so a slow advance cannot stall verb commits."""
+        if not self._publish_enabled:
+            return
+        with self._publish_lock:
+            # bumped BEFORE the views capture: a reader whose lazy build
+            # this publish raced past (its entry not yet inserted) sees
+            # the bump and re-advances its rows before trusting them
+            self._commit_seq += 1
+            old = self._published
+            with self._lock:
+                epoch = self._nodes_epoch
+                structural = epoch != self._pub_epoch
+                if structural:
+                    nodes = dict(self._nodes)
+                    non_tpu = frozenset(self._non_tpu)
+                else:
+                    nodes, non_tpu = old.nodes, old.non_tpu
+            views: dict[tuple, tuple | None] = {}
+            moved = False
+            if not structural:
+                for key, entry in list(old.views.items()):
+                    if entry is None:
+                        views[key] = None
+                        continue
+                    scorer, names_key, non_tpu_names, index_of = entry
+                    if changed:
+                        rows = [
+                            i for n in changed
+                            if (i := index_of.get(n)) is not None
+                        ]
+                        adv = scorer.advanced(rows) if rows else scorer
+                    else:
+                        adv = scorer.advanced()
+                    if adv is scorer:
+                        views[key] = entry
+                    else:
+                        moved = True
+                        views[key] = (adv, names_key, non_tpu_names,
+                                      index_of)
+                if not moved:
+                    return  # byte-identical views: nothing to publish
+            snap = _Snapshot(old.gen + 1, nodes, non_tpu)
+            snap.views = views
+            self._pub_epoch = epoch
+            self.perf.snapshot_publishes += 1
+            if structural:
+                self.perf.snapshot_structural += 1
+            self._published = snap
+
+    def _maybe_republish(self) -> None:
+        """Catch-up publish for read verbs that warmed cold nodes (their
+        apiserver GETs materialize NodeInfos without a writer commit)."""
+        if self._nodes_epoch != self._pub_epoch:
+            self._republish()
+
+    def _view_for(self, node_names: list[str]):
+        """The published snapshot's frozen view for this candidate list;
+        builds (and caches on the snapshot) lazily on first sight. No
+        dealer lock anywhere on the hit path.
+
+        The miss path must defend against a commit racing the build: the
+        rows are read from live NodeInfos, and a _republish that ran
+        between that read and the dict insert may have SKIPPED publishing
+        (our entry wasn't cached yet, so no view moved) — caching the
+        pre-commit rows then would be stale until some later commit
+        touched the same node. ``_commit_seq`` (bumped by every publish
+        attempt) detects the race; a detected race re-probes every row,
+        which by writer program order (chip mutation -> republish -> seq
+        bump) incorporates any commit the first read missed."""
+        snap = self._published
+        key = tuple(node_names)
+        entry = snap.views.get(key, _VIEW_MISSING)
+        if entry is not _VIEW_MISSING:
+            return entry
+        entry = None
+        built = False
+        for _ in range(4):  # bounded: each retry needs a fresh racing commit
+            seq = self._commit_seq
+            if not built:
+                entry = self._build_view(snap, key)
+                built = True
+            else:
+                scorer, names_key, non_tpu, index_of = entry
+                adv = scorer.advanced()
+                if adv is not scorer:
+                    entry = (adv, names_key, non_tpu, index_of)
+            while len(snap.views) >= 8:  # candidate pools are few & stable
+                try:
+                    snap.views.pop(next(iter(snap.views)), None)
+                except (StopIteration, RuntimeError):
+                    break  # racing evictor emptied/resized it first
+            snap.views[key] = entry
+            if entry is None or self._commit_seq == seq:
+                break
+        return entry
+
+    def _build_view(self, snap: _Snapshot, key: tuple):
+        pairs = [(n, snap.nodes.get(n)) for n in key]
+        non_tpu = {
+            n for n, info in pairs if info is None and n in snap.non_tpu
+        }
+        if any(info is None and n not in non_tpu for n, info in pairs):
+            return None  # cold candidates: take the warming per-node path
+        known = [(n, info) for n, info in pairs if info is not None]
+        infos = [info for _, info in known]
+        scorer = BatchScorer.build(infos, perf=self.perf)
+        if scorer is None:
+            return None
+        scorer.freeze()
+        self.perf.view_builds += 1
+        names = tuple(n for n, _ in known)
+        # name -> row index: lets a publish advance this view by probing
+        # only the rows its commit touched
+        return scorer, names, non_tpu, {n: i for i, n in enumerate(names)}
+
     # -- batched scoring fast path -----------------------------------------
     #: rater name -> prefer_used flag for the native batch engine; raters
     #: outside this map (random, sample) use the per-node path.
@@ -394,36 +582,16 @@ class Dealer:
 
     def _batch_plan(self, node_names: list[str]):
         """(scorer, ordered known names, non-TPU names, prefer_used) when
-        every candidate is already materialized and the pool is uniform;
-        None -> per-node path (cold candidates need apiserver GETs, or
-        mixed topologies)."""
+        every candidate is materialized in the published snapshot and the
+        pool is uniform; None -> per-node path (cold candidates need
+        apiserver GETs, or mixed topologies). Lock-free."""
         prefer = self._BATCH_POLICIES.get(self.rater.name)
         if prefer is None:
             return None
-        key = tuple(node_names)
-        with self._lock:
-            epoch = self._nodes_epoch
-            entry = self._batch_cache.get(key)
-        if entry is not None and entry[3] == epoch:
-            return entry[0], entry[1], entry[2], prefer
-        with self._lock:
-            pairs = [(n, self._nodes.get(n)) for n in node_names]
-            non_tpu = {
-                n for n, info in pairs if info is None and n in self._non_tpu
-            }
-            epoch = self._nodes_epoch
-        if any(info is None and n not in non_tpu for n, info in pairs):
-            return None  # cold candidates: take the warming per-node path
-        known = [(n, info) for n, info in pairs if info is not None]
-        names_key = tuple(n for n, _ in known)
-        infos = [info for _, info in known]
-        scorer = BatchScorer.build(infos)
-        if scorer is None:
+        entry = self._view_for(node_names)
+        if entry is None:
             return None
-        with self._lock:
-            self._batch_cache[key] = (scorer, names_key, non_tpu, epoch)
-            while len(self._batch_cache) > 8:
-                self._batch_cache.pop(next(iter(self._batch_cache)))
+        scorer, names_key, non_tpu, _index_of = entry
         return scorer, names_key, non_tpu, prefer
 
     # -- fused verb fast paths ---------------------------------------------
@@ -454,11 +622,17 @@ class Dealer:
         """ExtenderFilterResult JSON bytes, or None -> use assume()."""
         plan = self._payload_plan(node_names, pod)
         if plan is None:
+            self.perf.fastpath_misses += 1
             return None
         scorer, demand, prefer = plan
-        return scorer.filter_payload(
+        payload = scorer.filter_payload(
             demand, prefer, self._gang_member_slices(pod) or None
         )
+        if payload is None:
+            self.perf.fastpath_misses += 1
+        else:
+            self.perf.fastpath_hits += 1
+        return payload
 
     def priorities_payload(
         self, node_names: list[str], pod: Pod
@@ -466,11 +640,17 @@ class Dealer:
         """HostPriorityList JSON bytes, or None -> use score()."""
         plan = self._payload_plan(node_names, pod)
         if plan is None:
+            self.perf.fastpath_misses += 1
             return None
         scorer, demand, prefer = plan
-        return scorer.priorities_payload(
+        payload = scorer.priorities_payload(
             demand, prefer, self._gang_member_slices(pod) or None
         )
+        if payload is None:
+            self.perf.fastpath_misses += 1
+        else:
+            self.perf.fastpath_hits += 1
+        return payload
 
     # -- Assume (Filter verb): dealer.go:89-136 ----------------------------
     def _demand_of(self, pod: Pod) -> Demand:
@@ -539,6 +719,9 @@ class Dealer:
             results = list(self._pool.map(try_node, node_names))
         ok = [n for n, err in results if err is None]
         failed = {n: err for n, err in results if err is not None}
+        # cold candidates may have materialized NodeInfos: publish them so
+        # the next cycle takes the snapshot path
+        self._maybe_republish()
         return ok, failed
 
     def _gang_member_slices(self, pod: Pod) -> list[tuple[str, str]]:
@@ -558,8 +741,12 @@ class Dealer:
         if cached is not None and cached[0] == key and cached[1] == rev:
             return cached[2]
         member_slices: list[tuple[str, str]] = []
+        published = self._published.nodes
         for node in self.gangs.bound_nodes(key):
-            member = self._node_info(node)
+            # published snapshot first: the memo-miss path then usually
+            # takes no locks either (slice geometry is structural, so the
+            # snapshot copy is exactly as fresh as the epoch in `rev`)
+            member = published.get(node) or self._node_info(node)
             if member is not None:
                 member_slices.append((member.slice_name, member.slice_coords))
         self._gms_cache = (key, rev, member_slices)
@@ -600,6 +787,7 @@ class Dealer:
                 bonus = scorer.bonus(info.slice_name, info.slice_coords)
                 score = min(types.SCORE_MAX, score + bonus)
             out.append((name, score))
+        self._maybe_republish()  # the loop may have warmed cold nodes
         return out
 
     # -- Bind verb: dealer.go:155-203 --------------------------------------
@@ -607,6 +795,16 @@ class Dealer:
         """Apply the plan, write annotations (optimistic retry), post the
         binding. Raises BindError with accounting rolled back on failure.
         Emits a K8s Event either way (TPUAssigned / FailedBinding)."""
+        try:
+            return self._bind_outer(node_name, pod)
+        finally:
+            # one publish covers commit AND rollback: either way the chip
+            # state that read verbs consume may have moved — and only on
+            # this node (the reserve-half publish usually already carried
+            # it, making this a cheap no-op)
+            self._republish((node_name,))
+
+    def _bind_outer(self, node_name: str, pod: Pod) -> Pod:
         try:
             # idempotent-retry guard: the scheduler can re-issue a bind it
             # abandoned (its extender httpTimeout elapsed) that committed
@@ -662,6 +860,11 @@ class Dealer:
             raise BindError(
                 f"no feasible plan for pod {pod.key()} on node {node_name}"
             )
+        # publish the reservation NOW, not at bind completion: the API
+        # writes (and a strict gang's park window) can take seconds, and
+        # concurrent Filters reading the old snapshot would keep steering
+        # co-scheduled pods onto chips this pod already holds
+        self._republish((node_name,))
         return info, plan
 
     def _drop_gang_barrier(self, gang_key: str) -> None:
@@ -882,7 +1085,10 @@ class Dealer:
         """Reconcile a scheduled+running pod into accounting (syncPod path)."""
         if not pod.node_name or not podutil.is_assumed(pod):
             return False
-        return self._learn_bound_pod(pod)
+        learned = self._learn_bound_pod(pod)
+        if learned:
+            self._republish((pod.node_name,))
+        return learned
 
     def release(self, pod: Pod) -> bool:
         """Return a completed pod's chips; idempotent via the released set
@@ -894,6 +1100,7 @@ class Dealer:
         _warm_from_cluster deliberately skipped — over-committing the node.
         """
         released = False
+        released_node = None
         with self._lock:
             if pod.uid in self._released:
                 return False
@@ -921,12 +1128,15 @@ class Dealer:
                         try:
                             info.release(plan)
                             released = True
+                            released_node = info.name
                         except ValueError as e:
                             log.error(
                                 "release of %s on %s failed: %s",
                                 pod.key(), node, e,
                             )
         self.gangs.forget_pod(pod.uid)
+        if released:
+            self._republish((released_node,))
         return released
 
     def forget(self, pod: Pod) -> None:
@@ -946,11 +1156,23 @@ class Dealer:
     def update_chip_usage(
         self, node: str, chip: int, core: float | None = None,
         memory: float | None = None, now: float | None = None,
+        publish: bool = True,
     ) -> None:
+        """``publish=False`` defers the snapshot publish: a metric sweep
+        calls this once per chip, and per-chip publishes would clone every
+        cached view's row arrays O(nodes x chips) times per tick — batch
+        the sweep and finish with one :meth:`publish_usage`."""
         self.usage.update(node, chip, core=core, memory=memory, now=now)
         info = self._node_info(node)
         if info is not None:
             info.set_chip_load(chip, self.usage.effective_load(node, chip, now=now))
+            if publish:
+                self._republish((node,))
+
+    def publish_usage(self, nodes: tuple[str, ...]) -> None:
+        """One snapshot publish covering a batch of deferred
+        ``update_chip_usage(..., publish=False)`` calls."""
+        self._republish(tuple(nodes))
 
     # -- introspection (dealer.go:303-309, routes.go:212-240) --------------
     def status(self) -> dict:

@@ -1,0 +1,60 @@
+"""Hot-path attribution counters for the scheduler read/publish paths.
+
+The r5 fan-out artifact's worst rep sat 41% under the >=1000 pods/s bar
+with flat loadavg — an IN-PROCESS stall the bench could not name (VERDICT
+r5 weak #2). These counters exist so the slow rep names its own cause:
+the bench snapshots them around every timed window and `/metrics` exposes
+them live, so "GC pause vs scorer rebuild vs renderer warmup vs fallback
+path" is a delta read, not a guess.
+
+Increment discipline: every counter is bumped either under the publish
+lock (snapshot_*) or the per-candidate-list arena lock (view/renderer/
+memo), where `+=` is already serialized. The fastpath_* pair is bumped on
+the lock-free verb path; under CPython's GIL a lost update there is
+vanishingly rare and only ever undercounts attribution, never corrupts
+scheduling state.
+"""
+
+from __future__ import annotations
+
+
+class PerfCounters:
+    """Monotonic process-lifetime counters; cheap enough for hot paths."""
+
+    __slots__ = (
+        "snapshot_publishes",
+        "snapshot_structural",
+        "view_builds",
+        "view_advances",
+        "renderer_builds",
+        "fastpath_hits",
+        "fastpath_misses",
+        "memo_hits",
+        "native_calls",
+    )
+
+    def __init__(self):
+        #: snapshot swaps (== published generation; structural = node-set
+        #: change, which also drops candidate-list views)
+        self.snapshot_publishes = 0
+        self.snapshot_structural = 0
+        #: fresh flattened-scorer builds (cold candidate list / topology
+        #: change) vs copy-on-write advances (chip state moved under a
+        #: cached list — the steady-state "rebuild" of a publish)
+        self.view_builds = 0
+        self.view_advances = 0
+        #: pre-baked JSON fragment blob builds (once per candidate order;
+        #: >0 inside a timed window means warmup leaked into it)
+        self.renderer_builds = 0
+        #: fused native score+render served the verb / fell back to the
+        #: list-based path
+        self.fastpath_hits = 0
+        self.fastpath_misses = 0
+        #: Filter->Prioritize shared-score memo hits vs actual native
+        #: scoring calls
+        self.memo_hits = 0
+        self.native_calls = 0
+
+    def snapshot(self) -> dict[str, int]:
+        """Point-in-time copy (bench delta arithmetic / metrics render)."""
+        return {name: getattr(self, name) for name in self.__slots__}

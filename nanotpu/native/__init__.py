@@ -30,7 +30,7 @@ _SRC = os.path.join(_REPO_ROOT, "native", "allocator.cc")
 _LIB = os.path.join(_PKG_DIR, "libnanotpu_alloc.so")
 
 #: must match nanotpu_abi_version() in allocator.cc
-ABI_VERSION = 5
+ABI_VERSION = 6
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -137,6 +137,28 @@ def _load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_int32),  # hbm_free [n*chips] (nullable)
             ctypes.POINTER(ctypes.c_int32),  # hbm_demand (nullable)
         ]
+        lib.nanotpu_score_render.restype = ctypes.c_int32
+        lib.nanotpu_score_render.argtypes = (
+            lib.nanotpu_score_batch.argtypes[:15]  # scoring inputs
+            + [
+                ctypes.POINTER(ctypes.c_int32),  # hbm_free (nullable)
+                ctypes.POINTER(ctypes.c_int32),  # hbm_demand (nullable)
+                ctypes.POINTER(ctypes.c_uint8),  # feas arena (in/out)
+                ctypes.POINTER(ctypes.c_int32),  # score arena (in/out)
+                ctypes.c_int32,  # have_scores
+                ctypes.c_int32,  # mode: 0 filter, 1 priorities
+                ctypes.c_char_p,  # qnames blob
+                ctypes.POINTER(ctypes.c_int32),  # qoff [n+1]
+                ctypes.c_char_p,  # prio frags blob
+                ctypes.POINTER(ctypes.c_int32),  # prio_off [n+1]
+                ctypes.c_char_p,  # fail frags blob
+                ctypes.POINTER(ctypes.c_int32),  # fail_off [n+1]
+                ctypes.c_char_p,  # extra
+                ctypes.c_int32,  # extra_len
+                ctypes.c_char_p,  # out
+                ctypes.c_int32,  # out_cap
+            ]
+        )
         lib.nanotpu_render_priorities.restype = ctypes.c_int32
         lib.nanotpu_render_priorities.argtypes = [
             ctypes.c_char_p,  # frags blob
@@ -183,6 +205,7 @@ def score_batch(
     gang=None,
     hbm_flat=None,
     hbm_demand: list[int] | None = None,
+    out=None,
 ):
     """Feasibility + final score for every node of a uniform pool in ONE
     native call (Filter/Prioritize fan-out without per-node overhead).
@@ -192,7 +215,9 @@ def score_batch(
     persistent and update rows in place (see dealer.batch.BatchScorer).
     ``gang``: None, or a tuple ``(node_slice, node_coords, node_coord_ok,
     n_slices, slice_cells, slice_cell_off)`` of ctypes arrays encoding the
-    gang members' host cells per slice.
+    gang members' host cells per slice. ``out``: optional
+    ``(feasible u8 array, score i32 array)`` arena reused across calls
+    (the caller owns synchronization); None allocates fresh buffers.
 
     Returns (feasible: ctypes u8 array, score: ctypes i32 array); raises
     :class:`NativeUnavailable` when the caller should fall back.
@@ -203,8 +228,11 @@ def score_batch(
     nd = len(demands)
     c_dims = (ctypes.c_int32 * 3)(*dims)
     c_demands = (ctypes.c_int32 * max(nd, 1))(*demands)
-    out_feasible = (ctypes.c_uint8 * max(n_nodes, 1))()
-    out_score = (ctypes.c_int32 * max(n_nodes, 1))()
+    if out is not None:
+        out_feasible, out_score = out
+    else:
+        out_feasible = (ctypes.c_uint8 * max(n_nodes, 1))()
+        out_score = (ctypes.c_int32 * max(n_nodes, 1))()
     if gang is None:
         g = (None, None, None, 0, None, None)
     else:
@@ -223,6 +251,66 @@ def score_batch(
     if rc != OK:
         raise NativeUnavailable(f"native score_batch error {rc}")
     return out_feasible, out_score
+
+
+def score_render(
+    dims: tuple[int, int, int],
+    n_nodes: int,
+    free_flat,
+    total_flat,
+    load_flat,
+    demands: list[int],
+    prefer_used: bool,
+    percent_per_chip: int,
+    gang,
+    hbm_flat,
+    hbm_demand: list[int] | None,
+    feas,
+    score,
+    have_scores: bool,
+    mode: int,
+    qnames: bytes,
+    qoff,
+    prio_frags: bytes,
+    prio_off,
+    fail_frags: bytes,
+    fail_off,
+    out_buf,
+    demands_buf=None,
+) -> bytes:
+    """Fused score+render: ONE native crossing turns a (demand, snapshot)
+    pair into the full response body. ``feas``/``score`` are the caller's
+    per-snapshot arena (``have_scores`` skips the scoring pass and renders
+    the arena as-is — the Filter->Prioritize memo). ``mode`` 0 renders the
+    ExtenderFilterResult, 1 the HostPriorityList. ``demands_buf`` is an
+    optional reusable ``c_int32`` arena (>= len(demands)); None allocates.
+    Raises :class:`NativeUnavailable` when the caller should fall back."""
+    lib = _load()
+    if lib is None:
+        raise NativeUnavailable("native allocator unavailable")
+    nd = len(demands)
+    if demands_buf is not None and len(demands_buf) >= max(nd, 1):
+        c_demands = demands_buf
+        c_demands[:nd] = demands
+    else:
+        c_demands = (ctypes.c_int32 * max(nd, 1))(*demands)
+    g = gang if gang is not None else (None, None, None, 0, None, None)
+    c_hbmd = (
+        (ctypes.c_int32 * max(nd, 1))(*hbm_demand)
+        if hbm_demand and any(hbm_demand) else None
+    )
+    w = lib.nanotpu_score_render(
+        dims, n_nodes, free_flat, total_flat, load_flat, nd, c_demands,
+        1 if prefer_used else 0, percent_per_chip,
+        g[0], g[1], g[2], g[3], g[4], g[5],
+        hbm_flat if c_hbmd is not None else None, c_hbmd,
+        feas, score, 1 if have_scores else 0, mode,
+        qnames, qoff, prio_frags, prio_off, fail_frags, fail_off,
+        None, 0, out_buf, len(out_buf),
+    )
+    if w < 0:
+        raise NativeUnavailable(f"native score_render error {w}")
+    return ctypes.string_at(out_buf, w)
 
 
 def render_priorities(frags: bytes, frag_off, scores, n: int,

@@ -495,13 +495,15 @@ def speculative_serving_chunk(
 
 
 def prefill_cache_only(params, cfg, prompt_padded, max_len, mesh=None):
-    """Prefill that only primes a cache row — no sampling, no lm_head
+    """Prefill that only primes cache rows — no sampling, no lm_head
     (the speculative draft's admission path: the discarded full-vocab
     logits over a padded prompt would cost more than the shallow draft's
-    whole transformer). Returns (k rows, v rows) for insert_request."""
+    whole transformer). Accepts a [B, S] batch — the re-prime path pads
+    all stale rows of one bucket into a single call. Returns (k rows,
+    v rows) for insert_request (B=1) or insert_rows (batched)."""
     from nanotpu.models.generate import _run, KVCache
 
-    cache = KVCache.create(cfg, 1, max_len)
+    cache = KVCache.create(cfg, prompt_padded.shape[0], max_len)
     if mesh is not None:
         from nanotpu.parallel.infer import constrain_cache
 
@@ -600,6 +602,36 @@ def insert_request(cache, ks, vs, slot, length):
     new_k = tuple(put4(ck, rk) for ck, rk in zip(cache.k, ks))
     new_v = tuple(put4(cv, rv) for cv, rv in zip(cache.v, vs))
     return SlotCache(new_k, new_v, lengths)
+
+
+def insert_rows(cache, ks, vs, slots, lengths):
+    """Batched :func:`insert_request`: scatter B prefilled rows into their
+    slots in ONE call (the re-prime path's per-bucket device round trip).
+    ``slots``/``lengths`` are [B]; padding rows carry an out-of-range slot
+    index (== slot capacity) and are dropped by the scatter, which is what
+    lets the caller pad every batch to one compiled shape."""
+
+    def put(cache_arr, rows):
+        return cache_arr.at[slots].set(
+            rows.astype(cache_arr.dtype), mode="drop"
+        )
+
+    new_lengths = cache.lengths.at[slots].set(lengths, mode="drop")
+    if isinstance(cache, SlotCache8):
+        kq = [quantize_kv(rk) for rk in ks]
+        vq = [quantize_kv(rv) for rv in vs]
+        return SlotCache8(
+            tuple(put(ck, q) for ck, (q, _) in zip(cache.k, kq)),
+            tuple(put(cv, q) for cv, (q, _) in zip(cache.v, vq)),
+            tuple(put(cs, s) for cs, (_, s) in zip(cache.k_scale, kq)),
+            tuple(put(cs, s) for cs, (_, s) in zip(cache.v_scale, vq)),
+            new_lengths,
+        )
+    return SlotCache(
+        tuple(put(ck, rk) for ck, rk in zip(cache.k, ks)),
+        tuple(put(cv, rv) for cv, rv in zip(cache.v, vs)),
+        new_lengths,
+    )
 
 
 class Request:
@@ -809,11 +841,18 @@ class Engine:
                         f"{draft_tokens}]"
                     )
         self.spec_rules = rules
-        #: measured-policy state: occupancy bucket -> {k: EWMA tokens/s},
-        #: sample counts, and a per-bucket sync counter for re-probes
-        self._bandit_rate: dict[int, dict[int, float | None]] = {}
-        self._bandit_n: dict[int, dict[int, int]] = {}
-        self._bandit_t: dict[int, int] = {}
+        #: measured-policy state: (occupancy bucket, chunk flavor) ->
+        #: {k: EWMA tokens/s}, sample counts, and a per-cell sync counter
+        #: for re-probes. Small- and large-chunk samples never share a
+        #: cell: their per-sync overhead amortization differs ~chunk-size-
+        #: fold, so mixing them penalizes whichever arm drew more small
+        #: chunks (ADVICE r5).
+        self._bandit_rate: dict[tuple[int, str], dict[int, float | None]] = {}
+        self._bandit_n: dict[tuple[int, str], dict[int, int]] = {}
+        self._bandit_t: dict[tuple[int, str], int] = {}
+        #: (k, flavor) chunks that have executed at least once: the first
+        #: execution's bandit sample is compile-contaminated and dropped
+        self._chunk_seen: set[tuple[int, str]] = set()
         #: slots whose draft-cache row trails the target (plain chunks ran
         #: while they were active); re-primed before the next spec chunk
         self._draft_stale: set[int] = set()
@@ -992,10 +1031,16 @@ class Engine:
                     # can hit a bucket admission never used (context
                     # grows mid-request past the prompt's bucket), and a
                     # synchronous jit compile inside the engine loop
-                    # would stall every active row for seconds
+                    # would stall every active row for seconds. Both
+                    # shapes: [1, b] (admission) and [slots, b] (the
+                    # batched re-prime, which always pads to slot count)
                     for b in self.buckets:
                         self._prefill_draft(
                             self.draft_params, jnp.zeros((1, b), jnp.int32)
+                        )
+                        self._prefill_draft(
+                            self.draft_params,
+                            jnp.zeros((self.slots, b), jnp.int32),
                         )
             except Exception:
                 log.exception("large-chunk compile failed; small chunk only")
@@ -1022,6 +1067,12 @@ class Engine:
             )
             self._insert_d = jax.jit(
                 insert_request, donate_argnums=(0,),
+                out_shardings=(
+                    d_cache_sh if mesh is not None else None
+                ),
+            )
+            self._insert_rows_d = jax.jit(
+                insert_rows, donate_argnums=(0,),
                 out_shardings=(
                     d_cache_sh if mesh is not None else None
                 ),
@@ -1118,12 +1169,13 @@ class Engine:
                 )
                 if self.spec_cycles_total else None
             ),
-            # measured policy: the live per-bucket arm table (EWMA
-            # tokens/s per speculation depth), so operators can see WHY
-            # the engine is choosing plain or speculative chunks
+            # measured policy: the live per-(bucket, chunk flavor) arm
+            # table (EWMA tokens/s per speculation depth), so operators
+            # can see WHY the engine is choosing plain or speculative
+            # chunks; keys render as "occupancy/flavor"
             "spec_bandit_tok_s": (
                 {
-                    str(b): {
+                    f"{b[0]}/{b[1]}": {
                         str(k): (r if r is None else round(r, 1))
                         for k, r in arms.items()
                     }
@@ -1246,11 +1298,12 @@ class Engine:
             self._remaining[slot] = req.max_new_tokens - 1  # first already out
             self._dirty = True
 
-    def _policy_k(self, n_active: int) -> int:
+    def _policy_k(self, n_active: int, flavor: str = "large") -> int:
         """Speculation depth for a chunk at ``n_active`` occupied slots:
-        the first rule covering the count decides; none -> 0 (plain)."""
+        the first rule covering the count decides; none -> 0 (plain).
+        ``flavor`` picks the measured-mode arm table (see _bandit_pick)."""
         if self._measured:
-            return self._bandit_pick(n_active)
+            return self._bandit_pick(n_active, flavor)
         for max_active, rule_k in self.spec_rules:
             if n_active <= max_active:
                 return rule_k
@@ -1276,12 +1329,20 @@ class Engine:
             b *= 2
         return b
 
-    def _bandit_pick(self, n_active: int) -> int:
+    def _bandit_pick(self, n_active: int, flavor: str = "large") -> int:
         """Measured policy: explore under-sampled arms, then exploit the
-        best EWMA tokens/s for this occupancy bucket, re-probing losers
-        every BANDIT_PROBE_EVERY syncs. Greedy outputs are invariant
-        across arms, so exploration never changes emitted tokens."""
-        b = self._bandit_bucket(n_active)
+        best EWMA tokens/s for this (occupancy bucket, chunk flavor) cell,
+        re-probing losers every BANDIT_PROBE_EVERY syncs. Greedy outputs
+        are invariant across arms, so exploration never changes emitted
+        tokens.
+
+        Keyed by FLAVOR as well as bucket (ADVICE r5): the small chunk
+        amortizes the per-chunk host sync over far fewer device steps
+        than the large one, so its tokens/s samples run systematically
+        low — explore samples landing on the small chunk (queue briefly
+        non-empty) were sinking arms in the shared table on a penalty
+        that says nothing about the arm."""
+        b = (self._bandit_bucket(n_active), flavor)
         # the whole pick runs under the lock stats() snapshots with
         # (ADVICE r5): the writes are cheap scalar ops, and leaning on the
         # GIL for the _bandit_t read-modify-write would break the moment a
@@ -1308,18 +1369,30 @@ class Engine:
             return best
 
     def _bandit_update(self, n_active: int, k: int, tokens: int,
-                       dt: float) -> None:
-        if not self._measured or tokens <= 0 or dt <= 0:
+                       dt: float, flavor: str = "large",
+                       cold: bool = False) -> None:
+        """Fold one chunk's tokens/s into its (bucket, flavor, arm) EWMA.
+        ``cold`` marks the first-ever execution of that compiled chunk:
+        its dt includes XLA compile/dispatch warmup (seconds against a
+        millisecond steady state), a sample about the COMPILER that would
+        sink the arm for the next ~1/alpha real samples — dropped."""
+        if cold or not self._measured or tokens <= 0 or dt <= 0:
             return
-        b = self._bandit_bucket(n_active)
+        b = (self._bandit_bucket(n_active), flavor)
         r = tokens / dt
         with self._cv:  # stats() deep-copies the arm table under this lock
-            cur = self._bandit_rate[b][k]
-            self._bandit_rate[b][k] = (
+            rate = self._bandit_rate.setdefault(
+                b, {arm: None for arm in self._variant_ks}
+            )
+            n = self._bandit_n.setdefault(
+                b, {arm: 0 for arm in self._variant_ks}
+            )
+            cur = rate[k]
+            rate[k] = (
                 r if cur is None
                 else (1 - self.BANDIT_ALPHA) * cur + self.BANDIT_ALPHA * r
             )
-            self._bandit_n[b][k] += 1
+            n[k] += 1
 
     def _reprime_draft(self) -> None:
         """Catch stale draft-cache rows up to the target's frontier.
@@ -1328,11 +1401,18 @@ class Engine:
         next speculative chunk each surviving row's draft cache must hold
         k/v for the same context. The full token sequence is on the host
         (prompt + emitted), so this is exactly the admission-time draft
-        prefill re-run at the row's current length: one bucketed draft
-        forward + insert per stale row, dispatched async, only when the
-        policy switches regimes. Numeric wobble between a prefilled and
-        an incrementally-built draft row only perturbs PROPOSALS — never
-        emitted tokens, which acceptance pins to the target."""
+        prefill re-run at the row's current length — BATCHED: stale rows
+        group by context bucket and each bucket costs ONE padded draft
+        forward plus ONE scatter insert, so a plain→spec arm flip at
+        occupancy B pays one device round trip per bucket instead of up
+        to B (VERDICT r5 weak #5). Each batch is padded to the engine's
+        slot count so a bucket has exactly one compiled shape (warmed by
+        the background compile thread); padding rows scatter to an
+        out-of-range slot and are dropped. Numeric wobble between a
+        prefilled and an incrementally-built draft row only perturbs
+        PROPOSALS — never emitted tokens, which acceptance pins to the
+        target."""
+        by_bucket: dict[int, list[tuple[int, int, list[int]]]] = {}
         for i in sorted(self._draft_stale):
             self._draft_stale.discard(i)
             req = self._slot_req[i]
@@ -1340,14 +1420,24 @@ class Engine:
                 continue
             seq = req.prompt + req.out
             t_len = len(seq) - 1  # the last token is the next input
-            bucket = self._bucket(t_len)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :t_len] = seq[:t_len]
+            by_bucket.setdefault(self._bucket(t_len), []).append(
+                (i, t_len, seq)
+            )
+        for bucket, rows in by_bucket.items():
+            padded = np.zeros((self.slots, bucket), np.int32)
+            # padding rows target slot index == capacity -> scatter drops
+            slots = np.full((self.slots,), self.slots, np.int32)
+            lengths = np.zeros((self.slots,), np.int32)
+            for j, (i, t_len, seq) in enumerate(rows):
+                padded[j, :t_len] = seq[:t_len]
+                slots[j] = i
+                lengths[j] = t_len
             dks, dvs = self._prefill_draft(
                 self.draft_params, jnp.asarray(padded)
             )
-            self._d_cache = self._insert_d(
-                self._d_cache, dks, dvs, jnp.int32(i), jnp.int32(t_len)
+            self._d_cache = self._insert_rows_d(
+                self._d_cache, dks, dvs, jnp.asarray(slots),
+                jnp.asarray(lengths),
             )
 
     def _decode_cycle(self) -> None:
@@ -1383,7 +1473,23 @@ class Engine:
         # can cross regimes mid-stream (the invariance test pins that
         # greedy outputs don't notice).
         n_active = sum(r is not None for r in self._slot_req)
-        k = self._policy_k(n_active)
+        # flavor decided BEFORE the arm. Measured mode gates "large" on
+        # EVERY variant's large chunk existing: queued -> small chunk
+        # regardless of k, so the bandit must consult the small-chunk arm
+        # table (the two flavors' tokens/s are not comparable), and the
+        # all-or-nothing gate is what guarantees the chunk actually run
+        # matches the table consulted — with a partial _chunk_large (mid-
+        # compile, or one variant failed to compile) a per-k fallback
+        # would feed small-chunk samples to arms picked from the large
+        # table, pinning that cell in exploration forever. Rule-based
+        # policies never consult the table, so they keep the per-k
+        # fallback and use each large chunk the moment it compiles.
+        measured_large = (
+            self._measured and not queued and self._chunk_large
+            and all(k in self._chunk_large for k in self._variant_ks)
+        )
+        flavor = "large" if measured_large else "small"
+        k = self._policy_k(n_active, flavor)
         if k > 0 and self._draft_stale:
             self._reprime_draft()
         # timed AFTER the re-prime: the bandit estimates each arm's
@@ -1393,9 +1499,21 @@ class Engine:
         # a plain phase paid the re-prime, so the spec arm never looked
         # good at B=1 even when it was 1.5x faster sustained
         t_chunk = time.perf_counter()
-        chunk = self._chunk_small[k]
-        if not queued:
-            chunk = self._chunk_large.get(k, chunk)
+        if self._measured:
+            # pick/update consistency: run exactly the flavor the bandit
+            # consulted (measured_large guarantees availability)
+            chunk = (
+                self._chunk_large[k] if flavor == "large"
+                else self._chunk_small[k]
+            )
+        else:
+            chunk = self._chunk_small[k]
+            if not queued:
+                large = self._chunk_large.get(k)
+                if large is not None:
+                    chunk, flavor = large, "large"
+        cold = (k, flavor) not in self._chunk_seen
+        self._chunk_seen.add((k, flavor))
         if k > 0:
             (
                 self._cache, self._d_cache, self._d_tokens, self._d_done,
@@ -1480,7 +1598,8 @@ class Engine:
                 # one wakeup per chunk per row for stream() consumers
                 req._notify_progress()
         self._bandit_update(
-            n_active, k, self.tokens_total - toks_before, dt_chunk
+            n_active, k, self.tokens_total - toks_before, dt_chunk,
+            flavor=flavor, cold=cold,
         )
 
     def _loop(self) -> None:

@@ -477,8 +477,17 @@ class Simulator:
         self._push(self.now + delay, "metric_apply", samples)
 
     def _on_metric_apply(self, samples: list) -> None:
+        touched: set[str] = set()
         for node, chip, core in samples:
-            self.dealer.update_chip_usage(node, chip, core=core, now=self.now)
+            # publish deferred: one snapshot publish per metric event, not
+            # one full view clone per chip sample (same batching as
+            # controller/metricsync.sync_once)
+            self.dealer.update_chip_usage(
+                node, chip, core=core, now=self.now, publish=False
+            )
+            touched.add(node)
+        if touched:
+            self.dealer.publish_usage(tuple(sorted(touched)))
 
     def _on_resync(self) -> None:
         self.controller.resync_once()

@@ -406,7 +406,7 @@ int clamp_score(double s) {
 extern "C" {
 
 // ABI version so the ctypes loader can reject stale builds.
-int32_t nanotpu_abi_version() { return 5; }
+int32_t nanotpu_abi_version() { return 6; }
 
 // Place `n_demands` container demands onto one node's torus.
 //
@@ -766,6 +766,64 @@ int32_t nanotpu_render_filter(const char* qnames,
   memcpy(out + w, kTail, sizeof(kTail) - 1);
   w += sizeof(kTail) - 1;
   return w;
+}
+
+// Fused score + render (ABI 6): the per-request hot path of the
+// snapshot read side in ONE ctypes crossing. `feas`/`score` are the
+// caller's per-snapshot arena — written by the scoring pass and read by
+// the render pass; when `have_scores` is 1 (the sibling verb of the same
+// (pod, snapshot) already scored) the scoring pass is skipped entirely
+// and the arena contents are rendered as-is. `mode` 0 renders the
+// ExtenderFilterResult, 1 the HostPriorityList. Returns bytes written
+// into `out`, or a NANOTPU_ERR_* code.
+int32_t nanotpu_score_render(const int32_t dims[3],
+                             int32_t n_nodes,
+                             const int32_t* free_percent,
+                             const int32_t* total_percent,
+                             const double* load,
+                             int32_t n_demands,
+                             const int32_t* demands,
+                             int32_t prefer_used,
+                             int32_t percent_per_chip,
+                             const int32_t* node_slice,
+                             const int32_t* node_coords,
+                             const uint8_t* node_coord_ok,
+                             int32_t n_slices,
+                             const int32_t* slice_cells,
+                             const int32_t* slice_cell_off,
+                             const int32_t* hbm_free,
+                             const int32_t* hbm_demand,
+                             uint8_t* feas,
+                             int32_t* score,
+                             int32_t have_scores,
+                             int32_t mode,
+                             const char* qnames,
+                             const int32_t* qoff,
+                             const char* prio_frags,
+                             const int32_t* prio_off,
+                             const char* fail_frags,
+                             const int32_t* fail_off,
+                             const char* extra,
+                             int32_t extra_len,
+                             char* out,
+                             int32_t out_cap) {
+  if (!feas || !score || (mode != 0 && mode != 1))
+    return NANOTPU_ERR_BAD_ARGS;
+  if (!have_scores) {
+    // score_batch reports per-node infeasibility through `feas`, never as
+    // a return code — any non-OK rc here is a real argument/size error.
+    int32_t rc = nanotpu_score_batch(
+        dims, n_nodes, free_percent, total_percent, load, n_demands, demands,
+        prefer_used, percent_per_chip, node_slice, node_coords, node_coord_ok,
+        n_slices, slice_cells, slice_cell_off, feas, score, hbm_free,
+        hbm_demand);
+    if (rc != NANOTPU_OK) return rc;
+  }
+  if (mode == 1)
+    return nanotpu_render_priorities(prio_frags, prio_off, score, n_nodes,
+                                     out, out_cap);
+  return nanotpu_render_filter(qnames, qoff, fail_frags, fail_off, feas,
+                               n_nodes, extra, extra_len, out, out_cap);
 }
 
 }  // extern "C"

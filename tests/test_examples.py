@@ -4,6 +4,7 @@ not drift)."""
 
 from pathlib import Path
 
+import pytest
 import yaml
 
 from nanotpu import types
@@ -88,6 +89,7 @@ def test_long_context_example_sp_divides_seq():
     assert chips % sp == 0
 
 
+@pytest.mark.fullstack
 def test_speculative_serving_example_runs():
     """The speculative-serving walkthrough is runnable documentation:
     train-on-corpus -> distill -> per-row speculative engine -> exact

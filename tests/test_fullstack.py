@@ -52,6 +52,8 @@ from nanotpu.utils import pod as podutil
 
 from harness import Extender, v5p_node
 
+pytestmark = pytest.mark.fullstack
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 GANG = "llama-train"

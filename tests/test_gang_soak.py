@@ -25,6 +25,8 @@ from nanotpu.routes.server import SchedulerAPI, serve
 
 import urllib.request
 
+pytestmark = pytest.mark.fullstack
+
 
 def post(base, path, payload, timeout=30):
     req = urllib.request.Request(

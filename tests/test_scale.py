@@ -9,11 +9,15 @@ from __future__ import annotations
 
 import time
 
+import pytest
+
 from nanotpu import types
 from nanotpu.allocator.rater import make_rater
 from nanotpu.cmd.main import make_mock_cluster
 from nanotpu.dealer import Dealer
 from nanotpu.k8s.objects import make_container, make_pod
+
+pytestmark = pytest.mark.fullstack
 
 N_HOSTS = 256  # 1024 chips over 16 slices of 16 hosts
 N_PODS = 512   # x 2 chips = the entire pool

@@ -809,7 +809,67 @@ class TestMeasuredPolicy:
             # buckets are independent: occupancy 4 starts exploring fresh
             assert eng._bandit_pick(4) == 0 and eng._bandit_bucket(3) == 4
             tab = eng.stats()["spec_bandit_tok_s"]
-            assert "2" in tab and set(tab["2"]) == {"0", "3"}
+            assert "2/large" in tab and set(tab["2/large"]) == {"0", "3"}
+        finally:
+            eng.stop()
+
+    def test_bandit_arm_tables_are_keyed_by_chunk_flavor(self, tiny_model):
+        """Small-chunk samples amortize the per-sync overhead over far
+        fewer steps than large-chunk ones; a shared table let explore
+        samples landing on the small chunk sink an arm systematically
+        (ADVICE r5). The two flavors must explore and exploit
+        independently."""
+        params, cfg = tiny_model
+        draft, dcfg = self._draft(params, cfg)
+        eng = Engine(params, cfg, slots=4, max_len=128, buckets=(16,),
+                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3,
+                     spec_policy="measured")
+        try:
+            m = eng.BANDIT_MIN_SAMPLES
+            # large flavor: spec arm (3) measures 2x faster
+            for _ in range(2 * m):
+                k = eng._bandit_pick(2, "large")
+                eng._bandit_update(2, k, tokens=8,
+                                   dt=0.1 if k == 3 else 0.2, flavor="large")
+            # small flavor: the SAME occupancy measures plain faster —
+            # e.g. admission-latency-dominated small chunks
+            for _ in range(2 * m):
+                k = eng._bandit_pick(2, "small")
+                eng._bandit_update(2, k, tokens=8,
+                                   dt=0.2 if k == 3 else 0.1, flavor="small")
+            assert eng._bandit_pick(2, "large") == 3
+            assert eng._bandit_pick(2, "small") == 0
+            tab = eng.stats()["spec_bandit_tok_s"]
+            assert set(tab) == {"2/large", "2/small"}
+        finally:
+            eng.stop()
+
+    def test_cold_compile_sample_cannot_flip_the_argmax(self, tiny_model):
+        """The first execution of a compiled chunk carries XLA compile
+        time in its dt — seconds against a millisecond steady state. A
+        cold-flagged sample must leave the arm table untouched, so one
+        compile-phase observation can never flip which arm the bandit
+        exploits (ISSUE r6 satellite; ADVICE r5 medium)."""
+        params, cfg = tiny_model
+        draft, dcfg = self._draft(params, cfg)
+        eng = Engine(params, cfg, slots=4, max_len=128, buckets=(16,),
+                     draft_params=draft, draft_cfg=dcfg, draft_tokens=3,
+                     spec_policy="measured")
+        try:
+            m = eng.BANDIT_MIN_SAMPLES
+            for _ in range(2 * m):
+                k = eng._bandit_pick(2, "large")
+                eng._bandit_update(2, k, tokens=8,
+                                   dt=0.1 if k == 3 else 0.2, flavor="large")
+            assert eng._bandit_pick(2, "large") == 3
+            before = {
+                b: dict(arms) for b, arms in eng._bandit_rate.items()
+            }
+            # a compile-contaminated observation: 8 tokens in 30 seconds
+            eng._bandit_update(2, 3, tokens=8, dt=30.0, flavor="large",
+                               cold=True)
+            assert eng._bandit_rate == before
+            assert eng._bandit_pick(2, "large") == 3
         finally:
             eng.stop()
 

@@ -1,0 +1,233 @@
+"""RCU snapshot read-path invariants (ISSUE r6 tentpole).
+
+Filter/Prioritize consume a published, immutable snapshot instead of
+taking the dealer lock; writers publish successors. These tests pin the
+two properties the design's safety rests on:
+
+* generation numbers are strictly monotonic across every commit kind
+  (bind, release, node add/remove, chip-usage sync);
+* a snapshot handed to an in-flight read verb is NEVER mutated by a
+  concurrent Assume/bind — its scorer row arrays are byte-stable for as
+  long as the reader holds them.
+
+Plus the bench-warmup contract: after the untimed warmup pods, the timed
+window starts with every cache hot (zero renderer/view builds, zero
+fused-path misses in the first timed rep).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from nanotpu import native, types
+from nanotpu.allocator.rater import Binpack, make_rater
+from nanotpu.cmd.main import make_mock_cluster
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.objects import make_container, make_pod
+
+N_HOSTS = 8
+
+
+def _pod(client, name, percent=100):
+    return client.create_pod(
+        make_pod(
+            name,
+            containers=[
+                make_container("t", {types.RESOURCE_TPU_PERCENT: percent})
+            ],
+        )
+    )
+
+
+@pytest.fixture
+def dealer():
+    client = make_mock_cluster(N_HOSTS, 4)
+    d = Dealer(client, make_rater("binpack"))
+    yield d, client
+    d.close()
+
+
+def _row_bytes(scorer):
+    """The scorer's chip-state row arrays, as bytes (for exact
+    immutability comparison)."""
+    return tuple(
+        bytes(memoryview(arr))
+        for arr in (scorer.free, scorer.total, scorer.load, scorer.hbm)
+    )
+
+
+class TestSnapshotPublication:
+    def test_generation_strictly_monotonic_across_commit_kinds(self, dealer):
+        """Every observable commit kind publishes a strictly newer
+        generation. (A commit nothing can observe — no cached view moved
+        and no node-set change — is allowed to skip publishing, so the
+        view is warmed first to make each commit observable.)"""
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        d, client = dealer
+        names = [f"v5p-host-{i}" for i in range(N_HOSTS)]
+        assert d._batch_plan(names) is not None  # warm an observable view
+        gens = [d._published.gen]
+
+        pod = _pod(client, "p-mono")
+        d.assume(names, pod)
+        bound = d.bind(names[0], pod)
+        gens.append(d._published.gen)
+
+        d.update_chip_usage(names[0], 0, core=0.5)
+        gens.append(d._published.gen)
+
+        d.release(bound)
+        gens.append(d._published.gen)
+
+        node = client.get_node(names[1])
+        d.remove_node(names[1])
+        gens.append(d._published.gen)
+        d.observe_node(node)
+        gens.append(d._published.gen)
+
+        assert all(b > a for a, b in zip(gens, gens[1:])), gens
+
+    def test_structural_publish_starts_with_empty_views(self, dealer):
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        d, client = dealer
+        names = [f"v5p-host-{i}" for i in range(N_HOSTS)]
+        # warm a view on the current snapshot
+        assert d._batch_plan(names) is not None
+        assert d._published.views
+        # a node-set change is structural: the fresh snapshot must not
+        # carry views built against the old node mapping
+        d.remove_node(names[-1])
+        assert d._published.views == {}
+        # the next read warms the (shorter) list again
+        assert d._batch_plan(names[:-1]) is not None
+
+    def test_chip_state_publish_advances_views_copy_on_write(self, dealer):
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        d, client = dealer
+        names = [f"v5p-host-{i}" for i in range(N_HOSTS)]
+        scorer0 = d._batch_plan(names)[0]
+        pod = _pod(client, "p-cow")
+        d.assume(names, pod)
+        d.bind(names[0], pod)
+        scorer1 = d._batch_plan(names)[0]
+        # same candidate list, new view object: the bind's publish
+        # advanced it copy-on-write rather than mutating in place
+        assert scorer1 is not scorer0
+        assert scorer1.state_rev == scorer0.state_rev + 1
+        assert d.perf.view_advances >= 1
+        # the chain shares one arena (lock + output buffers + renderer)
+        assert scorer1._lock is scorer0._lock
+        assert scorer1.out_score is scorer0.out_score
+
+
+class TestSnapshotImmutability:
+    def test_bind_never_mutates_inflight_reader_snapshot(self, dealer):
+        """The in-flight Filter's view: capture the published scorer,
+        run a full Assume+Bind (which republishes), and verify the
+        captured arrays did not move a byte."""
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        d, client = dealer
+        names = [f"v5p-host-{i}" for i in range(N_HOSTS)]
+        snap = d._published
+        scorer = d._batch_plan(names)[0]
+        before = _row_bytes(scorer)
+        pod = _pod(client, "p-imm", percent=200)
+        ok, _ = d.assume(names, pod)
+        d.bind(ok[0], pod)
+        assert d._published is not snap
+        assert d._published.gen > snap.gen
+        assert _row_bytes(scorer) == before
+        # and the successor actually saw the bind
+        assert _row_bytes(d._batch_plan(names)[0]) != before
+
+    def test_concurrent_assume_bind_vs_filter_reads(self, dealer):
+        """Hammer variant: reader threads repeatedly capture the
+        published view and re-verify byte stability while a writer binds
+        and releases pods. Any in-place mutation of a captured scorer
+        shows up as a byte diff."""
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        d, client = dealer
+        names = [f"v5p-host-{i}" for i in range(N_HOSTS)]
+        assert d._batch_plan(names) is not None  # warm
+        stop = threading.Event()
+        errors: list[str] = []
+
+        def reader():
+            while not stop.is_set():
+                plan = d._batch_plan(names)
+                if plan is None:
+                    continue
+                scorer = plan[0]
+                first = _row_bytes(scorer)
+                # the writer commits in this window...
+                if _row_bytes(scorer) != first:
+                    errors.append("captured scorer mutated in place")
+                    return
+
+        threads = [threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(40):
+                pod = _pod(client, f"p-hammer-{i}")
+                ok, _ = d.assume(names, pod)
+                bound = d.bind(ok[0], pod)
+                d.release(bound)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors, errors
+
+    def test_filter_payload_parity_across_publishes(self, dealer):
+        """The fused snapshot path returns the same wire bytes semantics
+        as the list-based path after every publish (feasible sets match
+        state): bind pods until a host fills and check the fused Filter
+        stops offering it."""
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        d, client = dealer
+        names = [f"v5p-host-{i}" for i in range(N_HOSTS)]
+        # fill host 0: 4 chips = 400 percent
+        for i in range(2):
+            pod = _pod(client, f"p-fill-{i}", percent=200)
+            d.assume(names, pod)
+            d.bind(names[0], pod)
+        probe = _pod(client, "p-probe", percent=200)
+        payload = d.filter_payload(names, probe)
+        assert payload is not None
+        feasible = json.loads(payload)["NodeNames"]
+        assert names[0] not in feasible
+        assert set(names[1:]).issubset(set(feasible))
+        ok, failed = d.assume(names, probe)
+        assert sorted(feasible) == sorted(ok)
+
+
+class TestBenchWarmup:
+    def test_first_timed_rep_has_zero_cache_misses(self):
+        """The bench's untimed warmup pods must fully populate the
+        snapshot views and renderer blobs: the first timed rep's
+        attribution shows zero view/renderer builds and zero fused-path
+        misses (ISSUE r6 satellite — warmup leaking builds into the
+        timed window was a candidate cause of the r5 tail rep)."""
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        import bench
+
+        out = bench.run_fanout(n_hosts=N_HOSTS, n_pods=6, warm_pods=4)
+        attr = out["attr"]
+        assert attr["view_builds"] == 0, attr
+        assert attr["renderer_builds"] == 0, attr
+        assert attr["fastpath_misses"] == 0, attr
+        assert attr["gen2_collections"] == 0, attr
+        # every timed verb took the fused path: 2 per pod (filter +
+        # priorities)
+        assert attr["fastpath_hits"] == 2 * 6, attr
