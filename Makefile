@@ -3,9 +3,13 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native test test-fast bench sim-smoke image clean
+.PHONY: all native test test-fast bench sim-smoke chaos-soak image clean
 
-all: native test
+# Default verification tier: the fast inner loop (test-fast includes
+# sim-smoke) plus the overload-resilience soak. The tier-1 gate
+# (`pytest tests/ -m 'not slow'` over everything) is unchanged — run it
+# via `make test` / CI.
+all: native test-fast chaos-soak
 
 native:
 	$(MAKE) -C native
@@ -29,6 +33,14 @@ bench: native
 # (docs/simulation.md). Fast enough for every PR.
 sim-smoke:
 	python -m nanotpu.sim --scenario examples/sim/smoke.json --seed 0 \
+		--check-determinism
+
+# Overload-resilience gate (docs/robustness.md): smoke's faults + arrival
+# bursts + API brownouts through the resilient write path, bounded sync
+# queue, and assume-TTL sweeper. Run TWICE (--check-determinism): exits
+# nonzero on any invariant violation or digest divergence.
+chaos-soak:
+	python -m nanotpu.sim --scenario examples/sim/chaos.json --seed 0 \
 		--check-determinism
 
 image:

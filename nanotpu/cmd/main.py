@@ -23,8 +23,11 @@ from nanotpu import types
 from nanotpu.allocator.rater import make_rater
 from nanotpu.dealer import Dealer
 from nanotpu.k8s.client import FakeClientset
+from nanotpu.k8s.events import EventRecorder
+from nanotpu.k8s.resilience import ResilientClientset
 from nanotpu.metrics.registry import Registry
-from nanotpu.routes.server import SchedulerAPI, serve
+from nanotpu.metrics.resilience import ResilienceCounters
+from nanotpu.routes.server import OverloadConfig, SchedulerAPI, serve
 
 log = logging.getLogger("nanotpu.main")
 
@@ -68,6 +71,22 @@ def build_app(argv: list[str] | None = None):
         help="run against an in-memory cluster with N v5p hosts",
     )
     parser.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
+    parser.add_argument(
+        "--http-timeout", type=float, default=90.0, metavar="S",
+        help="the extender httpTimeout registered with kube-scheduler "
+        "(deploy/kube-scheduler-config.yaml); per-verb response budgets "
+        "derive from it — past-budget requests answer 503",
+    )
+    parser.add_argument(
+        "--max-inflight", type=int, default=64, metavar="N",
+        help="admission gate: shed Filter/Prioritize with 429 once this "
+        "many verb requests are in flight (Bind is never shed)",
+    )
+    parser.add_argument(
+        "--assume-ttl", type=float, default=300.0, metavar="S",
+        help="expire assumed-but-never-bound placement annotations after "
+        "this long (0 disables the sweeper)",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -83,10 +102,22 @@ def build_app(argv: list[str] | None = None):
 
         client = RestClientset.from_env(kubeconfig=args.kubeconfig)
 
+    # one degradation ledger shared by every layer, exported as
+    # nanotpu_resilience_* on /metrics; all apiserver writes go through
+    # the retry-budget + circuit-breaker wrapper (docs/robustness.md)
+    resilience = ResilienceCounters()
+    client = ResilientClientset(client, counters=resilience)
     rater = make_rater(args.priority)
-    dealer = Dealer(client, rater)
+    recorder = EventRecorder(client, resilience=resilience)
+    dealer = Dealer(client, rater, recorder=recorder)
     registry = Registry()
-    api = SchedulerAPI(dealer, registry)
+    api = SchedulerAPI(
+        dealer, registry,
+        overload=OverloadConfig(
+            http_timeout_s=args.http_timeout, max_inflight=args.max_inflight
+        ),
+        resilience=resilience,
+    )
     return args, client, dealer, api
 
 
@@ -95,8 +126,15 @@ def main(argv: list[str] | None = None) -> int:
 
     from nanotpu.controller.controller import Controller
 
-    controller = Controller(client, dealer, resync_period_s=args.sync_period)
+    controller = Controller(
+        client, dealer, resync_period_s=args.sync_period,
+        assume_ttl_s=args.assume_ttl, resilience=api.resilience,
+    )
     controller.start()
+    # /readyz (deploy readinessProbe): serve traffic only once boot-time
+    # assumed-pod reconstruction is done AND the informer has synced once
+    api.add_ready_check("dealer-warm", lambda: dealer.warmed)
+    api.add_ready_check("informer-sync", controller.synced)
 
     if args.load_schedule:
         from nanotpu.controller.metricsync import start_metric_sync
@@ -122,6 +160,9 @@ def main(argv: list[str] | None = None) -> int:
         stop["flag"] = True
         log.info("signal %s: shutting down", signum)
         controller.stop()
+        # flush pending K8s Events; a timeout logs + counts the unposted
+        # backlog (events_unflushed) instead of silently dropping it
+        dealer.recorder.flush(timeout=2.0)
         server.shutdown()
 
     signal.signal(signal.SIGINT, on_signal)

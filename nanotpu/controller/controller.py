@@ -14,6 +14,16 @@ Rebuild of ``pkg/controller/controller.go``. Same event semantics:
 Transient sync errors retry through the workqueue with exponential backoff,
 capped attempts (controller.go:202-268's rate-limited queue; node queue used
 10s->360s, controller.go:126).
+
+Overload behavior (docs/robustness.md): the workqueue is a bounded,
+per-pod-coalescing queue (client-go's workqueue dedupes the same way) —
+an event storm for one pod costs one queued sync, and a storm across many
+pods sheds watch-driven syncs once the bound is hit (counted; the
+periodic resync repairs whatever was shed). The assume-TTL sweeper
+(:meth:`Controller.sweep_assumed_once`) expires pods that carry placement
+annotations but never actually bound — a crashed scheduler's leftovers,
+or a bind whose API write half-failed — rolling chip accounting back and
+stripping the stale annotations so retries start clean.
 """
 
 from __future__ import annotations
@@ -22,9 +32,11 @@ import logging
 import queue
 import threading
 import time
+from collections import OrderedDict, deque
 
+from nanotpu import types
 from nanotpu.dealer import Dealer
-from nanotpu.k8s.client import ApiError, Clientset, NotFoundError
+from nanotpu.k8s.client import ApiError, Clientset, ConflictError, NotFoundError
 from nanotpu.k8s.objects import Pod
 from nanotpu.utils import pod as podutil
 
@@ -34,6 +46,96 @@ MAX_SYNC_RETRIES = 5
 BACKOFF_BASE_S = 0.05
 BACKOFF_MAX_S = 5.0
 
+#: Default bound on distinct pods queued for sync; beyond it, watch-driven
+#: enqueues shed (resync repairs). Repair-path enqueues bypass the bound.
+QUEUE_MAX_DEFAULT = 1024
+
+#: Default TTL for assumed-but-never-bound placement annotations.
+ASSUME_TTL_DEFAULT_S = 300.0
+
+
+class CoalescingQueue:
+    """Bounded pod-sync workqueue, latest-event-wins per pod key.
+
+    Semantics match client-go's workqueue where it matters here: a key
+    already queued absorbs repeat puts (one queued sync serves any number
+    of events — ``_sync_pod`` re-GETs the pod, so the latest state wins by
+    construction), FIFO across distinct keys, and ``None`` sentinels for
+    worker shutdown are delivered only after real items drain (matching
+    stdlib Queue's put-order behavior the workers were written against).
+
+    The bound applies to WATCH-driven puts only: an event storm across
+    more than ``maxsize`` distinct pods sheds the excess (counted as
+    ``queue_dropped``; the periodic resync re-enqueues every live pod).
+    Repair-path puts — resync itself, and capped retry re-puts — pass
+    ``force=True``: dropping the repair mechanism would turn a transient
+    shed into a permanent accounting divergence, and those paths are
+    naturally bounded (live pods / retry cap) anyway.
+    """
+
+    def __init__(self, maxsize: int = QUEUE_MAX_DEFAULT, resilience=None):
+        self._cv = threading.Condition()
+        self._items: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._sentinels: deque = deque()
+        self.maxsize = maxsize
+        self.resilience = resilience
+        self.unfinished_tasks = 0
+        self.dropped = 0
+        self.coalesced = 0
+
+    def put(self, item, force: bool = False) -> bool:
+        """Enqueue (namespace, name, attempt) or a ``None`` sentinel.
+        Returns False iff the item was shed (bound hit, not forced)."""
+        with self._cv:
+            if item is None:
+                self._sentinels.append(None)
+                self.unfinished_tasks += 1
+                self._cv.notify()
+                return True
+            namespace, name, attempt = item
+            key = (namespace, name)
+            existing = self._items.get(key)
+            if existing is not None:
+                # latest event wins; keep the larger attempt so the retry
+                # cap still binds when a retry re-put coalesces
+                self._items[key] = (namespace, name,
+                                    max(attempt, existing[2]))
+                self.coalesced += 1
+                if self.resilience is not None:
+                    self.resilience.inc("queue_coalesced")
+                return True
+            if not force and self.maxsize and len(self._items) >= self.maxsize:
+                self.dropped += 1
+                if self.resilience is not None:
+                    self.resilience.inc("queue_dropped")
+                log.warning(
+                    "sync queue full (%d pods); shed sync for %s/%s "
+                    "(resync will repair)", self.maxsize, namespace, name,
+                )
+                return False
+            self._items[key] = item
+            self.unfinished_tasks += 1
+            self._cv.notify()
+            return True
+
+    def get(self, block: bool = True):
+        with self._cv:
+            while not self._items and not self._sentinels:
+                if not block:
+                    raise queue.Empty
+                self._cv.wait()
+            if self._items:
+                _, item = self._items.popitem(last=False)
+                return item
+            return self._sentinels.popleft()
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def task_done(self) -> None:
+        with self._cv:
+            self.unfinished_tasks -= 1
+
 
 class Controller:
     def __init__(
@@ -42,6 +144,9 @@ class Controller:
         dealer: Dealer,
         workers: int = 2,
         resync_period_s: float = 30.0,
+        queue_max: int = QUEUE_MAX_DEFAULT,
+        assume_ttl_s: float = ASSUME_TTL_DEFAULT_S,
+        resilience=None,
     ):
         self.client = client
         self.dealer = dealer
@@ -49,7 +154,12 @@ class Controller:
         #: periodic full re-list (informer resync analogue, cmd/main.go:31);
         #: safety net for events lost across watch reconnects. <=0 disables.
         self.resync_period_s = resync_period_s
-        self._queue: "queue.Queue[tuple[str, str, int] | None]" = queue.Queue()
+        #: TTL for assumed-but-never-bound annotations; <=0 disables the
+        #: sweeper thread (sweep_assumed_once stays callable either way)
+        self.assume_ttl_s = assume_ttl_s
+        self.resilience = resilience
+        self._queue = CoalescingQueue(maxsize=queue_max,
+                                      resilience=resilience)
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self._pod_watch = None
@@ -57,6 +167,13 @@ class Controller:
         # key -> last seen pod object (the informer cache analogue)
         self._cache_lock = threading.Lock()
         self._pod_cache: dict[str, Pod] = {}
+        #: (pod key, resourceVersion) -> first time the sweeper saw it
+        #: unbound-but-assumed; an rv change (new bind attempt) restarts
+        #: the TTL clock automatically because it changes the key
+        self._assume_seen: dict[tuple[str, str], float] = {}
+        #: set once the initial list (or a later resync) has fed the dealer
+        #: — the informer-sync half of /readyz
+        self._synced = threading.Event()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -66,8 +183,10 @@ class Controller:
             for pod in self.client.list_pods():
                 if podutil.is_tpu_sharing_pod(pod):
                     self._remember(pod)
-                    self._enqueue(pod)
+                    self._enqueue(pod, force=True)  # boot sync is a repair
+            self._synced.set()
         except ApiError as e:
+            # not synced: /readyz stays 503 until a resync list succeeds
             log.warning("initial pod list failed: %s", e)
         self._pod_watch = self.client.watch_pods()
         self._node_watch = self.client.watch_nodes()
@@ -83,8 +202,17 @@ class Controller:
             self._threads.append(
                 threading.Thread(target=self._resync_loop, daemon=True, name="resync")
             )
+        if self.assume_ttl_s > 0:
+            self._threads.append(
+                threading.Thread(target=self._sweep_loop, daemon=True, name="assume-sweep")
+            )
         for t in self._threads:
             t.start()
+
+    def synced(self) -> bool:
+        """True once a full pod list has fed the dealer at least once (the
+        informer WaitForCacheSync analogue) — /readyz gates on this."""
+        return self._synced.is_set()
 
     def stop(self) -> None:
         self._stop.set()
@@ -113,8 +241,9 @@ class Controller:
         with self._cache_lock:
             return self._pod_cache.get(key)
 
-    def _enqueue(self, pod: Pod, attempt: int = 0) -> None:
-        self._queue.put((pod.namespace, pod.name, attempt))
+    def _enqueue(self, pod: Pod, attempt: int = 0,
+                 force: bool = False) -> None:
+        self._queue.put((pod.namespace, pod.name, attempt), force=force)
 
     def _pod_loop(self) -> None:
         for event in self._pod_watch:
@@ -185,7 +314,10 @@ class Controller:
         for pod in live_pods:
             if podutil.is_tpu_sharing_pod(pod):
                 self._remember(pod)
-                self._enqueue(pod)
+                # force: resync IS the repair path for shed watch syncs;
+                # coalescing bounds it at one entry per live pod
+                self._enqueue(pod, force=True)
+        self._synced.set()
         live_uids = {p.uid for p in live_pods}
         for uid, pod in pre.items():
             if uid not in live_uids:
@@ -207,6 +339,90 @@ class Controller:
         for node in live.values():  # catch resizes a dropped
             self.dealer.refresh_node(node)  # watch event missed
 
+    # -- assume-TTL sweeper ------------------------------------------------
+    def _sweep_loop(self) -> None:
+        period = max(self.assume_ttl_s / 2, 1.0)
+        while not self._stop.wait(period):
+            try:
+                self.sweep_assumed_once()
+            except Exception:  # the sweeper thread must outlive any sweep
+                log.exception("assume sweep failed")
+
+    def sweep_assumed_once(self, ttl_s: float | None = None,
+                           now: float | None = None) -> int:
+        """Expire assumed-but-never-bound placement annotations.
+
+        A pod carrying ``tpu.io/assume`` + chip annotations but no
+        ``spec.nodeName`` is a half-completed bind: the annotation PUT
+        landed, the pods/binding POST never did (API brownout, injected
+        failure, scheduler crash between the two writes). Live retries
+        rewrite the annotations (new resourceVersion -> fresh TTL clock),
+        so only pods parked in that state for a full TTL at the SAME
+        resourceVersion expire: their stale annotations are stripped so a
+        later scheduler boot can never replay a placement that does not
+        exist, and — if this dealer somehow still accounts the uid — the
+        chips roll back through ``Dealer.forget`` under the same
+        invariants the sim checks. Deterministic given ``now`` (the sim
+        passes virtual time). Returns the number of pods expired."""
+        ttl = self.assume_ttl_s if ttl_s is None else ttl_s
+        now = time.monotonic() if now is None else now
+        try:
+            pods = self.client.list_pods(
+                label_selector={types.ANNOTATION_ASSUME: "true"}
+            )
+        except ApiError as e:
+            log.warning("assume sweep list failed: %s", e)
+            return 0
+        expired = 0
+        seen: set[tuple[str, str]] = set()
+        for pod in pods:
+            if pod.node_name or podutil.is_completed_pod(pod):
+                continue
+            key = (pod.key(), pod.resource_version)
+            seen.add(key)
+            first = self._assume_seen.setdefault(key, now)
+            if now - first < ttl:
+                continue
+            if self._expire_assumed(pod, ttl):
+                expired += 1
+                self._assume_seen.pop(key, None)
+                seen.discard(key)
+                if self.resilience is not None:
+                    self.resilience.inc("assume_expired")
+        # entries whose pod progressed (bound/deleted/re-annotated) are
+        # stale bookkeeping; drop them so the map cannot grow unbounded
+        self._assume_seen = {
+            k: t for k, t in self._assume_seen.items() if k in seen
+        }
+        return expired
+
+    def _expire_assumed(self, pod: Pod, ttl: float) -> bool:
+        stripped = pod.deepcopy()
+        ann = stripped.ensure_annotations()
+        ann.pop(types.ANNOTATION_ASSUME, None)
+        ann.pop(types.ANNOTATION_BOUND_POLICY, None)
+        for c in stripped.containers:
+            ann.pop(types.ANNOTATION_CONTAINER_FMT.format(name=c.name), None)
+        stripped.ensure_labels().pop(types.ANNOTATION_ASSUME, None)
+        try:
+            self.client.update_pod(stripped)
+        except ConflictError:
+            return False  # the pod just moved (e.g. a retry re-annotated)
+        except NotFoundError:
+            pass  # deleted under us: the forget below still applies
+        except ApiError as e:
+            log.warning("assume sweep could not strip %s: %s", pod.key(), e)
+            return False
+        log.warning(
+            "expired stale placement annotations on %s (assumed but never "
+            "bound within %gs)", pod.key(), ttl,
+        )
+        if self.dealer.tracks(pod.uid):
+            # defensive: accounting for an unbound pod is exactly the leak
+            # the sweeper exists to stop — roll the chips back
+            self.dealer.forget(pod)
+        return True
+
     # -- work side ---------------------------------------------------------
     def drain_sync(self) -> int:
         """Synchronously process every queued pod sync in the caller's
@@ -224,7 +440,9 @@ class Controller:
             try:
                 if item is not None and self._process_item(
                     item,
-                    lambda ns, n, a: self._queue.put((ns, n, a + 1)),
+                    lambda ns, n, a: self._queue.put(
+                        (ns, n, a + 1), force=True
+                    ),
                 ):
                     processed += 1
             finally:
@@ -256,6 +474,7 @@ class Controller:
             delay,
             self._queue.put,
             args=((namespace, name, attempt + 1),),
+            kwargs={"force": True},  # capped retries never shed themselves
         )
         timer.daemon = True
         timer.start()

@@ -41,6 +41,7 @@ from nanotpu.k8s.events import EventRecorder
 from nanotpu.k8s.objects import Node, Pod
 from nanotpu.utils import node as nodeutil
 from nanotpu.utils import pod as podutil
+from nanotpu.utils.deadline import Deadline, check as deadline_check
 
 log = logging.getLogger("nanotpu.dealer")
 
@@ -207,6 +208,9 @@ class Dealer:
         self._warm_from_cluster()
         self._publish_enabled = True
         self._republish()
+        #: boot-time assumed-pod reconstruction is complete; one of the two
+        #: /readyz gates (the other is the controller's informer sync)
+        self.warmed = True
 
     # -- boot-time state reconstruction (dealer.go:58-72) ------------------
     def _warm_from_cluster(self) -> None:
@@ -432,6 +436,13 @@ class Dealer:
     def node_names(self) -> list[str]:
         with self._lock:
             return sorted(self._nodes)
+
+    def tracks(self, uid: str) -> bool:
+        """True when this dealer currently accounts pod ``uid`` (the
+        assume-TTL sweeper uses this to decide whether expiring a stale
+        annotation also needs a chip-accounting rollback)."""
+        with self._lock:
+            return uid in self._pods
 
     def tracked_pods(self) -> list[Pod]:
         """Snapshot of every pod the dealer currently accounts (bound by us
@@ -664,9 +675,16 @@ class Dealer:
         return demand
 
     def assume(
-        self, node_names: list[str], pod: Pod
+        self, node_names: list[str], pod: Pod,
+        deadline: Deadline | None = None,
     ) -> tuple[list[str], dict[str, str]]:
-        """Partition candidate nodes into (schedulable, {node: reason})."""
+        """Partition candidate nodes into (schedulable, {node: reason}).
+
+        ``deadline`` (threaded from the route layer's response budget)
+        aborts an over-budget request at entry — before any per-node
+        locks or apiserver warming GETs — with DeadlineExceeded; the
+        route layer answers 503 and kube-scheduler's retry carries on."""
+        deadline_check(deadline, "filter:start")
         demand = self._demand_of(pod)
         if not demand.is_valid():
             return [], {
@@ -713,6 +731,10 @@ class Dealer:
                 for n in node_names
                 if n not in self._nodes and n not in self._non_tpu
             )
+        # cold candidates mean blocking apiserver GETs ahead; re-probe the
+        # budget so a request that already burned it parsing/queueing does
+        # not start a fan-out nobody will read
+        deadline_check(deadline, "filter:warm")
         if cold <= ASSUME_COLD_POOL_THRESHOLD:
             results = [try_node(n) for n in node_names]
         else:
@@ -753,7 +775,9 @@ class Dealer:
         return member_slices
 
     # -- Score (Prioritize verb): dealer.go:138-153 ------------------------
-    def score(self, node_names: list[str], pod: Pod) -> list[tuple[str, int]]:
+    def score(self, node_names: list[str], pod: Pod,
+              deadline: Deadline | None = None) -> list[tuple[str, int]]:
+        deadline_check(deadline, "priorities:start")
         demand = self._demand_of(pod)
         if not demand.is_valid():
             return [(n, types.SCORE_MIN) for n in node_names]
@@ -791,10 +815,17 @@ class Dealer:
         return out
 
     # -- Bind verb: dealer.go:155-203 --------------------------------------
-    def bind(self, node_name: str, pod: Pod) -> Pod:
+    def bind(self, node_name: str, pod: Pod,
+             deadline: Deadline | None = None) -> Pod:
         """Apply the plan, write annotations (optimistic retry), post the
         binding. Raises BindError with accounting rolled back on failure.
-        Emits a K8s Event either way (TPUAssigned / FailedBinding)."""
+        Emits a K8s Event either way (TPUAssigned / FailedBinding).
+
+        The deadline is only probed HERE, before any reservation exists:
+        once chips are reserved the bind runs to completion regardless —
+        committing is idempotent-retry-safe (the _bind_outer uid guard),
+        abandoning a half-written annotation is not."""
+        deadline_check(deadline, "bind:start")
         try:
             return self._bind_outer(node_name, pod)
         finally:

@@ -51,9 +51,13 @@ class EventRecorder:
     thread, with update-in-place count aggregation. Thread-safe; never
     raises; never blocks the caller on the API."""
 
-    def __init__(self, client, component: str = COMPONENT):
+    def __init__(self, client, component: str = COMPONENT,
+                 resilience=None):
         self.client = client
         self.component = component
+        #: optional ResilienceCounters: events are fail-open by design, so
+        #: every drop (queue full, flush timeout) must at least be counted
+        self.resilience = resilience
         self._lock = threading.Lock()
         # key -> (event name, count, firstTimestamp), LRU-ordered
         self._entries: OrderedDict[tuple, tuple[str, int, str]] = OrderedDict()
@@ -80,6 +84,8 @@ class EventRecorder:
             # repeat-storm during an API outage undercounts — acceptable
             # for Events, which are themselves best-effort K8s objects
             log.warning("event queue full; dropped %s for %s", reason, pod.key())
+            if self.resilience is not None:
+                self.resilience.inc("events_failopen")
 
     def _build(self, item) -> tuple[str, str, int, dict]:
         """Aggregation bookkeeping + v1 Event body (worker thread)."""
@@ -119,13 +125,31 @@ class EventRecorder:
 
     def flush(self, timeout: float = 5.0) -> bool:
         """Block until everything enqueued so far has been posted (tests,
-        shutdown). Returns False on timeout."""
+        shutdown). Returns False on timeout — and since shutdown callers
+        historically dropped that return on the floor, a timeout also
+        logs the unposted backlog and counts it (events_unflushed), so
+        "the scheduler exited with N events unposted" is visible in logs
+        and on the final /metrics scrape instead of silently gone."""
         done = threading.Event()
         try:
             self._q.put_nowait(done)
         except queue.Full:
+            self._warn_unflushed(self._q.qsize())
             return False
-        return done.wait(timeout)
+        if done.wait(timeout):
+            return True
+        # the flush marker itself counts toward qsize; the real backlog
+        # is everything still ahead of (and including) unposted events
+        self._warn_unflushed(max(self._q.qsize() - 1, 1))
+        return False
+
+    def _warn_unflushed(self, n: int) -> None:
+        log.warning(
+            "event flush timed out with ~%d event(s) unposted; they will "
+            "be lost if the process exits now", n,
+        )
+        if self.resilience is not None:
+            self.resilience.inc("events_unflushed", n=n)
 
     def _drain(self) -> None:
         while True:

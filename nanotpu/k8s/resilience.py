@@ -1,0 +1,274 @@
+"""Resilient K8s write path: retry budget + circuit breaker per target.
+
+The dealer's bind sequence is two apiserver writes (annotation PUT, then
+the pods/binding POST) plus best-effort Event POSTs. Under an API
+brownout the naive client turns every scheduling cycle into a stack of
+30 s timeouts: handler threads pile up behind a dead apiserver, the
+extender blows its httpTimeout, and kube-scheduler sees the worst
+failure mode there is — a slow one. :class:`ResilientClientset` wraps
+any :class:`~nanotpu.k8s.client.Clientset` so failure is *fast and
+classified* instead:
+
+* **retries with jittered exponential backoff** for transient failures
+  (HTTP 5xx / 429 / transport errors). 404/409 are semantic answers
+  from a healthy server — never retried, and they *reset* the breaker.
+* **per-target retry budget** (token bucket): a retry storm may not
+  multiply load onto an already-degraded apiserver. Targets are
+  independent so Event-retry spend can never starve Bind.
+* **circuit breaker per target** (``bind`` / ``pod_write`` /
+  ``events``): consecutive failures trip it open; while open, writes
+  fast-fail in microseconds; after a cooldown one half-open probe is
+  allowed through — success closes it, failure re-opens with escalated
+  cooldown.
+* **failure policy by criticality**: Events **fail open** (dropped +
+  counted — they are best-effort objects); Bind and annotation writes
+  **fail closed** (the error propagates, the dealer rolls chip
+  accounting back, kube-scheduler requeues the pod and retries).
+
+Reads delegate untouched — list/watch already have their own reconnect
+discipline (rest.py), and a failed read is not a consistency hazard.
+
+``clock``/``sleep``/``rng`` are injectable so the deterministic sim can
+drive the exact production code on virtual time (docs/simulation.md).
+Every decision lands in :class:`~nanotpu.metrics.resilience.
+ResilienceCounters` so a brownout is attributable from ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Callable
+
+from nanotpu.k8s.client import ApiError, ConflictError, NotFoundError
+from nanotpu.metrics.resilience import ResilienceCounters
+
+log = logging.getLogger("nanotpu.k8s.resilience")
+
+TARGET_BIND = "bind"
+TARGET_POD_WRITE = "pod_write"
+TARGET_EVENTS = "events"
+
+
+def _retryable(e: ApiError) -> bool:
+    """Transient server/transport trouble, not a semantic answer."""
+    return not isinstance(e, (NotFoundError, ConflictError)) and (
+        e.code >= 500 or e.code == 429
+    )
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open probes; thread-safe."""
+
+    def __init__(self, target: str, counters: ResilienceCounters,
+                 clock: Callable[[], float],
+                 failure_threshold: int = 5,
+                 cooldown_s: float = 5.0, cooldown_max_s: float = 60.0):
+        self.target = target
+        self.counters = counters
+        self.clock = clock
+        self.failure_threshold = failure_threshold
+        self.base_cooldown_s = cooldown_s
+        self.cooldown_max_s = cooldown_max_s
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._open_until: float | None = None  # None == closed
+        self._cooldown = cooldown_s
+        self._probing = False
+
+    def allow(self) -> bool:
+        """True when the caller may issue a request (closed, or claimed
+        the single half-open probe slot)."""
+        with self._lock:
+            if self._open_until is None:
+                return True
+            if self.clock() >= self._open_until and not self._probing:
+                self._probing = True  # this caller IS the probe
+                return True
+            return False
+
+    def record(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self._failures = 0
+                self._open_until = None
+                self._cooldown = self.base_cooldown_s
+                self._probing = False
+                return
+            self._failures += 1
+            if self._probing:
+                # failed probe: re-open with escalated cooldown
+                self._probing = False
+                self._cooldown = min(self._cooldown * 2, self.cooldown_max_s)
+                self._open_until = self.clock() + self._cooldown
+                self.counters.inc("breaker_opens", self.target)
+                log.warning(
+                    "%s breaker probe failed; open for %.1fs",
+                    self.target, self._cooldown,
+                )
+            elif (
+                self._open_until is None
+                and self._failures >= self.failure_threshold
+            ):
+                self._open_until = self.clock() + self._cooldown
+                self.counters.inc("breaker_opens", self.target)
+                log.warning(
+                    "%s breaker opened after %d consecutive failures; "
+                    "fast-failing writes for %.1fs",
+                    self.target, self._failures, self._cooldown,
+                )
+
+    @property
+    def open(self) -> bool:
+        with self._lock:
+            return self._open_until is not None
+
+
+class _RetryBudget:
+    """Token bucket: each retry (not first attempt) spends one token."""
+
+    def __init__(self, capacity: float, refill_per_s: float,
+                 clock: Callable[[], float]):
+        self.capacity = capacity
+        self.refill_per_s = refill_per_s
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._tokens = capacity
+        self._last = clock()
+
+    def take(self) -> bool:
+        with self._lock:
+            now = self.clock()
+            self._tokens = min(
+                self.capacity,
+                self._tokens + (now - self._last) * self.refill_per_s,
+            )
+            self._last = now
+            if self._tokens < 1.0:
+                return False
+            self._tokens -= 1.0
+            return True
+
+
+class ResilientClientset:
+    """See module docstring. Wraps the write verbs; everything else
+    delegates to the inner clientset untouched."""
+
+    def __init__(
+        self,
+        inner,
+        counters: ResilienceCounters | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: random.Random | None = None,
+        max_attempts: int = 3,
+        backoff_base_s: float = 0.1,
+        backoff_max_s: float = 2.0,
+        retry_budget: float = 10.0,
+        retry_refill_per_s: float = 1.0,
+        failure_threshold: int = 5,
+        cooldown_s: float = 5.0,
+    ):
+        self.inner = inner
+        self.counters = counters or ResilienceCounters()
+        self._clock = clock
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+        self.max_attempts = max_attempts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.breakers = {
+            t: CircuitBreaker(
+                t, self.counters, clock,
+                failure_threshold=failure_threshold, cooldown_s=cooldown_s,
+            )
+            for t in (TARGET_BIND, TARGET_POD_WRITE, TARGET_EVENTS)
+        }
+        # per-target budgets: the Event recorder's background-thread retry
+        # spend must never starve a Bind retry on the verb thread
+        self._budgets = {
+            t: _RetryBudget(retry_budget, retry_refill_per_s, clock)
+            for t in self.breakers
+        }
+
+    # -- write plumbing ----------------------------------------------------
+    def _call(self, target: str, fn, fail_open: bool = False):
+        breaker = self.breakers[target]
+        if not breaker.allow():
+            self.counters.inc("breaker_fastfails", target)
+            if fail_open:
+                self.counters.inc("events_failopen")
+                return None
+            raise ApiError(
+                f"{target} write fast-failed: circuit breaker open "
+                "(apiserver writes are failing; request not attempted)",
+                code=503,
+            )
+        attempt = 0
+        while True:
+            try:
+                out = fn()
+            except (NotFoundError, ConflictError):
+                breaker.record(True)  # a healthy server said no
+                raise
+            # broad on purpose: the REST client maps most transport trouble
+            # to ApiError, but read-phase timeouts/resets surface raw — and
+            # an exception that bypassed record() would strand a claimed
+            # half-open probe slot, wedging the breaker open forever
+            except Exception as e:
+                breaker.record(False)
+                may_retry = (
+                    (_retryable(e) if isinstance(e, ApiError) else True)
+                    and attempt + 1 < self.max_attempts
+                    and not breaker.open
+                    and self._budgets[target].take()
+                )
+                if may_retry:
+                    self.counters.inc("api_retries", target)
+                    delay = min(
+                        self.backoff_base_s * (2 ** attempt),
+                        self.backoff_max_s,
+                    ) * (0.5 + self._rng.random())  # jitter in [0.5x, 1.5x]
+                    self._sleep(delay)
+                    attempt += 1
+                    continue
+                if fail_open:
+                    self.counters.inc("events_failopen")
+                    log.warning("%s write dropped open: %s", target, e)
+                    return None
+                raise
+            else:
+                breaker.record(True)
+                return out
+
+    # -- guarded writes ----------------------------------------------------
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        return self._call(
+            TARGET_BIND,
+            lambda: self.inner.bind_pod(namespace, name, node_name),
+        )
+
+    def update_pod(self, pod):
+        return self._call(
+            TARGET_POD_WRITE, lambda: self.inner.update_pod(pod)
+        )
+
+    def create_event(self, namespace: str, event: dict) -> None:
+        return self._call(
+            TARGET_EVENTS,
+            lambda: self.inner.create_event(namespace, event),
+            fail_open=True,
+        )
+
+    def update_event(self, namespace: str, name: str, event: dict) -> None:
+        return self._call(
+            TARGET_EVENTS,
+            lambda: self.inner.update_event(namespace, name, event),
+            fail_open=True,
+        )
+
+    # -- everything else delegates (reads, watches, fake-cluster extras) ---
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

@@ -158,6 +158,13 @@ class Registry:
             self._metrics.append(m)
         return m
 
+    def register(self, metric) -> None:
+        """Adopt an externally-owned renderable (anything with
+        ``render() -> list[str]``), e.g. the resilience-counter exporter
+        whose backing counters live outside the registry."""
+        with self._lock:
+            self._metrics.append(metric)
+
     def render(self) -> str:
         with self._lock:
             metrics = list(self._metrics)

@@ -1,0 +1,132 @@
+"""Overload/degradation attribution counters (`nanotpu_resilience_*`).
+
+The overload-resilience layer (admission gate, per-verb deadlines, the
+coalescing controller queue, the assume-TTL sweeper, the K8s write
+breaker) *deliberately drops work* when the box or the API is unhealthy.
+Every such drop must be attributable, or "graceful degradation" is
+indistinguishable from a silent bug: these counters are the one ledger
+all of those layers write to, exported live on ``/metrics`` and
+snapshotted into the sim's deterministic report so a chaos run can prove
+that every shed request, coalesced sync, expired reservation, and
+fast-failed write was counted.
+
+One instance is shared process-wide (cmd/main wires it through server,
+controller, recorder, and client wrapper). Increments take a lock —
+these are degradation paths, not the scheduling hot path, and exactness
+is the point.
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: scalar counter fields and their Prometheus names
+_SCALARS = {
+    "queue_coalesced": (
+        "nanotpu_resilience_queue_coalesced_total",
+        "Controller sync-queue puts absorbed by an already-queued entry "
+        "for the same pod (latest event wins)",
+    ),
+    "queue_dropped": (
+        "nanotpu_resilience_queue_dropped_total",
+        "Controller watch-event syncs shed because the bounded queue was "
+        "full (periodic resync repairs the divergence)",
+    ),
+    "assume_expired": (
+        "nanotpu_resilience_assume_expired_total",
+        "Assumed-but-never-bound pods whose placement annotations the "
+        "TTL sweeper expired and rolled back",
+    ),
+    "events_failopen": (
+        "nanotpu_resilience_events_failopen_total",
+        "K8s Events dropped open (queue full, breaker open, or retries "
+        "exhausted) instead of blocking or failing scheduling",
+    ),
+    "events_unflushed": (
+        "nanotpu_resilience_events_unflushed_total",
+        "K8s Events still unposted when a shutdown flush timed out",
+    ),
+}
+
+#: labeled counter fields: field -> (metric name, label key, help)
+_LABELED = {
+    "shed": (
+        "nanotpu_resilience_shed_total", "verb",
+        "Verb requests shed by the admission gate with 429 + Retry-After "
+        "(Bind is never shed)",
+    ),
+    "deadline_expired": (
+        "nanotpu_resilience_deadline_expired_total", "verb",
+        "Verb requests aborted past their response budget (503; the "
+        "budget derives from the extender httpTimeout contract)",
+    ),
+    "api_retries": (
+        "nanotpu_resilience_api_retries_total", "target",
+        "K8s API write retries spent by the resilient client wrapper",
+    ),
+    "breaker_opens": (
+        "nanotpu_resilience_breaker_open_total", "target",
+        "Circuit-breaker open transitions per write target",
+    ),
+    "breaker_fastfails": (
+        "nanotpu_resilience_breaker_fastfail_total", "target",
+        "K8s API writes fast-failed without a request because the "
+        "target's breaker was open",
+    ),
+}
+
+
+class ResilienceCounters:
+    """Process-lifetime degradation ledger; see module docstring."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        for name in _SCALARS:
+            setattr(self, name, 0)
+        for name in _LABELED:
+            setattr(self, name, {})
+
+    def inc(self, field: str, key: str | None = None, n: int = 1) -> None:
+        """Bump scalar ``field`` (key=None) or its per-``key`` series."""
+        with self._lock:
+            cur = getattr(self, field)  # unknown field -> AttributeError
+            if isinstance(cur, dict):
+                cur[key] = cur.get(key, 0) + n
+            else:
+                setattr(self, field, cur + n)
+
+    def get(self, field: str, key: str | None = None) -> int:
+        with self._lock:
+            cur = getattr(self, field)
+            return cur.get(key, 0) if isinstance(cur, dict) else cur
+
+    def snapshot(self) -> dict:
+        """Point-in-time copy: scalar fields as ints, labeled as sorted
+        dicts (the sim report embeds this; key order must be stable)."""
+        with self._lock:
+            out: dict = {name: getattr(self, name) for name in _SCALARS}
+            for name in _LABELED:
+                out[name] = dict(sorted(getattr(self, name).items()))
+            return out
+
+
+class ResilienceExporter:
+    """Registry-compatible renderer (``Registry.register``) exposing every
+    counter in Prometheus text format."""
+
+    def __init__(self, counters: ResilienceCounters):
+        self.counters = counters
+
+    def render(self) -> list[str]:
+        snap = self.counters.snapshot()
+        out: list[str] = []
+        for field, (metric, help_) in _SCALARS.items():
+            out += [f"# HELP {metric} {help_}", f"# TYPE {metric} counter",
+                    f"{metric} {snap[field]}"]
+        for field, (metric, label, help_) in _LABELED.items():
+            out += [f"# HELP {metric} {help_}", f"# TYPE {metric} counter"]
+            series = snap[field] or {"": 0}
+            for key, val in series.items():
+                lbl = f'{{{label}="{key}"}}' if key else ""
+                out.append(f"{metric}{lbl} {val}")
+        return out

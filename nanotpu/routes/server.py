@@ -7,6 +7,9 @@ ThreadingHTTPServer:
 * POST /status            — full dealer state dump (routes.go:212-240)
 * GET  /version           — version string (routes.go:172-178)
 * GET  /healthz           — liveness
+* GET  /readyz            — readiness: 200 only once boot-time assumed-pod
+  reconstruction AND the informer's first sync are done (a live-but-cold
+  extender answering Filter from an empty dealer would fail every pod)
 * GET  /metrics           — Prometheus exposition (NEW: the reference had no
   exporter, SURVEY §5; occupancy + verb latency histograms live here)
 * GET  /debug/pprof/...   — profiling endpoints (pprof.go:10-22): Python
@@ -15,6 +18,15 @@ ThreadingHTTPServer:
 Error handling: malformed JSON or handler errors return structured JSON with
 HTTP 400/500 — the reference panicked on bad Prioritize input
 (routes.go:103,108).
+
+Overload policy (docs/robustness.md): kube-scheduler trusts the extender
+under a hard ``httpTimeout``; an extender that queues past it is worse
+than one that says no. So every verb runs under a response budget derived
+from that contract (over budget -> structured 503 "DeadlineExceeded"),
+and an admission gate sheds Filter/Prioritize with 429 + Retry-After once
+in-flight requests saturate — Bind is NEVER shed: it is the only verb
+whose abandonment can strand a kube-scheduler scheduling cycle, and its
+chip commit is idempotent-retry-safe where a shed is pure waste.
 """
 
 from __future__ import annotations
@@ -27,23 +39,62 @@ import sys
 import threading
 import time
 import traceback
+from dataclasses import dataclass
 
 from nanotpu.dealer import Dealer
 from nanotpu.metrics.registry import Registry
+from nanotpu.metrics.resilience import ResilienceCounters, ResilienceExporter
 from nanotpu.scheduler.verbs import Bind, Predicate, Prioritize, VerbError
+from nanotpu.utils.deadline import Deadline, DeadlineExceeded, check as deadline_check
 
 log = logging.getLogger("nanotpu.routes")
 
 VERSION = "0.1.0"
 
 
+@dataclass
+class OverloadConfig:
+    """Knobs for the overload-resilience layer (cmd/main flags).
+
+    ``http_timeout_s`` mirrors the extender registration's httpTimeout
+    (deploy/kube-scheduler-config.yaml) — the contract every response
+    budget derives from. Bind gets ``deadline_fraction`` of it (the
+    margin covers network + kube-scheduler-side decode); Filter and
+    Prioritize additionally cap at ``read_budget_s``: a Filter answer
+    seconds old scores a cluster that no longer exists, so shedding it
+    early (and letting the scheduler retry against fresh state) beats
+    completing it late."""
+
+    http_timeout_s: float = 90.0
+    deadline_fraction: float = 0.9
+    read_budget_s: float = 2.0
+    #: admission gate: sheddable verbs 429 once this many verb requests
+    #: are already in flight (Bind is exempt and never queues behind it)
+    max_inflight: int = 64
+    retry_after_s: int = 1
+
+    def budget_for(self, verb_name: str) -> float:
+        budget = self.http_timeout_s * self.deadline_fraction
+        if verb_name != "bind":
+            budget = min(budget, self.read_budget_s)
+        return budget
+
+
 class SchedulerAPI:
     """Wires verbs + metrics; handler-agnostic so tests can call dispatch()
     without sockets and the bench can measure the exact request path."""
 
-    def __init__(self, dealer: Dealer, registry: Registry | None = None):
+    def __init__(self, dealer: Dealer, registry: Registry | None = None,
+                 overload: OverloadConfig | None = None,
+                 resilience: ResilienceCounters | None = None):
         self.dealer = dealer
         self.registry = registry or Registry()
+        self.overload = overload or OverloadConfig()
+        self.resilience = resilience or ResilienceCounters()
+        self.registry.register(ResilienceExporter(self.resilience))
+        #: readiness gates: (name, callable) — /readyz is 200 only when
+        #: every callable returns truthy (a raising check is "not ready")
+        self._ready_checks: list[tuple[str, object]] = []
         self.predicate = Predicate(dealer)
         self.prioritize = Prioritize(dealer)
         self.bind = Bind(dealer)
@@ -136,6 +187,8 @@ class SchedulerAPI:
                 return 200, "application/json", json.dumps({"version": VERSION})
             if method == "GET" and path == "/healthz":
                 return 200, "text/plain", "ok"
+            if method == "GET" and path == "/readyz":
+                return self._readyz()
             if method == "GET" and path == "/metrics":
                 return 200, "text/plain; version=0.0.4", self.registry.render()
             if method == "GET" and path.startswith("/debug/pprof"):
@@ -151,6 +204,27 @@ class SchedulerAPI:
 
     def _verb(self, verb, body: bytes) -> tuple[int, str, str]:
         with self._inflight_lock:
+            # admission gate: once the box is chewing max_inflight verb
+            # requests, queueing more only guarantees they answer past the
+            # extender httpTimeout — shed Filter/Prioritize NOW with 429 +
+            # Retry-After (kube-scheduler retries the cycle against fresh
+            # state). Bind is never shed: its loss strands a scheduling
+            # cycle, and it is exempt from the gate rather than queued
+            # behind sheddable traffic.
+            if (
+                verb.name != "bind"
+                and self.inflight >= self.overload.max_inflight
+            ):
+                self.resilience.inc("shed", verb.name)
+                self.verb_total.inc(verb=verb.name, code="429")
+                return 429, "application/json", json.dumps({
+                    "Error": (
+                        f"{verb.name} shed: {self.inflight} requests in "
+                        f"flight (gate {self.overload.max_inflight})"
+                    ),
+                    "Reason": "Overloaded",
+                    "RetryAfterSeconds": self.overload.retry_after_s,
+                })
             self.inflight += 1
             self.requests_seen += 1
             if self.inflight > self.inflight_peak:
@@ -167,6 +241,7 @@ class SchedulerAPI:
     def _verb_timed(self, verb, body: bytes) -> tuple[int, str, str]:
         started = time.perf_counter()
         code = 200
+        deadline = Deadline(self.overload.budget_for(verb.name))
         try:
             cached = self._parse_cache
             if cached is not None and cached[0] == body:
@@ -186,15 +261,32 @@ class SchedulerAPI:
                     args.pop("__nanotpu_extracted", None)
                     self._parse_cache = (bytes(body), args)
             try:
+                # a huge body can burn the whole budget in the JSON parse;
+                # abort before any dealer work if so
+                deadline_check(deadline, f"{verb.name}:parsed")
                 fast = getattr(verb, "fast", None)
                 if fast is not None:
                     payload = fast(args)
                     if payload is not None:
                         return 200, "application/json", payload
-                result = verb.handle(args)
+                result = verb.handle(args, deadline=deadline)
             except VerbError as e:
                 code = 400
                 return 400, "application/json", json.dumps({"Error": str(e)})
+            except DeadlineExceeded as e:
+                # structured 503: kube-scheduler's extender `ignorable`
+                # semantics decide whether the cycle continues without us
+                code = 503
+                self.resilience.inc("deadline_expired", verb.name)
+                return 503, "application/json", json.dumps({
+                    "Error": (
+                        f"{verb.name} exceeded its "
+                        f"{deadline.budget_s:g}s response budget "
+                        f"(stage {e}); aborted before commit"
+                    ),
+                    "Reason": "DeadlineExceeded",
+                    "RetryAfterSeconds": self.overload.retry_after_s,
+                })
             except Exception:
                 # dispatch's catch-all will answer 500; record it as such so
                 # error-rate metrics don't report success for failures
@@ -253,6 +345,28 @@ class SchedulerAPI:
             return args
         # the lone span was nested (not the top-level key): reparse fully
         return json.loads(body)
+
+    # -- readiness ---------------------------------------------------------
+    def add_ready_check(self, name: str, fn) -> None:
+        """Register a readiness gate; ``fn()`` truthy == ready. cmd/main
+        wires dealer warm-up and the controller's informer sync here."""
+        self._ready_checks.append((name, fn))
+
+    def _readyz(self) -> tuple[int, str, str]:
+        waiting = []
+        for name, fn in self._ready_checks:
+            try:
+                ready = bool(fn())
+            except Exception:  # a crashing check is a not-ready check
+                log.exception("readiness check %s raised", name)
+                ready = False
+            if not ready:
+                waiting.append(name)
+        if waiting:
+            return 503, "application/json", json.dumps(
+                {"ready": False, "waiting": waiting}
+            )
+        return 200, "application/json", json.dumps({"ready": True})
 
     # -- idle-time GC (the between-burst half of the GC discipline) --------
     def start_idle_gc(self, idle_s: float = 0.5,
@@ -407,8 +521,14 @@ _STATUS_LINE = {
     404: b"HTTP/1.1 404 Not Found\r\n",
     411: b"HTTP/1.1 411 Length Required\r\n",
     414: b"HTTP/1.1 414 URI Too Long\r\n",
+    429: b"HTTP/1.1 429 Too Many Requests\r\n",
     500: b"HTTP/1.1 500 Internal Server Error\r\n",
+    503: b"HTTP/1.1 503 Service Unavailable\r\n",
 }
+
+#: Retry-After stamped on overload answers (429 shed / 503 past-deadline):
+#: well-behaved clients space their retry instead of hammering the gate.
+RETRY_AFTER_S = 1
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -423,7 +543,14 @@ class _Handler(socketserver.StreamRequestHandler):
     # Without TCP_NODELAY, Nagle + delayed ACK stalls every keep-alive
     # request ~40-130ms. Go's net/http disables Nagle too.
     disable_nagle_algorithm = True
+    #: idle keep-alive timeout: how long a connection may sit BETWEEN
+    #: requests (kube-scheduler keeps its pool warm across cycles)
     timeout = 60
+    #: intra-request socket deadline: once a request line has arrived, a
+    #: client trickling headers/body (or draining its response) gets this
+    #: much per socket op, not the full keep-alive idle budget — a handful
+    #: of slow clients must not park the whole handler pool for 60s each
+    IO_TIMEOUT = 10
 
     #: Largest accepted request body; ExtenderArgs for thousands of nodes
     #: fit in well under this, and it bounds how long a handler thread can
@@ -454,6 +581,9 @@ class _Handler(socketserver.StreamRequestHandler):
                 self._write(414, "application/json",
                             '{"error": "request line too long"}', False)
                 return
+            # request underway: drop from the idle keep-alive budget to the
+            # slow-client deadline for the rest of this request/response
+            self.connection.settimeout(self.IO_TIMEOUT)
             try:
                 method, path, version = line.decode("latin-1").split()
             except ValueError:
@@ -519,16 +649,27 @@ class _Handler(socketserver.StreamRequestHandler):
                     return
             if not keep_alive:
                 return
+            # response flushed: back to the idle keep-alive budget
+            self.connection.settimeout(self.timeout)
 
     def _write(self, code: int, ctype: str, payload: str | bytes,
                keep_alive: bool):
         data = payload.encode() if isinstance(payload, str) else payload
+        if code in (429, 503):
+            # single source of truth with the JSON body's RetryAfterSeconds
+            # (ServingAPI has no overload config -> module default)
+            overload = getattr(self.api, "overload", None)
+            retry_s = int(overload.retry_after_s) if overload else RETRY_AFTER_S
+            retry_hdr = f"Retry-After: {retry_s}\r\n"
+        else:
+            retry_hdr = ""
         head = (
             _STATUS_LINE.get(code)
             or f"HTTP/1.1 {code} Status\r\n".encode()
         ) + (
             f"Content-Type: {ctype}\r\nContent-Length: {len(data)}\r\n"
-            f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n"
+            + retry_hdr
+            + f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n"
         ).encode()
         # one write: headers + body leave in a single segment
         self.wfile.write(head + data)

@@ -26,6 +26,7 @@ from nanotpu.dealer import BindError, Dealer
 from nanotpu.k8s.client import ApiError, NotFoundError
 from nanotpu.k8s.objects import Pod
 from nanotpu.utils import pod as podutil
+from nanotpu.utils.deadline import Deadline, check as deadline_check
 
 log = logging.getLogger("nanotpu.scheduler")
 
@@ -80,7 +81,8 @@ class Predicate:
         self._qname: dict[str, str] = {}
         self._qfail: dict[tuple[str, str], str] = {}
 
-    def handle(self, args: dict[str, Any]) -> dict[str, Any]:
+    def handle(self, args: dict[str, Any],
+               deadline: Deadline | None = None) -> dict[str, Any]:
         pod, node_names = _extract(args)
         # demand.total > 0 == is_tpu_sharing_pod (pod.go:27-29), via the
         # pod-memoized Demand so the quantity parse happens once per pod,
@@ -88,7 +90,7 @@ class Predicate:
         if Demand.from_pod(pod).total <= 0:
             # not ours: pass every node through untouched
             return {"NodeNames": node_names, "FailedNodes": {}, "Error": ""}
-        ok, failed = self.dealer.assume(node_names, pod)
+        ok, failed = self.dealer.assume(node_names, pod, deadline=deadline)
         return {"NodeNames": ok, "FailedNodes": failed, "Error": ""}
 
     def fast(self, args: dict[str, Any]) -> bytes | None:
@@ -143,11 +145,12 @@ class Prioritize:
         #: 256 dicts was the single largest server-side cost of the verb.
         self._frags: dict[str, str] = {}
 
-    def handle(self, args: dict[str, Any]) -> list[tuple[str, int]]:
+    def handle(self, args: dict[str, Any],
+               deadline: Deadline | None = None) -> list[tuple[str, int]]:
         pod, node_names = _extract(args)
         if Demand.from_pod(pod).total <= 0:
             return [(n, 0) for n in node_names]
-        return self.dealer.score(node_names, pod)
+        return self.dealer.score(node_names, pod, deadline=deadline)
 
     def fast(self, args: dict[str, Any]) -> bytes | None:
         """See Predicate.fast."""
@@ -183,7 +186,8 @@ class Bind:
     def __init__(self, dealer: Dealer):
         self.dealer = dealer
 
-    def handle(self, args: dict[str, Any]) -> dict[str, Any]:
+    def handle(self, args: dict[str, Any],
+               deadline: Deadline | None = None) -> dict[str, Any]:
         if not isinstance(args, dict):
             raise VerbError("ExtenderBindingArgs must be a JSON object")
         name = args.get("PodName") or args.get("podName")
@@ -192,6 +196,9 @@ class Bind:
         node = args.get("Node") or args.get("node")
         if not name or not node:
             raise VerbError("PodName and Node are required")
+        # last safe abort point before apiserver round-trips begin; past
+        # here the bind commits through (see Dealer.bind's deadline note)
+        deadline_check(deadline, "bind:get-pod")
         try:
             pod = self._get_pod(namespace, name, uid)
         except NotFoundError:
@@ -201,7 +208,7 @@ class Bind:
         if podutil.is_completed_pod(pod):
             return {"Error": f"pod {namespace}/{name} is already completed"}
         try:
-            self.dealer.bind(node, pod)
+            self.dealer.bind(node, pod, deadline=deadline)
         except BindError as e:
             return {"Error": str(e)}
         log.info("bound %s/%s to %s", namespace, name, node)

@@ -33,8 +33,10 @@ from nanotpu.allocator.rater import make_rater
 from nanotpu.controller.controller import Controller
 from nanotpu.dealer import Dealer
 from nanotpu.k8s.objects import Node, Pod, plain_copy
+from nanotpu.k8s.resilience import ResilientClientset
+from nanotpu.metrics.resilience import ResilienceCounters
 from nanotpu.scheduler.verbs import Bind, Predicate, Prioritize
-from nanotpu.sim.faults import FaultPlan
+from nanotpu.sim.faults import BrownoutClient, FaultPlan
 from nanotpu.sim.fleet import fleet_summary, make_fleet
 from nanotpu.sim.invariants import check_invariants, ground_truth_occupancy
 from nanotpu.sim.report import ReportBuilder, fragmentation_of
@@ -72,10 +74,21 @@ class Simulator:
         self.rng_fault = random.Random(base + 2)
         self.rng_metric = random.Random(base + 3)
         self.rng_lifecycle = random.Random(base + 4)
+        # overload-burst arrivals live on their own stream (same isolation
+        # rule as rng_lifecycle: toggling the fault must not shift the base
+        # arrival sequence); rng_retry feeds only the resilient client's
+        # backoff jitter, whose sleeps are no-ops under virtual time
+        self.rng_overload = random.Random(base + 5)
+        self.rng_retry = random.Random(base + 6)
 
         self.client = make_fleet(self.scenario["fleet"])
         self.faults = FaultPlan(self.scenario["faults"], self.rng_fault)
         self._bind_hook = self.faults.make_bind_hook()
+        #: the degradation ledger, shared across agent restarts (it is the
+        #: run's measurement, not the dealer's state) and snapshotted into
+        #: the deterministic report
+        self.resilience = ResilienceCounters()
+        self.now = 0.0  # before _build_stack: the wrapper's clock reads it
         self._build_stack()
         # the informer tap: the sim owns the watches and feeds the REAL
         # controller handlers, with the fault layer in between
@@ -83,7 +96,6 @@ class Simulator:
         self._node_watch = self.client.watch_nodes()
 
         self.report = ReportBuilder(self.scenario, seed)
-        self.now = 0.0
         self._heap: list[tuple[float, int, object, object]] = []
         self._seq = itertools.count()
         self._uid_seq = itertools.count()
@@ -93,9 +105,23 @@ class Simulator:
 
     # -- construction --------------------------------------------------------
     def _build_stack(self) -> None:
-        """(Re)build dealer + verbs — boot and the agent-restart fault."""
+        """(Re)build dealer + verbs — boot and the agent-restart fault.
+
+        The dealer talks to the cluster through the REAL resilient write
+        path (retry + breaker, on virtual clock / no-op sleep) over the
+        brownout tap — so a chaos run exercises exactly the production
+        degradation code. The wrapper is rebuilt with the dealer: breaker
+        and budget state die with the process they model, while the
+        counters (the run's measurement) persist."""
+        api_client = ResilientClientset(
+            BrownoutClient(self.client, self.faults),
+            counters=self.resilience,
+            clock=lambda: self.now,
+            sleep=lambda s: None,
+            rng=self.rng_retry,
+        )
         self.dealer = Dealer(
-            self.client, make_rater(self.scenario["policy"]), assume_workers=2
+            api_client, make_rater(self.scenario["policy"]), assume_workers=2
         )
         self.predicate = Predicate(self.dealer)
         self.prioritize = Prioritize(self.dealer)
@@ -104,9 +130,14 @@ class Simulator:
         if hasattr(self, "controller"):
             self.controller.dealer = self.dealer
         else:
-            # never start()ed: the sim steps it deterministically
+            # never start()ed: the sim steps it deterministically (the
+            # assume sweeper runs through scheduled "assume_sweep" events,
+            # not the controller's own thread)
             self.controller = Controller(
-                self.client, self.dealer, resync_period_s=0
+                self.client, self.dealer, resync_period_s=0,
+                queue_max=self.scenario["queue_max"],
+                assume_ttl_s=0,
+                resilience=self.resilience,
             )
 
     def _push(self, t: float, kind: str, payload=None) -> None:
@@ -137,6 +168,7 @@ class Simulator:
         self._settle(horizon)
         self.report.fault_counts = dict(self.faults.counts)
         self.report.pods["pending_final"] = len(self._pending)
+        self.report.resilience = self._deterministic_resilience()
         return self.report.build(
             include_timing=include_timing,
             wall_s=time.perf_counter() - wall0,
@@ -151,10 +183,23 @@ class Simulator:
         else:
             for t, config, entry in trace_arrivals(w, horizon):
                 self._push(t, "arrival", {"config": config, "trace": entry})
+        for t, config in self.faults.overload_arrivals(
+            w, horizon, self.rng_overload
+        ):
+            self._push(t, "arrival", {"config": config, "burst": True})
         for t in self.faults.flap_times(horizon):
             self._push(t, "flap", None)
         for t in self.faults.restart_times(horizon):
             self._push(t, "agent_restart", None)
+        for start, end in self.faults.brownout_windows(horizon):
+            self._push(start, "brownout", True)
+            self._push(end, "brownout", False)
+        ttl = self.scenario["assume_ttl_s"]
+        if ttl > 0:
+            t = ttl / 2
+            while t < horizon:
+                self._push(t, "assume_sweep", None)
+                t += ttl / 2
         metric_every, metric_delay = self.faults.metric_cadence()
         if metric_every > 0:
             t = metric_every
@@ -195,6 +240,10 @@ class Simulator:
             self._on_retry()
         elif kind == "gang_resubmit":
             self._on_gang_resubmit(payload)
+        elif kind == "brownout":
+            self._on_brownout(payload)
+        elif kind == "assume_sweep":
+            self._on_assume_sweep()
         else:  # pragma: no cover - event kinds are closed within this file
             raise AssertionError(f"unknown event kind {kind}")
 
@@ -301,11 +350,18 @@ class Simulator:
     def _on_arrival(self, payload: dict) -> None:
         w = self.scenario["workload"]
         trace = payload.get("trace") or {}
+        # overload-burst arrivals draw their lifetime/shape from the
+        # dedicated rng_overload stream, end to end: the isolation rule is
+        # that toggling the fault changes NOTHING about the base jobs —
+        # not their arrival times (pinned at schedule time) and not their
+        # shapes (drawn here, in arrival order, from rng_workload only)
+        burst = bool(payload.get("burst"))
+        rng = self.rng_overload if burst else self.rng_workload
         # explicit trace overrides win even when falsy (lifetime_s: 0 ==
         # depart immediately); only absence falls back to the scenario
         life = trace.get("lifetime_s")
         if life is None:
-            life = draw_lifetime(w["lifetime_s"], self.rng_workload)
+            life = draw_lifetime(w["lifetime_s"], rng)
         gang_size = trace.get("gang_size")
         replicas = trace.get("replicas")
         job = build_job(
@@ -313,11 +369,12 @@ class Simulator:
             config=payload["config"],
             arrival_t=self.now,
             lifetime_s=float(life),
-            rng=self.rng_workload,
+            rng=rng,
             uid_of=lambda name: self._uid(),
             gang_size=int(w["gang_size"] if gang_size is None else gang_size),
             replicas=int(w["replicas"] if replicas is None else replicas),
         )
+        job.burst = burst
         self._admit_job(job)
 
     def _remove_pod(self, pod: Pod, complete_first: bool) -> None:
@@ -450,6 +507,21 @@ class Simulator:
                 ),
             })
 
+    def _on_brownout(self, active: bool) -> None:
+        self.faults.brownout_active = active
+        if active:
+            self.faults.counts["brownouts"] += 1
+        self.report.journal(
+            self.now, "brownout-start" if active else "brownout-end"
+        )
+
+    def _on_assume_sweep(self) -> None:
+        expired = self.controller.sweep_assumed_once(
+            self.scenario["assume_ttl_s"], now=self.now
+        )
+        if expired:
+            self.report.journal(self.now, f"assume-expire {expired}")
+
     def _on_metric_sync(self, payload: dict) -> None:
         self.faults.counts["metric_syncs"] += 1
         samples = []
@@ -532,11 +604,27 @@ class Simulator:
                 + ",".join(sorted({v['kind'] for v in violations})),
             )
 
+    def _deterministic_resilience(self) -> dict:
+        """The resilience-counter snapshot MINUS the Event recorder's
+        share: Events post from a background thread whose interleaving is
+        wall-clock, so their counters (events_* scalars, the "events"
+        write target) stay off the deterministic report — everything else
+        is bumped on the sim thread and is part of the contract."""
+        out: dict = {}
+        for key, val in self.resilience.snapshot().items():
+            if key.startswith("events_"):
+                continue
+            if isinstance(val, dict):
+                val = {t: c for t, c in sorted(val.items()) if t != "events"}
+            out[key] = val
+        return out
+
     def _settle(self, horizon: float) -> None:
         """Stop the fault tap, deliver everything in flight, reconcile,
         and run the convergence invariants + final sample."""
         self.now = horizon
         self.faults.armed = False
+        self.faults.brownout_active = False  # windows are horizon-clipped
         self._pump_informers()
         self.controller.resync_once()
         self.controller.drain_sync()

@@ -21,6 +21,17 @@ it injected. The taxonomy (scenario ``faults`` section):
 * ``agent_restart`` — the Dealer is torn down and rebuilt from cluster
   annotations at the listed times (``Dealer._warm_from_cluster`` replay);
   occupancy must round-trip exactly.
+* ``overload``      — periodic arrival BURSTS multiply the Poisson rate
+  for ``burst_s`` every ``burst_every_s`` (extra arrivals drawn from a
+  dedicated rng stream so toggling the fault never shifts the base
+  workload): the pending queue, the controller's bounded coalescing
+  queue, and the assume-TTL sweeper must absorb the surge and converge.
+* ``api_brownout``  — windows where the SCHEDULER's apiserver writes
+  (annotation PUT, pods/binding POST) all fail 503, injected through
+  :class:`BrownoutClient` between the dealer and the cluster: the
+  resilient client wrapper must retry, trip its breaker, fast-fail, and
+  recover through a half-open probe once the window closes — with chip
+  accounting exact throughout.
 """
 
 from __future__ import annotations
@@ -28,6 +39,31 @@ from __future__ import annotations
 import random
 
 from nanotpu.k8s.client import ApiError
+
+
+class BrownoutClient:
+    """Clientset proxy the DEALER sees: fails scheduler-side API writes
+    while a brownout window is active.
+
+    Deliberately not a ``FakeClientset`` hook: the sim's own lifecycle
+    writes (pod completion, eviction, the sweeper's annotation strip) are
+    kubelet/controller traffic that does not flow through the scheduler's
+    client in a real cluster, so the brownout must not touch them."""
+
+    def __init__(self, inner, faults: "FaultPlan"):
+        self._inner = inner
+        self._faults = faults
+
+    def update_pod(self, pod):
+        self._faults.check_brownout("update_pod")
+        return self._inner.update_pod(pod)
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        self._faults.check_brownout("bind_pod")
+        return self._inner.bind_pod(namespace, name, node_name)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
 
 
 class FaultPlan:
@@ -39,6 +75,8 @@ class FaultPlan:
         #: set False during the settle phase: convergence is only checkable
         #: once the fault tap stops perturbing the event stream
         self.armed = True
+        #: True inside an api_brownout window (core.py toggles via events)
+        self.brownout_active = False
         self.counts = {
             "node_flaps": 0,
             "pods_evicted": 0,
@@ -49,6 +87,9 @@ class FaultPlan:
             "agent_restarts": 0,
             "metric_syncs": 0,
             "metric_samples_delayed": 0,
+            "overload_arrivals": 0,
+            "brownouts": 0,
+            "brownout_rejections": 0,
         }
 
     # -- schedule-time queries (used once, at sim setup) --------------------
@@ -75,6 +116,71 @@ class FaultPlan:
         """(every_s, delay_s); every_s <= 0 disables the metric pipeline."""
         ms = self.spec["metric_sync"]
         return float(ms.get("every_s", 0) or 0), float(ms.get("delay_s", 0.0))
+
+    def overload_windows(self, horizon_s: float) -> list[tuple[float, float]]:
+        """Burst windows [(start, end)) within the horizon."""
+        ov = self.spec["overload"]
+        every = float(ov.get("burst_every_s", 0) or 0)
+        burst = float(ov.get("burst_s", 0) or 0)
+        if every <= 0 or burst <= 0:
+            return []
+        return [
+            (t * every, min(t * every + burst, horizon_s))
+            for t in range(1, int(horizon_s / every) + 1)
+            if t * every < horizon_s
+        ]
+
+    def overload_arrivals(
+        self, workload: dict, horizon_s: float, rng: random.Random
+    ) -> list[tuple[float, str]]:
+        """Extra (arrival time, config) pairs inside the burst windows,
+        at ``(rate_multiplier - 1) x`` the base Poisson rate — stacked on
+        the untouched base stream, the in-window rate is multiplied.
+        Draws come only from the dedicated ``rng`` (sim's rng_overload):
+        toggling the fault cannot shift the base arrival sequence."""
+        windows = self.overload_windows(horizon_s)
+        if not windows or "mix" not in workload:
+            # disabled, or a trace workload (explicit arrivals have no mix
+            # to draw burst shapes from — bursts are a Poisson-mode fault)
+            return []
+        mult = float(self.spec["overload"].get("rate_multiplier", 4.0))
+        extra_rate = float(workload.get("rate_per_s", 1.0)) * max(
+            mult - 1.0, 0.0
+        )
+        if extra_rate <= 0:
+            return []
+        mix = workload["mix"]
+        kinds = [k for k in sorted(mix) if mix.get(k, 0) > 0]
+        weights = [float(mix[k]) for k in kinds]
+        out: list[tuple[float, str]] = []
+        for start, end in windows:
+            t = start
+            while True:
+                t += rng.expovariate(extra_rate)
+                if t >= end:
+                    break
+                out.append((t, rng.choices(kinds, weights=weights)[0]))
+        self.counts["overload_arrivals"] += len(out)
+        return out
+
+    def brownout_windows(self, horizon_s: float) -> list[tuple[float, float]]:
+        """API-brownout windows [(start, end)) clipped inside the horizon
+        (a window must CLOSE before settle so convergence is checkable)."""
+        bo = self.spec["api_brownout"]
+        duration = float(bo.get("duration_s", 0) or 0)
+        if duration <= 0:
+            return []
+        return [
+            (t, min(t + duration, horizon_s))
+            for t in sorted(float(x) for x in bo.get("at_s", []))
+            if 0 < t < horizon_s
+        ]
+
+    def check_brownout(self, what: str) -> None:
+        """Raise 503 for a scheduler-side API write inside a brownout."""
+        if self.armed and self.brownout_active:
+            self.counts["brownout_rejections"] += 1
+            raise ApiError(f"injected API brownout ({what})", code=503)
 
     # -- event-time decisions (seeded; order of calls is deterministic) -----
     def drop_event(self) -> bool:

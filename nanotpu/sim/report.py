@@ -99,6 +99,10 @@ class ReportBuilder:
         self.invariant_checks = 0
         self.violations: list[dict] = []
         self.fault_counts: dict[str, int] = {}
+        #: deterministic slice of the resilience-counter snapshot (core.py
+        #: filters out the background-thread Event counters): attribution
+        #: for every shed/coalesced/dropped/expired/fast-failed action
+        self.resilience: dict = {}
         self.restart_occupancy_drift = 0.0
         self.final_occupancy = 0.0
         self.final_fragmentation = 0.0
@@ -158,6 +162,8 @@ class ReportBuilder:
             },
             "verbs": dict(self.verb_counts),
             "faults": dict(sorted(self.fault_counts.items())),
+            "resilience": {k: self.resilience[k]
+                           for k in sorted(self.resilience)},
             "restart_occupancy_drift_pct": round(
                 100 * self.restart_occupancy_drift, 6
             ),

@@ -26,12 +26,17 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
         "drop_event": {"prob": 0.03},
         "dup_event": {"prob": 0.03},
         "metric_sync": {"every_s": 2.0, "delay_s": 1.0},
-        "agent_restart": {"at_s": [15.0]}
+        "agent_restart": {"at_s": [15.0]},
+        "overload": {"burst_every_s": 8.0, "burst_s": 3.0,
+                     "rate_multiplier": 4.0},
+        "api_brownout": {"at_s": [12.0], "duration_s": 4.0}
       },
       "resync_every_s": 5.0,
       "sample_every_s": 1.0,
       "retry_every_s": 0.5,
-      "invariant_every_events": 1
+      "invariant_every_events": 1,
+      "assume_ttl_s": 0.0,           # >0: sweep assumed-never-bound pods
+      "queue_max": 0                 # >0: bound the controller sync queue
     }
 
 Omitted sections disable that feature (``faults: {}`` == fault-free run).
@@ -106,11 +111,19 @@ def normalize_scenario(raw: dict) -> dict:
 
     f = dict(raw.get("faults") or {})
     for key in ("node_flap", "bind_failure", "drop_event", "dup_event",
-                "metric_sync", "agent_restart"):
+                "metric_sync", "agent_restart", "overload", "api_brownout"):
         f.setdefault(key, {})
     for key in ("bind_failure", "drop_event", "dup_event"):
         prob = float(f[key].get("prob", 0.0))
         _require(0.0 <= prob <= 1.0, f"faults.{key}.prob must be in [0, 1]")
+    _require(
+        float(f["overload"].get("rate_multiplier", 4.0)) >= 1.0,
+        "faults.overload.rate_multiplier must be >= 1",
+    )
+    _require(
+        float(f["api_brownout"].get("duration_s", 0) or 0) >= 0,
+        "faults.api_brownout.duration_s must be >= 0",
+    )
 
     return {
         "name": raw.get("name", "unnamed"),
@@ -124,6 +137,8 @@ def normalize_scenario(raw: dict) -> dict:
         "sample_every_s": float(raw.get("sample_every_s", 1.0)),
         "retry_every_s": float(raw.get("retry_every_s", 0.5)),
         "invariant_every_events": int(raw.get("invariant_every_events", 1)),
+        "assume_ttl_s": float(raw.get("assume_ttl_s", 0.0)),
+        "queue_max": int(raw.get("queue_max", 0)),
     }
 
 

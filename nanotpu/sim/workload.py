@@ -52,6 +52,9 @@ class Job:
     #: the next resubmission gets incarnation + 1 so repeated kills of the
     #: same job id never reuse pod names or uids
     incarnation: int = 0
+    #: True for overload-fault burst arrivals (their draws live on the
+    #: sim's rng_overload stream, never rng_workload)
+    burst: bool = False
 
     @property
     def size(self) -> int:

@@ -1,0 +1,50 @@
+"""Request deadlines: the response-budget token threaded verb -> dealer.
+
+kube-scheduler calls the extender under a hard ``httpTimeout``
+(deploy/kube-scheduler-config.yaml): a response that arrives after it is
+indistinguishable from no response, except that it also burned a handler
+thread, the dealer locks, and an apiserver write slot on work nobody will
+read. The route layer derives a per-verb budget from that contract
+(:class:`nanotpu.routes.server.OverloadConfig`), wraps it in a
+:class:`Deadline`, and threads it through ``verb.handle`` into the dealer,
+which calls :func:`check` at its safe points — verb entry, before lock
+acquisition, before apiserver round-trips — so an over-budget request
+aborts where nothing needs rolling back instead of deep inside a commit.
+
+Checks are deliberately sparse: once a bind holds a chip reservation it
+runs to completion regardless of the deadline (committing is
+idempotent-retry-safe, abandoning a half-written annotation is not).
+``deadline=None`` everywhere means "no budget" — the sim and direct tests
+drive verbs without one and pay zero overhead for it.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class DeadlineExceeded(Exception):
+    """The request ran past its response budget; str() names the stage
+    (e.g. ``filter:start``) where the overrun was detected."""
+
+
+class Deadline:
+    """An absolute monotonic expiry; cheap enough to probe per safe point."""
+
+    __slots__ = ("at", "budget_s")
+
+    def __init__(self, budget_s: float):
+        self.budget_s = budget_s
+        self.at = time.monotonic() + budget_s
+
+    def remaining(self) -> float:
+        return self.at - time.monotonic()
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.at
+
+
+def check(deadline: Deadline | None, stage: str) -> None:
+    """Raise :class:`DeadlineExceeded` when past budget; no-op for None."""
+    if deadline is not None and time.monotonic() >= deadline.at:
+        raise DeadlineExceeded(stage)

@@ -1,0 +1,356 @@
+"""Overload-resilience behavior (docs/robustness.md): the admission gate
+under real threaded saturation, per-verb response budgets, /readyz, the
+bounded coalescing workqueue, and the assume-TTL sweeper.
+
+The saturation test is the acceptance pin for this layer: with the gate
+held full by parked Filter requests, Bind must still commit within its
+deadline budget while additional Filters shed 429 — and every shed must
+be attributed by ``nanotpu_resilience_shed_total``.
+"""
+
+from __future__ import annotations
+
+import json
+import queue as queue_mod
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.cmd.main import make_mock_cluster
+from nanotpu.controller.controller import CoalescingQueue, Controller
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.objects import make_container, make_pod
+from nanotpu.metrics.resilience import ResilienceCounters
+from nanotpu.routes.server import OverloadConfig, SchedulerAPI, serve
+from nanotpu.utils import pod as podutil
+from nanotpu.utils.deadline import Deadline, DeadlineExceeded
+
+from harness import post
+
+
+def _create_tpu_pod(client, name, percent=100):
+    pod = make_pod(
+        name,
+        containers=[make_container("main", {types.RESOURCE_TPU_PERCENT: percent})],
+    )
+    return client.create_pod(pod)
+
+
+def _api(n_hosts=2, **overload_kw):
+    client = make_mock_cluster(n_hosts)
+    dealer = Dealer(client, make_rater(types.POLICY_BINPACK))
+    api = SchedulerAPI(dealer, overload=OverloadConfig(**overload_kw))
+    return client, dealer, api
+
+
+class TestAdmissionGate:
+    def test_bind_commits_within_budget_while_filter_sheds(self):
+        """The tentpole contract: saturate the gate with parked Filters;
+        Bind passes the gate and commits inside its deadline budget,
+        further Filters answer 429 immediately, and the shed counter
+        attributes every one of them."""
+        client, dealer, api = _api(max_inflight=2)
+        server = serve(api, 0, host="127.0.0.1")
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        release = threading.Event()
+        entered = threading.Semaphore(0)
+        orig_handle = api.predicate.handle
+
+        def parked_handle(args, deadline=None):
+            entered.release()
+            release.wait(10)
+            return orig_handle(args, deadline=deadline)
+
+        api.predicate.handle = parked_handle
+        api.predicate.fast = None  # force the handle() path
+        try:
+            victim = _create_tpu_pod(client, "victim")
+            args = {
+                "Pod": victim.raw,
+                "NodeNames": ["v5p-host-0", "v5p-host-1"],
+            }
+            results = []
+            occupying = [
+                threading.Thread(
+                    target=lambda: results.append(
+                        post(base, "/scheduler/filter", args)
+                    )
+                )
+                for _ in range(2)
+            ]
+            for t in occupying:
+                t.start()
+            assert entered.acquire(timeout=5) and entered.acquire(timeout=5)
+
+            # gate saturated: more Filters shed NOW, not after a queue wait
+            for _ in range(4):
+                t0 = time.monotonic()
+                code, body = post(base, "/scheduler/filter", args)
+                assert time.monotonic() - t0 < 1.0
+                assert code == 429
+                assert body["Reason"] == "Overloaded"
+                assert body["RetryAfterSeconds"] >= 1
+            # the wire carries Retry-After for naive clients too
+            req = urllib.request.Request(
+                base + "/scheduler/filter",
+                data=json.dumps(args).encode(), method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 429
+            assert e.value.headers["Retry-After"]
+
+            # Bind is never shed: it commits while the gate is saturated,
+            # well inside its response budget
+            binder = _create_tpu_pod(client, "binder")
+            t0 = time.monotonic()
+            code, res = post(base, "/scheduler/bind", {
+                "PodName": "binder", "PodNamespace": "default",
+                "PodUID": binder.uid, "Node": "v5p-host-0",
+            })
+            elapsed = time.monotonic() - t0
+            assert code == 200 and res["Error"] == ""
+            assert elapsed < api.overload.budget_for("bind")
+            bound = client.get_pod("default", "binder")
+            assert podutil.is_assumed(bound)
+
+            # every shed attributed: 4 via post() + 1 raw request
+            assert api.resilience.get("shed", "filter") == 5
+            assert api.resilience.get("shed", "priorities") == 0
+        finally:
+            release.set()
+            for t in occupying:
+                t.join(timeout=5)
+            server.shutdown()
+        # the parked Filters completed normally once released
+        assert [code for code, _ in results] == [200, 200]
+
+    def test_gate_admits_below_threshold(self):
+        client, dealer, api = _api(max_inflight=2)
+        pod = _create_tpu_pod(client, "p")
+        body = json.dumps(
+            {"Pod": pod.raw, "NodeNames": ["v5p-host-0"]}
+        ).encode()
+        code, _, payload = api.dispatch("POST", "/scheduler/filter", body)
+        assert code == 200
+        assert api.resilience.get("shed", "filter") == 0
+
+
+class TestDeadlines:
+    def test_filter_past_budget_answers_structured_503(self):
+        client, dealer, api = _api(n_hosts=1, read_budget_s=0.05)
+        api.predicate.fast = None
+        orig_handle = api.predicate.handle
+
+        def slow_handle(args, deadline=None):
+            time.sleep(0.1)  # burn the 50ms budget before the dealer runs
+            return orig_handle(args, deadline=deadline)
+
+        api.predicate.handle = slow_handle
+        pod = _create_tpu_pod(client, "p")
+        body = json.dumps(
+            {"Pod": pod.raw, "NodeNames": ["v5p-host-0"]}
+        ).encode()
+        code, _, payload = api.dispatch("POST", "/scheduler/filter", body)
+        assert code == 503
+        out = json.loads(payload)
+        assert out["Reason"] == "DeadlineExceeded"
+        assert "filter" in out["Error"]
+        assert api.resilience.get("deadline_expired", "filter") == 1
+
+    def test_dealer_aborts_before_locks(self):
+        """The deadline token reaches the dealer and aborts at entry —
+        no partial state, no chip movement."""
+        client, dealer, _ = _api(n_hosts=1)
+        pod = _create_tpu_pod(client, "p")
+        expired = Deadline(-1.0)  # already past budget
+        with pytest.raises(DeadlineExceeded):
+            dealer.assume(["v5p-host-0"], pod, deadline=expired)
+        with pytest.raises(DeadlineExceeded):
+            dealer.score(["v5p-host-0"], pod, deadline=expired)
+        with pytest.raises(DeadlineExceeded):
+            dealer.bind("v5p-host-0", pod, deadline=expired)
+        assert dealer.occupancy() == 0.0  # nothing reserved or committed
+
+    def test_budget_derivation_from_http_timeout(self):
+        cfg = OverloadConfig(http_timeout_s=90.0, read_budget_s=2.0)
+        assert cfg.budget_for("bind") == pytest.approx(81.0)
+        assert cfg.budget_for("filter") == 2.0
+        assert cfg.budget_for("priorities") == 2.0
+        tight = OverloadConfig(http_timeout_s=1.0, read_budget_s=2.0)
+        # read budgets never exceed the httpTimeout-derived bound
+        assert tight.budget_for("filter") == pytest.approx(0.9)
+
+
+class TestReadyz:
+    def test_ready_gates(self):
+        client, dealer, api = _api(n_hosts=1)
+        code, _, _ = api.dispatch("GET", "/readyz", b"")
+        assert code == 200  # no gates registered
+        synced = {"ok": False}
+        api.add_ready_check("informer-sync", lambda: synced["ok"])
+        api.add_ready_check("dealer-warm", lambda: dealer.warmed)
+        code, _, payload = api.dispatch("GET", "/readyz", b"")
+        assert code == 503
+        assert json.loads(payload)["waiting"] == ["informer-sync"]
+        synced["ok"] = True
+        code, _, payload = api.dispatch("GET", "/readyz", b"")
+        assert code == 200 and json.loads(payload) == {"ready": True}
+        # liveness stays 200 throughout: the two probes are distinct
+        code, _, _ = api.dispatch("GET", "/healthz", b"")
+        assert code == 200
+
+    def test_raising_check_reads_as_not_ready(self):
+        _, _, api = _api(n_hosts=1)
+
+        def broken():
+            raise RuntimeError("probe dependency down")
+
+        api.add_ready_check("broken", broken)
+        code, _, payload = api.dispatch("GET", "/readyz", b"")
+        assert code == 503 and json.loads(payload)["waiting"] == ["broken"]
+
+    def test_controller_sync_flips_readiness(self):
+        client = make_mock_cluster(1)
+        dealer = Dealer(client, make_rater(types.POLICY_BINPACK))
+        ctrl = Controller(client, dealer, resync_period_s=0, assume_ttl_s=0)
+        assert not ctrl.synced()
+        ctrl.start()
+        try:
+            deadline = time.time() + 5
+            while not ctrl.synced() and time.time() < deadline:
+                time.sleep(0.01)
+            assert ctrl.synced()
+        finally:
+            ctrl.stop()
+
+
+class TestCoalescingQueue:
+    def test_latest_event_wins_keeps_retry_cap(self):
+        counters = ResilienceCounters()
+        q = CoalescingQueue(maxsize=8, resilience=counters)
+        q.put(("ns", "a", 0))
+        q.put(("ns", "a", 3))  # retry re-put coalesces, attempt kept
+        q.put(("ns", "a", 1))
+        assert q.unfinished_tasks == 1
+        assert counters.get("queue_coalesced") == 2
+        assert q.get_nowait() == ("ns", "a", 3)
+        q.task_done()
+        assert q.unfinished_tasks == 0
+
+    def test_bound_sheds_watch_puts_not_forced_or_coalesced(self):
+        counters = ResilienceCounters()
+        q = CoalescingQueue(maxsize=2, resilience=counters)
+        assert q.put(("ns", "a", 0))
+        assert q.put(("ns", "b", 0))
+        assert not q.put(("ns", "c", 0))  # full: watch-driven put sheds
+        assert counters.get("queue_dropped") == 1
+        assert q.put(("ns", "c", 0), force=True)  # repair path never sheds
+        assert q.put(("ns", "a", 2))  # coalescing needs no free slot
+        assert counters.get("queue_coalesced") == 1
+        got = {q.get_nowait()[:2] for _ in range(3)}
+        assert got == {("ns", "a"), ("ns", "b"), ("ns", "c")}
+
+    def test_sentinels_deliver_after_items(self):
+        q = CoalescingQueue()
+        q.put(None)
+        q.put(("ns", "a", 0))
+        assert q.get() == ("ns", "a", 0)  # backlog drains before shutdown
+        assert q.get() is None
+
+    def test_get_nowait_empty_raises_queue_empty(self):
+        with pytest.raises(queue_mod.Empty):
+            CoalescingQueue().get_nowait()
+
+    def test_fifo_across_distinct_keys(self):
+        q = CoalescingQueue()
+        q.put(("ns", "a", 0))
+        q.put(("ns", "b", 0))
+        q.put(("ns", "a", 1))  # coalesces into the existing FRONT entry
+        assert q.get_nowait() == ("ns", "a", 1)
+        assert q.get_nowait() == ("ns", "b", 0)
+
+
+class TestAssumeSweeper:
+    def _annotated_unbound(self, client, name="stale"):
+        """A pod stamped with placement annotations but never bound — the
+        exact leftovers of a scheduler that died between its two writes."""
+        pod = make_pod(
+            name,
+            containers=[
+                make_container("main", {types.RESOURCE_TPU_PERCENT: 100})
+            ],
+        )
+        stamped = podutil.annotated_pod(pod, {"main": [0]}, policy="binpack")
+        return client.create_pod(stamped)
+
+    def _controller(self, client):
+        dealer = Dealer(client, make_rater(types.POLICY_BINPACK))
+        counters = ResilienceCounters()
+        ctrl = Controller(
+            client, dealer, resync_period_s=0, assume_ttl_s=0,
+            resilience=counters,
+        )
+        return dealer, counters, ctrl
+
+    def test_expires_after_ttl_at_same_resource_version(self):
+        client = make_mock_cluster(1)
+        dealer, counters, ctrl = self._controller(client)
+        self._annotated_unbound(client)
+        assert ctrl.sweep_assumed_once(ttl_s=5.0, now=100.0) == 0  # first seen
+        assert ctrl.sweep_assumed_once(ttl_s=5.0, now=103.0) == 0  # young
+        assert ctrl.sweep_assumed_once(ttl_s=5.0, now=106.0) == 1
+        fresh = client.get_pod("default", "stale")
+        assert not podutil.is_assumed(fresh)
+        assert podutil.get_assigned_chips(fresh) is None
+        labels = (fresh.raw.get("metadata") or {}).get("labels") or {}
+        assert types.ANNOTATION_ASSUME not in labels
+        assert counters.get("assume_expired") == 1
+        # idempotent: the stripped pod no longer matches the sweep
+        assert ctrl.sweep_assumed_once(ttl_s=5.0, now=120.0) == 0
+
+    def test_rewrite_restarts_the_ttl_clock(self):
+        """A live retry rewrites the annotations (new resourceVersion);
+        the sweeper must treat that as a fresh bind attempt, not age."""
+        client = make_mock_cluster(1)
+        dealer, counters, ctrl = self._controller(client)
+        self._annotated_unbound(client)
+        assert ctrl.sweep_assumed_once(ttl_s=5.0, now=100.0) == 0
+        pod = client.get_pod("default", "stale")
+        client.update_pod(
+            podutil.annotated_pod(pod, {"main": [1]}, policy="binpack")
+        )
+        assert ctrl.sweep_assumed_once(ttl_s=5.0, now=106.0) == 0  # new rv
+        assert ctrl.sweep_assumed_once(ttl_s=5.0, now=112.0) == 1
+
+    def test_bound_pods_never_expire(self):
+        client = make_mock_cluster(1)
+        dealer, counters, ctrl = self._controller(client)
+        created = _create_tpu_pod(client, "bound")
+        dealer.bind("v5p-host-0", created)
+        assert ctrl.sweep_assumed_once(ttl_s=1.0, now=100.0) == 0
+        assert ctrl.sweep_assumed_once(ttl_s=1.0, now=1000.0) == 0
+        assert podutil.is_assumed(client.get_pod("default", "bound"))
+        assert counters.get("assume_expired") == 0
+
+    def test_expiry_rolls_back_tracked_accounting(self):
+        """If the dealer still accounts an expired pod (the leak the
+        sweeper exists to stop), the chips come back."""
+        client = make_mock_cluster(1)
+        dealer, counters, ctrl = self._controller(client)
+        created = _create_tpu_pod(client, "leak")
+        bound = dealer.bind("v5p-host-0", created)
+        assert dealer.occupancy() > 0
+        # simulate the binding never landing: clear nodeName server-side
+        # while the dealer keeps its accounting
+        raw = client._pods["default/leak"]
+        raw.get("spec", {}).pop("nodeName", None)
+        assert ctrl.sweep_assumed_once(ttl_s=5.0, now=100.0) == 0
+        assert ctrl.sweep_assumed_once(ttl_s=5.0, now=106.0) == 1
+        assert dealer.occupancy() == 0.0
+        assert not dealer.tracks(bound.uid)

@@ -1,0 +1,264 @@
+"""ResilientClientset: retry budget, jittered backoff, per-target circuit
+breakers with half-open probes, and the fail-open (Events) vs fail-closed
+(Bind / annotation writes) policy split (docs/robustness.md). Driven on a
+fake clock with no-op sleeps — the same injection surface the
+deterministic sim uses."""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+
+import pytest
+
+from nanotpu.k8s.client import ApiError, ConflictError, FakeClientset
+from nanotpu.k8s.events import EventRecorder
+from nanotpu.k8s.objects import Pod, make_container, make_pod
+from nanotpu.k8s.resilience import (
+    TARGET_BIND,
+    TARGET_EVENTS,
+    TARGET_POD_WRITE,
+    ResilientClientset,
+)
+from nanotpu.metrics.resilience import ResilienceCounters, ResilienceExporter
+from nanotpu.metrics.registry import Registry
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _wrap(client, **kw):
+    clock = Clock()
+    counters = ResilienceCounters()
+    wrapper = ResilientClientset(
+        client, counters=counters, clock=clock, sleep=lambda s: None,
+        rng=random.Random(0), **kw,
+    )
+    return wrapper, counters, clock
+
+
+def _with_pod(name="p"):
+    client = FakeClientset()
+    client.create_pod(make_pod(name, containers=[make_container("c", {})]))
+    return client
+
+
+def _failer(n=None, code=503):
+    """Hook raising ApiError for the first ``n`` calls (forever if None)."""
+    calls = {"n": 0}
+
+    def hook(*a, **kw):
+        calls["n"] += 1
+        if n is None or calls["n"] <= n:
+            raise ApiError("injected", code=code)
+
+    return hook, calls
+
+
+class TestRetries:
+    def test_transient_bind_failures_heal_within_attempts(self):
+        client = _with_pod()
+        hook, calls = _failer(2)
+        client.before_bind = hook
+        wrapper, counters, _ = _wrap(client)
+        wrapper.bind_pod("default", "p", "n1")
+        assert client.bindings == [("default", "p", "n1")]
+        assert counters.get("api_retries", TARGET_BIND) == 2
+        assert counters.get("breaker_opens", TARGET_BIND) == 0
+
+    def test_semantic_errors_never_retry(self):
+        client = _with_pod()
+        wrapper, counters, _ = _wrap(client)
+        pod = client.get_pod("default", "p")
+        stale = Pod(pod.raw)
+        stale.raw["metadata"]["resourceVersion"] = "999"
+        with pytest.raises(ConflictError):
+            wrapper.update_pod(stale)
+        assert counters.get("api_retries", TARGET_POD_WRITE) == 0
+        # and a 409 proves the server healthy: breaker failure streak resets
+        assert not wrapper.breakers[TARGET_POD_WRITE].open
+
+    def test_retry_budget_exhaustion_stops_retrying(self):
+        client = _with_pod()
+        hook, calls = _failer(None)
+        client.before_bind = hook
+        wrapper, counters, _ = _wrap(
+            client, max_attempts=3, retry_budget=1.0, retry_refill_per_s=0.0,
+        )
+        with pytest.raises(ApiError):
+            wrapper.bind_pod("default", "p", "n1")
+        # 3 attempts allowed but only 1 token: exactly one retry spent
+        assert counters.get("api_retries", TARGET_BIND) == 1
+        assert calls["n"] == 2
+
+
+class TestCircuitBreaker:
+    def _tripped(self, **kw):
+        client = _with_pod()
+        hook, calls = _failer(None)
+        client.before_bind = hook
+        wrapper, counters, clock = _wrap(client, max_attempts=1, **kw)
+        for _ in range(5):
+            with pytest.raises(ApiError):
+                wrapper.bind_pod("default", "p", "n1")
+        assert counters.get("breaker_opens", TARGET_BIND) == 1
+        return client, wrapper, counters, clock, calls
+
+    def test_open_breaker_fast_fails_without_touching_api(self):
+        client, wrapper, counters, clock, calls = self._tripped()
+        before = calls["n"]
+        with pytest.raises(ApiError) as e:
+            wrapper.bind_pod("default", "p", "n1")
+        assert "breaker open" in str(e.value)
+        assert calls["n"] == before  # no API call happened
+        assert counters.get("breaker_fastfails", TARGET_BIND) == 1
+
+    def test_half_open_probe_recovers_after_cooldown(self):
+        client, wrapper, counters, clock, calls = self._tripped()
+        client.before_bind = None  # the API healed
+        clock.t += 10.0  # past the 5s cooldown
+        wrapper.bind_pod("default", "p", "n1")  # the probe, and it passes
+        assert not wrapper.breakers[TARGET_BIND].open
+        wrapper.bind_pod("default", "p", "n1")  # closed for real
+        assert counters.get("breaker_opens", TARGET_BIND) == 1
+
+    def test_failed_probe_reopens_with_escalated_cooldown(self):
+        client, wrapper, counters, clock, calls = self._tripped()
+        clock.t += 10.0  # cooldown over, API still down
+        with pytest.raises(ApiError):
+            wrapper.bind_pod("default", "p", "n1")  # the probe fails
+        assert counters.get("breaker_opens", TARGET_BIND) == 2
+        # escalated cooldown: 5s is no longer enough to earn a probe
+        clock.t += 6.0
+        before = calls["n"]
+        with pytest.raises(ApiError):
+            wrapper.bind_pod("default", "p", "n1")
+        assert calls["n"] == before  # still fast-failing
+        clock.t += 10.0  # 10s (doubled) elapsed: probe allowed again
+        client.before_bind = None
+        wrapper.bind_pod("default", "p", "n1")
+        assert not wrapper.breakers[TARGET_BIND].open
+
+    def test_raw_transport_error_cannot_wedge_half_open_probe(self):
+        """A read-phase TimeoutError (which the REST client does NOT map
+        to ApiError) must still hit the breaker bookkeeping: a claimed
+        half-open probe slot is released either way, and the error counts
+        as a (retryable) failure rather than leaking uncounted."""
+        client = _with_pod()
+
+        def raw_timeout(*a, **kw):
+            raise TimeoutError("read timed out")
+
+        client.before_bind = raw_timeout
+        wrapper, counters, clock = _wrap(client, max_attempts=1)
+        for _ in range(5):
+            with pytest.raises(TimeoutError):
+                wrapper.bind_pod("default", "p", "n1")
+        assert counters.get("breaker_opens", TARGET_BIND) == 1
+        clock.t += 10.0  # earn the half-open probe — and fail it raw
+        with pytest.raises(TimeoutError):
+            wrapper.bind_pod("default", "p", "n1")
+        # the probe slot was released and re-opened with escalated cooldown
+        assert counters.get("breaker_opens", TARGET_BIND) == 2
+        clock.t += 20.0
+        client.before_bind = None
+        wrapper.bind_pod("default", "p", "n1")  # next probe recovers
+        assert not wrapper.breakers[TARGET_BIND].open
+
+    def test_targets_are_isolated(self):
+        """An Events outage must never trip the Bind path."""
+        client = _with_pod()
+        hook, _ = _failer(None)
+        client.before_create_event = hook
+        wrapper, counters, _ = _wrap(client, max_attempts=1)
+        for _ in range(8):
+            wrapper.create_event("default", {"reason": "X"})
+        assert counters.get("breaker_opens", TARGET_EVENTS) == 1
+        assert wrapper.breakers[TARGET_BIND].allow()
+        wrapper.bind_pod("default", "p", "n1")  # unaffected
+        assert client.bindings
+
+
+class TestFailurePolicy:
+    def test_events_fail_open_and_count(self):
+        client = _with_pod()
+        hook, _ = _failer(None)
+        client.before_create_event = hook
+        wrapper, counters, _ = _wrap(client, max_attempts=1)
+        # no exception out of a dead Events path, ever
+        assert wrapper.create_event("default", {"reason": "X"}) is None
+        assert counters.get("events_failopen") == 1
+        for _ in range(6):
+            wrapper.create_event("default", {"reason": "X"})
+        # breaker open now: still silent, still counted
+        assert counters.get("breaker_fastfails", TARGET_EVENTS) > 0
+        assert counters.get("events_failopen") == 7
+
+    def test_bind_fails_closed(self):
+        client = _with_pod()
+        hook, _ = _failer(None)
+        client.before_bind = hook
+        wrapper, _, _ = _wrap(client, max_attempts=2)
+        with pytest.raises(ApiError):
+            wrapper.bind_pod("default", "p", "n1")
+
+    def test_reads_delegate_untouched(self):
+        client = _with_pod()
+        wrapper, _, _ = _wrap(client)
+        assert wrapper.get_pod("default", "p").name == "p"
+        assert [p.name for p in wrapper.list_pods()] == ["p"]
+        # FakeClientset extras pass through too (the sim relies on this)
+        assert wrapper.events == []
+
+
+class TestRecorderIntegration:
+    def test_flush_timeout_warns_and_counts_unflushed(self, caplog):
+        """The satellite fix: a timed-out shutdown flush names its backlog
+        instead of silently dropping the False return."""
+        client = FakeClientset()
+        release = threading.Event()
+        client.before_create_event = lambda e: release.wait(5)
+        counters = ResilienceCounters()
+        recorder = EventRecorder(client, resilience=counters)
+        pod = make_pod("p", containers=[make_container("c", {})])
+        recorder.event(pod, "Normal", "TPUAssigned", "m")
+        with caplog.at_level(logging.WARNING, logger="nanotpu.k8s.events"):
+            assert recorder.flush(timeout=0.2) is False
+        release.set()
+        assert counters.get("events_unflushed") >= 1
+        assert any("unposted" in r.getMessage() for r in caplog.records)
+
+    def test_queue_full_drop_counts_failopen(self):
+        client = FakeClientset()
+        release = threading.Event()
+        client.before_create_event = lambda e: release.wait(5)
+        counters = ResilienceCounters()
+        recorder = EventRecorder(client, resilience=counters)
+        pod = make_pod("p", containers=[make_container("c", {})])
+        recorder._q.maxsize = 2
+        for _ in range(6):
+            recorder.event(pod, "Normal", "TPUAssigned", "m")
+        release.set()
+        assert counters.get("events_failopen") >= 1
+
+
+class TestExporter:
+    def test_metrics_render_through_registry(self):
+        counters = ResilienceCounters()
+        counters.inc("shed", "filter", 3)
+        counters.inc("queue_dropped")
+        counters.inc("breaker_opens", "bind")
+        registry = Registry()
+        registry.register(ResilienceExporter(counters))
+        text = registry.render()
+        assert 'nanotpu_resilience_shed_total{verb="filter"} 3' in text
+        assert "nanotpu_resilience_queue_dropped_total 1" in text
+        assert 'nanotpu_resilience_breaker_open_total{target="bind"} 1' in text
+        # every family renders a TYPE line even with no samples yet
+        assert "# TYPE nanotpu_resilience_assume_expired_total counter" in text

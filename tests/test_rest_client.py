@@ -274,6 +274,121 @@ def test_watch_reconnects_after_stream_end(stub):
     watch.stop()
 
 
+def _capture_delays(watch, want, timeout=10.0):
+    """Patch the watch's stop-event wait to record requested backoff
+    delays (sleeping 20ms instead); returns once ``want`` are captured."""
+    delays = []
+    real_wait = watch._stopped.wait
+    watch._stopped.wait = lambda timeout=None: (
+        delays.append(timeout), real_wait(0.02)
+    )[1]
+    deadline = time.time() + timeout
+    while len(delays) < want and time.time() < deadline:
+        time.sleep(0.02)
+    return delays
+
+
+class _FakeStream:
+    """Stands in for urlopen's response in the watch loop (context manager
+    + line iterator)."""
+
+    def __init__(self, lines):
+        self._lines = lines
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def __iter__(self):
+        return iter(self._lines)
+
+
+def test_read_timeout_backoff_escalates_to_cap(monkeypatch):
+    """The read-timeout path (generic-Exception branch): consecutive
+    failures must escalate 1 -> 2 -> 4 ... up to the 30s cap, never
+    reset by the mere act of reconnecting."""
+    from nanotpu.k8s import rest as rest_mod
+
+    monkeypatch.setattr(
+        rest_mod.urllib.request, "urlopen",
+        lambda *a, **kw: (_ for _ in ()).throw(TimeoutError("read timeout")),
+    )
+    client = RestClientset("http://stub.invalid")
+    watch = client.watch_pods()
+    delays = _capture_delays(watch, want=8)
+    watch.stop()
+    assert len(delays) >= 8
+    window = delays[1:8]  # the patch may miss the very first wait
+    assert window == sorted(window), delays  # monotone escalation
+    assert 30.0 in window, delays  # reaches the cap
+    assert all(d <= 30.0 for d in window), delays  # and stays there
+
+
+def test_event_delivery_resets_read_timeout_backoff(monkeypatch):
+    """Reset-vs-escalate on the read-timeout path: backoff resets to 1.0
+    only once a stream DELIVERS an event — then failures escalate again
+    from scratch."""
+    from nanotpu.k8s import rest as rest_mod
+
+    raw = _pod_raw("a")
+    raw["metadata"]["resourceVersion"] = "7"
+    line = (json.dumps({"type": "ADDED", "object": raw}) + "\n").encode()
+    script = ["raise", "raise", "raise", _FakeStream([line])]
+
+    def fake_urlopen(*a, **kw):
+        action = script.pop(0) if script else "raise"
+        if action == "raise":
+            raise TimeoutError("read timeout")
+        return action
+
+    monkeypatch.setattr(rest_mod.urllib.request, "urlopen", fake_urlopen)
+    client = RestClientset("http://stub.invalid")
+    watch = client.watch_pods()
+    delays = _capture_delays(watch, want=7)
+    evt = watch.poll(timeout=1)
+    watch.stop()
+    assert evt and evt.type == "ADDED"
+    assert len(delays) >= 7
+    # escalation ran before the healthy stream (a 2.0+ wait happened) ...
+    first_tail = next(i for i, d in enumerate(delays) if d >= 2.0)
+    # ... and a LATER wait dropped back to exactly 1.0 (the reset), after
+    # which escalation starts over
+    later = delays[first_tail + 1:]
+    assert 1.0 in later, delays
+    reset_at = first_tail + 1 + later.index(1.0)
+    assert delays[reset_at:reset_at + 3] == sorted(
+        delays[reset_at:reset_at + 3]
+    ), delays
+
+
+def test_event_delivery_resets_410_relist_backoff(stub):
+    """Reset-vs-escalate on the 410-relist path: repeated 410 cycles
+    escalate the full-LIST throttle; a stream that then delivers a real
+    event resets it, and the NEXT 410 waits 1.0 again."""
+    stub.pods["default/p1"] = _pod_raw("p1")
+    stub.list_rv = "5000"
+    err = {"type": "ERROR",
+           "object": {"kind": "Status", "code": 410, "message": "too old"}}
+    raw = _pod_raw("live")
+    raw["metadata"]["resourceVersion"] = "5001"
+    ok = {"type": "ADDED", "object": raw}
+    stub.watch_batches = (
+        [[dict(err)] for _ in range(3)]
+        + [[ok]]
+        + [[dict(err)] for _ in range(30)]
+    )
+    client = RestClientset(stub.url)
+    watch = client.watch_pods()
+    delays = _capture_delays(watch, want=7)
+    watch.stop()
+    assert len(delays) >= 7
+    first_tail = next(i for i, d in enumerate(delays) if d >= 2.0)
+    later = delays[first_tail + 1:]
+    assert 1.0 in later, delays
+
+
 def test_persistent_410_backoff_escalates(stub):
     """A watch cache permanently lagging the list rv (connect ok -> instant
     ERROR 410, no events) must back the full-LIST-and-replay loop off

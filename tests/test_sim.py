@@ -462,6 +462,87 @@ class TestExampleScenarios:
         assert set(scenario["workload"]["mix"]) == set(CONFIG_KINDS)
 
 
+class TestChaosScenario:
+    """The overload-resilience gate's scenario (chaos.json, `make
+    chaos-soak`) at a shortened horizon that still covers one overload
+    burst and the first API brownout: the resilient write path, bounded
+    queue, and sweeper must all engage, converge clean, and reproduce."""
+
+    def _scenario(self, horizon=18.0):
+        scenario = load_scenario(EXAMPLES / "chaos.json")
+        scenario["horizon_s"] = horizon
+        return scenario
+
+    def test_chaos_short_converges_and_attributes(self):
+        scenario = self._scenario()
+        a = run_scenario(scenario, seed=0)
+        assert a["invariants"]["violations"] == 0, a["invariants"]["first"]
+        # the brownout engaged and the resilient client classified it:
+        # injected write rejections show up as retries and/or breaker
+        # activity, never as invariant violations
+        assert a["faults"]["brownouts"] >= 1
+        assert a["faults"]["brownout_rejections"] > 0
+        res = a["resilience"]
+        breaker_events = (
+            sum(res["breaker_opens"].values())
+            + sum(res["api_retries"].values())
+        )
+        assert breaker_events > 0
+        # overload burst arrivals landed on their own rng stream
+        assert a["faults"]["overload_arrivals"] > 0
+        # the bounded queue coalesced under the burst
+        assert res["queue_coalesced"] > 0
+        # background-thread Event counters stay OFF the deterministic
+        # report (they are wall-clock-ordered)
+        assert "events_failopen" not in res
+        assert "events" not in res["breaker_fastfails"]
+        b = run_scenario(scenario, seed=0)
+        assert render(strip_timing(a)) == render(strip_timing(b))
+
+    def test_overload_toggle_does_not_shift_base_arrivals(self):
+        """The isolation rule that makes fault bisection possible: turning
+        the overload fault off must remove ONLY the burst arrivals (their
+        draws live on rng_overload), never reshape the base Poisson
+        stream."""
+        def scheduled_arrivals(scenario):
+            sim = Simulator(scenario, seed=3)
+            sim._schedule_static_events(scenario["horizon_s"])
+            base, burst = [], []
+            for t, _, kind, payload in sim._heap:
+                if kind != "arrival":
+                    continue
+                entry = (round(t, 9), payload["config"])
+                (burst if payload.get("burst") else base).append(entry)
+            return sorted(base), sorted(burst)
+
+        scenario_off = self._scenario(horizon=10.0)
+        scenario_off["faults"]["overload"] = {}
+        base_on, burst_on = scheduled_arrivals(self._scenario(horizon=10.0))
+        base_off, burst_off = scheduled_arrivals(scenario_off)
+        assert burst_on and not burst_off  # the fault adds bursts...
+        assert base_on == base_off  # ...and touches nothing else
+
+    def test_overload_toggle_does_not_reshape_base_jobs(self):
+        """Deeper than arrival times: burst jobs draw lifetime/shape from
+        rng_overload END TO END, so the i-th base job's (config, lifetime,
+        size) is identical with the fault on or off — the property fault
+        bisection actually leans on."""
+        def base_job_shapes(scenario):
+            sim = Simulator(scenario, seed=3)
+            sim.run()
+            return [
+                (j.config, round(j.lifetime_s, 9), j.size)
+                for j in sim.jobs
+                if not j.burst and j.incarnation == 0
+            ]
+
+        scenario_off = self._scenario(horizon=10.0)
+        scenario_off["faults"]["overload"] = {}
+        on = base_job_shapes(self._scenario(horizon=10.0))
+        off = base_job_shapes(scenario_off)
+        assert on and on == off
+
+
 @pytest.mark.slow
 class TestChurnSweep:
     """The acceptance-gate scenario at full length: a v5p-512 pool under
