@@ -3,13 +3,20 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native test test-fast bench sim-smoke chaos-soak image clean
+.PHONY: all native lint test test-fast bench sim-smoke chaos-soak image clean
 
-# Default verification tier: the fast inner loop (test-fast includes
-# sim-smoke) plus the overload-resilience soak. The tier-1 gate
-# (`pytest tests/ -m 'not slow'` over everything) is unchanged — run it
-# via `make test` / CI.
-all: native test-fast chaos-soak
+# Default verification tier: static analysis, then the fast inner loop
+# (test-fast includes sim-smoke), then the overload-resilience soak. The
+# tier-1 gate (`pytest tests/ -m 'not slow'` over everything) is
+# unchanged — run it via `make test` / CI.
+all: native lint test-fast chaos-soak
+
+# nanolint (docs/static-analysis.md): AST invariant passes over the
+# scheduler's concurrency & determinism contracts — lock discipline,
+# snapshot immutability, deadline threading, sim determinism, metrics
+# completeness. Exit 0 == clean tree + every ignore justified.
+lint:
+	python -m nanotpu.analysis
 
 native:
 	$(MAKE) -C native
@@ -38,10 +45,14 @@ sim-smoke:
 # Overload-resilience gate (docs/robustness.md): smoke's faults + arrival
 # bursts + API brownouts through the resilient write path, bounded sync
 # queue, and assume-TTL sweeper. Run TWICE (--check-determinism): exits
-# nonzero on any invariant violation or digest divergence.
+# nonzero on any invariant violation or digest divergence. The env var
+# arms the lock-order witness BEFORE interpreter imports construct the
+# module-level locks (nodeinfo._state_gen_lock, native._lock) — the
+# scenario's `lock_witness: true` then asserts acyclicity at teardown
+# (docs/static-analysis.md).
 chaos-soak:
-	python -m nanotpu.sim --scenario examples/sim/chaos.json --seed 0 \
-		--check-determinism
+	NANOTPU_LOCK_WITNESS=1 python -m nanotpu.sim \
+		--scenario examples/sim/chaos.json --seed 0 --check-determinism
 
 image:
 	docker build -t $(IMAGE):$(TAG) .

@@ -1,0 +1,38 @@
+"""nanolint: project-specific static analysis + runtime concurrency witness.
+
+PRs 2-3 grew a real concurrent control plane — RCU snapshot publishing in
+the dealer, a coalescing workqueue, per-target circuit breakers, deadline
+tokens threaded server -> dealer — whose correctness rests on conventions
+(lock order, snapshot immutability, injected clock/rng in sim-driven
+code, attributable degradation counters) that code review alone cannot
+hold. This package is the machine check for those conventions:
+
+* **Static passes** (stdlib ``ast``, no new deps) run via
+  ``python -m nanotpu.analysis`` (``make lint``, part of ``make all``):
+
+  - ``lock-discipline``       lock-order cycles + blocking calls under
+                              the dealer's hot locks
+  - ``snapshot-immutability`` attribute stores on published ``_Snapshot``
+                              / frozen ``BatchScorer`` state outside the
+                              publisher path
+  - ``deadline-threading``    verb-path functions that drop the
+                              ``Deadline`` token instead of forwarding it
+  - ``sim-determinism``       wall clock, ambient randomness, and
+                              unordered-set iteration in sim-driven code
+  - ``metrics-completeness``  counters incremented but not exported (and
+                              exported but never incremented)
+
+  See docs/static-analysis.md for the pass catalogue and the
+  ``# nanolint: ignore[<pass>]: <justification>`` escape hatch.
+
+* **Runtime witness** (:mod:`nanotpu.analysis.witness`): an opt-in
+  instrumented lock wrapper (``NANOTPU_LOCK_WITNESS=1`` — tests and the
+  chaos soak turn it on) that records the global lock-acquisition-order
+  graph across threads and asserts acyclicity at teardown, turning a
+  latent lock inversion into a deterministic failure with a witness
+  stack for each edge of the cycle.
+
+This ``__init__`` stays import-light on purpose: production modules
+import :mod:`nanotpu.analysis.witness` for their lock factories, and that
+must not drag the analysis framework (or anything heavier) along.
+"""

@@ -1,0 +1,94 @@
+"""CLI: ``python -m nanotpu.analysis`` — the ``make lint`` gate.
+
+Exit-code contract (CI leans on it):
+
+* ``0`` — every enabled pass is clean AND every ignore directive carries
+  a justification (justified ignores are fine; they are listed).
+* ``1`` — findings (including unjustified or stale ignores).
+* ``2`` — bad usage (unknown pass, unreadable root).
+
+Human-readable report on stderr; ``--json`` writes the machine-readable
+report to stdout (findings, ignores, pass list — stable key order).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from nanotpu.analysis.core import run_analysis
+from nanotpu.analysis.passes import ALL_PASSES, BY_NAME
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m nanotpu.analysis",
+        description="nanolint: scheduler concurrency/determinism "
+        "invariant checks (docs/static-analysis.md)",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="package root to analyze (default: the installed nanotpu/)",
+    )
+    parser.add_argument(
+        "--pass", dest="passes", action="append", default=None,
+        metavar="NAME", help="run only this pass (repeatable)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable report on stdout",
+    )
+    parser.add_argument(
+        "--list-passes", action="store_true", help="list passes and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        for p in ALL_PASSES:
+            print(f"{p.name:24s} {p.doc}")
+        return 0
+
+    if args.passes:
+        unknown = [n for n in args.passes if n not in BY_NAME]
+        if unknown:
+            print(f"error: unknown pass(es): {', '.join(unknown)}; "
+                  f"known: {', '.join(sorted(BY_NAME))}", file=sys.stderr)
+            return 2
+        passes = [BY_NAME[n] for n in args.passes]
+    else:
+        passes = list(ALL_PASSES)
+
+    root = Path(args.root) if args.root else Path(__file__).resolve().parents[1]
+    if not root.is_dir():
+        print(f"error: root {root} is not a directory", file=sys.stderr)
+        return 2
+
+    report = run_analysis(root, passes)
+
+    if args.json:
+        print(json.dumps(report.as_dict(), indent=2, sort_keys=True))
+    for f in report.findings:
+        print(f.render(), file=sys.stderr)
+    justified = [i for i in report.ignores if i.justification]
+    if justified:
+        print(f"-- {len(justified)} justified ignore(s):", file=sys.stderr)
+        for ig in justified:
+            print(
+                f"   {ig.path}:{ig.line}: ignore[{','.join(ig.passes)}] "
+                f"— {ig.justification}",
+                file=sys.stderr,
+            )
+    print(
+        f"nanolint: {len(report.passes_run)} passes, "
+        f"{len(report.findings)} finding(s), "
+        f"{report.suppressed} suppressed by "
+        f"{len(justified)} justified ignore(s)",
+        file=sys.stderr,
+    )
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

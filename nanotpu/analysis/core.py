@@ -1,0 +1,262 @@
+"""nanolint framework: module loading, findings, the ignore budget.
+
+A *pass* is an object with ``name``, ``doc``, ``scope`` (dotted module
+prefixes it applies to — fixture modules outside the ``nanotpu`` package
+are always in scope so tests can feed seeded violations), and
+``run(modules) -> list[Finding]``. Passes are pure AST walks: no imports
+of the code under analysis, so a module with a syntax error or an
+unimportable dependency still gets analyzed (or reported as unparsable)
+without executing anything.
+
+The escape hatch::
+
+    risky_call()  # nanolint: ignore[lock-discipline]: probe cannot block
+                  # here - the node is already materialized
+
+suppresses findings of the named pass(es) on that line (a directive on a
+comment-only line covers the next line). Every ignore MUST carry a
+justification after the closing bracket — the report lists all of them,
+and an ignore without one is itself a finding (``ignore-budget``), so
+silencing the linter is always a reviewed, explained act. An ignore that
+suppresses nothing is reported too (stale ignores rot into camouflage).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+#: directive syntax (in a comment): ``nanolint: ignore[pass-a,pass-b]``
+#: followed by ``:`` or ``--`` and the justification text
+_IGNORE_RE = re.compile(
+    r"#\s*nanolint:\s*ignore\[([a-z0-9_,\s-]+)\]\s*(?::|--)?\s*(.*)$"
+)
+
+
+@dataclass
+class Finding:
+    pass_name: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_name}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "pass": self.pass_name,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+@dataclass
+class Ignore:
+    path: str
+    line: int
+    passes: tuple[str, ...]
+    justification: str
+    #: the code line this directive covers: its own line for a trailing
+    #: comment; the next non-comment line for a comment-only directive
+    #: (so a directive atop a multi-line comment block still lands)
+    target_line: int = 0
+    used: bool = False
+
+    def as_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "passes": list(self.passes),
+            "justification": self.justification,
+            "used": self.used,
+        }
+
+
+class Module:
+    """One parsed source file: AST + source lines + ignore directives."""
+
+    def __init__(self, path: Path, name: str, text: str):
+        self.path = path
+        self.name = name  # dotted, e.g. "nanotpu.dealer.dealer"
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=str(path))
+        # directives live in real COMMENT tokens only — a docstring that
+        # *describes* the syntax (like this framework's own) is not one
+        self.ignores: list[Ignore] = []
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+        except (tokenize.TokenError, IndentationError):
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _IGNORE_RE.search(tok.string)
+            if m is None:
+                continue
+            passes = tuple(
+                p.strip() for p in m.group(1).split(",") if p.strip()
+            )
+            line = tok.start[0]
+            target = line
+            if self.lines[line - 1].lstrip().startswith("#"):
+                # comment-only directive: covers the next code line
+                target = line + 1
+                while target <= len(self.lines):
+                    stripped = self.lines[target - 1].strip()
+                    if stripped and not stripped.startswith("#"):
+                        break
+                    target += 1
+            self.ignores.append(
+                Ignore(str(path), line, passes, m.group(2).strip(), target)
+            )
+        self._parents: dict[ast.AST, ast.AST] | None = None
+
+    def parent_of(self, node: ast.AST) -> ast.AST | None:
+        if self._parents is None:
+            self._parents = {}
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+        return self._parents.get(node)
+
+    def in_scope(self, prefixes: tuple[str, ...]) -> bool:
+        """Fixture modules (anything not under ``nanotpu``) are always in
+        scope; real modules must match a pass's prefix list."""
+        if not self.name.startswith("nanotpu"):
+            return True
+        return any(
+            self.name == p or self.name.startswith(p + ".")
+            for p in prefixes
+        )
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain; None for anything else
+    (subscripts, calls in the chain, literals)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def module_name_for(path: Path, root: Path) -> str:
+    """Dotted name of ``path`` rooted at ``root``'s parent, so analyzing
+    ``<repo>/nanotpu`` yields ``nanotpu.dealer.dealer`` names."""
+    rel = path.relative_to(root.parent)
+    parts = list(rel.with_suffix("").parts)
+    if parts[-1] == "__init__":
+        parts.pop()
+    return ".".join(parts)
+
+
+def collect_modules(root: Path) -> tuple[list[Module], list[Finding]]:
+    """Parse every ``*.py`` under ``root``. Unparsable files become
+    findings rather than crashes — a syntax error must fail lint, not
+    hide the rest of the tree from it."""
+    modules: list[Module] = []
+    errors: list[Finding] = []
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        text = path.read_text()
+        try:
+            modules.append(Module(path, module_name_for(path, root), text))
+        except SyntaxError as e:
+            errors.append(
+                Finding("parse", str(path), e.lineno or 0, f"syntax error: {e.msg}")
+            )
+    return modules, errors
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    ignores: list[Ignore] = field(default_factory=list)
+    suppressed: int = 0
+    passes_run: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def as_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "passes": self.passes_run,
+            "findings": [f.as_dict() for f in self.findings],
+            "suppressed": self.suppressed,
+            "ignores": [i.as_dict() for i in self.ignores],
+        }
+
+
+def _apply_ignores(findings: list[Finding], modules: list[Module],
+                   report: Report) -> list[Finding]:
+    """Suppress findings covered by a justified ignore on the same line
+    (or the line below a comment-only directive); convert unjustified or
+    stale ignores into findings of their own."""
+    by_site: dict[tuple[str, int, str], list[Ignore]] = {}
+    for mod in modules:
+        for ig in mod.ignores:
+            report.ignores.append(ig)
+            for p in ig.passes:
+                by_site.setdefault((ig.path, ig.line, p), []).append(ig)
+                by_site.setdefault(
+                    (ig.path, ig.target_line, p), []
+                ).append(ig)
+    kept: list[Finding] = []
+    for f in findings:
+        hits = by_site.get((f.path, f.line, f.pass_name))
+        if hits:
+            for ig in hits:
+                ig.used = True
+            report.suppressed += 1
+        else:
+            kept.append(f)
+    ran = set(report.passes_run)
+    for ig in report.ignores:
+        # budget checks only bind when the directive was in play this
+        # run: a subset run (--pass X) must not call another pass's
+        # justified ignore "stale" (it never had the chance to be used),
+        # and staleness is only provable when EVERY named pass ran
+        if not ran & set(ig.passes):
+            continue
+        if not ig.justification:
+            kept.append(Finding(
+                "ignore-budget", ig.path, ig.line,
+                f"ignore[{','.join(ig.passes)}] has no justification — "
+                "every suppression must say why it is sound",
+            ))
+        elif not ig.used and set(ig.passes) <= ran:
+            kept.append(Finding(
+                "ignore-budget", ig.path, ig.line,
+                f"ignore[{','.join(ig.passes)}] suppresses nothing — "
+                "stale directive, delete it",
+            ))
+    return kept
+
+
+def run_analysis(root: Path, passes) -> Report:
+    """Run ``passes`` over every module under ``root``; apply the ignore
+    budget; return the full report (the CLI renders it)."""
+    modules, parse_errors = collect_modules(Path(root))
+    report = Report()
+    findings = list(parse_errors)
+    for p in passes:
+        report.passes_run.append(p.name)
+        scoped = [m for m in modules if m.in_scope(p.scope)]
+        findings.extend(p.run(scoped))
+    findings = _apply_ignores(findings, modules, report)
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_name))
+    report.findings = findings
+    return report

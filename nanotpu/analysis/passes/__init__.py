@@ -1,0 +1,15 @@
+"""The nanolint pass registry. Each submodule exports one ``PASS``."""
+
+from __future__ import annotations
+
+from nanotpu.analysis.passes.deadlines import PASS as DEADLINES
+from nanotpu.analysis.passes.determinism import PASS as DETERMINISM
+from nanotpu.analysis.passes.locks import PASS as LOCKS
+from nanotpu.analysis.passes.metrics import PASS as METRICS
+from nanotpu.analysis.passes.snapshots import PASS as SNAPSHOTS
+
+#: registry order == report order (lock discipline first: its findings
+#: are the ones that turn into 3am pages)
+ALL_PASSES = (LOCKS, SNAPSHOTS, DEADLINES, DETERMINISM, METRICS)
+
+BY_NAME = {p.name: p for p in ALL_PASSES}

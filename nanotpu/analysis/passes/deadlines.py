@@ -1,0 +1,154 @@
+"""deadline-threading: the response-budget token must reach the dealer.
+
+PR 3 threaded a :class:`~nanotpu.utils.deadline.Deadline` from the route
+layer's per-verb budget through ``verb.handle`` into the dealer so an
+over-budget request aborts at a safe point instead of burning a handler
+thread on an answer kube-scheduler already abandoned. The token only
+works if EVERY hop forwards it: one call site that drops ``deadline=``
+silently reverts that verb path to unbounded work — no test fails,
+latency just quietly regresses under overload.
+
+Two checks:
+
+* **roots accept** — every function in :data:`ROOTS` (the verb-path
+  entry points) must declare a ``deadline`` parameter;
+* **hops forward** — inside any function that declares ``deadline``, a
+  call to a known deadline sink (:data:`SINKS`: ``<...>.dealer.assume/
+  score/bind`` and ``<verb>.handle``) must pass ``deadline=``. Functions
+  WITHOUT the parameter are exempt by design: ``deadline=None`` is the
+  documented "no budget" mode the sim and direct tests use.
+
+A declared-but-unused ``deadline`` parameter is also flagged: a hop that
+accepts the token and neither forwards nor checks it is a drop with
+extra steps.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from nanotpu.analysis.core import Finding, Module, dotted
+
+PASS_NAME = "deadline-threading"
+
+SCOPE = ("nanotpu.dealer", "nanotpu.scheduler", "nanotpu.routes")
+
+#: verb-path entry points that must accept the token (matched by
+#: qualified name; fixture modules outside nanotpu match on name alone)
+ROOT_QUALS = {
+    "Dealer.assume", "Dealer.score", "Dealer.bind",
+    "Predicate.handle", "Prioritize.handle", "Bind.handle",
+}
+ROOT_MODULES = ("nanotpu.dealer.dealer", "nanotpu.scheduler.verbs")
+
+#: (receiver terminal, method) pairs that accept ``deadline=``; the
+#: receiver filter keeps `info.score(...)` (NodeInfo, no deadline) from
+#: false-positive matching on the method name alone
+SINKS = {
+    ("dealer", "assume"), ("dealer", "score"), ("dealer", "bind"),
+    ("verb", "handle"),
+}
+
+
+def _functions(mod: Module):
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield node.name, sub
+
+
+def _has_deadline_param(fn) -> bool:
+    names = [a.arg for a in fn.args.args + fn.args.kwonlyargs]
+    return "deadline" in names
+
+
+def _creates_deadline(fn) -> bool:
+    """A function that builds its own token (``deadline = Deadline(...)``,
+    the route layer) owes downstream sinks the forward just as much as
+    one that received it."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            chain = dotted(node.value.func) or ""
+            if chain.rsplit(".", 1)[-1] == "Deadline" and any(
+                isinstance(t, ast.Name) and t.id == "deadline"
+                for t in node.targets
+            ):
+                return True
+    return False
+
+
+class _DeadlinePass:
+    name = PASS_NAME
+    doc = "verb-path hops that drop the Deadline response-budget token"
+    scope = SCOPE
+
+    def run(self, modules: list[Module]) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in modules:
+            is_root_module = (
+                mod.name in ROOT_MODULES
+                or not mod.name.startswith("nanotpu")
+            )
+            for cls_name, fn in _functions(mod):
+                qual = f"{cls_name}.{fn.name}" if cls_name else fn.name
+                has_param = _has_deadline_param(fn)
+                if is_root_module and qual in ROOT_QUALS and not has_param:
+                    findings.append(Finding(
+                        self.name, str(mod.path), fn.lineno,
+                        f"{qual} is a verb-path entry point but does "
+                        "not accept a `deadline` parameter — the "
+                        "response budget cannot reach it",
+                    ))
+                    continue
+                if not has_param and not _creates_deadline(fn):
+                    continue
+                findings.extend(
+                    self._check_body(mod, qual, fn, has_param)
+                )
+        return findings
+
+    def _check_body(self, mod: Module, qual: str, fn,
+                    has_param: bool) -> list[Finding]:
+        findings: list[Finding] = []
+        used = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id == "deadline" and \
+                    isinstance(node.ctx, ast.Load):
+                used = True
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func)
+            if chain is None or "." not in chain:
+                continue
+            receiver, method = chain.rsplit(".", 1)
+            rterm = receiver.rsplit(".", 1)[-1]
+            if (rterm, method) not in SINKS:
+                continue
+            forwards = any(
+                kw.arg == "deadline" for kw in node.keywords
+            ) or any(
+                isinstance(a, ast.Name) and a.id == "deadline"
+                for a in node.args
+            )
+            if not forwards:
+                findings.append(Finding(
+                    self.name, str(mod.path), node.lineno,
+                    f"{qual} holds a deadline but calls {chain}() without "
+                    "forwarding it — the budget stops here and the "
+                    "downstream work becomes unbounded",
+                ))
+        if has_param and not used:
+            findings.append(Finding(
+                self.name, str(mod.path), fn.lineno,
+                f"{qual} accepts `deadline` but never reads or forwards "
+                "it — an accepted-and-dropped token is still a drop",
+            ))
+        return findings
+
+
+PASS = _DeadlinePass()

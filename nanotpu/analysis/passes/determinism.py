@@ -1,0 +1,243 @@
+"""sim-determinism: sim-driven code must not read ambient entropy.
+
+The simulator's whole value is its contract: two runs of (scenario,
+seed) produce byte-identical reports, so a digest diff IS a behavior
+diff (docs/simulation.md). The sim drives the REAL dealer / controller /
+verbs / resilient client, which means those modules must draw time and
+randomness only from what the sim injects — one ``time.time()`` or
+ambient ``random.random()`` on a sim-reachable path and the digest
+becomes a coin flip that `--check-determinism` may or may not catch.
+
+Banned in scope:
+
+* ``time.time()`` — wall clock. (``time.monotonic`` is tolerated: it
+  never enters reports, only local timeout arithmetic, and the sim
+  passes explicit ``now=`` on every determinism-relevant path.)
+* ambient ``random.*`` module calls — ``random.random()``,
+  ``random.choice``, … and UNSEEDED ``random.Random()``. Seeded
+  ``random.Random(seed)`` streams are the required idiom.
+* ``uuid.uuid4`` / ``os.urandom`` / ``secrets.*`` — entropy by any
+  other name.
+* iteration over locally-built ``set``/``frozenset`` values (for loops,
+  comprehensions, ``list()``/``tuple()``/``enumerate()``/``iter()``/
+  ``min()``/``max()`` wrapping) — string-set order depends on
+  ``PYTHONHASHSEED``, so it reproduces within a process and diverges
+  across processes, the worst kind of flake. ``sorted(...)`` over a set
+  is the sanctioned spelling. Order-INSENSITIVE consumption is allowed:
+  a generator feeding ``sum``/``len``/``any``/``all``, and a set
+  comprehension over a set (set in, set out — no order escapes).
+  ``min``/``max`` stay flagged because a ``key=`` with ties resolves by
+  iteration order; a fully-discriminating key earns a justified ignore.
+
+The **injection idiom is allowed**: a banned call as the fallback arm of
+``x if <param> is None else <param>`` or ``<param> or <call>`` is how
+production code declares an injectable clock/rng with a wall-clock
+default — the sim always supplies the parameter.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from nanotpu.analysis.core import Finding, Module, dotted
+
+PASS_NAME = "sim-determinism"
+
+#: the sim itself plus every module it drives (sim/core.py imports)
+SCOPE = (
+    "nanotpu.sim", "nanotpu.dealer", "nanotpu.controller",
+    "nanotpu.scheduler", "nanotpu.allocator",
+    "nanotpu.k8s.objects", "nanotpu.k8s.client", "nanotpu.k8s.resilience",
+    "nanotpu.k8s.events",
+    "nanotpu.metrics.resilience", "nanotpu.metrics.stats",
+    "nanotpu.utils", "nanotpu.topology", "nanotpu.types",
+    "nanotpu.native",
+)
+
+_BANNED_CALLS = {
+    "time.time": "wall clock",
+    "uuid.uuid4": "random UUID",
+    "os.urandom": "OS entropy",
+    "datetime.now": "wall clock",
+    "datetime.datetime.now": "wall clock",
+}
+
+_SET_WRAPPERS = ("list", "tuple", "enumerate", "iter", "max", "min")
+
+#: calls whose result cannot depend on argument order: a generator over
+#: a set feeding one of these is deterministic
+_ORDER_FREE_SINKS = ("sum", "len", "any", "all", "set", "frozenset",
+                     "sorted")
+
+
+def _is_injection_fallback(mod: Module, node: ast.Call) -> bool:
+    """True when ``node`` is the fallback arm of the injectable-default
+    idiom: ``X() if param is None else param`` or ``param or X()``."""
+    parent = mod.parent_of(node)
+    if isinstance(parent, ast.IfExp) and parent.body is node:
+        test = parent.test
+        if (
+            isinstance(test, ast.Compare)
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.Is)
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None
+        ):
+            return True
+    if isinstance(parent, ast.BoolOp) and isinstance(parent.op, ast.Or) \
+            and parent.values and parent.values[-1] is node:
+        return True
+    return False
+
+
+def _set_producing(node: ast.AST, set_vars: set[str]) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        chain = dotted(node.func)
+        if chain in ("set", "frozenset"):
+            return True
+    if isinstance(node, ast.Name) and node.id in set_vars:
+        return True
+    return False
+
+
+class _FnWalk(ast.NodeVisitor):
+    def __init__(self, mod: Module, findings: list[Finding], fn):
+        self.mod = mod
+        self.findings = findings
+        self.fn = fn
+        self.set_vars: set[str] = set()
+
+    def visit_FunctionDef(self, node):
+        if node is not self.fn:
+            return
+        # pre-scan: locals bound ONLY from set-producing expressions.
+        # Every other binding form — for-loop targets, tuple unpacks,
+        # `with ... as`, walrus — demotes the name, so a set var rebound
+        # by a later loop is never falsely flagged at its new type
+        assigned_set: set[str] = set()
+        assigned_other: set[str] = set()
+
+        def demote(target: ast.AST) -> None:
+            for n in ast.walk(target):
+                if isinstance(n, ast.Name):
+                    assigned_other.add(n.id)
+
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                if len(sub.targets) == 1 and isinstance(
+                    sub.targets[0], ast.Name
+                ) and _set_producing(sub.value, set()):
+                    assigned_set.add(sub.targets[0].id)
+                else:
+                    for t in sub.targets:
+                        demote(t)
+            elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                demote(sub.target)
+            elif isinstance(sub, ast.withitem) and \
+                    sub.optional_vars is not None:
+                demote(sub.optional_vars)
+            elif isinstance(sub, ast.NamedExpr):
+                if _set_producing(sub.value, set()):
+                    assigned_set.add(sub.target.id)
+                else:
+                    demote(sub.target)
+        self.set_vars = assigned_set - assigned_other
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _flag(self, line: int, msg: str) -> None:
+        self.findings.append(
+            Finding(PASS_NAME, str(self.mod.path), line, msg)
+        )
+
+    def _check_iter(self, iter_node: ast.AST, line: int) -> None:
+        if _set_producing(iter_node, self.set_vars):
+            self._flag(
+                line,
+                "iteration over an unordered set — order depends on "
+                "PYTHONHASHSEED and diverges across processes; iterate "
+                "sorted(...) instead",
+            )
+
+    def visit_For(self, node: ast.For):
+        self._check_iter(node.iter, node.lineno)
+        self.generic_visit(node)
+
+    def _visit_comp(self, node):
+        if isinstance(node, ast.SetComp):
+            self.generic_visit(node)  # set in, set out: order never escapes
+            return
+        if isinstance(node, ast.GeneratorExp):
+            parent = self.mod.parent_of(node)
+            if isinstance(parent, ast.Call):
+                chain = dotted(parent.func)
+                if chain in _ORDER_FREE_SINKS:
+                    self.generic_visit(node)
+                    return
+        for gen in node.generators:
+            self._check_iter(gen.iter, node.lineno)
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_DictComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+
+    def visit_Call(self, node: ast.Call):
+        chain = dotted(node.func)
+        if chain is not None:
+            reason = _BANNED_CALLS.get(chain)
+            if reason is not None and not _is_injection_fallback(
+                self.mod, node
+            ):
+                self._flag(
+                    node.lineno,
+                    f"{chain}() ({reason}) in sim-driven code — use the "
+                    "injected clock/now parameter (the `X if now is None "
+                    "else now` idiom declares the injectable default)",
+                )
+            elif chain.startswith("random.") and chain != "random.Random":
+                self._flag(
+                    node.lineno,
+                    f"ambient {chain}() in sim-driven code — draw from an "
+                    "injected, seeded random.Random stream",
+                )
+            elif chain == "random.Random" and not node.args and \
+                    not node.keywords and \
+                    not _is_injection_fallback(self.mod, node):
+                self._flag(
+                    node.lineno,
+                    "unseeded random.Random() in sim-driven code — seed "
+                    "it, or make it an injectable default "
+                    "(`rng or random.Random()`)",
+                )
+            elif chain.startswith("secrets."):
+                self._flag(node.lineno, f"{chain}() entropy in sim-driven code")
+            if chain in _SET_WRAPPERS and node.args:
+                self._check_iter(node.args[0], node.lineno)
+        self.generic_visit(node)
+
+
+class _DeterminismPass:
+    name = PASS_NAME
+    doc = "wall clock / ambient randomness / set iteration in sim-driven code"
+    scope = SCOPE
+
+    def run(self, modules: list[Module]) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in modules:
+            fns = [
+                n for n in ast.walk(mod.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            for fn in fns:
+                walker = _FnWalk(mod, findings, fn)
+                walker.visit_FunctionDef(fn)
+        return findings
+
+
+PASS = _DeterminismPass()

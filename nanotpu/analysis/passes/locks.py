@@ -1,0 +1,446 @@
+"""lock-discipline: acquisition-order cycles + blocking work under hot locks.
+
+The dealer's concurrency design (dealer.py module docstring) rests on two
+conventions nothing else enforces:
+
+1. **One global lock order.** ``_republish`` takes ``_publish_lock`` then
+   briefly ``_lock``; ``_bind_strict`` takes ``_lock`` then a barrier's
+   ``cv``. Any code path establishing the reverse order of ANY two locks
+   is a deadlock waiting for contention. This pass builds the
+   acquisition graph — lexical ``with`` nesting plus a fixpoint over the
+   intra-/cross-class call graph (``self.method()`` calls and calls
+   through ``self.attr = ClassName(...)``-typed attributes) — and rejects
+   cycles.
+
+2. **Nothing blocking under the hot locks.** ``Dealer._lock`` serializes
+   every verb commit and ``Dealer._publish_lock`` every snapshot swap; an
+   apiserver round-trip, a socket write, a ``time.sleep``, or a native
+   ctypes call made while holding one turns a microsecond critical
+   section into a convoy (dealer.go's single-mutex p50 collapse, SURVEY
+   §6 — the bug this codebase exists to not have). ``time.sleep`` is
+   rejected under ANY lock.
+
+Lock identity is by *name* — ``Class.attr`` — resolved in this order:
+the literal handed to the witness factories (``make_lock("Dealer._lock")``),
+``self.attr`` inside its class, annotated/constructed local types, then a
+unique global owner of a lock-ish attribute. The same names the runtime
+witness (nanotpu/analysis/witness.py) uses, so a static edge and a
+witnessed edge land in one namespace.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from nanotpu.analysis.core import Finding, Module, dotted
+
+PASS_NAME = "lock-discipline"
+
+SCOPE = (
+    "nanotpu.dealer", "nanotpu.controller", "nanotpu.routes",
+    "nanotpu.scheduler", "nanotpu.k8s", "nanotpu.metrics", "nanotpu.sim",
+    "nanotpu.native", "nanotpu.policy", "nanotpu.utils",
+    "nanotpu.analysis",
+)
+
+#: locks whose critical sections are the scheduling hot path: blocking
+#: calls under these are findings (elsewhere only cycles + sleep are)
+HOT_LOCKS = ("Dealer._lock", "Dealer._publish_lock")
+
+#: terminal attribute names treated as lock objects
+_LOCKISH = ("cv", "_cv", "cond", "_cond", "_mu")
+_FACTORIES = ("make_lock", "make_rlock", "make_condition")
+
+
+def _is_lockish(attr: str) -> bool:
+    return "lock" in attr.lower() or attr in _LOCKISH
+
+
+def _blocking_reason(chain: str) -> str | None:
+    """Why a dotted call chain counts as blocking, or None."""
+    parts = chain.split(".")
+    if chain == "time.sleep":
+        return "time.sleep"
+    terminal = parts[-1]
+    if terminal == "urlopen":
+        return "HTTP round-trip (urlopen)"
+    if terminal in ("sendall", "recv", "connect"):
+        return f"socket {terminal}"
+    if any(p in ("wfile", "rfile") for p in parts[:-1]):
+        return "handler socket I/O"
+    if any(p in ("client", "clientset") for p in parts[:-1]):
+        return f"apiserver round-trip ({chain})"
+    if parts[0] == "native" and len(parts) > 1:
+        return f"ctypes native call ({chain})"
+    if terminal == "wait":
+        return f"blocking wait ({chain})"
+    return None
+
+
+@dataclass
+class _FnSummary:
+    qual: str                       # "Class.method" or "function"
+    cls: str | None
+    path: str = ""
+    acquires: set = field(default_factory=set)
+    #: (reason, line) of directly blocking calls anywhere in the body
+    blocking: set = field(default_factory=set)
+    #: (callee class or None-for-same-module-function, callee name, line)
+    calls: set = field(default_factory=set)
+    #: under-lock observations: (held names tuple, node, chain)
+    under: list = field(default_factory=list)
+    #: (held names tuple, callee cls, callee name, line)
+    under_calls: list = field(default_factory=list)
+    edges: list = field(default_factory=list)  # (a, b, line)
+    bare: list = field(default_factory=list)   # (chain, line) acquire()/release()
+
+
+class _ModuleIndex:
+    """Per-module name resolution state shared by the function walks."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        short = mod.name.rsplit(".", 1)[-1]
+        self.short = short
+        self.classes: dict[str, ast.ClassDef] = {}
+        #: (cls, attr) -> canonical lock name from a witness factory call
+        self.factory_names: dict[tuple[str, str], str] = {}
+        #: (cls, attr) -> class name, from ``self.attr = ClassName(...)``
+        self.attr_types: dict[tuple[str, str], str] = {}
+        #: lock-ish attr -> owner class, when globally unique in-module
+        self.attr_owner: dict[str, str | None] = {}
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+        for cls in self.classes.values():
+            for sub in ast.walk(cls):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                self._index_assign(cls.name, sub)
+
+    def _index_assign(self, cls: str, assign: ast.Assign) -> None:
+        for target in assign.targets:
+            chain = dotted(target)
+            if chain is None or not chain.startswith("self."):
+                continue
+            attr = chain[len("self."):]
+            if "." in attr:
+                continue
+            value = assign.value
+            # unwrap ``x or Fallback()`` injection defaults
+            if isinstance(value, ast.BoolOp) and value.values:
+                value = value.values[-1]
+            if isinstance(value, ast.Call):
+                fchain = dotted(value.func) or ""
+                fname = fchain.rsplit(".", 1)[-1]
+                if fname in _FACTORIES and value.args and isinstance(
+                    value.args[0], ast.Constant
+                ) and isinstance(value.args[0].value, str):
+                    self.factory_names[(cls, attr)] = value.args[0].value
+                elif fname in self.classes or (
+                    fname and fname[0].isupper() and "." not in fchain
+                ):
+                    self.attr_types[(cls, attr)] = fname
+                if _is_lockish(attr) and (
+                    fname in _FACTORIES
+                    or fchain in ("threading.Lock", "threading.RLock",
+                                  "threading.Condition")
+                ):
+                    if attr in self.attr_owner and self.attr_owner[attr] != cls:
+                        self.attr_owner[attr] = None  # ambiguous
+                    else:
+                        self.attr_owner.setdefault(attr, cls)
+
+
+class _FnWalker(ast.NodeVisitor):
+    def __init__(self, index: _ModuleIndex, cls: str | None, fn):
+        self.index = index
+        self.cls = cls
+        self.fn = fn
+        self.summary = _FnSummary(
+            qual=f"{cls}.{fn.name}" if cls else fn.name, cls=cls
+        )
+        #: local/param name -> class name
+        self.local_types: dict[str, str] = {}
+        self.held: list[str] = []
+        for arg in list(fn.args.args) + list(fn.args.kwonlyargs):
+            ann = arg.annotation
+            if isinstance(ann, ast.Name):
+                self.local_types[arg.arg] = ann.id
+            elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                self.local_types[arg.arg] = ann.value
+
+    # -- name resolution ---------------------------------------------------
+    def lock_name(self, expr: ast.AST) -> str | None:
+        chain = dotted(expr)
+        if chain is None:
+            return None
+        parts = chain.split(".")
+        if not _is_lockish(parts[-1]):
+            return None
+        if parts[0] == "self" and self.cls:
+            if len(parts) == 2:
+                key = (self.cls, parts[1])
+                if key in self.index.factory_names:
+                    return self.index.factory_names[key]
+                return f"{self.cls}.{parts[1]}"
+            owner = self.index.attr_types.get((self.cls, parts[1]))
+            if owner:
+                return f"{owner}." + ".".join(parts[2:])
+            return f"{self.cls}." + ".".join(parts[1:])
+        if parts[0] in self.local_types and len(parts) >= 2:
+            return f"{self.local_types[parts[0]]}." + ".".join(parts[1:])
+        if len(parts) >= 2:
+            owner = self.index.attr_owner.get(parts[-1])
+            if owner:
+                return f"{owner}.{parts[-1]}"
+            return chain
+        return f"{self.index.short}.{parts[0]}"
+
+    def _callee(self, call: ast.Call):
+        """(cls|None, name) for calls the fixpoint can chase."""
+        chain = dotted(call.func)
+        if chain is None:
+            return None
+        parts = chain.split(".")
+        if parts[0] == "self" and self.cls:
+            if len(parts) == 2:
+                return (self.cls, parts[1])
+            if len(parts) == 3:
+                owner = self.index.attr_types.get((self.cls, parts[1]))
+                if owner:
+                    return (owner, parts[2])
+            return None
+        if len(parts) == 1:
+            return (None, parts[0])  # same-module function
+        if parts[0] in self.local_types and len(parts) == 2:
+            return (self.local_types[parts[0]], parts[1])
+        return None
+
+    # -- traversal -----------------------------------------------------------
+    def visit_FunctionDef(self, node):  # nested defs: don't descend
+        if node is not self.fn:
+            return
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node: ast.Assign):
+        value = node.value
+        if isinstance(value, ast.BoolOp) and value.values:
+            value = value.values[-1]
+        if isinstance(value, ast.Call):
+            fchain = dotted(value.func) or ""
+            fname = fchain.rsplit(".", 1)[-1]
+            if fname and fname[0].isupper() and (
+                fname in self.index.classes or "." not in fchain
+            ):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        self.local_types[target.id] = fname
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With):
+        acquired: list[str] = []
+        for item in node.items:
+            name = self.lock_name(item.context_expr)
+            if name is None:
+                self.visit(item.context_expr)
+                continue
+            for h in self.held:
+                if h != name:
+                    self.summary.edges.append((h, name, node.lineno))
+            self.held.append(name)
+            acquired.append(name)
+            self.summary.acquires.add(name)
+        for stmt in node.body:
+            self.visit(stmt)
+        for name in reversed(acquired):
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call):
+        chain = dotted(node.func)
+        if chain is not None:
+            terminal = chain.rsplit(".", 1)[-1]
+            receiver = chain.rsplit(".", 1)[0] if "." in chain else ""
+            if terminal in ("acquire", "release") and receiver and \
+                    _is_lockish(receiver.rsplit(".", 1)[-1]):
+                self.summary.bare.append((chain, node.lineno))
+            reason = _blocking_reason(chain)
+            if reason is not None:
+                self.summary.blocking.add((reason, node.lineno))
+                if self.held:
+                    self.summary.under.append(
+                        (tuple(self.held), node.lineno, reason)
+                    )
+            callee = self._callee(node)
+            if callee is not None:
+                self.summary.calls.add((callee[0], callee[1], node.lineno))
+                if self.held:
+                    self.summary.under_calls.append(
+                        (tuple(self.held), callee[0], callee[1], node.lineno)
+                    )
+        self.generic_visit(node)
+
+
+def _summarize(modules: list[Module]):
+    summaries: dict[tuple[str | None, str], _FnSummary] = {}
+    per_module: dict[str, list[_FnSummary]] = {}
+    for mod in modules:
+        index = _ModuleIndex(mod)
+        fns: list[tuple[str | None, ast.AST]] = [
+            (None, n) for n in mod.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for cls in index.classes.values():
+            fns += [
+                (cls.name, n) for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+        out = []
+        for cls_name, fn in fns:
+            walker = _FnWalker(index, cls_name, fn)
+            walker.visit_FunctionDef(fn)
+            s = walker.summary
+            s.path = str(mod.path)
+            summaries[(cls_name, fn.name)] = s
+            out.append(s)
+        per_module[mod.name] = out
+    return summaries, per_module
+
+
+def _fixpoint(summaries) -> tuple[dict, dict]:
+    """Transitive may_acquire / may_block over the resolvable call graph.
+    Same-module plain-function callees resolve with cls=None; bounded by
+    the lattice height (sets only grow)."""
+    may_acquire = {k: set(s.acquires) for k, s in summaries.items()}
+    may_block = {k: set(s.blocking) for k, s in summaries.items()}
+    changed = True
+    guard = 0
+    while changed and guard < 50:
+        changed = False
+        guard += 1
+        for key, s in summaries.items():
+            for ccls, cname, _line in s.calls:
+                ckey = (ccls, cname)
+                if ckey not in summaries:
+                    continue
+                if not may_acquire[key] >= may_acquire[ckey]:
+                    may_acquire[key] |= may_acquire[ckey]
+                    changed = True
+                if not may_block[key] >= may_block[ckey]:
+                    may_block[key] |= may_block[ckey]
+                    changed = True
+    return may_acquire, may_block
+
+
+def _find_cycles(edges: dict) -> list[list[str]]:
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    cycles: list[list[str]] = []
+    seen_cycles: set[tuple] = set()
+    state: dict[str, int] = {}
+
+    def visit(node: str, trail: list[str]):
+        state[node] = 1
+        trail.append(node)
+        for nxt in sorted(graph.get(node, ())):
+            if state.get(nxt, 0) == 1:
+                cycle = trail[trail.index(nxt):] + [nxt]
+                key = tuple(sorted(cycle))
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cycle)
+            elif state.get(nxt, 0) == 0:
+                visit(nxt, trail)
+        trail.pop()
+        state[node] = 2
+
+    for node in sorted(graph):
+        if state.get(node, 0) == 0:
+            visit(node, [])
+    return cycles
+
+
+class _LockPass:
+    name = PASS_NAME
+    doc = "lock-order cycles and blocking calls under the dealer's hot locks"
+    scope = SCOPE
+    hot_locks = HOT_LOCKS
+
+    def run(self, modules: list[Module]) -> list[Finding]:
+        summaries, _per_module = _summarize(modules)
+        may_acquire, may_block = _fixpoint(summaries)
+        findings: list[Finding] = []
+        #: (a, b) -> (path, line) of one witness site
+        edges: dict[tuple[str, str], tuple[str, int]] = {}
+
+        for key, s in summaries.items():
+            path = s.path
+            for a, b, line in s.edges:
+                edges.setdefault((a, b), (path, line))
+            # propagated edges: calling m while holding L orders L before
+            # everything m may acquire
+            for held, ccls, cname, line in s.under_calls:
+                ckey = (ccls, cname)
+                for lock in may_acquire.get(ckey, ()):
+                    for h in held:
+                        if h != lock:
+                            edges.setdefault((h, lock), (path, line))
+            # blocking directly under a lock
+            for held, line, reason in s.under:
+                if reason == "time.sleep":
+                    findings.append(Finding(
+                        self.name, path, line,
+                        f"time.sleep while holding {held[-1]} — sleeping "
+                        "under any lock convoys every waiter",
+                    ))
+                elif any(h in self.hot_locks for h in held):
+                    hot = next(h for h in held if h in self.hot_locks)
+                    findings.append(Finding(
+                        self.name, path, line,
+                        f"blocking call ({reason}) while holding hot lock "
+                        f"{hot} — hot-path critical sections must stay "
+                        "compute-only",
+                    ))
+            # blocking reached through a call chain under a hot lock
+            for held, ccls, cname, line in s.under_calls:
+                hot = next((h for h in held if h in self.hot_locks), None)
+                if hot is None:
+                    continue
+                blocked = sorted(may_block.get((ccls, cname), set()))
+                if blocked:  # one finding per call site, first cause
+                    reason = blocked[0][0]
+                    callee = f"{ccls}.{cname}" if ccls else cname
+                    findings.append(Finding(
+                        self.name, path, line,
+                        f"call to {callee} while holding {hot} may "
+                        f"block ({reason}) — move it outside the "
+                        "critical section or prove it cannot block here",
+                    ))
+            for chain, line in s.bare:
+                findings.append(Finding(
+                    self.name, path, line,
+                    f"bare {chain}() — use `with` so nanolint (and "
+                    "reviewers) can see the critical-section extent",
+                ))
+
+        for cycle in _find_cycles(edges):
+            sites = "; ".join(
+                f"{a}->{b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+                for a, b in zip(cycle, cycle[1:])
+            )
+            path, line = edges[(cycle[0], cycle[1])]
+            findings.append(Finding(
+                self.name, path, line,
+                f"lock-order cycle {' -> '.join(cycle)} ({sites}) — two "
+                "code paths disagree about acquisition order; under "
+                "contention this deadlocks",
+            ))
+        return findings
+
+
+PASS = _LockPass()

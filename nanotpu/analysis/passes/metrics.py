@@ -1,0 +1,162 @@
+"""metrics-completeness: every counter both incremented and exported.
+
+The robustness layer's promise (docs/robustness.md) is that every
+deliberate degradation — shed request, coalesced sync, expired
+annotation, fast-failed write — is attributable on ``/metrics``. That
+promise has two string-ly typed seams this pass stitches shut:
+
+* **ResilienceCounters** fields are declared in the ``_SCALARS`` /
+  ``_LABELED`` tables of ``nanotpu/metrics/resilience.py`` (which the
+  exporter renders), but bumped via ``counters.inc("<field>")`` string
+  calls scattered across server / controller / k8s / events. An inc of
+  an undeclared field raises AttributeError at degradation time (the
+  worst possible moment); a declared field nobody bumps renders a
+  forever-zero metric that reads as "this failure never happens" when it
+  actually means "nobody counts it".
+
+* **PerfCounters** slots are auto-exported by the route layer's
+  ``perf.__slots__`` loop, so registration is structural — but a slot
+  with no ``+=`` site anywhere is again a lying zero on ``/metrics``.
+
+Registry-built metrics (``registry.counter(...)`` etc.) register at
+construction by design and need no check here.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from nanotpu.analysis.core import Finding, Module, dotted
+
+PASS_NAME = "metrics-completeness"
+
+#: inc-site receivers that denote the resilience ledger
+_LEDGER_RECEIVERS = ("resilience", "counters", "_counters")
+
+SCOPE = ("nanotpu",)  # inc sites can live anywhere in the package
+
+
+def _declared_resilience(mod: Module) -> dict[str, int] | None:
+    """field -> declaration line from the _SCALARS/_LABELED literals."""
+    out: dict[str, int] = {}
+    found = False
+    for node in mod.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if not any(t in ("_SCALARS", "_LABELED") for t in targets):
+            continue
+        found = True
+        if isinstance(node.value, ast.Dict):
+            for key in node.value.keys:
+                if isinstance(key, ast.Constant) and isinstance(
+                    key.value, str
+                ):
+                    out[key.value] = key.lineno
+    return out if found else None
+
+
+def _declared_slots(mod: Module, cls_name: str) -> dict[str, int] | None:
+    for node in mod.tree.body:
+        if not isinstance(node, ast.ClassDef) or node.name != cls_name:
+            continue
+        for sub in node.body:
+            if isinstance(sub, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "__slots__"
+                for t in sub.targets
+            ) and isinstance(sub.value, (ast.Tuple, ast.List)):
+                return {
+                    e.value: e.lineno for e in sub.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                }
+    return None
+
+
+class _MetricsPass:
+    name = PASS_NAME
+    doc = "counters incremented but unregistered, or registered but never bumped"
+    scope = SCOPE
+
+    def run(self, modules: list[Module]) -> list[Finding]:
+        findings: list[Finding] = []
+        declared: dict[str, int] | None = None
+        decl_mod: Module | None = None
+        slots: dict[str, int] | None = None
+        slots_mod: Module | None = None
+        for mod in modules:
+            d = _declared_resilience(mod)
+            if d is not None:
+                declared, decl_mod = d, mod
+            s = _declared_slots(mod, "PerfCounters")
+            if s is not None:
+                slots, slots_mod = s, mod
+
+        inc_sites: dict[str, tuple[str, int]] = {}
+        perf_incs: dict[str, tuple[str, int]] = {}
+        for mod in modules:
+            if decl_mod is not None and mod is decl_mod:
+                continue  # the ledger's own inc() plumbing is not a site
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    chain = dotted(node.func)
+                    if chain is None or not chain.endswith(".inc"):
+                        continue
+                    receiver = chain.rsplit(".", 2)[-2]
+                    if receiver not in _LEDGER_RECEIVERS:
+                        continue
+                    if node.args and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        field = node.args[0].value
+                        inc_sites.setdefault(
+                            field, (str(mod.path), node.lineno)
+                        )
+                        if declared is not None and field not in declared:
+                            findings.append(Finding(
+                                self.name, str(mod.path), node.lineno,
+                                f"resilience counter {field!r} is "
+                                "incremented here but not declared in "
+                                "_SCALARS/_LABELED — it will raise at "
+                                "degradation time and never export",
+                            ))
+                elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Attribute
+                ):
+                    base = dotted(node.target.value)
+                    if base is not None and base.split(".")[-1] in (
+                        "perf", "_perf"
+                    ):
+                        perf_incs.setdefault(
+                            node.target.attr, (str(mod.path), node.lineno)
+                        )
+
+        if declared is not None and decl_mod is not None:
+            for field, line in sorted(declared.items()):
+                if field not in inc_sites:
+                    findings.append(Finding(
+                        self.name, str(decl_mod.path), line,
+                        f"resilience counter {field!r} is exported but "
+                        "never incremented anywhere — a forever-zero "
+                        "metric reads as 'cannot happen'",
+                    ))
+        if slots is not None and slots_mod is not None:
+            for slot, line in sorted(slots.items()):
+                if slot not in perf_incs:
+                    findings.append(Finding(
+                        self.name, str(slots_mod.path), line,
+                        f"PerfCounters slot {slot!r} is exported on "
+                        "/metrics but never incremented anywhere",
+                    ))
+            for slot, (path, line) in sorted(perf_incs.items()):
+                if slot not in slots:
+                    findings.append(Finding(
+                        self.name, path, line,
+                        f"perf counter {slot!r} is incremented here but "
+                        "is not a PerfCounters slot — it is never "
+                        "exported (and will AttributeError at runtime)",
+                    ))
+        return findings
+
+
+PASS = _MetricsPass()

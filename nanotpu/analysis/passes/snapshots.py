@@ -1,0 +1,176 @@
+"""snapshot-immutability: published RCU state is write-once.
+
+The whole point of the dealer's RCU read path (PR 2) is that read verbs
+consume ``Dealer._published`` WITHOUT the dealer lock — which is only
+sound because a published ``_Snapshot`` and the frozen ``BatchScorer``
+views hanging off it are never mutated after the swap. There is no
+runtime enforcement (CPython has no frozen objects without cost on the
+hot path), so the convention is exactly one unreviewed edit away from a
+torn read. This pass is the enforcement:
+
+* any attribute store (``x.attr = ...``, ``x.attr += ...``) on a value
+  known to be a snapshot — a local assigned from ``_Snapshot(...)`` or
+  from ``<anything>._published``, or a direct ``...._published.attr``
+  chain — is a finding unless it happens inside the publisher path
+  (``_Snapshot.__init__`` and the functions in :data:`PUBLISHER_FUNCS`,
+  which build the NEXT snapshot before the swap);
+* any attribute store on a value known to be a frozen view — a local
+  assigned from ``<scorer>.advanced(...)`` — is a finding anywhere
+  outside :data:`VIEW_MODULE` (``advanced()`` itself builds the clone's
+  fresh arrays before freezing it; that module owns the freeze protocol).
+
+Subscript mutation of ``snap.views`` by readers is legal by design (the
+lazy view cache — dict ops are GIL-atomic and documented in _Snapshot's
+docstring), so only attribute stores are policed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from nanotpu.analysis.core import Finding, Module, dotted
+
+PASS_NAME = "snapshot-immutability"
+
+SCOPE = (
+    "nanotpu.dealer", "nanotpu.controller", "nanotpu.routes",
+    "nanotpu.scheduler", "nanotpu.sim",
+)
+
+#: functions allowed to store attributes on a _Snapshot: the publisher
+PUBLISHER_FUNCS = {"Dealer._republish", "_Snapshot.__init__"}
+
+#: the module that owns BatchScorer's freeze/clone protocol
+VIEW_MODULE = "nanotpu.dealer.batch"
+
+#: constructors whose results are snapshots
+_SNAPSHOT_CTORS = {"_Snapshot"}
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, qual: str, fn, findings: list[Finding], path: str,
+                 in_publisher: bool, in_view_module: bool):
+        self.qual = qual
+        self.fn = fn
+        self.findings = findings
+        self.path = path
+        self.in_publisher = in_publisher
+        self.in_view_module = in_view_module
+        self.snapshot_vars: set[str] = set()
+        self.frozen_vars: set[str] = set()
+
+    def visit_FunctionDef(self, node):
+        if node is not self.fn:
+            return  # nested defs keep their own tracking scope
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _classify_value(self, value: ast.AST) -> str | None:
+        """'snapshot' / 'frozen' when the expression produces one."""
+        if isinstance(value, ast.Call):
+            chain = dotted(value.func) or ""
+            name = chain.rsplit(".", 1)[-1]
+            if name in _SNAPSHOT_CTORS:
+                return "snapshot"
+            if name == "advanced":
+                return "frozen"
+        chain = dotted(value)
+        if chain is not None and chain.split(".")[-1] == "_published":
+            return "snapshot"
+        if isinstance(value, ast.Name):
+            if value.id in self.snapshot_vars:
+                return "snapshot"
+            if value.id in self.frozen_vars:
+                return "frozen"
+        return None
+
+    def _check_store(self, target: ast.AST, line: int) -> None:
+        if not isinstance(target, ast.Attribute):
+            return
+        base = target.value
+        kind = None
+        chain = dotted(base)
+        if isinstance(base, ast.Name):
+            if base.id in self.snapshot_vars:
+                kind = "snapshot"
+            elif base.id in self.frozen_vars:
+                kind = "frozen"
+        if kind is None and chain is not None and \
+                chain.split(".")[-1] == "_published":
+            kind = "snapshot"
+        if kind == "snapshot" and not self.in_publisher:
+            self.findings.append(Finding(
+                PASS_NAME, self.path, line,
+                f"store to published snapshot attribute "
+                f"`.{target.attr}` in {self.qual} — snapshots are "
+                "immutable after the RCU swap; build a successor and "
+                "republish instead",
+            ))
+        elif kind == "frozen" and not self.in_view_module:
+            self.findings.append(Finding(
+                PASS_NAME, self.path, line,
+                f"store to frozen BatchScorer attribute `.{target.attr}` "
+                f"in {self.qual} — frozen views are write-once; state "
+                "drift must go through advanced()",
+            ))
+
+    def visit_Assign(self, node: ast.Assign):
+        kind = self._classify_value(node.value)
+        for target in node.targets:
+            self._check_store(target, node.lineno)
+            if kind is not None and isinstance(target, ast.Name):
+                (self.snapshot_vars if kind == "snapshot"
+                 else self.frozen_vars).add(target.id)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._check_store(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            kind = self._classify_value(node.value)
+            self._check_store(node.target, node.lineno)
+            if kind is not None and isinstance(node.target, ast.Name):
+                (self.snapshot_vars if kind == "snapshot"
+                 else self.frozen_vars).add(node.target.id)
+        self.generic_visit(node)
+
+
+class _SnapshotPass:
+    name = PASS_NAME
+    doc = "attribute stores on published/frozen RCU state outside the publisher"
+    scope = SCOPE
+
+    def run(self, modules: list[Module]) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in modules:
+            in_view_module = mod.name == VIEW_MODULE or (
+                not mod.name.startswith("nanotpu")
+                and mod.name.endswith("batch")
+            )
+            fns: list[tuple[str | None, ast.AST]] = [
+                (None, n) for n in mod.tree.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            for cls in mod.tree.body:
+                if isinstance(cls, ast.ClassDef):
+                    fns += [
+                        (cls.name, n) for n in cls.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                    ]
+            for cls_name, fn in fns:
+                qual = f"{cls_name}.{fn.name}" if cls_name else fn.name
+                walker = _Walker(
+                    qual, fn, findings, str(mod.path),
+                    in_publisher=qual in PUBLISHER_FUNCS,
+                    in_view_module=in_view_module,
+                )
+                walker.visit_FunctionDef(fn)
+        return findings
+
+
+PASS = _SnapshotPass()

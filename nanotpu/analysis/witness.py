@@ -1,0 +1,282 @@
+"""Runtime lock-order witness: turn latent lock inversions into failures.
+
+The static ``lock-discipline`` pass sees lexical nesting and an
+intra-class call graph; it cannot see orders established across objects
+at runtime (thread A takes ``GangBarrier.cv`` then ``Dealer._lock`` while
+thread B does the reverse through three call layers). This module closes
+that gap dynamically: when active, every lock built through the
+:func:`make_lock` / :func:`make_rlock` / :func:`make_condition` factories
+is wrapped so each acquisition records, for every lock the acquiring
+thread already holds, a directed edge ``held -> acquiring`` in one
+process-global graph, together with the first stack that witnessed it.
+:func:`assert_acyclic` (called at sim teardown and by the test session's
+finish hook) then fails loudly — with both witness stacks — if any two
+code paths ever disagreed about the order.
+
+Locks are identified by the NAME given at the factory (``"Dealer._lock"``,
+``"GangBarrier.cv"``): the witness checks the ordering discipline between
+lock *classes*, which is how such disciplines are stated ("dealer lock
+before barrier cv"), not between individual instances. Re-entrant
+re-acquisition of the same class is therefore never an edge.
+
+Cost model: when inactive (the default — no ``NANOTPU_LOCK_WITNESS=1`` in
+the environment and no :func:`enable`), the factories return plain
+``threading`` primitives; production pays nothing. When active, an
+acquisition does a per-thread list walk plus GIL-atomic dict membership
+probes, and takes the witness's own mutex only to record a NEVER-seen
+edge — steady state adds no shared-lock traffic, so enabling it under the
+race tests does not serialize the very contention they exercise.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+
+_ENV_FLAG = "NANOTPU_LOCK_WITNESS"
+
+
+class LockOrderError(AssertionError):
+    """The witnessed acquisition-order graph contains a cycle."""
+
+
+class LockWitness:
+    """One acquisition-order graph. A process-global instance backs the
+    factories; tests that *construct* deliberate inversions use private
+    instances so they cannot poison the global graph."""
+
+    def __init__(self):
+        self._mu = threading.Lock()  # guards _edges inserts only
+        #: (held, acquired) -> "thread-name\nstack" of the first witness
+        self._edges: dict[tuple[str, str], str] = {}
+        self._held = threading.local()
+
+    # -- bookkeeping (called by _WitnessLock) ------------------------------
+    def _stack(self) -> list[str]:
+        s = getattr(self._held, "stack", None)
+        if s is None:
+            s = self._held.stack = []
+        return s
+
+    def on_acquire(self, name: str) -> None:
+        """Record edges held->name, then push. Called BEFORE the real
+        acquire: the ordering intent exists at the attempt, and a thread
+        that deadlocks inside the acquire still leaves its edge behind."""
+        held = self._stack()
+        for h in held:
+            if h == name:
+                continue  # re-entrant same-class hold, not an ordering
+            key = (h, name)
+            if key in self._edges:  # GIL-atomic probe; hot path stays
+                continue            # off the witness mutex entirely
+            with self._mu:
+                if key not in self._edges:
+                    self._edges[key] = (
+                        f"thread {threading.current_thread().name}:\n"
+                        + "".join(traceback.format_stack(limit=8)[:-2])
+                    )
+        held.append(name)
+
+    def on_acquire_failed(self, name: str) -> None:
+        """A non-blocking/timed acquire that did not get the lock: undo
+        the push (the edges stay — the *attempt* ordered the locks)."""
+        self._pop(name)
+
+    def on_release(self, name: str) -> None:
+        self._pop(name)
+
+    def _pop(self, name: str) -> None:
+        held = self._stack()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    def on_release_all(self, name: str) -> int:
+        """Drop every hold of ``name`` (Condition.wait's _release_save on
+        a re-entrant lock); returns the count for _acquire_restore."""
+        held = self._stack()
+        n = held.count(name)
+        if n:
+            self._held.stack = [h for h in held if h != name]
+        return n
+
+    def on_acquire_n(self, name: str, n: int) -> None:
+        self.on_acquire(name)
+        self._stack().extend([name] * (n - 1))
+
+    # -- inspection --------------------------------------------------------
+    def edges(self) -> list[tuple[str, str]]:
+        # snapshot under the mutex: teardown asserts can run while daemon
+        # threads (event recorder, assume pool) still insert first-seen
+        # edges, and iterating a mutating dict raises
+        with self._mu:
+            return sorted(self._edges)
+
+    def edge_count(self) -> int:
+        return len(self._edges)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+
+    def find_cycle(self) -> list[str] | None:
+        """Some cycle in the order graph as [a, b, ..., a], or None."""
+        graph: dict[str, list[str]] = {}
+        for a, b in self.edges():
+            graph.setdefault(a, []).append(b)
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in graph}
+        parent: dict[str, str] = {}
+
+        def visit(node: str) -> list[str] | None:
+            color[node] = GRAY
+            for nxt in graph.get(node, ()):
+                c = color.get(nxt, WHITE)
+                if c == GRAY:  # back edge: walk parents to print the loop
+                    cycle = [nxt, node]
+                    cur = node
+                    while cur != nxt:
+                        cur = parent[cur]
+                        cycle.append(cur)
+                    cycle.reverse()
+                    return cycle
+                if c == WHITE:
+                    parent[nxt] = node
+                    found = visit(nxt)
+                    if found:
+                        return found
+            color[node] = BLACK
+            return None
+
+        for node in sorted(graph):
+            if color.get(node, WHITE) == WHITE:
+                found = visit(node)
+                if found:
+                    return found
+        return None
+
+    def assert_acyclic(self) -> None:
+        cycle = self.find_cycle()
+        if cycle is None:
+            return
+        lines = [
+            "lock-order cycle witnessed at runtime: "
+            + " -> ".join(cycle),
+            "each edge below was first acquired in this order by:",
+        ]
+        for a, b in zip(cycle, cycle[1:]):
+            lines.append(f"--- {a} -> {b} ---")
+            lines.append(self._edges.get((a, b), "(edge lost)").rstrip())
+        raise LockOrderError("\n".join(lines))
+
+
+#: the process-global witness behind the factories
+_GLOBAL = LockWitness()
+_forced: bool | None = None  # enable()/disable() override for tests
+
+
+def global_witness() -> LockWitness:
+    return _GLOBAL
+
+
+def active() -> bool:
+    if _forced is not None:
+        return _forced
+    return os.environ.get(_ENV_FLAG, "") not in ("", "0")
+
+
+def opted_out() -> bool:
+    """An explicit ``NANOTPU_LOCK_WITNESS=0`` is a user decision that
+    in-process arming (the sim's ``lock_witness`` scenario knob) must
+    respect — enable() alone would silently override it for the rest of
+    the process."""
+    return os.environ.get(_ENV_FLAG, "") == "0"
+
+
+def enable() -> None:
+    global _forced
+    _forced = True
+
+
+def disable() -> None:
+    global _forced
+    _forced = False
+
+
+class _WitnessLock:
+    """Wraps a ``threading.Lock``/``RLock``; every acquisition path —
+    including the ``_release_save``/``_acquire_restore`` protocol
+    ``Condition.wait`` drives — keeps the witness's per-thread held
+    stack truthful."""
+
+    def __init__(self, inner, name: str, witness: LockWitness):
+        self._inner = inner
+        self.name = name
+        self._witness = witness
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._witness.on_acquire(self.name)
+        got = self._inner.acquire(blocking, timeout)
+        if not got:
+            self._witness.on_acquire_failed(self.name)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._witness.on_release(self.name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # -- Condition.wait protocol (RLock inner only) ------------------------
+    def _release_save(self):
+        n = self._witness.on_release_all(self.name)
+        return self._inner._release_save(), n
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, n = state
+        self._inner._acquire_restore(inner_state)
+        self._witness.on_acquire_n(self.name, max(n, 1))
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+def wrap(inner, name: str, witness: LockWitness | None = None):
+    """Instrument an existing primitive (tests with private witnesses)."""
+    return _WitnessLock(inner, name, witness or _GLOBAL)
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` — instrumented iff the witness is active at
+    construction time (locks are built at object construction, so tests
+    and the sim flip activation before building their stacks)."""
+    if active():
+        return _WitnessLock(threading.Lock(), name, _GLOBAL)
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    if active():
+        return _WitnessLock(threading.RLock(), name, _GLOBAL)
+    return threading.RLock()
+
+
+def make_condition(name: str):
+    """A ``threading.Condition`` whose underlying RLock is instrumented;
+    ``wait()`` releases/re-acquires THROUGH the witness so the held
+    stack never lies across a park."""
+    if active():
+        return threading.Condition(
+            _WitnessLock(threading.RLock(), name, _GLOBAL)
+        )
+    return threading.Condition()

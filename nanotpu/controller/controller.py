@@ -35,6 +35,7 @@ import time
 from collections import OrderedDict, deque
 
 from nanotpu import types
+from nanotpu.analysis.witness import make_condition, make_lock
 from nanotpu.dealer import Dealer
 from nanotpu.k8s.client import ApiError, Clientset, ConflictError, NotFoundError
 from nanotpu.k8s.objects import Pod
@@ -74,7 +75,7 @@ class CoalescingQueue:
     """
 
     def __init__(self, maxsize: int = QUEUE_MAX_DEFAULT, resilience=None):
-        self._cv = threading.Condition()
+        self._cv = make_condition("CoalescingQueue._cv")
         self._items: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._sentinels: deque = deque()
         self.maxsize = maxsize
@@ -165,7 +166,7 @@ class Controller:
         self._pod_watch = None
         self._node_watch = None
         # key -> last seen pod object (the informer cache analogue)
-        self._cache_lock = threading.Lock()
+        self._cache_lock = make_lock("Controller._cache_lock")
         self._pod_cache: dict[str, Pod] = {}
         #: (pod key, resourceVersion) -> first time the sweeper saw it
         #: unbound-but-assumed; an rv change (new bind attempt) restarts
@@ -225,8 +226,8 @@ class Controller:
 
     def wait_idle(self, timeout: float = 5.0) -> bool:
         """Test helper: block until the workqueue drains."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if self._queue.unfinished_tasks == 0:
                 return True
             time.sleep(0.01)

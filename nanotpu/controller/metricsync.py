@@ -28,6 +28,7 @@ import urllib.request
 from typing import Protocol
 
 from nanotpu import types
+from nanotpu.analysis.witness import make_lock
 from nanotpu.dealer import Dealer
 from nanotpu.k8s.client import ApiError, Clientset
 from nanotpu.k8s.objects import Node
@@ -59,7 +60,7 @@ class TpuRuntimeSource:
     def __init__(self, port: int = TPU_RUNTIME_METRICS_PORT, timeout_s: float = 5.0):
         self.port = port
         self.timeout_s = timeout_s
-        self._cache_lock = threading.Lock()
+        self._cache_lock = make_lock("TpuRuntimeSource._cache_lock")
         self._cache: dict[str, list] = {}  # node -> parsed samples (per tick)
 
     def begin_tick(self) -> None:

@@ -37,9 +37,9 @@ from __future__ import annotations
 
 import ctypes
 import itertools
-import threading
 
 from nanotpu import native, types
+from nanotpu.analysis.witness import make_lock
 from nanotpu.dealer import nodeinfo as nodeinfo_mod
 from nanotpu.dealer.nodeinfo import NodeInfo
 from nanotpu.dealer.perf import PerfCounters
@@ -90,7 +90,7 @@ class BatchScorer:
         #: arena lock: serializes READERS of every view in this chain
         #: around the shared output buffers/memo/renderer; publishers
         #: (advanced()) never take it
-        self._lock = threading.Lock()
+        self._lock = make_lock("BatchScorer.arena")
         self.free = (ctypes.c_int32 * (n * c))()
         self.total = (ctypes.c_int32 * (n * c))()
         self.load = (ctypes.c_double * (n * c))()

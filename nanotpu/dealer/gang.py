@@ -34,10 +34,10 @@ guarantees no reservation outlives an incomplete gang.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
 from functools import lru_cache
 
+from nanotpu.analysis.witness import make_condition, make_lock
 from nanotpu.topology import Coord, parse_slice_coords
 
 #: Gang keys are "<namespace>/<gang-name>" — the annotation value alone would
@@ -61,7 +61,7 @@ class _Gang:
 
 class GangTracker:
     def __init__(self, on_gang_empty=None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("GangTracker._lock")
         self._gangs: dict[str, _Gang] = {}
         self._by_uid: dict[str, str] = {}  # uid -> gang name
         #: bumped on every membership change; consumers key memoized
@@ -126,7 +126,7 @@ class GangBarrier:
     binds straight through)."""
 
     def __init__(self, size: int):
-        self.cv = threading.Condition()
+        self.cv = make_condition("GangBarrier.cv")
         #: the barrier threshold — the LARGEST size any member has
         #: declared (Dealer raises it under ``cv`` as members arrive).
         #: One member with a typoed smaller size must not open the

@@ -9,9 +9,8 @@ dealer.go:81 — the documented p50 bottleneck, SURVEY §6).
 
 from __future__ import annotations
 
-import threading
-
 from nanotpu import types
+from nanotpu.analysis.witness import make_lock, make_rlock
 from nanotpu.allocator.core import ChipSet, Demand, Plan
 from nanotpu.allocator.rater import Rater
 from nanotpu.k8s.objects import Node
@@ -27,7 +26,7 @@ from nanotpu.utils import node as nodeutil
 #: read is impossible for a Python int, and a bump racing the read is the
 #: same staleness window the per-node probe loop already has.
 _state_gen = 0
-_state_gen_lock = threading.Lock()
+_state_gen_lock = make_lock("nodeinfo._state_gen_lock")
 
 
 def state_generation() -> int:
@@ -55,7 +54,7 @@ class NodeInfo:
 
     def __init__(self, node: Node):
         self.name = node.name
-        self.lock = threading.RLock()
+        self.lock = make_rlock("NodeInfo.lock")
         (
             chip_count, generation, topo, self.slice_name, self.slice_coords,
         ) = self.fingerprint_of(node)

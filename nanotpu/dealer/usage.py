@@ -14,9 +14,10 @@ read as 0 (scheduling must degrade to load-blind, never crash).
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
+
+from nanotpu.analysis.witness import make_lock
 
 #: Grace added to a policy's sync period when judging staleness
 #: (reference: 5 min, type.go:6).
@@ -34,7 +35,7 @@ class UsageStore:
     """node -> chip -> latest usage sample."""
 
     def __init__(self, window_s: float = 15.0):
-        self._lock = threading.Lock()
+        self._lock = make_lock("UsageStore._lock")
         self._data: dict[str, dict[int, ChipUsageSample]] = {}
         #: expected sync period; staleness cutoff = window + grace
         self.window_s = window_s

@@ -20,6 +20,7 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Protocol
 
+from nanotpu.analysis.witness import make_rlock
 from nanotpu.k8s.objects import Node, Pod, plain_copy
 
 
@@ -117,7 +118,7 @@ class FakeClientset:
     """In-memory API server with watches and optimistic concurrency."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("FakeClientset._lock")
         self._pods: dict[str, dict] = {}  # key ns/name -> raw
         self._nodes: dict[str, dict] = {}
         self._rv = itertools.count(start=2)

@@ -28,6 +28,7 @@ import threading
 import time
 from collections import OrderedDict
 
+from nanotpu.analysis.witness import make_lock
 from nanotpu.k8s.client import ApiError
 from nanotpu.k8s.objects import Pod
 
@@ -52,13 +53,18 @@ class EventRecorder:
     raises; never blocks the caller on the API."""
 
     def __init__(self, client, component: str = COMPONENT,
-                 resilience=None):
+                 resilience=None, clock=time.time):
         self.client = client
         self.component = component
         #: optional ResilienceCounters: events are fail-open by design, so
         #: every drop (queue full, flush timeout) must at least be counted
         self.resilience = resilience
-        self._lock = threading.Lock()
+        #: injectable wall clock for Event timestamps: the default is real
+        #: time (timestamps are for `kubectl describe`), but a harness
+        #: that wants reproducible bodies can pin it (nanolint
+        #: sim-determinism requires the injection seam)
+        self._clock = clock
+        self._lock = make_lock("EventRecorder._lock")
         # key -> (event name, count, firstTimestamp), LRU-ordered
         self._entries: OrderedDict[tuple, tuple[str, int, str]] = OrderedDict()
         self._seq = 0
@@ -76,7 +82,7 @@ class EventRecorder:
         try:
             self._q.put_nowait(
                 (pod.namespace, pod.name, pod.uid, etype, reason, message,
-                 time.time())
+                 self._clock())
             )
         except queue.Full:
             # best-effort by design: a drop also loses its aggregation

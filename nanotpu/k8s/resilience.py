@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Callable
 
+from nanotpu.analysis.witness import make_lock
 from nanotpu.k8s.client import ApiError, ConflictError, NotFoundError
 from nanotpu.metrics.resilience import ResilienceCounters
 
@@ -72,7 +73,7 @@ class CircuitBreaker:
         self.failure_threshold = failure_threshold
         self.base_cooldown_s = cooldown_s
         self.cooldown_max_s = cooldown_max_s
-        self._lock = threading.Lock()
+        self._lock = make_lock("CircuitBreaker._lock")
         self._failures = 0
         self._open_until: float | None = None  # None == closed
         self._cooldown = cooldown_s
@@ -134,7 +135,7 @@ class _RetryBudget:
         self.capacity = capacity
         self.refill_per_s = refill_per_s
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("RetryBudget._lock")
         self._tokens = capacity
         self._last = clock()
 

@@ -9,8 +9,9 @@ histogram with cumulative buckets.
 
 from __future__ import annotations
 
-import threading
 from bisect import bisect_left
+
+from nanotpu.analysis.witness import make_lock
 
 #: Default latency buckets (seconds) tuned for scheduler verbs: sub-ms to 2.5s.
 LATENCY_BUCKETS = (
@@ -28,7 +29,7 @@ def _fmt_labels(labels: dict[str, str]) -> str:
 class Counter:
     def __init__(self, name: str, help_: str):
         self.name, self.help = name, help_
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.Counter._lock")
         self._values: dict[tuple, float] = {}
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
@@ -48,7 +49,7 @@ class Counter:
 class Gauge:
     def __init__(self, name: str, help_: str):
         self.name, self.help = name, help_
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.Gauge._lock")
         self._values: dict[tuple, float] = {}
         self._fn = None
 
@@ -80,7 +81,7 @@ class Histogram:
     def __init__(self, name: str, help_: str, buckets: tuple[float, ...] = LATENCY_BUCKETS):
         self.name, self.help = name, help_
         self.buckets = tuple(sorted(buckets))
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.Histogram._lock")
         # per label-set: (bucket counts, total count, sum)
         self._series: dict[tuple, list] = {}
 
@@ -137,7 +138,7 @@ class Histogram:
 
 class Registry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.Registry._lock")
         self._metrics: list = []
 
     def counter(self, name: str, help_: str) -> Counter:

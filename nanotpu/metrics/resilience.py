@@ -18,7 +18,7 @@ is the point.
 
 from __future__ import annotations
 
-import threading
+from nanotpu.analysis.witness import make_lock
 
 #: scalar counter fields and their Prometheus names
 _SCALARS = {
@@ -80,7 +80,7 @@ class ResilienceCounters:
     """Process-lifetime degradation ledger; see module docstring."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("ResilienceCounters._lock")
         for name in _SCALARS:
             setattr(self, name, 0)
         for name in _LABELED:

@@ -20,7 +20,8 @@ import ctypes
 import logging
 import os
 import subprocess
-import threading
+
+from nanotpu.analysis.witness import make_lock
 
 log = logging.getLogger("nanotpu.native")
 
@@ -32,7 +33,7 @@ _LIB = os.path.join(_PKG_DIR, "libnanotpu_alloc.so")
 #: must match nanotpu_abi_version() in allocator.cc
 ABI_VERSION = 6
 
-_lock = threading.Lock()
+_lock = make_lock("native._lock")
 _lib: ctypes.CDLL | None = None
 _tried = False
 

@@ -36,6 +36,8 @@ from dataclasses import dataclass, field
 
 import yaml
 
+from nanotpu.analysis.witness import make_lock
+
 log = logging.getLogger("nanotpu.policy")
 
 #: Metric names (reference: gpu_core_usage_avg / gpu_memory_usage_avg,
@@ -148,7 +150,7 @@ class PolicyWatcher:
     def __init__(self, path: str = "", poll_s: float = 3.0):
         self.path = path
         self.poll_s = poll_s
-        self._lock = threading.Lock()
+        self._lock = make_lock("PolicyWatcher._lock")
         self._spec = PolicySpec.default()
         self._mtime = 0.0
         self._stop = threading.Event()

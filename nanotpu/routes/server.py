@@ -41,6 +41,7 @@ import time
 import traceback
 from dataclasses import dataclass
 
+from nanotpu.analysis.witness import make_lock
 from nanotpu.dealer import Dealer
 from nanotpu.metrics.registry import Registry
 from nanotpu.metrics.resilience import ResilienceCounters, ResilienceExporter
@@ -136,7 +137,7 @@ class SchedulerAPI:
         #: live concurrent verb requests + a resettable high-water mark
         #: (the bench's accept-queue-depth attribution: >1 means the
         #: scheduler was still chewing a request when the next arrived)
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = make_lock("SchedulerAPI._inflight_lock")
         self.inflight = 0
         self.inflight_peak = 0
         self.requests_seen = 0
@@ -158,7 +159,7 @@ class SchedulerAPI:
         )
         g.set_function(lambda: self.idle_gc_collections)
         # shared sampling-profiler state (one sampler, concurrent scrapes join)
-        self._profile_lock = threading.Lock()
+        self._profile_lock = make_lock("SchedulerAPI._profile_lock")
         self._profile_run: dict | None = None
         #: one-slot (body bytes, parsed args): Filter and the immediately
         #: following Prioritize carry byte-identical ExtenderArgs (the

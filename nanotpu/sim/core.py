@@ -30,6 +30,7 @@ import time
 
 from nanotpu import types
 from nanotpu.allocator.rater import make_rater
+from nanotpu.analysis import witness as lock_witness
 from nanotpu.controller.controller import Controller
 from nanotpu.dealer import Dealer
 from nanotpu.k8s.objects import Node, Pod, plain_copy
@@ -63,6 +64,16 @@ class Simulator:
     def __init__(self, scenario: dict, seed: int = 0):
         self.scenario = normalize_scenario(scenario)
         self.seed = seed
+        # must precede the stack build (dealer, fleet, queue locks): the
+        # witness factories decide plain-vs-instrumented at creation
+        # time. Locks built at IMPORT time (nodeinfo._state_gen_lock,
+        # native._lock) are already constructed by now — full coverage
+        # needs NANOTPU_LOCK_WITNESS=1 in the environment, which `make
+        # chaos-soak` and tests/conftest.py both set; this enable() is
+        # the in-process arm for ad-hoc Simulator use. Sticky by design:
+        # a lock order is a process-wide discipline.
+        if self.scenario["lock_witness"] and not lock_witness.opted_out():
+            lock_witness.enable()
         # independent seeded streams so e.g. adding a fault cannot shift
         # the arrival sequence out from under a regression bisect:
         # rng_workload is consumed ONLY by the fixed arrival sequence;
@@ -102,6 +113,9 @@ class Simulator:
         self.jobs: list[Job] = []
         self._pod_job: dict[str, Job] = {}
         self._pending: list[str] = []  # pod names awaiting re-schedule
+        #: lock-order edges the witness held at teardown (lock_witness
+        #: scenarios only; tests assert the witness actually observed)
+        self.lock_witness_edges = 0
 
     # -- construction --------------------------------------------------------
     def _build_stack(self) -> None:
@@ -169,6 +183,17 @@ class Simulator:
         self.report.fault_counts = dict(self.faults.counts)
         self.report.pods["pending_final"] = len(self._pending)
         self.report.resilience = self._deterministic_resilience()
+        if self.scenario["lock_witness"]:
+            # teardown assert: any two code paths that disagreed about
+            # lock order during the run fail the soak HERE, with the
+            # witness stack of every edge in the cycle. The edge set
+            # itself stays out of the report: it depends on wall-clock
+            # thread interleaving (recorder/assume-pool threads), and the
+            # digest must remain byte-reproducible with the witness on.
+            self.lock_witness_edges = (
+                lock_witness.global_witness().edge_count()
+            )
+            lock_witness.global_witness().assert_acyclic()
         return self.report.build(
             include_timing=include_timing,
             wall_s=time.perf_counter() - wall0,

@@ -36,7 +36,10 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
       "retry_every_s": 0.5,
       "invariant_every_events": 1,
       "assume_ttl_s": 0.0,           # >0: sweep assumed-never-bound pods
-      "queue_max": 0                 # >0: bound the controller sync queue
+      "queue_max": 0,                # >0: bound the controller sync queue
+      "lock_witness": false          # true: instrument every lock and
+                                     # assert acquisition-order acyclicity
+                                     # at teardown (docs/static-analysis.md)
     }
 
 Omitted sections disable that feature (``faults: {}`` == fault-free run).
@@ -139,6 +142,7 @@ def normalize_scenario(raw: dict) -> dict:
         "invariant_every_events": int(raw.get("invariant_every_events", 1)),
         "assume_ttl_s": float(raw.get("assume_ttl_s", 0.0)),
         "queue_max": int(raw.get("queue_max", 0)),
+        "lock_witness": bool(raw.get("lock_witness", False)),
     }
 
 

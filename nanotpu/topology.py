@@ -124,6 +124,8 @@ class Torus:
         if not chips:
             return True
         seen = {next(iter(chips))}
+        # nanolint: ignore[sim-determinism]: BFS seed/visit order cannot
+        # change the connectivity verdict (the result is a set equality)
         frontier = list(seen)
         while frontier:
             c = frontier.pop()
@@ -189,6 +191,9 @@ class Torus:
             }
             if not frontier:
                 return None
+            # nanolint: ignore[sim-determinism]: the key is fully
+            # discriminating (-n tiebreak), so max() over the set picks
+            # the same chip regardless of iteration order
             pick = max(
                 frontier,
                 key=lambda n: (

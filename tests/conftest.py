@@ -26,12 +26,31 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Runtime lock-order witness (nanotpu/analysis/witness.py): every lock
+# built through the witness factories during the test run records the
+# global acquisition-order graph; pytest_sessionfinish asserts acyclicity,
+# so a latent lock inversion exercised by ANY test fails the whole run
+# with witness stacks. Set before any nanotpu import so module-level and
+# constructor-time locks are instrumented too. Opt out with
+# NANOTPU_LOCK_WITNESS=0 (setdefault respects an explicit value).
+os.environ.setdefault("NANOTPU_LOCK_WITNESS", "1")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import faulthandler
 import signal
 
 import pytest
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Teardown half of the lock-order witness: the whole suite is one
+    big concurrency exercise, and any ordering cycle it witnessed —
+    even one that never happened to deadlock — fails the session."""
+    from nanotpu.analysis.witness import active, global_witness
+
+    if active():
+        global_witness().assert_acyclic()
 
 
 @pytest.fixture

@@ -148,3 +148,24 @@ def test_distinct_messages_get_distinct_objects():
     assert rec.flush()
     names = [e["metadata"]["name"] for e in client.events]
     assert len(client.events) == 2 and len(set(names)) == 2
+
+
+def test_injected_clock_pins_event_timestamps():
+    """Regression pin for the nanolint sim-determinism fix: ``event()``
+    draws its timestamp from the injectable ``clock`` (default wall
+    time), so a harness that pins the clock gets byte-reproducible Event
+    bodies — and ambient ``time.time()`` can never sneak back onto the
+    emission path (tests/test_analysis.py's clean-tree pin enforces the
+    static half)."""
+    client = _cluster()
+    rec = EventRecorder(client, resilience=None, clock=lambda: 1_700_000_000.0)
+    pod = _pod(client)
+    rec.event(pod, "Normal", REASON_ASSIGNED, "pinned")
+    assert rec.flush()
+    ev = [e for e in client.events if e["message"] == "pinned"]
+    assert len(ev) == 1
+    # 1_700_000_000 epoch == 2023-11-14T22:13:20Z, exactly
+    assert ev[0]["firstTimestamp"] == "2023-11-14T22:13:20Z"
+    assert ev[0]["lastTimestamp"] == "2023-11-14T22:13:20Z"
+    # the event NAME embeds the pinned milliseconds too (hex)
+    assert format(1_700_000_000_000, "x") in ev[0]["metadata"]["name"]

@@ -235,10 +235,16 @@ class TestStrictGangBarrier:
             threads += t
             results.update(r)
             # wait for this member's reservation to land before the next
-            # member's filter (its bind thread reserves, then parks)
+            # member's filter (its bind thread reserves, then parks).
+            # Poll the dealer's reservation registry, NOT occupancy():
+            # occupancy reads live NodeInfos and rises at info.bind, a
+            # moment BEFORE _reserve publishes the snapshot that the next
+            # Filter reads — polling it can release this loop inside that
+            # window and steer two members onto the same chips. The
+            # registry entry is written strictly after the publish.
             deadline = time.time() + 5
             while (
-                dealer.occupancy() < (i + 1) * 2 / 64 - 1e-9
+                len(dealer.debug_snapshot()["reserved_uids"]) < i + 1
                 and not results
                 and time.time() < deadline
             ):

@@ -499,6 +499,29 @@ class TestChaosScenario:
         b = run_scenario(scenario, seed=0)
         assert render(strip_timing(a)) == render(strip_timing(b))
 
+    def test_lock_witness_observes_and_stays_acyclic(self):
+        """chaos.json enables the runtime lock-order witness
+        (``lock_witness: true``): the run must instrument the real
+        dealer/controller locks (edges observed > 0), assert acyclicity
+        at teardown (run() raises LockOrderError otherwise — none
+        expected), and leave the digest byte-identical to a run's twin,
+        because the witness adds nothing to the report."""
+        from nanotpu.analysis.witness import global_witness
+
+        scenario = self._scenario(horizon=8.0)
+        assert scenario["lock_witness"] is True  # the knob shipped armed
+        sim = Simulator(scenario, seed=0)
+        a = sim.run()
+        # real ordering edges were witnessed (e.g. publish -> dealer map
+        # capture inside _republish), and the global graph stayed acyclic
+        assert sim.lock_witness_edges > 0
+        assert any(
+            "Dealer._publish_lock" in e for edge in
+            global_witness().edges() for e in edge
+        )
+        b = Simulator(scenario, seed=0).run()
+        assert render(strip_timing(a)) == render(strip_timing(b))
+
     def test_overload_toggle_does_not_shift_base_arrivals(self):
         """The isolation rule that makes fault bisection possible: turning
         the overload fault off must remove ONLY the burst arrivals (their
