@@ -3,13 +3,13 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench sim-smoke chaos-soak image clean
+.PHONY: all native lint test test-fast bench sim-smoke chaos-soak obs-check image clean
 
 # Default verification tier: static analysis, then the fast inner loop
-# (test-fast includes sim-smoke), then the overload-resilience soak. The
-# tier-1 gate (`pytest tests/ -m 'not slow'` over everything) is
-# unchanged — run it via `make test` / CI.
-all: native lint test-fast chaos-soak
+# (test-fast includes sim-smoke), then the observability gate, then the
+# overload-resilience soak. The tier-1 gate (`pytest tests/ -m 'not
+# slow'` over everything) is unchanged — run it via `make test` / CI.
+all: native lint test-fast obs-check chaos-soak
 
 # nanolint (docs/static-analysis.md): AST invariant passes over the
 # scheduler's concurrency & determinism contracts — lock discipline,
@@ -41,6 +41,15 @@ bench: native
 sim-smoke:
 	python -m nanotpu.sim --scenario examples/sim/smoke.json --seed 0 \
 		--check-determinism
+
+# Observability gate (docs/observability.md): golden-file schema test for
+# the /debug JSON endpoints + tracer/ledger/exposition tests, then a sim
+# smoke run on a short horizon asserting the report — including its
+# `traces` digest — is byte-reproducible across two runs.
+obs-check:
+	python -m pytest tests/test_obs.py tests/test_promtext.py -q
+	python -m nanotpu.sim --scenario examples/sim/smoke.json --seed 0 \
+		--horizon-s 12 --check-determinism > /dev/null
 
 # Overload-resilience gate (docs/robustness.md): smoke's faults + arrival
 # bursts + API brownouts through the resilient write path, bounded sync

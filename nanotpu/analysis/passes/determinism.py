@@ -50,6 +50,7 @@ SCOPE = (
     "nanotpu.k8s.objects", "nanotpu.k8s.client", "nanotpu.k8s.resilience",
     "nanotpu.k8s.events",
     "nanotpu.metrics.resilience", "nanotpu.metrics.stats",
+    "nanotpu.obs",
     "nanotpu.utils", "nanotpu.topology", "nanotpu.types",
     "nanotpu.native",
 )

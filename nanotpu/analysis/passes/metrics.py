@@ -3,7 +3,7 @@
 The robustness layer's promise (docs/robustness.md) is that every
 deliberate degradation — shed request, coalesced sync, expired
 annotation, fast-failed write — is attributable on ``/metrics``. That
-promise has two string-ly typed seams this pass stitches shut:
+promise has three string-ly typed seams this pass stitches shut:
 
 * **ResilienceCounters** fields are declared in the ``_SCALARS`` /
   ``_LABELED`` tables of ``nanotpu/metrics/resilience.py`` (which the
@@ -17,6 +17,19 @@ promise has two string-ly typed seams this pass stitches shut:
 * **PerfCounters** slots are auto-exported by the route layer's
   ``perf.__slots__`` loop, so registration is structural — but a slot
   with no ``+=`` site anywhere is again a lying zero on ``/metrics``.
+
+* **Decision-audit reason codes** (``REASON_*`` in
+  ``nanotpu/obs/decisions.py``, docs/observability.md): a code recorded
+  somewhere but not declared in the enum would ship an uncatalogued
+  string nobody can look up; a declared code no call site ever records
+  is a catalogue entry that reads as "this can happen" when nothing
+  produces it. Both directions are findings, plus every constant must
+  appear in the ``REASONS`` description catalogue (and vice versa) so
+  the operator-facing table can never drift from the enum. Use sites
+  are any load of a ``REASON_*`` name imported from the declaring
+  module (or referenced through a ``decisions.`` attribute) — keyword
+  ``record(reason=...)`` arguments, mapping-table values, and
+  ``BindError(..., reason=...)`` constructors all count.
 
 Registry-built metrics (``registry.counter(...)`` etc.) register at
 construction by design and need no check here.
@@ -57,6 +70,73 @@ def _declared_resilience(mod: Module) -> dict[str, int] | None:
     return out if found else None
 
 
+def _declared_reasons(mod: Module) -> tuple[dict[str, int], set[str]] | None:
+    """(REASON_* constant -> declaration line, REASONS catalogue keys)
+    for the module declaring the decision-audit enum; None when this
+    module declares no ``REASONS`` catalogue."""
+    constants: dict[str, int] = {}
+    catalogue: set[str] = set()
+    found = False
+    for node in mod.tree.body:
+        # the real catalogue is an ANNOTATED assignment
+        # (``REASONS: dict[str, str] = {...}``) — ast.AnnAssign, not
+        # ast.Assign; matching only the latter silently no-ops the
+        # whole check on the production enum
+        if isinstance(node, ast.AnnAssign):
+            if node.value is None or not isinstance(node.target, ast.Name):
+                continue
+            targets = [node.target.id]
+            value = node.value
+        elif isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        else:
+            continue
+        if any(t == "REASONS" for t in targets):
+            found = True
+            if isinstance(value, ast.Dict):
+                for key in value.keys:
+                    if isinstance(key, ast.Name):
+                        catalogue.add(key.id)
+        for t in targets:
+            if t.startswith("REASON_") and isinstance(
+                value, ast.Constant
+            ) and isinstance(value.value, str):
+                constants[t] = node.lineno
+    return (constants, catalogue) if found else None
+
+
+def _reason_uses(mod: Module) -> dict[str, tuple[str, int]]:
+    """REASON_* name -> first use site in ``mod``: loads of names
+    imported from the decisions module, and ``decisions.REASON_*``
+    attribute references."""
+    imported: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            # only imports FROM the decisions module count: other modules
+            # legitimately export REASON_* strings of their own (e.g.
+            # k8s/events' kubectl event reasons) and must not be held to
+            # the decision-audit enum
+            module = node.module or ""
+            if module.rsplit(".", 1)[-1] != "decisions":
+                continue
+            for alias in node.names:
+                if alias.name.startswith("REASON_"):
+                    imported.add(alias.asname or alias.name)
+    uses: dict[str, tuple[str, int]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in imported:
+            uses.setdefault(node.id, (str(mod.path), node.lineno))
+        elif isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, ast.Load
+        ) and node.attr.startswith("REASON_"):
+            base = dotted(node.value)
+            if base is not None and base.split(".")[-1] == "decisions":
+                uses.setdefault(node.attr, (str(mod.path), node.lineno))
+    return uses
+
+
 def _declared_slots(mod: Module, cls_name: str) -> dict[str, int] | None:
     for node in mod.tree.body:
         if not isinstance(node, ast.ClassDef) or node.name != cls_name:
@@ -85,6 +165,9 @@ class _MetricsPass:
         decl_mod: Module | None = None
         slots: dict[str, int] | None = None
         slots_mod: Module | None = None
+        reasons: dict[str, int] | None = None
+        catalogue: set[str] = set()
+        reasons_mod: Module | None = None
         for mod in modules:
             d = _declared_resilience(mod)
             if d is not None:
@@ -92,6 +175,9 @@ class _MetricsPass:
             s = _declared_slots(mod, "PerfCounters")
             if s is not None:
                 slots, slots_mod = s, mod
+            r = _declared_reasons(mod)
+            if r is not None:
+                (reasons, catalogue), reasons_mod = r, mod
 
         inc_sites: dict[str, tuple[str, int]] = {}
         perf_incs: dict[str, tuple[str, int]] = {}
@@ -156,6 +242,53 @@ class _MetricsPass:
                         "is not a PerfCounters slot — it is never "
                         "exported (and will AttributeError at runtime)",
                     ))
+        if reasons is not None and reasons_mod is not None:
+            findings.extend(self._check_reasons(
+                modules, reasons, catalogue, reasons_mod
+            ))
+        return findings
+
+    def _check_reasons(self, modules: list[Module],
+                       reasons: dict[str, int], catalogue: set[str],
+                       reasons_mod: Module) -> list[Finding]:
+        """Decision-audit reason-code enum vs use sites, both directions,
+        plus enum <-> REASONS catalogue equivalence."""
+        findings: list[Finding] = []
+        uses: dict[str, tuple[str, int]] = {}
+        for mod in modules:
+            if mod is reasons_mod:
+                continue
+            for name, site in _reason_uses(mod).items():
+                uses.setdefault(name, site)
+                if name not in reasons:
+                    findings.append(Finding(
+                        self.name, site[0], site[1],
+                        f"reason code {name!r} is recorded here but not "
+                        "declared in the decision-audit enum — the audit "
+                        "would ship an uncatalogued code no operator can "
+                        "look up",
+                    ))
+        for name, line in sorted(reasons.items()):
+            if name not in uses:
+                findings.append(Finding(
+                    self.name, str(reasons_mod.path), line,
+                    f"reason code {name!r} is declared but no call site "
+                    "ever records it — a catalogue entry nothing "
+                    "produces reads as 'this can happen'",
+                ))
+            if name not in catalogue:
+                findings.append(Finding(
+                    self.name, str(reasons_mod.path), line,
+                    f"reason code {name!r} is missing from the REASONS "
+                    "description catalogue — operators cannot look up "
+                    "what it means",
+                ))
+        for name in sorted(catalogue - set(reasons)):
+            findings.append(Finding(
+                self.name, str(reasons_mod.path), 0,
+                f"REASONS catalogue references {name!r} which is not a "
+                "declared reason constant",
+            ))
         return findings
 
 

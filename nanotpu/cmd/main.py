@@ -27,6 +27,8 @@ from nanotpu.k8s.events import EventRecorder
 from nanotpu.k8s.resilience import ResilientClientset
 from nanotpu.metrics.registry import Registry
 from nanotpu.metrics.resilience import ResilienceCounters
+from nanotpu.obs import Observability
+from nanotpu.obs.logfmt import JsonLogFormatter
 from nanotpu.routes.server import OverloadConfig, SchedulerAPI, serve
 
 log = logging.getLogger("nanotpu.main")
@@ -87,6 +89,22 @@ def build_app(argv: list[str] | None = None):
         help="expire assumed-but-never-bound placement annotations after "
         "this long (0 disables the sweeper)",
     )
+    parser.add_argument(
+        "--trace-sample", type=int, default=0, metavar="N",
+        help="request tracing + decision audit: 0 off (zero overhead on "
+        "the fused fast path), 1 every request, N one request in N; "
+        "sampled requests are served via GET /debug/traces/<pod-uid> "
+        "and GET /debug/decisions (docs/observability.md)",
+    )
+    parser.add_argument(
+        "--trace-capacity", type=int, default=1024, metavar="N",
+        help="completed traces retained in the debug ring (oldest evicted)",
+    )
+    parser.add_argument(
+        "--log-json", action="store_true",
+        help="one JSON object per log line, stamped with the active "
+        "request's pod UID / trace id so logs join traces on one key",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -94,6 +112,9 @@ def build_app(argv: list[str] | None = None):
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    if args.log_json:
+        for handler in logging.getLogger().handlers:
+            handler.setFormatter(JsonLogFormatter())
 
     if args.mock:
         client = make_mock_cluster(args.mock)
@@ -109,7 +130,14 @@ def build_app(argv: list[str] | None = None):
     client = ResilientClientset(client, counters=resilience)
     rater = make_rater(args.priority)
     recorder = EventRecorder(client, resilience=resilience)
-    dealer = Dealer(client, rater, recorder=recorder)
+    # one observability bundle shared by server, dealer, and controller:
+    # traces, the decision audit, and the bind/gang histograms all join
+    # on it (docs/observability.md)
+    obs = Observability(
+        sample=args.trace_sample, trace_capacity=args.trace_capacity,
+        decision_capacity=args.trace_capacity,
+    )
+    dealer = Dealer(client, rater, recorder=recorder, obs=obs)
     registry = Registry()
     api = SchedulerAPI(
         dealer, registry,
@@ -117,6 +145,7 @@ def build_app(argv: list[str] | None = None):
             http_timeout_s=args.http_timeout, max_inflight=args.max_inflight
         ),
         resilience=resilience,
+        obs=obs,
     )
     return args, client, dealer, api
 
@@ -129,6 +158,7 @@ def main(argv: list[str] | None = None) -> int:
     controller = Controller(
         client, dealer, resync_period_s=args.sync_period,
         assume_ttl_s=args.assume_ttl, resilience=api.resilience,
+        obs=api.obs,
     )
     controller.start()
     # /readyz (deploy readinessProbe): serve traffic only once boot-time

@@ -39,6 +39,7 @@ from nanotpu.analysis.witness import make_condition, make_lock
 from nanotpu.dealer import Dealer
 from nanotpu.k8s.client import ApiError, Clientset, ConflictError, NotFoundError
 from nanotpu.k8s.objects import Pod
+from nanotpu.obs.decisions import REASON_ASSUME_EXPIRED
 from nanotpu.utils import pod as podutil
 
 log = logging.getLogger("nanotpu.controller")
@@ -148,9 +149,14 @@ class Controller:
         queue_max: int = QUEUE_MAX_DEFAULT,
         assume_ttl_s: float = ASSUME_TTL_DEFAULT_S,
         resilience=None,
+        obs=None,
     ):
         self.client = client
         self.dealer = dealer
+        #: optional Observability bundle: the sweeper audits every expiry
+        #: into the decision ledger so a pod whose annotations vanished
+        #: has a causal record, not just a counter bump
+        self.obs = obs
         self.workers = workers
         #: periodic full re-list (informer resync analogue, cmd/main.go:31);
         #: safety net for events lost across watch reconnects. <=0 disables.
@@ -418,6 +424,18 @@ class Controller:
             "expired stale placement annotations on %s (assumed but never "
             "bound within %gs)", pod.key(), ttl,
         )
+        if self.obs is not None and self.obs.tracer.sampled(pod.uid):
+            # close the pod's audit trail (final=True: the expiry is a
+            # terminal verdict — without it the cycle would sit in the
+            # building map reading as "still in flight" and never reach
+            # /debug/decisions). Gated on the pod's sticky sampling
+            # verdict, not just enabled: under 1-in-N a mass-expiry event
+            # recording 100% of pods would evict the sampled pods'
+            # complete cycles from the bounded ring.
+            self.obs.ledger.bind_outcome(
+                pod.uid, pod.node_name or "", REASON_ASSUME_EXPIRED,
+                False, pod=pod.key(), final=True,
+            )
         if self.dealer.tracks(pod.uid):
             # defensive: accounting for an unbound pod is exactly the leak
             # the sweeper exists to stop — roll the chips back

@@ -40,6 +40,18 @@ from nanotpu.k8s import events
 from nanotpu.k8s.client import ApiError, Clientset, ConflictError, NotFoundError
 from nanotpu.k8s.events import EventRecorder
 from nanotpu.k8s.objects import Node, Pod
+from nanotpu.k8s.resilience import BreakerOpenError
+from nanotpu.obs.decisions import (
+    REASON_ALREADY_BOUND,
+    REASON_API_ERROR,
+    REASON_BIND_FAILED,
+    REASON_BREAKER_OPEN,
+    REASON_GANG_TIMEOUT,
+    REASON_INSUFFICIENT_CHIPS,
+    REASON_NODE_CHANGED,
+    REASON_NOT_TPU_NODE,
+    REASON_POD_RELEASED,
+)
 from nanotpu.utils import node as nodeutil
 from nanotpu.utils import pod as podutil
 from nanotpu.utils.deadline import Deadline, check as deadline_check
@@ -62,7 +74,14 @@ RELEASED_TOMBSTONES_MAX = 100_000
 
 
 class BindError(Exception):
-    """Bind failed; chip accounting has been rolled back."""
+    """Bind failed; chip accounting has been rolled back. ``reason`` is
+    the typed audit code (nanotpu.obs.decisions) the decision ledger
+    records, so "why did this bind fail" is an enum, not a regex over
+    the message."""
+
+    def __init__(self, message: str, reason: str = REASON_BIND_FAILED):
+        super().__init__(message)
+        self.reason = reason
 
 
 class _Snapshot:
@@ -154,10 +173,15 @@ class Dealer:
         usage: UsageStore | None = None,
         assume_workers: int = 8,
         recorder: EventRecorder | None = None,
+        obs=None,
     ):
         self.client = client
         self.rater = rater
         self.usage = usage or UsageStore()
+        #: optional Observability bundle (nanotpu.obs): bind-commit and
+        #: gang-wait histograms observe through it; None costs nothing
+        #: (SchedulerAPI attaches its own bundle when the dealer has none)
+        self.obs = obs
         # K8s Events on bind outcomes — the reference built a recorder and
         # never emitted (controller.go:78-81, SURVEY §5); here `kubectl
         # describe pod` shows the placement decision
@@ -682,15 +706,22 @@ class Dealer:
 
     def assume(
         self, node_names: list[str], pod: Pod,
-        deadline: Deadline | None = None,
+        deadline: Deadline | None = None, trace=None,
     ) -> tuple[list[str], dict[str, str]]:
         """Partition candidate nodes into (schedulable, {node: reason}).
 
         ``deadline`` (threaded from the route layer's response budget)
         aborts an over-budget request at entry — before any per-node
         locks or apiserver warming GETs — with DeadlineExceeded; the
-        route layer answers 503 and kube-scheduler's retry carries on."""
+        route layer answers 503 and kube-scheduler's retry carries on.
+        ``trace`` (same threading) records which read path served the
+        request — snapshot batch vs warming per-node fan-out."""
         deadline_check(deadline, "filter:start")
+        if trace is not None:
+            trace.event(
+                "snapshot:read",
+                f"gen={self._published.gen} candidates={len(node_names)}",
+            )
         demand = self._demand_of(pod)
         if not demand.is_valid():
             return [], {
@@ -702,6 +733,8 @@ class Dealer:
         batch = self._batch_plan(node_names)
         if batch is not None:
             scorer, names_key, non_tpu, prefer = batch
+            if trace is not None:
+                trace.event("native:batch-score", f"rows={len(names_key)}")
             # pass the gang context even though Filter ignores scores: the
             # native result is memoized, so the immediately following
             # Prioritize (same pod, same state) reuses this exact call
@@ -741,6 +774,8 @@ class Dealer:
         # budget so a request that already burned it parsing/queueing does
         # not start a fan-out nobody will read
         deadline_check(deadline, "filter:warm")
+        if trace is not None:
+            trace.event("filter:per-node", f"cold={cold}")
         if cold <= ASSUME_COLD_POOL_THRESHOLD:
             results = [try_node(n) for n in node_names]
         else:
@@ -782,8 +817,14 @@ class Dealer:
 
     # -- Score (Prioritize verb): dealer.go:138-153 ------------------------
     def score(self, node_names: list[str], pod: Pod,
-              deadline: Deadline | None = None) -> list[tuple[str, int]]:
+              deadline: Deadline | None = None,
+              trace=None) -> list[tuple[str, int]]:
         deadline_check(deadline, "priorities:start")
+        if trace is not None:
+            trace.event(
+                "snapshot:read",
+                f"gen={self._published.gen} candidates={len(node_names)}",
+            )
         demand = self._demand_of(pod)
         if not demand.is_valid():
             return [(n, types.SCORE_MIN) for n in node_names]
@@ -792,6 +833,8 @@ class Dealer:
         batch = self._batch_plan(node_names)
         if batch is not None:
             bscorer, names_key, _non_tpu, prefer = batch
+            if trace is not None:
+                trace.event("native:batch-score", f"rows={len(names_key)}")
             _, scores = bscorer.run(demand, prefer, member_slices or None)
             if len(names_key) == len(node_names) and list(names_key) == node_names:
                 # all candidates are known TPU nodes (the common case):
@@ -822,7 +865,7 @@ class Dealer:
 
     # -- Bind verb: dealer.go:155-203 --------------------------------------
     def bind(self, node_name: str, pod: Pod,
-             deadline: Deadline | None = None) -> Pod:
+             deadline: Deadline | None = None, trace=None) -> Pod:
         """Apply the plan, write annotations (optimistic retry), post the
         binding. Raises BindError with accounting rolled back on failure.
         Emits a K8s Event either way (TPUAssigned / FailedBinding).
@@ -830,10 +873,12 @@ class Dealer:
         The deadline is only probed HERE, before any reservation exists:
         once chips are reserved the bind runs to completion regardless —
         committing is idempotent-retry-safe (the _bind_outer uid guard),
-        abandoning a half-written annotation is not."""
+        abandoning a half-written annotation is not. ``trace`` rides the
+        same threading and records reservation / commit / gang-park
+        events."""
         deadline_check(deadline, "bind:start")
         try:
-            return self._bind_outer(node_name, pod)
+            return self._bind_outer(node_name, pod, trace)
         finally:
             # one publish covers commit AND rollback: either way the chip
             # state that read verbs consume may have moved — and only on
@@ -841,7 +886,7 @@ class Dealer:
             # it, making this a cheap no-op)
             self._republish((node_name,))
 
-    def _bind_outer(self, node_name: str, pod: Pod) -> Pod:
+    def _bind_outer(self, node_name: str, pod: Pod, trace=None) -> Pod:
         try:
             # idempotent-retry guard: the scheduler can re-issue a bind it
             # abandoned (its extender httpTimeout elapsed) that committed
@@ -859,13 +904,14 @@ class Dealer:
                     return existing
                 raise BindError(
                     f"pod {pod.key()} is already "
-                    + (f"bound to {prev}" if prev else "mid-bind")
+                    + (f"bound to {prev}" if prev else "mid-bind"),
+                    reason=REASON_ALREADY_BOUND,
                 )
             gang = podutil.gang_of(pod)
             if gang and gang[1] > 1 and podutil.gang_is_strict(pod):
-                bound = self._bind_strict(node_name, pod, gang)
+                bound = self._bind_strict(node_name, pod, gang, trace)
             else:
-                bound = self._bind(node_name, pod)
+                bound = self._bind(node_name, pod, trace)
         except BindError as e:
             self.recorder.event(
                 pod, "Warning", events.REASON_FAILED_BINDING, str(e)
@@ -881,22 +927,28 @@ class Dealer:
         )
         return bound
 
-    def _bind(self, node_name: str, pod: Pod) -> Pod:
-        info, plan = self._reserve(node_name, pod)
-        return self._commit_reserved(info, plan, node_name, pod)
+    def _bind(self, node_name: str, pod: Pod, trace=None) -> Pod:
+        info, plan = self._reserve(node_name, pod, trace)
+        return self._commit_reserved(info, plan, node_name, pod, trace)
 
-    def _reserve(self, node_name: str, pod: Pod):
+    def _reserve(self, node_name: str, pod: Pod, trace=None):
         """Apply the pod's chip reservation on the node (no API writes).
         Returns (NodeInfo, Plan); raises BindError when infeasible."""
         info = self._node_info(node_name)
         if info is None:
-            raise BindError(f"node {node_name} is not a known TPU node")
+            raise BindError(
+                f"node {node_name} is not a known TPU node",
+                reason=REASON_NOT_TPU_NODE,
+            )
         demand = self._demand_of(pod)
         plan = info.bind(demand, self.rater)
         if plan is None:
             raise BindError(
-                f"no feasible plan for pod {pod.key()} on node {node_name}"
+                f"no feasible plan for pod {pod.key()} on node {node_name}",
+                reason=REASON_INSUFFICIENT_CHIPS,
             )
+        if trace is not None:
+            trace.event("bind:reserved", node_name)
         # publish the reservation NOW, not at bind completion: the API
         # writes (and a strict gang's park window) can take seconds, and
         # concurrent Filters reading the old snapshot would keep steering
@@ -924,7 +976,7 @@ class Dealer:
                 barrier.cv.notify_all()
 
     def _bind_strict(self, node_name: str, pod: Pod,
-                     gang: tuple[str, int]) -> Pod:
+                     gang: tuple[str, int], trace=None) -> Pod:
         """All-or-nothing gang bind (tpu.io/gang-policy: strict): reserve,
         register the reservation (so node rebuilds migrate it), then park
         at the gang's barrier until ``barrier.size`` members hold
@@ -952,7 +1004,7 @@ class Dealer:
                     barrier.size = max(barrier.size, gang[1])
             barrier.users += 1
         try:
-            return self._park_and_commit(barrier, key, node_name, pod)
+            return self._park_and_commit(barrier, key, node_name, pod, trace)
         finally:
             with self._lock:
                 barrier.users -= 1
@@ -968,47 +1020,64 @@ class Dealer:
                     self._gang_barriers.pop(key, None)
 
     def _park_and_commit(self, barrier: GangBarrier, key: str,
-                         node_name: str, pod: Pod) -> Pod:
-        info, plan = self._reserve(node_name, pod)
+                         node_name: str, pod: Pod, trace=None) -> Pod:
+        info, plan = self._reserve(node_name, pod, trace)
         with barrier.cv:
             if pod.uid in barrier.parked:
                 info.unbind(plan)
                 raise BindError(
                     f"bind of {pod.key()} is already parked at gang {key}'s "
-                    "barrier"
+                    "barrier",
+                    reason=REASON_ALREADY_BOUND,
                 )
             barrier.parked.add(pod.uid)
         with self._lock:
             self._reserved[pod.uid] = _Reservation(node_name, info, plan, key)
+        if trace is not None:
+            trace.event("gang:parked", key)
         timeout = podutil.gang_timeout(pod)
         deadline = time.monotonic() + timeout
+        parked_t0 = time.monotonic()
         try:
-            with barrier.cv:
-                if not barrier.open and (
-                    self.gangs.bound_count(key) + len(barrier.parked)
-                    >= barrier.size
-                ):
-                    barrier.open = True
-                    barrier.cv.notify_all()
-                while not barrier.open:
-                    if pod.uid not in barrier.parked:
-                        # de-parked by _invalidate_reservation (node died
-                        # mid-park): fail now, not at the timeout — the
-                        # post-loop validity check raises the right error
-                        break
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        have = (
-                            self.gangs.bound_count(key) + len(barrier.parked)
-                        )
-                        raise BindError(
-                            f"gang {key} barrier timeout: {have} of "
-                            f"{barrier.size} members held reservations "
-                            f"within {timeout:g}s; reservation for "
-                            f"{pod.key()} rolled back"
-                        )
-                    barrier.cv.wait(remaining)
+            try:
+                with barrier.cv:
+                    if not barrier.open and (
+                        self.gangs.bound_count(key) + len(barrier.parked)
+                        >= barrier.size
+                    ):
+                        barrier.open = True
+                        barrier.cv.notify_all()
+                    while not barrier.open:
+                        if pod.uid not in barrier.parked:
+                            # de-parked by _invalidate_reservation (node
+                            # died mid-park): fail now, not at the
+                            # timeout — the post-loop validity check
+                            # raises the right error
+                            break
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            have = (
+                                self.gangs.bound_count(key)
+                                + len(barrier.parked)
+                            )
+                            raise BindError(
+                                f"gang {key} barrier timeout: {have} of "
+                                f"{barrier.size} members held reservations "
+                                f"within {timeout:g}s; reservation for "
+                                f"{pod.key()} rolled back",
+                                reason=REASON_GANG_TIMEOUT,
+                            )
+                        barrier.cv.wait(remaining)
+            finally:
+                # ONE observation point covering every exit from the
+                # park window — open, timeout, and unexpected raises
+                if self.obs is not None:
+                    self.obs.gang_wait.observe(
+                        time.monotonic() - parked_t0
+                    )
         except BindError:
+            if trace is not None:
+                trace.event("gang:timeout", key)
             with barrier.cv:
                 barrier.parked.discard(pod.uid)
             with self._lock:
@@ -1022,7 +1091,11 @@ class Dealer:
         with self._lock:
             res = self._reserved.pop(pod.uid, None)
         if res is not None and res.valid and opened:
-            return self._commit_reserved(res.info, res.plan, node_name, pod)
+            if trace is not None:
+                trace.event("gang:opened", key)
+            return self._commit_reserved(
+                res.info, res.plan, node_name, pod, trace
+            )
         if res is not None and res.valid:
             # de-parked without the barrier opening (defensive): roll back
             res.info.unbind(res.plan)
@@ -1031,13 +1104,31 @@ class Dealer:
         # live on an orphaned NodeInfo or were never re-applied
         raise BindError(
             f"node {node_name} changed while {pod.key()} awaited gang "
-            f"{key}'s barrier; reservation lost, bind must retry"
+            f"{key}'s barrier; reservation lost, bind must retry",
+            reason=REASON_NODE_CHANGED,
         )
 
     def _commit_reserved(self, info, plan: Plan, node_name: str,
-                         pod: Pod) -> Pod:
+                         pod: Pod, trace=None) -> Pod:
         """API writes + bookkeeping for an applied reservation (the second
-        half of a bind; rolls the reservation back on write failure)."""
+        half of a bind; rolls the reservation back on write failure).
+        The wall-clock duration lands in the ``nanotpu_bind_commit_
+        duration_seconds`` histogram when an Observability bundle is
+        attached — the cost of the two apiserver writes is the part of a
+        bind the dealer cannot control and the part worth a
+        distribution."""
+        if self.obs is not None:
+            commit_t0 = time.monotonic()
+            try:
+                return self._commit_reserved_inner(
+                    info, plan, node_name, pod, trace
+                )
+            finally:
+                self.obs.bind_commit.observe(time.monotonic() - commit_t0)
+        return self._commit_reserved_inner(info, plan, node_name, pod, trace)
+
+    def _commit_reserved_inner(self, info, plan: Plan, node_name: str,
+                               pod: Pod, trace=None) -> Pod:
         # register BEFORE the API writes: update_pod fires a MODIFIED event
         # (assume=true) that the reconciler races to allocate — the map entry
         # is what makes _learn_bound_pod a no-op for this pod
@@ -1046,6 +1137,8 @@ class Dealer:
             self._pods[pod.uid] = pod
             self._released.pop(pod.uid, None)
         try:
+            if trace is not None:
+                trace.event("bind:commit", f"annotate+bind {node_name}")
             annotated = self._write_annotations(pod, plan)
             self.client.bind_pod(annotated.namespace, annotated.name, node_name)
             # mirror what the binding subresource did server-side, so the
@@ -1058,7 +1151,14 @@ class Dealer:
                 self._pods.pop(pod.uid, None)
                 if was_released:  # restore the tombstone we popped
                     self._mark_released(pod.uid)
-            raise BindError(f"bind of {pod.key()} to {node_name} failed: {e}") from e
+            raise BindError(
+                f"bind of {pod.key()} to {node_name} failed: {e}",
+                reason=(
+                    REASON_BREAKER_OPEN
+                    if isinstance(e, BreakerOpenError)
+                    else REASON_API_ERROR
+                ),
+            ) from e
         with self._lock:
             # a release/forget may have raced us mid-bind (pod deleted while
             # the API writes were in flight): it popped our reservation and
@@ -1093,7 +1193,8 @@ class Dealer:
         if raced:
             info.unbind(plan)
             raise BindError(
-                f"pod {pod.key()} was released while bind was in flight"
+                f"pod {pod.key()} was released while bind was in flight",
+                reason=REASON_POD_RELEASED,
             )
         if needs_replay:
             self._learn_bound_pod(annotated)

@@ -45,12 +45,21 @@ from typing import Callable
 from nanotpu.analysis.witness import make_lock
 from nanotpu.k8s.client import ApiError, ConflictError, NotFoundError
 from nanotpu.metrics.resilience import ResilienceCounters
+from nanotpu.obs.trace import current as current_trace
 
 log = logging.getLogger("nanotpu.k8s.resilience")
 
 TARGET_BIND = "bind"
 TARGET_POD_WRITE = "pod_write"
 TARGET_EVENTS = "events"
+
+
+class BreakerOpenError(ApiError):
+    """A write fast-failed because its target's circuit breaker is open.
+
+    A distinct type (not a message) so upper layers can attribute the
+    failure with a typed reason code — the dealer maps it to the
+    decision ledger's ``breaker_open`` instead of a generic API error."""
 
 
 def _retryable(e: ApiError) -> bool:
@@ -199,10 +208,13 @@ class ResilientClientset:
         breaker = self.breakers[target]
         if not breaker.allow():
             self.counters.inc("breaker_fastfails", target)
+            trace = current_trace()
+            if trace is not None:
+                trace.event("api:breaker-fastfail", target)
             if fail_open:
                 self.counters.inc("events_failopen")
                 return None
-            raise ApiError(
+            raise BreakerOpenError(
                 f"{target} write fast-failed: circuit breaker open "
                 "(apiserver writes are failing; request not attempted)",
                 code=503,
@@ -228,6 +240,14 @@ class ResilientClientset:
                 )
                 if may_retry:
                     self.counters.inc("api_retries", target)
+                    trace = current_trace()
+                    if trace is not None:
+                        # the attempt number, never the jittered delay:
+                        # trace events must stay deterministic under the
+                        # sim's seeded rng (docs/observability.md)
+                        trace.event(
+                            "api:retry", f"{target} attempt={attempt + 1}"
+                        )
                     delay = min(
                         self.backoff_base_s * (2 ** attempt),
                         self.backoff_max_s,

@@ -18,6 +18,25 @@ _SAMPLE_RE = re.compile(
 )
 _LABEL_RE = re.compile(r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:\\.|[^"\\])*)"')
 
+_UNESCAPES = {"\\\\": "\\", '\\"': '"', "\\n": "\n"}
+
+
+def _unescape_label_value(raw: str) -> str:
+    """Inverse of the exposition format's label-value escaping (the
+    registry's ``_escape_label_value``): ``\\\\``, ``\\"``, ``\\n``.
+    Processed left-to-right so ``\\\\n`` stays a backslash + ``n``."""
+    out: list[str] = []
+    i = 0
+    while i < len(raw):
+        pair = raw[i:i + 2]
+        if pair in _UNESCAPES:
+            out.append(_UNESCAPES[pair])
+            i += 2
+        else:
+            out.append(raw[i])
+            i += 1
+    return "".join(out)
+
 
 @dataclass(frozen=True)
 class Sample:
@@ -49,7 +68,7 @@ def parse_prometheus_text(text: str) -> list[Sample]:
         labels = {}
         if m.group("labels"):
             labels = {
-                lm.group("k"): lm.group("v").replace('\\"', '"')
+                lm.group("k"): _unescape_label_value(lm.group("v"))
                 for lm in _LABEL_RE.finditer(m.group("labels"))
             }
         out.append(Sample(m.group("name"), labels, value))

@@ -1,0 +1,100 @@
+"""nanotpu.obs: tracing, decision audit, and latency distributions.
+
+The observability layer the reference never had (SURVEY §5): a sampled
+per-request :class:`~nanotpu.obs.trace.Trace` threaded through the verb
+path, a :class:`~nanotpu.obs.decisions.DecisionLedger` that makes every
+placement explainable by typed reason code, and the fixed-bucket
+latency histograms (bind-commit, gang-wait; the per-verb duration
+histogram lives in the route layer's registry). One
+:class:`Observability` bundle is shared by server, dealer, controller,
+and sim — see docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+
+from nanotpu.metrics.registry import Histogram
+from nanotpu.obs.decisions import REASONS, DecisionLedger
+from nanotpu.obs.trace import Trace, Tracer, current, set_current
+
+__all__ = [
+    "Observability", "Tracer", "Trace", "DecisionLedger", "REASONS",
+    "current", "set_current",
+]
+
+#: bind-commit buckets: two apiserver writes, sub-ms (mock) to brownout
+#: retry territory
+COMMIT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+#: gang-wait buckets: a strict member parks up to the gang timeout
+GANG_WAIT_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+class Observability:
+    """The process-wide observability bundle (see module docstring).
+
+    ``sample`` follows the tracer's convention (0 off, 1 all, N 1-in-N)
+    and gates BOTH the tracer and the decision ledger: an unsampled
+    request records nothing anywhere. The histograms are always live —
+    they are aggregate exposition, not per-request state — and cost
+    nothing until something observes into them. ``clock`` is injectable
+    (the sim passes virtual time) and feeds traces and decision records;
+    histogram observations always measure real elapsed time and never
+    enter the deterministic sim report."""
+
+    def __init__(self, sample: int = 0, trace_capacity: int = 256,
+                 decision_capacity: int = 512, clock=time.monotonic):
+        self.tracer = Tracer(
+            sample=sample, capacity=trace_capacity, clock=clock
+        )
+        self.ledger = DecisionLedger(capacity=decision_capacity, clock=clock)
+        self.bind_commit = Histogram(
+            "nanotpu_bind_commit_duration_seconds",
+            "Duration of the bind commit half (annotation PUT + binding "
+            "POST + bookkeeping) once a chip reservation is held",
+            buckets=COMMIT_BUCKETS,
+        )
+        self.gang_wait = Histogram(
+            "nanotpu_gang_wait_seconds",
+            "Time a strict-gang bind parked at its barrier before it "
+            "opened, timed out, or was invalidated",
+            buckets=GANG_WAIT_BUCKETS,
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.tracer.enabled
+
+    def register_with(self, registry) -> None:
+        """Adopt the bundle's histograms into a metrics registry
+        (they render Prometheus text like any registry-built metric)."""
+        registry.register(self.bind_commit)
+        registry.register(self.gang_wait)
+
+    def digest_summary(self) -> dict:
+        """Deterministic summary of everything retained: counts plus a
+        sha256 over the canonical serialization of all traces and
+        decision records. With the sim's virtual clock this is
+        byte-reproducible across runs — the report's ``traces``
+        section."""
+        traces = self.tracer.dump()
+        decisions = self.ledger.dump()
+        blob = json.dumps(
+            {"traces": traces, "decisions": decisions},
+            sort_keys=True, separators=(",", ":"),
+        ).encode()
+        return {
+            "enabled": self.enabled,
+            "traces": len(traces),
+            "decisions": len(decisions),
+            "trace_events": sum(len(t["events"]) for t in traces),
+            "digest": "sha256:" + hashlib.sha256(blob).hexdigest(),
+        }

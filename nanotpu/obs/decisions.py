@@ -1,0 +1,254 @@
+"""The decision audit ledger: every placement explainable after the fact.
+
+The extender answers Filter/Prioritize/Bind and historically left behind
+only an annotation — "why did this pod land on that card" and "why was
+node X rejected" were unanswerable. The ledger captures, per scheduling
+cycle (one pod attempt), the per-node filter verdict as a TYPED reason
+code, the per-candidate score breakdown, and every bind attempt with its
+outcome; completed cycles land in a bounded ring served by
+``GET /debug/decisions`` and joined with traces by pod UID.
+
+Reason codes are the enum below. The nanolint metrics-completeness pass
+cross-checks it against use sites BOTH directions (a code recorded
+somewhere but not declared here, or declared here but recorded nowhere,
+is a lint finding) — the same honesty contract the resilience counters
+live under. Every ``REASON_*`` constant must also appear in the
+:data:`REASONS` catalogue with its operator-facing description.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+
+from nanotpu.analysis.witness import make_lock
+
+# -- the typed reason-code enum (docs/observability.md catalogue) ----------
+REASON_OK = "ok"
+REASON_NOT_TPU_NODE = "not_tpu_node"
+REASON_INSUFFICIENT_CHIPS = "insufficient_chips"
+REASON_INVALID_DEMAND = "invalid_demand"
+REASON_GANG_TIMEOUT = "gang_timeout"
+REASON_NODE_CHANGED = "node_changed"
+REASON_ALREADY_BOUND = "already_bound"
+REASON_POD_RELEASED = "pod_released"
+REASON_POD_NOT_FOUND = "pod_not_found"
+REASON_POD_COMPLETED = "pod_completed"
+REASON_BIND_FAILED = "bind_failed"
+REASON_API_ERROR = "api_error"
+REASON_BREAKER_OPEN = "breaker_open"
+REASON_DEADLINE_SHED = "deadline_shed"
+REASON_ADMISSION_SHED = "admission_shed"
+REASON_ASSUME_EXPIRED = "assume_expired"
+
+#: code -> operator-facing description. Keys must be exactly the
+#: ``REASON_*`` constants above (nanolint pins the equivalence).
+REASONS: dict[str, str] = {
+    REASON_OK: "candidate accepted / bind committed",
+    REASON_NOT_TPU_NODE: "candidate advertises no TPU capacity",
+    REASON_INSUFFICIENT_CHIPS:
+        "no feasible chip plan for the demand on this node",
+    REASON_INVALID_DEMAND:
+        "pod demand malformed (multi-chip requests must be whole chips)",
+    REASON_GANG_TIMEOUT:
+        "strict gang barrier timed out before all members reserved",
+    REASON_NODE_CHANGED:
+        "node rebuilt/removed while the bind was parked; reservation lost",
+    REASON_ALREADY_BOUND: "pod already bound or mid-bind (idempotency guard)",
+    REASON_POD_RELEASED: "pod released/deleted while the bind was in flight",
+    REASON_POD_NOT_FOUND: "pod vanished from the apiserver before bind",
+    REASON_POD_COMPLETED: "pod already completed; binding it is meaningless",
+    REASON_BIND_FAILED: "bind failed for an unclassified reason",
+    REASON_API_ERROR: "apiserver write failed after retries; bind rolled back",
+    REASON_BREAKER_OPEN:
+        "write fast-failed: the target's circuit breaker is open",
+    REASON_DEADLINE_SHED:
+        "request aborted past its response budget (structured 503)",
+    REASON_ADMISSION_SHED:
+        "request shed by the admission gate (429 + Retry-After)",
+    REASON_ASSUME_EXPIRED:
+        "assumed-but-never-bound annotations expired by the TTL sweeper",
+}
+
+
+class _Cycle:
+    """One pod scheduling cycle under construction (see ledger)."""
+
+    __slots__ = ("uid", "pod", "seq", "t", "policy", "verdicts", "scores",
+                 "binds", "outcome")
+
+    def __init__(self, uid: str, pod: str, seq: int, t: float):
+        self.uid = uid
+        self.pod = pod
+        self.seq = seq
+        self.t = t
+        self.policy = ""
+        self.verdicts: dict[str, str] = {}
+        self.scores: dict[str, int] = {}
+        self.binds: list[dict] = []
+        self.outcome = ""
+
+    def as_dict(self) -> dict:
+        return {
+            "uid": self.uid,
+            "pod": self.pod,
+            "seq": self.seq,
+            "t": self.t,
+            "policy": self.policy,
+            "filter": {k: self.verdicts[k] for k in sorted(self.verdicts)},
+            "scores": {k: self.scores[k] for k in sorted(self.scores)},
+            "binds": list(self.binds),
+            "outcome": self.outcome,
+        }
+
+
+#: building cycles kept per ledger before the oldest is force-finalized
+#: (a pod whose bind never arrives must not pin memory forever)
+BUILDING_MAX = 1024
+
+
+class DecisionLedger:
+    """Bounded audit ring of per-cycle decision records; thread-safe.
+
+    A cycle opens at the first filter verdict for a pod UID, accumulates
+    the score breakdown and bind attempts, and finalizes when a bind
+    commits, the pod's next cycle begins (a retry re-filters), or an
+    abort (deadline/admission shed) ends the request. ``clock`` is
+    injectable so the sim's records carry virtual time and stay
+    byte-reproducible."""
+
+    def __init__(self, capacity: int = 512, clock=time.monotonic):
+        if capacity <= 0:
+            raise ValueError(
+                f"decision capacity must be > 0, got {capacity}"
+            )
+        self.capacity = int(capacity)
+        self.clock = clock
+        self._lock = make_lock("DecisionLedger._lock")
+        self._building: "OrderedDict[str, _Cycle]" = OrderedDict()
+        self._ring: deque[_Cycle] = deque(maxlen=self.capacity)
+        self._seq = 0
+        #: "<reason>:<verb>" -> count for UID-less aborts (pre-parse
+        #: admission sheds): aggregated instead of ring-recorded, so an
+        #: overload burst cannot evict the per-pod records the ledger
+        #: exists to keep
+        self._uidless_aborts: dict[str, int] = {}
+
+    # -- recording ---------------------------------------------------------
+    def _cycle_locked(self, uid: str, pod: str = "") -> _Cycle:
+        cyc = self._building.get(uid)
+        if cyc is None:
+            self._seq += 1
+            cyc = _Cycle(uid, pod, self._seq, round(self.clock(), 6))
+            self._building[uid] = cyc
+            while len(self._building) > BUILDING_MAX:
+                _, stale = self._building.popitem(last=False)
+                stale.outcome = stale.outcome or "abandoned"
+                self._ring.append(stale)
+        elif pod and not cyc.pod:
+            cyc.pod = pod
+        return cyc
+
+    def filter_verdicts(self, uid: str, pod: str,
+                        verdicts: dict[str, str], policy: str = "") -> None:
+        """Open (or roll) the pod's cycle with per-node filter verdicts.
+        A pod re-filtering (retry) finalizes the previous cycle first —
+        each kube-scheduler attempt is its own auditable record."""
+        with self._lock:
+            prev = self._building.get(uid)
+            if prev is not None and (prev.verdicts or prev.binds):
+                prev.outcome = prev.outcome or "retried"
+                self._ring.append(self._building.pop(uid))
+            cyc = self._cycle_locked(uid, pod)
+            cyc.verdicts = dict(verdicts)
+            if policy:
+                cyc.policy = policy
+
+    def scores(self, uid: str, scored, policy: str = "") -> None:
+        """Attach the per-candidate score breakdown to the pod's cycle."""
+        with self._lock:
+            cyc = self._cycle_locked(uid)
+            cyc.scores = {name: int(score) for name, score in scored}
+            if policy and not cyc.policy:
+                cyc.policy = policy
+
+    def bind_outcome(self, uid: str, node: str, reason: str,
+                     bound: bool, pod: str = "", final: bool = False) -> None:
+        """Record one bind attempt. A committed bind finalizes the cycle;
+        ``final=True`` finalizes a FAILED attempt too (outcome = its
+        reason) — for terminal verdicts like the TTL sweeper's expiry,
+        where nothing further will ever arrive for this cycle."""
+        with self._lock:
+            if not uid:
+                # a bind whose client omitted PodUID: keying a cycle on
+                # "" would conflate every such pod's attempts into one
+                # record — count it like the other uid-less events
+                key = f"{reason}:bind"
+                self._uidless_aborts[key] = (
+                    self._uidless_aborts.get(key, 0) + 1
+                )
+                return
+            cyc = self._cycle_locked(uid, pod)
+            cyc.binds.append({
+                "t": round(self.clock(), 6),
+                "node": node,
+                "reason": reason,
+                "bound": bound,
+            })
+            if bound or final:
+                cyc.outcome = "bound" if bound else reason
+                self._ring.append(self._building.pop(uid))
+
+    def abort(self, uid: str, verb: str, reason: str) -> None:
+        """A request ended without a decision (deadline / admission shed);
+        finalize whatever cycle exists so the shed is auditable. Aborts
+        with no pod UID (sheds refused before the body was parsed) only
+        bump an aggregate — one per-shed ring record each would flush
+        every genuine placement record out of the bounded ring exactly
+        when the operator needs them."""
+        key = f"{reason}:{verb}"
+        with self._lock:
+            if not uid:
+                self._uidless_aborts[key] = (
+                    self._uidless_aborts.get(key, 0) + 1
+                )
+                return
+            cyc = self._building.pop(uid, None)
+            if cyc is None:
+                self._seq += 1
+                cyc = _Cycle(uid, "", self._seq, round(self.clock(), 6))
+            cyc.outcome = key
+            self._ring.append(cyc)
+
+    def abort_summary(self) -> dict[str, int]:
+        """Aggregate counts of UID-less aborts ("<reason>:<verb>" keys)."""
+        with self._lock:
+            return {
+                k: self._uidless_aborts[k]
+                for k in sorted(self._uidless_aborts)
+            }
+
+    # -- retrieval ---------------------------------------------------------
+    def get(self, uid: str) -> list[dict]:
+        """Every retained record for ``uid`` (finalized + in-progress),
+        oldest first."""
+        with self._lock:
+            out = [c for c in self._ring if c.uid == uid]
+            live = self._building.get(uid)
+            if live is not None:
+                out.append(live)
+            return [c.as_dict() for c in sorted(out, key=lambda c: c.seq)]
+
+    def recent(self, limit: int = 50) -> list[dict]:
+        """The newest ``limit`` finalized records, newest first."""
+        with self._lock:
+            records = list(self._ring)
+        records.sort(key=lambda c: -c.seq)
+        return [c.as_dict() for c in records[:max(0, limit)]]
+
+    def dump(self) -> list[dict]:
+        """Every retained finalized record in cycle order (digest input)."""
+        with self._lock:
+            records = list(self._ring)
+        records.sort(key=lambda c: c.seq)
+        return [c.as_dict() for c in records]

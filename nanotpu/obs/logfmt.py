@@ -1,0 +1,35 @@
+"""Structured JSON log formatter with trace correlation.
+
+``--log-json`` in cmd/main swaps the plain formatter for this one: every
+record becomes one JSON object per line, and records emitted while a
+sampled request is active on the thread are stamped with that request's
+pod UID and trace id — so logs and ``/debug/traces/<uid>`` join on one
+key instead of by eyeball-on-timestamps.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from nanotpu.obs.trace import current
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per log line, trace-correlated when possible."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        trace = current()
+        if trace is not None:
+            out["pod_uid"] = trace.uid
+            out["trace_id"] = trace.trace_id
+            out["verb"] = trace.verb
+        return json.dumps(out, sort_keys=True, separators=(",", ":"))

@@ -1,0 +1,192 @@
+"""Request tracing: the per-pod causal record of a scheduling decision.
+
+A :class:`Trace` is the request-scoped context the route layer (or the
+sim) creates per verb request and threads alongside the
+:class:`~nanotpu.utils.deadline.Deadline` token through
+``verb.handle -> dealer``; layers the token cannot reach by signature
+(the resilient K8s write wrapper, deep bind internals) read the
+thread-local :func:`current` instead. Each trace is a flat list of
+``(t, kind, detail)`` events — verb entry/exit, snapshot reads, native
+calls, reservations, bind commits, API retries, breaker fast-fails —
+timestamped by the tracer's injectable clock, so the production tracer
+records wall time while the sim's records virtual time and stays
+byte-reproducible (docs/observability.md).
+
+Cost contract: with sampling OFF the fused Filter/Prioritize fast path
+must not change by a single allocation (the bench's per-rep attribution
+counters pin this). That is why
+
+* ``Tracer.begin`` is only called behind a ``tracer.sample`` truthiness
+  check (two attribute loads, no call) on the request path, and
+* :func:`current` fast-exits on a module-global bool before touching the
+  thread-local, so deep layers may probe it unconditionally.
+
+Sampling: ``sample=0`` off, ``1`` every request, ``N`` one request in N.
+Completed traces land in a bounded ring (oldest evicted) indexed by pod
+UID for ``GET /debug/traces/<uid>``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from zlib import crc32
+
+from nanotpu.analysis.witness import make_lock
+
+#: flipped (sticky) the first time any sampling tracer is constructed;
+#: :func:`current` fast-exits on it so un-instrumented processes pay one
+#: module-global bool check, never a thread-local probe
+_ACTIVE = False
+
+_tls = threading.local()
+
+
+def current() -> "Trace | None":
+    """The trace of the request being served on THIS thread, or None.
+
+    Deep layers (ResilientClientset, dealer bind internals) call this
+    instead of growing a ``trace=`` parameter through every signature;
+    the route layer / sim establish it with :func:`set_current`."""
+    if not _ACTIVE:
+        return None
+    return getattr(_tls, "trace", None)
+
+
+def set_current(trace: "Trace | None") -> None:
+    """Install ``trace`` as this thread's active trace (None clears)."""
+    _tls.trace = trace
+
+
+class Trace:
+    """One sampled request's event record. Single-writer by design: the
+    request thread that began it is the only appender, so ``event()``
+    needs no lock; readers only see it after ``Tracer.commit``."""
+
+    __slots__ = ("uid", "trace_id", "verb", "seq", "t0", "events", "_clock")
+
+    def __init__(self, uid: str, verb: str, seq: int, clock):
+        self.uid = uid
+        self.verb = verb
+        self.seq = seq
+        self.trace_id = f"t{seq}"
+        self._clock = clock
+        self.t0 = round(clock(), 6)
+        self.events: list[tuple[float, str, str]] = []
+
+    def event(self, kind: str, detail: str = "") -> None:
+        """Append one timestamped event (timestamps come from the
+        tracer's clock: wall in production, virtual in the sim)."""
+        self.events.append((round(self._clock(), 6), kind, detail))
+
+    def as_dict(self) -> dict:
+        return {
+            "uid": self.uid,
+            "trace_id": self.trace_id,
+            "verb": self.verb,
+            "t0": self.t0,
+            "events": [[t, kind, detail] for t, kind, detail in self.events],
+        }
+
+
+class Tracer:
+    """Sampling + the bounded completed-trace ring (see module docstring).
+
+    The ring is allocated lazily on the first commit so an off tracer
+    (the default everywhere but cmd/main's ``--trace-sample`` and the
+    sim) costs a handful of attributes and nothing else."""
+
+    def __init__(self, sample: int = 0, capacity: int = 256,
+                 clock=time.monotonic):
+        if capacity <= 0:
+            raise ValueError(f"trace capacity must be > 0, got {capacity}")
+        self.sample = max(0, int(sample))
+        self.capacity = int(capacity)
+        self.clock = clock
+        self._lock = make_lock("Tracer._lock")
+        self._ring: list[Trace | None] | None = None
+        self._slot = 0
+        self._n = 0  # requests seen (the sampling counter / trace seq)
+        self._by_uid: dict[str, list[Trace]] = {}
+        self.committed = 0
+        self.evicted = 0
+        if self.sample > 0:
+            global _ACTIVE
+            _ACTIVE = True
+
+    @property
+    def enabled(self) -> bool:
+        return self.sample > 0
+
+    def begin(self, verb: str, uid: str) -> Trace | None:
+        """Start a trace for this request, or None when not sampled.
+        Callers on the request path must pre-check ``tracer.sample`` so
+        the off path never even makes this call.
+
+        1-in-N sampling is sticky **per pod UID** (stable crc32 hash),
+        not per request: a pod's Filter, Prioritize, and Bind requests
+        share one sampling verdict, so a sampled pod always gets a
+        COMPLETE causal record and its decision cycle always reaches a
+        finalizing bind — per-request coin flips would leave ~(1-1/N) of
+        opened cycles permanently half-built. UID-less requests (the
+        pre-parse admission-shed audit) fall back to a request counter."""
+        if self.sample <= 0:
+            return None
+        with self._lock:
+            self._n += 1
+            n = self._n
+        if self.sample > 1:
+            if uid:
+                if not self.sampled(uid):
+                    return None
+            elif n % self.sample:
+                return None
+        return Trace(uid, verb, n, self.clock)
+
+    def sampled(self, uid: str) -> bool:
+        """The sticky per-pod sampling verdict, for recorders that are
+        not requests (e.g. the assume-TTL sweeper's audit entries): an
+        unsampled pod must record nothing anywhere, or 100%-recorded
+        side channels would evict the 1-in-N actually-sampled pods'
+        records from the bounded rings."""
+        if self.sample <= 0:
+            return False
+        if self.sample == 1:
+            return True
+        return crc32(uid.encode()) % self.sample == 0
+
+    def commit(self, trace: Trace) -> None:
+        """File a finished trace into the ring (evicting the oldest once
+        full) and the by-UID index."""
+        with self._lock:
+            if self._ring is None:
+                self._ring = [None] * self.capacity
+            old = self._ring[self._slot]
+            if old is not None:
+                self.evicted += 1
+                kept = self._by_uid.get(old.uid)
+                if kept is not None:
+                    try:
+                        kept.remove(old)
+                    except ValueError:
+                        pass
+                    if not kept:
+                        del self._by_uid[old.uid]
+            self._ring[self._slot] = trace
+            self._slot = (self._slot + 1) % self.capacity
+            self._by_uid.setdefault(trace.uid, []).append(trace)
+            self.committed += 1
+
+    def get(self, uid: str) -> list[dict]:
+        """Every retained trace for ``uid``, oldest first."""
+        with self._lock:
+            traces = list(self._by_uid.get(uid, ()))
+        traces.sort(key=lambda t: t.seq)
+        return [t.as_dict() for t in traces]
+
+    def dump(self) -> list[dict]:
+        """Every retained trace in begin order (the sim digest input)."""
+        with self._lock:
+            traces = [t for t in (self._ring or ()) if t is not None]
+        traces.sort(key=lambda t: t.seq)
+        return [t.as_dict() for t in traces]

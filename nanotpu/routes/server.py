@@ -14,6 +14,9 @@ ThreadingHTTPServer:
   exporter, SURVEY §5; occupancy + verb latency histograms live here)
 * GET  /debug/pprof/...   — profiling endpoints (pprof.go:10-22): Python
   equivalents (thread dump, cProfile over a window, tracemalloc heap)
+* GET  /debug/traces/<pod-uid> — every retained trace + decision-audit
+  record for the pod (docs/observability.md); admission-gate-exempt
+* GET  /debug/decisions?limit=N — newest finalized decision records
 
 Error handling: malformed JSON or handler errors return structured JSON with
 HTTP 400/500 — the reference panicked on bad Prioritize input
@@ -45,12 +48,38 @@ from nanotpu.analysis.witness import make_lock
 from nanotpu.dealer import Dealer
 from nanotpu.metrics.registry import Registry
 from nanotpu.metrics.resilience import ResilienceCounters, ResilienceExporter
+from nanotpu.obs import Observability, set_current
+from nanotpu.obs.decisions import REASON_ADMISSION_SHED, REASON_DEADLINE_SHED
 from nanotpu.scheduler.verbs import Bind, Predicate, Prioritize, VerbError
 from nanotpu.utils.deadline import Deadline, DeadlineExceeded, check as deadline_check
 
 log = logging.getLogger("nanotpu.routes")
 
 VERSION = "0.1.0"
+
+
+def error_body(reason: str, message: str, **extra) -> str:
+    """The ONE JSON error envelope every non-200 answer uses — the
+    structured 429/503 overload responses, /readyz's 503, 404s, and the
+    /debug endpoints all share it (``Error`` + ``Reason`` + optional
+    extras like ``RetryAfterSeconds``/``Waiting``) so clients parse one
+    shape instead of three ad-hoc formats."""
+    body = {"Error": message, "Reason": reason}
+    body.update(extra)
+    return json.dumps(body)
+
+
+def _trace_uid(verb_name: str, args) -> str:
+    """Pod UID for trace/audit keying, best-effort from parsed args."""
+    if isinstance(args, dict):
+        if verb_name == "bind":
+            return str(args.get("PodUID") or args.get("podUID") or "")
+        pod = args.get("Pod") or args.get("pod")
+        if isinstance(pod, dict):
+            meta = pod.get("metadata") or {}
+            if isinstance(meta, dict):
+                return str(meta.get("uid") or "")
+    return ""
 
 
 @dataclass
@@ -87,21 +116,31 @@ class SchedulerAPI:
 
     def __init__(self, dealer: Dealer, registry: Registry | None = None,
                  overload: OverloadConfig | None = None,
-                 resilience: ResilienceCounters | None = None):
+                 resilience: ResilienceCounters | None = None,
+                 obs: Observability | None = None):
         self.dealer = dealer
         self.registry = registry or Registry()
         self.overload = overload or OverloadConfig()
         self.resilience = resilience or ResilienceCounters()
         self.registry.register(ResilienceExporter(self.resilience))
+        #: tracing + decision audit + bind/gang histograms (sampling off
+        #: by default: the tracer then costs one truthiness check per
+        #: request and the fused fast path is untouched)
+        self.obs = obs or Observability()
+        self.obs.register_with(self.registry)
+        if getattr(dealer, "obs", None) is None:
+            # a dealer built without the bundle (tests, bench) adopts
+            # ours so bind-commit/gang-wait histograms populate
+            dealer.obs = self.obs
         #: readiness gates: (name, callable) — /readyz is 200 only when
         #: every callable returns truthy (a raising check is "not ready")
         self._ready_checks: list[tuple[str, object]] = []
-        self.predicate = Predicate(dealer)
-        self.prioritize = Prioritize(dealer)
-        self.bind = Bind(dealer)
+        self.predicate = Predicate(dealer, obs=self.obs)
+        self.prioritize = Prioritize(dealer, obs=self.obs)
+        self.bind = Bind(dealer, obs=self.obs)
         r = self.registry
-        self.verb_latency = r.histogram(
-            "nanotpu_verb_latency_seconds", "Latency of extender verbs"
+        self.verb_duration = r.histogram(
+            "nanotpu_verb_duration_seconds", "Duration of extender verbs"
         )
         self.verb_total = r.counter(
             "nanotpu_verb_requests_total", "Extender verb requests"
@@ -194,16 +233,25 @@ class SchedulerAPI:
                 return 200, "text/plain; version=0.0.4", self.registry.render()
             if method == "GET" and path.startswith("/debug/pprof"):
                 return self._pprof(path)
-            return 404, "application/json", json.dumps({"error": f"no route {path}"})
+            if method == "GET" and path.startswith("/debug/traces/"):
+                # admission-gate-exempt like /healthz: an overloaded
+                # scheduler is exactly when its traces matter most
+                return self._debug_traces(path)
+            if method == "GET" and path.startswith("/debug/decisions"):
+                return self._debug_decisions(path)
+            return 404, "application/json", error_body(
+                "NotFound", f"no route {path}"
+            )
         except Exception:  # never let a request kill the scheduler
             log.exception("unhandled error on %s %s", method, path)
             return (
                 500,
                 "application/json",
-                json.dumps({"error": traceback.format_exc(limit=3)}),
+                error_body("Internal", traceback.format_exc(limit=3)),
             )
 
     def _verb(self, verb, body: bytes) -> tuple[int, str, str]:
+        shed_inflight = -1
         with self._inflight_lock:
             # admission gate: once the box is chewing max_inflight verb
             # requests, queueing more only guarantees they answer past the
@@ -216,20 +264,31 @@ class SchedulerAPI:
                 verb.name != "bind"
                 and self.inflight >= self.overload.max_inflight
             ):
-                self.resilience.inc("shed", verb.name)
-                self.verb_total.inc(verb=verb.name, code="429")
-                return 429, "application/json", json.dumps({
-                    "Error": (
-                        f"{verb.name} shed: {self.inflight} requests in "
-                        f"flight (gate {self.overload.max_inflight})"
-                    ),
-                    "Reason": "Overloaded",
-                    "RetryAfterSeconds": self.overload.retry_after_s,
-                })
-            self.inflight += 1
-            self.requests_seen += 1
-            if self.inflight > self.inflight_peak:
-                self.inflight_peak = self.inflight
+                shed_inflight = self.inflight
+            else:
+                self.inflight += 1
+                self.requests_seen += 1
+                if self.inflight > self.inflight_peak:
+                    self.inflight_peak = self.inflight
+        if shed_inflight >= 0:
+            # everything below stays OUTSIDE the gate lock: the whole
+            # point of the 429 is to be the cheap path under overload
+            self.resilience.inc("shed", verb.name)
+            self.verb_total.inc(verb=verb.name, code="429")
+            if self.obs.tracer.sample and self.obs.tracer.begin(
+                verb.name, ""
+            ) is not None:
+                # subject to the same 1-in-N knob as every trace (the
+                # begun trace itself is discarded — a shed has no spans);
+                # pre-parse the pod UID is unknown, so the ledger only
+                # bumps its uid-less aggregate (never the ring)
+                self.obs.ledger.abort("", verb.name, REASON_ADMISSION_SHED)
+            return 429, "application/json", error_body(
+                "Overloaded",
+                f"{verb.name} shed: {shed_inflight} requests in "
+                f"flight (gate {self.overload.max_inflight})",
+                RetryAfterSeconds=self.overload.retry_after_s,
+            )
         try:
             code, ctype, payload = self._verb_timed(verb, body)
             self.verb_bytes.inc(len(payload), verb=verb.name)
@@ -242,6 +301,7 @@ class SchedulerAPI:
     def _verb_timed(self, verb, body: bytes) -> tuple[int, str, str]:
         started = time.perf_counter()
         code = 200
+        trace = None
         deadline = Deadline(self.overload.budget_for(verb.name))
         try:
             cached = self._parse_cache
@@ -252,8 +312,8 @@ class SchedulerAPI:
                     args = self._parse_args(body)
                 except json.JSONDecodeError as e:
                     code = 400
-                    return 400, "application/json", json.dumps(
-                        {"Error": f"malformed JSON: {e}"}
+                    return 400, "application/json", error_body(
+                        "BadRequest", f"malformed JSON: {e}"
                     )
                 if isinstance(args, dict):
                     # never trust the verb-layer stash key from the wire: a
@@ -261,33 +321,56 @@ class SchedulerAPI:
                     # validation inside _extract
                     args.pop("__nanotpu_extracted", None)
                     self._parse_cache = (bytes(body), args)
+            if self.obs.tracer.sample:
+                # the one tracing touch on the request path: when sampling
+                # is off this is a truthiness check and nothing else (the
+                # bench's per-rep attribution counters pin that)
+                trace = self.obs.tracer.begin(
+                    verb.name, _trace_uid(verb.name, args)
+                )
+                if trace is not None:
+                    set_current(trace)
+                    trace.event("verb:recv", f"{verb.name} {len(body)}B")
             try:
                 # a huge body can burn the whole budget in the JSON parse;
                 # abort before any dealer work if so
                 deadline_check(deadline, f"{verb.name}:parsed")
-                fast = getattr(verb, "fast", None)
-                if fast is not None:
-                    payload = fast(args)
-                    if payload is not None:
-                        return 200, "application/json", payload
-                result = verb.handle(args, deadline=deadline)
+                if trace is None:
+                    fast = getattr(verb, "fast", None)
+                    if fast is not None:
+                        payload = fast(args)
+                        if payload is not None:
+                            return 200, "application/json", payload
+                    result = verb.handle(args, deadline=deadline)
+                else:
+                    # a sampled request takes the list path on purpose:
+                    # the fused native renderer answers in one opaque
+                    # crossing and cannot narrate verdicts — result
+                    # parity between the two paths is pinned by the
+                    # extender protocol tests
+                    result = verb.handle(args, deadline=deadline, trace=trace)
             except VerbError as e:
                 code = 400
-                return 400, "application/json", json.dumps({"Error": str(e)})
+                return 400, "application/json", error_body(
+                    "BadRequest", str(e)
+                )
             except DeadlineExceeded as e:
                 # structured 503: kube-scheduler's extender `ignorable`
                 # semantics decide whether the cycle continues without us
                 code = 503
                 self.resilience.inc("deadline_expired", verb.name)
-                return 503, "application/json", json.dumps({
-                    "Error": (
-                        f"{verb.name} exceeded its "
-                        f"{deadline.budget_s:g}s response budget "
-                        f"(stage {e}); aborted before commit"
-                    ),
-                    "Reason": "DeadlineExceeded",
-                    "RetryAfterSeconds": self.overload.retry_after_s,
-                })
+                if trace is not None:
+                    trace.event("deadline:exceeded", str(e))
+                    self.obs.ledger.abort(
+                        trace.uid, verb.name, REASON_DEADLINE_SHED
+                    )
+                return 503, "application/json", error_body(
+                    "DeadlineExceeded",
+                    f"{verb.name} exceeded its "
+                    f"{deadline.budget_s:g}s response budget "
+                    f"(stage {e}); aborted before commit",
+                    RetryAfterSeconds=self.overload.retry_after_s,
+                )
             except Exception:
                 # dispatch's catch-all will answer 500; record it as such so
                 # error-rate metrics don't report success for failures
@@ -300,8 +383,12 @@ class SchedulerAPI:
             )
             return 200, "application/json", payload
         finally:
+            if trace is not None:
+                trace.event("verb:done", f"{verb.name}:{code}")
+                set_current(None)
+                self.obs.tracer.commit(trace)
             elapsed = time.perf_counter() - started
-            self.verb_latency.observe(elapsed, verb=verb.name)
+            self.verb_duration.observe(elapsed, verb=verb.name)
             self.verb_total.inc(verb=verb.name, code=str(code))
 
     def _parse_args(self, body: bytes):
@@ -364,10 +451,63 @@ class SchedulerAPI:
             if not ready:
                 waiting.append(name)
         if waiting:
-            return 503, "application/json", json.dumps(
-                {"ready": False, "waiting": waiting}
+            return 503, "application/json", error_body(
+                "NotReady",
+                f"not ready: waiting on {', '.join(waiting)}",
+                Waiting=waiting,
+                RetryAfterSeconds=self.overload.retry_after_s,
             )
         return 200, "application/json", json.dumps({"ready": True})
+
+    # -- decision/trace introspection (docs/observability.md) --------------
+    def _debug_traces(self, path: str) -> tuple[int, str, str]:
+        """``GET /debug/traces/<pod-uid>``: every retained trace AND
+        decision record for the pod, joined on UID. Admission-exempt."""
+        uid = path[len("/debug/traces/"):].partition("?")[0]
+        if not uid:
+            return 400, "application/json", error_body(
+                "BadRequest", "usage: /debug/traces/<pod-uid>"
+            )
+        traces = self.obs.tracer.get(uid)
+        decisions = self.obs.ledger.get(uid)
+        if not traces and not decisions:
+            return 404, "application/json", error_body(
+                "NotFound",
+                f"no trace for pod uid {uid} (sampling "
+                f"{'off' if not self.obs.enabled else 'on'}; ring keeps "
+                f"the last {self.obs.tracer.capacity} traces)",
+            )
+        return 200, "application/json", json.dumps({
+            "uid": uid,
+            "sampling": self.obs.tracer.sample,
+            "traces": traces,
+            "decisions": decisions,
+        }, sort_keys=True)
+
+    def _debug_decisions(self, path: str) -> tuple[int, str, str]:
+        """``GET /debug/decisions?limit=N``: newest finalized decision
+        records (default 50). Admission-exempt."""
+        _, _, query = path.partition("?")
+        params = dict(
+            kv.split("=", 1) for kv in query.split("&") if "=" in kv
+        )
+        try:
+            limit = min(max(int(params.get("limit", 50)), 1),
+                        self.obs.ledger.capacity)
+        except ValueError:
+            return 400, "application/json", error_body(
+                "BadRequest", "limit must be an integer"
+            )
+        records = self.obs.ledger.recent(limit)
+        return 200, "application/json", json.dumps({
+            "sampling": self.obs.tracer.sample,
+            "count": len(records),
+            "decisions": records,
+            # UID-less sheds (refused pre-parse) are aggregated, never
+            # ring-recorded — an overload burst must not evict the
+            # per-pod records this endpoint exists to serve
+            "aborts": self.obs.ledger.abort_summary(),
+        }, sort_keys=True)
 
     # -- idle-time GC (the between-burst half of the GC discipline) --------
     def start_idle_gc(self, idle_s: float = 0.5,
@@ -580,7 +720,8 @@ class _Handler(socketserver.StreamRequestHandler):
                 # a fresh line, desyncing keep-alive framing (stdlib's
                 # _MAXLINE -> 414/400 behavior)
                 self._write(414, "application/json",
-                            '{"error": "request line too long"}', False)
+                            error_body("BadRequest",
+                                       "request line too long"), False)
                 return
             # request underway: drop from the idle keep-alive budget to the
             # slow-client deadline for the rest of this request/response
@@ -589,7 +730,8 @@ class _Handler(socketserver.StreamRequestHandler):
                 method, path, version = line.decode("latin-1").split()
             except ValueError:
                 self._write(400, "application/json",
-                            '{"error": "malformed request line"}', False)
+                            error_body("BadRequest",
+                                       "malformed request line"), False)
                 return
             length = 0
             keep_alive = version == "HTTP/1.1"
@@ -604,12 +746,14 @@ class _Handler(socketserver.StreamRequestHandler):
                     # its tail parsed as a separate header (a Content-Length
                     # buried past the cap would be lost, desyncing framing)
                     self._write(400, "application/json",
-                                '{"error": "header line too long"}', False)
+                                error_body("BadRequest",
+                                           "header line too long"), False)
                     return
                 n_headers += 1
                 if n_headers > self.MAX_HEADERS:
                     self._write(400, "application/json",
-                                '{"error": "too many headers"}', False)
+                                error_body("BadRequest",
+                                           "too many headers"), False)
                     return
                 k, _, v = h.partition(b":")
                 k = k.strip().lower()
@@ -626,12 +770,14 @@ class _Handler(socketserver.StreamRequestHandler):
                 # chunk framing is not implemented; silently dispatching an
                 # empty body would desync the connection on the chunk bytes
                 self._write(411, "application/json",
-                            '{"error": "chunked framing unsupported; '
-                            'send Content-Length"}', False)
+                            error_body("BadRequest",
+                                       "chunked framing unsupported; "
+                                       "send Content-Length"), False)
                 return
             if length < 0 or length > self.MAX_BODY:
                 self._write(400, "application/json",
-                            '{"error": "invalid Content-Length"}', False)
+                            error_body("BadRequest",
+                                       "invalid Content-Length"), False)
                 return
             body = self.rfile.read(length) if length else b""
             code, ctype, payload = self.api.dispatch(method, path, body)

@@ -21,14 +21,36 @@ import json
 import logging
 from typing import Any
 
+from nanotpu import types
 from nanotpu.allocator.core import Demand
 from nanotpu.dealer import BindError, Dealer
 from nanotpu.k8s.client import ApiError, NotFoundError
 from nanotpu.k8s.objects import Pod
+from nanotpu.obs.decisions import (
+    REASON_API_ERROR,
+    REASON_INSUFFICIENT_CHIPS,
+    REASON_INVALID_DEMAND,
+    REASON_NOT_TPU_NODE,
+    REASON_OK,
+    REASON_POD_COMPLETED,
+    REASON_POD_NOT_FOUND,
+)
 from nanotpu.utils import pod as podutil
 from nanotpu.utils.deadline import Deadline, check as deadline_check
 
 log = logging.getLogger("nanotpu.scheduler")
+
+
+def _filter_reason_code(message: str) -> str:
+    """Map a Filter failure message (the wire-format FailedNodes value)
+    to its typed audit reason code (nanotpu.obs.decisions)."""
+    if message == "not a TPU node":
+        return REASON_NOT_TPU_NODE
+    if message == types.REASON_NO_CAPACITY:
+        return REASON_INSUFFICIENT_CHIPS
+    if message.startswith("invalid demand"):
+        return REASON_INVALID_DEMAND
+    return REASON_INSUFFICIENT_CHIPS
 
 
 class VerbError(Exception):
@@ -72,8 +94,11 @@ class Predicate:
 
     name = "filter"
 
-    def __init__(self, dealer: Dealer):
+    def __init__(self, dealer: Dealer, obs=None):
         self.dealer = dealer
+        #: optional Observability bundle: sampled requests audit their
+        #: per-node verdicts into its decision ledger
+        self.obs = obs
         #: name -> '"<json-escaped name>"' and (name, reason) -> the
         #: FailedNodes entry '"name":"reason"'. Candidate names and failure
         #: reasons repeat every scheduling cycle; joining cached fragments
@@ -82,7 +107,8 @@ class Predicate:
         self._qfail: dict[tuple[str, str], str] = {}
 
     def handle(self, args: dict[str, Any],
-               deadline: Deadline | None = None) -> dict[str, Any]:
+               deadline: Deadline | None = None,
+               trace=None) -> dict[str, Any]:
         pod, node_names = _extract(args)
         # demand.total > 0 == is_tpu_sharing_pod (pod.go:27-29), via the
         # pod-memoized Demand so the quantity parse happens once per pod,
@@ -90,7 +116,21 @@ class Predicate:
         if Demand.from_pod(pod).total <= 0:
             # not ours: pass every node through untouched
             return {"NodeNames": node_names, "FailedNodes": {}, "Error": ""}
-        ok, failed = self.dealer.assume(node_names, pod, deadline=deadline)
+        ok, failed = self.dealer.assume(
+            node_names, pod, deadline=deadline, trace=trace
+        )
+        if trace is not None:
+            trace.event(
+                "filter:verdicts", f"ok={len(ok)} failed={len(failed)}"
+            )
+            if self.obs is not None:
+                verdicts = {n: REASON_OK for n in ok}
+                for n, msg in failed.items():
+                    verdicts[n] = _filter_reason_code(msg)
+                self.obs.ledger.filter_verdicts(
+                    pod.uid, pod.key(), verdicts,
+                    policy=self.dealer.rater.name,
+                )
         return {"NodeNames": ok, "FailedNodes": failed, "Error": ""}
 
     def fast(self, args: dict[str, Any]) -> bytes | None:
@@ -137,8 +177,9 @@ class Prioritize:
 
     name = "priorities"
 
-    def __init__(self, dealer: Dealer):
+    def __init__(self, dealer: Dealer, obs=None):
         self.dealer = dealer
+        self.obs = obs
         #: host -> '{"Host":"<json-escaped>","Score":' — the fixed prefix of
         #: every HostPriority entry. Node names repeat across every
         #: scheduling cycle (nodeCacheCapable), and generic json.dumps of
@@ -146,11 +187,21 @@ class Prioritize:
         self._frags: dict[str, str] = {}
 
     def handle(self, args: dict[str, Any],
-               deadline: Deadline | None = None) -> list[tuple[str, int]]:
+               deadline: Deadline | None = None,
+               trace=None) -> list[tuple[str, int]]:
         pod, node_names = _extract(args)
         if Demand.from_pod(pod).total <= 0:
             return [(n, 0) for n in node_names]
-        return self.dealer.score(node_names, pod, deadline=deadline)
+        scored = self.dealer.score(
+            node_names, pod, deadline=deadline, trace=trace
+        )
+        if trace is not None:
+            trace.event("priorities:scored", f"candidates={len(scored)}")
+            if self.obs is not None:
+                self.obs.ledger.scores(
+                    pod.uid, scored, policy=self.dealer.rater.name
+                )
+        return scored
 
     def fast(self, args: dict[str, Any]) -> bytes | None:
         """See Predicate.fast."""
@@ -183,11 +234,24 @@ class Bind:
 
     name = "bind"
 
-    def __init__(self, dealer: Dealer):
+    def __init__(self, dealer: Dealer, obs=None):
         self.dealer = dealer
+        self.obs = obs
+
+    def _audit(self, trace, uid: str, node: str, reason: str,
+               bound: bool, pod: str = "", final: bool = False) -> None:
+        """``final`` marks a TERMINAL failed verdict (pod gone/completed:
+        it will never re-filter, so nothing else can ever finalize the
+        cycle); retryable failures stay open — the pod's next Filter
+        rolls them as 'retried'."""
+        if trace is not None and self.obs is not None:
+            self.obs.ledger.bind_outcome(
+                uid, node, reason, bound, pod=pod, final=final
+            )
 
     def handle(self, args: dict[str, Any],
-               deadline: Deadline | None = None) -> dict[str, Any]:
+               deadline: Deadline | None = None,
+               trace=None) -> dict[str, Any]:
         if not isinstance(args, dict):
             raise VerbError("ExtenderBindingArgs must be a JSON object")
         name = args.get("PodName") or args.get("podName")
@@ -196,21 +260,35 @@ class Bind:
         node = args.get("Node") or args.get("node")
         if not name or not node:
             raise VerbError("PodName and Node are required")
+        key = f"{namespace}/{name}"
         # last safe abort point before apiserver round-trips begin; past
         # here the bind commits through (see Dealer.bind's deadline note)
         deadline_check(deadline, "bind:get-pod")
+        if trace is not None:
+            trace.event("bind:get-pod", key)
         try:
             pod = self._get_pod(namespace, name, uid)
         except NotFoundError:
+            self._audit(trace, uid, node, REASON_POD_NOT_FOUND, False, key,
+                        final=True)
             return {"Error": f"pod {namespace}/{name} not found"}
         except ApiError as e:
+            # transient (apiserver trouble): the scheduler retries the
+            # cycle, whose Filter will roll this record — not final
+            self._audit(trace, uid, node, REASON_API_ERROR, False, key)
             return {"Error": f"get pod {namespace}/{name}: {e}"}
         if podutil.is_completed_pod(pod):
+            self._audit(trace, uid, node, REASON_POD_COMPLETED, False, key,
+                        final=True)
             return {"Error": f"pod {namespace}/{name} is already completed"}
         try:
-            self.dealer.bind(node, pod, deadline=deadline)
+            self.dealer.bind(node, pod, deadline=deadline, trace=trace)
         except BindError as e:
+            self._audit(trace, pod.uid, node, e.reason, False, key)
             return {"Error": str(e)}
+        if trace is not None:
+            trace.event("bind:committed", f"{key} -> {node}")
+        self._audit(trace, pod.uid, node, REASON_OK, True, key)
         log.info("bound %s/%s to %s", namespace, name, node)
         return {"Error": ""}
 

@@ -36,6 +36,7 @@ from nanotpu.dealer import Dealer
 from nanotpu.k8s.objects import Node, Pod, plain_copy
 from nanotpu.k8s.resilience import ResilientClientset
 from nanotpu.metrics.resilience import ResilienceCounters
+from nanotpu.obs import Observability, set_current
 from nanotpu.scheduler.verbs import Bind, Predicate, Prioritize
 from nanotpu.sim.faults import BrownoutClient, FaultPlan
 from nanotpu.sim.fleet import fleet_summary, make_fleet
@@ -100,6 +101,17 @@ class Simulator:
         #: the deterministic report
         self.resilience = ResilienceCounters()
         self.now = 0.0  # before _build_stack: the wrapper's clock reads it
+        #: sampling=all tracing + decision audit on the VIRTUAL clock —
+        #: every event timestamp is `self.now`, so the trace set (and the
+        #: report's `traces` digest) is byte-reproducible. Like the
+        #: resilience ledger it survives agent restarts: it is the run's
+        #: measurement, not the dealer's state.
+        self.obs = Observability(
+            sample=1 if self.scenario["trace"] else 0,
+            trace_capacity=131072,
+            decision_capacity=65536,
+            clock=lambda: self.now,
+        )
         self._build_stack()
         # the informer tap: the sim owns the watches and feeds the REAL
         # controller handlers, with the fault layer in between
@@ -135,11 +147,12 @@ class Simulator:
             rng=self.rng_retry,
         )
         self.dealer = Dealer(
-            api_client, make_rater(self.scenario["policy"]), assume_workers=2
+            api_client, make_rater(self.scenario["policy"]), assume_workers=2,
+            obs=self.obs,
         )
-        self.predicate = Predicate(self.dealer)
-        self.prioritize = Prioritize(self.dealer)
-        self.bind_verb = Bind(self.dealer)
+        self.predicate = Predicate(self.dealer, obs=self.obs)
+        self.prioritize = Prioritize(self.dealer, obs=self.obs)
+        self.bind_verb = Bind(self.dealer, obs=self.obs)
         self.client.before_bind = self._bind_hook
         if hasattr(self, "controller"):
             self.controller.dealer = self.dealer
@@ -152,6 +165,7 @@ class Simulator:
                 queue_max=self.scenario["queue_max"],
                 assume_ttl_s=0,
                 resilience=self.resilience,
+                obs=self.obs,
             )
 
     def _push(self, t: float, kind: str, payload=None) -> None:
@@ -183,6 +197,10 @@ class Simulator:
         self.report.fault_counts = dict(self.faults.counts)
         self.report.pods["pending_final"] = len(self._pending)
         self.report.resilience = self._deterministic_resilience()
+        # every trace/decision timestamp is virtual time and every event
+        # fires on the sim thread, so this digest is part of the
+        # determinism contract — a replayable causal narrative per pod
+        self.report.traces = self.obs.digest_summary()
         if self.scenario["lock_witness"]:
             # teardown assert: any two code paths that disagreed about
             # lock order during the run fail the soak HERE, with the
@@ -306,19 +324,36 @@ class Simulator:
     def _live_node_names(self) -> list[str]:
         return sorted(n.name for n in self.client.list_nodes())
 
+    def _run_verb(self, verb_obj, args, uid: str):
+        """One verb call, traced on the virtual clock when the scenario
+        enables tracing — the sim-side mirror of the route layer's
+        sampled path (one trace per request, thread-local current set
+        so the resilient client's retry/breaker events land in it)."""
+        if not self.obs.tracer.sample:
+            return verb_obj.handle(args)
+        trace = self.obs.tracer.begin(verb_obj.name, uid)
+        if trace is None:  # a future 1-in-N scenario knob must not crash
+            return verb_obj.handle(args)
+        set_current(trace)
+        try:
+            return verb_obj.handle(args, trace=trace)
+        finally:
+            set_current(None)
+            self.obs.tracer.commit(trace)
+
     def _try_schedule(self, job: Job, pod: Pod) -> bool:
         node_names = self._live_node_names()
         if not node_names:
             return False
         args = {"Pod": pod.raw, "NodeNames": node_names}
         t0 = time.perf_counter()
-        filt = self.predicate.handle(args)
+        filt = self._run_verb(self.predicate, args, pod.uid)
         self.report.observe_verb("filter", time.perf_counter() - t0)
         feasible = set(filt["NodeNames"])
         if not feasible:
             return False
         t0 = time.perf_counter()
-        scored = self.prioritize.handle(args)
+        scored = self._run_verb(self.prioritize, args, pod.uid)
         self.report.observe_verb("prioritize", time.perf_counter() - t0)
         ranked = sorted(
             ((name, score) for name, score in scored if name in feasible),
@@ -328,12 +363,12 @@ class Simulator:
             if attempt > BIND_RETRIES_PER_CYCLE:
                 break
             t0 = time.perf_counter()
-            result = self.bind_verb.handle({
+            result = self._run_verb(self.bind_verb, {
                 "PodName": pod.name,
                 "PodNamespace": pod.namespace,
                 "PodUID": pod.uid,
                 "Node": best,
-            })
+            }, pod.uid)
             self.report.observe_verb("bind", time.perf_counter() - t0)
             if not result["Error"]:
                 job.bound_t[pod.name] = self.now

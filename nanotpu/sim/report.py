@@ -103,6 +103,10 @@ class ReportBuilder:
         #: filters out the background-thread Event counters): attribution
         #: for every shed/coalesced/dropped/expired/fast-failed action
         self.resilience: dict = {}
+        #: trace/decision-audit summary (Observability.digest_summary):
+        #: counts plus a sha256 over every retained trace and decision
+        #: record — virtual-clock timestamps make it byte-reproducible
+        self.traces: dict = {}
         self.restart_occupancy_drift = 0.0
         self.final_occupancy = 0.0
         self.final_fragmentation = 0.0
@@ -164,6 +168,7 @@ class ReportBuilder:
             "faults": dict(sorted(self.fault_counts.items())),
             "resilience": {k: self.resilience[k]
                            for k in sorted(self.resilience)},
+            "traces": {k: self.traces[k] for k in sorted(self.traces)},
             "restart_occupancy_drift_pct": round(
                 100 * self.restart_occupancy_drift, 6
             ),

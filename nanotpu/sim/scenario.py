@@ -37,9 +37,14 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
       "invariant_every_events": 1,
       "assume_ttl_s": 0.0,           # >0: sweep assumed-never-bound pods
       "queue_max": 0,                # >0: bound the controller sync queue
-      "lock_witness": false          # true: instrument every lock and
+      "lock_witness": false,         # true: instrument every lock and
                                      # assert acquisition-order acyclicity
                                      # at teardown (docs/static-analysis.md)
+      "trace": true                  # sampling=all tracing + decision
+                                     # audit on the virtual clock; the
+                                     # report gains a deterministic
+                                     # `traces` digest section
+                                     # (docs/observability.md)
     }
 
 Omitted sections disable that feature (``faults: {}`` == fault-free run).
@@ -143,6 +148,7 @@ def normalize_scenario(raw: dict) -> dict:
         "assume_ttl_s": float(raw.get("assume_ttl_s", 0.0)),
         "queue_max": int(raw.get("queue_max", 0)),
         "lock_witness": bool(raw.get("lock_witness", False)),
+        "trace": bool(raw.get("trace", True)),
     }
 
 

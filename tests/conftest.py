@@ -43,6 +43,14 @@ import signal
 import pytest
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--regen-obs-golden", action="store_true", default=False,
+        help="rewrite tests/golden/obs_debug_schema.json from the live "
+        "/debug JSON shape (test_obs.py golden-file schema test)",
+    )
+
+
 def pytest_sessionfinish(session, exitstatus):
     """Teardown half of the lock-order witness: the whole suite is one
     big concurrency exercise, and any ordering cycle it witnessed —

@@ -408,6 +408,123 @@ class TestMetricsCompleteness:
         assert any("ghosts" in m for m in msgs), msgs
         assert any("untracked" in m for m in msgs), msgs
 
+    # -- decision-audit reason codes (nanotpu/obs/decisions.py) ------------
+    REASONS_DECL = """
+        REASON_OK = "ok"
+        REASON_DEAD = "dead_code"
+        REASONS = {
+            REASON_OK: "fine",
+            REASON_DEAD: "nothing ever records this",
+        }
+        """
+
+    def test_reason_recorded_but_undeclared(self, tmp_path):
+        report = lint(tmp_path, {
+            "decisions.py": self.REASONS_DECL,
+            "user.py": """
+                from decisions import REASON_GHOST, REASON_OK
+
+                def f(ledger):
+                    ledger.bind_outcome("u", "n", reason=REASON_OK, bound=True)
+                    ledger.abort("u", "bind", REASON_GHOST)
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("REASON_GHOST" in m and "not declared" in m
+                   for m in msgs), msgs
+
+    def test_reason_declared_but_never_recorded(self, tmp_path):
+        report = lint(tmp_path, {
+            "decisions.py": self.REASONS_DECL,
+            "user.py": """
+                from decisions import REASON_OK
+
+                def f(ledger):
+                    ledger.bind_outcome("u", "n", reason=REASON_OK, bound=True)
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("REASON_DEAD" in m and "no call site" in m
+                   for m in msgs), msgs
+        assert not any("REASON_OK" in m for m in msgs), msgs
+
+    def test_reason_missing_from_catalogue(self, tmp_path):
+        report = lint(tmp_path, {
+            "decisions.py": """
+                REASON_OK = "ok"
+                REASON_UNLISTED = "unlisted"
+                REASONS = {REASON_OK: "fine"}
+                """,
+            "user.py": """
+                import decisions
+
+                def f(ledger):
+                    ledger.abort("u", "bind", decisions.REASON_OK)
+                    ledger.abort("u", "bind", decisions.REASON_UNLISTED)
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("REASON_UNLISTED" in m and "REASONS" in m
+                   for m in msgs), msgs
+
+    def test_reason_catalogue_detected_through_annotated_assign(self, tmp_path):
+        # the REAL enum declares ``REASONS: dict[str, str] = {...}`` —
+        # an ast.AnnAssign; matching only plain Assign silently no-ops
+        # the whole check on production code (review finding)
+        report = lint(tmp_path, {
+            "decisions.py": """
+                REASON_OK = "ok"
+                REASON_DEAD = "dead_code"
+                REASONS: dict[str, str] = {
+                    REASON_OK: "fine",
+                    REASON_DEAD: "nothing records this",
+                }
+                """,
+            "user.py": """
+                from decisions import REASON_OK
+
+                def f(ledger):
+                    ledger.bind_outcome("u", "n", reason=REASON_OK, bound=True)
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("REASON_DEAD" in m and "no call site" in m
+                   for m in msgs), msgs
+
+    def test_reason_import_from_other_module_is_not_held_to_enum(self, tmp_path):
+        # k8s/events exports kubectl-conventional REASON_* strings of its
+        # own; importing those must not trip the decision-audit check
+        report = lint(tmp_path, {
+            "decisions.py": self.REASONS_DECL,
+            "user.py": """
+                from decisions import REASON_DEAD, REASON_OK
+                from events import REASON_ASSIGNED
+
+                def f(ledger, recorder):
+                    ledger.bind_outcome("u", "n", reason=REASON_OK, bound=True)
+                    ledger.abort("u", "bind", REASON_DEAD)
+                    recorder.event(None, "Normal", REASON_ASSIGNED, "msg")
+                """,
+        }, ["metrics-completeness"])
+        assert not any("REASON_ASSIGNED" in f.message
+                       for f in report.findings), report.findings
+
+    def test_reason_attribute_reference_counts_as_use(self, tmp_path):
+        report = lint(tmp_path, {
+            "decisions.py": """
+                REASON_OK = "ok"
+                REASONS = {REASON_OK: "fine"}
+                """,
+            "user.py": """
+                from nanotpu.obs import decisions
+
+                def f(ledger):
+                    ledger.abort("u", "bind", decisions.REASON_OK)
+                """,
+        }, ["metrics-completeness"])
+        assert not any("REASON_OK" in f.message
+                       for f in report.findings), report.findings
+
 
 # ---------------------------------------------------------------------------
 # the ignore budget

@@ -239,7 +239,7 @@ class TestOperationalEndpoints:
         )
         code, text = get(base, "/metrics")
         assert code == 200
-        assert "nanotpu_verb_latency_seconds_bucket" in text
+        assert "nanotpu_verb_duration_seconds_bucket" in text
         assert 'verb="filter"' in text and 'verb="bind"' in text
         # occupancy: host-0 full (4 chips), host-1 untouched but materialized
         occ = next(

@@ -1,0 +1,508 @@
+"""Observability layer tests: tracer sampling/ring, decision ledger
+lifecycle, /debug endpoints (golden-file schema), the shared JSON error
+envelope, log correlation, and the sim's deterministic traces digest
+(docs/observability.md).
+"""
+
+import json
+import logging
+from pathlib import Path
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.cmd.main import make_mock_cluster
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.objects import make_container, make_pod
+from nanotpu.metrics.registry import Registry
+from nanotpu.obs import Observability, set_current
+from nanotpu.obs.decisions import (
+    REASON_INSUFFICIENT_CHIPS,
+    REASON_OK,
+    REASONS,
+    DecisionLedger,
+)
+from nanotpu.obs.logfmt import JsonLogFormatter
+from nanotpu.obs.trace import Tracer
+from nanotpu.routes.server import SchedulerAPI
+from nanotpu.sim.core import Simulator
+from nanotpu.sim.report import render, strip_timing
+
+GOLDEN = Path(__file__).parent / "golden" / "obs_debug_schema.json"
+
+
+# ---------------------------------------------------------------------------
+# tracer unit behavior
+# ---------------------------------------------------------------------------
+class TestTracer:
+    def test_off_samples_nothing(self):
+        t = Tracer(sample=0)
+        assert not t.enabled
+        assert t.begin("filter", "uid-1") is None
+        assert t.dump() == []
+
+    def test_sample_all(self):
+        t = Tracer(sample=1)
+        for i in range(3):
+            tr = t.begin("filter", f"uid-{i}")
+            assert tr is not None
+            t.commit(tr)
+        assert t.committed == 3
+
+    def test_one_in_n_is_sticky_per_pod_uid(self):
+        # sampling hashes the pod UID, so a pod's filter/priorities/bind
+        # requests share ONE verdict — a per-request coin flip would
+        # leave most opened decision cycles permanently half-built
+        t = Tracer(sample=3)
+        uids = [f"uid-{i}" for i in range(300)]
+        filter_verdicts = {u: t.begin("filter", u) is not None for u in uids}
+        bind_verdicts = {u: t.begin("bind", u) is not None for u in uids}
+        assert filter_verdicts == bind_verdicts
+        n_sampled = sum(filter_verdicts.values())
+        assert 0 < n_sampled < len(uids)  # roughly 1 in 3, never all/none
+
+    def test_one_in_n_uidless_falls_back_to_request_counter(self):
+        t = Tracer(sample=3)
+        hits = [t.begin("filter", "") for _ in range(9)]
+        assert sum(h is not None for h in hits) == 3  # requests 3, 6, 9
+
+    def test_sampled_verdict_matches_begin(self):
+        # non-request recorders (the TTL sweeper) must share the sticky
+        # per-pod verdict, or 100%-recorded side channels evict the
+        # 1-in-N sampled pods' records from the bounded ring
+        t = Tracer(sample=3)
+        for i in range(50):
+            uid = f"uid-{i}"
+            assert t.sampled(uid) == (t.begin("bind", uid) is not None)
+        assert Tracer(sample=0).sampled("any") is False
+        assert Tracer(sample=1).sampled("any") is True
+
+    def test_ring_evicts_oldest_and_uid_index_follows(self):
+        t = Tracer(sample=1, capacity=2)
+        for i in range(3):
+            tr = t.begin("bind", f"uid-{i}")
+            tr.event("bind:committed", "node")
+            t.commit(tr)
+        assert t.evicted == 1
+        assert t.get("uid-0") == []  # evicted
+        assert len(t.get("uid-1")) == 1
+        assert len(t.get("uid-2")) == 1
+
+    def test_injectable_clock_stamps_events(self):
+        now = {"t": 10.0}
+        t = Tracer(sample=1, clock=lambda: now["t"])
+        tr = t.begin("filter", "u")
+        now["t"] = 12.5
+        tr.event("snapshot:read", "gen=1")
+        t.commit(tr)
+        dumped = t.dump()[0]
+        assert dumped["t0"] == 10.0
+        assert dumped["events"] == [[12.5, "snapshot:read", "gen=1"]]
+
+
+# ---------------------------------------------------------------------------
+# decision ledger lifecycle
+# ---------------------------------------------------------------------------
+class TestDecisionLedger:
+    def test_cycle_finalizes_on_bound(self):
+        led = DecisionLedger(clock=lambda: 1.0)
+        led.filter_verdicts(
+            "u1", "default/p", {"n0": REASON_OK, "n1": REASON_INSUFFICIENT_CHIPS},
+            policy="binpack",
+        )
+        led.scores("u1", [("n0", 63)])
+        led.bind_outcome("u1", "n0", REASON_OK, True)
+        recs = led.get("u1")
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["outcome"] == "bound"
+        assert rec["filter"] == {
+            "n0": REASON_OK, "n1": REASON_INSUFFICIENT_CHIPS,
+        }
+        assert rec["scores"] == {"n0": 63}
+        assert rec["binds"][0]["bound"] is True
+        assert rec["policy"] == "binpack"
+
+    def test_refilter_rolls_previous_cycle(self):
+        led = DecisionLedger(clock=lambda: 0.0)
+        led.filter_verdicts("u1", "default/p", {"n0": REASON_OK})
+        led.filter_verdicts("u1", "default/p", {"n0": REASON_OK})
+        recs = led.get("u1")
+        assert len(recs) == 2
+        assert recs[0]["outcome"] == "retried"
+        assert recs[1]["outcome"] == ""  # still building
+
+    def test_recent_is_newest_first_and_limited(self):
+        led = DecisionLedger(clock=lambda: 0.0)
+        for i in range(5):
+            led.bind_outcome(f"u{i}", "n0", REASON_OK, True)
+        recent = led.recent(limit=2)
+        assert [r["uid"] for r in recent] == ["u4", "u3"]
+
+    def test_every_reason_has_a_description(self):
+        for code, description in REASONS.items():
+            assert code and description
+
+    def test_abort_records_shed(self):
+        led = DecisionLedger(clock=lambda: 0.0)
+        led.abort("u9", "filter", "deadline_shed")
+        assert led.get("u9")[0]["outcome"] == "deadline_shed:filter"
+
+    def test_uidless_bind_outcome_aggregates_not_conflates(self):
+        # binds whose client omitted PodUID must not share one ""-keyed
+        # cycle that misattributes pod A's attempts to pod B
+        led = DecisionLedger(clock=lambda: 0.0)
+        led.bind_outcome("", "n0", "api_error", False)
+        led.bind_outcome("", "n1", "api_error", False)
+        assert led.abort_summary() == {"api_error:bind": 2}
+        assert led.dump() == [] and not led._building
+
+    def test_uidless_aborts_aggregate_and_never_evict_the_ring(self):
+        # a 429 storm (pre-parse, no pod UID) must not flush genuine
+        # placement records out of the bounded ring (review finding)
+        led = DecisionLedger(capacity=4, clock=lambda: 0.0)
+        led.bind_outcome("real-pod", "n0", REASON_OK, True)
+        for _ in range(100):
+            led.abort("", "filter", "admission_shed")
+        assert led.abort_summary() == {"admission_shed:filter": 100}
+        assert [r["uid"] for r in led.recent()] == ["real-pod"]
+        assert led.dump()[0]["outcome"] == "bound"
+
+    def test_final_failed_outcome_finalizes_cycle(self):
+        # terminal verdicts (the TTL sweeper's assume_expired) must reach
+        # /debug/decisions, not sit in the building map as "in flight"
+        led = DecisionLedger(clock=lambda: 0.0)
+        led.filter_verdicts("u1", "default/p", {"n0": REASON_OK})
+        led.bind_outcome(
+            "u1", "n0", "assume_expired", False, final=True
+        )
+        recent = led.recent()
+        assert len(recent) == 1
+        assert recent[0]["outcome"] == "assume_expired"
+        assert recent[0]["binds"][0]["bound"] is False
+
+
+# ---------------------------------------------------------------------------
+# the live request path + /debug endpoints
+# ---------------------------------------------------------------------------
+def _traced_api(n_hosts=2, sample=1):
+    client = make_mock_cluster(n_hosts)
+    dealer = Dealer(client, make_rater(types.POLICY_BINPACK))
+    api = SchedulerAPI(
+        dealer, Registry(), obs=Observability(sample=sample)
+    )
+    return client, dealer, api
+
+
+def _schedule_one(client, api, name="job-0", percent=200):
+    pod = make_pod(
+        name,
+        containers=[make_container("main", {types.RESOURCE_TPU_PERCENT: percent})],
+    )
+    client.create_pod(pod)
+    server_pod = client.get_pod("default", name)
+    args = json.dumps({
+        "Pod": server_pod.raw,
+        "NodeNames": ["v5p-host-0", "v5p-host-1"],
+    }).encode()
+    code, _, filt = api.dispatch("POST", "/scheduler/filter", args)
+    assert code == 200, filt
+    code, _, _prio = api.dispatch("POST", "/scheduler/priorities", args)
+    assert code == 200
+    best = json.loads(filt)["NodeNames"][0]
+    code, _, bound = api.dispatch("POST", "/scheduler/bind", json.dumps({
+        "PodName": name,
+        "PodNamespace": "default",
+        "PodUID": server_pod.uid,
+        "Node": best,
+    }).encode())
+    assert code == 200 and json.loads(bound)["Error"] == "", bound
+    return server_pod.uid, best
+
+
+class TestDebugEndpoints:
+    def test_full_cycle_trace_and_decisions_by_uid(self):
+        client, _, api = _traced_api()
+        uid, best = _schedule_one(client, api)
+        code, ctype, payload = api.dispatch(
+            "GET", f"/debug/traces/{uid}", b""
+        )
+        assert code == 200 and ctype == "application/json"
+        body = json.loads(payload)
+        assert body["uid"] == uid
+        verbs = [t["verb"] for t in body["traces"]]
+        assert verbs == ["filter", "priorities", "bind"]
+        bind_events = [
+            kind for t in body["traces"] if t["verb"] == "bind"
+            for _, kind, _ in t["events"]
+        ]
+        assert "bind:reserved" in bind_events
+        assert "bind:commit" in bind_events
+        assert "bind:committed" in bind_events
+        # the decision record joins on the same uid
+        assert body["decisions"][-1]["outcome"] == "bound"
+        assert body["decisions"][-1]["binds"][-1]["node"] == best
+
+    def test_decisions_endpoint_limit(self):
+        client, _, api = _traced_api(n_hosts=4)
+        for i in range(3):
+            _schedule_one(client, api, name=f"job-{i}")
+        code, _, payload = api.dispatch(
+            "GET", "/debug/decisions?limit=2", b""
+        )
+        assert code == 200
+        body = json.loads(payload)
+        assert body["count"] == 2
+        assert all(r["outcome"] == "bound" for r in body["decisions"])
+        code, _, payload = api.dispatch(
+            "GET", "/debug/decisions?limit=bogus", b""
+        )
+        assert code == 400
+        assert json.loads(payload)["Reason"] == "BadRequest"
+
+    def test_unknown_uid_404_names_sampling_state(self):
+        _, _, api = _traced_api()
+        code, _, payload = api.dispatch("GET", "/debug/traces/ghost", b"")
+        body = json.loads(payload)
+        assert code == 404 and body["Reason"] == "NotFound"
+        assert "sampling on" in body["Error"]
+
+    def test_terminal_bind_failure_finalizes_decision(self):
+        # a deleted pod never re-filters, so pod_not_found must finalize
+        # the cycle into /debug/decisions instead of parking forever
+        _, _, api = _traced_api()
+        code, _, payload = api.dispatch("POST", "/scheduler/bind", json.dumps({
+            "PodName": "ghost",
+            "PodNamespace": "default",
+            "PodUID": "uid-ghost",
+            "Node": "v5p-host-0",
+        }).encode())
+        assert code == 200 and "not found" in json.loads(payload)["Error"]
+        recs = [r for r in api.obs.ledger.recent(10)
+                if r["uid"] == "uid-ghost"]
+        assert recs and recs[0]["outcome"] == "pod_not_found", recs
+        assert not api.obs.ledger._building
+
+    def test_sweeper_audit_respects_sampling_verdict(self):
+        from nanotpu.controller.controller import Controller
+
+        client = make_mock_cluster(1)
+        dealer = Dealer(client, make_rater(types.POLICY_BINPACK))
+        obs = Observability(sample=1000)  # nearly every uid unsampled
+        ctl = Controller(client, dealer, resync_period_s=0,
+                         assume_ttl_s=1.0, obs=obs)
+        for i in range(20):
+            client.create_pod(make_pod(
+                f"stale-{i}",
+                containers=[make_container(
+                    "m", {types.RESOURCE_TPU_PERCENT: "100"}
+                )],
+                labels={types.ANNOTATION_ASSUME: "true"},
+                annotations={types.ANNOTATION_ASSUME: "true"},
+            ))
+        assert ctl.sweep_assumed_once(ttl_s=1.0, now=0.0) == 0
+        assert ctl.sweep_assumed_once(ttl_s=1.0, now=5.0) == 20
+        recorded = obs.ledger.dump()
+        sampled_uids = [
+            client.get_pod("default", f"stale-{i}").uid for i in range(20)
+            if obs.tracer.sampled(client.get_pod("default", f"stale-{i}").uid)
+        ]
+        # only sampled pods' expiries reach the ring (most uids at
+        # 1-in-1000 are unsampled; equality pins the gating either way)
+        assert sorted(r["uid"] for r in recorded) == sorted(sampled_uids)
+
+    def test_sampling_off_records_nothing(self):
+        client, _, api = _traced_api(sample=0)
+        uid, _ = _schedule_one(client, api)
+        assert api.obs.tracer.committed == 0
+        assert api.obs.ledger.dump() == []
+        code, _, payload = api.dispatch("GET", f"/debug/traces/{uid}", b"")
+        assert code == 404
+        assert "sampling off" in json.loads(payload)["Error"]
+
+    def test_histograms_populate_on_bind_path(self):
+        client, _, api = _traced_api(sample=0)
+        _schedule_one(client, api)
+        text = api.registry.render()
+        assert "nanotpu_bind_commit_duration_seconds_count 1" in text
+        assert "nanotpu_verb_duration_seconds_bucket" in text
+
+
+class TestGoldenDebugSchema:
+    """Pin the SHAPE of the /debug JSON (keys + value kinds). Breaking it
+    breaks every dashboard/script that scrapes these endpoints — the
+    golden file makes that an explicit, reviewed change
+    (regenerate: python -m pytest tests/test_obs.py --regen-obs-golden)."""
+
+    @staticmethod
+    def _shape(obj):
+        if isinstance(obj, bool):
+            return "bool"
+        if isinstance(obj, (int, float)):
+            return "num"
+        if isinstance(obj, str):
+            return "str"
+        if obj is None:
+            return "null"
+        if isinstance(obj, list):
+            return [TestGoldenDebugSchema._shape(obj[0])] if obj else []
+        return {
+            k: TestGoldenDebugSchema._shape(v) for k, v in sorted(obj.items())
+        }
+
+    def _live_schema(self):
+        client, _, api = _traced_api()
+        uid, _ = _schedule_one(client, api)
+        _, _, traces = api.dispatch("GET", f"/debug/traces/{uid}", b"")
+        _, _, decisions = api.dispatch("GET", "/debug/decisions?limit=5", b"")
+        return {
+            "debug_traces": self._shape(json.loads(traces)),
+            "debug_decisions": self._shape(json.loads(decisions)),
+        }
+
+    def test_debug_json_matches_golden_schema(self, request):
+        live = self._live_schema()
+        if request.config.getoption("--regen-obs-golden"):
+            GOLDEN.parent.mkdir(exist_ok=True)
+            GOLDEN.write_text(json.dumps(live, indent=2, sort_keys=True) + "\n")
+            pytest.skip("golden schema regenerated")
+        assert GOLDEN.exists(), (
+            "golden schema missing; regenerate with "
+            "pytest tests/test_obs.py --regen-obs-golden"
+        )
+        golden = json.loads(GOLDEN.read_text())
+        assert live == golden, (
+            "/debug JSON schema drifted from tests/golden/"
+            "obs_debug_schema.json — if intentional, regenerate the "
+            "golden file and call it out in review"
+        )
+
+
+class TestErrorEnvelope:
+    """PR 3's structured 429/503, /readyz's 503, and the /debug errors
+    must share ONE envelope (Error + Reason [+ extras])."""
+
+    def test_envelope_everywhere(self):
+        _, _, api = _traced_api()
+        api.add_ready_check("never", lambda: False)
+        cases = [
+            api.dispatch("GET", "/readyz", b""),
+            api.dispatch("GET", "/nosuchroute", b""),
+            api.dispatch("GET", "/debug/traces/ghost", b""),
+            api.dispatch("GET", "/debug/decisions?limit=x", b""),
+            api.dispatch("POST", "/scheduler/filter", b"{not json"),
+        ]
+        for code, _, payload in cases:
+            assert code in (400, 404, 503), (code, payload)
+            body = json.loads(payload)
+            assert set(body) >= {"Error", "Reason"}, body
+            assert body["Reason"] in (
+                "NotReady", "NotFound", "BadRequest"
+            ), body
+
+    def test_readyz_envelope_keeps_waiting_detail(self):
+        _, _, api = _traced_api()
+        api.add_ready_check("informer-sync", lambda: False)
+        code, _, payload = api.dispatch("GET", "/readyz", b"")
+        body = json.loads(payload)
+        assert code == 503
+        assert body["Reason"] == "NotReady"
+        assert body["Waiting"] == ["informer-sync"]
+        assert body["RetryAfterSeconds"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# log correlation
+# ---------------------------------------------------------------------------
+class TestJsonLogFormatter:
+    def _record(self, msg="bound default/p to n0"):
+        return logging.LogRecord(
+            "nanotpu.scheduler", logging.INFO, __file__, 1, msg, (), None
+        )
+
+    def test_plain_record_renders_json(self):
+        line = JsonLogFormatter().format(self._record())
+        body = json.loads(line)
+        assert body["level"] == "INFO"
+        assert body["logger"] == "nanotpu.scheduler"
+        assert body["message"] == "bound default/p to n0"
+        assert "pod_uid" not in body
+
+    def test_active_trace_stamps_uid_and_trace_id(self):
+        tracer = Tracer(sample=1)
+        trace = tracer.begin("bind", "uid-42")
+        set_current(trace)
+        try:
+            body = json.loads(JsonLogFormatter().format(self._record()))
+        finally:
+            set_current(None)
+        assert body["pod_uid"] == "uid-42"
+        assert body["trace_id"] == trace.trace_id
+        assert body["verb"] == "bind"
+
+
+# ---------------------------------------------------------------------------
+# sim: deterministic traces digest + per-pod completeness
+# ---------------------------------------------------------------------------
+MINI_SCENARIO = {
+    "name": "obs-mini",
+    "fleet": {"pools": [
+        {"generation": "v5p", "hosts": 4, "prefix": "v5p-host"},
+    ]},
+    "policy": "binpack",
+    "horizon_s": 8.0,
+    "workload": {
+        "kind": "poisson",
+        "rate_per_s": 1.0,
+        "mix": {"fractional": 0.5, "spread": 0.5},
+        "lifetime_s": {"dist": "exp", "mean": 6.0},
+    },
+    "faults": {"bind_failure": {"prob": 0.2}},
+    "resync_every_s": 2.0,
+    "sample_every_s": 1.0,
+    "retry_every_s": 0.5,
+}
+
+
+class TestSimTraces:
+    def test_traces_digest_is_deterministic(self):
+        a = Simulator(dict(MINI_SCENARIO), seed=3).run()
+        b = Simulator(dict(MINI_SCENARIO), seed=3).run()
+        assert a["traces"]["digest"] == b["traces"]["digest"]
+        assert a["traces"]["traces"] > 0
+        assert render(strip_timing(a)) == render(strip_timing(b))
+
+    def test_different_seed_different_traces(self):
+        a = Simulator(dict(MINI_SCENARIO), seed=3).run()
+        b = Simulator(dict(MINI_SCENARIO), seed=4).run()
+        assert a["traces"]["digest"] != b["traces"]["digest"]
+
+    def test_every_bound_pod_has_complete_causal_record(self):
+        sim = Simulator(dict(MINI_SCENARIO), seed=3)
+        report = sim.run()
+        assert report["pods"]["bound"] > 0
+        bound_uids = sorted(sim.dealer.debug_snapshot()["tracked_uids"])
+        assert bound_uids
+        for uid in bound_uids:
+            traces = sim.obs.tracer.get(uid)
+            assert traces, f"bound pod {uid} has no trace"
+            events = [
+                kind for t in traces for _, kind, _ in t["events"]
+            ]
+            assert "bind:committed" in events, (uid, events)
+            decisions = sim.obs.ledger.get(uid)
+            bound_recs = [d for d in decisions if d["outcome"] == "bound"]
+            assert bound_recs, f"bound pod {uid} has no decision record"
+            rec = bound_recs[-1]
+            assert rec["filter"], "verdicts missing"
+            assert rec["binds"][-1]["bound"] is True
+
+    def test_trace_knob_off_disables_collection(self):
+        scenario = dict(MINI_SCENARIO)
+        scenario["trace"] = False
+        sim = Simulator(scenario, seed=3)
+        report = sim.run()
+        assert report["traces"]["enabled"] is False
+        assert report["traces"]["traces"] == 0
+        assert sim.obs.tracer.committed == 0

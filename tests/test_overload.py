@@ -197,7 +197,10 @@ class TestReadyz:
         api.add_ready_check("dealer-warm", lambda: dealer.warmed)
         code, _, payload = api.dispatch("GET", "/readyz", b"")
         assert code == 503
-        assert json.loads(payload)["waiting"] == ["informer-sync"]
+        body = json.loads(payload)
+        # shared JSON error envelope (routes.server.error_body)
+        assert body["Reason"] == "NotReady"
+        assert body["Waiting"] == ["informer-sync"]
         synced["ok"] = True
         code, _, payload = api.dispatch("GET", "/readyz", b"")
         assert code == 200 and json.loads(payload) == {"ready": True}
@@ -213,7 +216,7 @@ class TestReadyz:
 
         api.add_ready_check("broken", broken)
         code, _, payload = api.dispatch("GET", "/readyz", b"")
-        assert code == 503 and json.loads(payload)["waiting"] == ["broken"]
+        assert code == 503 and json.loads(payload)["Waiting"] == ["broken"]
 
     def test_controller_sync_flips_readiness(self):
         client = make_mock_cluster(1)
