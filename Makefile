@@ -3,13 +3,14 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench sim-smoke chaos-soak obs-check image clean
+.PHONY: all native lint test test-fast bench sim-smoke sim-multipool chaos-soak obs-check fanout-4k image clean
 
 # Default verification tier: static analysis, then the fast inner loop
 # (test-fast includes sim-smoke), then the observability gate, then the
-# overload-resilience soak. The tier-1 gate (`pytest tests/ -m 'not
-# slow'` over everything) is unchanged — run it via `make test` / CI.
-all: native lint test-fast obs-check chaos-soak
+# overload-resilience soak, then the sharded 4096-host fan-out gate
+# (FAST=1 skips it). The tier-1 gate (`pytest tests/ -m 'not slow'` over
+# everything) is unchanged — run it via `make test` / CI.
+all: native lint test-fast obs-check chaos-soak fanout-4k
 
 # nanolint (docs/static-analysis.md): AST invariant passes over the
 # scheduler's concurrency & determinism contracts — lock discipline,
@@ -62,6 +63,28 @@ obs-check:
 chaos-soak:
 	NANOTPU_LOCK_WITNESS=1 python -m nanotpu.sim \
 		--scenario examples/sim/chaos.json --seed 0 --check-determinism
+
+# Sharded 4096-host fan-out gate (docs/sharding.md): one short rep of
+# bench.py's fanout4k row — four v5p-1024 pools, one RCU snapshot shard
+# per pool, parallel per-shard native score+render. The asserts run
+# IN-bench: every timed Filter/Prioritize inside the 2s per-verb budget,
+# zero gen-2 GC and zero view/renderer rebuilds in the timed window.
+# `FAST=1 make all` skips it (it is a perf gate, not a correctness one).
+fanout-4k: native
+	@if [ "$(FAST)" = "1" ]; then \
+		echo "fanout-4k: skipped (FAST=1)"; \
+	else \
+		python bench.py --fanout-4k; \
+	fi
+
+# The 4096-host multi-pool churn scenario through the sharded dealer,
+# run TWICE (--check-determinism): exits nonzero on any invariant
+# violation or digest divergence. Not part of `make all` (≈40s); the
+# acceptance gate for sharding changes alongside the parity pins in
+# tests/test_shard.py.
+sim-multipool:
+	python -m nanotpu.sim --scenario examples/sim/v5p-multipool.json \
+		--seed 0 --check-determinism
 
 image:
 	docker build -t $(IMAGE):$(TAG) .

@@ -45,8 +45,11 @@ SCOPE = (
 )
 
 #: locks whose critical sections are the scheduling hot path: blocking
-#: calls under these are findings (elsewhere only cycles + sleep are)
-HOT_LOCKS = ("Dealer._lock", "Dealer._publish_lock")
+#: calls under these are findings (elsewhere only cycles + sleep are).
+#: ``_Shard._publish_lock`` is the per-shard successor of the old
+#: ``Dealer._publish_lock`` (kept for fixtures/back-compat): every
+#: snapshot swap serializes on exactly one of them.
+HOT_LOCKS = ("Dealer._lock", "Dealer._publish_lock", "_Shard._publish_lock")
 
 #: terminal attribute names treated as lock objects
 _LOCKISH = ("cv", "_cv", "cond", "_cond", "_mu")

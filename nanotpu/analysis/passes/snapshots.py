@@ -38,7 +38,11 @@ SCOPE = (
 )
 
 #: functions allowed to store attributes on a _Snapshot: the publisher
-PUBLISHER_FUNCS = {"Dealer._republish", "_Snapshot.__init__"}
+#: (per-shard since the sharded-dealer refactor — Dealer._republish only
+#: routes commits to the owning shard's _republish_shard)
+PUBLISHER_FUNCS = {
+    "Dealer._republish", "Dealer._republish_shard", "_Snapshot.__init__",
+}
 
 #: the module that owns BatchScorer's freeze/clone protocol
 VIEW_MODULE = "nanotpu.dealer.batch"

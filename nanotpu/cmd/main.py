@@ -105,6 +105,14 @@ def build_app(argv: list[str] | None = None):
         help="one JSON object per log line, stamped with the active "
         "request's pod UID / trace id so logs join traces on one key",
     )
+    parser.add_argument(
+        "--shards", choices=["1", "auto"], default="1",
+        help="dealer snapshot sharding: '1' publishes one RCU snapshot "
+        "for the whole fleet; 'auto' gives every slice family (pool) its "
+        "own shard — commits republish only their shard and "
+        "Filter/Prioritize score shards in parallel (docs/sharding.md; "
+        "recommended beyond ~1k hosts)",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -137,7 +145,10 @@ def build_app(argv: list[str] | None = None):
         sample=args.trace_sample, trace_capacity=args.trace_capacity,
         decision_capacity=args.trace_capacity,
     )
-    dealer = Dealer(client, rater, recorder=recorder, obs=obs)
+    dealer = Dealer(
+        client, rater, recorder=recorder, obs=obs,
+        shards="auto" if args.shards == "auto" else 1,
+    )
     registry = Registry()
     api = SchedulerAPI(
         dealer, registry,

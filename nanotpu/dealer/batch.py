@@ -10,8 +10,9 @@ the whole pool to ``native.score_batch`` / ``native.score_render``
 compactness band + gang bonus) — and, on the fused path, the full response
 JSON — for every node in one call.
 
-Concurrency model (r6): a scorer adopted into a dealer snapshot is FROZEN
-(``freeze()``) — its row arrays are written once and never mutated, so
+Concurrency model (r6, sharded in r7): a scorer adopted into a dealer
+snapshot is FROZEN (``freeze()``) — its row arrays are written once and
+never mutated, so
 read verbs consume them without probing node versions or copying rows.
 Writers publish a successor via :meth:`advanced`, a copy-on-write clone
 that memmoves the arrays and re-reads only rows whose ``NodeInfo.version``
@@ -24,6 +25,14 @@ no wire buffers at all. Readers of any view in the chain serialize on the
 arena lock; publishers never take it (they only read the predecessor's
 immutable arrays), which is the whole point: Filter/Prioritize never
 contend with Assume/bind writers.
+
+Under the sharded dealer (r7, nanotpu/dealer/shard.py) every scorer —
+rows, arena, renderer blobs — belongs to exactly ONE shard's snapshot
+chain and covers only that shard's candidates; parallel per-shard
+``run()``/``score_render`` calls therefore touch disjoint arenas and
+never contend (the arena lock still serializes readers WITHIN a shard).
+The native calls release the GIL, which is what makes the per-shard
+fan-out genuinely parallel.
 
 The standalone (non-snapshot) mode keeps the historical self-refreshing
 behavior for tests and ad-hoc use: ``run()`` probes node versions and

@@ -15,6 +15,15 @@ annotations), different concurrency and failure design:
   and also roll chip accounting back (the reference leaked it until Release).
 * **node eviction exists** — NodeMaps never evicted deleted nodes in the
   reference (dealer.go:271-301).
+* **per-pool snapshot shards** (``shards="auto"``) — read verbs consume
+  RCU-published snapshots partitioned by slice family
+  (:mod:`nanotpu.dealer.shard`): a commit republishes only its own
+  shard's views (incremental deltas), Filter/Prioritize fan native
+  scoring out across shards in parallel, and results merge back into
+  candidate order exactly (per-node scores are pure functions, so the
+  partition costs nothing in placement quality — docs/sharding.md).
+  ``shards=1`` (default) keeps the whole fleet in one shard with
+  byte-identical behavior to the unsharded dealer.
 
 The K8s API remains the durable checkpoint: placement lives in pod
 annotations, and a restarted dealer replays them (dealer.go:58-72,279-299).
@@ -35,6 +44,15 @@ from nanotpu.dealer.batch import BatchScorer
 from nanotpu.dealer.gang import GangBarrier, GangScorer, GangTracker
 from nanotpu.dealer.nodeinfo import NodeInfo
 from nanotpu.dealer.perf import PerfCounters
+from nanotpu.dealer.shard import (
+    DEFAULT_SHARD_KEY,
+    _Shard,
+    _Snapshot,
+    merge_top_k,
+    shard_key_of,
+    splice_filter_payloads,
+    splice_priorities_payloads,
+)
 from nanotpu.dealer.usage import UsageStore
 from nanotpu.k8s import events
 from nanotpu.k8s.client import ApiError, Clientset, ConflictError, NotFoundError
@@ -82,41 +100,6 @@ class BindError(Exception):
     def __init__(self, message: str, reason: str = REASON_BIND_FAILED):
         super().__init__(message)
         self.reason = reason
-
-
-class _Snapshot:
-    """One RCU-published, immutable view of the dealer's placement state.
-
-    Read verbs (Filter/Prioritize) consume whatever ``Dealer._published``
-    points at WITHOUT the dealer lock: the reference swap is atomic under
-    the GIL, ``nodes``/``non_tpu`` are never mutated after publication,
-    and each cached candidate-list view is a frozen
-    :class:`~nanotpu.dealer.batch.BatchScorer` whose row arrays are
-    written once. Writers build a successor snapshot after their commit
-    and swap it in (``Dealer._republish``) — readers never contend with
-    them and never trigger synchronous rebuilds; at worst they score
-    against the previous generation, the same staleness window the old
-    lock-and-probe path already had (kube-scheduler's bind re-checks
-    under the node lock either way).
-
-    ``views`` maps a candidate-name tuple to ``(scorer, known names,
-    non-TPU names, name->row index)`` — or ``None`` when that list cannot
-    take the batch path in this snapshot (cold/unknown candidates,
-    heterogeneous pool, native unavailable). Caching the None verdict is
-    sound because anything that could change it (a node materializing, a
-    topology change) is structural and structural publishes start with
-    empty views. Reader threads insert into ``views`` lazily; dict ops
-    are atomic under the GIL and a racing double-build is just wasted
-    work.
-    """
-
-    __slots__ = ("gen", "nodes", "non_tpu", "views")
-
-    def __init__(self, gen: int, nodes: dict, non_tpu: frozenset):
-        self.gen = gen
-        self.nodes = nodes
-        self.non_tpu = non_tpu
-        self.views: dict[tuple, tuple | None] = {}
 
 
 #: sentinel distinguishing "no cached view yet" from a cached None verdict
@@ -174,6 +157,7 @@ class Dealer:
         assume_workers: int = 8,
         recorder: EventRecorder | None = None,
         obs=None,
+        shards: int | str = 1,
     ):
         self.client = client
         self.rater = rater
@@ -216,19 +200,37 @@ class Dealer:
         #: bumped on any structural _nodes change; structural publishes
         #: rebuild the snapshot's node mapping and drop its views
         self._nodes_epoch = 0
-        #: hot-path attribution (bench deltas + /metrics)
+        #: request-level hot-path attribution (bench deltas + /metrics);
+        #: shard-level counters (publishes, view work, native calls) live
+        #: on each shard's own PerfCounters — in single-shard mode the one
+        #: shard ALIASES this object, so existing reads see everything
         self.perf = PerfCounters()
-        #: RCU read state: the currently published snapshot, the epoch it
-        #: was built from, and the publisher serialization lock. Ordering
-        #: rule: _republish takes _publish_lock then briefly self._lock —
-        #: NEVER call it while holding self._lock.
-        self._publish_lock = make_lock("Dealer._publish_lock")
-        self._published = _Snapshot(0, {}, frozenset())
-        self._pub_epoch = -1
-        #: bumped at the START of every _republish attempt, including ones
-        #: that end up skipped: lets a reader detect that a commit raced
-        #: its lazy view build (see _view_for's re-advance loop)
-        self._commit_seq = 0
+        #: RCU read state, one publication domain per slice family
+        #: (nanotpu.dealer.shard): each shard owns its published snapshot,
+        #: publisher lock, commit sequence, and structural epoch, so a
+        #: commit republishes only its own shard. ``shards=1`` puts the
+        #: whole fleet in one shard (behavior byte-identical to the
+        #: unsharded dealer); ``shards="auto"`` keys shards by
+        #: generation + slice family. Ordering rule: _republish_shard
+        #: takes the shard's _publish_lock then briefly self._lock —
+        #: NEVER call it while holding self._lock, and never hold two
+        #: shard publish locks at once.
+        if shards not in (1, "auto"):
+            raise ValueError(f"shards must be 1 or 'auto', got {shards!r}")
+        self._shard_fn = shard_key_of if shards == "auto" else None
+        self._shards: dict[str, _Shard] = {}
+        #: node name -> shard key (sharded mode only; dealer lock)
+        self._shard_of: dict[str, str] = {}
+        #: shard key -> {node name -> NodeInfo} (sharded mode only)
+        self._members: dict[str, dict[str, NodeInfo]] = {}
+        #: candidate tuple -> (nodes epoch, partition) — requests repeat
+        #: the same candidate list every cycle; bounded like snap.views
+        self._part_cache: dict[tuple, tuple] = {}
+        if self._shard_fn is None:
+            self._default_shard = _Shard(DEFAULT_SHARD_KEY, perf=self.perf)
+            self._shards[DEFAULT_SHARD_KEY] = self._default_shard
+        else:
+            self._default_shard = None
         self._publish_enabled = False
         self._warm_from_cluster()
         self._publish_enabled = True
@@ -344,8 +346,7 @@ class Dealer:
             existing = self._nodes.get(name)
             if existing is not None:
                 return existing
-            self._nodes[name] = new_info
-            self._nodes_epoch += 1
+            self._register_node(name, new_info)
             # a node can reappear with pods still tracked (node object
             # deleted and re-created while its pods kept running): their
             # chips live on the orphaned NodeInfo — migrate them INSIDE the
@@ -356,6 +357,41 @@ class Dealer:
             # nested _node_info hits the map and never GETs the apiserver
             self._replay_tracked(name)
         return new_info
+
+    def _register_node(self, name: str, info: NodeInfo) -> None:
+        """Insert (or replace) a NodeInfo in the registry AND its shard's
+        membership (caller holds ``self._lock``). Bumps the structural
+        epochs that make the next publish rebuild the affected shard's
+        mapping and drop its views. A relabel that moves the node across
+        slice families bumps BOTH shards."""
+        self._nodes[name] = info
+        self._nodes_epoch += 1
+        if self._shard_fn is None:
+            return
+        key = self._shard_fn(info)
+        old_key = self._shard_of.get(name)
+        if old_key is not None and old_key != key:
+            self._members[old_key].pop(name, None)
+            self._shards[old_key].epoch += 1
+        shard = self._shards.get(key)
+        if shard is None:
+            shard = self._shards[key] = _Shard(key)
+            self._members[key] = {}
+        self._shard_of[name] = key
+        self._members[key][name] = info
+        shard.epoch += 1
+
+    def _unregister_node(self, name: str) -> None:
+        """Evict a node from the registry and its shard's membership
+        (caller holds ``self._lock``)."""
+        self._nodes.pop(name, None)
+        self._nodes_epoch += 1
+        if self._shard_fn is None:
+            return
+        key = self._shard_of.pop(name, None)
+        if key is not None:
+            self._members[key].pop(name, None)
+            self._shards[key].epoch += 1
 
     def _replay_tracked(self, name: str) -> None:
         """Migrate tracked pods of node ``name`` whose accounting lives on
@@ -392,9 +428,8 @@ class Dealer:
     def remove_node(self, name: str) -> None:
         """Evict a deleted/resized node (missing in the reference)."""
         with self._lock:
-            self._nodes.pop(name, None)
+            self._unregister_node(name)
             self._non_tpu.discard(name)
-            self._nodes_epoch += 1
             for uid, res in self._reserved.items():
                 # parked strict-gang reservations on this node are gone;
                 # their binds must fail rather than commit to a dead node
@@ -431,9 +466,8 @@ class Dealer:
                 and NodeInfo.fingerprint_of(node) == info.fingerprint()
             ):
                 return False
-            self._nodes[node.name] = NodeInfo(node)
+            self._register_node(node.name, NodeInfo(node))
             self._non_tpu.discard(node.name)
-            self._nodes_epoch += 1
             # nanolint: ignore[lock-discipline]: replays only this node,
             # freshly present in _nodes — the nested _node_info never GETs
             self._replay_tracked(node.name)
@@ -485,7 +519,53 @@ class Dealer:
 
     # -- RCU snapshot publication ------------------------------------------
     def _republish(self, changed: tuple[str, ...] = ()) -> None:
-        """Swap in a fresh immutable snapshot after a state commit.
+        """Publish fresh immutable snapshots on the shards a commit
+        touched — the incremental-delta half of the sharded design.
+
+        ``changed`` names the nodes the commit touched; each maps to one
+        shard, and ONLY those shards republish (with the probe narrowed
+        to their own changed rows). Empty ``changed`` means a structural
+        sweep: every shard whose membership epoch moved republishes
+        structurally, every other shard is untouched — chip-state changes
+        always arrive with their node named, so an unnamed sweep never
+        needs to probe rows. Single-shard mode degenerates to exactly the
+        pre-shard behavior: one shard, every commit lands on it."""
+        if not self._publish_enabled:
+            return
+        if self._shard_fn is None:
+            self._republish_shard(self._default_shard, changed)
+            return
+        if changed:
+            by_shard: dict[str, list[str]] = {}
+            for n in changed:
+                key = self._shard_of.get(n)
+                if key is None:
+                    continue  # just evicted/unknown: the sweep covers it
+                by_shard.setdefault(key, []).append(n)
+            for key, names in by_shard.items():
+                self._republish_shard(self._shards[key], tuple(names))
+        # unconditional epoch sweep (O(#shards) int compares): any shard
+        # whose membership epoch moved — a relabel's OLD family, an
+        # eviction, a registration — republishes on the very next commit,
+        # regardless of which call path delivered it. Steady-state binds
+        # pay only the compares.
+        for shard in list(self._shards.values()):
+            if shard.epoch != shard._pub_epoch:
+                self._republish_shard(shard, ())
+
+    def _shard_epoch_locked(self, shard: _Shard) -> int:
+        """The structural epoch a publish of this shard must catch up to
+        (caller holds ``self._lock``). Single-shard mode uses the global
+        node epoch (tombstone changes included, exactly as before);
+        sharded mode uses the shard's own membership epoch so one pool's
+        churn never forces siblings to drop their views."""
+        if self._shard_fn is None:
+            return self._nodes_epoch
+        return shard.epoch
+
+    def _republish_shard(self, shard: _Shard,
+                         changed: tuple[str, ...] = ()) -> None:
+        """Swap in a fresh immutable snapshot on ONE shard.
 
         Chip-state-only publishes reuse the node mapping and ADVANCE every
         cached candidate-list view (copy-on-write: only rows whose
@@ -499,22 +579,25 @@ class Dealer:
         cannot observe a difference, and the memo/state_rev stay valid.
         Structural publishes (node added/removed/rebuilt, tombstone
         changes) copy the mapping and start with empty views; the next
-        read warms them. Publishers serialize on _publish_lock and hold
-        self._lock only for the epoch/mapping capture — never while
-        advancing views, so a slow advance cannot stall verb commits."""
-        if not self._publish_enabled:
-            return
-        with self._publish_lock:
+        read warms them. Publishers serialize on the shard's
+        _publish_lock and hold self._lock only for the epoch/mapping
+        capture — never while advancing views, so a slow advance cannot
+        stall verb commits (and never while holding another shard's
+        publish lock, so no cross-shard lock order exists)."""
+        with shard._publish_lock:
             # bumped BEFORE the views capture: a reader whose lazy build
             # this publish raced past (its entry not yet inserted) sees
             # the bump and re-advances its rows before trusting them
-            self._commit_seq += 1
-            old = self._published
+            shard._commit_seq += 1
+            old = shard._published
             with self._lock:
-                epoch = self._nodes_epoch
-                structural = epoch != self._pub_epoch
+                epoch = self._shard_epoch_locked(shard)
+                structural = epoch != shard._pub_epoch
                 if structural:
-                    nodes = dict(self._nodes)
+                    if self._shard_fn is None:
+                        nodes = dict(self._nodes)
+                    else:
+                        nodes = dict(self._members.get(shard.key, {}))
                     non_tpu = frozenset(self._non_tpu)
                 else:
                     nodes, non_tpu = old.nodes, old.non_tpu
@@ -544,43 +627,79 @@ class Dealer:
                     return  # byte-identical views: nothing to publish
             snap = _Snapshot(old.gen + 1, nodes, non_tpu)
             snap.views = views
-            self._pub_epoch = epoch
-            self.perf.snapshot_publishes += 1
+            shard._pub_epoch = epoch
+            shard.perf.snapshot_publishes += 1
             if structural:
-                self.perf.snapshot_structural += 1
-            self._published = snap
+                shard.perf.snapshot_structural += 1
+            shard._published = snap
 
     def _maybe_republish(self) -> None:
         """Catch-up publish for read verbs that warmed cold nodes (their
         apiserver GETs materialize NodeInfos without a writer commit)."""
-        if self._nodes_epoch != self._pub_epoch:
-            self._republish()
+        if self._shard_fn is None:
+            if self._nodes_epoch != self._default_shard._pub_epoch:
+                self._republish()
+            return
+        for shard in list(self._shards.values()):
+            if shard.epoch != shard._pub_epoch:
+                self._republish_shard(shard, ())
 
-    def _view_for(self, node_names: list[str]):
-        """The published snapshot's frozen view for this candidate list;
+    @property
+    def _published(self) -> _Snapshot:
+        """Back-compat single-shard accessor (tests, ad-hoc
+        introspection): the default shard's published snapshot. Sharded
+        dealers have one snapshot PER shard — use :meth:`shard_status`
+        or :meth:`debug_snapshot`."""
+        return self._default_shard._published
+
+    def _snapshot_gen(self) -> int:
+        """Published generation for trace lines: the single shard's gen,
+        or (sharded) the sum across shards — monotonic either way."""
+        if self._shard_fn is None:
+            return self._default_shard._published.gen
+        total = 0
+        # list() snapshot: _register_node can insert a brand-new shard
+        # concurrently, and iterating the live dict would raise
+        for shard in list(self._shards.values()):
+            total += shard._published.gen
+        return total
+
+    def _published_node(self, name: str):
+        """The published NodeInfo for ``name`` from its owning shard's
+        snapshot (lock-free), or None when unpublished/unknown."""
+        if self._shard_fn is None:
+            return self._default_shard._published.nodes.get(name)
+        key = self._shard_of.get(name)
+        shard = self._shards.get(key) if key is not None else None
+        if shard is None:
+            return None
+        return shard._published.nodes.get(name)
+
+    def _view_for(self, shard: _Shard, key: tuple):
+        """The shard's published frozen view for this candidate tuple;
         builds (and caches on the snapshot) lazily on first sight. No
         dealer lock anywhere on the hit path.
 
         The miss path must defend against a commit racing the build: the
-        rows are read from live NodeInfos, and a _republish that ran
+        rows are read from live NodeInfos, and a publish that ran
         between that read and the dict insert may have SKIPPED publishing
         (our entry wasn't cached yet, so no view moved) — caching the
         pre-commit rows then would be stale until some later commit
-        touched the same node. ``_commit_seq`` (bumped by every publish
-        attempt) detects the race; a detected race re-probes every row,
-        which by writer program order (chip mutation -> republish -> seq
-        bump) incorporates any commit the first read missed."""
-        snap = self._published
-        key = tuple(node_names)
+        touched the same node. The shard's ``_commit_seq`` (bumped by
+        every publish attempt) detects the race; a detected race
+        re-probes every row, which by writer program order (chip mutation
+        -> republish -> seq bump) incorporates any commit the first read
+        missed."""
+        snap = shard._published
         entry = snap.views.get(key, _VIEW_MISSING)
         if entry is not _VIEW_MISSING:
             return entry
         entry = None
         built = False
         for _ in range(4):  # bounded: each retry needs a fresh racing commit
-            seq = self._commit_seq
+            seq = shard._commit_seq
             if not built:
-                entry = self._build_view(snap, key)
+                entry = self._build_view(snap, key, shard.perf)
                 built = True
             else:
                 scorer, names_key, non_tpu, index_of = entry
@@ -593,11 +712,11 @@ class Dealer:
                 except (StopIteration, RuntimeError):
                     break  # racing evictor emptied/resized it first
             snap.views[key] = entry
-            if entry is None or self._commit_seq == seq:
+            if entry is None or shard._commit_seq == seq:
                 break
         return entry
 
-    def _build_view(self, snap: _Snapshot, key: tuple):
+    def _build_view(self, snap: _Snapshot, key: tuple, perf: PerfCounters):
         pairs = [(n, snap.nodes.get(n)) for n in key]
         non_tpu = {
             n for n, info in pairs if info is None and n in snap.non_tpu
@@ -606,11 +725,11 @@ class Dealer:
             return None  # cold candidates: take the warming per-node path
         known = [(n, info) for n, info in pairs if info is not None]
         infos = [info for _, info in known]
-        scorer = BatchScorer.build(infos, perf=self.perf)
+        scorer = BatchScorer.build(infos, perf=perf)
         if scorer is None:
             return None
         scorer.freeze()
-        self.perf.view_builds += 1
+        perf.view_builds += 1
         names = tuple(n for n, _ in known)
         # name -> row index: lets a publish advance this view by probing
         # only the rows its commit touched
@@ -622,18 +741,204 @@ class Dealer:
     _BATCH_POLICIES = {types.POLICY_BINPACK: True, types.POLICY_SPREAD: False}
 
     def _batch_plan(self, node_names: list[str]):
-        """(scorer, ordered known names, non-TPU names, prefer_used) when
-        every candidate is materialized in the published snapshot and the
-        pool is uniform; None -> per-node path (cold candidates need
-        apiserver GETs, or mixed topologies). Lock-free."""
+        """Single-shard fast plan: (scorer, ordered known names, non-TPU
+        names, prefer_used) when every candidate is materialized in the
+        published snapshot and the pool is uniform; None -> per-node path
+        (cold candidates need apiserver GETs, or mixed topologies).
+        Lock-free. Sharded dealers use :meth:`_shard_plan` instead."""
+        if self._default_shard is None:
+            return None
         prefer = self._BATCH_POLICIES.get(self.rater.name)
         if prefer is None:
             return None
-        entry = self._view_for(node_names)
+        entry = self._view_for(self._default_shard, tuple(node_names))
         if entry is None:
             return None
         scorer, names_key, non_tpu, _index_of = entry
         return scorer, names_key, non_tpu, prefer
+
+    # -- sharded scoring plan ----------------------------------------------
+    def _compute_partition(self, names_key: tuple):
+        """``(parts, non_tpu names, contiguous)`` for a candidate tuple,
+        or None when an unknown (cold) candidate forces the warming
+        per-node path. ``parts`` is ``[(shard key, names, positions)]``
+        in first-appearance (== ascending position) order; ``contiguous``
+        is True when every shard's candidates form one unbroken run of
+        the request order — the precondition for bytewise payload
+        splicing."""
+        # lock-free reads of the live maps: individual dict/set probes
+        # are GIL-atomic, and a concurrent register/evict at worst yields
+        # a partition that resolves to the warming path or a stale view —
+        # the same staleness window every read path already tolerates
+        # (the epoch key on the cache retires it at the next commit)
+        shard_of = self._shard_of
+        tomb = self._non_tpu
+        parts: dict[str, tuple[list, list]] = {}
+        non_tpu: list[str] = []
+        for i, n in enumerate(names_key):
+            key = shard_of.get(n)
+            if key is None:
+                if n in tomb:
+                    non_tpu.append(n)
+                    continue
+                return None
+            names, positions = parts.setdefault(key, ([], []))
+            names.append(n)
+            positions.append(i)
+        contiguous = not non_tpu and all(
+            pos[-1] - pos[0] + 1 == len(pos)
+            for _names, pos in parts.values()
+        )
+        return (
+            [(k, tuple(v[0]), tuple(v[1])) for k, v in parts.items()],
+            non_tpu,
+            contiguous,
+        )
+
+    def _shard_plan(self, node_names: list[str]):
+        """Sharded fast plan: partition the candidate list by shard and
+        resolve each part's frozen view. Returns ``(resolved, non_tpu,
+        contiguous, prefer)`` with ``resolved = [(shard, view entry,
+        names, positions)]``, or None -> per-node path. Lock-free on the
+        partition-cache hit path."""
+        prefer = self._BATCH_POLICIES.get(self.rater.name)
+        if prefer is None:
+            return None
+        key = tuple(node_names)
+        cached = self._part_cache.get(key)
+        if cached is None or cached[0] != self._nodes_epoch:
+            cached = (self._nodes_epoch, self._compute_partition(key))
+            # a partition is cheap to hold (names + positions), so the
+            # bound is looser than the 8-entry view cache: upstream
+            # predicate prefiltering can cycle many candidate subsets
+            while len(self._part_cache) >= 32:
+                try:
+                    self._part_cache.pop(next(iter(self._part_cache)), None)
+                except (StopIteration, RuntimeError):
+                    break
+            self._part_cache[key] = cached
+        partition = cached[1]
+        if partition is None:
+            return None
+        parts, non_tpu, contiguous = partition
+        resolved = []
+        for shard_key, names, positions in parts:
+            shard = self._shards.get(shard_key)
+            if shard is None:
+                return None
+            entry = self._view_for(shard, names)
+            if entry is None:
+                return None
+            _scorer, names_key, view_non_tpu, _index = entry
+            if view_non_tpu or len(names_key) != len(names):
+                return None  # membership drifted under the partition
+            resolved.append((shard, entry, names, positions))
+        return resolved, non_tpu, contiguous, prefer
+
+    def _run_shards(self, resolved, demand, prefer: bool, member_slices):
+        """Score every shard part. More than one part fans out on the
+        thread pool: each part is one native ``score_batch`` call that
+        releases the GIL, so shards genuinely score in parallel. Results
+        come back in part order (pool.map preserves it) — deterministic
+        regardless of completion order."""
+        def run_one(item):
+            return item[1][0].run(demand, prefer, member_slices)
+
+        if len(resolved) == 1:
+            return [run_one(resolved[0])]
+        return list(self._pool.map(run_one, resolved))
+
+    def _sharded_assume(self, node_names: list[str], pod: Pod, demand,
+                        trace=None):
+        """Sharded Filter: parallel per-shard native scoring merged back
+        into candidate order. Returns (ok, failed) — the same lists, in
+        the same order, the single-shard batch path builds (the parity
+        pin in tests/test_shard.py holds the merge to byte equality) —
+        or None for the warming per-node path."""
+        plan = self._shard_plan(node_names)
+        if plan is None:
+            return None
+        resolved, non_tpu, _contiguous, prefer = plan
+        if trace is not None:
+            trace.event(
+                "shard:fanout",
+                f"shards={len(resolved)} "
+                f"rows={sum(len(item[2]) for item in resolved)}",
+            )
+        runs = self._run_shards(
+            resolved, demand, prefer, self._gang_member_slices(pod) or None
+        )
+        feas: list = [None] * len(node_names)
+        for item, (feasible, _scores) in zip(resolved, runs):
+            for pos, f in zip(item[3], feasible):
+                feas[pos] = f
+        ok = [n for n, f in zip(node_names, feas) if f]
+        failed = {
+            n: types.REASON_NO_CAPACITY
+            for n, f in zip(node_names, feas)
+            if f is False
+        }
+        failed.update({n: "not a TPU node" for n in non_tpu})
+        return ok, failed
+
+    def _sharded_score(self, node_names: list[str], pod: Pod, demand,
+                       member_slices, trace=None):
+        """Sharded Prioritize: parallel per-shard native scoring merged
+        back into candidate order (non-TPU candidates score SCORE_MIN,
+        exactly as the single-shard path does). None -> per-node path."""
+        plan = self._shard_plan(node_names)
+        if plan is None:
+            return None
+        resolved, _non_tpu, _contiguous, prefer = plan
+        if trace is not None:
+            trace.event(
+                "shard:fanout",
+                f"shards={len(resolved)} "
+                f"rows={sum(len(item[2]) for item in resolved)}",
+            )
+        runs = self._run_shards(resolved, demand, prefer,
+                                member_slices or None)
+        out = [types.SCORE_MIN] * len(node_names)
+        for item, (_feasible, scores) in zip(resolved, runs):
+            for pos, score in zip(item[3], scores):
+                out[pos] = score
+        return list(zip(node_names, out))
+
+    def top_candidates(self, node_names: list[str], pod: Pod,
+                       k: int | None = 1) -> list[tuple[str, int]]:
+        """The best ``k`` feasible ``(host, score)`` pairs for this pod,
+        merged across shards by the single deterministic top-k reduce
+        (:func:`nanotpu.dealer.shard.merge_top_k`: score descending, then
+        name ascending) — shard count cannot change the answer. The
+        unsharded dealer ranks the same way, so this is THE tie-break
+        contract consumers should rely on (the bench's 4096-host row
+        cross-checks its HTTP-derived pick against it)."""
+        demand = self._demand_of(pod)
+        if not demand.is_valid():
+            return []
+        if self._shard_fn is not None:
+            plan = self._shard_plan(node_names)
+            if plan is not None:
+                resolved, _non_tpu, _contiguous, prefer = plan
+                runs = self._run_shards(
+                    resolved, demand, prefer,
+                    self._gang_member_slices(pod) or None,
+                )
+                lists = [
+                    [
+                        (n, s)
+                        for n, f, s in zip(item[2], feasible, scores)
+                        if f
+                    ]
+                    for item, (feasible, scores) in zip(resolved, runs)
+                ]
+                return merge_top_k(lists, k)
+        ok, _failed = self.assume(node_names, pod)
+        feasible_set = set(ok)
+        scored = self.score(node_names, pod)
+        return merge_top_k(
+            [[(n, s) for n, s in scored if n in feasible_set]], k
+        )
 
     # -- fused verb fast paths ---------------------------------------------
     #
@@ -659,8 +964,59 @@ class Dealer:
             return None
         return scorer, demand, prefer
 
+    def _sharded_payload(self, node_names: list[str], pod: Pod,
+                         mode: int) -> bytes | None:
+        """Sharded fused path: parallel native ``nanotpu_score_render``
+        calls — one per shard, each rendering its own slice of the
+        response from its own frozen arena — then a bytewise splice in
+        request order. Requires every candidate mapped to a shard and
+        each shard's candidates contiguous in the request (the fleet
+        factory and nodeCacheCapable candidate lists both satisfy this);
+        anything else returns None and the verb takes the merged list
+        path, which produces the same bytes through the render caches.
+        ``mode`` 0 = ExtenderFilterResult, 1 = HostPriorityList."""
+        demand = self._demand_of(pod)
+        plan = self._shard_plan(node_names) if demand.is_valid() else None
+        if plan is None:
+            self.perf.fastpath_misses += 1
+            return None
+        resolved, non_tpu, contiguous, prefer = plan
+        if non_tpu or not contiguous:
+            self.perf.fastpath_misses += 1
+            return None
+        for _shard, entry, names, _pos in resolved:
+            if not entry[0].ensure_renderer(names):
+                self.perf.fastpath_misses += 1
+                return None
+        member = self._gang_member_slices(pod) or None
+
+        def render_one(item):
+            scorer = item[1][0]
+            if mode == 0:
+                return scorer.filter_payload(demand, prefer, member)
+            return scorer.priorities_payload(demand, prefer, member)
+
+        if len(resolved) == 1:
+            payloads = [render_one(resolved[0])]
+        else:
+            payloads = list(self._pool.map(render_one, resolved))
+        if any(p is None for p in payloads):
+            self.perf.fastpath_misses += 1
+            return None
+        merged = (
+            splice_filter_payloads(payloads) if mode == 0
+            else splice_priorities_payloads(payloads)
+        )
+        if merged is None:
+            self.perf.fastpath_misses += 1
+            return None
+        self.perf.fastpath_hits += 1
+        return merged
+
     def filter_payload(self, node_names: list[str], pod: Pod) -> bytes | None:
         """ExtenderFilterResult JSON bytes, or None -> use assume()."""
+        if self._shard_fn is not None:
+            return self._sharded_payload(node_names, pod, 0)
         plan = self._payload_plan(node_names, pod)
         if plan is None:
             self.perf.fastpath_misses += 1
@@ -679,6 +1035,8 @@ class Dealer:
         self, node_names: list[str], pod: Pod
     ) -> bytes | None:
         """HostPriorityList JSON bytes, or None -> use score()."""
+        if self._shard_fn is not None:
+            return self._sharded_payload(node_names, pod, 1)
         plan = self._payload_plan(node_names, pod)
         if plan is None:
             self.perf.fastpath_misses += 1
@@ -720,7 +1078,7 @@ class Dealer:
         if trace is not None:
             trace.event(
                 "snapshot:read",
-                f"gen={self._published.gen} candidates={len(node_names)}",
+                f"gen={self._snapshot_gen()} candidates={len(node_names)}",
             )
         demand = self._demand_of(pod)
         if not demand.is_valid():
@@ -730,25 +1088,33 @@ class Dealer:
                 for n in node_names
             }
 
-        batch = self._batch_plan(node_names)
-        if batch is not None:
-            scorer, names_key, non_tpu, prefer = batch
-            if trace is not None:
-                trace.event("native:batch-score", f"rows={len(names_key)}")
-            # pass the gang context even though Filter ignores scores: the
-            # native result is memoized, so the immediately following
-            # Prioritize (same pod, same state) reuses this exact call
-            feasible, _ = scorer.run(
-                demand, prefer, self._gang_member_slices(pod) or None
-            )
-            ok = [n for n, f in zip(names_key, feasible) if f]
-            failed = {
-                n: types.REASON_NO_CAPACITY
-                for n, f in zip(names_key, feasible)
-                if not f
-            }
-            failed.update({n: "not a TPU node" for n in non_tpu})
-            return ok, failed
+        if self._shard_fn is not None:
+            merged = self._sharded_assume(node_names, pod, demand, trace)
+            if merged is not None:
+                return merged
+        else:
+            batch = self._batch_plan(node_names)
+            if batch is not None:
+                scorer, names_key, non_tpu, prefer = batch
+                if trace is not None:
+                    trace.event(
+                        "native:batch-score", f"rows={len(names_key)}"
+                    )
+                # pass the gang context even though Filter ignores scores:
+                # the native result is memoized, so the immediately
+                # following Prioritize (same pod, same state) reuses this
+                # exact call
+                feasible, _ = scorer.run(
+                    demand, prefer, self._gang_member_slices(pod) or None
+                )
+                ok = [n for n, f in zip(names_key, feasible) if f]
+                failed = {
+                    n: types.REASON_NO_CAPACITY
+                    for n, f in zip(names_key, feasible)
+                    if not f
+                }
+                failed.update({n: "not a TPU node" for n in non_tpu})
+                return ok, failed
 
         def try_node(name: str) -> tuple[str, str | None]:
             info = self._node_info(name)
@@ -804,12 +1170,13 @@ class Dealer:
         if cached is not None and cached[0] == key and cached[1] == rev:
             return cached[2]
         member_slices: list[tuple[str, str]] = []
-        published = self._published.nodes
         for node in self.gangs.bound_nodes(key):
-            # published snapshot first: the memo-miss path then usually
-            # takes no locks either (slice geometry is structural, so the
-            # snapshot copy is exactly as fresh as the epoch in `rev`)
-            member = published.get(node) or self._node_info(node)
+            # published snapshot first (per-shard lookup in sharded mode —
+            # gang members CAN span shards): the memo-miss path then
+            # usually takes no locks either (slice geometry is structural,
+            # so the snapshot copy is exactly as fresh as the epoch in
+            # `rev`)
+            member = self._published_node(node) or self._node_info(node)
             if member is not None:
                 member_slices.append((member.slice_name, member.slice_coords))
         self._gms_cache = (key, rev, member_slices)
@@ -823,14 +1190,21 @@ class Dealer:
         if trace is not None:
             trace.event(
                 "snapshot:read",
-                f"gen={self._published.gen} candidates={len(node_names)}",
+                f"gen={self._snapshot_gen()} candidates={len(node_names)}",
             )
         demand = self._demand_of(pod)
         if not demand.is_valid():
             return [(n, types.SCORE_MIN) for n in node_names]
         member_slices = self._gang_member_slices(pod)
 
-        batch = self._batch_plan(node_names)
+        if self._shard_fn is not None:
+            merged = self._sharded_score(
+                node_names, pod, demand, member_slices, trace
+            )
+            if merged is not None:
+                return merged
+        batch = None if self._shard_fn is not None else \
+            self._batch_plan(node_names)
         if batch is not None:
             bscorer, names_key, _non_tpu, prefer = batch
             if trace is not None:
@@ -949,6 +1323,12 @@ class Dealer:
             )
         if trace is not None:
             trace.event("bind:reserved", node_name)
+            if self._shard_fn is not None:
+                # thread the shard identity into the bind's causal record:
+                # which publication domain this reservation republished
+                trace.event(
+                    "bind:shard", self._shard_of.get(node_name, "?")
+                )
         # publish the reservation NOW, not at bind completion: the API
         # writes (and a strict gang's park window) can take seconds, and
         # concurrent Filters reading the old snapshot would keep steering
@@ -1333,15 +1713,58 @@ class Dealer:
         total = sum(i.chips.percent_total() for i in infos)
         return used / total if total else 0.0
 
+    def shard_status(self) -> dict:
+        """Per-shard publication state — generation, published host
+        count, membership epoch vs published epoch, cached view count.
+        A stale shard (epoch ahead of published_epoch, or a generation
+        that stopped moving while siblings advance) is diagnosable from
+        the outside via /debug/decisions and :meth:`debug_snapshot`."""
+        out: dict[str, dict] = {}
+        # list() snapshot: a concurrent _register_node may grow the dict
+        for key, shard in list(self._shards.items()):
+            snap = shard._published
+            out[key] = {
+                "gen": snap.gen,
+                "hosts": len(snap.nodes),
+                "epoch": (
+                    self._nodes_epoch if self._shard_fn is None
+                    else shard.epoch
+                ),
+                "published_epoch": shard._pub_epoch,
+                "views": len(snap.views),
+            }
+        return out
+
+    def perf_totals(self) -> dict[str, int]:
+        """Fleet-wide attribution: the dealer's request-level counters
+        plus every shard's own (the bench's per-rep deltas and the
+        unlabeled ``nanotpu_sched_*`` gauges read this; per-shard values
+        stay visible via :meth:`perf_by_shard`)."""
+        out = self.perf.snapshot()
+        for shard in list(self._shards.values()):
+            if shard.perf is self.perf:
+                continue  # single-shard mode aliases the dealer counters
+            for name, value in shard.perf.snapshot().items():
+                out[name] += value
+        return out
+
+    def perf_by_shard(self) -> dict[str, dict[str, int]]:
+        """Per-shard attribution counter snapshots keyed by shard key."""
+        return {
+            key: shard.perf.snapshot()
+            for key, shard in list(self._shards.items())
+        }
+
     def debug_snapshot(self) -> dict:
         """Deep-introspection view for harnesses and invariant checkers
         (nanotpu.sim): tracked/reserved uids, uid -> accounting node, and
-        the LIVE NodeInfo objects keyed by node name. The maps are copies
-        (safe to iterate), the NodeInfos are the real instances — callers
+        the LIVE NodeInfo objects keyed by node name, plus per-shard
+        publication state (``shards``). The maps are copies (safe to
+        iterate), the NodeInfos are the real instances — callers
         that inspect chip state must tolerate concurrent verbs, or (like
         the single-threaded sim) guarantee none are in flight."""
         with self._lock:
-            return {
+            out = {
                 "tracked_uids": sorted(self._pods),
                 "reserved_uids": sorted(self._reserved),
                 "accounted": {
@@ -1349,6 +1772,8 @@ class Dealer:
                 },
                 "node_infos": dict(self._nodes),
             }
+        out["shards"] = self.shard_status()
+        return out
 
     def close(self) -> None:
         """Release the assume thread pool. Only needed by harnesses that

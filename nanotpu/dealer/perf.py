@@ -13,6 +13,15 @@ memo), where `+=` is already serialized. The fastpath_* pair is bumped on
 the lock-free verb path; under CPython's GIL a lost update there is
 vanishingly rare and only ever undercounts attribution, never corrupts
 scheduling state.
+
+Sharded attribution (r7): each snapshot shard owns its OWN PerfCounters
+instance — publishes, view work, and native calls are attributed to the
+shard that did them (``Dealer.perf_by_shard()``, the
+``nanotpu_sched_shard`` metric family, and the bench's per-rep
+``attr["shards"]`` split). The dealer's own instance keeps the
+request-level counters (fastpath hits/misses); ``Dealer.perf_totals()``
+sums both for the fleet-wide view. Single-shard dealers alias the
+dealer's instance onto their one shard, so nothing changes there.
 """
 
 from __future__ import annotations

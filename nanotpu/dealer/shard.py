@@ -1,0 +1,202 @@
+"""Per-pool snapshot shards: the dealer's RCU publication domains.
+
+The r6 hot path published ONE ``_Snapshot`` for the whole fleet: every
+commit advanced every cached candidate-list view, and every structural
+change dropped them all. That is fine at 256 hosts and wrong at 4096 —
+"millions of users" means Filter/Prioritize over thousands of nodes per
+cycle, and a monolithic arena makes every bind pay for the whole fleet
+(ISSUE r7 tentpole; Tesserae's partitioned-placement result is the
+reference for why splitting the search space does not cost placement
+quality — scores are per-node pure functions, so a partition merge is
+exact, not approximate).
+
+A :class:`_Shard` is one independent publication domain keyed by **slice
+family** (TPU generation + the slice-label family, i.e. the pool): it
+owns its own RCU-published snapshot chain, publisher lock, commit
+sequence, structural epoch, and :class:`~nanotpu.dealer.perf.PerfCounters`
+— so a bind on pool A republishes pool A's views only (an incremental
+delta), pool B's readers never even observe a generation bump, and
+Filter/Prioritize fan scoring out across shards in parallel native calls.
+
+Merge determinism: per-shard score lists reassemble by candidate
+position (exact), and consumers that want "the best k hosts" use
+:func:`merge_top_k`, a single deterministic reduce ordered by
+``(-score, name)`` — shard count can never change the answer, which is
+what the sharded-vs-single parity pin in tests/test_shard.py asserts
+byte-for-byte. ``splice_filter_payloads``/``splice_priorities_payloads``
+merge per-shard fused ``nanotpu_score_render`` responses bytewise; they
+require each shard's candidates to form one contiguous run of the request
+order (the caller checks), so the merged body is byte-identical to what a
+single shard covering every candidate would have rendered.
+
+Every shard lock is built through the witness factories
+(docs/static-analysis.md): the runtime lock-order witness and the static
+lock-discipline pass both see ``_Shard._publish_lock``, and production
+code never holds two shard publish locks at once (``Dealer._republish``
+publishes shards strictly one at a time), so no cross-shard order exists
+to invert.
+"""
+
+from __future__ import annotations
+
+import re
+
+from nanotpu.analysis.witness import make_lock
+from nanotpu.dealer.perf import PerfCounters
+
+#: the shard key of an unsharded dealer (one shard holds the whole fleet)
+DEFAULT_SHARD_KEY = "all"
+
+#: ``slice-3`` / ``v4slice-0`` -> family ``slice`` / ``v4slice``: slices of
+#: one pool share a label prefix and differ only in the trailing index
+_TRAILING_INDEX = re.compile(r"-\d+$")
+
+
+def family_of(slice_name: str) -> str:
+    """The slice-family (pool) component of a shard key: the slice label
+    with its trailing ``-<index>`` stripped. Empty label -> empty family
+    (unlabeled nodes pool together per generation)."""
+    if not slice_name:
+        return ""
+    return _TRAILING_INDEX.sub("", slice_name)
+
+
+def shard_key_of(info) -> str:
+    """Shard key for a NodeInfo: ``<generation>/<slice family>`` — one
+    shard per pool, the partition the fleet factory (sim/fleet.py) and
+    real multi-pool clusters both produce."""
+    return f"{info.generation}/{family_of(info.slice_name)}"
+
+
+class _Snapshot:
+    """One RCU-published, immutable view of a shard's placement state.
+
+    Read verbs (Filter/Prioritize) consume whatever the owning shard's
+    ``_published`` points at WITHOUT the dealer lock: the reference swap
+    is atomic under the GIL, ``nodes``/``non_tpu`` are never mutated
+    after publication, and each cached candidate-list view is a frozen
+    :class:`~nanotpu.dealer.batch.BatchScorer` whose row arrays are
+    written once. Writers build a successor snapshot after their commit
+    and swap it in (``Dealer._republish_shard``) — readers never contend
+    with them and never trigger synchronous rebuilds; at worst they score
+    against the previous generation, the same staleness window the old
+    lock-and-probe path already had (kube-scheduler's bind re-checks
+    under the node lock either way).
+
+    ``views`` maps a candidate-name tuple to ``(scorer, known names,
+    non-TPU names, name->row index)`` — or ``None`` when that list cannot
+    take the batch path in this snapshot (cold/unknown candidates,
+    heterogeneous pool, native unavailable). Caching the None verdict is
+    sound because anything that could change it (a node materializing, a
+    topology change) is structural and structural publishes start with
+    empty views. Reader threads insert into ``views`` lazily; dict ops
+    are atomic under the GIL and a racing double-build is just wasted
+    work.
+    """
+
+    __slots__ = ("gen", "nodes", "non_tpu", "views")
+
+    def __init__(self, gen: int, nodes: dict, non_tpu: frozenset):
+        self.gen = gen
+        self.nodes = nodes
+        self.non_tpu = non_tpu
+        self.views: dict[tuple, tuple | None] = {}
+
+
+class _Shard:
+    """One publication domain: snapshot chain + publisher state + perf.
+
+    All fields except ``perf`` and ``key`` are written under
+    ``_publish_lock`` (``epoch`` under the dealer lock); ``_published``
+    is read lock-free by verbs. ``perf`` may be the dealer's own counters
+    (single-shard mode aliases them so existing attribution reads are
+    unchanged) or shard-private ones (sharded mode, where per-shard
+    attribution is the point)."""
+
+    __slots__ = (
+        "key", "perf", "epoch", "_publish_lock", "_published",
+        "_pub_epoch", "_commit_seq",
+    )
+
+    def __init__(self, key: str, perf: PerfCounters | None = None):
+        self.key = key
+        self.perf = perf or PerfCounters()
+        #: bumped (under the dealer lock) on any structural change to this
+        #: shard's membership; a mismatch with ``_pub_epoch`` makes the
+        #: next publish rebuild the mapping and drop the views
+        self.epoch = 0
+        self._publish_lock = make_lock("_Shard._publish_lock")
+        self._published = _Snapshot(0, {}, frozenset())
+        self._pub_epoch = -1
+        #: bumped at the START of every publish attempt on this shard,
+        #: including skipped ones: lets a reader detect that a commit
+        #: raced its lazy view build (see Dealer._view_for)
+        self._commit_seq = 0
+
+
+def merge_top_k(scored_lists, k: int | None = None) -> list[tuple[str, int]]:
+    """THE deterministic top-k reduce over per-shard ``(name, score)``
+    lists: score descending, then name ascending — a total order with no
+    hash-dependent ties, so the merge is independent of shard count,
+    shard iteration order, and per-shard list order. ``k=None`` returns
+    the full merged ranking."""
+    merged: list[tuple[str, int]] = []
+    for scored in scored_lists:
+        merged.extend(scored)
+    merged.sort(key=lambda ns: (-ns[1], ns[0]))
+    if k is None:
+        return merged
+    return merged[:k]
+
+
+# -- bytewise payload splicing (the sharded fused-render merge) ------------
+#
+# Each per-shard payload comes from our own native renderer
+# (native/allocator.cc nanotpu_render_*), whose frame is fixed:
+# filter  = {"NodeNames":[...],"FailedNodes":{...},"Error":""}
+# priorities = [{"Host":...,"Score":...},...]
+# The frame byte-patterns below cannot occur INSIDE a payload string:
+# any '"' within a JSON-encoded node name is escaped to '\"', so the
+# unescaped '],"FailedNodes":{' run only ever appears as the frame.
+
+_FILTER_HEAD = b'{"NodeNames":['
+_FILTER_MID = b'],"FailedNodes":{'
+_FILTER_TAIL = b'},"Error":""}'
+
+
+def splice_filter_payloads(payloads: list[bytes]) -> bytes | None:
+    """Merge per-shard ExtenderFilterResult bodies into the body a single
+    shard over the concatenated candidate list would render. Caller
+    guarantees the shard runs are contiguous and in request order; None
+    on any frame surprise (caller falls back to the list path)."""
+    names: list[bytes] = []
+    fails: list[bytes] = []
+    for p in payloads:
+        if not (p.startswith(_FILTER_HEAD) and p.endswith(_FILTER_TAIL)):
+            return None
+        mid = p.find(_FILTER_MID, len(_FILTER_HEAD))
+        if mid < 0:
+            return None
+        inner_names = p[len(_FILTER_HEAD):mid]
+        inner_fails = p[mid + len(_FILTER_MID):-len(_FILTER_TAIL)]
+        if inner_names:
+            names.append(inner_names)
+        if inner_fails:
+            fails.append(inner_fails)
+    return (
+        _FILTER_HEAD + b",".join(names)
+        + _FILTER_MID + b",".join(fails) + _FILTER_TAIL
+    )
+
+
+def splice_priorities_payloads(payloads: list[bytes]) -> bytes | None:
+    """Merge per-shard HostPriorityList bodies (see
+    :func:`splice_filter_payloads` for the contract)."""
+    inner: list[bytes] = []
+    for p in payloads:
+        if not (p.startswith(b"[") and p.endswith(b"]")):
+            return None
+        body = p[1:-1]
+        if body:
+            inner.append(body)
+    return b"[" + b",".join(inner) + b"]"

@@ -46,7 +46,7 @@ from dataclasses import dataclass
 
 from nanotpu.analysis.witness import make_lock
 from nanotpu.dealer import Dealer
-from nanotpu.metrics.registry import Registry
+from nanotpu.metrics.registry import Registry, _escape_label_value
 from nanotpu.metrics.resilience import ResilienceCounters, ResilienceExporter
 from nanotpu.obs import Observability, set_current
 from nanotpu.obs.decisions import REASON_ADMISSION_SHED, REASON_DEADLINE_SHED
@@ -110,6 +110,36 @@ class OverloadConfig:
         return budget
 
 
+class ShardPerfExporter:
+    """Registry-compatible renderer (``Registry.register``) exposing the
+    dealer's per-shard attribution counters as one labeled gauge family
+    — ``nanotpu_sched_shard{shard="v5p/slice",counter="view_builds"}`` —
+    so a scrape can tell WHICH publication domain is doing the work
+    (docs/sharding.md). A distinct family, not extra labels on the
+    ``nanotpu_sched_*`` totals, because a Prometheus metric family must
+    not mix labeled and unlabeled series."""
+
+    def __init__(self, dealer: Dealer):
+        self.dealer = dealer
+
+    def render(self) -> list[str]:
+        out = [
+            "# HELP nanotpu_sched_shard Per-shard dealer hot-path "
+            "attribution counters (see the matching unlabeled "
+            "nanotpu_sched_* totals)",
+            "# TYPE nanotpu_sched_shard gauge",
+        ]
+        by_shard = self.dealer.perf_by_shard()
+        for key in sorted(by_shard):
+            snap = by_shard[key]
+            for counter in sorted(snap):
+                out.append(
+                    f'nanotpu_sched_shard{{counter="{counter}",'
+                    f'shard="{_escape_label_value(key)}"}} {snap[counter]}'
+                )
+        return out
+
+
 class SchedulerAPI:
     """Wires verbs + metrics; handler-agnostic so tests can call dispatch()
     without sockets and the bench can measure the exact request path."""
@@ -154,14 +184,23 @@ class SchedulerAPI:
         # Prometheus scrape and the bench's per-rep deltas read the same
         # counters: a slow window names its own cause (GC vs scorer rebuild
         # vs renderer warmup vs fallback path) instead of "flat loadavg,
-        # unattributed" (VERDICT r5 weak #2)
+        # unattributed" (VERDICT r5 weak #2). The unlabeled series are
+        # fleet-wide totals (request-level + every shard); per-shard
+        # attribution rides alongside as nanotpu_sched_shard{shard,counter}
+        # (docs/sharding.md) so a stale or slow shard names itself.
+        perf_totals = getattr(dealer, "perf_totals", None)
         for name in dealer.perf.__slots__:
             g = r.gauge(
                 f"nanotpu_sched_{name}",
                 f"Dealer hot-path attribution counter: "
                 f"{name.replace('_', ' ')}",
             )
-            g.set_function(lambda n=name: getattr(dealer.perf, n))
+            if perf_totals is not None:
+                g.set_function(lambda n=name: perf_totals()[n])
+            else:
+                g.set_function(lambda n=name: getattr(dealer.perf, n))
+        if getattr(dealer, "perf_by_shard", None) is not None:
+            r.register(ShardPerfExporter(dealer))
         for gen in range(3):
             g = r.gauge(
                 f"nanotpu_gc_gen{gen}_collections",
@@ -499,6 +538,7 @@ class SchedulerAPI:
                 "BadRequest", "limit must be an integer"
             )
         records = self.obs.ledger.recent(limit)
+        shard_status = getattr(self.dealer, "shard_status", None)
         return 200, "application/json", json.dumps({
             "sampling": self.obs.tracer.sample,
             "count": len(records),
@@ -507,6 +547,11 @@ class SchedulerAPI:
             # ring-recorded — an overload burst must not evict the
             # per-pod records this endpoint exists to serve
             "aborts": self.obs.ledger.abort_summary(),
+            # per-shard snapshot generation / host count / epochs: a
+            # stale shard (epoch ahead of published_epoch, or a gen that
+            # stopped moving while siblings advance) is diagnosable from
+            # the outside (docs/sharding.md)
+            "shards": shard_status() if shard_status is not None else {},
         }, sort_keys=True)
 
     # -- idle-time GC (the between-burst half of the GC discipline) --------

@@ -148,7 +148,7 @@ class Simulator:
         )
         self.dealer = Dealer(
             api_client, make_rater(self.scenario["policy"]), assume_workers=2,
-            obs=self.obs,
+            obs=self.obs, shards=self.scenario["shards"],
         )
         self.predicate = Predicate(self.dealer, obs=self.obs)
         self.prioritize = Prioritize(self.dealer, obs=self.obs)

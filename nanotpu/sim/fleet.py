@@ -74,6 +74,18 @@ def make_fleet(spec: dict, client: FakeClientset | None = None) -> FakeClientset
 
     Pools are created in listed order; node names must not collide across
     pools (give each pool a distinct ``prefix``).
+
+    ``count: N`` replicates a pool entry N times with distinct name and
+    slice prefixes (``<prefix>-p<i>`` / ``<slice_prefix>-p<i>``) — the
+    shorthand that makes 4096-host multi-pool fleets one line::
+
+        {"pools": [{"generation": "v5p", "hosts": 1024, "slice_hosts": 64,
+                    "prefix": "v5p-pool", "count": 4}]}
+
+    Each replica is its own slice family, so a sharded dealer
+    (``shards: "auto"``, docs/sharding.md) gives each replica its own
+    snapshot shard. ``count`` 1 (the default) leaves names byte-identical
+    to what this factory always produced.
     """
     client = client or FakeClientset()
     pools = spec.get("pools")
@@ -81,22 +93,38 @@ def make_fleet(spec: dict, client: FakeClientset | None = None) -> FakeClientset
         raise ValueError("fleet spec needs a non-empty 'pools' list")
     seen: set[str] = set()
     for p, pool in enumerate(pools):
-        nodes = pool_nodes(
-            hosts=int(pool.get("hosts", 1)),
-            generation=pool.get("generation", "v5p"),
-            chips_per_host=pool.get("chips_per_host"),
-            slice_hosts=pool.get("slice_hosts"),
-            prefix=pool.get("prefix"),
-            slice_prefix=pool.get("slice_prefix", f"slice{p}" if p else "slice"),
+        count = int(pool.get("count", 1))
+        if count < 1:
+            raise ValueError(f"pool {p}: count must be >= 1, got {count}")
+        base_prefix = pool.get("prefix")
+        base_slice_prefix = pool.get(
+            "slice_prefix", f"slice{p}" if p else "slice"
         )
-        for node in nodes:
-            if node.name in seen:
-                raise ValueError(
-                    f"fleet node name collision: {node.name!r} (give pool "
-                    f"{p} a distinct 'prefix')"
+        for rep in range(count):
+            prefix = base_prefix
+            slice_prefix = base_slice_prefix
+            if count > 1:
+                prefix = (
+                    f"{base_prefix or pool.get('generation', 'v5p') + '-host'}"
+                    f"-p{rep}"
                 )
-            seen.add(node.name)
-            client.create_node(node)
+                slice_prefix = f"{base_slice_prefix}-p{rep}"
+            nodes = pool_nodes(
+                hosts=int(pool.get("hosts", 1)),
+                generation=pool.get("generation", "v5p"),
+                chips_per_host=pool.get("chips_per_host"),
+                slice_hosts=pool.get("slice_hosts"),
+                prefix=prefix,
+                slice_prefix=slice_prefix,
+            )
+            for node in nodes:
+                if node.name in seen:
+                    raise ValueError(
+                        f"fleet node name collision: {node.name!r} (give "
+                        f"pool {p} a distinct 'prefix')"
+                    )
+                seen.add(node.name)
+                client.create_node(node)
     return client
 
 

@@ -37,6 +37,10 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
       "invariant_every_events": 1,
       "assume_ttl_s": 0.0,           # >0: sweep assumed-never-bound pods
       "queue_max": 0,                # >0: bound the controller sync queue
+      "shards": 1,                   # 1 (single publication domain,
+                                     # byte-identical to the pre-shard
+                                     # dealer) or "auto" (one RCU shard
+                                     # per slice family — docs/sharding.md)
       "lock_witness": false,         # true: instrument every lock and
                                      # assert acquisition-order acyclicity
                                      # at teardown (docs/static-analysis.md)
@@ -132,6 +136,11 @@ def normalize_scenario(raw: dict) -> dict:
         float(f["api_brownout"].get("duration_s", 0) or 0) >= 0,
         "faults.api_brownout.duration_s must be >= 0",
     )
+    shards = raw.get("shards", 1)
+    _require(
+        shards in (1, "auto"),
+        f"shards must be 1 or 'auto', got {shards!r}",
+    )
 
     return {
         "name": raw.get("name", "unnamed"),
@@ -147,6 +156,7 @@ def normalize_scenario(raw: dict) -> dict:
         "invariant_every_events": int(raw.get("invariant_every_events", 1)),
         "assume_ttl_s": float(raw.get("assume_ttl_s", 0.0)),
         "queue_max": int(raw.get("queue_max", 0)),
+        "shards": shards,
         "lock_witness": bool(raw.get("lock_witness", False)),
         "trace": bool(raw.get("trace", True)),
     }

@@ -79,6 +79,63 @@ class TestLockDiscipline:
             """, "lock-discipline")
         assert report.findings == []
 
+    def test_cross_shard_lock_order_inversion(self, tmp_path):
+        """ISSUE r7 satellite: two shard publish locks taken in opposite
+        orders by two code paths is the canonical sharded-dealer
+        deadlock; the pass must name the cycle. (Production never holds
+        two at once — Dealer._republish publishes shards one at a time —
+        so this fixture SEEDS the violation the discipline forbids.)"""
+        report = one(tmp_path, """
+            from nanotpu.analysis.witness import make_lock
+
+            class ShardA:
+                def __init__(self):
+                    self.publish_lock = make_lock("ShardA._publish_lock")
+
+            class ShardB:
+                def __init__(self):
+                    self.publish_lock = make_lock("ShardB._publish_lock")
+
+            class Dealer:
+                def republish_ab(self, sa: ShardA, sb: ShardB):
+                    with sa.publish_lock:
+                        with sb.publish_lock:
+                            pass
+
+                def republish_ba(self, sa: ShardA, sb: ShardB):
+                    with sb.publish_lock:
+                        with sa.publish_lock:
+                            pass
+            """, "lock-discipline")
+        cycles = [f for f in report.findings if "cycle" in f.message]
+        assert cycles, report.findings
+        assert any(
+            "ShardA.publish_lock" in f.message
+            and "ShardB.publish_lock" in f.message
+            for f in cycles
+        ), cycles
+
+    def test_blocking_call_under_shard_publish_lock(self, tmp_path):
+        """_Shard._publish_lock is a HOT lock: an apiserver round-trip
+        under a shard publish must be a finding, exactly as it was under
+        the old monolithic Dealer._publish_lock."""
+        report = one(tmp_path, """
+            from nanotpu.analysis.witness import make_lock
+
+            class _Shard:
+                def __init__(self):
+                    self._publish_lock = make_lock("_Shard._publish_lock")
+
+            class Dealer:
+                def republish(self, shard: _Shard):
+                    with shard._publish_lock:
+                        self.client.get_node("n")
+            """, "lock-discipline")
+        assert any(
+            "_Shard._publish_lock" in f.message and "blocking" in f.message
+            for f in report.findings
+        ), report.findings
+
     def test_blocking_call_under_hot_lock(self, tmp_path):
         report = one(tmp_path, """
             class Dealer:
@@ -692,6 +749,92 @@ class TestWitness:
         msg = str(exc.value)
         assert "A -> B" in msg or "B -> A" in msg
         assert "thread" in msg  # witness stacks name their thread
+
+    def test_cross_shard_inversion_witnessed(self):
+        """ISSUE r7 satellite: shard publish locks are registered through
+        the witness factories, so a runtime order disagreement between
+        two shards' locks (thread 1: pool A then pool B; thread 2: the
+        reverse) must fail assert_acyclic with both witness stacks.
+        Private witness + per-instance names: the production discipline
+        (one shard publish at a time, never nested) means the GLOBAL
+        graph can never contain these edges — this seeds the violation."""
+        w = witness.LockWitness()
+        shard_a, shard_b = self._locks(
+            w, "Shard[v5p/fama]._publish_lock",
+            "Shard[v5p/famb]._publish_lock",
+        )
+        barrier = threading.Barrier(2)
+
+        def publish_ab():
+            with shard_a:
+                with shard_b:
+                    pass
+            barrier.wait(2)
+
+        def publish_ba():
+            barrier.wait(2)
+            with shard_b:
+                with shard_a:
+                    pass
+
+        t1 = threading.Thread(target=publish_ab)
+        t2 = threading.Thread(target=publish_ba)
+        t1.start(); t2.start(); t1.join(5); t2.join(5)
+        with pytest.raises(witness.LockOrderError) as exc:
+            w.assert_acyclic()
+        assert "Shard[v5p/fama]._publish_lock" in str(exc.value)
+        assert "Shard[v5p/famb]._publish_lock" in str(exc.value)
+
+    def test_sharded_dealer_publishes_acyclic_under_witness(self):
+        """The production order — every shard publish takes exactly one
+        _Shard._publish_lock then briefly Dealer._lock — must leave the
+        witness graph acyclic under concurrent multi-shard commits."""
+        prior_forced = witness._forced
+        witness.enable()
+        try:
+            from nanotpu import types
+            from nanotpu.allocator.rater import make_rater
+            from nanotpu.dealer import Dealer
+            from nanotpu.k8s.objects import make_container, make_pod
+            from nanotpu.sim.fleet import make_fleet
+
+            client = make_fleet({"pools": [
+                {"generation": "v5p", "hosts": 4, "slice_hosts": 2,
+                 "prefix": "pa", "slice_prefix": "fa"},
+                {"generation": "v5p", "hosts": 4, "slice_hosts": 2,
+                 "prefix": "pb", "slice_prefix": "fb"},
+            ]})
+            dealer = Dealer(client, make_rater("binpack"), shards="auto")
+            nodes = [n.name for n in client.list_nodes()]
+
+            def schedule(prefix):
+                for i in range(6):
+                    pod = client.create_pod(make_pod(
+                        f"{prefix}-{i}",
+                        containers=[make_container(
+                            "t", {types.RESOURCE_TPU_PERCENT: 100}
+                        )],
+                    ))
+                    ok, _ = dealer.assume(nodes, pod)
+                    targets = [n for n in ok if n.startswith(prefix)]
+                    if targets:
+                        bound = dealer.bind(targets[0], pod)
+                        dealer.release(bound)
+
+            threads = [
+                threading.Thread(target=schedule, args=(p,))
+                for p in ("pa", "pb")
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+            dealer.close()
+            witness.global_witness().assert_acyclic()
+        finally:
+            # restore rather than disable(): the suite-wide witness
+            # (conftest's env arming) must stay in force after this test
+            witness._forced = prior_forced
 
     def test_consistent_order_is_acyclic(self):
         w = witness.LockWitness()

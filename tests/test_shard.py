@@ -1,0 +1,377 @@
+"""Sharded-dealer invariants (ISSUE r7 tentpole: per-pool snapshot
+shards, parallel native scoring, incremental deltas).
+
+The load-bearing property is the **parity pin**: a sharded dealer
+(``shards="auto"``) and a single-shard dealer (``shards=1``) driven
+through the REAL request path with the same event sequence must produce
+byte-identical Filter/Prioritize response bodies and identical bind
+outcomes — sharding is a performance partition, never a policy change.
+Plus the delta contract (a bind republishes ONLY its own shard), the
+deterministic top-k merge, the bytewise payload splice, and the
+diagnosability surfaces (debug_snapshot / /debug/decisions / /metrics).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from nanotpu import native, types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.dealer import Dealer
+from nanotpu.dealer.shard import (
+    family_of,
+    merge_top_k,
+    shard_key_of,
+    splice_filter_payloads,
+    splice_priorities_payloads,
+)
+from nanotpu.k8s.objects import make_container, make_pod
+from nanotpu.metrics.registry import Registry
+from nanotpu.routes.server import SchedulerAPI
+from nanotpu.sim.fleet import make_fleet
+
+#: two v5p pools + a v4 pool: three slice families -> three shards
+FLEET_SPEC = {
+    "pools": [
+        {"generation": "v5p", "hosts": 8, "slice_hosts": 4,
+         "prefix": "v5p-a", "slice_prefix": "fama"},
+        {"generation": "v5p", "hosts": 8, "slice_hosts": 4,
+         "prefix": "v5p-b", "slice_prefix": "famb"},
+        {"generation": "v4", "hosts": 4, "prefix": "v4-host",
+         "slice_prefix": "v4slice"},
+    ]
+}
+
+POD_SHAPES = (50, 100, 200, 400)
+
+
+def _mk_pod(client, name: str, percent: int, gang: str | None = None):
+    ann = {}
+    if gang:
+        ann = {
+            types.ANNOTATION_GANG_NAME: gang,
+            types.ANNOTATION_GANG_SIZE: "4",
+        }
+    return client.create_pod(
+        make_pod(
+            name,
+            containers=[
+                make_container("t", {types.RESOURCE_TPU_PERCENT: percent})
+            ],
+            annotations=ann,
+        )
+    )
+
+
+class _Stack:
+    def __init__(self, shards):
+        self.client = make_fleet(FLEET_SPEC)
+        self.dealer = Dealer(self.client, make_rater("binpack"),
+                             shards=shards)
+        self.api = SchedulerAPI(self.dealer, Registry())
+        self.nodes = [n.name for n in self.client.list_nodes()]
+
+    def verb(self, path: str, body: bytes):
+        code, _ctype, payload = self.api.dispatch("POST", path, body)
+        assert code == 200, (path, code, payload)
+        return payload if isinstance(payload, bytes) else payload.encode()
+
+    def close(self):
+        self.dealer.close()
+
+
+@pytest.fixture
+def stacks():
+    a, b = _Stack(1), _Stack("auto")
+    yield a, b
+    a.close()
+    b.close()
+
+
+class TestShardKeying:
+    def test_family_strips_trailing_index(self):
+        assert family_of("slice-3") == "slice"
+        assert family_of("v4slice-0") == "v4slice"
+        assert family_of("slice-p2-15") == "slice-p2"
+        assert family_of("") == ""
+
+    def test_auto_sharding_keys_by_generation_and_family(self):
+        s = _Stack("auto")
+        try:
+            assert sorted(s.dealer._shards) == [
+                "v4/v4slice", "v5p/fama", "v5p/famb",
+            ]
+            assert shard_key_of(
+                s.dealer._nodes["v5p-a-0"]
+            ) == "v5p/fama"
+            status = s.dealer.shard_status()
+            assert status["v5p/fama"]["hosts"] == 8
+            assert status["v4/v4slice"]["hosts"] == 4
+        finally:
+            s.close()
+
+    def test_single_shard_mode_has_one_domain(self):
+        s = _Stack(1)
+        try:
+            assert sorted(s.dealer._shards) == ["all"]
+            assert s.dealer.shard_status()["all"]["hosts"] == 20
+        finally:
+            s.close()
+
+    def test_invalid_shards_arg_rejected(self):
+        client = make_fleet(FLEET_SPEC)
+        with pytest.raises(ValueError):
+            Dealer(client, make_rater("binpack"), shards=4)
+
+
+class TestMergeTopK:
+    def test_orders_by_score_then_name(self):
+        lists = [
+            [("b", 5), ("a", 9)],
+            [("c", 9), ("d", 1)],
+        ]
+        assert merge_top_k(lists, 3) == [("a", 9), ("c", 9), ("b", 5)]
+
+    def test_independent_of_shard_split(self):
+        entries = [(f"n{i}", (i * 7) % 5) for i in range(20)]
+        whole = merge_top_k([entries], None)
+        rng = random.Random(0)
+        for _ in range(5):
+            shuffled = list(entries)
+            rng.shuffle(shuffled)
+            cut = rng.randrange(1, len(entries))
+            split = [shuffled[:cut], shuffled[cut:]]
+            assert merge_top_k(split, None) == whole
+            assert merge_top_k(split, 4) == whole[:4]
+
+
+class TestSplice:
+    def test_filter_splice_matches_single_render(self):
+        parts = [
+            b'{"NodeNames":["a","b"],"FailedNodes":{},"Error":""}',
+            b'{"NodeNames":[],"FailedNodes":{"c":"why"},"Error":""}',
+            b'{"NodeNames":["d"],"FailedNodes":{"e":"no"},"Error":""}',
+        ]
+        merged = splice_filter_payloads(parts)
+        assert merged == (
+            b'{"NodeNames":["a","b","d"],'
+            b'"FailedNodes":{"c":"why","e":"no"},"Error":""}'
+        )
+        assert json.loads(merged)["NodeNames"] == ["a", "b", "d"]
+
+    def test_priorities_splice(self):
+        parts = [
+            b'[{"Host":"a","Score":3}]',
+            b"[]",
+            b'[{"Host":"b","Score":1},{"Host":"c","Score":2}]',
+        ]
+        assert splice_priorities_payloads(parts) == (
+            b'[{"Host":"a","Score":3},'
+            b'{"Host":"b","Score":1},{"Host":"c","Score":2}]'
+        )
+
+    def test_frame_surprise_returns_none(self):
+        assert splice_filter_payloads([b"not json at all"]) is None
+        assert splice_priorities_payloads([b"{}"]) is None
+
+
+class TestShardedParity:
+    """The satellite pin: byte-identical responses, identical bind
+    outcomes, over a seeded property-style event sequence (schedules,
+    releases, node removals/restores, gangs, fractional pods)."""
+
+    def _cycle(self, stacks, pod_a, pod_b, nodes):
+        a, b = stacks
+        args = json.dumps(
+            {"Pod": pod_a.raw, "NodeNames": nodes}, separators=(",", ":")
+        ).encode()
+        args_b = json.dumps(
+            {"Pod": pod_b.raw, "NodeNames": nodes}, separators=(",", ":")
+        ).encode()
+        filt_a = a.verb("/scheduler/filter", args)
+        filt_b = b.verb("/scheduler/filter", args_b)
+        assert filt_a == filt_b
+        prio_a = a.verb("/scheduler/priorities", args)
+        prio_b = b.verb("/scheduler/priorities", args_b)
+        assert prio_a == prio_b
+        feasible = set(json.loads(filt_a)["NodeNames"])
+        if not feasible:
+            return None
+        ranked = sorted(
+            (p for p in json.loads(prio_a) if p["Host"] in feasible),
+            key=lambda p: (-p["Score"], p["Host"]),
+        )
+        best = ranked[0]["Host"]
+        bind = json.dumps({
+            "PodName": pod_a.name, "PodNamespace": "default",
+            "PodUID": pod_a.uid, "Node": best,
+        }).encode()
+        res_a = a.verb("/scheduler/bind", bind)
+        res_b = b.verb("/scheduler/bind", bind)
+        assert res_a == res_b
+        return best if json.loads(res_a)["Error"] == "" else None
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_event_sequence_parity(self, stacks, seed):
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        a, b = stacks
+        assert a.nodes == b.nodes
+        rng = random.Random(seed)
+        bound: list = []  # (pod_a, pod_b)
+        removed: list = []  # node raw dicts
+        for step in range(40):
+            roll = rng.random()
+            live = [
+                n for n in a.nodes
+                if n not in {r["metadata"]["name"] for r in removed}
+            ]
+            if roll < 0.6 or not bound:
+                percent = rng.choice(POD_SHAPES)
+                gang = f"g{step % 3}" if rng.random() < 0.4 else None
+                name = f"p-{seed}-{step}"
+                pod_a = _mk_pod(a.client, name, percent, gang)
+                pod_b = _mk_pod(b.client, name, percent, gang)
+                assert pod_a.uid == pod_b.uid
+                if self._cycle((a, b), pod_a, pod_b, live) is not None:
+                    bound.append((pod_a, pod_b))
+            elif roll < 0.8:
+                pod_a, pod_b = bound.pop(rng.randrange(len(bound)))
+                assert a.dealer.release(pod_a) == b.dealer.release(pod_b)
+            elif roll < 0.9 and len(removed) < 3:
+                victim = rng.choice(live)
+                raw = a.client.get_node(victim).raw
+                removed.append(raw)
+                for s in (a, b):
+                    s.client.delete_node(victim)
+                    s.dealer.remove_node(victim)
+            elif removed:
+                raw = removed.pop()
+                from nanotpu.k8s.objects import Node, plain_copy
+
+                for s in (a, b):
+                    node = Node(plain_copy(raw))
+                    s.client.create_node(node)
+                    s.dealer.observe_node(node)
+        # end state converged identically
+        assert a.dealer.occupancy() == b.dealer.occupancy()
+        snap_a, snap_b = a.dealer.debug_snapshot(), b.dealer.debug_snapshot()
+        assert snap_a["tracked_uids"] == snap_b["tracked_uids"]
+        assert snap_a["accounted"] == snap_b["accounted"]
+
+    def test_top_candidates_agree_across_shard_counts(self, stacks):
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        a, b = stacks
+        pod_a = _mk_pod(a.client, "topk", 200, gang="g0")
+        pod_b = _mk_pod(b.client, "topk", 200, gang="g0")
+        top_a = a.dealer.top_candidates(a.nodes, pod_a, 5)
+        top_b = b.dealer.top_candidates(b.nodes, pod_b, 5)
+        assert top_a == top_b
+        assert len(top_a) == 5
+
+
+class TestIncrementalDeltas:
+    def test_bind_republishes_only_its_shard(self):
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        s = _Stack("auto")
+        try:
+            # warm every shard's view through one full fan-out
+            pod = _mk_pod(s.client, "warm", 200)
+            assert s.dealer.filter_payload(s.nodes, pod) is not None
+            gens = {k: v["gen"] for k, v in s.dealer.shard_status().items()}
+            probe = _mk_pod(s.client, "probe", 200)
+            ok, _ = s.dealer.assume(s.nodes, probe)
+            target = [n for n in ok if n.startswith("v5p-b")][0]
+            s.dealer.bind(target, probe)
+            after = {k: v["gen"] for k, v in s.dealer.shard_status().items()}
+            assert after["v5p/famb"] > gens["v5p/famb"]
+            # sibling shards: untouched generation — the delta contract
+            assert after["v5p/fama"] == gens["v5p/fama"]
+            assert after["v4/v4slice"] == gens["v4/v4slice"]
+        finally:
+            s.close()
+
+
+class TestDiagnosability:
+    def test_debug_snapshot_and_decisions_expose_shards(self):
+        s = _Stack("auto")
+        try:
+            snap = s.dealer.debug_snapshot()
+            assert set(snap["shards"]) == {
+                "v4/v4slice", "v5p/fama", "v5p/famb",
+            }
+            for entry in snap["shards"].values():
+                assert entry["epoch"] == entry["published_epoch"]
+            code, _, payload = s.api.dispatch(
+                "GET", "/debug/decisions?limit=5", b""
+            )
+            assert code == 200
+            body = json.loads(payload)
+            assert set(body["shards"]) == set(snap["shards"])
+            assert body["shards"]["v5p/fama"]["hosts"] == 8
+        finally:
+            s.close()
+
+    def test_metrics_expose_per_shard_counters(self):
+        s = _Stack("auto")
+        try:
+            pod = _mk_pod(s.client, "m", 200)
+            s.dealer.filter_payload(s.nodes, pod)
+            code, _, payload = s.api.dispatch("GET", "/metrics", b"")
+            assert code == 200
+            assert "nanotpu_sched_shard{" in payload
+            assert 'shard="v5p/fama"' in payload
+            # the unlabeled series stay fleet-wide totals
+            totals = s.dealer.perf_totals()
+            line = next(
+                ln for ln in payload.splitlines()
+                if ln.startswith("nanotpu_sched_native_calls ")
+            )
+            assert float(line.split()[-1]) == totals["native_calls"]
+        finally:
+            s.close()
+
+
+class TestShardedSimDeterminism:
+    @pytest.mark.fullstack
+    def test_multipool_churn_reproduces_with_zero_violations(self):
+        """A scaled-down v5p-multipool (4 pools, shards=auto, full fault
+        plan): two fresh runs must agree byte-for-byte and converge with
+        zero invariant violations. The full 4096-host scenario runs via
+        `make sim-multipool` (examples/sim/v5p-multipool.json)."""
+        from nanotpu.sim import run_scenario
+        from nanotpu.sim.report import render, strip_timing
+
+        scenario = {
+            "name": "multipool-mini",
+            "fleet": {"pools": [{
+                "generation": "v5p", "hosts": 16, "slice_hosts": 8,
+                "prefix": "v5p-pool", "count": 4,
+            }]},
+            "policy": "binpack",
+            "horizon_s": 12.0,
+            "shards": "auto",
+            "workload": {
+                "kind": "poisson", "rate_per_s": 2.0,
+                "lifetime_s": {"dist": "exp", "mean": 6.0},
+                "gang_size": 4, "replicas": 2,
+            },
+            "faults": {
+                "node_flap": {"every_s": 4.0, "down_s": 2.0},
+                "bind_failure": {"prob": 0.05},
+                "drop_event": {"prob": 0.03},
+                "dup_event": {"prob": 0.03},
+                "metric_sync": {"every_s": 3.0, "delay_s": 1.0},
+            },
+            "invariant_every_events": 1,
+        }
+        r1 = run_scenario(scenario, seed=3)
+        r2 = run_scenario(scenario, seed=3)
+        assert render(strip_timing(r1)) == render(strip_timing(r2))
+        assert r1["invariants"]["violations"] == 0
+        assert r1["pods"]["bound"] > 0
