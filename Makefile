@@ -3,7 +3,7 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench sim-smoke sim-multipool chaos-soak obs-check fanout-4k image clean
+.PHONY: all native lint test test-fast bench bench-ab bind-storm sim-smoke sim-multipool chaos-soak obs-check fanout-4k image clean
 
 # Default verification tier: static analysis, then the fast inner loop
 # (test-fast includes sim-smoke), then the observability gate, then the
@@ -36,6 +36,27 @@ test-fast: native sim-smoke
 bench: native
 	python bench.py
 
+# The churn-heavy write-path row on its own (docs/bind-pipeline.md):
+# 4096-host single-zone fleet, strict-gang mix, concurrent binders,
+# median of 3 reps with in-bench asserts (zero gen-2 GC, zero rebuilds,
+# coalesced publishes proven by the attribution counters).
+bind-storm: native
+	python bench.py --bind-storm
+
+# The ROADMAP same-day A/B re-measure protocol, automated: worktree the
+# base REF (default HEAD = working tree vs last commit), build native
+# there, run the row INTERLEAVED A,B,A,B..., emit one comparison JSON
+# with the attribution-counter diff. Override the row:
+#   make bench-ab REF=e31ad8c REPS=5 \
+#        AB_CMD="python bench.py --bind-storm-rep" AB_KEY=bindstorm_pods_per_s
+REF ?= HEAD
+REPS ?= 5
+AB_CMD ?= python bench.py --bind-storm-rep
+AB_KEY ?= bindstorm_pods_per_s
+bench-ab: native
+	python bench_ab.py --ref $(REF) --reps $(REPS) --cmd "$(AB_CMD)" \
+		--rate-key $(AB_KEY)
+
 # 30 virtual seconds, all five BASELINE configs, every fault armed, run
 # TWICE: exits nonzero on any invariant violation or determinism breach
 # (docs/simulation.md). Fast enough for every PR.
@@ -59,7 +80,9 @@ obs-check:
 # arms the lock-order witness BEFORE interpreter imports construct the
 # module-level locks (nodeinfo._state_gen_lock, native._lock) — the
 # scenario's `lock_witness: true` then asserts acyclicity at teardown
-# (docs/static-analysis.md).
+# (docs/static-analysis.md). Runs at full commit-pipeline depth
+# (chaos.json `pipeline: 8` — docs/bind-pipeline.md); the depth-1
+# byte-identity pin vs the pre-pipeline digest lives in tests/test_sim.py.
 chaos-soak:
 	NANOTPU_LOCK_WITNESS=1 python -m nanotpu.sim \
 		--scenario examples/sim/chaos.json --seed 0 --check-determinism

@@ -12,11 +12,14 @@ Prints ONE JSON line.
 
 from __future__ import annotations
 
+import inspect
 import json
 import os
+import queue
 import re
 import socket
 import statistics
+import threading
 import time
 
 from nanotpu import types
@@ -454,6 +457,386 @@ def run_fanout_4k(reps: int = 3, max_reps: int = 5,
     )
 
 
+#: Dealer feature probe: the same bench file runs inside the A/B
+#: harness's base-ref worktree (bench_ab.py copies it there), whose Dealer
+#: may predate the commit pipeline — pass the knob only when it exists.
+_DEALER_HAS_PIPELINE = (
+    "pipeline_depth" in inspect.signature(Dealer.__init__).parameters
+)
+
+#: The bind-storm fleet: 4096 hosts as ONE single-generation zone (one
+#: slice family -> one snapshot shard) — the write path's worst case.
+#: Per-pool sharding (the r6 read-path win) gives a single-family zone
+#: no write-side relief: every bind republishes the same publication
+#: domain, so this is the shape that isolates what the commit pipeline
+#: changes (docs/bind-pipeline.md). The read-path 4k row (FLEET_4K)
+#: keeps its four-pool shape.
+STORM_FLEET = {
+    "pools": [{
+        "generation": "v5p", "hosts": 4096, "slice_hosts": 64,
+        "prefix": "v5p-zone", "count": 1,
+    }]
+}
+
+#: bind-storm shape (docs/bind-pipeline.md): per pool, this many
+#: feasibility-filtered candidate views stay warm (each drops a different
+#: tenth of the pool — different pod shapes exclude different slivers, so
+#: the views overlap on ~90% of the hosts exactly like upstream predicate
+#: filtering produces). Every bind's publish must advance the views its
+#: node appears in — the per-bind write amplification the pipeline's
+#: coalescing folds away. 8 == the snapshot view-cache bound: the storm
+#: keeps the cache exactly full without thrashing it.
+STORM_VIEWS_PER_POOL = 8
+STORM_GANG_SIZE = 8
+
+
+def run_bind_storm(n_hosts: int = 4096, n_pods: int = 768,
+                   warm_pods: int = 32, workers: int = 8,
+                   gang_frac: float = 0.5, read_interval_s: float = 0.05,
+                   pipeline: int = 16) -> dict:
+    """Churn-heavy bind storm over the 4096-host fleet: ``workers``
+    concurrent scheduler loops replay pre-placed bind decisions (the
+    shape of a migration/defrag storm — placement already decided,
+    write path under test) with a strict-gang mix, against warm
+    feasibility-filtered candidate views, measuring pods-bound/s.
+
+    * **gang mix** — ``gang_frac`` of the pods arrive as strict gangs of
+      ``STORM_GANG_SIZE``: each gang's member binds are issued
+      CONCURRENTLY (one connection per member, as kube-scheduler's async
+      bind goroutines do), park at the gang barrier, and commit when the
+      last member arrives — through the batched commit pool when the
+      dealer has one, one-at-a-time otherwise.
+    * **churn realism** — a background scheduling loop keeps issuing a
+      Filter over a rotating candidate view every ``read_interval_s``
+      (the cluster's read traffic is a RATE, independent of how fast
+      binds commit — coupling reads to bind count would charge the
+      faster build more read work per second), which is also what
+      bounds publish-coalescing staleness: reads drain pending deltas.
+    * **placement is NOT under test** — pods are pre-placed round-robin
+      (capacity guaranteed), pod objects and bind bodies are pre-encoded
+      outside the timed window, exactly like the fan-out rows.
+
+    In-bench asserts: every bind succeeds, zero gen-2 GC, zero view /
+    renderer rebuilds inside the timed window; when the dealer has the
+    commit pipeline, the per-rep attribution must additionally show
+    coalesced publishes (``publish_coalesced`` > 0 and swaps well under
+    one per bind) — the row cannot quietly run unpipelined."""
+    from nanotpu.sim.fleet import make_fleet
+
+    client = make_fleet(STORM_FLEET)
+    nodes = sorted(n.name for n in client.list_nodes())
+    assert len(nodes) == n_hosts, (len(nodes), n_hosts)
+    pools: dict[str, list[str]] = {}
+    for n in nodes:
+        pools.setdefault(n.rsplit("-", 2)[0], []).append(n)
+    dealer_kw = dict(shards="auto")
+    if _DEALER_HAS_PIPELINE:
+        dealer_kw["pipeline_depth"] = pipeline
+    dealer = Dealer(client, make_rater("binpack"), **dealer_kw)
+    api = SchedulerAPI(dealer, Registry())
+    server = serve(api, 0, host="127.0.0.1")
+    api.stop_idle_gc()
+    port = server.server_address[1]
+
+    # warm the candidate views (+ renderers) the storm's reads rotate over
+    subsets = []
+    for pnodes in pools.values():
+        for k in range(STORM_VIEWS_PER_POOL):
+            subsets.append([n for j, n in enumerate(pnodes) if j % 10 != k])
+    warm_pod = make_pod(
+        "storm-warm",
+        containers=[make_container("t", {types.RESOURCE_TPU_PERCENT:
+                                         POD_PERCENT})],
+    )
+    subset_args = [
+        json.dumps({"Pod": warm_pod.raw, "NodeNames": s},
+                   separators=_GO_SEP).encode()
+        for s in subsets
+    ]
+    conn = HttpClient("127.0.0.1", port)
+    for a in subset_args:
+        conn.post_raw("/scheduler/filter", a)
+        conn.post_raw("/scheduler/priorities", a)
+
+    def make_bind(name: str, node: str, gang: str | None = None):
+        ann = {}
+        if gang is not None:
+            ann = {
+                types.ANNOTATION_GANG_NAME: gang,
+                types.ANNOTATION_GANG_SIZE: str(STORM_GANG_SIZE),
+                types.ANNOTATION_GANG_POLICY: types.GANG_POLICY_STRICT,
+                types.ANNOTATION_GANG_TIMEOUT: "30",
+            }
+        pod = client.create_pod(make_pod(
+            name,
+            containers=[make_container(
+                "t", {types.RESOURCE_TPU_PERCENT: POD_PERCENT}
+            )],
+            annotations=ann,
+        ))
+        return json.dumps({
+            "PodName": name, "PodNamespace": "default",
+            "PodUID": pod.uid, "Node": node,
+        }).encode()
+
+    # warm binds: the bind path itself (demand memo, event recorder,
+    # renderer-adjacent caches) must be hot before the timed window
+    for i in range(warm_pods):
+        body = make_bind(f"storm-warm-{i}", nodes[-(i + 1)])
+        r = conn.post_raw("/scheduler/bind", body)
+        assert b'"Error":""' in r, r
+    # ...including the strict-gang path: the commit pool's worker
+    # threads spawn lazily, and a first gang paying thread-spawn inside
+    # the timed window would charge harness warmup to the scheduler
+    warm_gang = []
+    for m in range(STORM_GANG_SIZE):
+        warm_gang.append(make_bind(
+            f"storm-warm-g{m}", nodes[-(warm_pods + m + 1)],
+            gang="storm-warm-gang",
+        ))
+    warm_conns = [HttpClient("127.0.0.1", port)
+                  for _ in range(STORM_GANG_SIZE)]
+    warm_errs: list[bytes] = []
+
+    def _warm_member(j):
+        r = warm_conns[j].post_raw("/scheduler/bind", warm_gang[j])
+        if b'"Error":""' not in r:
+            warm_errs.append(r[:200])
+
+    warm_threads = [threading.Thread(target=_warm_member, args=(j,))
+                    for j in range(STORM_GANG_SIZE)]
+    for t in warm_threads:
+        t.start()
+    for t in warm_threads:
+        t.join()
+    for c in warm_conns:
+        c.close()
+    assert not warm_errs, warm_errs
+
+    # pre-placed storm tasks: singles + whole gangs (a gang is ONE task so
+    # its member binds are always issued together — splitting members
+    # across busy workers could park every connection behind incomplete
+    # gangs). Round-robin placement over the fleet guarantees capacity.
+    n_gangs = int(n_pods * gang_frac) // STORM_GANG_SIZE
+    n_singles = n_pods - n_gangs * STORM_GANG_SIZE
+    node_i = 0
+    gang_tasks, single_tasks = [], []
+    for g in range(n_gangs):
+        members = []
+        for m in range(STORM_GANG_SIZE):
+            name = f"storm-g{g}-m{m}"
+            members.append(make_bind(name, nodes[node_i % len(nodes)],
+                                     gang=f"storm-gang-{g}"))
+            node_i += 1
+        gang_tasks.append(("gang", members))
+    for i in range(n_singles):
+        single_tasks.append(
+            ("single",
+             [make_bind(f"storm-s{i}", nodes[node_i % len(nodes)])]))
+        node_i += 1
+    # interleave gangs among singles (deterministically): a front-loaded
+    # gang block would park workers x gang_size connections at once and
+    # measure peak-park behavior instead of a steady churn mix
+    tasks: queue.Queue = queue.Queue()
+    stride = max(len(single_tasks) // max(len(gang_tasks), 1), 1)
+    gi = si = 0
+    while gi < len(gang_tasks) or si < len(single_tasks):
+        for _ in range(stride):
+            if si < len(single_tasks):
+                tasks.put(single_tasks[si])
+                si += 1
+        if gi < len(gang_tasks):
+            tasks.put(gang_tasks[gi])
+            gi += 1
+
+    lats: list[float] = []
+    lats_lock = threading.Lock()
+    errors: list[bytes] = []
+
+    def bind_one(c: HttpClient, body: bytes) -> float:
+        t0 = time.perf_counter()
+        r = c.post_raw("/scheduler/bind", body)
+        dt = time.perf_counter() - t0
+        if b'"Error":""' not in r:
+            with lats_lock:
+                errors.append(r[:200])
+        return dt
+
+    # keep-alive connection pools + a persistent member-thread pool per
+    # worker, both built BEFORE the timed window: kube-scheduler's Go
+    # transport reuses warm connections and its bind goroutines are
+    # ~free to launch — per-gang client thread spawns would charge
+    # harness setup to the scheduler
+    from concurrent.futures import ThreadPoolExecutor as _TPE
+
+    conn_pools = [
+        [HttpClient("127.0.0.1", port) for _ in range(STORM_GANG_SIZE)]
+        for _ in range(workers)
+    ]
+    member_pools = [
+        _TPE(max_workers=STORM_GANG_SIZE,
+             thread_name_prefix=f"storm-member-{w}")
+        for w in range(workers)
+    ]
+    for pool in member_pools:  # spawn the threads now, not mid-window
+        list(pool.map(lambda _: None, range(STORM_GANG_SIZE)))
+
+    def worker(wid: int):
+        conns = conn_pools[wid]
+        members = member_pools[wid]
+        my_lats = []
+        try:
+            while True:
+                try:
+                    kind, bodies = tasks.get_nowait()
+                except queue.Empty:
+                    break
+                if kind == "single":
+                    my_lats.append(bind_one(conns[0], bodies[0]))
+                else:
+                    # one pooled thread per member: the members must park
+                    # at the barrier CONCURRENTLY, exactly like
+                    # kube-scheduler's per-pod bind goroutines
+                    my_lats.extend(members.map(
+                        lambda jb: bind_one(conns[jb[0]], jb[1]),
+                        enumerate(bodies),
+                    ))
+        finally:
+            with lats_lock:
+                lats.extend(my_lats)
+
+    stop_reader = threading.Event()
+    reader_errors: list[BaseException] = []
+
+    def reader():
+        c = HttpClient("127.0.0.1", port)
+        k = 0
+        try:
+            while not stop_reader.wait(read_interval_s):
+                c.post_raw("/scheduler/filter",
+                           subset_args[k % len(subset_args)])
+                k += 1
+        except BaseException as e:
+            # a dead reader silently changes the row's protocol (no
+            # read traffic, no drains) — it must fail the rep, not
+            # quietly shrink the measured work
+            reader_errors.append(e)
+        finally:
+            c.close()
+
+    import gc
+    import sys as _sys
+
+    gc.collect()
+    gc.disable()
+    # the storm is wake-latency bound (client worker <-> handler thread
+    # ping-pong on few cores): CPython's default 5 ms GIL switch interval
+    # adds up to 5 ms of handoff latency per blocking wake, which swamps
+    # the sub-ms work under test and makes reps bimodal. 1 ms keeps
+    # handoffs prompt at negligible throughput cost; restored after.
+    swi = _sys.getswitchinterval()
+    _sys.setswitchinterval(0.001)
+    try:
+        gc.collect()
+        gc.freeze()
+        gc_before = gc.get_stats()
+        perf_before = dealer.perf_totals()
+        api.inflight_peak = 0
+        reader_thread = threading.Thread(target=reader, daemon=True)
+        reader_thread.start()
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(workers)]
+        started = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - started
+        # the fixed-cadence read loop must have survived the whole
+        # window: a dead reader voids the row's protocol
+        assert reader_thread.is_alive() and not reader_errors, \
+            reader_errors
+        stop_reader.set()
+        reader_thread.join(5)
+        gc_after = gc.get_stats()
+        perf_after = dealer.perf_totals()
+    finally:
+        stop_reader.set()
+        _sys.setswitchinterval(swi)
+        gc.enable()
+        gc.unfreeze()
+        conn.close()
+        for pool in conn_pools:
+            for c in pool:
+                c.close()
+        for mpool in member_pools:
+            mpool.shutdown(wait=False)
+        server.shutdown()
+        dealer.close()
+    gc.collect()
+    assert not errors, errors[:3]
+    # every pod's bind completed AND was timed: a worker killed by a
+    # transport error would otherwise silently shrink the workload and
+    # overstate pods/s
+    assert len(lats) == n_pods, (len(lats), n_pods)
+    attr = _gc_deltas(gc_before, gc_after)
+    attr.update((k, perf_after[k] - perf_before[k]) for k in perf_after)
+    attr["inflight_peak"] = api.inflight_peak
+    assert attr["gen2_collections"] == 0, attr
+    assert attr["view_builds"] == 0, attr
+    assert attr["renderer_builds"] == 0, attr
+    if _DEALER_HAS_PIPELINE and pipeline > 1:
+        # the pipeline must actually engage: publishes coalesce (swaps
+        # well under one per bind) and gang members commit batched
+        assert attr["publish_coalesced"] > 0, attr
+        assert attr["snapshot_publishes"] < n_pods / 2, attr
+        if n_gangs:
+            assert attr["gang_batched_commits"] > 0, attr
+    return {
+        "bindstorm_hosts": n_hosts,
+        "bindstorm_pods": n_pods,
+        "bindstorm_gangs": n_gangs,
+        "bindstorm_pods_per_s": round(n_pods / elapsed, 1),
+        "bindstorm_bind_p50_ms": round(
+            percentile(lats, 0.50) * 1000, 3),
+        "bindstorm_bind_p99_ms": round(
+            percentile(lats, 0.99) * 1000, 3),
+        "bindstorm_pipeline": pipeline if _DEALER_HAS_PIPELINE else 1,
+        "attr": attr,
+    }
+
+
+def run_bind_storm_reps(reps: int = 3, max_reps: int = 5,
+                        **kwargs) -> dict:
+    """Median-of-reps protocol for the bind-storm row (same convention
+    and noise policy as :func:`run_fanout_reps`)."""
+    rates, p50s, p99s, loads, attrs = [], [], [], [], []
+    out = {}
+    n = 0
+    while n < reps or (n < max_reps and max(rates) > 1.25 * min(rates)):
+        out = run_bind_storm(**kwargs)
+        rates.append(out["bindstorm_pods_per_s"])
+        p50s.append(out["bindstorm_bind_p50_ms"])
+        p99s.append(out["bindstorm_bind_p99_ms"])
+        loads.append(round(os.getloadavg()[0], 2))
+        attrs.append(out["attr"])
+        n += 1
+    order = sorted(range(n), key=lambda i: rates[i])
+    return {
+        "bindstorm_hosts": out["bindstorm_hosts"],
+        "bindstorm_pods": out["bindstorm_pods"],
+        "bindstorm_gangs": out["bindstorm_gangs"],
+        "bindstorm_pipeline": out["bindstorm_pipeline"],
+        "bindstorm_pods_per_s": statistics.median(rates),
+        "bindstorm_bind_p50_ms": statistics.median(p50s),
+        "bindstorm_bind_p99_ms": max(p99s),
+        "bindstorm_reps": n,
+        "bindstorm_pods_per_s_all": [rates[i] for i in order],
+        "bindstorm_loadavg_1m_per_rep": [loads[i] for i in order],
+        "bindstorm_attr_per_rep": [attrs[i] for i in order],
+    }
+
+
 def run_once() -> tuple[list[float], float, int, float]:
     """One full 32-pod scenario; returns (latencies, elapsed, bound, occ%)."""
     client = make_mock_cluster(N_HOSTS, CHIPS_PER_HOST)
@@ -539,6 +922,10 @@ def run() -> dict:
     import gc
 
     gc.collect()
+    # the write-path row last: it binds thousands of pods and its heap
+    # must not depress the read-path rows measured above
+    bindstorm = run_bind_storm_reps()
+    gc.collect()
     run_once()  # warmup: module-level caches (topology link bounds, demand
     # hashes, compactness) persist across repetitions, as in a live scheduler
     latencies: list[float] = []
@@ -597,6 +984,7 @@ def run() -> dict:
     }
     out.update(fanout)
     out.update(fanout4k)
+    out.update(bindstorm)
     out["host_loadavg_start"] = load_start
     out["host_loadavg_end"] = [round(x, 2) for x in os.getloadavg()]
     out["host_cpu_count"] = os.cpu_count()
@@ -614,5 +1002,11 @@ if __name__ == "__main__":
         # rebuilds in the timed window) are the gate — an AssertionError
         # exits nonzero
         print(json.dumps(run_fanout_4k(reps=1, max_reps=1)))
+    elif "--bind-storm" in sys.argv:
+        # the full bind-storm row (median of 3 reps, in-bench asserts)
+        print(json.dumps(run_bind_storm_reps()))
+    elif "--bind-storm-rep" in sys.argv:
+        # one rep, for bench_ab.py's interleaved A/B protocol
+        print(json.dumps(run_bind_storm()))
     else:
         print(json.dumps(run()))

@@ -1,0 +1,210 @@
+"""Same-day A/B re-measure harness (`make bench-ab`): the ROADMAP's
+bench protocol, automated.
+
+Bench numbers on this class of box drift 15-20% with host load, so a
+round's headline is only meaningful against a SAME-DAY re-measure of the
+previous HEAD on the same box (ROADMAP "Tier-1 note"). Doing that by
+hand means: check out the base ref somewhere, rebuild native, run the
+two builds alternately so slow minutes hit both sides, then diff the
+attribution counters to separate in-process change from host noise.
+This script does exactly that:
+
+1. ``git worktree add`` the base ref (default: HEAD — i.e. working tree
+   vs last commit) into a temp dir and ``make -C native`` there;
+2. copy THIS tree's bench files into the worktree so both sides run the
+   IDENTICAL measurement code against their own scheduler (bench.py
+   feature-detects dealer capabilities, so it runs on older dealers);
+3. run the row command in A (this tree) and B (base) INTERLEAVED —
+   A,B,A,B,... — one JSON line per rep, recording per-rep loadavg;
+4. emit ONE comparison JSON: per-side medians and spreads, the
+   median-of-ratios, and the attribution-counter diff (summed per-rep
+   deltas) that names WHAT the code change did to the measured work
+   (e.g. "view_advances 2764 -> 250, publish_coalesced 0 -> 512").
+
+The ratio convention: ``ratio = A_median / B_median`` for the headline
+rate key, so > 1.0 means this tree is faster than the base.
+
+Usage::
+
+    python bench_ab.py [--ref HEAD] [--reps 5]
+        [--cmd "python bench.py --bind-storm-rep"]
+        [--rate-key bindstorm_pods_per_s]
+
+Exit status 0 always (measurement, not a gate); the caller judges the
+ratio. Prints progress to stderr, the comparison JSON to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+#: measurement files copied from THIS tree into the base worktree so both
+#: sides run byte-identical bench code (bench.py feature-detects dealer
+#: capabilities that the base may not have)
+BENCH_FILES = ("bench.py",)
+
+
+def _log(msg: str) -> None:
+    print(f"bench_ab: {msg}", file=sys.stderr, flush=True)
+
+
+def _run(cmd: list[str], cwd: str, check: bool = True, **kw):
+    return subprocess.run(
+        cmd, cwd=cwd, check=check, capture_output=True, text=True, **kw
+    )
+
+
+def make_worktree(ref: str) -> tuple[str, str]:
+    """Create a detached worktree of ``ref``; returns (path, sha)."""
+    sha = _run(["git", "rev-parse", ref], cwd=REPO).stdout.strip()
+    path = tempfile.mkdtemp(prefix=f"nanotpu-ab-{sha[:8]}-")
+    # the dir must not exist for `git worktree add`
+    os.rmdir(path)
+    _run(["git", "worktree", "add", "--detach", path, sha], cwd=REPO)
+    return path, sha
+
+
+def drop_worktree(path: str) -> None:
+    _run(["git", "worktree", "remove", "--force", path], cwd=REPO,
+         check=False)
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def one_rep(cmd: list[str], cwd: str) -> dict:
+    """Run one rep; the command must print exactly one JSON object on its
+    last stdout line."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        cmd, cwd=cwd, capture_output=True, text=True, env=env
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"rep failed in {cwd} (exit {out.returncode}):\n"
+            f"{out.stdout[-2000:]}\n{out.stderr[-2000:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _attr_sum(reps: list[dict]) -> dict[str, int]:
+    """Sum the numeric attribution counters across reps (per-rep `attr`
+    dicts, or `*_attr_per_rep` lists from aggregated rows)."""
+    total: dict[str, int] = {}
+    for rep in reps:
+        attrs = []
+        if isinstance(rep.get("attr"), dict):
+            attrs.append(rep["attr"])
+        for key, val in rep.items():
+            if key.endswith("_attr_per_rep") and isinstance(val, list):
+                attrs.extend(a for a in val if isinstance(a, dict))
+        for attr in attrs:
+            for k, v in attr.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    total[k] = total.get(k, 0) + v
+    return total
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--ref", default="HEAD",
+        help="base git ref to A/B against (default HEAD: working tree vs "
+        "last commit — the standard PR measurement)",
+    )
+    parser.add_argument("--reps", type=int, default=5)
+    parser.add_argument(
+        "--cmd", default="python bench.py --bind-storm-rep",
+        help="one-rep command; must print one JSON object on its last "
+        "stdout line",
+    )
+    parser.add_argument(
+        "--rate-key", default="bindstorm_pods_per_s",
+        help="the headline higher-is-better key the ratio is computed on",
+    )
+    parser.add_argument(
+        "--out", default="",
+        help="also write the comparison JSON to this path",
+    )
+    args = parser.parse_args(argv)
+    cmd = args.cmd.split()
+
+    base_path, base_sha = make_worktree(args.ref)
+    _log(f"base worktree: {args.ref} ({base_sha[:12]}) at {base_path}")
+    try:
+        for f in BENCH_FILES:
+            shutil.copy2(os.path.join(REPO, f), os.path.join(base_path, f))
+        _log("building native in base worktree")
+        _run(["make", "-C", "native"], cwd=base_path)
+
+        a_reps: list[dict] = []
+        b_reps: list[dict] = []
+        loads: list[float] = []
+        for rep in range(args.reps):
+            # interleaved A,B per rep: a slow host minute hits both sides
+            loads.append(round(os.getloadavg()[0], 2))
+            _log(f"rep {rep + 1}/{args.reps}: A (working tree)")
+            a_reps.append(one_rep(cmd, REPO))
+            _log(f"rep {rep + 1}/{args.reps}: B ({args.ref})")
+            b_reps.append(one_rep(cmd, base_path))
+
+        key = args.rate_key
+        a_rates = [r[key] for r in a_reps]
+        b_rates = [r[key] for r in b_reps]
+        ratio = round(
+            statistics.median(a_rates) / statistics.median(b_rates), 4
+        )
+        a_attr, b_attr = _attr_sum(a_reps), _attr_sum(b_reps)
+        attr_diff = {
+            k: {"a": a_attr.get(k, 0), "b": b_attr.get(k, 0)}
+            for k in sorted(set(a_attr) | set(b_attr))
+            if a_attr.get(k, 0) != b_attr.get(k, 0)
+        }
+        out = {
+            "protocol": "interleaved same-day A/B "
+                        "(ROADMAP bench re-measure protocol)",
+            "cmd": args.cmd,
+            "rate_key": key,
+            "reps": args.reps,
+            "a": {
+                "ref": "worktree",
+                "median": statistics.median(a_rates),
+                "all": sorted(a_rates),
+            },
+            "b": {
+                "ref": f"{args.ref} ({base_sha[:12]})",
+                "median": statistics.median(b_rates),
+                "all": sorted(b_rates),
+            },
+            "ratio_a_over_b": ratio,
+            # summed in-window attribution counters that CHANGED between
+            # the builds: the in-process explanation of the ratio (host
+            # noise cannot move these)
+            "attr_diff": attr_diff,
+            "host_loadavg_per_rep": loads,
+            "host_cpu_count": os.cpu_count(),
+            "measured_unix": round(time.time(), 1),
+        }
+        blob = json.dumps(out)
+        print(blob)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(blob + "\n")
+        _log(f"A median {out['a']['median']} vs B median "
+             f"{out['b']['median']} -> ratio {ratio}")
+        return 0
+    finally:
+        drop_worktree(base_path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

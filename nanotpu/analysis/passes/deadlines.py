@@ -21,6 +21,17 @@ Two checks:
 A declared-but-unused ``deadline`` parameter is also flagged: a hop that
 accepts the token and neither forwards nor checks it is a drop with
 extra steps.
+
+A third check covers the COMMIT side of a bind (docs/bind-pipeline.md):
+once a chip reservation exists, the bind must run to completion —
+committing is idempotent-retry-safe, abandoning a half-written
+annotation is not. So the budget may never be probed past the point a
+reservation is created: inside any function, a probe
+(``deadline_check(...)`` / ``deadline.check(...)``) lexically after a
+call in :data:`RESERVE_CALLS` is a finding, and the functions in
+:data:`COMMIT_SIDE` (the commit half and the pipeline's batched
+gang-commit workers, which run entirely reservation-side) may not probe
+at all.
 """
 
 from __future__ import annotations
@@ -48,6 +59,33 @@ SINKS = {
     ("dealer", "assume"), ("dealer", "score"), ("dealer", "bind"),
     ("verb", "handle"),
 }
+
+#: calls that CREATE a chip reservation: past one of these, the caller
+#: holds applied-but-uncommitted chip state and must commit through
+RESERVE_CALLS = {"_reserve"}
+
+#: functions that run entirely on the commit side of a reservation —
+#: including the commit pipeline's async gang-commit workers
+#: (docs/bind-pipeline.md): the deadline token must not reach them
+COMMIT_SIDE = {
+    "_commit_reserved", "_commit_reserved_inner", "_park_and_commit",
+    "_commit_gang_batch", "_commit_gang_member",
+}
+
+
+def _is_probe(node: ast.Call) -> bool:
+    """A deadline probe: ``deadline_check(...)`` (the canonical import
+    alias), ``deadline.check(...)``, or a bare ``check(deadline, ...)``
+    whose first argument is the token."""
+    chain = dotted(node.func) or ""
+    terminal = chain.rsplit(".", 1)[-1]
+    if terminal == "deadline_check":
+        return True
+    if chain == "deadline.check":
+        return True
+    return terminal == "check" and any(
+        isinstance(a, ast.Name) and a.id == "deadline" for a in node.args
+    )
 
 
 def _functions(mod: Module):
@@ -105,11 +143,50 @@ class _DeadlinePass:
                         "response budget cannot reach it",
                     ))
                     continue
+                findings.extend(self._check_commit_side(mod, qual, fn))
                 if not has_param and not _creates_deadline(fn):
                     continue
                 findings.extend(
                     self._check_body(mod, qual, fn, has_param)
                 )
+        return findings
+
+    def _check_commit_side(self, mod: Module, qual: str,
+                           fn) -> list[Finding]:
+        """No deadline probe may run once a reservation exists: not
+        lexically after a ``RESERVE_CALLS`` call, and never inside the
+        ``COMMIT_SIDE`` functions (which hold one for their whole body —
+        the commit pipeline's workers included)."""
+        findings: list[Finding] = []
+        commit_side = fn.name in COMMIT_SIDE
+        reserve_line: int | None = None
+        probes: list[tuple[int, str]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func) or ""
+            if chain.rsplit(".", 1)[-1] in RESERVE_CALLS:
+                if reserve_line is None or node.lineno < reserve_line:
+                    reserve_line = node.lineno
+            elif _is_probe(node):
+                probes.append((node.lineno, chain))
+        for line, chain in sorted(probes):
+            if commit_side:
+                findings.append(Finding(
+                    self.name, str(mod.path), line,
+                    f"{qual} probes the deadline ({chain}) but runs on "
+                    "the commit side of a reservation — an applied "
+                    "reservation must commit through, never abort "
+                    "(docs/bind-pipeline.md)",
+                ))
+            elif reserve_line is not None and line > reserve_line:
+                findings.append(Finding(
+                    self.name, str(mod.path), line,
+                    f"{qual} probes the deadline ({chain}) after "
+                    f"creating a reservation (line {reserve_line}) — "
+                    "once chips are reserved the bind must run to "
+                    "completion; probe before reserving instead",
+                ))
         return findings
 
     def _check_body(self, mod: Module, qual: str, fn,

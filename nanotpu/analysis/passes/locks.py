@@ -49,7 +49,20 @@ SCOPE = (
 #: ``_Shard._publish_lock`` is the per-shard successor of the old
 #: ``Dealer._publish_lock`` (kept for fixtures/back-compat): every
 #: snapshot swap serializes on exactly one of them.
-HOT_LOCKS = ("Dealer._lock", "Dealer._publish_lock", "_Shard._publish_lock")
+#: ``_Shard._pending_lock`` guards the commit pipeline's coalescing
+#: queue (docs/bind-pipeline.md): every pipelined commit enqueues under
+#: it, so its critical sections must stay set-ops-only.
+HOT_LOCKS = (
+    "Dealer._lock", "Dealer._publish_lock", "_Shard._publish_lock",
+    "_Shard._pending_lock",
+)
+
+#: per-node reservation locks (docs/bind-pipeline.md): the commit
+#: pipeline's workers apply and roll back chip reservations under these,
+#: so a blocking call while holding one would convoy every verb touching
+#: that node — same rule as the hot locks, named separately because the
+#: lock is per-NODE (fine-grained), not global.
+RESERVATION_LOCKS = ("NodeInfo.lock",)
 
 #: terminal attribute names treated as lock objects
 _LOCKISH = ("cv", "_cv", "cond", "_cond", "_mu")
@@ -97,6 +110,13 @@ class _FnSummary:
     under_calls: list = field(default_factory=list)
     edges: list = field(default_factory=list)  # (a, b, line)
     bare: list = field(default_factory=list)   # (chain, line) acquire()/release()
+    #: (lock name, chain, line) of `.acquire(blocking=False)` attempts —
+    #: the commit pipeline's publish-leader election idiom. Legal ONLY
+    #: when the same function also releases the same lock (checked in
+    #: run()); the span between acquire and release is tracked as held.
+    tryacquired: list = field(default_factory=list)
+    #: lock names `.release()`d while statically held by a try-acquire
+    released: set = field(default_factory=set)
 
 
 class _ModuleIndex:
@@ -167,6 +187,10 @@ class _FnWalker(ast.NodeVisitor):
         #: local/param name -> class name
         self.local_types: dict[str, str] = {}
         self.held: list[str] = []
+        #: the subset of `held` opened by a try-acquire (not a `with`):
+        #: only THESE may be closed by a bare release() — a release of a
+        #: with-held lock stays an unbalanced-release finding
+        self._try_held: list[str] = []
         for arg in list(fn.args.args) + list(fn.args.kwonlyargs):
             ann = arg.annotation
             if isinstance(ann, ast.Name):
@@ -263,6 +287,20 @@ class _FnWalker(ast.NodeVisitor):
         for name in reversed(acquired):
             self.held.pop()
 
+    @staticmethod
+    def _is_nonblocking(node: ast.Call) -> bool:
+        """``.acquire(blocking=False)`` / ``.acquire(False)`` — the
+        commit pipeline's publish-leader try-acquire."""
+        for kw in node.keywords:
+            if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return True
+        return bool(
+            node.args
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value is False
+        )
+
     def visit_Call(self, node: ast.Call):
         chain = dotted(node.func)
         if chain is not None:
@@ -270,7 +308,46 @@ class _FnWalker(ast.NodeVisitor):
             receiver = chain.rsplit(".", 1)[0] if "." in chain else ""
             if terminal in ("acquire", "release") and receiver and \
                     _is_lockish(receiver.rsplit(".", 1)[-1]):
-                self.summary.bare.append((chain, node.lineno))
+                name = self.lock_name(node.func.value)
+                if (
+                    terminal == "acquire"
+                    and name is not None
+                    and self._is_nonblocking(node)
+                ):
+                    # try-acquire (leader election): an acquisition
+                    # attempt, not an opaque bare acquire — record its
+                    # ordering edges and hold the span until the matching
+                    # release() in this function (required; checked in
+                    # run()). A FAILED try-acquire returns without the
+                    # lock, so treating the failure branch as held only
+                    # ever over-approximates, never misses an edge.
+                    for h in self.held:
+                        if h != name:
+                            self.summary.edges.append(
+                                (h, name, node.lineno)
+                            )
+                    self.held.append(name)
+                    self._try_held.append(name)
+                    self.summary.acquires.add(name)
+                    self.summary.tryacquired.append(
+                        (name, chain, node.lineno)
+                    )
+                elif (
+                    terminal == "release"
+                    and name is not None
+                    and name in self._try_held
+                ):
+                    # the matching release of a try-acquire span; a
+                    # release of a `with`-held lock is NOT matched — it
+                    # stays a bare-release finding like before
+                    self.summary.released.add(name)
+                    self._try_held.remove(name)
+                    for i in range(len(self.held) - 1, -1, -1):
+                        if self.held[i] == name:
+                            del self.held[i]
+                            break
+                else:
+                    self.summary.bare.append((chain, node.lineno))
             reason = _blocking_reason(chain)
             if reason is not None:
                 self.summary.blocking.add((reason, node.lineno))
@@ -373,6 +450,7 @@ class _LockPass:
     doc = "lock-order cycles and blocking calls under the dealer's hot locks"
     scope = SCOPE
     hot_locks = HOT_LOCKS
+    reservation_locks = RESERVATION_LOCKS
 
     def run(self, modules: list[Module]) -> list[Finding]:
         summaries, _per_module = _summarize(modules)
@@ -409,10 +487,25 @@ class _LockPass:
                         f"{hot} — hot-path critical sections must stay "
                         "compute-only",
                     ))
-            # blocking reached through a call chain under a hot lock
+                elif any(h in self.reservation_locks for h in held):
+                    res = next(
+                        h for h in held if h in self.reservation_locks
+                    )
+                    findings.append(Finding(
+                        self.name, path, line,
+                        f"blocking call ({reason}) while holding per-node "
+                        f"reservation lock {res} — a parked apiserver "
+                        "round-trip here convoys every verb touching "
+                        "that node (docs/bind-pipeline.md)",
+                    ))
+            # blocking reached through a call chain under a hot or
+            # per-node reservation lock
             for held, ccls, cname, line in s.under_calls:
                 hot = next((h for h in held if h in self.hot_locks), None)
-                if hot is None:
+                res = None if hot is not None else next(
+                    (h for h in held if h in self.reservation_locks), None
+                )
+                if hot is None and res is None:
                     continue
                 blocked = sorted(may_block.get((ccls, cname), set()))
                 if blocked:  # one finding per call site, first cause
@@ -420,9 +513,18 @@ class _LockPass:
                     callee = f"{ccls}.{cname}" if ccls else cname
                     findings.append(Finding(
                         self.name, path, line,
-                        f"call to {callee} while holding {hot} may "
+                        f"call to {callee} while holding {hot or res} may "
                         f"block ({reason}) — move it outside the "
                         "critical section or prove it cannot block here",
+                    ))
+            for name, chain, line in s.tryacquired:
+                if name not in s.released:
+                    findings.append(Finding(
+                        self.name, path, line,
+                        f"try-acquire {chain}(blocking=False) without a "
+                        f"matching {name}.release() in the same function "
+                        "— a leader that cannot be seen to release reads "
+                        "as a leaked lock",
                     ))
             for chain, line in s.bare:
                 findings.append(Finding(

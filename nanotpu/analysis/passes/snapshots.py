@@ -41,7 +41,8 @@ SCOPE = (
 #: (per-shard since the sharded-dealer refactor — Dealer._republish only
 #: routes commits to the owning shard's _republish_shard)
 PUBLISHER_FUNCS = {
-    "Dealer._republish", "Dealer._republish_shard", "_Snapshot.__init__",
+    "Dealer._republish", "Dealer._republish_shard",
+    "Dealer._publish_shard_locked", "_Snapshot.__init__",
 }
 
 #: the module that owns BatchScorer's freeze/clone protocol

@@ -113,6 +113,14 @@ def build_app(argv: list[str] | None = None):
         "Filter/Prioritize score shards in parallel (docs/sharding.md; "
         "recommended beyond ~1k hosts)",
     )
+    parser.add_argument(
+        "--pipeline-depth", type=int, default=1, metavar="N",
+        help="commit-pipeline depth (docs/bind-pipeline.md): 1 keeps the "
+        "classic write path; >1 coalesces snapshot publishes across "
+        "concurrent binds and fans a complete strict gang's member "
+        "commits out over N bounded workers (recommended with --shards "
+        "auto under bind/migration storms)",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -148,6 +156,7 @@ def build_app(argv: list[str] | None = None):
     dealer = Dealer(
         client, rater, recorder=recorder, obs=obs,
         shards="auto" if args.shards == "auto" else 1,
+        pipeline_depth=max(args.pipeline_depth, 1),
     )
     registry = Registry()
     api = SchedulerAPI(

@@ -24,6 +24,17 @@ annotations), different concurrency and failure design:
   partition costs nothing in placement quality — docs/sharding.md).
   ``shards=1`` (default) keeps the whole fleet in one shard with
   byte-identical behavior to the unsharded dealer.
+* **pipelined bind commits** (``pipeline_depth>1`` — docs/bind-pipeline.md)
+  — the write path scales the way r6 scaled reads: snapshot publishes
+  COALESCE (a commit only enqueues its delta; the next reader of the
+  shard drains everything pending into one swap through a non-blocking
+  leader election, so a storm burst costs one view advance per READ
+  instead of one per bind), the redundant second republish of a clean
+  bind is skipped outright (``perf.publish_skips``), and a complete
+  strict gang's member commits fan out concurrently through a bounded
+  commit pool so a 64-member gang costs ~1 write round-trip, not 64
+  sequential ones. Depth 1 with coalescing off (the default) takes the
+  exact pre-pipeline code path — wire behavior byte-identical.
 
 The K8s API remains the durable checkpoint: placement lives in pod
 annotations, and a restarted dealer replays them (dealer.go:58-72,279-299).
@@ -59,6 +70,7 @@ from nanotpu.k8s.client import ApiError, Clientset, ConflictError, NotFoundError
 from nanotpu.k8s.events import EventRecorder
 from nanotpu.k8s.objects import Node, Pod
 from nanotpu.k8s.resilience import BreakerOpenError
+from nanotpu.obs import set_current
 from nanotpu.obs.decisions import (
     REASON_ALREADY_BOUND,
     REASON_API_ERROR,
@@ -115,14 +127,22 @@ class _Reservation:
     marks it invalid and the parked bind fails instead of double-booking.
     """
 
-    __slots__ = ("node_name", "info", "plan", "valid", "gang_key")
+    __slots__ = ("node_name", "info", "plan", "valid", "gang_key", "pod",
+                 "trace")
 
-    def __init__(self, node_name: str, info, plan: Plan, gang_key: str):
+    def __init__(self, node_name: str, info, plan: Plan, gang_key: str,
+                 pod: Pod | None = None, trace=None):
         self.node_name = node_name
         self.info = info
         self.plan = plan
         self.valid = True
         self.gang_key = gang_key
+        #: the pod + trace of the parked bind: a batched gang commit
+        #: (Dealer._commit_gang_batch) runs this member's API writes on a
+        #: commit-pool worker, which needs the member's own request
+        #: context rather than the opener's
+        self.pod = pod
+        self.trace = trace
 
 
 def plan_from_pod(pod: Pod) -> Plan | None:
@@ -158,6 +178,8 @@ class Dealer:
         recorder: EventRecorder | None = None,
         obs=None,
         shards: int | str = 1,
+        pipeline_depth: int = 1,
+        coalesce: bool | None = None,
     ):
         self.client = client
         self.rater = rater
@@ -231,10 +253,41 @@ class Dealer:
             self._shards[DEFAULT_SHARD_KEY] = self._default_shard
         else:
             self._default_shard = None
+        #: commit pipeline (docs/bind-pipeline.md): ``pipeline_depth`` is
+        #: the bounded worker count for batched strict-gang commit
+        #: fan-out (1 == no pool, members commit on their own bind
+        #: threads — the pre-pipeline behavior); ``coalesce`` turns
+        #: publish coalescing on/off independently (default: on exactly
+        #: when the pipeline is). Depth 1 + coalescing off is the exact
+        #: r6 code path, byte-identical on the wire.
+        if (
+            not isinstance(pipeline_depth, int)
+            or isinstance(pipeline_depth, bool)
+            or pipeline_depth < 1
+        ):
+            raise ValueError(
+                f"pipeline_depth must be an int >= 1, got {pipeline_depth!r}"
+            )
+        self._pipeline_depth = pipeline_depth
+        self._coalesce = (
+            pipeline_depth > 1 if coalesce is None else bool(coalesce)
+        )
+        self._commit_pool = (
+            ThreadPoolExecutor(
+                max_workers=pipeline_depth, thread_name_prefix="commit"
+            )
+            if pipeline_depth > 1 else None
+        )
         self._publish_enabled = False
         self._warm_from_cluster()
         self._publish_enabled = True
         self._republish()
+        if self._coalesce:
+            # boot publishes eagerly even under coalescing: a freshly
+            # constructed dealer must expose its warm mapping (readyz,
+            # tests, debug surfaces) without waiting for a first reader
+            for shard in list(self._shards.values()):
+                self._drain_shard(shard)
         #: boot-time assumed-pod reconstruction is complete; one of the two
         #: /readyz gates (the other is the controller's informer sync)
         self.warmed = True
@@ -565,7 +618,75 @@ class Dealer:
 
     def _republish_shard(self, shard: _Shard,
                          changed: tuple[str, ...] = ()) -> None:
-        """Swap in a fresh immutable snapshot on ONE shard.
+        """Publish a commit's delta on ONE shard.
+
+        Direct mode (coalescing off — the default at pipeline depth 1)
+        swaps the snapshot synchronously under the shard's publish lock:
+        the exact pre-pipeline behavior. Coalescing mode
+        (docs/bind-pipeline.md) is the commit BATCHER: the commit only
+        ENQUEUES its delta into the shard's pending set (set ops under a
+        tiny lock — the write path never does view-advance work, never
+        waits on the publish lock) and the next READER of the shard's
+        snapshot drains everything pending into ONE swap. All
+        republishes landing between two reads of a shard — every bind
+        of a storm burst, a whole drained metric sweep — fold into a
+        single snapshot swap with a single copy-on-write advance per
+        cached view, instead of one full advance per commit."""
+        if not self._coalesce:
+            with shard._publish_lock:
+                self._publish_shard_locked(shard, changed)
+            return
+        with shard._pending_lock:
+            if changed:
+                shard._pending.update(changed)
+            else:
+                shard._pending_all = True
+        shard.perf.publish_coalesced += 1
+
+    def _drain_shard(self, shard: _Shard) -> None:
+        """Reader-side coalescing drain: fold every enqueued delta on
+        this shard into (at most) one snapshot swap, via a non-blocking
+        publish-leader election.
+
+        The try-acquire keeps the RCU promise that readers never block
+        on publisher work: one reader becomes the leader and performs
+        the swap; concurrent readers proceed against the current
+        snapshot. Staleness is therefore bounded by ONE in-flight swap —
+        a reader racing the leader can score a commit behind, the same
+        sub-millisecond window a pre-pipeline reader racing a
+        publish-in-progress already had (and kube-scheduler's bind
+        re-checks feasibility against live chip state either way). A
+        leader re-checks the pending set after releasing, so a delta
+        enqueued while it held the lock can never park unpublished while
+        readers keep arriving. Generation numbers stay strictly
+        monotonic: swaps still serialize on the publish lock."""
+        while shard._pending or shard._pending_all:
+            if not shard._publish_lock.acquire(blocking=False):
+                # a concurrent leader is mid-swap; read the current
+                # snapshot rather than wait (its post-release re-check
+                # keeps the delta from parking)
+                return
+            try:
+                with shard._pending_lock:
+                    # sorted(): a deterministic drain order (sets iterate
+                    # in hash order, which the sim-determinism discipline
+                    # bans on code the sim drives)
+                    drained = tuple(sorted(shard._pending))
+                    probe_all = shard._pending_all
+                    shard._pending.clear()
+                    shard._pending_all = False
+                self._publish_shard_locked(
+                    shard, () if probe_all else drained
+                )
+            finally:
+                shard._publish_lock.release()
+            # loop: a delta enqueued while we held the lock (its commit's
+            # try-acquire failed against us) must not park unpublished
+
+    def _publish_shard_locked(self, shard: _Shard,
+                              changed: tuple[str, ...] = ()) -> None:
+        """Swap in a fresh immutable snapshot on ONE shard (caller holds
+        ``shard._publish_lock``).
 
         Chip-state-only publishes reuse the node mapping and ADVANCE every
         cached candidate-list view (copy-on-write: only rows whose
@@ -584,54 +705,53 @@ class Dealer:
         capture — never while advancing views, so a slow advance cannot
         stall verb commits (and never while holding another shard's
         publish lock, so no cross-shard lock order exists)."""
-        with shard._publish_lock:
-            # bumped BEFORE the views capture: a reader whose lazy build
-            # this publish raced past (its entry not yet inserted) sees
-            # the bump and re-advances its rows before trusting them
-            shard._commit_seq += 1
-            old = shard._published
-            with self._lock:
-                epoch = self._shard_epoch_locked(shard)
-                structural = epoch != shard._pub_epoch
-                if structural:
-                    if self._shard_fn is None:
-                        nodes = dict(self._nodes)
-                    else:
-                        nodes = dict(self._members.get(shard.key, {}))
-                    non_tpu = frozenset(self._non_tpu)
-                else:
-                    nodes, non_tpu = old.nodes, old.non_tpu
-            views: dict[tuple, tuple | None] = {}
-            moved = False
-            if not structural:
-                for key, entry in list(old.views.items()):
-                    if entry is None:
-                        views[key] = None
-                        continue
-                    scorer, names_key, non_tpu_names, index_of = entry
-                    if changed:
-                        rows = [
-                            i for n in changed
-                            if (i := index_of.get(n)) is not None
-                        ]
-                        adv = scorer.advanced(rows) if rows else scorer
-                    else:
-                        adv = scorer.advanced()
-                    if adv is scorer:
-                        views[key] = entry
-                    else:
-                        moved = True
-                        views[key] = (adv, names_key, non_tpu_names,
-                                      index_of)
-                if not moved:
-                    return  # byte-identical views: nothing to publish
-            snap = _Snapshot(old.gen + 1, nodes, non_tpu)
-            snap.views = views
-            shard._pub_epoch = epoch
-            shard.perf.snapshot_publishes += 1
+        # bumped BEFORE the views capture: a reader whose lazy build
+        # this publish raced past (its entry not yet inserted) sees
+        # the bump and re-advances its rows before trusting them
+        shard._commit_seq += 1
+        old = shard._published
+        with self._lock:
+            epoch = self._shard_epoch_locked(shard)
+            structural = epoch != shard._pub_epoch
             if structural:
-                shard.perf.snapshot_structural += 1
-            shard._published = snap
+                if self._shard_fn is None:
+                    nodes = dict(self._nodes)
+                else:
+                    nodes = dict(self._members.get(shard.key, {}))
+                non_tpu = frozenset(self._non_tpu)
+            else:
+                nodes, non_tpu = old.nodes, old.non_tpu
+        views: dict[tuple, tuple | None] = {}
+        moved = False
+        if not structural:
+            for key, entry in list(old.views.items()):
+                if entry is None:
+                    views[key] = None
+                    continue
+                scorer, names_key, non_tpu_names, index_of = entry
+                if changed:
+                    rows = [
+                        i for n in changed
+                        if (i := index_of.get(n)) is not None
+                    ]
+                    adv = scorer.advanced(rows) if rows else scorer
+                else:
+                    adv = scorer.advanced()
+                if adv is scorer:
+                    views[key] = entry
+                else:
+                    moved = True
+                    views[key] = (adv, names_key, non_tpu_names,
+                                  index_of)
+            if not moved:
+                return  # byte-identical views: nothing to publish
+        snap = _Snapshot(old.gen + 1, nodes, non_tpu)
+        snap.views = views
+        shard._pub_epoch = epoch
+        shard.perf.snapshot_publishes += 1
+        if structural:
+            shard.perf.snapshot_structural += 1
+        shard._published = snap
 
     def _maybe_republish(self) -> None:
         """Catch-up publish for read verbs that warmed cold nodes (their
@@ -650,7 +770,10 @@ class Dealer:
         introspection): the default shard's published snapshot. Sharded
         dealers have one snapshot PER shard — use :meth:`shard_status`
         or :meth:`debug_snapshot`."""
-        return self._default_shard._published
+        shard = self._default_shard
+        if shard._pending or shard._pending_all:
+            self._drain_shard(shard)  # commit-pipeline read barrier
+        return shard._published
 
     def _snapshot_gen(self) -> int:
         """Published generation for trace lines: the single shard's gen,
@@ -668,11 +791,16 @@ class Dealer:
         """The published NodeInfo for ``name`` from its owning shard's
         snapshot (lock-free), or None when unpublished/unknown."""
         if self._shard_fn is None:
-            return self._default_shard._published.nodes.get(name)
+            shard = self._default_shard
+            if shard._pending or shard._pending_all:
+                self._drain_shard(shard)  # commit-pipeline read barrier
+            return shard._published.nodes.get(name)
         key = self._shard_of.get(name)
         shard = self._shards.get(key) if key is not None else None
         if shard is None:
             return None
+        if shard._pending or shard._pending_all:
+            self._drain_shard(shard)  # commit-pipeline read barrier
         return shard._published.nodes.get(name)
 
     def _view_for(self, shard: _Shard, key: tuple):
@@ -690,6 +818,14 @@ class Dealer:
         re-probes every row, which by writer program order (chip mutation
         -> republish -> seq bump) incorporates any commit the first read
         missed."""
+        if shard._pending or shard._pending_all:
+            # commit-pipeline read barrier (docs/bind-pipeline.md): drain
+            # any coalesced-but-unswapped delta before consuming the
+            # snapshot. A read either swaps the delta in itself or races
+            # a leader already mid-swap — staleness is bounded by that
+            # ONE in-flight swap (see _drain_shard). Two plain attribute
+            # loads when idle; both always empty with coalescing off.
+            self._drain_shard(shard)
         snap = shard._published
         entry = snap.views.get(key, _VIEW_MISSING)
         if entry is not _VIEW_MISSING:
@@ -1251,16 +1387,37 @@ class Dealer:
         same threading and records reservation / commit / gang-park
         events."""
         deadline_check(deadline, "bind:start")
+        #: set by _reserve right after it applies+publishes the chip
+        #: reservation: (NodeInfo, version at reserve time)
+        reserved_state: list = []
         try:
-            return self._bind_outer(node_name, pod, trace)
+            return self._bind_outer(node_name, pod, trace, reserved_state)
         finally:
             # one publish covers commit AND rollback: either way the chip
             # state that read verbs consume may have moved — and only on
-            # this node (the reserve-half publish usually already carried
-            # it, making this a cheap no-op)
-            self._republish((node_name,))
+            # this node. But a CLEAN commit moves nothing (the API writes
+            # touch annotations, not chips), so when the node's version
+            # still matches what _reserve published — and the NodeInfo is
+            # still the registered instance (a mid-commit rebuild replays
+            # onto a fresh one, which must publish) — the reserve-half
+            # publish already covers everything and the second republish
+            # is skipped outright instead of probed-and-dropped.
+            # Rollbacks bump the version (unbind), so they always publish.
+            # Both reads are GIL-atomic; a concurrent bind moving the
+            # version only ever forces an extra (cheap, probe-only)
+            # publish, never a skipped one.
+            entry = reserved_state[-1] if reserved_state else None
+            if (
+                entry is not None
+                and entry[0].version == entry[1]
+                and self._nodes.get(node_name) is entry[0]
+            ):
+                self.perf.publish_skips += 1
+            else:
+                self._republish((node_name,))
 
-    def _bind_outer(self, node_name: str, pod: Pod, trace=None) -> Pod:
+    def _bind_outer(self, node_name: str, pod: Pod, trace=None,
+                    reserved_state: list | None = None) -> Pod:
         try:
             # idempotent-retry guard: the scheduler can re-issue a bind it
             # abandoned (its extender httpTimeout elapsed) that committed
@@ -1283,9 +1440,10 @@ class Dealer:
                 )
             gang = podutil.gang_of(pod)
             if gang and gang[1] > 1 and podutil.gang_is_strict(pod):
-                bound = self._bind_strict(node_name, pod, gang, trace)
+                bound = self._bind_strict(node_name, pod, gang, trace,
+                                          reserved_state)
             else:
-                bound = self._bind(node_name, pod, trace)
+                bound = self._bind(node_name, pod, trace, reserved_state)
         except BindError as e:
             self.recorder.event(
                 pod, "Warning", events.REASON_FAILED_BINDING, str(e)
@@ -1301,13 +1459,18 @@ class Dealer:
         )
         return bound
 
-    def _bind(self, node_name: str, pod: Pod, trace=None) -> Pod:
-        info, plan = self._reserve(node_name, pod, trace)
+    def _bind(self, node_name: str, pod: Pod, trace=None,
+              reserved_state: list | None = None) -> Pod:
+        info, plan = self._reserve(node_name, pod, trace, reserved_state)
         return self._commit_reserved(info, plan, node_name, pod, trace)
 
-    def _reserve(self, node_name: str, pod: Pod, trace=None):
+    def _reserve(self, node_name: str, pod: Pod, trace=None,
+                 reserved_state: list | None = None):
         """Apply the pod's chip reservation on the node (no API writes).
-        Returns (NodeInfo, Plan); raises BindError when infeasible."""
+        Returns (NodeInfo, Plan); raises BindError when infeasible.
+        ``reserved_state`` (when given) receives ``(info, version)`` at
+        reserve time — the token bind()'s finally-clause compares to
+        decide whether the commit moved chip state at all."""
         info = self._node_info(node_name)
         if info is None:
             raise BindError(
@@ -1321,6 +1484,12 @@ class Dealer:
                 f"no feasible plan for pod {pod.key()} on node {node_name}",
                 reason=REASON_INSUFFICIENT_CHIPS,
             )
+        if reserved_state is not None:
+            # captured BEFORE the publish below: every later version bump
+            # (a rollback here, a concurrent bind) carries its own
+            # publish, so "version still == this" means the publish below
+            # covered every chip move this bind is responsible for
+            reserved_state.append((info, info.version))
         if trace is not None:
             trace.event("bind:reserved", node_name)
             if self._shard_fn is not None:
@@ -1356,7 +1525,8 @@ class Dealer:
                 barrier.cv.notify_all()
 
     def _bind_strict(self, node_name: str, pod: Pod,
-                     gang: tuple[str, int], trace=None) -> Pod:
+                     gang: tuple[str, int], trace=None,
+                     reserved_state: list | None = None) -> Pod:
         """All-or-nothing gang bind (tpu.io/gang-policy: strict): reserve,
         register the reservation (so node rebuilds migrate it), then park
         at the gang's barrier until ``barrier.size`` members hold
@@ -1384,7 +1554,8 @@ class Dealer:
                     barrier.size = max(barrier.size, gang[1])
             barrier.users += 1
         try:
-            return self._park_and_commit(barrier, key, node_name, pod, trace)
+            return self._park_and_commit(barrier, key, node_name, pod, trace,
+                                         reserved_state)
         finally:
             with self._lock:
                 barrier.users -= 1
@@ -1400,19 +1571,28 @@ class Dealer:
                     self._gang_barriers.pop(key, None)
 
     def _park_and_commit(self, barrier: GangBarrier, key: str,
-                         node_name: str, pod: Pod, trace=None) -> Pod:
-        info, plan = self._reserve(node_name, pod, trace)
-        with barrier.cv:
-            if pod.uid in barrier.parked:
-                info.unbind(plan)
-                raise BindError(
-                    f"bind of {pod.key()} is already parked at gang {key}'s "
-                    "barrier",
-                    reason=REASON_ALREADY_BOUND,
-                )
-            barrier.parked.add(pod.uid)
+                         node_name: str, pod: Pod, trace=None,
+                         reserved_state: list | None = None) -> Pod:
+        info, plan = self._reserve(node_name, pod, trace, reserved_state)
+        # parking and reservation registration are ONE dealer-lock
+        # critical section (lock order dealer -> cv, same as
+        # _bind_strict): a batch committer captures the parked set under
+        # cv but claims the reservations under the dealer lock, so a
+        # member must never be visible in `parked` before its
+        # reservation is registered — the committer would claim None and
+        # fail a member whose chips are validly reserved
+        my_res = _Reservation(node_name, info, plan, key, pod, trace)
         with self._lock:
-            self._reserved[pod.uid] = _Reservation(node_name, info, plan, key)
+            with barrier.cv:
+                if pod.uid in barrier.parked:
+                    info.unbind(plan)
+                    raise BindError(
+                        f"bind of {pod.key()} is already parked at gang "
+                        f"{key}'s barrier",
+                        reason=REASON_ALREADY_BOUND,
+                    )
+                barrier.parked.add(pod.uid)
+            self._reserved[pod.uid] = my_res
         if trace is not None:
             trace.event("gang:parked", key)
         timeout = podutil.gang_timeout(pod)
@@ -1420,13 +1600,35 @@ class Dealer:
         parked_t0 = time.monotonic()
         try:
             try:
+                batch = None
                 with barrier.cv:
-                    if not barrier.open and (
-                        self.gangs.bound_count(key) + len(barrier.parked)
+                    if (
+                        not barrier.open
+                        and not barrier.committing
+                        and self.gangs.bound_count(key) + len(barrier.parked)
                         >= barrier.size
                     ):
-                        barrier.open = True
-                        barrier.cv.notify_all()
+                        if (
+                            self._commit_pool is not None
+                            and len(barrier.parked) > 1
+                        ):
+                            # batched gang commit (docs/bind-pipeline.md):
+                            # the arriving member that completes the gang
+                            # becomes its COMMITTER — it fans every parked
+                            # member's API writes out through the bounded
+                            # commit pool and only then opens the barrier,
+                            # delivering per-member results. Claiming under
+                            # cv suspends the claimed members' timeouts:
+                            # their writes are now in flight.
+                            barrier.committing = True
+                            batch = sorted(barrier.parked)
+                            barrier.claimed.update(batch)
+                        else:
+                            barrier.open = True
+                            barrier.cv.notify_all()
+                if batch is not None:
+                    self._commit_gang_batch(barrier, key, batch, trace)
+                with barrier.cv:
                     while not barrier.open:
                         if pod.uid not in barrier.parked:
                             # de-parked by _invalidate_reservation (node
@@ -1436,6 +1638,15 @@ class Dealer:
                             break
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
+                            if pod.uid in barrier.claimed:
+                                # a batch committer claimed this member:
+                                # its API write is IN FLIGHT on the commit
+                                # pool and will deliver a result (the
+                                # resilient client bounds its attempts) —
+                                # a timeout rollback here would double-
+                                # book the chips that write is committing
+                                barrier.cv.wait(1.0)
+                                continue
                             have = (
                                 self.gangs.bound_count(key)
                                 + len(barrier.parked)
@@ -1459,7 +1670,14 @@ class Dealer:
             if trace is not None:
                 trace.event("gang:timeout", key)
             with barrier.cv:
+                # also clear any claim/result that raced this timeout
+                # (the committer can capture a member in the window
+                # between its timeout raise and this handler): a stale
+                # claim would suspend a RETRY's timeout forever, and a
+                # stale result would be mistaken for the retry's own
                 barrier.parked.discard(pod.uid)
+                barrier.claimed.discard(pod.uid)
+                barrier.results.pop(pod.uid, None)
             with self._lock:
                 res = self._reserved.pop(pod.uid, None)
             if res is not None and res.valid:
@@ -1467,7 +1685,25 @@ class Dealer:
             raise
         with barrier.cv:
             barrier.parked.discard(pod.uid)
+            barrier.claimed.discard(pod.uid)
             opened = barrier.open
+            entry = barrier.results.pop(pod.uid, None)
+            # a result is only OURS if it carries OUR reservation: an
+            # entry left by a previous (timed-out) park of this uid must
+            # not decide this bind — drop it and commit individually
+            result = (
+                entry[1] if entry is not None and entry[0] is my_res
+                else None
+            )
+        if result is not None:
+            # this member's commit ran on the batch committer's pool: the
+            # result IS the outcome — accounting was committed or rolled
+            # back there, exactly as it would have been on this thread
+            if isinstance(result, BindError):
+                raise result
+            if trace is not None:
+                trace.event("gang:opened", f"{key} batched")
+            return result
         with self._lock:
             res = self._reserved.pop(pod.uid, None)
         if res is not None and res.valid and opened:
@@ -1487,6 +1723,103 @@ class Dealer:
             f"{key}'s barrier; reservation lost, bind must retry",
             reason=REASON_NODE_CHANGED,
         )
+
+    def _commit_gang_batch(self, barrier: GangBarrier, key: str,
+                           uids: list[str], trace=None) -> None:
+        """Fan a complete strict gang's member commits out through the
+        bounded commit pool — ``pipeline_depth`` members' annotation +
+        binding writes overlap, so the gang commits in ~ceil(n/depth)
+        write round-trips instead of n sequential ones.
+
+        Claimed reservations are popped from ``_reserved`` first (the
+        same ownership transfer a single member's post-open path does),
+        then each member's :meth:`_commit_reserved` runs on a worker —
+        bookkeeping, per-member rollback, and the assume-TTL/forget
+        replay escape hatches are shared with the one-at-a-time path, so
+        failure semantics are identical: a member whose write fails gets
+        its accounting rolled back and a BindError result while the rest
+        of the (already fully-reserved) gang commits; kube-scheduler
+        retries the failed member, which binds straight through the open
+        barrier. Never raises: every claimed uid gets a result and the
+        barrier ALWAYS opens, even if the pool is shutting down."""
+        with self._lock:
+            claimed = [(uid, self._reserved.pop(uid, None)) for uid in uids]
+        if trace is not None:
+            trace.event("gang:batch-commit", f"{key} members={len(uids)}")
+        #: uid -> (claimed reservation, bound Pod | BindError). The
+        #: reservation is the result's IDENTITY: the parked thread only
+        #: consumes a result carrying ITS OWN reservation, so an outcome
+        #: orphaned by a timeout race can never decide a later re-bind.
+        results: dict[str, tuple] = {}
+        try:
+            futures = {}
+            for uid, res in claimed:
+                if res is None or not res.valid:
+                    # node removed/rebuilt while parked and the plan no
+                    # longer fits — or the member's timeout raced our
+                    # claim and already rolled itself back: same terminal
+                    # answer the individual post-open path gives
+                    results[uid] = (res, BindError(
+                        f"node changed while a member of gang {key} "
+                        "awaited the barrier; reservation lost, bind "
+                        "must retry",
+                        reason=REASON_NODE_CHANGED,
+                    ))
+                    continue
+                try:
+                    futures[self._commit_pool.submit(
+                        self._commit_gang_member, res
+                    )] = (uid, res)
+                except Exception as e:
+                    # pool shutting down (dealer.close() racing a live
+                    # gang): the claimed reservation was applied via
+                    # info.bind and nothing downstream will commit it —
+                    # roll it back HERE or the chips leak ownerless
+                    res.info.unbind(res.plan)
+                    results[uid] = (res, BindError(
+                        f"gang {key} commit pool unavailable ({e}); "
+                        "reservation rolled back, bind must retry",
+                    ))
+            for future, (uid, res) in futures.items():
+                results[uid] = (res, future.result())
+        finally:
+            with barrier.cv:
+                for uid in uids:
+                    # defensive: every claimed uid gets a terminal answer
+                    results.setdefault(uid, (None, BindError(
+                        f"gang {key} batch commit aborted before this "
+                        "member's write was attempted; bind must retry",
+                    )))
+                barrier.results.update(results)
+                barrier.committing = False
+                barrier.open = True
+                barrier.cv.notify_all()
+
+    def _commit_gang_member(self, res: _Reservation):
+        """One claimed member's API writes + bookkeeping on a commit-pool
+        worker. Returns the bound Pod or the BindError — accounting is
+        committed/rolled back inside ``_commit_reserved`` exactly as on
+        the member's own bind thread. The member's trace is re-bound
+        thread-locally so the resilient client's retry/breaker events
+        land in the right causal record."""
+        set_current(res.trace)
+        try:
+            bound = self._commit_reserved(
+                res.info, res.plan, res.node_name, res.pod, res.trace
+            )
+            self.perf.gang_batched_commits += 1
+            return bound
+        except BindError as e:
+            return e
+        except Exception as e:  # defensive: a worker must never lose a
+            # member's outcome — the parked thread is waiting on it
+            log.exception(
+                "gang member commit for pod uid(s) on %s failed "
+                "unexpectedly", res.node_name,
+            )
+            return BindError(f"gang member commit failed: {e}")
+        finally:
+            set_current(None)
 
     def _commit_reserved(self, info, plan: Plan, node_name: str,
                          pod: Pod, trace=None) -> Pod:
@@ -1775,8 +2108,28 @@ class Dealer:
         out["shards"] = self.shard_status()
         return out
 
+    def pipeline_status(self) -> dict:
+        """Commit-pipeline configuration + live coalescing state
+        (docs/bind-pipeline.md): exposed on ``/debug/decisions`` so a
+        storm's publish behavior is diagnosable from the outside."""
+        shards = list(self._shards.values())
+        return {
+            "depth": self._pipeline_depth,
+            "coalesce": self._coalesce,
+            # named deltas plus parked probe-everything publishes
+            # awaiting the next reader. NONZERO AFTER A WRITE BURST IS
+            # NORMAL (binds only enqueue; the next read drains) — what
+            # it diagnoses is a value that never returns to zero while
+            # reads ARE arriving
+            "pending": sum(len(shard._pending) for shard in shards)
+            + sum(1 for shard in shards if shard._pending_all),
+        }
+
     def close(self) -> None:
-        """Release the assume thread pool. Only needed by harnesses that
-        churn dealers (the sim's agent-restart fault builds a fresh dealer
-        per restart); a live scheduler keeps one dealer for its lifetime."""
+        """Release the assume thread pool (and the commit pool when the
+        pipeline is on). Only needed by harnesses that churn dealers (the
+        sim's agent-restart fault builds a fresh dealer per restart); a
+        live scheduler keeps one dealer for its lifetime."""
         self._pool.shutdown(wait=False)
+        if self._commit_pool is not None:
+            self._commit_pool.shutdown(wait=False)

@@ -138,6 +138,17 @@ class GangBarrier:
         #: keeps barrier cleanup from orphaning a fetched-but-not-yet-
         #: parked thread onto a removed object
         self.users = 0
+        #: batched-commit state (Dealer._commit_gang_batch, all under
+        #: ``cv``): while ``committing`` the opener is fanning the claimed
+        #: members' API writes out through the dealer's commit pool —
+        #: ``open`` stays False so late arrivals keep parking, and claimed
+        #: members' timeouts are suspended (their write is in flight; a
+        #: timeout rollback would double-book the chips the batch worker
+        #: is committing). ``results`` delivers each claimed member's
+        #: bound Pod or BindError back to its own parked bind thread.
+        self.committing = False
+        self.claimed: set[str] = set()
+        self.results: dict[str, object] = {}
 
 
 def gang_affinity_bonus(

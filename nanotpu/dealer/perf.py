@@ -40,6 +40,9 @@ class PerfCounters:
         "fastpath_misses",
         "memo_hits",
         "native_calls",
+        "publish_skips",
+        "publish_coalesced",
+        "gang_batched_commits",
     )
 
     def __init__(self):
@@ -63,6 +66,20 @@ class PerfCounters:
         #: scoring calls
         self.memo_hits = 0
         self.native_calls = 0
+        #: bind finally-clause republishes skipped because commit/rollback
+        #: did not move chip state beyond what _reserve already published
+        #: (the bench proves the two-publishes-per-bind pattern is gone)
+        self.publish_skips = 0
+        #: commit-pipeline publishes enqueued to the coalescing batcher
+        #: instead of swapping inline (docs/bind-pipeline.md): the next
+        #: reader folds ALL of a shard's pending deltas into one swap, so
+        #: (coalesced - publishes) is the per-bind view-advance work the
+        #: pipeline removed from the write path
+        self.publish_coalesced = 0
+        #: strict-gang member commits fanned out through the dealer's
+        #: bounded commit pool (vs committed one-at-a-time on the member's
+        #: own bind thread)
+        self.gang_batched_commits = 0
 
     def snapshot(self) -> dict[str, int]:
         """Point-in-time copy (bench delta arithmetic / metrics render)."""

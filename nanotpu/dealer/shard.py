@@ -115,7 +115,8 @@ class _Shard:
 
     __slots__ = (
         "key", "perf", "epoch", "_publish_lock", "_published",
-        "_pub_epoch", "_commit_seq",
+        "_pub_epoch", "_commit_seq", "_pending", "_pending_all",
+        "_pending_lock",
     )
 
     def __init__(self, key: str, perf: PerfCounters | None = None):
@@ -132,6 +133,17 @@ class _Shard:
         #: including skipped ones: lets a reader detect that a commit
         #: raced its lazy view build (see Dealer._view_for)
         self._commit_seq = 0
+        #: commit-pipeline coalescing state (docs/bind-pipeline.md):
+        #: changed node names whose snapshot publish has been ENQUEUED but
+        #: not yet swapped — a publish leader (or the next reader) drains
+        #: them into ONE snapshot swap. ``_pending_all`` marks a queued
+        #: probe-everything publish (structural sweep / cold-node warmup).
+        #: Guarded by ``_pending_lock`` (tiny, compute-only critical
+        #: sections; in nanolint's HOT_LOCKS); both are read lock-free as
+        #: a truthiness fast path by readers.
+        self._pending: set[str] = set()
+        self._pending_all = False
+        self._pending_lock = make_lock("_Shard._pending_lock")
 
 
 def merge_top_k(scored_lists, k: int | None = None) -> list[tuple[str, int]]:

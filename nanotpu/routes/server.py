@@ -539,6 +539,7 @@ class SchedulerAPI:
             )
         records = self.obs.ledger.recent(limit)
         shard_status = getattr(self.dealer, "shard_status", None)
+        pipeline_status = getattr(self.dealer, "pipeline_status", None)
         return 200, "application/json", json.dumps({
             "sampling": self.obs.tracer.sample,
             "count": len(records),
@@ -552,6 +553,14 @@ class SchedulerAPI:
             # stopped moving while siblings advance) is diagnosable from
             # the outside (docs/sharding.md)
             "shards": shard_status() if shard_status is not None else {},
+            # commit-pipeline depth/coalescing + publish deltas parked
+            # for the next reader (docs/bind-pipeline.md). Nonzero
+            # `pending` right after a write burst is NORMAL (binds only
+            # enqueue; reads drain) — a value that never returns to zero
+            # while reads keep arriving names a drain bug
+            "pipeline": (
+                pipeline_status() if pipeline_status is not None else {}
+            ),
         }, sort_keys=True)
 
     # -- idle-time GC (the between-burst half of the GC discipline) --------
@@ -904,6 +913,13 @@ class _Handler(socketserver.StreamRequestHandler):
 class _Server(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
+    #: listen(2) backlog. socketserver's default of FIVE drops SYNs the
+    #: moment kube-scheduler's async bind goroutines open a burst of
+    #: connections (a 32-member gang connecting at once overflows it),
+    #: and a dropped SYN costs the client a 1s/3s retransmit — measured
+    #: as exactly-1000ms connect stalls in the bind-storm bench. Go's
+    #: net/http listens with the OS somaxconn for the same reason.
+    request_queue_size = 128
     api: SchedulerAPI | None = None
 
     def shutdown(self):

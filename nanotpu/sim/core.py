@@ -149,6 +149,7 @@ class Simulator:
         self.dealer = Dealer(
             api_client, make_rater(self.scenario["policy"]), assume_workers=2,
             obs=self.obs, shards=self.scenario["shards"],
+            pipeline_depth=self.scenario["pipeline"],
         )
         self.predicate = Predicate(self.dealer, obs=self.obs)
         self.prioritize = Prioritize(self.dealer, obs=self.obs)

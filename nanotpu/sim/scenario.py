@@ -41,6 +41,15 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
                                      # byte-identical to the pre-shard
                                      # dealer) or "auto" (one RCU shard
                                      # per slice family — docs/sharding.md)
+      "pipeline": 1,                 # commit-pipeline depth
+                                     # (docs/bind-pipeline.md): 1 = the
+                                     # pre-pipeline write path; >1 arms
+                                     # publish coalescing + the batched
+                                     # gang-commit pool (the sim is
+                                     # single-threaded, so behavior — and
+                                     # the digest — stays identical; the
+                                     # soak proves the armed code path
+                                     # keeps every invariant)
       "lock_witness": false,         # true: instrument every lock and
                                      # assert acquisition-order acyclicity
                                      # at teardown (docs/static-analysis.md)
@@ -141,6 +150,12 @@ def normalize_scenario(raw: dict) -> dict:
         shards in (1, "auto"),
         f"shards must be 1 or 'auto', got {shards!r}",
     )
+    pipeline = raw.get("pipeline", 1)
+    _require(
+        isinstance(pipeline, int) and not isinstance(pipeline, bool)
+        and pipeline >= 1,
+        f"pipeline must be an int >= 1, got {pipeline!r}",
+    )
 
     return {
         "name": raw.get("name", "unnamed"),
@@ -157,6 +172,7 @@ def normalize_scenario(raw: dict) -> dict:
         "assume_ttl_s": float(raw.get("assume_ttl_s", 0.0)),
         "queue_max": int(raw.get("queue_max", 0)),
         "shards": shards,
+        "pipeline": pipeline,
         "lock_witness": bool(raw.get("lock_witness", False)),
         "trace": bool(raw.get("trace", True)),
     }

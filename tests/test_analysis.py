@@ -211,6 +211,118 @@ class TestLockDiscipline:
         assert any("cycle" in f.message for f in report.findings), \
             report.findings
 
+    def test_tryacquire_with_release_is_clean(self, tmp_path):
+        # the commit pipeline's publish-leader election
+        # (docs/bind-pipeline.md): a non-blocking acquire with a matching
+        # release in the same function is the sanctioned idiom, not an
+        # opaque bare acquire
+        report = one(tmp_path, """
+            class Dealer:
+                def drain(self, shard: _Shard):
+                    if not shard._publish_lock.acquire(blocking=False):
+                        return
+                    try:
+                        pass
+                    finally:
+                        shard._publish_lock.release()
+            """, "lock-discipline")
+        assert report.findings == [], report.findings
+
+    def test_release_of_with_held_lock_stays_bare(self, tmp_path):
+        # the try-acquire matcher must not absorb an unbalanced
+        # release() inside a `with` block — that was (and remains) a
+        # bare-release finding
+        report = one(tmp_path, """
+            class Dealer:
+                def f(self):
+                    with self._publish_lock:
+                        self._publish_lock.release()
+            """, "lock-discipline")
+        assert any("bare" in f.message for f in report.findings), \
+            report.findings
+
+    def test_tryacquire_without_release_flagged(self, tmp_path):
+        report = one(tmp_path, """
+            class Dealer:
+                def leak(self, shard: _Shard):
+                    if shard._publish_lock.acquire(blocking=False):
+                        pass
+            """, "lock-discipline")
+        assert any(
+            "try-acquire" in f.message and "release" in f.message
+            for f in report.findings
+        ), report.findings
+
+    def test_tryacquire_span_carries_order_edges(self, tmp_path):
+        # a cycle established THROUGH a try-acquire span must still be
+        # caught: forward path try-acquires A then takes B; reverse path
+        # nests B then A
+        report = one(tmp_path, """
+            class Dealer:
+                def forward(self, shard: _Shard):
+                    if not shard._a_lock.acquire(blocking=False):
+                        return
+                    try:
+                        with shard._b_lock:
+                            pass
+                    finally:
+                        shard._a_lock.release()
+
+                def backward(self, shard: _Shard):
+                    with shard._b_lock:
+                        with shard._a_lock:
+                            pass
+            """, "lock-discipline")
+        assert any("cycle" in f.message for f in report.findings), \
+            report.findings
+
+    def test_blocking_under_reservation_lock(self, tmp_path):
+        # the per-node reservation-lock rule (docs/bind-pipeline.md): the
+        # async commit workers apply/roll back reservations under
+        # NodeInfo.lock, so an apiserver round-trip under one convoys
+        # every verb touching that node
+        report = one(tmp_path, """
+            class NodeInfo:
+                def __init__(self):
+                    self.lock = make_rlock("NodeInfo.lock")
+
+                def bind_and_write(self):
+                    with self.lock:
+                        self.client.update_pod(None)
+            """, "lock-discipline")
+        assert any(
+            "reservation lock NodeInfo.lock" in f.message
+            for f in report.findings
+        ), report.findings
+
+    def test_compute_under_reservation_lock_is_clean(self, tmp_path):
+        report = one(tmp_path, """
+            class NodeInfo:
+                def __init__(self):
+                    self.lock = make_rlock("NodeInfo.lock")
+
+                def bind(self, demand):
+                    with self.lock:
+                        return self.chips.can_fit(demand)
+            """, "lock-discipline")
+        assert report.findings == [], report.findings
+
+    def test_blocking_under_pending_lock_flagged(self, tmp_path):
+        # _Shard._pending_lock is in HOT_LOCKS: every pipelined commit
+        # enqueues under it, so its critical sections are set-ops-only
+        report = one(tmp_path, """
+            class _Shard:
+                def __init__(self):
+                    self._pending_lock = make_lock("_Shard._pending_lock")
+
+                def enqueue_and_fetch(self):
+                    with self._pending_lock:
+                        self.client.get_node("n")
+            """, "lock-discipline")
+        assert any(
+            "_Shard._pending_lock" in f.message for f in report.findings
+        ), report.findings
+
 
 # ---------------------------------------------------------------------------
 # snapshot-immutability
@@ -320,6 +432,47 @@ class TestDeadlineThreading:
                     return [info.score(pod) for info in self.infos]
             """, "deadline-threading")
         assert report.findings == []
+
+    def test_probe_after_reserve_flagged(self, tmp_path):
+        # once a chip reservation exists the bind must commit through
+        # (docs/bind-pipeline.md): a budget probe past _reserve would
+        # abandon applied-but-uncommitted chip state
+        report = one(tmp_path, """
+            class Dealer:
+                def bind(self, node_name, pod, deadline=None):
+                    deadline_check(deadline, "bind:start")
+                    info, plan = self._reserve(node_name, pod)
+                    deadline_check(deadline, "bind:committing")
+                    return self._commit_reserved(info, plan)
+            """, "deadline-threading")
+        assert any(
+            "after creating a reservation" in f.message
+            for f in report.findings
+        ), report.findings
+
+    def test_probe_before_reserve_is_clean(self, tmp_path):
+        report = one(tmp_path, """
+            class Dealer:
+                def bind(self, node_name, pod, deadline=None):
+                    deadline_check(deadline, "bind:start")
+                    info, plan = self._reserve(node_name, pod)
+                    return self._commit_reserved(info, plan)
+            """, "deadline-threading")
+        assert report.findings == [], report.findings
+
+    def test_commit_side_worker_must_not_probe(self, tmp_path):
+        # the pipeline's async gang-commit workers run ENTIRELY on the
+        # commit side of a reservation: any probe inside is a finding,
+        # reserve call or not
+        report = one(tmp_path, """
+            class Dealer:
+                def _commit_gang_member(self, res, deadline=None):
+                    deadline_check(deadline, "gang:member")
+                    return self._do_writes(res)
+            """, "deadline-threading")
+        assert any(
+            "commit side" in f.message for f in report.findings
+        ), report.findings
 
 
 # ---------------------------------------------------------------------------

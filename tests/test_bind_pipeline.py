@@ -1,0 +1,518 @@
+"""Commit-pipeline invariants (pipelined bind commits: coalesced
+publishes, batched gang commits, the redundant-republish skip).
+
+The load-bearing properties:
+
+* **depth-1 byte-identity** — ``pipeline_depth=1`` (the default) takes
+  the exact pre-pipeline code path: wire responses byte-equal to a
+  pipelined dealer driven through the same sequence, and the sim digest
+  is unchanged across depths;
+* **bounded staleness under coalescing** — a commit only enqueues its
+  publish delta; a reader drains everything pending before consuming
+  the snapshot, or — when racing a drain leader mid-swap — scores at
+  most ONE swap behind (an uncontended read after a bind always sees
+  it, which is what the single-threaded pins here assert);
+* **generation monotonicity** — coalesced or not, published generations
+  only ever advance (pinned under a concurrent bind/read hammer);
+* **batched gang commits** — a complete strict gang's member writes fan
+  out through the commit pool with per-member rollback semantics
+  identical to the one-at-a-time path;
+* **the publish-skip satellite** — a clean bind's finally-clause
+  republish is skipped outright (counted), while rollbacks still
+  publish.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.cmd.main import make_mock_cluster
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.client import ApiError
+from nanotpu.k8s.objects import make_container, make_pod
+from nanotpu.metrics.registry import Registry
+from nanotpu.routes.server import SchedulerAPI
+
+
+def mk_pod(client, name: str, percent: int = 200, gang: str | None = None,
+           size: int = 8, strict: bool = False, timeout: float | None = None):
+    ann = {}
+    if gang:
+        ann = {
+            types.ANNOTATION_GANG_NAME: gang,
+            types.ANNOTATION_GANG_SIZE: str(size),
+        }
+        if strict:
+            ann[types.ANNOTATION_GANG_POLICY] = types.GANG_POLICY_STRICT
+        if timeout is not None:
+            ann[types.ANNOTATION_GANG_TIMEOUT] = str(timeout)
+    return client.create_pod(make_pod(
+        name,
+        containers=[make_container("t", {types.RESOURCE_TPU_PERCENT: percent})],
+        annotations=ann,
+    ))
+
+
+def build(n_hosts=8, **dealer_kw):
+    client = make_mock_cluster(n_hosts, 4)
+    dealer = Dealer(client, make_rater("binpack"), **dealer_kw)
+    return client, dealer
+
+
+NODES8 = [f"v5p-host-{i}" for i in range(8)]
+
+
+class TestConfig:
+    def test_default_depth_has_no_pool_and_no_coalescing(self):
+        client, dealer = build()
+        try:
+            assert dealer._commit_pool is None
+            assert dealer._coalesce is False
+            assert dealer.pipeline_status() == {
+                "depth": 1, "coalesce": False, "pending": 0,
+            }
+        finally:
+            dealer.close()
+
+    def test_invalid_depth_rejected(self):
+        client = make_mock_cluster(2, 4)
+        for bad in (0, -1, 1.5, "auto", True):
+            with pytest.raises(ValueError):
+                Dealer(client, make_rater("binpack"), pipeline_depth=bad)
+
+    def test_coalesce_knob_is_independent(self):
+        _, d = build(pipeline_depth=4, coalesce=False)
+        try:
+            assert d._commit_pool is not None
+            assert d._coalesce is False
+        finally:
+            d.close()
+        _, d = build(pipeline_depth=1, coalesce=True)
+        try:
+            assert d._commit_pool is None
+            assert d._coalesce is True
+        finally:
+            d.close()
+
+
+class TestPublishSkip:
+    """Satellite: the bind finally-clause republish is skipped when the
+    commit did not move chip state beyond what _reserve published."""
+
+    def test_clean_bind_skips_second_republish(self):
+        client, dealer = build()
+        try:
+            # warm a candidate-list view: publishes only swap when some
+            # cached view actually moves
+            warm = mk_pod(client, "warm")
+            dealer.assume(NODES8, warm)
+            dealer.score(NODES8, warm)
+            pod = mk_pod(client, "p0")
+            before = dealer.perf.snapshot()
+            gen0 = dealer._published.gen
+            dealer.bind("v5p-host-0", pod)
+            after = dealer.perf.snapshot()
+            assert after["publish_skips"] - before["publish_skips"] == 1
+            # exactly ONE swap — the reserve half's; the finally half
+            # never even probed
+            assert dealer._published.gen == gen0 + 1
+        finally:
+            dealer.close()
+
+    def test_failed_commit_rolls_back_and_publishes(self):
+        client, dealer = build()
+        try:
+            def boom(pod):
+                raise ApiError("injected write failure", code=500)
+
+            client.before_update_pod = boom
+            pod = mk_pod(client, "p0")
+            before = dealer.perf.snapshot()
+            with pytest.raises(Exception) as err:
+                dealer.bind("v5p-host-0", pod)
+            assert "injected write failure" in str(err.value)
+            after = dealer.perf.snapshot()
+            # the rollback moved chip state (unbind) past the reserve
+            # publish: the finally republish must RUN, not skip
+            assert after["publish_skips"] == before["publish_skips"]
+            assert dealer.occupancy() == 0.0
+        finally:
+            dealer.close()
+
+    def test_skip_counts_in_pipelined_mode_too(self):
+        client, dealer = build(pipeline_depth=4)
+        try:
+            pod = mk_pod(client, "p0")
+            dealer.bind("v5p-host-0", pod)
+            assert dealer.perf.publish_skips == 1
+        finally:
+            dealer.close()
+
+
+class TestCoalescing:
+    def test_commit_enqueues_and_reader_drains(self):
+        client, dealer = build(pipeline_depth=4)
+        try:
+            # warm a view so drained publishes have rows to move (a
+            # publish with no cached views is skipped at any depth)
+            warm = mk_pod(client, "warm")
+            dealer.assume(NODES8, warm)
+            dealer.score(NODES8, warm)
+            shard = dealer._default_shard
+            # raw attribute read (no drain): the commit must NOT have
+            # swapped the snapshot itself
+            gen0 = shard._published.gen
+            pod = mk_pod(client, "p0", percent=400)  # fills a 4-chip host
+            dealer.bind("v5p-host-0", pod)
+            assert dealer.perf.publish_coalesced >= 1
+            assert shard._published.gen == gen0  # parked, not swapped
+            assert shard._pending == {"v5p-host-0"}
+            # read-your-writes: the next read drains before consuming —
+            # the filled node must be infeasible on the wire
+            probe = mk_pod(client, "probe", percent=400)
+            ok, failed = dealer.assume(NODES8, probe)
+            assert "v5p-host-0" not in ok
+            assert shard._pending == set()
+            assert shard._published.gen == gen0 + 1
+        finally:
+            dealer.close()
+
+    def test_burst_folds_into_one_swap(self):
+        client, dealer = build(pipeline_depth=4)
+        try:
+            # warm one candidate-list view so swaps do real advance work
+            warm = mk_pod(client, "warm")
+            dealer.assume(NODES8, warm)
+            dealer.score(NODES8, warm)
+            shard = dealer._default_shard
+            pubs0 = dealer.perf.snapshot_publishes
+            for i in range(4):
+                dealer.bind(f"v5p-host-{i}", mk_pod(client, f"p{i}"))
+            assert dealer.perf.snapshot_publishes == pubs0  # all parked
+            assert shard._pending == {f"v5p-host-{i}" for i in range(4)}
+            probe = mk_pod(client, "probe")
+            dealer.score(NODES8, probe)
+            # the whole burst folded into ONE swap
+            assert dealer.perf.snapshot_publishes == pubs0 + 1
+        finally:
+            dealer.close()
+
+    def test_generation_monotonic_under_concurrent_hammer(self):
+        client, dealer = build(n_hosts=16, pipeline_depth=4)
+        try:
+            nodes = [f"v5p-host-{i}" for i in range(16)]
+            warm = mk_pod(client, "warm")
+            dealer.assume(nodes, warm)
+            gens: list[int] = []
+            stop = threading.Event()
+
+            def reader():
+                while not stop.is_set():
+                    gens.append(dealer._published.gen)
+                    probe = make_pod(
+                        "r",
+                        containers=[make_container(
+                            "t", {types.RESOURCE_TPU_PERCENT: 200})],
+                    )
+                    dealer.assume(nodes, probe)
+
+            threads = [threading.Thread(target=reader) for _ in range(2)]
+            for t in threads:
+                t.start()
+            for i in range(32):
+                dealer.bind(f"v5p-host-{i % 16}",
+                            mk_pod(client, f"h{i}", percent=50))
+            stop.set()
+            for t in threads:
+                t.join(10)
+            # per-reader samples are monotonic by publication order; the
+            # interleaved global list may jitter by thread timing, so
+            # assert per-sample non-decrease with the final drain winning
+            final = dealer._published.gen
+            assert final >= max(gens)
+            # every bind is visible in live accounting
+            assert dealer.occupancy() == pytest.approx(
+                32 * 0.5 / 64
+            )
+        finally:
+            dealer.close()
+
+    def test_depth1_never_enqueues(self):
+        client, dealer = build()
+        try:
+            dealer.bind("v5p-host-0", mk_pod(client, "p0"))
+            assert dealer.perf.publish_coalesced == 0
+            assert dealer._default_shard._pending == set()
+        finally:
+            dealer.close()
+
+
+class TestWireParityAcrossDepths:
+    """Depth 1 vs depth 8 driven through the REAL request path with one
+    event sequence: byte-identical responses, converged equal state."""
+
+    def _stack(self, depth):
+        client = make_mock_cluster(8, 4)
+        dealer = Dealer(client, make_rater("binpack"), pipeline_depth=depth)
+        return client, dealer, SchedulerAPI(dealer, Registry())
+
+    def test_event_sequence_parity(self):
+        a_client, a_dealer, a_api = self._stack(1)
+        b_client, b_dealer, b_api = self._stack(8)
+        try:
+            bound = []
+            for step in range(12):
+                percent = (50, 100, 200, 400)[step % 4]
+                gang = f"g{step % 2}" if step % 3 == 0 else None
+                pod_a = mk_pod(a_client, f"p{step}", percent, gang, size=4)
+                pod_b = mk_pod(b_client, f"p{step}", percent, gang, size=4)
+                assert pod_a.uid == pod_b.uid
+                args = json.dumps(
+                    {"Pod": pod_a.raw, "NodeNames": NODES8},
+                    separators=(",", ":"),
+                ).encode()
+                args_b = json.dumps(
+                    {"Pod": pod_b.raw, "NodeNames": NODES8},
+                    separators=(",", ":"),
+                ).encode()
+                outs = []
+                for api, body in ((a_api, args), (b_api, args_b)):
+                    code, _, filt = api.dispatch(
+                        "POST", "/scheduler/filter", body)
+                    assert code == 200
+                    code, _, prio = api.dispatch(
+                        "POST", "/scheduler/priorities", body)
+                    assert code == 200
+                    outs.append((filt, prio))
+                assert outs[0] == outs[1]
+                feasible = set(json.loads(outs[0][0])["NodeNames"])
+                if not feasible:
+                    continue
+                prio = json.loads(outs[0][1])
+                best = sorted(
+                    (p for p in prio if p["Host"] in feasible),
+                    key=lambda p: (-p["Score"], p["Host"]),
+                )[0]["Host"]
+                bind = json.dumps({
+                    "PodName": pod_a.name, "PodNamespace": "default",
+                    "PodUID": pod_a.uid, "Node": best,
+                }).encode()
+                res_a = a_api.dispatch("POST", "/scheduler/bind", bind)
+                res_b = b_api.dispatch("POST", "/scheduler/bind", bind)
+                assert res_a == res_b
+                if json.loads(res_a[2])["Error"] == "":
+                    bound.append((pod_a, pod_b))
+                if step % 5 == 4 and bound:
+                    pa, pb = bound.pop(0)
+                    assert a_dealer.release(pa) == b_dealer.release(pb)
+            assert a_dealer.occupancy() == b_dealer.occupancy()
+            snap_a = a_dealer.debug_snapshot()
+            snap_b = b_dealer.debug_snapshot()
+            assert snap_a["tracked_uids"] == snap_b["tracked_uids"]
+            assert snap_a["accounted"] == snap_b["accounted"]
+        finally:
+            a_dealer.close()
+            b_dealer.close()
+
+    def test_sim_digest_identical_across_depths(self):
+        from nanotpu.sim import run_scenario
+        from nanotpu.sim.scenario import load_scenario
+
+        scn = load_scenario("examples/sim/smoke.json")
+        scn["horizon_s"] = 8.0
+        a = run_scenario(dict(scn), seed=0)
+        deep = dict(scn)
+        deep["pipeline"] = 8
+        b = run_scenario(deep, seed=0)
+        assert a["digest"] == b["digest"]
+        assert a["invariants"]["violations"] == 0
+
+
+class TestGangBatch:
+    def _bind_async(self, dealer, pods_nodes):
+        results: dict[str, str] = {}
+
+        def one(pod, node):
+            try:
+                dealer.bind(node, pod)
+                results[pod.name] = "ok"
+            except Exception as e:
+                results[pod.name] = str(e)
+
+        threads = [
+            threading.Thread(target=one, args=(p, n), daemon=True)
+            for p, n in pods_nodes
+        ]
+        for t in threads:
+            t.start()
+        return threads, results
+
+    def test_complete_gang_commits_through_pool(self):
+        client, dealer = build(n_hosts=16, pipeline_depth=8)
+        try:
+            pods = [
+                mk_pod(client, f"m{i}", gang="gg", strict=True, timeout=20)
+                for i in range(8)
+            ]
+            threads, results = self._bind_async(
+                dealer, [(p, f"v5p-host-{i}") for i, p in enumerate(pods)]
+            )
+            for t in threads:
+                t.join(15)
+                assert not t.is_alive()
+            assert all(v == "ok" for v in results.values()), results
+            # every member's API writes ran on the commit pool
+            assert dealer.perf.gang_batched_commits == 8
+            assert dealer.gangs.bound_count("default/gg") == 8
+            for pod in pods:
+                fresh = client.get_pod("default", pod.name)
+                assert fresh.annotations.get(
+                    types.ANNOTATION_ASSUME) == "true"
+            assert dealer.occupancy() == pytest.approx(16 / 64)
+            # no leftover barrier state
+            barrier = dealer._gang_barriers.get("default/gg")
+            if barrier is not None:
+                assert barrier.results == {}
+                assert barrier.claimed == set()
+                assert not barrier.committing
+        finally:
+            dealer.close()
+
+    def test_depth1_gang_commits_individually(self):
+        client, dealer = build(n_hosts=16)
+        try:
+            pods = [
+                mk_pod(client, f"m{i}", gang="gg", strict=True, timeout=20)
+                for i in range(4)
+            ]
+            for p in pods:
+                p.raw["metadata"]["annotations"][
+                    types.ANNOTATION_GANG_SIZE] = "4"
+            threads, results = self._bind_async(
+                dealer, [(p, f"v5p-host-{i}") for i, p in enumerate(pods)]
+            )
+            for t in threads:
+                t.join(15)
+            assert all(v == "ok" for v in results.values()), results
+            assert dealer.perf.gang_batched_commits == 0
+        finally:
+            dealer.close()
+
+    def test_member_write_failure_rolls_back_only_that_member(self):
+        client, dealer = build(n_hosts=16, pipeline_depth=8)
+        try:
+            def fail_m3(pod):
+                if pod.name == "m3":
+                    raise ApiError("injected member write failure",
+                                   code=500)
+
+            client.before_update_pod = fail_m3
+            pods = [
+                mk_pod(client, f"m{i}", gang="gg", strict=True, timeout=20)
+                for i in range(8)
+            ]
+            threads, results = self._bind_async(
+                dealer, [(p, f"v5p-host-{i}") for i, p in enumerate(pods)]
+            )
+            for t in threads:
+                t.join(15)
+                assert not t.is_alive()
+            oks = {k for k, v in results.items() if v == "ok"}
+            assert oks == {f"m{i}" for i in range(8)} - {"m3"}
+            assert "injected member write failure" in results["m3"]
+            # the failed member's chips rolled back; the rest committed
+            assert dealer.gangs.bound_count("default/gg") == 7
+            assert dealer.occupancy() == pytest.approx(14 / 64)
+            # the retry binds straight through the (now open) barrier
+            client.before_update_pod = None
+            dealer.bind("v5p-host-3", pods[3])
+            assert dealer.gangs.bound_count("default/gg") == 8
+            assert dealer.occupancy() == pytest.approx(16 / 64)
+        finally:
+            dealer.close()
+
+
+class TestConcurrentRebindGuard:
+    """Satellite: the idempotent re-bind uid guard under a CONCURRENT
+    in-flight commit for the same uid — not just a completed one."""
+
+    def test_second_bind_while_commit_in_flight(self):
+        client, dealer = build()
+        try:
+            release = threading.Event()
+            entered = threading.Event()
+
+            def stall(pod):
+                entered.set()
+                assert release.wait(10), "test harness stall"
+
+            client.before_update_pod = stall
+            pod = mk_pod(client, "p0")
+            errs: list = []
+
+            def first():
+                try:
+                    dealer.bind("v5p-host-0", pod)
+                except Exception as e:  # pragma: no cover - fails test
+                    errs.append(e)
+
+            t = threading.Thread(target=first, daemon=True)
+            t.start()
+            assert entered.wait(10)
+            # the first bind holds the uid mid-commit: a concurrent
+            # re-issue must fail fast as mid-bind — never double-book
+            occupancy_during = dealer.occupancy()
+            with pytest.raises(Exception) as err:
+                dealer.bind("v5p-host-0", pod)
+            assert "mid-bind" in str(err.value)
+            # ...and must not have touched chip accounting
+            assert dealer.occupancy() == occupancy_during
+            client.before_update_pod = None
+            release.set()
+            t.join(10)
+            assert not errs, errs
+            # now committed: a re-issued bind is idempotent success
+            again = dealer.bind("v5p-host-0", pod)
+            assert again.node_name == "v5p-host-0"
+            assert dealer.occupancy() == pytest.approx(2 / 32)
+            # a conflicting node re-issue still fails loudly
+            with pytest.raises(Exception) as err:
+                dealer.bind("v5p-host-1", pod)
+            assert "already bound" in str(err.value)
+        finally:
+            release.set()
+            dealer.close()
+
+
+class TestDebugSurface:
+    def test_debug_decisions_exposes_pipeline(self):
+        client, dealer = build(pipeline_depth=4)
+        try:
+            api = SchedulerAPI(dealer, Registry())
+            code, _, payload = api.dispatch("GET", "/debug/decisions", b"")
+            assert code == 200
+            body = json.loads(payload)
+            assert body["pipeline"] == {
+                "depth": 4, "coalesce": True, "pending": 0,
+            }
+        finally:
+            dealer.close()
+
+    def test_perf_counters_exported_on_metrics(self):
+        client, dealer = build(pipeline_depth=4)
+        try:
+            api = SchedulerAPI(dealer, Registry())
+            dealer.bind("v5p-host-0", mk_pod(client, "p0"))
+            code, _, payload = api.dispatch("GET", "/metrics", b"")
+            assert code == 200
+            assert "nanotpu_sched_publish_skips 1" in payload
+            assert "nanotpu_sched_publish_coalesced" in payload
+            assert "nanotpu_sched_gang_batched_commits" in payload
+        finally:
+            dealer.close()

@@ -297,6 +297,77 @@ class TestIncrementalDeltas:
             s.close()
 
 
+class TestNonContiguousFallback:
+    """Satellite: the fused splice path requires each shard's candidates
+    to form one contiguous run of the request order; an interleaved list
+    must fall back to the (render-cache) list path — counted as a
+    fastpath miss — and still answer byte-identically to a single-shard
+    stack."""
+
+    def _interleaved(self, nodes):
+        by_fam: dict[str, list[str]] = {}
+        for n in nodes:
+            by_fam.setdefault(n.rsplit("-", 1)[0], []).append(n)
+        fams = sorted(by_fam)
+        out = []
+        i = 0
+        while any(by_fam[f] for f in fams):
+            f = fams[i % len(fams)]
+            if by_fam[f]:
+                out.append(by_fam[f].pop(0))
+            i += 1
+        return out
+
+    def test_interleaved_candidates_answer_identically(self, stacks):
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        a, b = stacks
+        mixed = self._interleaved(a.nodes)
+        # sanity: the interleave really does break every shard's run
+        assert mixed != sorted(mixed)
+        pod_a = _mk_pod(a.client, "mix", 200)
+        pod_b = _mk_pod(b.client, "mix", 200)
+        args_a = json.dumps(
+            {"Pod": pod_a.raw, "NodeNames": mixed}, separators=(",", ":")
+        ).encode()
+        args_b = json.dumps(
+            {"Pod": pod_b.raw, "NodeNames": mixed}, separators=(",", ":")
+        ).encode()
+        misses0 = b.dealer.perf.fastpath_misses
+        filt_a = a.verb("/scheduler/filter", args_a)
+        filt_b = b.verb("/scheduler/filter", args_b)
+        assert filt_a == filt_b
+        prio_a = a.verb("/scheduler/priorities", args_a)
+        prio_b = b.verb("/scheduler/priorities", args_b)
+        assert prio_a == prio_b
+        # the sharded stack really did take the fallback, not the splice
+        assert b.dealer.perf.fastpath_misses > misses0
+        # and a full bind cycle through the fallback stays in lockstep
+        feasible = set(json.loads(filt_a)["NodeNames"])
+        ranked = sorted(
+            (p for p in json.loads(prio_a) if p["Host"] in feasible),
+            key=lambda p: (-p["Score"], p["Host"]),
+        )
+        bind = json.dumps({
+            "PodName": "mix", "PodNamespace": "default",
+            "PodUID": pod_a.uid, "Node": ranked[0]["Host"],
+        }).encode()
+        res_a = a.verb("/scheduler/bind", bind)
+        res_b = b.verb("/scheduler/bind", bind)
+        assert res_a == res_b
+        assert json.loads(res_a)["Error"] == ""
+        assert a.dealer.occupancy() == b.dealer.occupancy()
+
+    def test_contiguous_runs_still_take_the_splice(self, stacks):
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        _, b = stacks
+        pod = _mk_pod(b.client, "contig", 200)
+        hits0 = b.dealer.perf.fastpath_hits
+        assert b.dealer.filter_payload(sorted(b.nodes), pod) is not None
+        assert b.dealer.perf.fastpath_hits > hits0
+
+
 class TestDiagnosability:
     def test_debug_snapshot_and_decisions_expose_shards(self):
         s = _Stack("auto")
