@@ -3,14 +3,14 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench bench-ab bind-storm sim-smoke sim-multipool chaos-soak obs-check fanout-4k image clean
+.PHONY: all native lint test test-fast bench bench-ab bind-storm sim-smoke sim-multipool sim-het chaos-soak obs-check fanout-4k image clean
 
 # Default verification tier: static analysis, then the fast inner loop
 # (test-fast includes sim-smoke), then the observability gate, then the
 # overload-resilience soak, then the sharded 4096-host fan-out gate
 # (FAST=1 skips it). The tier-1 gate (`pytest tests/ -m 'not slow'` over
 # everything) is unchanged — run it via `make test` / CI.
-all: native lint test-fast obs-check chaos-soak fanout-4k
+all: native lint test-fast obs-check chaos-soak sim-het fanout-4k
 
 # nanolint (docs/static-analysis.md): AST invariant passes over the
 # scheduler's concurrency & determinism contracts — lock discipline,
@@ -98,6 +98,24 @@ fanout-4k: native
 		echo "fanout-4k: skipped (FAST=1)"; \
 	else \
 		python bench.py --fanout-4k; \
+	fi
+
+# Heterogeneity/contention certification gate (docs/scoring.md): both
+# het scenarios run TWICE (--check-determinism, digest-reproducible),
+# then the binpack-vs-throughput comparison asserts the acceptance
+# deltas (default rater loses >=10% modeled throughput vs oracle on the
+# contended mixed fleet; priority=throughput recovers >=8%) and that
+# the decision ledger carries a per-term breakdown for every bound pod.
+# `FAST=1 make all` skips it (same rule as fanout-4k).
+sim-het:
+	@if [ "$(FAST)" = "1" ]; then \
+		echo "sim-het: skipped (FAST=1)"; \
+	else \
+		python -m nanotpu.sim --scenario examples/sim/het-throughput.json \
+			--seed 0 --check-determinism > /dev/null && \
+		python -m nanotpu.sim --scenario examples/sim/het-contended.json \
+			--seed 0 --check-determinism > /dev/null && \
+		python -m pytest tests/test_throughput.py -q -k certification; \
 	fi
 
 # The 4096-host multi-pool churn scenario through the sharded dealer,

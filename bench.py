@@ -196,7 +196,9 @@ VERB_BUDGET_S = 2.0
 def run_fanout(n_hosts: int = 256, n_pods: int = 256,
                warm_pods: int = 32, fleet: dict | None = None,
                shards: int | str = 1,
-               verb_budget_s: float | None = None) -> dict:
+               verb_budget_s: float | None = None,
+               rater: str = "binpack",
+               require_warm: bool = False) -> dict:
     """Large-cluster fan-out: every Filter evaluates all n_hosts candidates
     over live HTTP (the scenario the batched native scorer exists for).
     ``warm_pods`` untimed pods run FIRST against the SAME dealer/server so
@@ -233,7 +235,7 @@ def run_fanout(n_hosts: int = 256, n_pods: int = 256,
         client = make_fleet(fleet)
         nodes = [n.name for n in client.list_nodes()]
         assert len(nodes) == n_hosts, (len(nodes), n_hosts)
-    dealer = Dealer(client, make_rater("binpack"), shards=shards)
+    dealer = Dealer(client, make_rater(rater), shards=shards)
     api = SchedulerAPI(dealer, Registry())
     server = serve(api, 0, host="127.0.0.1")
     # the server's idle-GC hook must not fire INSIDE a timed window (a
@@ -368,15 +370,24 @@ def run_fanout(n_hosts: int = 256, n_pods: int = 256,
     filter_p99 = percentile(filter_lats, 0.99)
     prio_p99 = percentile(prio_lats, 0.99)
     if verb_budget_s is not None:
-        # the acceptance contract of the 4096-host row: EVERY timed
-        # Filter/Prioritize answers inside the per-verb budget, and the
-        # timed window ran on warm caches — zero view/renderer rebuilds,
-        # zero fused-path misses, zero gen-2 collections (asserted above)
         assert max(filter_lats) < verb_budget_s, max(filter_lats)
         assert max(prio_lats) < verb_budget_s, max(prio_lats)
+    if verb_budget_s is not None or require_warm:
+        # warm-window contract (4096-host row AND the het-throughput
+        # row): the timed window ran on warm caches — zero
+        # view/renderer rebuilds, zero gen-2 collections (asserted
+        # above). A fused-capable rater (binpack/spread) must serve
+        # every verb from the fused path; a hook rater (throughput,
+        # docs/scoring.md) REFUSES the fused path by design, so the
+        # assert inverts: zero hits, every verb a counted refusal —
+        # either way the counters prove which path the row measured.
         assert attr["view_builds"] == 0, attr
         assert attr["renderer_builds"] == 0, attr
-        assert attr["fastpath_misses"] == 0, attr
+        if getattr(dealer, "_batch_hook", None) is None:
+            assert attr["fastpath_misses"] == 0, attr
+        else:
+            assert attr["fastpath_hits"] == 0, attr
+            assert attr["fastpath_misses"] > 0, attr
     p50 = percentile(lats, 0.50)
     return {
         "fanout_hosts": n_hosts,
@@ -454,6 +465,34 @@ def run_fanout_4k(reps: int = 3, max_reps: int = 5,
         reps=reps, max_reps=max_reps, prefix="fanout4k",
         n_hosts=4096, n_pods=n_pods, warm_pods=warm_pods,
         fleet=FLEET_4K, shards="auto", verb_budget_s=VERB_BUDGET_S,
+    )
+
+
+#: The het-throughput row's fleet: 256 hosts, mixed v5p+v4 (the
+#: heterogeneity the throughput rater exists for — docs/scoring.md).
+HET_FLEET_256 = {
+    "pools": [
+        {"generation": "v5p", "hosts": 192, "slice_hosts": 64,
+         "prefix": "v5p-het"},
+        {"generation": "v4", "hosts": 64, "slice_hosts": 64,
+         "prefix": "v4-het", "slice_prefix": "v4het"},
+    ]
+}
+
+
+def run_het_throughput(reps: int = 3, max_reps: int = 5) -> dict:
+    """The throughput-rater fan-out row (docs/scoring.md): 256 mixed
+    v5p+v4 hosts, ``priority=throughput`` — every Filter runs the native
+    batch feasibility pass and every Prioritize the Python row hook over
+    the same frozen view; the fused render path is REFUSED by design
+    (every verb a counted miss) and the warm-window asserts run IN-bench:
+    zero gen-2 GC, zero view/renderer rebuilds, zero fused hits. The
+    row's job is to price the hook against the fused default — the
+    default rater's own 256-host row is the A/B-guarded hot path."""
+    return run_fanout_reps(
+        reps=reps, max_reps=max_reps, prefix="het",
+        n_hosts=256, fleet=HET_FLEET_256,
+        rater="throughput", require_warm=True,
     )
 
 
@@ -922,6 +961,10 @@ def run() -> dict:
     import gc
 
     gc.collect()
+    # het_* = the throughput-rater row (docs/scoring.md): measured after
+    # the default-rater rows so their A/B comparability is untouched
+    het = run_het_throughput()
+    gc.collect()
     # the write-path row last: it binds thousands of pods and its heap
     # must not depress the read-path rows measured above
     bindstorm = run_bind_storm_reps()
@@ -984,6 +1027,7 @@ def run() -> dict:
     }
     out.update(fanout)
     out.update(fanout4k)
+    out.update(het)
     out.update(bindstorm)
     out["host_loadavg_start"] = load_start
     out["host_loadavg_end"] = [round(x, 2) for x in os.getloadavg()]
@@ -996,7 +1040,15 @@ def run() -> dict:
 if __name__ == "__main__":
     import sys
 
-    if "--fanout-4k" in sys.argv:
+    if "--het-throughput" in sys.argv:
+        # the throughput-rater row on its own (in-bench warm asserts)
+        print(json.dumps(run_het_throughput()))
+    elif "--fanout-rep" in sys.argv:
+        # one 256-host default-rater rep, for bench_ab.py's interleaved
+        # A/B protocol (the "hot path unregressed with the new rater
+        # off" acceptance check)
+        print(json.dumps(run_fanout()))
+    elif "--fanout-4k" in sys.argv:
         # `make fanout-4k`: one short rep of the 4096-host sharded row;
         # the in-bench asserts (per-verb budget, zero gen-2 GC, zero view
         # rebuilds in the timed window) are the gate — an AssertionError

@@ -340,10 +340,18 @@ class Sample:
         return Plan(demand=demand, assignments=assignments, score=types.SCORE_MAX)
 
 
+def _make_throughput():
+    # local import: throughput.py imports Plan/_choose back from here
+    from nanotpu.allocator.throughput import Throughput
+
+    return Throughput()
+
+
 _RATERS = {
     types.POLICY_BINPACK: Binpack,
     types.POLICY_SPREAD: Spread,
     types.POLICY_RANDOM: Random,
+    types.POLICY_THROUGHPUT: _make_throughput,
     "sample": Sample,
 }
 

@@ -1,0 +1,484 @@
+"""Throughput-aware placement: heterogeneity + contention rater.
+
+The binpack/spread raters treat every chip as interchangeable within a
+node — on a mixed v4/v5p fleet they happily park work on the slow
+generation while the fast one idles, and on contended fractional cards
+they stack shares until everyone time-slices. Gavel ("Heterogeneity-Aware
+Cluster Scheduling Policies", PAPERS.md) shows per-(workload x
+accelerator-type) effective-throughput models recover double-digit
+aggregate throughput, and BandPilot shows the contention penalty can be
+*calibrated online* from observed per-card usage — exactly the signal the
+metric-sync loop already writes into :mod:`nanotpu.dealer.usage`.
+
+Two pieces:
+
+* :class:`ThroughputModel` — the per-(pod-shape x slice-type)
+  effective-throughput table (seedable per-generation defaults, YAML
+  override via ``policy.yaml``'s ``throughput:`` section,
+  :mod:`nanotpu.policy`) plus the contention calibrator: an EWMA over
+  every per-card usage sample the dealer ingests. ``version`` bumps on
+  every table reload AND every calibration update — it is the cache
+  token :meth:`NodeInfo.assume <nanotpu.dealer.nodeinfo.NodeInfo.assume>`
+  folds into its plan-cache key, so a score computed against pre-sync
+  usage can never be served after the sync lands (the stale-cached-plan
+  window this PR closes).
+* :class:`Throughput` — the rater (``priority=throughput``). Its score
+  decomposes into three terms the decision ledger records per candidate
+  (docs/scoring.md):
+
+  ===============  =====================================================
+  base             ``BASE_BAND x (table value / table max)`` — how fast
+                   this pod-shape runs on this node's slice type
+  contention       ``-CONTENTION_BAND x EWMA(per-card usage)`` — steer
+                   away from cards the calibrator has seen hot (falls
+                   back to the instantaneous folded load before the
+                   first sync)
+  fragmentation    ``FRAG_BAND x (whole-free percent / free percent)``
+                   — prefer nodes whose free capacity is whole chips
+                   (a gang can still land there after us)
+  ===============  =====================================================
+
+Score parity contract: :meth:`Throughput.rate`, the per-node
+``NodeInfo.score`` path, and the batch row hook
+(:meth:`Throughput.batch_score_rows`, consumed by
+``BatchScorer.run(score_hook=...)``) all funnel through ONE formula
+(:meth:`Throughput._score_terms`), so the list path and the batch path
+are bit-equal by construction — pinned by tests/test_throughput.py. The
+fused native renderer cannot evaluate the model, so a throughput dealer
+*explicitly refuses* the fused payload path (counted as a fastpath miss)
+and answers through the render-cached list path: same wire shape, zero
+view/renderer rebuilds per request.
+
+Determinism: the model draws time only through the injectable ``now``
+parameter (``time.time() if now is None else now`` — the sanctioned
+injection idiom; the sim passes virtual time end to end), holds one
+witness-named lock, and iterates nothing hash-ordered, so the nanolint
+sim-determinism pass holds this module to the same contract as the
+dealer it feeds.
+"""
+
+from __future__ import annotations
+
+import time
+
+from nanotpu import types
+from nanotpu.analysis.witness import make_lock
+
+#: Score-band split (sums to SCORE_MAX): how fast the slice type runs
+#: this shape / how hot the calibrator has seen its cards / how much of
+#: its free capacity is still whole chips.
+BASE_BAND = 70
+CONTENTION_BAND = 20
+FRAG_BAND = 10
+
+#: EWMA smoothing for online contention calibration (BandPilot-style:
+#: heavy enough to converge within a few metric-sync ticks, light enough
+#: that one noisy sample cannot flip a placement).
+DEFAULT_EWMA_ALPHA = 0.3
+
+#: Fraction of a pod's modeled throughput lost per 100% of co-resident
+#: share on its cards (the sim's aggregate-throughput metric and the
+#: /metrics modeled-aggregate gauge both derate with this).
+CONTENTION_LOSS = 0.3
+
+#: Seedable per-generation effective-throughput defaults, normalized to
+#: v5p == 1.0 (relative bf16 peak compute per chip: v4 275 TFLOPs, v5p
+#: 459, v5e 197, v6e 918 capped into the band). Shape ``"*"`` is the
+#: wildcard row; ``policy.yaml`` overrides add (shape, sliceType) rows.
+DEFAULT_TABLE: dict[tuple[str, str], float] = {
+    ("*", "v5p"): 1.0,
+    ("*", "v4"): 0.6,
+    ("*", "v5e"): 0.43,
+    ("*", "v6e"): 1.0,
+}
+
+#: table value when neither the (shape, generation) row nor the
+#: generation wildcard exists: schedule load-blind, never crash
+FALLBACK_VALUE = 0.5
+
+
+def shape_of(demand) -> str:
+    """Canonical pod-shape key for the throughput table: the non-zero
+    per-container percents, largest first — ``"400"``, ``"100/100"``,
+    ``"20"``. Stable across container ordering."""
+    parts = sorted((p for p in demand.percents if p > 0), reverse=True)
+    return "/".join(str(p) for p in parts) or "0"
+
+
+class ThroughputModel:
+    """Effective-throughput table + online contention calibrator.
+
+    Thread-safe: ``observe`` lands from the metric-sync thread while
+    verbs read; every mutation bumps ``version`` (the plan-cache token).
+    """
+
+    def __init__(self, table: dict | None = None,
+                 alpha: float = DEFAULT_EWMA_ALPHA):
+        self._lock = make_lock("ThroughputModel._lock")
+        self.alpha = float(alpha)
+        self._table: dict[tuple[str, str], float] = dict(
+            table if table is not None else DEFAULT_TABLE
+        )
+        self._norm = max(self._table.values(), default=1.0) or 1.0
+        #: node -> chip -> EWMA of observed usage (the calibration state)
+        self._ewma: dict[str, dict[int, float]] = {}
+        #: node -> last observe() timestamp (gauge: calibration age)
+        self._updated_at: dict[str, float] = {}
+        self._last_update: float | None = None
+        #: bumped on EVERY state change (table reload, calibration
+        #: sample): NodeInfo folds it into the plan-cache key so cached
+        #: plans version out instead of serving pre-sync scores
+        self.version = 0
+
+    # -- table -------------------------------------------------------------
+    def configure(self, spec) -> None:
+        """Apply a :class:`nanotpu.policy.ThroughputSpec` (``policy.yaml``
+        override): replaces matching (shape, sliceType) rows on top of
+        the seed defaults and retunes the EWMA alpha. Idempotent; bumps
+        ``version`` so every cached plan re-scores."""
+        if spec is None:
+            return
+        with self._lock:
+            if spec.alpha is not None:
+                self.alpha = float(spec.alpha)
+            for entry in spec.entries:
+                self._table[(entry.shape, entry.slice_type)] = float(
+                    entry.value
+                )
+            self._norm = max(self._table.values(), default=1.0) or 1.0
+            self.version += 1
+
+    def effective(self, shape: str, generation: str) -> float:
+        """Raw table value for (shape, generation): exact row, then the
+        generation wildcard, then the load-blind fallback."""
+        table = self._table
+        v = table.get((shape, generation))
+        if v is None:
+            v = table.get(("*", generation))
+        return FALLBACK_VALUE * self._norm if v is None else v
+
+    def base_fraction(self, shape: str, generation: str) -> float:
+        """``effective / table max`` in (0, 1] — the base-term scaler."""
+        return min(1.0, self.effective(shape, generation) / self._norm)
+
+    # -- online contention calibration ------------------------------------
+    def observe(self, node: str, chip: int, load: float,
+                now: float | None = None) -> None:
+        """Fold one observed per-card usage sample (the same value the
+        dealer writes into ``ChipResource.load``) into the card's EWMA.
+        Called by ``Dealer.update_chip_usage`` on every metric-sync
+        write; ``now`` is the injectable clock (virtual time in-sim)."""
+        ts = time.time() if now is None else now
+        load = max(0.0, min(1.0, load))
+        with self._lock:
+            per_node = self._ewma.setdefault(node, {})
+            prev = per_node.get(chip)
+            per_node[chip] = (
+                load if prev is None
+                else prev + self.alpha * (load - prev)
+            )
+            self._updated_at[node] = ts
+            self._last_update = ts
+            self.version += 1
+
+    def contention(self, node: str) -> float | None:
+        """Mean per-card EWMA for the node in [0, 1]; None before the
+        first calibration sample (callers fall back to instantaneous
+        load)."""
+        with self._lock:
+            per_node = self._ewma.get(node)
+            if not per_node:
+                return None
+            return sum(per_node.values()) / len(per_node)
+
+    def contention_many(self, nodes) -> dict[str, float]:
+        """Mean per-card EWMA for many nodes under ONE lock hold —
+        the batch row hook scores hundreds of candidates per verb while
+        holding the view arena lock, and a per-candidate lock
+        round-trip there contends with the metric-sync writer. Nodes
+        without calibration are absent from the result (caller falls
+        back to instantaneous load). Iterates the caller's list, so the
+        result order carries no hash-order dependence."""
+        with self._lock:
+            out: dict[str, float] = {}
+            for n in nodes:
+                per_node = self._ewma.get(n)
+                if per_node:
+                    out[n] = sum(per_node.values()) / len(per_node)
+            return out
+
+    def forget_node(self, node: str) -> None:
+        with self._lock:
+            self._ewma.pop(node, None)
+            self._updated_at.pop(node, None)
+            self.version += 1
+
+    # -- gauges (nanotpu_sched_throughput_*, docs/scoring.md) --------------
+    def calibration_age_s(self, now: float | None = None) -> float:
+        """Seconds since the newest calibration sample; -1 before the
+        first (a forever-growing age and a never-calibrated model must
+        read differently on a dashboard)."""
+        ts = time.time() if now is None else now
+        with self._lock:
+            if self._last_update is None:
+                return -1.0
+            return max(0.0, ts - self._last_update)
+
+    def calibrated_nodes(self) -> int:
+        with self._lock:
+            return len(self._ewma)
+
+    def gauge_values(self, now: float | None = None) -> dict[str, float]:
+        """The unlabeled ``nanotpu_sched_throughput_*`` gauge values,
+        keyed by metric suffix. The nanolint metrics-completeness pass
+        cross-checks these keys against the exporter's declared
+        ``_THROUGHPUT_GAUGES`` table BOTH directions — a suffix produced
+        here but never exported (or declared there but never produced)
+        is a lint finding."""
+        return {
+            "calibration_age_seconds": self.calibration_age_s(now),
+            "calibrated_nodes": float(self.calibrated_nodes()),
+            "table_rows": float(len(self._table)),
+        }
+
+
+class Throughput:
+    """The ``priority=throughput`` rater (docs/scoring.md).
+
+    Placement (``choose``) packs whole-chip demands like binpack
+    (contiguity preserves ICI for gangs) but SPREADS fractional demands
+    across cards — co-residency is exactly the contention the model
+    penalizes, so stacking shares while scoring against stacking would
+    fight itself. Node ranking is the three-term model score; the plan's
+    score IS ``rate`` (no plan-local compactness bonus) so the per-node,
+    batch-hook, and ledger-breakdown views of a score are one number.
+    """
+
+    name = types.POLICY_THROUGHPUT
+
+    def __init__(self, model: ThroughputModel | None = None):
+        self.model = model or ThroughputModel()
+
+    # -- dealer integration hooks ------------------------------------------
+    def cache_token(self) -> int:
+        """Plan-cache version key (see NodeInfo.assume): any model state
+        change — a calibration sample, a table reload — retires every
+        plan cached under the previous token."""
+        return self.model.version
+
+    def observe_usage(self, node: str, chip: int, load: float,
+                      now: float | None = None) -> None:
+        """Dealer.update_chip_usage forwards every per-card usage write
+        here — the online-calibration tap."""
+        self.model.observe(node, chip, load, now=now)
+
+    def forget_node(self, node: str) -> None:
+        self.model.forget_node(node)
+
+    def configure(self, spec) -> None:
+        self.model.configure(spec)
+
+    # -- the one scoring formula -------------------------------------------
+    @staticmethod
+    def _combine(base_f: float, cont: float | None,
+                 free, total, load) -> dict[str, int]:
+        """The term arithmetic, shared verbatim by every caller — this
+        single body is what makes list-path, batch-path, and ledger
+        scores bit-equal. ``cont`` None means uncalibrated: fall back
+        to the node's instantaneous folded load (identical values in a
+        ChipSet and in the batch rows copied from it)."""
+        if cont is None:
+            n = len(load)
+            cont = (sum(load) / n) if n else 0.0
+        free_pct = sum(free)
+        whole_free = sum(
+            f for f, t in zip(free, total) if f == t and t > 0
+        )
+        frag_f = (whole_free / free_pct) if free_pct else 0.0
+        base = int(BASE_BAND * base_f)
+        contention = int(CONTENTION_BAND * cont)
+        frag = int(FRAG_BAND * frag_f)
+        total_score = max(
+            types.SCORE_MIN,
+            min(types.SCORE_MAX, base - contention + frag),
+        )
+        return {
+            "base": base,
+            "contention": -contention,
+            "fragmentation": frag,
+            "total": total_score,
+        }
+
+    def _score_terms(self, generation: str, node_key: str,
+                     free, total, load, demand) -> dict[str, int]:
+        """Per-term score breakdown from raw per-chip state (the
+        one-candidate adapter over :meth:`_combine`)."""
+        model = self.model
+        return self._combine(
+            model.base_fraction(shape_of(demand), generation),
+            model.contention(node_key),
+            free, total, load,
+        )
+
+    def _terms_of(self, chips, demand) -> dict[str, int]:
+        return self._score_terms(
+            chips.torus.generation, chips.key,
+            [c.percent_free for c in chips.chips],
+            [c.percent_total for c in chips.chips],
+            [c.load for c in chips.chips],
+            demand,
+        )
+
+    # -- Rater protocol ----------------------------------------------------
+    def rate(self, chips, demand) -> int:
+        return self._terms_of(chips, demand)["total"]
+
+    def rate_terms(self, chips, demand) -> dict[str, int]:
+        """The per-term breakdown the decision ledger records for every
+        scored candidate (docs/scoring.md: how the ledger proves WHY a
+        pod moved)."""
+        return self._terms_of(chips, demand)
+
+    def choose(self, chips, demand):
+        from nanotpu.allocator.rater import Plan, _choose
+
+        has_fractional = any(
+            0 < p < types.PERCENT_PER_CHIP for p in demand.percents
+        )
+        assignments = _choose(chips, demand, prefer_used=not has_fractional)
+        if assignments is None:
+            return None
+        # plan.score == rate: one number across the per-node path, the
+        # batch hook, and the ledger breakdown (no plan-local bonus)
+        return Plan(
+            demand=demand, assignments=assignments,
+            score=self.rate(chips, demand),
+        )
+
+    # -- batch row hook (BatchScorer.run(score_hook=...)) ------------------
+    def batch_score_rows(self, scorer, demand, feasible) -> list[int]:
+        """Python-side scores over a frozen BatchScorer's row arrays:
+        the same :meth:`_combine` arithmetic the per-node path runs,
+        over the same free/total/load values (rows are copies of
+        exactly that state). Infeasible rows score SCORE_MIN, like the
+        per-node path's infeasible verdict.
+
+        Loop-invariant work is hoisted: the shape key + per-generation
+        base fraction compute once per call, and every candidate's
+        contention EWMA snapshots under ONE model-lock hold
+        (:meth:`ThroughputModel.contention_many`) — this loop runs under
+        the view's arena lock at fan-out sizes, and per-candidate lock
+        round-trips there would contend with the metric-sync writer."""
+        model = self.model
+        shape = shape_of(demand)
+        base_by_gen: dict[str, float] = {}
+        cont_map = model.contention_many(
+            [info.name for info in scorer.infos]
+        )
+        c = scorer.chip_count
+        out: list[int] = []
+        for i, info in enumerate(scorer.infos):
+            if not feasible[i]:
+                out.append(types.SCORE_MIN)
+                continue
+            base_f = base_by_gen.get(info.generation)
+            if base_f is None:
+                base_f = base_by_gen[info.generation] = (
+                    model.base_fraction(shape, info.generation)
+                )
+            row = i * c
+            out.append(self._combine(
+                base_f,
+                cont_map.get(info.name),
+                scorer.free[row:row + c],
+                scorer.total[row:row + c],
+                scorer.load[row:row + c],
+            )["total"])
+        return out
+
+
+# -- modeled aggregate throughput (sim report + /metrics gauge) ------------
+
+def pod_modeled_throughput(pod, info, model: ThroughputModel) -> float:
+    """One bound pod's modeled throughput: the (shape x slice-type)
+    table value derated by co-residency on its assigned cards —
+    ``1 - CONTENTION_LOSS x (co-resident share / 100)`` per card,
+    averaged over the pod's cards. 0.0 when the pod's chip annotations
+    are missing/corrupt (unaccountable work models as nothing)."""
+    from nanotpu.allocator.core import Demand
+    from nanotpu.utils import pod as podutil
+
+    assigned = podutil.get_assigned_chips(pod)
+    if not assigned:
+        return 0.0
+    demand = Demand.from_pod(pod)
+    value = model.effective(shape_of(demand), info.generation)
+    by_name = dict(
+        zip(demand.container_names, demand.percents)
+    )
+    eff_sum, n_chips = 0.0, 0
+    for cname in sorted(assigned):
+        chip_ids = assigned[cname]
+        percent = by_name.get(cname, 0)
+        if not chip_ids or percent <= 0:
+            continue
+        own = (
+            types.PERCENT_PER_CHIP
+            if percent >= types.PERCENT_PER_CHIP else percent
+        )
+        for chip_id in chip_ids:
+            if not 0 <= chip_id < len(info.chips.chips):
+                continue
+            used = info.chips.chips[chip_id].percent_used
+            others = max(0, used - own)
+            eff_sum += 1.0 - CONTENTION_LOSS * (
+                others / types.PERCENT_PER_CHIP
+            )
+            n_chips += 1
+    if n_chips == 0:
+        return 0.0
+    return value * (eff_sum / n_chips)
+
+
+def modeled_aggregate(node_infos: dict, pods: list,
+                      model: ThroughputModel | None = None) -> dict:
+    """Fleet-wide modeled throughput for a set of bound pods, plus the
+    oracle bound (every pod on its best slice type, uncontended) — the
+    sim report's ``throughput`` section and the certification metric
+    for the het-throughput scenarios (docs/scoring.md). Deterministic:
+    pods iterate in sorted-name order, floats round at the edge."""
+    from nanotpu.allocator.core import Demand
+
+    model = model or ThroughputModel()
+    generations = sorted({
+        info.generation for info in node_infos.values()
+    })
+    total = 0.0
+    oracle = 0.0
+    by_gen: dict[str, float] = {}
+    n = 0
+    for pod in sorted(pods, key=lambda p: (p.name, p.uid)):
+        info = node_infos.get(pod.node_name)
+        if info is None:
+            continue
+        tput = pod_modeled_throughput(pod, info, model)
+        if tput <= 0.0:
+            continue
+        n += 1
+        total += tput
+        by_gen[info.generation] = by_gen.get(info.generation, 0.0) + tput
+        shape = shape_of(Demand.from_pod(pod))
+        oracle += max(
+            (model.effective(shape, g) for g in generations),
+            default=0.0,
+        )
+    loss_pct = (
+        round(100.0 * (oracle - total) / oracle, 2) if oracle else 0.0
+    )
+    return {
+        "pods": n,
+        "aggregate": round(total, 4),
+        "oracle": round(oracle, 4),
+        "loss_vs_oracle_pct": loss_pct,
+        "by_generation": {g: round(by_gen[g], 4) for g in sorted(by_gen)},
+    }

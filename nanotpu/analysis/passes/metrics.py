@@ -18,6 +18,15 @@ promise has three string-ly typed seams this pass stitches shut:
   ``perf.__slots__`` loop, so registration is structural — but a slot
   with no ``+=`` site anywhere is again a lying zero on ``/metrics``.
 
+* **Throughput gauges** (``nanotpu_sched_throughput_*``,
+  docs/scoring.md): the exporter's ``_THROUGHPUT_GAUGES`` table
+  (``nanotpu/metrics/throughput.py``) declares the family; the model's
+  ``gauge_values()`` dict literal produces the values. A suffix
+  declared but never produced renders a scrape-time KeyError (the
+  exporter indexes the values dict); a suffix produced but never
+  declared is a computed value no scrape ever sees. Both directions
+  are findings.
+
 * **Decision-audit reason codes** (``REASON_*`` in
   ``nanotpu/obs/decisions.py``, docs/observability.md): a code recorded
   somewhere but not declared in the enum would ship an uncatalogued
@@ -137,6 +146,53 @@ def _reason_uses(mod: Module) -> dict[str, tuple[str, int]]:
     return uses
 
 
+def _declared_throughput_gauges(mod: Module) -> dict[str, int] | None:
+    """gauge suffix -> declaration line from the ``_THROUGHPUT_GAUGES``
+    dict literal; None when this module declares no such table."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.AnnAssign):
+            if node.value is None or not isinstance(node.target, ast.Name):
+                continue
+            targets, value = [node.target.id], node.value
+        elif isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        else:
+            continue
+        if "_THROUGHPUT_GAUGES" not in targets:
+            continue
+        out: dict[str, int] = {}
+        if isinstance(value, ast.Dict):
+            for key in value.keys:
+                if isinstance(key, ast.Constant) and isinstance(
+                    key.value, str
+                ):
+                    out[key.value] = key.lineno
+        return out
+    return None
+
+
+def _gauge_value_keys(mod: Module) -> dict[str, tuple[str, int]]:
+    """gauge suffix -> first production site: string keys of dict
+    literals inside any function named ``gauge_values``."""
+    out: dict[str, tuple[str, int]] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or node.name != "gauge_values":
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Dict):
+                continue
+            for key in sub.keys:
+                if isinstance(key, ast.Constant) and isinstance(
+                    key.value, str
+                ):
+                    out.setdefault(
+                        key.value, (str(mod.path), key.lineno)
+                    )
+    return out
+
+
 def _declared_slots(mod: Module, cls_name: str) -> dict[str, int] | None:
     for node in mod.tree.body:
         if not isinstance(node, ast.ClassDef) or node.name != cls_name:
@@ -168,6 +224,8 @@ class _MetricsPass:
         reasons: dict[str, int] | None = None
         catalogue: set[str] = set()
         reasons_mod: Module | None = None
+        tgauges: dict[str, int] | None = None
+        tgauges_mod: Module | None = None
         for mod in modules:
             d = _declared_resilience(mod)
             if d is not None:
@@ -178,6 +236,9 @@ class _MetricsPass:
             r = _declared_reasons(mod)
             if r is not None:
                 (reasons, catalogue), reasons_mod = r, mod
+            t = _declared_throughput_gauges(mod)
+            if t is not None:
+                tgauges, tgauges_mod = t, mod
 
         inc_sites: dict[str, tuple[str, int]] = {}
         perf_incs: dict[str, tuple[str, int]] = {}
@@ -246,6 +307,28 @@ class _MetricsPass:
             findings.extend(self._check_reasons(
                 modules, reasons, catalogue, reasons_mod
             ))
+        if tgauges is not None and tgauges_mod is not None:
+            produced: dict[str, tuple[str, int]] = {}
+            for mod in modules:
+                for suffix, site in _gauge_value_keys(mod).items():
+                    produced.setdefault(suffix, site)
+                    if suffix not in tgauges:
+                        findings.append(Finding(
+                            self.name, site[0], site[1],
+                            f"throughput gauge {suffix!r} is produced by "
+                            "gauge_values() here but not declared in "
+                            "_THROUGHPUT_GAUGES — it is computed on "
+                            "every scrape and never exported",
+                        ))
+            for suffix, line in sorted(tgauges.items()):
+                if suffix not in produced:
+                    findings.append(Finding(
+                        self.name, str(tgauges_mod.path), line,
+                        f"throughput gauge {suffix!r} is declared in "
+                        "_THROUGHPUT_GAUGES but no gauge_values() "
+                        "produces it — the exporter will KeyError at "
+                        "scrape time",
+                    ))
         return findings
 
     def _check_reasons(self, modules: list[Module],

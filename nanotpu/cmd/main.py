@@ -56,8 +56,12 @@ def build_app(argv: list[str] | None = None):
     parser.add_argument(
         "--priority",
         default=types.POLICY_BINPACK,
-        choices=[types.POLICY_BINPACK, types.POLICY_SPREAD, types.POLICY_RANDOM],
-        help="placement policy (main.go:64)",
+        choices=[
+            types.POLICY_BINPACK, types.POLICY_SPREAD, types.POLICY_RANDOM,
+            types.POLICY_THROUGHPUT,
+        ],
+        help="placement policy (main.go:64; 'throughput' is the "
+        "heterogeneity/contention-aware model rater — docs/scoring.md)",
     )
     parser.add_argument(
         "--port", type=int, default=int(os.environ.get("PORT", "39999"))
@@ -145,6 +149,21 @@ def build_app(argv: list[str] | None = None):
     resilience = ResilienceCounters()
     client = ResilientClientset(client, counters=resilience)
     rater = make_rater(args.priority)
+    policy_watcher = None
+    if args.policy_config:
+        # the ONE policy watcher for the process: the throughput rater's
+        # table reload (docs/scoring.md) and the metric-sync weights
+        # share its single mtime poll (start_metric_sync reuses it). A
+        # bad reload keeps the last good spec either way.
+        from nanotpu.policy import PolicyWatcher
+
+        on_reload = (
+            (lambda spec: rater.configure(spec.throughput))
+            if hasattr(rater, "configure") else None
+        )
+        policy_watcher = PolicyWatcher(
+            args.policy_config, on_reload=on_reload
+        )
     recorder = EventRecorder(client, resilience=resilience)
     # one observability bundle shared by server, dealer, and controller:
     # traces, the decision audit, and the bind/gang histograms all join
@@ -167,6 +186,9 @@ def build_app(argv: list[str] | None = None):
         resilience=resilience,
         obs=obs,
     )
+    #: the process's single policy watcher (None without --policy-config);
+    #: main() hands it to start_metric_sync and stops it at shutdown
+    api.policy_watcher = policy_watcher
     return args, client, dealer, api
 
 
@@ -194,6 +216,7 @@ def main(argv: list[str] | None = None) -> int:
             client,
             prometheus_url=args.prometheus_url,
             policy_config=args.policy_config,
+            policy=api.policy_watcher,
         )
 
     server = serve(api, args.port)
@@ -210,6 +233,8 @@ def main(argv: list[str] | None = None) -> int:
         stop["flag"] = True
         log.info("signal %s: shutting down", signum)
         controller.stop()
+        if api.policy_watcher is not None:
+            api.policy_watcher.stop()
         # flush pending K8s Events; a timeout logs + counts the unposted
         # backlog (events_unflushed) instead of silently dropping it
         dealer.recorder.flush(timeout=2.0)

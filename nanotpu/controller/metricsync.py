@@ -238,11 +238,15 @@ def start_metric_sync(
     client: Clientset,
     prometheus_url: str = "",
     policy_config: str = "",
+    policy: PolicyWatcher | None = None,
 ) -> MetricSyncer:
     """Wire the load-aware pipeline (cmd/main.go:115-119 + controller.go:
     125-134). TPU runtime endpoint is the default source; a Prometheus URL
-    switches to PromQL."""
-    policy = PolicyWatcher(policy_config)
+    switches to PromQL. ``policy`` reuses an existing watcher (cmd/main
+    builds ONE per process so the throughput rater's table reload and
+    the metric weights share a single mtime poll) instead of starting a
+    second poll thread on the same file."""
+    policy = policy or PolicyWatcher(policy_config)
     source: MetricSource
     if prometheus_url:
         source = PrometheusSource(prometheus_url)

@@ -340,12 +340,29 @@ class BatchScorer:
         demand,
         prefer_used: bool,
         member_slices: list[tuple[str, str]] | None = None,
+        score_hook=None,
     ) -> tuple[list[bool], list[int]]:
-        """(feasible per node, final score per node) in candidate order."""
+        """(feasible per node, final score per node) in candidate order.
+
+        ``score_hook`` is the Python-side scoring path for raters the
+        native engine cannot express (the throughput rater,
+        docs/scoring.md): feasibility still comes from the (memoized)
+        native call — placement feasibility is rater-independent — but
+        the returned scores are ``score_hook(self, demand, feasible)``
+        over this view's frozen row arrays. Hook results are computed
+        fresh on every call and NEVER land in the arena memo: the hook
+        reads live model state (the contention EWMA) that moves without
+        a row version bump, so memoizing it would serve pre-sync scores
+        — exactly the staleness the model's cache token exists to kill.
+        The native feasibility/score arena stays memoized as usual (it
+        depends only on rows)."""
         with self._lock:
             feas, score = self._run_locked(demand, prefer_used, member_slices)
             n = len(self.infos)
-            return [bool(feas[i]) for i in range(n)], list(score[:n])
+            feasible = [bool(feas[i]) for i in range(n)]
+            if score_hook is not None:
+                return feasible, score_hook(self, demand, feasible)
+            return feasible, list(score[:n])
 
     # -- fused score+render (the Filter/Prioritize fan-out fast path) ------
 

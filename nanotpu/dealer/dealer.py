@@ -183,6 +183,17 @@ class Dealer:
     ):
         self.client = client
         self.rater = rater
+        #: rater integration hooks, resolved once (the rater is fixed for
+        #: the dealer's lifetime). ``_batch_hook`` is the Python-side
+        #: batch row scorer for raters the native engine cannot express
+        #: (throughput, docs/scoring.md): feasibility still runs native,
+        #: scores come from the hook over the frozen rows. ``_rater_
+        #: observe`` taps every per-card usage write for online
+        #: contention calibration; ``_rater_forget`` drops a removed
+        #: node's calibration state.
+        self._batch_hook = getattr(rater, "batch_score_rows", None)
+        self._rater_observe = getattr(rater, "observe_usage", None)
+        self._rater_forget = getattr(rater, "forget_node", None)
         self.usage = usage or UsageStore()
         #: optional Observability bundle (nanotpu.obs): bind-commit and
         #: gang-wait histograms observe through it; None costs nothing
@@ -489,6 +500,8 @@ class Dealer:
                 if res.node_name == name and res.valid:
                     self._invalidate_reservation(uid, res)
         self.usage.forget_node(name)
+        if self._rater_forget is not None:
+            self._rater_forget(name)
         self._republish()
 
     def refresh_node(self, node: Node) -> bool:
@@ -873,8 +886,20 @@ class Dealer:
 
     # -- batched scoring fast path -----------------------------------------
     #: rater name -> prefer_used flag for the native batch engine; raters
-    #: outside this map (random, sample) use the per-node path.
+    #: outside this map take the batch path only when they provide a
+    #: Python-side ``batch_score_rows`` hook (throughput), else the
+    #: per-node path (random, sample).
     _BATCH_POLICIES = {types.POLICY_BINPACK: True, types.POLICY_SPREAD: False}
+
+    def _batch_prefer(self):
+        """prefer_used flag for the batch engine, or None -> per-node
+        path. Hook raters run the native engine for FEASIBILITY only
+        (feasibility is rater-independent: a placement exists or it does
+        not), with prefer=True; their scores come from the hook."""
+        prefer = self._BATCH_POLICIES.get(self.rater.name)
+        if prefer is None and self._batch_hook is not None:
+            return True
+        return prefer
 
     def _batch_plan(self, node_names: list[str]):
         """Single-shard fast plan: (scorer, ordered known names, non-TPU
@@ -884,7 +909,7 @@ class Dealer:
         Lock-free. Sharded dealers use :meth:`_shard_plan` instead."""
         if self._default_shard is None:
             return None
-        prefer = self._BATCH_POLICIES.get(self.rater.name)
+        prefer = self._batch_prefer()
         if prefer is None:
             return None
         entry = self._view_for(self._default_shard, tuple(node_names))
@@ -937,7 +962,7 @@ class Dealer:
         contiguous, prefer)`` with ``resolved = [(shard, view entry,
         names, positions)]``, or None -> per-node path. Lock-free on the
         partition-cache hit path."""
-        prefer = self._BATCH_POLICIES.get(self.rater.name)
+        prefer = self._batch_prefer()
         if prefer is None:
             return None
         key = tuple(node_names)
@@ -971,18 +996,33 @@ class Dealer:
             resolved.append((shard, entry, names, positions))
         return resolved, non_tpu, contiguous, prefer
 
-    def _run_shards(self, resolved, demand, prefer: bool, member_slices):
+    def _run_shards(self, resolved, demand, prefer: bool, member_slices,
+                    score_hook=None):
         """Score every shard part. More than one part fans out on the
         thread pool: each part is one native ``score_batch`` call that
         releases the GIL, so shards genuinely score in parallel. Results
         come back in part order (pool.map preserves it) — deterministic
-        regardless of completion order."""
+        regardless of completion order. ``score_hook`` threads the
+        Python-side rater hook into each part's run (throughput rater)."""
         def run_one(item):
-            return item[1][0].run(demand, prefer, member_slices)
+            return item[1][0].run(demand, prefer, member_slices,
+                                  score_hook=score_hook)
 
         if len(resolved) == 1:
             return [run_one(resolved[0])]
         return list(self._pool.map(run_one, resolved))
+
+    def _hook_gang_bonus(self, scorer, scores, gang_scorer):
+        """Fold the gang-affinity bonus into hook-path scores exactly as
+        the per-node path does (native-path scores arrive with it folded
+        in already): ``min(SCORE_MAX, score + bonus)`` per candidate."""
+        return [
+            min(
+                types.SCORE_MAX,
+                s + gang_scorer.bonus(info.slice_name, info.slice_coords),
+            )
+            for s, info in zip(scores, scorer.infos)
+        ]
 
     def _sharded_assume(self, node_names: list[str], pod: Pod, demand,
                         trace=None):
@@ -1033,9 +1073,16 @@ class Dealer:
                 f"rows={sum(len(item[2]) for item in resolved)}",
             )
         runs = self._run_shards(resolved, demand, prefer,
-                                member_slices or None)
+                                member_slices or None,
+                                score_hook=self._batch_hook)
+        gs = (
+            GangScorer(member_slices)
+            if self._batch_hook is not None and member_slices else None
+        )
         out = [types.SCORE_MIN] * len(node_names)
         for item, (_feasible, scores) in zip(resolved, runs):
+            if gs is not None:
+                scores = self._hook_gang_bonus(item[1][0], scores, gs)
             for pos, score in zip(item[3], scores):
                 out[pos] = score
         return list(zip(node_names, out))
@@ -1056,18 +1103,26 @@ class Dealer:
             plan = self._shard_plan(node_names)
             if plan is not None:
                 resolved, _non_tpu, _contiguous, prefer = plan
+                member = self._gang_member_slices(pod) or None
                 runs = self._run_shards(
-                    resolved, demand, prefer,
-                    self._gang_member_slices(pod) or None,
+                    resolved, demand, prefer, member,
+                    score_hook=self._batch_hook,
                 )
-                lists = [
-                    [
+                gs = (
+                    GangScorer(member)
+                    if self._batch_hook is not None and member else None
+                )
+                lists = []
+                for item, (feasible, scores) in zip(resolved, runs):
+                    if gs is not None:
+                        scores = self._hook_gang_bonus(
+                            item[1][0], scores, gs
+                        )
+                    lists.append([
                         (n, s)
                         for n, f, s in zip(item[2], feasible, scores)
                         if f
-                    ]
-                    for item, (feasible, scores) in zip(resolved, runs)
-                ]
+                    ])
                 return merge_top_k(lists, k)
         ok, _failed = self.assume(node_names, pod)
         feasible_set = set(ok)
@@ -1087,6 +1142,14 @@ class Dealer:
     # every-32nd-cycle cross-check.
 
     def _payload_plan(self, node_names: list[str], pod: Pod):
+        if self._batch_hook is not None:
+            # explicit fused-path refusal (docs/scoring.md): the native
+            # renderer cannot evaluate a Python-side score hook, and a
+            # half-fused answer would desync Filter from Prioritize. The
+            # verb falls back to the render-cached list path — same wire
+            # shape, zero view/renderer rebuilds — and the miss counter
+            # makes the refusal visible in the bench attribution.
+            return None
         demand = self._demand_of(pod)
         if not demand.is_valid():
             return None
@@ -1111,6 +1174,11 @@ class Dealer:
         anything else returns None and the verb takes the merged list
         path, which produces the same bytes through the render caches.
         ``mode`` 0 = ExtenderFilterResult, 1 = HostPriorityList."""
+        if self._batch_hook is not None:
+            # same explicit refusal as _payload_plan: hook raters answer
+            # through the merged list path (byte-identical wire shape)
+            self.perf.fastpath_misses += 1
+            return None
         demand = self._demand_of(pod)
         plan = self._shard_plan(node_names) if demand.is_valid() else None
         if plan is None:
@@ -1345,7 +1413,12 @@ class Dealer:
             bscorer, names_key, _non_tpu, prefer = batch
             if trace is not None:
                 trace.event("native:batch-score", f"rows={len(names_key)}")
-            _, scores = bscorer.run(demand, prefer, member_slices or None)
+            _, scores = bscorer.run(demand, prefer, member_slices or None,
+                                    score_hook=self._batch_hook)
+            if self._batch_hook is not None and member_slices:
+                scores = self._hook_gang_bonus(
+                    bscorer, scores, GangScorer(member_slices)
+                )
             if len(names_key) == len(node_names) and list(names_key) == node_names:
                 # all candidates are known TPU nodes (the common case):
                 # scores are already in candidate order
@@ -1371,6 +1444,53 @@ class Dealer:
                 score = min(types.SCORE_MAX, score + bonus)
             out.append((name, score))
         self._maybe_republish()  # the loop may have warmed cold nodes
+        return out
+
+    def score_terms(self, node_names: list[str],
+                    pod: Pod) -> dict[str, dict[str, int]]:
+        """Per-candidate per-TERM score breakdown for the decision
+        ledger (docs/scoring.md): {node: {base, contention,
+        fragmentation[, gang], total}}. Only raters that expose
+        ``rate_terms`` (throughput) produce breakdowns; everything else
+        returns {} so the audit path costs one getattr. Called on
+        SAMPLED requests only (the route layer's trace gate), so the
+        second scoring pass never lands on the untraced hot path."""
+        rate_terms = getattr(self.rater, "rate_terms", None)
+        if rate_terms is None:
+            return {}
+        demand = self._demand_of(pod)
+        if not demand.is_valid():
+            return {}
+        member_slices = self._gang_member_slices(pod)
+        gs = GangScorer(member_slices) if member_slices else None
+        out: dict[str, dict[str, int]] = {}
+        for name in node_names:
+            info = self._published_node(name)
+            if info is None:
+                with self._lock:
+                    info = self._nodes.get(name)
+            if info is None:
+                continue
+            with info.lock:
+                terms = dict(rate_terms(info.chips, demand))
+                # the audit contract is total == WIRE score, and the
+                # wire scores an infeasible candidate SCORE_MIN (hook
+                # path and per-node path alike). The assume() here is
+                # the plan-cache hit the just-run scoring pass warmed —
+                # not a second packing.
+                if info.assume(demand, self.rater) is None:
+                    terms["infeasible"] = 1
+                    terms["total"] = types.SCORE_MIN
+            if gs is not None:
+                # the wire adds the gang bonus unconditionally (even on
+                # SCORE_MIN), so the breakdown must too
+                bonus = gs.bonus(info.slice_name, info.slice_coords)
+                if bonus:
+                    terms["gang"] = bonus
+                    terms["total"] = min(
+                        types.SCORE_MAX, terms["total"] + bonus
+                    )
+            out[name] = terms
         return out
 
     # -- Bind verb: dealer.go:155-203 --------------------------------------
@@ -2014,9 +2134,16 @@ class Dealer:
         cached view's row arrays O(nodes x chips) times per tick — batch
         the sweep and finish with one :meth:`publish_usage`."""
         self.usage.update(node, chip, core=core, memory=memory, now=now)
+        load = self.usage.effective_load(node, chip, now=now)
+        if self._rater_observe is not None:
+            # online contention calibration (docs/scoring.md): every
+            # usage write the metric-sync loop delivers also feeds the
+            # throughput model's per-card EWMA — which bumps the model
+            # version, retiring every plan cached under the old one
+            self._rater_observe(node, chip, load, now=now)
         info = self._node_info(node)
         if info is not None:
-            info.set_chip_load(chip, self.usage.effective_load(node, chip, now=now))
+            info.set_chip_load(chip, load)
             if publish:
                 self._republish((node,))
 

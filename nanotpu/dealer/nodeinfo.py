@@ -65,6 +65,13 @@ class NodeInfo:
         self.chips.key = self.name
         #: demand hash -> Plan (node.go:20,44-57)
         self._plan_cache: dict[str, Plan] = {}
+        #: the rater cache token the current _plan_cache contents were
+        #: computed under (None == tokenless rater); a token move clears
+        #: the WHOLE cache rather than minting new keys, so the cache
+        #: stays bounded by live demand shapes — folding the token into
+        #: each key would strand one dead Plan per (shape, token) on any
+        #: node the sweep paths stop clearing
+        self._plan_cache_token = None
         #: bumped on every chip-state mutation; the batch scorer
         #: (dealer/batch.py) uses it to refresh only changed rows
         self.version = 0
@@ -93,8 +100,24 @@ class NodeInfo:
 
         Returns None when infeasible. The plan is cached so the immediately
         following Score and Bind reuse it without re-packing.
+
+        The cache is VERSION-GUARDED by the rater when it exposes a
+        ``cache_token`` (the throughput rater's model version): a rater
+        whose score depends on state outside this node's chips — the
+        online contention EWMA, a hot-reloaded throughput table — moves
+        that token on every model change, and a moved token clears the
+        whole cache before lookup, so a plan scored against pre-sync
+        usage can never satisfy a post-sync lookup, even on paths that
+        bypass :meth:`set_chip_load`'s clear. Raters without the hook
+        keep the bare demand-hash behavior bit-identically.
         """
+        token = getattr(rater, "cache_token", None)
         with self.lock:
+            if token is not None:
+                t = token()
+                if t != self._plan_cache_token:
+                    self._plan_cache.clear()
+                    self._plan_cache_token = t
             key = demand.hash()
             cached = self._plan_cache.get(key)
             if cached is not None:
@@ -152,7 +175,11 @@ class NodeInfo:
         with self.lock:
             if 0 <= chip < len(self.chips.chips):
                 self.chips.chips[chip].load = max(0.0, min(1.0, load))
-                # load shifts rater scores; cached plans are stale
+                # load shifts rater scores; cached plans are stale. This
+                # clear only covers updates routed THROUGH this node —
+                # model state that moves without touching it (a usage
+                # sync's EWMA calibration, a throughput-table reload) is
+                # covered by the rater cache token in assume()'s key.
                 self._plan_cache.clear()
                 self._bump()
 

@@ -1,0 +1,98 @@
+"""``nanotpu_sched_throughput_*`` exposition: the throughput model's
+observable surface (docs/scoring.md).
+
+Two kinds of series:
+
+* unlabeled model gauges — the keys of :data:`_THROUGHPUT_GAUGES`,
+  produced by :meth:`ThroughputModel.gauge_values
+  <nanotpu.allocator.throughput.ThroughputModel.gauge_values>`. The
+  nanolint metrics-completeness pass cross-checks the two tables BOTH
+  directions (a gauge declared here but never produced, or produced
+  there but never declared/exported, is a lint finding) — the same
+  honesty contract the resilience counters and PerfCounters live under.
+* ``nanotpu_sched_throughput_modeled_aggregate{shard=...}`` — modeled
+  aggregate throughput of the pods bound to each snapshot shard's
+  nodes, derated for card co-residency (the fleet's "how much work is
+  the cluster actually delivering" number; the sim certifies the
+  binpack-vs-throughput delta on exactly this model,
+  examples/sim/het-throughput.json).
+"""
+
+from __future__ import annotations
+
+from nanotpu.metrics.registry import _escape_label_value
+
+_FAMILY = "nanotpu_sched_throughput_"
+
+#: gauge suffix -> help text. Keys must match ThroughputModel.
+#: gauge_values() exactly — nanolint pins the equivalence both ways.
+_THROUGHPUT_GAUGES: dict[str, str] = {
+    "calibration_age_seconds":
+        "Seconds since the newest contention-EWMA calibration sample "
+        "(-1: never calibrated)",
+    "calibrated_nodes":
+        "Nodes with at least one contention-EWMA calibration sample",
+    "table_rows":
+        "Rows in the effective-throughput table (seed defaults + "
+        "policy.yaml overrides)",
+}
+
+_MODELED = _FAMILY + "modeled_aggregate"
+
+
+def modeled_aggregate_by_shard(dealer, model) -> dict[str, float]:
+    """Modeled aggregate throughput of bound pods, grouped by the
+    snapshot shard owning each pod's node (``all`` in single-shard
+    mode). Scrape-time walk over the dealer's tracked pods — O(pods),
+    copies taken under the dealer lock via the public snapshot."""
+    from nanotpu.allocator.throughput import pod_modeled_throughput
+    from nanotpu.dealer.shard import DEFAULT_SHARD_KEY
+
+    node_infos = dealer.debug_snapshot()["node_infos"]
+    shard_of = getattr(dealer, "_shard_of", {})
+    out: dict[str, float] = {}
+    for pod in dealer.tracked_pods():
+        info = node_infos.get(pod.node_name)
+        if info is None:
+            continue
+        tput = pod_modeled_throughput(pod, info, model)
+        if tput <= 0.0:
+            continue
+        key = shard_of.get(pod.node_name) or DEFAULT_SHARD_KEY
+        out[key] = out.get(key, 0.0) + tput
+    return {k: round(out[k], 4) for k in sorted(out)}
+
+
+class ThroughputExporter:
+    """Registry-compatible renderer (``Registry.register``) for the
+    throughput model's gauges. Registered by SchedulerAPI exactly when
+    the dealer's rater carries a model, so binpack/spread deployments
+    export nothing new."""
+
+    def __init__(self, dealer, model):
+        self.dealer = dealer
+        self.model = model
+
+    def render(self) -> list[str]:
+        out: list[str] = []
+        values = self.model.gauge_values()
+        for suffix in sorted(_THROUGHPUT_GAUGES):
+            name = _FAMILY + suffix
+            out.append(f"# HELP {name} {_THROUGHPUT_GAUGES[suffix]}")
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {float(values[suffix])}")
+        out.append(
+            f"# HELP {_MODELED} Modeled aggregate throughput of bound "
+            "pods per snapshot shard (co-residency derated; "
+            "docs/scoring.md)"
+        )
+        out.append(f"# TYPE {_MODELED} gauge")
+        by_shard = modeled_aggregate_by_shard(self.dealer, self.model)
+        if not by_shard:
+            out.append(f'{_MODELED}{{shard="all"}} 0.0')
+        for key in sorted(by_shard):
+            out.append(
+                f'{_MODELED}{{shard="{_escape_label_value(key)}"}} '
+                f"{by_shard[key]}"
+            )
+        return out

@@ -75,7 +75,7 @@ class _Cycle:
     """One pod scheduling cycle under construction (see ledger)."""
 
     __slots__ = ("uid", "pod", "seq", "t", "policy", "verdicts", "scores",
-                 "binds", "outcome")
+                 "score_terms", "binds", "outcome")
 
     def __init__(self, uid: str, pod: str, seq: int, t: float):
         self.uid = uid
@@ -85,11 +85,15 @@ class _Cycle:
         self.policy = ""
         self.verdicts: dict[str, str] = {}
         self.scores: dict[str, int] = {}
+        #: node -> per-TERM score breakdown (base / contention /
+        #: fragmentation / gang / total) — recorded only by raters that
+        #: decompose their score (throughput, docs/scoring.md)
+        self.score_terms: dict[str, dict[str, int]] = {}
         self.binds: list[dict] = []
         self.outcome = ""
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "uid": self.uid,
             "pod": self.pod,
             "seq": self.seq,
@@ -100,6 +104,14 @@ class _Cycle:
             "binds": list(self.binds),
             "outcome": self.outcome,
         }
+        if self.score_terms:
+            # present only when recorded: raters without term breakdowns
+            # keep their record bytes (and trace digests) unchanged
+            out["score_terms"] = {
+                k: dict(self.score_terms[k])
+                for k in sorted(self.score_terms)
+            }
+        return out
 
 
 #: building cycles kept per ledger before the oldest is force-finalized
@@ -171,6 +183,20 @@ class DecisionLedger:
             cyc.scores = {name: int(score) for name, score in scored}
             if policy and not cyc.policy:
                 cyc.policy = policy
+
+    def score_terms(self, uid: str,
+                    terms: dict[str, dict[str, int]]) -> None:
+        """Attach per-candidate per-TERM score breakdowns (base /
+        contention / fragmentation / gang / total) to the pod's cycle —
+        the ledger's proof of WHY the winning node outranked the rest
+        (docs/scoring.md)."""
+        if not terms:
+            return
+        with self._lock:
+            cyc = self._cycle_locked(uid)
+            cyc.score_terms = {
+                name: dict(t) for name, t in terms.items()
+            }
 
     def bind_outcome(self, uid: str, node: str, reason: str,
                      bound: bool, pod: str = "", final: bool = False) -> None:

@@ -72,9 +72,33 @@ class PriorityWeight:
 
 
 @dataclass(frozen=True)
+class ThroughputEntry:
+    """One effective-throughput table row: how fast pod-shape ``shape``
+    (``"*"`` wildcard, or a :func:`nanotpu.allocator.throughput.shape_of`
+    key like ``"100/100"``) runs on slice type ``slice_type``, relative
+    units (normalized against the table max at configure time)."""
+
+    shape: str
+    slice_type: str
+    value: float
+
+
+@dataclass(frozen=True)
+class ThroughputSpec:
+    """``policy.yaml``'s ``throughput:`` section — the YAML override for
+    the throughput rater's seed table + EWMA smoothing
+    (docs/scoring.md). ``alpha`` None keeps the model default."""
+
+    alpha: float | None = None
+    entries: tuple[ThroughputEntry, ...] = ()
+
+
+@dataclass(frozen=True)
 class PolicySpec:
     sync_periods: tuple[SyncPeriod, ...] = ()
     priorities: tuple[PriorityWeight, ...] = ()
+    #: None == no throughput section (the rater keeps its seed defaults)
+    throughput: ThroughputSpec | None = None
 
     def period_for(self, metric: str, default: float = 15.0) -> float:
         for sp in self.sync_periods:
@@ -119,10 +143,12 @@ def parse_policy(text: str) -> PolicySpec:
         body = doc
     if not isinstance(body, dict):
         raise ValueError("policy document must be a mapping")
-    if "syncPeriod" not in body and "priority" not in body:
+    if not any(k in body for k in ("syncPeriod", "priority", "throughput")):
         # any YAML mapping parses "successfully"; require at least one known
         # key so unrelated/garbage files don't silently become empty policy
-        raise ValueError("policy document has neither syncPeriod nor priority")
+        raise ValueError(
+            "policy document has none of syncPeriod/priority/throughput"
+        )
     periods = []
     for entry in body.get("syncPeriod") or []:
         try:
@@ -139,7 +165,39 @@ def parse_policy(text: str) -> PolicySpec:
             )
         except (KeyError, TypeError, ValueError) as e:
             raise ValueError(f"bad priority entry {entry!r}: {e}") from e
-    return PolicySpec(sync_periods=tuple(periods), priorities=tuple(weights))
+    throughput = None
+    if "throughput" in body:
+        tp = body.get("throughput") or {}
+        if not isinstance(tp, dict):
+            raise ValueError("policy.throughput must be a mapping")
+        alpha = tp.get("ewmaAlpha")
+        if alpha is not None:
+            alpha = float(alpha)
+            if not 0.0 < alpha <= 1.0:
+                raise ValueError(
+                    f"policy.throughput.ewmaAlpha must be in (0, 1], "
+                    f"got {alpha}"
+                )
+        entries = []
+        for entry in tp.get("table") or []:
+            try:
+                value = float(entry["value"])
+                if value <= 0:
+                    raise ValueError("value must be > 0")
+                entries.append(ThroughputEntry(
+                    str(entry.get("shape", "*")),
+                    str(entry["sliceType"]),
+                    value,
+                ))
+            except (KeyError, TypeError, ValueError) as e:
+                raise ValueError(
+                    f"bad throughput table entry {entry!r}: {e}"
+                ) from e
+        throughput = ThroughputSpec(alpha=alpha, entries=tuple(entries))
+    return PolicySpec(
+        sync_periods=tuple(periods), priorities=tuple(weights),
+        throughput=throughput,
+    )
 
 
 class PolicyWatcher:
@@ -147,13 +205,19 @@ class PolicyWatcher:
     ``spec()`` on every use, so reloads take effect — fixing the reference's
     one-shot copy (main.go:118). A bad reload keeps the last good spec."""
 
-    def __init__(self, path: str = "", poll_s: float = 3.0):
+    def __init__(self, path: str = "", poll_s: float = 3.0,
+                 on_reload=None):
         self.path = path
         self.poll_s = poll_s
         self._lock = make_lock("PolicyWatcher._lock")
         self._spec = PolicySpec.default()
         self._mtime = 0.0
         self._stop = threading.Event()
+        #: called with the new PolicySpec after every SUCCESSFUL load
+        #: (initial included) — how the throughput rater's table applies
+        #: YAML overrides hot (docs/scoring.md); a raising callback is
+        #: logged, never fatal to the poller
+        self.on_reload = on_reload
         if path:
             self._load(initial=True)
             threading.Thread(
@@ -178,6 +242,11 @@ class PolicyWatcher:
                 self._spec = spec
                 self._mtime = mtime
             log.info("policy loaded from %s", self.path)
+            if self.on_reload is not None:
+                try:
+                    self.on_reload(spec)
+                except Exception:
+                    log.exception("policy on_reload callback failed")
         except (OSError, ValueError) as e:
             log.error("policy load failed (%s); keeping last good spec", e)
 

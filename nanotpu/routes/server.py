@@ -201,6 +201,13 @@ class SchedulerAPI:
                 g.set_function(lambda n=name: getattr(dealer.perf, n))
         if getattr(dealer, "perf_by_shard", None) is not None:
             r.register(ShardPerfExporter(dealer))
+        model = getattr(dealer.rater, "model", None)
+        if model is not None and hasattr(model, "gauge_values"):
+            # throughput rater (docs/scoring.md): export the model's
+            # calibration gauges + per-shard modeled aggregate throughput
+            from nanotpu.metrics.throughput import ThroughputExporter
+
+            r.register(ThroughputExporter(dealer, model))
         for gen in range(3):
             g = r.gauge(
                 f"nanotpu_gc_gen{gen}_collections",

@@ -201,6 +201,15 @@ class Prioritize:
                 self.obs.ledger.scores(
                     pod.uid, scored, policy=self.dealer.rater.name
                 )
+                # per-TERM breakdown (docs/scoring.md): raters that
+                # decompose their score (throughput) explain every
+                # candidate's ranking in the audit record; others
+                # return {} for the cost of one getattr
+                terms_fn = getattr(self.dealer, "score_terms", None)
+                if terms_fn is not None:
+                    self.obs.ledger.score_terms(
+                        pod.uid, terms_fn(node_names, pod)
+                    )
         return scored
 
     def fast(self, args: dict[str, Any]) -> bytes | None:

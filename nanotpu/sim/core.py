@@ -587,6 +587,29 @@ class Simulator:
         self.faults.counts["metric_syncs"] += 1
         samples = []
         infos = self.dealer.debug_snapshot()["node_infos"]
+        if self.scenario["metric_from_allocation"]:
+            # usage mirrors the REAL per-card allocation (used fraction)
+            # instead of seeded noise: the signal that calibrates the
+            # throughput rater's contention EWMA end to end — a card two
+            # fractional pods share reads hot, an idle card reads cold
+            # (docs/scoring.md). Deterministic: derived from accounting,
+            # no rng draw. Nodes the dealer does not track yet have no
+            # known allocation and simply skip the tick.
+            for name in self._live_node_names():
+                info = infos.get(name)
+                if info is None:
+                    continue
+                for chip, c in enumerate(info.chips.chips):
+                    frac = (
+                        c.percent_used / c.percent_total
+                        if c.percent_total else 0.0
+                    )
+                    samples.append((name, chip, round(frac, 4)))
+            delay = float(payload["delay"])
+            if delay > 0:
+                self.faults.counts["metric_samples_delayed"] += len(samples)
+            self._push(self.now + delay, "metric_apply", samples)
+            return
         for name in self._live_node_names():
             info = infos.get(name)
             if info is not None:
@@ -698,6 +721,26 @@ class Simulator:
             f"settle occ={self.report.final_occupancy:.6f} "
             f"frag={self.report.final_fragmentation:.4f}",
         )
+        if self.scenario["throughput_report"]:
+            # modeled aggregate throughput of the pods still bound at the
+            # horizon vs the oracle bound, ONE fixed default model for
+            # every policy (so binpack-vs-throughput runs of the same
+            # scenario compare on identical units — the het-throughput
+            # certification, docs/scoring.md). Part of the journal, so
+            # part of the determinism digest.
+            from nanotpu.allocator.throughput import modeled_aggregate
+
+            agg = modeled_aggregate(
+                self.dealer.debug_snapshot()["node_infos"],
+                self.dealer.tracked_pods(),
+            )
+            self.report.throughput = agg
+            self.report.journal(
+                horizon,
+                f"throughput agg={agg['aggregate']:.4f} "
+                f"oracle={agg['oracle']:.4f} "
+                f"loss={agg['loss_vs_oracle_pct']:.2f}%",
+            )
 
 
 def run_scenario(scenario: dict, seed: int = 0,

@@ -107,6 +107,10 @@ class ReportBuilder:
         #: counts plus a sha256 over every retained trace and decision
         #: record — virtual-clock timestamps make it byte-reproducible
         self.traces: dict = {}
+        #: modeled aggregate throughput vs oracle at settle (the
+        #: het-throughput certification metric, docs/scoring.md);
+        #: empty == scenario did not enable throughput_report
+        self.throughput: dict = {}
         self.restart_occupancy_drift = 0.0
         self.final_occupancy = 0.0
         self.final_fragmentation = 0.0
@@ -182,6 +186,12 @@ class ReportBuilder:
             "digest": "sha256:" + self._journal.hexdigest(),
             "journal_lines": self._journal_lines,
         }
+        if self.throughput:
+            # present only when the scenario opts in: existing scenarios'
+            # reports (and digests) stay byte-identical
+            report["throughput"] = {
+                k: self.throughput[k] for k in sorted(self.throughput)
+            }
         if include_timing:
             report["timing"] = {
                 "note": "wall-clock; excluded from the determinism contract",

@@ -50,6 +50,19 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
                                      # the digest — stays identical; the
                                      # soak proves the armed code path
                                      # keeps every invariant)
+      "metric_from_allocation": false, # true: metric-sync samples mirror
+                                     # the REAL per-card allocation
+                                     # (used fraction) instead of seeded
+                                     # noise — what calibrates the
+                                     # throughput rater's contention
+                                     # EWMA end to end (docs/scoring.md)
+      "throughput_report": false,    # true: the report gains a
+                                     # deterministic `throughput`
+                                     # section (modeled aggregate vs
+                                     # oracle, docs/scoring.md) and a
+                                     # settle journal line — off keeps
+                                     # existing scenario digests
+                                     # byte-identical
       "lock_witness": false,         # true: instrument every lock and
                                      # assert acquisition-order acyclicity
                                      # at teardown (docs/static-analysis.md)
@@ -75,7 +88,9 @@ CONFIG_KINDS = (
     "fractional", "spread", "multi_container", "gang_llama", "mixtral",
 )
 
-_POLICIES = (types.POLICY_BINPACK, types.POLICY_SPREAD)
+_POLICIES = (
+    types.POLICY_BINPACK, types.POLICY_SPREAD, types.POLICY_THROUGHPUT,
+)
 
 
 def _require(cond: bool, msg: str) -> None:
@@ -173,6 +188,10 @@ def normalize_scenario(raw: dict) -> dict:
         "queue_max": int(raw.get("queue_max", 0)),
         "shards": shards,
         "pipeline": pipeline,
+        "metric_from_allocation": bool(
+            raw.get("metric_from_allocation", False)
+        ),
+        "throughput_report": bool(raw.get("throughput_report", False)),
         "lock_witness": bool(raw.get("lock_witness", False)),
         "trace": bool(raw.get("trace", True)),
     }

@@ -138,6 +138,9 @@ GANG_BARRIER_TIMEOUT_S = 30.0
 POLICY_BINPACK = "binpack"
 POLICY_SPREAD = "spread"
 POLICY_RANDOM = "random"
+#: Heterogeneity/contention-aware throughput-model rater (NEW — no
+#: reference analogue; Gavel/BandPilot-style, see docs/scoring.md).
+POLICY_THROUGHPUT = "throughput"
 
 #: Sentinel chip id for containers that request no TPU.
 #: Reference: NotNeedGPU = -1 (pkg/dealer/allocate.go:15).

@@ -506,6 +506,28 @@ class TestSimDeterminism:
             """, "sim-determinism")
         assert any("ambient" in f.message for f in report.findings)
 
+    def test_ewma_calibration_must_inject_its_clock(self, tmp_path):
+        """The throughput model's online calibration (docs/scoring.md)
+        is sim-driven — an observe() that stamps wall-clock instead of
+        the injected `now` is exactly the class of bug this pass exists
+        for; the sanctioned injection idiom stays clean. (The real
+        nanotpu/allocator/throughput.py is in the allocator scope and
+        held to this by the clean-tree pin.)"""
+        report = one(tmp_path, """
+            import time
+
+            class Model:
+                def observe_bad(self, node, chip, load):
+                    self._updated_at[node] = time.time()
+
+                def observe_good(self, node, chip, load, now=None):
+                    self._updated_at[node] = (
+                        time.time() if now is None else now
+                    )
+            """, "sim-determinism")
+        assert len(report.findings) == 1
+        assert "wall clock" in report.findings[0].message
+
     def test_seeded_stream_allowed_unseeded_flagged(self, tmp_path):
         report = one(tmp_path, """
             import random
@@ -734,6 +756,58 @@ class TestMetricsCompleteness:
         }, ["metrics-completeness"])
         assert not any("REASON_OK" in f.message
                        for f in report.findings), report.findings
+
+    # -- throughput gauge family (nanotpu/metrics/throughput.py) -----------
+    TGAUGES_DECL = """
+        _THROUGHPUT_GAUGES = {
+            "calibration_age_seconds": "age",
+            "dead_gauge": "declared but never produced",
+        }
+        """
+
+    def test_throughput_gauge_produced_but_undeclared(self, tmp_path):
+        report = lint(tmp_path, {
+            "exporter.py": self.TGAUGES_DECL,
+            "model.py": """
+                class Model:
+                    def gauge_values(self, now=None):
+                        return {
+                            "calibration_age_seconds": 1.0,
+                            "ghost_gauge": 2.0,
+                        }
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("ghost_gauge" in m and "not declared" in m
+                   for m in msgs), msgs
+
+    def test_throughput_gauge_declared_but_never_produced(self, tmp_path):
+        report = lint(tmp_path, {
+            "exporter.py": self.TGAUGES_DECL,
+            "model.py": """
+                class Model:
+                    def gauge_values(self, now=None):
+                        return {"calibration_age_seconds": 1.0}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("dead_gauge" in m and "KeyError" in m
+                   for m in msgs), msgs
+        assert not any("calibration_age_seconds" in m for m in msgs), msgs
+
+    def test_throughput_gauges_consistent_is_clean(self, tmp_path):
+        report = lint(tmp_path, {
+            "exporter.py": """
+                _THROUGHPUT_GAUGES = {"calibrated_nodes": "n"}
+                """,
+            "model.py": """
+                class Model:
+                    def gauge_values(self, now=None):
+                        return {"calibrated_nodes": 3.0}
+                """,
+        }, ["metrics-completeness"])
+        assert not any("gauge" in f.message for f in report.findings), \
+            report.findings
 
 
 # ---------------------------------------------------------------------------

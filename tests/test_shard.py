@@ -67,9 +67,9 @@ def _mk_pod(client, name: str, percent: int, gang: str | None = None):
 
 
 class _Stack:
-    def __init__(self, shards):
+    def __init__(self, shards, policy: str = "binpack"):
         self.client = make_fleet(FLEET_SPEC)
-        self.dealer = Dealer(self.client, make_rater("binpack"),
+        self.dealer = Dealer(self.client, make_rater(policy),
                              shards=shards)
         self.api = SchedulerAPI(self.dealer, Registry())
         self.nodes = [n.name for n in self.client.list_nodes()]
@@ -146,6 +146,27 @@ class TestMergeTopK:
             split = [shuffled[:cut], shuffled[cut:]]
             assert merge_top_k(split, None) == whole
             assert merge_top_k(split, 4) == whole[:4]
+
+    def test_ties_reduce_deterministically(self):
+        """Satellite pin (docs/scoring.md): the throughput rater scores
+        every node of a uniform idle pool IDENTICALLY, so the reduce
+        runs almost entirely on ties — equal scores must still order
+        name-ascending, byte-identically, for every shard split and
+        every per-shard list order."""
+        entries = [(f"host-{i:03d}", 80) for i in range(16)]
+        entries += [(f"cold-{i:03d}", 52) for i in range(8)]
+        whole = merge_top_k([entries], None)
+        assert whole[:16] == sorted(entries[:16])  # pure name order
+        rng = random.Random(7)
+        for _ in range(8):
+            shuffled = list(entries)
+            rng.shuffle(shuffled)
+            n_parts = rng.randrange(1, 5)
+            parts: list[list] = [[] for _ in range(n_parts)]
+            for i, e in enumerate(shuffled):
+                parts[i % n_parts].append(e)
+            assert merge_top_k(parts, None) == whole
+            assert merge_top_k(parts, 5) == whole[:5]
 
 
 class TestSplice:
@@ -366,6 +387,78 @@ class TestNonContiguousFallback:
         hits0 = b.dealer.perf.fastpath_hits
         assert b.dealer.filter_payload(sorted(b.nodes), pod) is not None
         assert b.dealer.perf.fastpath_hits > hits0
+
+
+class TestThroughputRaterParity:
+    """Satellite: the throughput rater always takes the fallback (list)
+    path — the fused splice cannot evaluate its model — and that path
+    must answer byte-identically between a single-shard and a sharded
+    stack, score ties included (equal modeled throughput across shards
+    reduces score-desc/name-asc either way)."""
+
+    def test_sharded_vs_single_byte_parity(self):
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        a = _Stack(1, policy="throughput")
+        b = _Stack("auto", policy="throughput")
+        try:
+            assert a.nodes == b.nodes
+            # calibrate both models identically so the contention term
+            # participates in the parity too
+            for s in (a, b):
+                for chip in range(4):
+                    s.dealer.update_chip_usage(
+                        "v5p-a-1", chip, core=0.8, now=9.0
+                    )
+            rng = random.Random(2)
+            for step in range(12):
+                percent = rng.choice(POD_SHAPES)
+                name = f"tp-{step}"
+                pod_a = _mk_pod(a.client, name, percent)
+                pod_b = _mk_pod(b.client, name, percent)
+                args_a = json.dumps(
+                    {"Pod": pod_a.raw, "NodeNames": a.nodes},
+                    separators=(",", ":"),
+                ).encode()
+                args_b = json.dumps(
+                    {"Pod": pod_b.raw, "NodeNames": b.nodes},
+                    separators=(",", ":"),
+                ).encode()
+                filt_a = a.verb("/scheduler/filter", args_a)
+                filt_b = b.verb("/scheduler/filter", args_b)
+                assert filt_a == filt_b
+                prio_a = a.verb("/scheduler/priorities", args_a)
+                prio_b = b.verb("/scheduler/priorities", args_b)
+                assert prio_a == prio_b
+                feasible = set(json.loads(filt_a)["NodeNames"])
+                if not feasible:
+                    continue
+                ranked = sorted(
+                    (p for p in json.loads(prio_a)
+                     if p["Host"] in feasible),
+                    key=lambda p: (-p["Score"], p["Host"]),
+                )
+                bind = json.dumps({
+                    "PodName": name, "PodNamespace": "default",
+                    "PodUID": pod_a.uid, "Node": ranked[0]["Host"],
+                }).encode()
+                res_a = a.verb("/scheduler/bind", bind)
+                res_b = b.verb("/scheduler/bind", bind)
+                assert res_a == res_b
+            # both stacks refused the fused path on every read verb
+            assert a.dealer.perf.fastpath_hits == 0
+            assert b.dealer.perf.fastpath_hits == 0
+            assert a.dealer.perf.fastpath_misses > 0
+            assert b.dealer.perf.fastpath_misses > 0
+            assert a.dealer.occupancy() == b.dealer.occupancy()
+            # top-k agrees across shard counts under heavy ties
+            probe_a = _mk_pod(a.client, "probe", 100)
+            probe_b = _mk_pod(b.client, "probe", 100)
+            assert a.dealer.top_candidates(a.nodes, probe_a, 6) \
+                == b.dealer.top_candidates(b.nodes, probe_b, 6)
+        finally:
+            a.close()
+            b.close()
 
 
 class TestDiagnosability:
