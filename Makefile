@@ -3,7 +3,7 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench bench-ab bind-storm sim-smoke sim-multipool sim-het chaos-soak obs-check fanout-4k image clean
+.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm sim-smoke sim-multipool sim-het chaos-soak obs-check fanout-4k image clean
 
 # Default verification tier: static analysis, then the fast inner loop
 # (test-fast includes sim-smoke), then the observability gate, then the
@@ -56,6 +56,15 @@ AB_KEY ?= bindstorm_pods_per_s
 bench-ab: native
 	python bench_ab.py --ref $(REF) --reps $(REPS) --cmd "$(AB_CMD)" \
 		--rate-key $(AB_KEY)
+
+# The het-throughput row interleaved against the base ref
+# (docs/scoring.md): bench.py feature-detects whether each side's dealer
+# scores the model natively (ABI 7 fused path) or through the Python row
+# hook, so the SAME measurement file runs on both — the ratio prices the
+# native fixed-point path against the base's per-row Python.
+bench-het-ab: native
+	python bench_ab.py --ref $(REF) --reps $(REPS) \
+		--cmd "python bench.py --het-rep" --rate-key het_pods_per_s
 
 # 30 virtual seconds, all five BASELINE configs, every fault armed, run
 # TWICE: exits nonzero on any invariant violation or determinism breach

@@ -22,7 +22,7 @@ import statistics
 import threading
 import time
 
-from nanotpu import types
+from nanotpu import native, types
 from nanotpu.allocator.rater import make_rater
 from nanotpu.cmd.main import make_mock_cluster
 from nanotpu.dealer import Dealer
@@ -376,18 +376,34 @@ def run_fanout(n_hosts: int = 256, n_pods: int = 256,
         # warm-window contract (4096-host row AND the het-throughput
         # row): the timed window ran on warm caches — zero
         # view/renderer rebuilds, zero gen-2 collections (asserted
-        # above). A fused-capable rater (binpack/spread) must serve
-        # every verb from the fused path; a hook rater (throughput,
-        # docs/scoring.md) REFUSES the fused path by design, so the
-        # assert inverts: zero hits, every verb a counted refusal —
-        # either way the counters prove which path the row measured.
+        # above). A fused-capable rater must serve every verb from the
+        # fused path — which since ABI 7 includes the throughput rater
+        # (native model scoring, docs/scoring.md: fused hits > 0 and
+        # ZERO hook refusals are the row's acceptance contract). On a
+        # pre-ABI-7 base (bench_ab worktree) the rater REFUSES the
+        # fused path by design, so the assert inverts: zero hits, every
+        # verb a counted refusal — either way the counters prove which
+        # path the row measured.
         assert attr["view_builds"] == 0, attr
         assert attr["renderer_builds"] == 0, attr
-        if getattr(dealer, "_batch_hook", None) is None:
+        native_model_active = (
+            _NATIVE_HAS_MODEL
+            and getattr(dealer, "_native_model", None) is not None
+        )
+        if getattr(dealer, "_batch_hook", None) is None \
+                or native_model_active:
             assert attr["fastpath_misses"] == 0, attr
+            if native_model_active:
+                assert attr["fastpath_hits"] > 0, attr
+                assert attr.get("hook_refusals", 0) == 0, attr
         else:
             assert attr["fastpath_hits"] == 0, attr
-            assert attr["fastpath_misses"] > 0, attr
+            # refusals land in the dedicated counter when it exists
+            # (>= r9), in the generic miss counter before it (r8 base)
+            refused = (
+                attr.get("hook_refusals", 0) + attr["fastpath_misses"]
+            )
+            assert refused > 0, attr
     p50 = percentile(lats, 0.50)
     return {
         "fanout_hosts": n_hosts,
@@ -482,13 +498,14 @@ HET_FLEET_256 = {
 
 def run_het_throughput(reps: int = 3, max_reps: int = 5) -> dict:
     """The throughput-rater fan-out row (docs/scoring.md): 256 mixed
-    v5p+v4 hosts, ``priority=throughput`` — every Filter runs the native
-    batch feasibility pass and every Prioritize the Python row hook over
-    the same frozen view; the fused render path is REFUSED by design
-    (every verb a counted miss) and the warm-window asserts run IN-bench:
-    zero gen-2 GC, zero view/renderer rebuilds, zero fused hits. The
-    row's job is to price the hook against the fused default — the
-    default rater's own 256-host row is the A/B-guarded hot path."""
+    v5p+v4 hosts, ``priority=throughput``. Since ABI 7 the model scores
+    IN the fused native path — one ctypes crossing per verb, exactly
+    like the default rater — and the warm-window asserts run IN-bench:
+    zero gen-2 GC, zero view/renderer rebuilds, fused hits > 0, and
+    ``hook_refusals == 0`` (the r9 acceptance contract; on a pre-ABI-7
+    base the same bench file detects the hook path and inverts the
+    fused asserts, which is what lets ``make bench-het-ab`` interleave
+    this row against the r8 HEAD)."""
     return run_fanout_reps(
         reps=reps, max_reps=max_reps, prefix="het",
         n_hosts=256, fleet=HET_FLEET_256,
@@ -501,6 +518,14 @@ def run_het_throughput(reps: int = 3, max_reps: int = 5) -> dict:
 #: may predate the commit pipeline — pass the knob only when it exists.
 _DEALER_HAS_PIPELINE = (
     "pipeline_depth" in inspect.signature(Dealer.__init__).parameters
+)
+
+#: Native feature probe, same A/B rationale: ABI 7 added the ``model``
+#: parameter to ``native.score_batch`` (fixed-point throughput scoring,
+#: docs/scoring.md). On a pre-ABI-7 base the het row runs the Python row
+#: hook and the warm-window asserts invert (see run_fanout).
+_NATIVE_HAS_MODEL = (
+    "model" in inspect.signature(native.score_batch).parameters
 )
 
 #: The bind-storm fleet: 4096 hosts as ONE single-generation zone (one
@@ -1043,6 +1068,12 @@ if __name__ == "__main__":
     if "--het-throughput" in sys.argv:
         # the throughput-rater row on its own (in-bench warm asserts)
         print(json.dumps(run_het_throughput()))
+    elif "--het-rep" in sys.argv:
+        # one het-throughput rep, for bench_ab.py's interleaved A/B
+        # protocol (`make bench-het-ab`): the same bench file runs on
+        # the base worktree and feature-detects whether that dealer
+        # scores the model natively (ABI 7) or through the row hook
+        print(json.dumps(run_het_throughput(reps=1, max_reps=1)))
     elif "--fanout-rep" in sys.argv:
         # one 256-host default-rater rep, for bench_ab.py's interleaved
         # A/B protocol (the "hot path unregressed with the new rater

@@ -38,16 +38,25 @@ Two pieces:
                    (a gang can still land there after us)
   ===============  =====================================================
 
-Score parity contract: :meth:`Throughput.rate`, the per-node
-``NodeInfo.score`` path, and the batch row hook
+Score parity contract (fixed-point, ABI 7 — docs/scoring.md): the score
+arithmetic is pure INTEGER arithmetic over quantized inputs
+(:func:`quantize`, Q16 fixed point), and every consumer runs the same
+integer formula (:meth:`Throughput._combine`): the per-node
+``NodeInfo.score`` path, the batch row hook
 (:meth:`Throughput.batch_score_rows`, consumed by
-``BatchScorer.run(score_hook=...)``) all funnel through ONE formula
-(:meth:`Throughput._score_terms`), so the list path and the batch path
-are bit-equal by construction — pinned by tests/test_throughput.py. The
-fused native renderer cannot evaluate the model, so a throughput dealer
-*explicitly refuses* the fused payload path (counted as a fastpath miss)
-and answers through the render-cached list path: same wire shape, zero
-view/renderer rebuilds per request.
+``BatchScorer.run(score_hook=...)``), the decision-ledger per-term
+breakdown, and — since ABI 7 — the NATIVE fused path
+(``nanotpu_score_batch``/``nanotpu_score_render`` evaluate the identical
+integer formula in C over the model mirror the dealer syncs into the
+scoring arena). Fixed point is what makes that native evaluation
+bit-deterministic across platforms AND bit-equal to this module — no
+float op survives past the quantization edge, so there is no
+compiler/FPU freedom left to diverge. Parity is fuzz-pinned by
+tests/test_throughput.py. When the native model path is unavailable
+(``NANOTPU_NATIVE_MODEL=0``, stale library), the dealer falls back to
+the Python row hook and *explicitly refuses* the fused payload path
+(counted as ``hook_refusals``), answering through the render-cached list
+path: same wire shape, zero view/renderer rebuilds per request.
 
 Determinism: the model draws time only through the injectable ``now``
 parameter (``time.time() if now is None else now`` — the sanctioned
@@ -95,6 +104,25 @@ DEFAULT_TABLE: dict[tuple[str, str], float] = {
 #: table value when neither the (shape, generation) row nor the
 #: generation wildcard exists: schedule load-blind, never crash
 FALLBACK_VALUE = 0.5
+
+#: Fixed-point quantization (docs/scoring.md, ABI 7): every fractional
+#: score input — the base fraction, each per-card contention EWMA, each
+#: instantaneous per-card load — is quantized to Q16 (``value * 2**16``
+#: rounded, clamped to [0, Q_ONE]) at the float/int edge, and ALL
+#: downstream arithmetic is integer. 16 fraction bits resolve 1/65536 ≈
+#: 0.0015% of a band — far below the 1-point score granularity — while
+#: keeping every intermediate product (band × sum of ≤64 quantized
+#: cards) comfortably inside int64 for the C evaluation.
+Q_BITS = 16
+Q_ONE = 1 << Q_BITS
+
+
+def quantize(fraction: float) -> int:
+    """Quantize a [0, 1] fraction to Q16 (out-of-range inputs clamp).
+    THE float→int edge of the scoring formula: Python and C never see
+    the same value disagree because past this point there are no
+    floats left to round differently."""
+    return min(Q_ONE, max(0, round(fraction * Q_ONE)))
 
 
 def shape_of(demand) -> str:
@@ -161,6 +189,20 @@ class ThroughputModel:
         """``effective / table max`` in (0, 1] — the base-term scaler."""
         return min(1.0, self.effective(shape, generation) / self._norm)
 
+    def base_q(self, shape: str, generation: str) -> int:
+        """Quantized (Q16) base fraction — the integer the score formula
+        actually consumes (docs/scoring.md fixed-point contract)."""
+        return quantize(self.base_fraction(shape, generation))
+
+    def base_q_for(self, demand, generations) -> list[int]:
+        """Quantized base fractions for one demand across a view's
+        generation list — the per-call table resolution the native path
+        needs (O(#generations) dict lookups in Python; the per-ROW
+        indirection happens in C via the view's generation indices).
+        Iterates the caller's list: no hash-order dependence."""
+        shape = shape_of(demand)
+        return [quantize(self.base_fraction(shape, g)) for g in generations]
+
     # -- online contention calibration ------------------------------------
     def observe(self, node: str, chip: int, load: float,
                 now: float | None = None) -> None:
@@ -183,29 +225,69 @@ class ThroughputModel:
 
     def contention(self, node: str) -> float | None:
         """Mean per-card EWMA for the node in [0, 1]; None before the
-        first calibration sample (callers fall back to instantaneous
-        load)."""
+        first calibration sample. Introspection/test surface ONLY — the
+        scoring paths consume :meth:`contention_q` (the quantized
+        integers), never this float."""
         with self._lock:
             per_node = self._ewma.get(node)
             if not per_node:
                 return None
             return sum(per_node.values()) / len(per_node)
 
-    def contention_many(self, nodes) -> dict[str, float]:
-        """Mean per-card EWMA for many nodes under ONE lock hold —
+    @staticmethod
+    def _q_entry(per_node: dict[int, float]) -> tuple[int, int]:
+        """``(sum of per-card Q16 EWMAs, card count)`` for one node's
+        calibration dict (caller holds the lock). THE quantize-then-sum
+        rule — never sum-then-quantize — in exactly one place: the
+        mirror, the hook, and the per-node path all feed the fixed-point
+        formula integers produced by this body, which is what keeps them
+        bit-equal to each other and to the C evaluation."""
+        return sum(quantize(v) for v in per_node.values()), len(per_node)
+
+    def contention_q(self, node: str) -> tuple[int, int] | None:
+        """Quantized contention state for one node (:meth:`_q_entry`) —
+        the exact integers the fixed-point formula divides. None before
+        the first calibration sample (callers fall back to quantized
+        instantaneous load)."""
+        with self._lock:
+            per_node = self._ewma.get(node)
+            if not per_node:
+                return None
+            return self._q_entry(per_node)
+
+    def _collect_q_locked(self, nodes) -> dict[str, tuple[int, int]]:
+        """:meth:`_q_entry` per calibrated node (caller holds the
+        lock). Nodes without calibration are absent (callers fall back
+        to quantized instantaneous load). Iterates the caller's list,
+        so the result order carries no hash-order dependence."""
+        out: dict[str, tuple[int, int]] = {}
+        for n in nodes:
+            per_node = self._ewma.get(n)
+            if per_node:
+                out[n] = self._q_entry(per_node)
+        return out
+
+    def contention_q_many(self, nodes) -> dict[str, tuple[int, int]]:
+        """:meth:`contention_q` for many nodes under ONE lock hold —
         the batch row hook scores hundreds of candidates per verb while
         holding the view arena lock, and a per-candidate lock
-        round-trip there contends with the metric-sync writer. Nodes
-        without calibration are absent from the result (caller falls
-        back to instantaneous load). Iterates the caller's list, so the
-        result order carries no hash-order dependence."""
+        round-trip there contends with the metric-sync writer."""
         with self._lock:
-            out: dict[str, float] = {}
-            for n in nodes:
-                per_node = self._ewma.get(n)
-                if per_node:
-                    out[n] = sum(per_node.values()) / len(per_node)
-            return out
+            return self._collect_q_locked(nodes)
+
+    def mirror_snapshot(
+        self, nodes
+    ) -> tuple[int, dict[str, tuple[int, int]]]:
+        """``(version, {node: (Q16 EWMA sum, card count)})`` captured
+        under ONE lock hold — the copy-on-write source for the scoring
+        arena's model mirror (nanotpu.dealer.batch). Capturing the
+        version INSIDE the same critical section as the state is what
+        makes the mirror's version stamp honest: a concurrent
+        ``observe`` either lands before the capture (and is in both) or
+        after (and bumps ``version`` past the stamp, retiring the
+        mirror on the next read)."""
+        with self._lock:
+            return self.version, self._collect_q_locked(nodes)
 
     def forget_node(self, node: str) -> None:
         with self._lock:
@@ -266,6 +348,15 @@ class Throughput:
         plan cached under the previous token."""
         return self.model.version
 
+    def native_model(self):
+        """Duck-typed dealer hook (ABI 7, docs/scoring.md): expose the
+        model so the dealer can mirror its quantized state into the
+        scoring arena and evaluate the fixed-point formula inside
+        ``nanotpu_score_batch``/``nanotpu_score_render`` — the same
+        integer arithmetic as :meth:`_combine`, bit-equal by
+        construction."""
+        return self.model
+
     def observe_usage(self, node: str, chip: int, load: float,
                       now: float | None = None) -> None:
         """Dealer.update_chip_usage forwards every per-card usage write
@@ -280,24 +371,40 @@ class Throughput:
 
     # -- the one scoring formula -------------------------------------------
     @staticmethod
-    def _combine(base_f: float, cont: float | None,
-                 free, total, load) -> dict[str, int]:
+    def _combine(base_q: int, cont: tuple[int, int] | None,
+                 free, total, load_q) -> dict[str, int]:
         """The term arithmetic, shared verbatim by every caller — this
-        single body is what makes list-path, batch-path, and ledger
-        scores bit-equal. ``cont`` None means uncalibrated: fall back
-        to the node's instantaneous folded load (identical values in a
-        ChipSet and in the batch rows copied from it)."""
+        single body is what makes the per-node path, the batch row
+        hook, the ledger breakdown, AND the native C evaluation
+        (allocator.cc ``model_score``) bit-equal. Pure integer
+        arithmetic over quantized inputs (docs/scoring.md):
+
+        * ``base_q`` — Q16 base fraction (:meth:`ThroughputModel.base_q`)
+        * ``cont`` — ``(Q16 EWMA sum, card count)`` or None;
+          None means uncalibrated: fall back to the node's quantized
+          instantaneous per-card loads (``load_q`` — identical values
+          in a ChipSet and in the batch rows copied from it)
+        * ``free``/``total`` — raw integer chip percents
+
+        Every division is floor division of non-negative integers —
+        exactly C's truncating ``/`` on the same operands, which is the
+        whole parity argument. Change NOTHING here without changing
+        allocator.cc in lockstep (the fuzz pin in tests/test_throughput
+        will catch you)."""
         if cont is None:
-            n = len(load)
-            cont = (sum(load) / n) if n else 0.0
+            cont_sum, cont_n = sum(load_q), len(load_q)
+        else:
+            cont_sum, cont_n = cont
+        contention = (
+            (CONTENTION_BAND * cont_sum) // (cont_n * Q_ONE)
+            if cont_n else 0
+        )
         free_pct = sum(free)
         whole_free = sum(
             f for f, t in zip(free, total) if f == t and t > 0
         )
-        frag_f = (whole_free / free_pct) if free_pct else 0.0
-        base = int(BASE_BAND * base_f)
-        contention = int(CONTENTION_BAND * cont)
-        frag = int(FRAG_BAND * frag_f)
+        frag = (FRAG_BAND * whole_free) // free_pct if free_pct else 0
+        base = (BASE_BAND * base_q) // Q_ONE
         total_score = max(
             types.SCORE_MIN,
             min(types.SCORE_MAX, base - contention + frag),
@@ -312,12 +419,14 @@ class Throughput:
     def _score_terms(self, generation: str, node_key: str,
                      free, total, load, demand) -> dict[str, int]:
         """Per-term score breakdown from raw per-chip state (the
-        one-candidate adapter over :meth:`_combine`)."""
+        one-candidate adapter over :meth:`_combine`; ``load`` is the
+        raw float per-card loads, quantized here at the formula's
+        float/int edge)."""
         model = self.model
         return self._combine(
-            model.base_fraction(shape_of(demand), generation),
-            model.contention(node_key),
-            free, total, load,
+            model.base_q(shape_of(demand), generation),
+            model.contention_q(node_key),
+            free, total, [quantize(v) for v in load],
         )
 
     def _terms_of(self, chips, demand) -> dict[str, int]:
@@ -364,15 +473,19 @@ class Throughput:
         per-node path's infeasible verdict.
 
         Loop-invariant work is hoisted: the shape key + per-generation
-        base fraction compute once per call, and every candidate's
-        contention EWMA snapshots under ONE model-lock hold
-        (:meth:`ThroughputModel.contention_many`) — this loop runs under
-        the view's arena lock at fan-out sizes, and per-candidate lock
-        round-trips there would contend with the metric-sync writer."""
+        quantized base fraction compute once per call, and every
+        candidate's quantized contention state snapshots under ONE
+        model-lock hold (:meth:`ThroughputModel.contention_q_many`) —
+        this loop runs under the view's arena lock at fan-out sizes, and
+        per-candidate lock round-trips there would contend with the
+        metric-sync writer. The uncalibrated fallback reads the view's
+        pre-quantized ``load_q`` rows — the SAME integers the native
+        mirror path consumes, which is what keeps hook and native
+        bit-equal."""
         model = self.model
         shape = shape_of(demand)
-        base_by_gen: dict[str, float] = {}
-        cont_map = model.contention_many(
+        base_by_gen: dict[str, int] = {}
+        cont_map = model.contention_q_many(
             [info.name for info in scorer.infos]
         )
         c = scorer.chip_count
@@ -381,18 +494,18 @@ class Throughput:
             if not feasible[i]:
                 out.append(types.SCORE_MIN)
                 continue
-            base_f = base_by_gen.get(info.generation)
-            if base_f is None:
-                base_f = base_by_gen[info.generation] = (
-                    model.base_fraction(shape, info.generation)
+            base_q = base_by_gen.get(info.generation)
+            if base_q is None:
+                base_q = base_by_gen[info.generation] = (
+                    model.base_q(shape, info.generation)
                 )
             row = i * c
             out.append(self._combine(
-                base_f,
+                base_q,
                 cont_map.get(info.name),
                 scorer.free[row:row + c],
                 scorer.total[row:row + c],
-                scorer.load[row:row + c],
+                scorer.load_q[row:row + c],
             )["total"])
         return out
 

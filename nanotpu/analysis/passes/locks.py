@@ -41,7 +41,7 @@ SCOPE = (
     "nanotpu.dealer", "nanotpu.controller", "nanotpu.routes",
     "nanotpu.scheduler", "nanotpu.k8s", "nanotpu.metrics", "nanotpu.sim",
     "nanotpu.native", "nanotpu.policy", "nanotpu.utils",
-    "nanotpu.analysis",
+    "nanotpu.analysis", "nanotpu.allocator",
 )
 
 #: locks whose critical sections are the scheduling hot path: blocking
@@ -52,9 +52,14 @@ SCOPE = (
 #: ``_Shard._pending_lock`` guards the commit pipeline's coalescing
 #: queue (docs/bind-pipeline.md): every pipelined commit enqueues under
 #: it, so its critical sections must stay set-ops-only.
+#: ``ThroughputModel._lock`` is the mirror-sync lock (docs/scoring.md,
+#: ABI 7): the metric-sync writer holds it per observe and every scoring
+#: view's mirror resync snapshots under it while HOLDING the arena lock
+#: — a blocking call inside it would stall both calibration and the
+#: Filter/Prioritize read path at once.
 HOT_LOCKS = (
     "Dealer._lock", "Dealer._publish_lock", "_Shard._publish_lock",
-    "_Shard._pending_lock",
+    "_Shard._pending_lock", "ThroughputModel._lock",
 )
 
 #: per-node reservation locks (docs/bind-pipeline.md): the commit

@@ -48,6 +48,7 @@ import ctypes
 import itertools
 
 from nanotpu import native, types
+from nanotpu.allocator.throughput import quantize
 from nanotpu.analysis.witness import make_lock
 from nanotpu.dealer import nodeinfo as nodeinfo_mod
 from nanotpu.dealer.nodeinfo import NodeInfo
@@ -59,25 +60,49 @@ _DEFAULT_PERF = PerfCounters()
 
 #: attributes shared by reference across an advanced() chain: static
 #: geometry plus the per-candidate-list arena (lock, output buffers, memo,
-#: gang cache, renderer blobs)
+#: gang cache, renderer blobs) — and, for model raters (ABI 7,
+#: docs/scoring.md), the model handle, the generation index, and the
+#: model-mirror box (the mirror itself is copy-on-write, so sharing the
+#: BOX means one resync serves the whole chain)
 _SHARED_ATTRS = (
     "infos", "dims", "chip_count", "slice_names", "node_coords", "coord_ok",
     "_lock", "_memo", "_gang_cache", "_renderer_box", "out_feas",
     "out_score", "c_dims", "c_demands", "_perf", "_rev_counter",
+    "_model", "_model_box", "generations", "gen_idx", "c_base_q",
 )
+
+
+class _ModelMirror:
+    """One write-once quantized snapshot of the throughput model's
+    contention state, laid out for the native call (ABI 7) and stamped
+    with the model ``version`` it mirrors: ``cont_sum``/``cont_cnt`` are
+    per-candidate int32 arrays (Q16 per-card EWMA sum, calibrated card
+    count; count 0 = uncalibrated, the native formula falls back to the
+    view's ``load_q`` rows). Published copy-on-write into the chain's
+    shared ``_model_box`` under the arena lock — readers mid-call keep
+    the mirror they captured, exactly the RCU discipline the row arrays
+    already follow — and retired by version compare on the next call
+    after any model mutation (one resync per metric-sync batch, since
+    sweeps batch their observes between reads)."""
+
+    __slots__ = ("version", "cont_sum", "cont_cnt")
 
 
 class BatchScorer:
     """Flattened state for one (ordered) candidate list of a uniform pool.
 
     Built when: the native library is loadable, every candidate has the
-    same torus dims/chip count (<= 64 chips), and the rater is binpack or
-    spread — the Dealer falls back to the per-node path otherwise.
+    same torus dims/chip count (<= 64 chips), and the rater is binpack,
+    spread, or a model rater (throughput — ``model`` carries its
+    ThroughputModel and native calls evaluate the quantized fixed-point
+    formula in C, ABI 7) — the Dealer falls back to the per-node path
+    otherwise.
     """
 
     @staticmethod
     def build(infos: list[NodeInfo],
-              perf: PerfCounters | None = None) -> "BatchScorer | None":
+              perf: PerfCounters | None = None,
+              model=None) -> "BatchScorer | None":
         if not infos or not native.available():
             return None
         dims = infos[0].chips.torus.dims
@@ -87,10 +112,10 @@ class BatchScorer:
         for info in infos:
             if info.chips.torus.dims != dims or info.chip_count != count:
                 return None  # heterogeneous pool
-        return BatchScorer(infos, dims, count, perf=perf)
+        return BatchScorer(infos, dims, count, perf=perf, model=model)
 
     def __init__(self, infos: list[NodeInfo], dims, chip_count: int,
-                 perf: PerfCounters | None = None):
+                 perf: PerfCounters | None = None, model=None):
         self.infos = infos
         self.dims = tuple(dims)
         self.chip_count = chip_count
@@ -103,8 +128,36 @@ class BatchScorer:
         self.free = (ctypes.c_int32 * (n * c))()
         self.total = (ctypes.c_int32 * (n * c))()
         self.load = (ctypes.c_double * (n * c))()
+        #: Q16-quantized mirror of ``load`` — the fixed-point formula's
+        #: uncalibrated-contention fallback (quantized at row-copy time,
+        #: the same float→int edge the per-node path applies, so hook /
+        #: native / per-node consume identical integers)
+        self.load_q = (ctypes.c_int32 * (n * c))()
         self.hbm = (ctypes.c_int32 * (n * c))()  # -1 == untracked
         self.versions: list[int | None] = [None] * n
+        #: throughput model (ABI 7) or None; with a model, native calls
+        #: pass the quantized mirror and evaluate the model formula in C
+        self._model = model
+        #: [mirror or None] — chain-shared box, swapped copy-on-write
+        #: under the arena lock (see _ModelMirror)
+        self._model_box: list = [None]
+        # generation index: per-row indirection into the per-call
+        # base_q array (generations are static for a NodeInfo's life,
+        # so this is write-once chain state like the coords)
+        gens: list[str] = []
+        gen_index: dict[str, int] = {}
+        self.gen_idx = (ctypes.c_int32 * n)()
+        for idx, info in enumerate(infos):
+            g = info.generation
+            i = gen_index.get(g)
+            if i is None:
+                i = gen_index[g] = len(gens)
+                gens.append(g)
+            self.gen_idx[idx] = i
+        self.generations = gens
+        #: per-call scratch: quantized base fraction per generation for
+        #: the current demand's shape (filled under the arena lock)
+        self.c_base_q = (ctypes.c_int32 * max(len(gens), 1))()
         #: nodeinfo.state_generation() at last refresh; -1 forces the
         #: first refresh to probe every row (standalone mode only)
         self._last_state_gen = -1
@@ -171,6 +224,7 @@ class BatchScorer:
                     self.free[base + j] = chip.percent_free
                     self.total[base + j] = chip.percent_total
                     self.load[base + j] = chip.load
+                    self.load_q[base + j] = quantize(chip.load)
                     self.hbm[base + j] = (
                         chip.hbm_free_mib if chip.hbm_total_mib else -1
                     )
@@ -207,10 +261,12 @@ class BatchScorer:
         new.free = (ctypes.c_int32 * (n * c))()
         new.total = (ctypes.c_int32 * (n * c))()
         new.load = (ctypes.c_double * (n * c))()
+        new.load_q = (ctypes.c_int32 * (n * c))()
         new.hbm = (ctypes.c_int32 * (n * c))()
         ctypes.memmove(new.free, self.free, ctypes.sizeof(self.free))
         ctypes.memmove(new.total, self.total, ctypes.sizeof(self.total))
         ctypes.memmove(new.load, self.load, ctypes.sizeof(self.load))
+        ctypes.memmove(new.load_q, self.load_q, ctypes.sizeof(self.load_q))
         ctypes.memmove(new.hbm, self.hbm, ctypes.sizeof(self.hbm))
         new.versions = list(self.versions)
         new._copy_row_range(changed)
@@ -285,26 +341,90 @@ class BatchScorer:
                 self._gang_cache.pop(next(iter(self._gang_cache)))
         return gang, gang_sig
 
-    def _memo_key(self, demand, prefer_used: bool, gang_sig):
-        return (demand.hash(), prefer_used, self.state_rev, gang_sig)
+    def _memo_key(self, demand, prefer_used: bool, gang_sig, model_rev):
+        return (
+            demand.hash(), prefer_used, self.state_rev, gang_sig, model_rev
+        )
+
+    def _sync_model_locked(self) -> _ModelMirror:
+        """Rebuild the quantized model mirror copy-on-write (caller
+        holds the arena lock). One :meth:`ThroughputModel.mirror_snapshot`
+        — a single model-lock hold for the whole candidate list, the
+        same discipline as the hook's ``contention_q_many`` — then the
+        fresh arrays swap into the chain-shared box. Counted as
+        ``model_syncs``: between metric-sync batches the version compare
+        short-circuits and this never runs."""
+        version, table = self._model.mirror_snapshot(
+            [info.name for info in self.infos]
+        )
+        n = len(self.infos)
+        mirror = _ModelMirror()
+        mirror.version = version
+        mirror.cont_sum = (ctypes.c_int32 * max(n, 1))()
+        mirror.cont_cnt = (ctypes.c_int32 * max(n, 1))()
+        for i, info in enumerate(self.infos):
+            entry = table.get(info.name)
+            if entry is not None:
+                mirror.cont_sum[i] = entry[0]
+                mirror.cont_cnt[i] = entry[1]
+        self._model_box[0] = mirror
+        self._perf.model_syncs += 1
+        return mirror
+
+    def _ensure_mirror_locked(self) -> _ModelMirror:
+        """Current model mirror, resynced if the model version moved
+        (caller holds the arena lock)."""
+        mirror = self._model_box[0]
+        if mirror is None or mirror.version != self._model.version:
+            mirror = self._sync_model_locked()
+        return mirror
+
+    def _model_args_locked(self, demand, mirror: _ModelMirror):
+        """The native model tuple for one call (caller holds the arena
+        lock): resolve this demand's shape against the table into the
+        per-generation base array (O(#generations) Python — the per-ROW
+        work all happens in C). Called only when a native call will
+        actually run; memo hits skip the table resolution entirely."""
+        base = self._model.base_q_for(demand, self.generations)
+        self.c_base_q[: len(base)] = base
+        return (
+            self.gen_idx, self.c_base_q, len(self.generations),
+            mirror.cont_sum, mirror.cont_cnt, self.load_q,
+        )
 
     def _prepare_locked(self, demand, prefer_used: bool, member_slices):
         """The shared pre-native protocol (caller holds the arena lock):
-        refresh in standalone mode, resolve the gang encoding, probe the
-        one-slot memo. Returns ``(gang, key, have_scores)``; when
+        refresh in standalone mode, resolve the gang encoding, sync the
+        model mirror (model raters only), probe the one-slot memo.
+        Returns ``(gang, key, have_scores, model_args)``; when
         ``have_scores`` is False the memo has been cleared (the arena is
         about to be overwritten) and the caller must ``_commit_memo(key)``
         after a successful native call. One copy of this invariant — the
-        list path and the fused render path must never drift."""
+        list path and the fused render path must never drift. The memo
+        key carries the mirror version: model scores may move without a
+        row bump (a calibration sample), and a key that ignored that
+        would serve pre-sync scores — exactly the staleness the model's
+        cache token exists to kill."""
         if self._mutable:
             self._refresh()
         gang, gang_sig = self._gang_of(member_slices)
-        key = self._memo_key(demand, prefer_used, gang_sig)
+        mirror = None
+        if self._model is not None:
+            mirror = self._ensure_mirror_locked()
+        key = self._memo_key(
+            demand, prefer_used, gang_sig,
+            mirror.version if mirror is not None else None,
+        )
         if self._memo[0] == key:
             self._perf.memo_hits += 1
-            return gang, key, True
+            # arena already holds this exact result; model args unused
+            return gang, key, True, None
         self._memo[0] = None  # arena about to be overwritten
-        return gang, key, False
+        model_args = (
+            self._model_args_locked(demand, mirror)
+            if mirror is not None else None
+        )
+        return gang, key, False, model_args
 
     def _commit_memo(self, key) -> None:
         """Record a completed native call's result as the arena's memo
@@ -317,7 +437,7 @@ class BatchScorer:
         shared ``out_feas``/``out_score`` arena (valid until the next
         native call in this chain — callers copy or render under the same
         lock hold)."""
-        gang, key, have_scores = self._prepare_locked(
+        gang, key, have_scores, model_args = self._prepare_locked(
             demand, prefer_used, member_slices
         )
         if have_scores:
@@ -331,6 +451,7 @@ class BatchScorer:
                 demand.hbm_of(i) for i in range(len(demand.percents))
             ],
             out=(self.out_feas, self.out_score),
+            model=model_args,
         )
         self._commit_memo(key)
         return feas, score
@@ -344,18 +465,21 @@ class BatchScorer:
     ) -> tuple[list[bool], list[int]]:
         """(feasible per node, final score per node) in candidate order.
 
-        ``score_hook`` is the Python-side scoring path for raters the
-        native engine cannot express (the throughput rater,
-        docs/scoring.md): feasibility still comes from the (memoized)
-        native call — placement feasibility is rater-independent — but
-        the returned scores are ``score_hook(self, demand, feasible)``
-        over this view's frozen row arrays. Hook results are computed
-        fresh on every call and NEVER land in the arena memo: the hook
-        reads live model state (the contention EWMA) that moves without
-        a row version bump, so memoizing it would serve pre-sync scores
-        — exactly the staleness the model's cache token exists to kill.
-        The native feasibility/score arena stays memoized as usual (it
-        depends only on rows)."""
+        ``score_hook`` is the Python-side scoring fallback for raters
+        whose model the native engine cannot (or may not) evaluate
+        (``NANOTPU_NATIVE_MODEL=0`` — docs/scoring.md): feasibility
+        still comes from the (memoized) native call — placement
+        feasibility is rater-independent — but the returned scores are
+        ``score_hook(self, demand, feasible)`` over this view's frozen
+        row arrays. Hook results are computed fresh on every call and
+        NEVER land in the arena memo: the hook reads live model state
+        (the contention EWMA) that moves without a row version bump, so
+        memoizing it would serve pre-sync scores — exactly the
+        staleness the model's cache token exists to kill. The NATIVE
+        model path (``model`` set, no hook) has no such problem: its
+        memo key carries the mirror version, so its scores memoize like
+        any other native result and retire the instant the model
+        moves."""
         with self._lock:
             feas, score = self._run_locked(demand, prefer_used, member_slices)
             n = len(self.infos)
@@ -423,7 +547,7 @@ class BatchScorer:
             r = self._renderer_box[0]
             if r is None:
                 return None
-            gang, key, have_scores = self._prepare_locked(
+            gang, key, have_scores, model_args = self._prepare_locked(
                 demand, prefer_used, member_slices
             )
             try:
@@ -435,6 +559,7 @@ class BatchScorer:
                     self.out_feas, self.out_score, have_scores, mode,
                     r[1], r[2], r[3], r[4], r[5], r[6], r[7],
                     demands_buf=self.c_demands,
+                    model=model_args,
                 )
             except native.NativeUnavailable:
                 return None

@@ -43,11 +43,12 @@ annotations, and a restarted dealer replays them (dealer.go:58-72,279-299).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
-from nanotpu import types
+from nanotpu import native, types
 from nanotpu.allocator.core import Demand, Plan
 from nanotpu.analysis.witness import make_lock, make_rlock
 from nanotpu.allocator.rater import Rater
@@ -184,14 +185,34 @@ class Dealer:
         self.client = client
         self.rater = rater
         #: rater integration hooks, resolved once (the rater is fixed for
-        #: the dealer's lifetime). ``_batch_hook`` is the Python-side
-        #: batch row scorer for raters the native engine cannot express
-        #: (throughput, docs/scoring.md): feasibility still runs native,
-        #: scores come from the hook over the frozen rows. ``_rater_
-        #: observe`` taps every per-card usage write for online
-        #: contention calibration; ``_rater_forget`` drops a removed
-        #: node's calibration state.
+        #: the dealer's lifetime). ``_native_model`` is the rater's
+        #: ThroughputModel when the native engine can evaluate its
+        #: formula in C (ABI 7, docs/scoring.md): scoring views mirror
+        #: the model's quantized state and the fused score+render path
+        #: serves the rater like any other — ``NANOTPU_NATIVE_MODEL=0``
+        #: forces the Python row hook instead. ``_batch_hook`` is that
+        #: Python-side batch row scorer (the reference implementation,
+        #: and the fallback when the native model path is off):
+        #: feasibility still runs native, scores come from the hook over
+        #: the frozen rows, and the fused path is refused
+        #: (``perf.hook_refusals``). ``_rater_observe`` taps every
+        #: per-card usage write for online contention calibration;
+        #: ``_rater_forget`` drops a removed node's calibration state.
         self._batch_hook = getattr(rater, "batch_score_rows", None)
+        nm_fn = getattr(rater, "native_model", None)
+        self._native_model = (
+            nm_fn()
+            if nm_fn is not None
+            and os.environ.get("NANOTPU_NATIVE_MODEL", "1") != "0"
+            and native.available()
+            else None
+        )
+        #: True exactly when batch scoring must route through the Python
+        #: hook (and the fused path must refuse): a hook rater whose
+        #: model the native engine cannot (or may not) evaluate
+        self._hook_active = (
+            self._batch_hook is not None and self._native_model is None
+        )
         self._rater_observe = getattr(rater, "observe_usage", None)
         self._rater_forget = getattr(rater, "forget_node", None)
         self.usage = usage or UsageStore()
@@ -874,7 +895,8 @@ class Dealer:
             return None  # cold candidates: take the warming per-node path
         known = [(n, info) for n, info in pairs if info is not None]
         infos = [info for _, info in known]
-        scorer = BatchScorer.build(infos, perf=perf)
+        scorer = BatchScorer.build(infos, perf=perf,
+                                   model=self._native_model)
         if scorer is None:
             return None
         scorer.freeze()
@@ -1072,12 +1094,16 @@ class Dealer:
                 f"shards={len(resolved)} "
                 f"rows={sum(len(item[2]) for item in resolved)}",
             )
-        runs = self._run_shards(resolved, demand, prefer,
-                                member_slices or None,
-                                score_hook=self._batch_hook)
+        runs = self._run_shards(
+            resolved, demand, prefer, member_slices or None,
+            score_hook=self._batch_hook if self._hook_active else None,
+        )
+        # native-path scores (default raters AND native-model raters)
+        # arrive with the gang bonus folded in; only hook scores need
+        # the Python-side fold
         gs = (
             GangScorer(member_slices)
-            if self._batch_hook is not None and member_slices else None
+            if self._hook_active and member_slices else None
         )
         out = [types.SCORE_MIN] * len(node_names)
         for item, (_feasible, scores) in zip(resolved, runs):
@@ -1106,11 +1132,13 @@ class Dealer:
                 member = self._gang_member_slices(pod) or None
                 runs = self._run_shards(
                     resolved, demand, prefer, member,
-                    score_hook=self._batch_hook,
+                    score_hook=(
+                        self._batch_hook if self._hook_active else None
+                    ),
                 )
                 gs = (
                     GangScorer(member)
-                    if self._batch_hook is not None and member else None
+                    if self._hook_active and member else None
                 )
                 lists = []
                 for item, (feasible, scores) in zip(resolved, runs):
@@ -1142,14 +1170,6 @@ class Dealer:
     # every-32nd-cycle cross-check.
 
     def _payload_plan(self, node_names: list[str], pod: Pod):
-        if self._batch_hook is not None:
-            # explicit fused-path refusal (docs/scoring.md): the native
-            # renderer cannot evaluate a Python-side score hook, and a
-            # half-fused answer would desync Filter from Prioritize. The
-            # verb falls back to the render-cached list path — same wire
-            # shape, zero view/renderer rebuilds — and the miss counter
-            # makes the refusal visible in the bench attribution.
-            return None
         demand = self._demand_of(pod)
         if not demand.is_valid():
             return None
@@ -1174,11 +1194,6 @@ class Dealer:
         anything else returns None and the verb takes the merged list
         path, which produces the same bytes through the render caches.
         ``mode`` 0 = ExtenderFilterResult, 1 = HostPriorityList."""
-        if self._batch_hook is not None:
-            # same explicit refusal as _payload_plan: hook raters answer
-            # through the merged list path (byte-identical wire shape)
-            self.perf.fastpath_misses += 1
-            return None
         demand = self._demand_of(pod)
         plan = self._shard_plan(node_names) if demand.is_valid() else None
         if plan is None:
@@ -1219,6 +1234,18 @@ class Dealer:
 
     def filter_payload(self, node_names: list[str], pod: Pod) -> bytes | None:
         """ExtenderFilterResult JSON bytes, or None -> use assume()."""
+        if self._hook_active:
+            # explicit fused-path refusal (docs/scoring.md): the native
+            # renderer cannot evaluate a Python-side score hook, and a
+            # half-fused answer would desync Filter from Prioritize. The
+            # verb falls back to the render-cached list path — same wire
+            # shape, zero view/renderer rebuilds. Counted as a DEDICATED
+            # refusal, not a generic miss: "the rater opted out by
+            # design" and "the fast path failed" must be different
+            # numbers in the bench attribution. Native-model raters
+            # (ABI 7) never land here — the fused path serves them.
+            self.perf.hook_refusals += 1
+            return None
         if self._shard_fn is not None:
             return self._sharded_payload(node_names, pod, 0)
         plan = self._payload_plan(node_names, pod)
@@ -1239,6 +1266,9 @@ class Dealer:
         self, node_names: list[str], pod: Pod
     ) -> bytes | None:
         """HostPriorityList JSON bytes, or None -> use score()."""
+        if self._hook_active:
+            self.perf.hook_refusals += 1
+            return None
         if self._shard_fn is not None:
             return self._sharded_payload(node_names, pod, 1)
         plan = self._payload_plan(node_names, pod)
@@ -1413,9 +1443,11 @@ class Dealer:
             bscorer, names_key, _non_tpu, prefer = batch
             if trace is not None:
                 trace.event("native:batch-score", f"rows={len(names_key)}")
-            _, scores = bscorer.run(demand, prefer, member_slices or None,
-                                    score_hook=self._batch_hook)
-            if self._batch_hook is not None and member_slices:
+            _, scores = bscorer.run(
+                demand, prefer, member_slices or None,
+                score_hook=self._batch_hook if self._hook_active else None,
+            )
+            if self._hook_active and member_slices:
                 scores = self._hook_gang_bonus(
                     bscorer, scores, GangScorer(member_slices)
                 )

@@ -43,6 +43,8 @@ class PerfCounters:
         "publish_skips",
         "publish_coalesced",
         "gang_batched_commits",
+        "hook_refusals",
+        "model_syncs",
     )
 
     def __init__(self):
@@ -80,6 +82,16 @@ class PerfCounters:
         #: bounded commit pool (vs committed one-at-a-time on the member's
         #: own bind thread)
         self.gang_batched_commits = 0
+        #: fused-path refusals because the rater scores through a Python
+        #: row hook the native renderer cannot evaluate (docs/scoring.md)
+        #: — split out of fastpath_misses so "the rater opted out" and
+        #: "the fast path failed" are different numbers; the bench's
+        #: native-throughput row asserts this stays ZERO
+        self.hook_refusals = 0
+        #: throughput-model mirror rebuilds in the scoring arena (ABI 7):
+        #: one per model-version movement per view chain — a metric-sync
+        #: batch costs one, a steady read window costs none
+        self.model_syncs = 0
 
     def snapshot(self) -> dict[str, int]:
         """Point-in-time copy (bench delta arithmetic / metrics render)."""

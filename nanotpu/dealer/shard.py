@@ -151,7 +151,11 @@ def merge_top_k(scored_lists, k: int | None = None) -> list[tuple[str, int]]:
     lists: score descending, then name ascending — a total order with no
     hash-dependent ties, so the merge is independent of shard count,
     shard iteration order, and per-shard list order. ``k=None`` returns
-    the full merged ranking."""
+    the full merged ranking. The order is byte-stable across shard
+    splits for EVERY rater because scores are bit-deterministic
+    integers — including the throughput model since ABI 7, whose
+    fixed-point native evaluation (docs/scoring.md) leaves no float
+    rounding for a platform or shard boundary to perturb."""
     merged: list[tuple[str, int]] = []
     for scored in scored_lists:
         merged.extend(scored)

@@ -31,7 +31,7 @@ _SRC = os.path.join(_REPO_ROOT, "native", "allocator.cc")
 _LIB = os.path.join(_PKG_DIR, "libnanotpu_alloc.so")
 
 #: must match nanotpu_abi_version() in allocator.cc
-ABI_VERSION = 6
+ABI_VERSION = 7
 
 _lock = make_lock("native._lock")
 _lib: ctypes.CDLL | None = None
@@ -137,6 +137,14 @@ def _load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_int32),  # out_score [n]
             ctypes.POINTER(ctypes.c_int32),  # hbm_free [n*chips] (nullable)
             ctypes.POINTER(ctypes.c_int32),  # hbm_demand (nullable)
+            # throughput-model mirror (ABI 7, docs/scoring.md); all
+            # nullable — model_gen non-null selects the model formula
+            ctypes.POINTER(ctypes.c_int32),  # model_gen [n]
+            ctypes.POINTER(ctypes.c_int32),  # model_base_q [n_gens]
+            ctypes.c_int32,  # model_n_gens
+            ctypes.POINTER(ctypes.c_int32),  # model_cont_sum [n]
+            ctypes.POINTER(ctypes.c_int32),  # model_cont_cnt [n]
+            ctypes.POINTER(ctypes.c_int32),  # model_load_q [n*chips]
         ]
         lib.nanotpu_score_render.restype = ctypes.c_int32
         lib.nanotpu_score_render.argtypes = (
@@ -144,6 +152,12 @@ def _load() -> ctypes.CDLL | None:
             + [
                 ctypes.POINTER(ctypes.c_int32),  # hbm_free (nullable)
                 ctypes.POINTER(ctypes.c_int32),  # hbm_demand (nullable)
+                ctypes.POINTER(ctypes.c_int32),  # model_gen [n]
+                ctypes.POINTER(ctypes.c_int32),  # model_base_q [n_gens]
+                ctypes.c_int32,  # model_n_gens
+                ctypes.POINTER(ctypes.c_int32),  # model_cont_sum [n]
+                ctypes.POINTER(ctypes.c_int32),  # model_cont_cnt [n]
+                ctypes.POINTER(ctypes.c_int32),  # model_load_q [n*chips]
                 ctypes.POINTER(ctypes.c_uint8),  # feas arena (in/out)
                 ctypes.POINTER(ctypes.c_int32),  # score arena (in/out)
                 ctypes.c_int32,  # have_scores
@@ -207,6 +221,7 @@ def score_batch(
     hbm_flat=None,
     hbm_demand: list[int] | None = None,
     out=None,
+    model=None,
 ):
     """Feasibility + final score for every node of a uniform pool in ONE
     native call (Filter/Prioritize fan-out without per-node overhead).
@@ -219,6 +234,11 @@ def score_batch(
     gang members' host cells per slice. ``out``: optional
     ``(feasible u8 array, score i32 array)`` arena reused across calls
     (the caller owns synchronization); None allocates fresh buffers.
+    ``model``: None (default rater formula), or a tuple ``(gen_of,
+    base_q_by_gen, n_gens, cont_sum, cont_cnt, load_q)`` of ctypes arrays
+    — the quantized throughput-model mirror (ABI 7, docs/scoring.md)
+    selecting the fixed-point ``base − contention + fragmentation``
+    formula instead.
 
     Returns (feasible: ctypes u8 array, score: ctypes i32 array); raises
     :class:`NativeUnavailable` when the caller should fall back.
@@ -238,6 +258,7 @@ def score_batch(
         g = (None, None, None, 0, None, None)
     else:
         g = gang
+    m = model if model is not None else (None, None, 0, None, None, None)
     c_hbmd = (
         (ctypes.c_int32 * max(nd, 1))(*hbm_demand)
         if hbm_demand and any(hbm_demand) else None
@@ -248,6 +269,7 @@ def score_batch(
         g[0], g[1], g[2], g[3], g[4], g[5],
         out_feasible, out_score,
         hbm_flat if c_hbmd is not None else None, c_hbmd,
+        m[0], m[1], m[2], m[3], m[4], m[5],
     )
     if rc != OK:
         raise NativeUnavailable(f"native score_batch error {rc}")
@@ -278,6 +300,7 @@ def score_render(
     fail_off,
     out_buf,
     demands_buf=None,
+    model=None,
 ) -> bytes:
     """Fused score+render: ONE native crossing turns a (demand, snapshot)
     pair into the full response body. ``feas``/``score`` are the caller's
@@ -285,7 +308,9 @@ def score_render(
     the arena as-is — the Filter->Prioritize memo). ``mode`` 0 renders the
     ExtenderFilterResult, 1 the HostPriorityList. ``demands_buf`` is an
     optional reusable ``c_int32`` arena (>= len(demands)); None allocates.
-    Raises :class:`NativeUnavailable` when the caller should fall back."""
+    ``model`` selects the throughput-model scoring formula (same tuple as
+    :func:`score_batch` — ABI 7). Raises :class:`NativeUnavailable` when
+    the caller should fall back."""
     lib = _load()
     if lib is None:
         raise NativeUnavailable("native allocator unavailable")
@@ -296,6 +321,7 @@ def score_render(
     else:
         c_demands = (ctypes.c_int32 * max(nd, 1))(*demands)
     g = gang if gang is not None else (None, None, None, 0, None, None)
+    m = model if model is not None else (None, None, 0, None, None, None)
     c_hbmd = (
         (ctypes.c_int32 * max(nd, 1))(*hbm_demand)
         if hbm_demand and any(hbm_demand) else None
@@ -305,6 +331,7 @@ def score_render(
         1 if prefer_used else 0, percent_per_chip,
         g[0], g[1], g[2], g[3], g[4], g[5],
         hbm_flat if c_hbmd is not None else None, c_hbmd,
+        m[0], m[1], m[2], m[3], m[4], m[5],
         feas, score, 1 if have_scores else 0, mode,
         qnames, qoff, prio_frags, prio_off, fail_frags, fail_off,
         None, 0, out_buf, len(out_buf),

@@ -189,7 +189,10 @@ class DecisionLedger:
         """Attach per-candidate per-TERM score breakdowns (base /
         contention / fragmentation / gang / total) to the pod's cycle —
         the ledger's proof of WHY the winning node outranked the rest
-        (docs/scoring.md)."""
+        (docs/scoring.md). The terms are reconstructed from the SAME
+        fixed-point integers the native scoring path evaluates (ABI 7),
+        so ``total`` equals the wire score to the byte even though the
+        wire score was computed in C."""
         if not terms:
             return
         with self._lock:

@@ -401,12 +401,55 @@ int clamp_score(double s) {
   return v;
 }
 
+// -- throughput-model scoring (ABI 7, docs/scoring.md) -------------------
+//
+// The fixed-point mirror of allocator/throughput.py Throughput._combine:
+// base − contention + fragmentation over Q16-quantized inputs, pure
+// integer arithmetic. Python quantizes at the float/int edge (quantize());
+// this file never touches a float for the model path, so the two
+// implementations cannot round apart — the fuzz pin in
+// tests/test_throughput.py holds them bit-equal. Every division below is
+// C truncating division of non-negative operands == Python floor division
+// on the same integers. Constants mirror throughput.py's band split and
+// MUST move in lockstep with it.
+constexpr int kBaseBand = 70;        // throughput.py BASE_BAND
+constexpr int kContentionBand = 20;  // throughput.py CONTENTION_BAND
+constexpr int kFragBand = 10;        // throughput.py FRAG_BAND
+constexpr int64_t kQOne = 1 << 16;   // throughput.py Q_ONE (Q16)
+
+// One node's model score (gang bonus excluded — the caller folds it in
+// exactly like the rater path). cont_cnt == 0 means uncalibrated: fall
+// back to the quantized instantaneous per-card loads, the same integers
+// the Python hook reads from the view's load_q rows.
+int model_score(const int32_t* free_n, const int32_t* total_n,
+                const int32_t* load_q_n, int n_chips,
+                int64_t base_q, int64_t cont_sum, int64_t cont_cnt) {
+  if (cont_cnt <= 0) {
+    cont_sum = 0;
+    for (int c = 0; c < n_chips; ++c) cont_sum += load_q_n[c];
+    cont_cnt = n_chips;
+  }
+  int64_t contention =
+      cont_cnt ? (kContentionBand * cont_sum) / (cont_cnt * kQOne) : 0;
+  int64_t free_pct = 0, whole_free = 0;
+  for (int c = 0; c < n_chips; ++c) {
+    free_pct += free_n[c];
+    if (free_n[c] == total_n[c] && total_n[c] > 0) whole_free += free_n[c];
+  }
+  int64_t frag = free_pct ? (kFragBand * whole_free) / free_pct : 0;
+  int64_t base = (kBaseBand * base_q) / kQOne;
+  int64_t score = base - contention + frag;
+  if (score < 0) score = 0;      // types.SCORE_MIN
+  if (score > 100) score = 100;  // types.SCORE_MAX
+  return static_cast<int>(score);
+}
+
 }  // namespace
 
 extern "C" {
 
 // ABI version so the ctypes loader can reject stale builds.
-int32_t nanotpu_abi_version() { return 6; }
+int32_t nanotpu_abi_version() { return 7; }
 
 // Place `n_demands` container demands onto one node's torus.
 //
@@ -485,9 +528,22 @@ int32_t nanotpu_choose(const int32_t dims[3],
 //   out_score[n_nodes]          rater score + compactness band + gang
 //                               bonus, clamped to [0, 100] (SCORE_MIN for
 //                               infeasible nodes)
+//   model inputs (ABI 7, all null when the default rater formula runs;
+//   model_gen non-null selects the throughput-model formula instead —
+//   docs/scoring.md):
+//     model_gen[n_nodes]        index into model_base_q (the node's
+//                               generation; static per view)
+//     model_base_q[n_gens]      Q16 base fraction per generation for THIS
+//                               demand's shape (resolved in Python)
+//     model_cont_sum[n_nodes] / model_cont_cnt[n_nodes]
+//                               quantized per-card contention EWMA sum +
+//                               calibrated card count (0 = uncalibrated,
+//                               fall back to model_load_q)
+//     model_load_q[n_nodes*chips]  Q16 instantaneous per-card loads
 //
 // Parity: out_feasible matches NodeInfo.assume != None and out_score
-// matches Dealer.score per node — fuzz-enforced in tests/test_native.py.
+// matches Dealer.score per node — fuzz-enforced in tests/test_native.py
+// (default formula) and tests/test_throughput.py (model formula).
 int32_t nanotpu_score_batch(const int32_t dims[3],
                             int32_t n_nodes,
                             const int32_t* free_percent,
@@ -506,10 +562,21 @@ int32_t nanotpu_score_batch(const int32_t dims[3],
                             uint8_t* out_feasible,
                             int32_t* out_score,
                             const int32_t* hbm_free,
-                            const int32_t* hbm_demand) {
+                            const int32_t* hbm_demand,
+                            const int32_t* model_gen,
+                            const int32_t* model_base_q,
+                            int32_t model_n_gens,
+                            const int32_t* model_cont_sum,
+                            const int32_t* model_cont_cnt,
+                            const int32_t* model_load_q) {
   if (!dims || !free_percent || !total_percent || !load || !demands ||
       !out_feasible || !out_score || n_nodes < 0 || n_demands < 0 ||
       percent_per_chip <= 0)
+    return NANOTPU_ERR_BAD_ARGS;
+  // model mode needs the whole mirror; a half-wired caller must fall
+  // back to Python rather than score against garbage
+  if (model_gen && (!model_base_q || model_n_gens <= 0 ||
+                    !model_cont_sum || !model_cont_cnt || !model_load_q))
     return NANOTPU_ERR_BAD_ARGS;
   Torus t(dims);
   if (t.n <= 0 || t.n > kMaxChips) return NANOTPU_ERR_TOO_BIG;
@@ -606,6 +673,24 @@ int32_t nanotpu_score_batch(const int32_t dims[3],
     }
     if (rc != NANOTPU_OK) return rc;
     out_feasible[nidx] = 1;
+
+    if (model_gen) {
+      // throughput-model formula (ABI 7): base − contention +
+      // fragmentation over the quantized mirror, then the gang bonus
+      // folded in exactly as the Python hook path does
+      // (Dealer._hook_gang_bonus: min(SCORE_MAX, score + bonus))
+      int gidx = model_gen[nidx];
+      int64_t base_q =
+          (gidx >= 0 && gidx < model_n_gens) ? model_base_q[gidx] : 0;
+      int score = model_score(free_n, total_n,
+                              model_load_q + (size_t)nidx * t.n, t.n,
+                              base_q, model_cont_sum[nidx],
+                              model_cont_cnt[nidx]);
+      score += gang_bonus(nidx);
+      if (score > 100) score = 100;
+      out_score[nidx] = score;
+      continue;
+    }
 
     // Rate on the PRE-assignment state (rater.py Binpack/Spread.rate)
     long total_sum = 0, used_sum = 0, avail = 0;
@@ -768,14 +853,17 @@ int32_t nanotpu_render_filter(const char* qnames,
   return w;
 }
 
-// Fused score + render (ABI 6): the per-request hot path of the
-// snapshot read side in ONE ctypes crossing. `feas`/`score` are the
-// caller's per-snapshot arena — written by the scoring pass and read by
-// the render pass; when `have_scores` is 1 (the sibling verb of the same
-// (pod, snapshot) already scored) the scoring pass is skipped entirely
-// and the arena contents are rendered as-is. `mode` 0 renders the
-// ExtenderFilterResult, 1 the HostPriorityList. Returns bytes written
-// into `out`, or a NANOTPU_ERR_* code.
+// Fused score + render (ABI 6; model inputs added in ABI 7): the
+// per-request hot path of the snapshot read side in ONE ctypes
+// crossing. `feas`/`score` are the caller's arena — written by the
+// scoring pass and read by the render pass; when `have_scores` is 1
+// (the sibling verb of the same (pod, snapshot) already scored) the
+// scoring pass is skipped entirely and the arena contents are rendered
+// as-is. The `model_*` inputs select the throughput-model formula (see
+// nanotpu_score_batch) — with them the fused path serves hook-free
+// model raters too. `mode` 0 renders the ExtenderFilterResult, 1 the
+// HostPriorityList. Returns bytes written into `out`, or a
+// NANOTPU_ERR_* code.
 int32_t nanotpu_score_render(const int32_t dims[3],
                              int32_t n_nodes,
                              const int32_t* free_percent,
@@ -793,6 +881,12 @@ int32_t nanotpu_score_render(const int32_t dims[3],
                              const int32_t* slice_cell_off,
                              const int32_t* hbm_free,
                              const int32_t* hbm_demand,
+                             const int32_t* model_gen,
+                             const int32_t* model_base_q,
+                             int32_t model_n_gens,
+                             const int32_t* model_cont_sum,
+                             const int32_t* model_cont_cnt,
+                             const int32_t* model_load_q,
                              uint8_t* feas,
                              int32_t* score,
                              int32_t have_scores,
@@ -816,7 +910,8 @@ int32_t nanotpu_score_render(const int32_t dims[3],
         dims, n_nodes, free_percent, total_percent, load, n_demands, demands,
         prefer_used, percent_per_chip, node_slice, node_coords, node_coord_ok,
         n_slices, slice_cells, slice_cell_off, feas, score, hbm_free,
-        hbm_demand);
+        hbm_demand, model_gen, model_base_q, model_n_gens, model_cont_sum,
+        model_cont_cnt, model_load_q);
     if (rc != NANOTPU_OK) return rc;
   }
   if (mode == 1)

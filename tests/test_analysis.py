@@ -323,6 +323,63 @@ class TestLockDiscipline:
             "_Shard._pending_lock" in f.message for f in report.findings
         ), report.findings
 
+    def test_blocking_under_model_lock_flagged(self, tmp_path):
+        # ThroughputModel._lock is the mirror-sync lock (docs/scoring.md
+        # ABI 7): the metric-sync writer holds it per observe and every
+        # scoring view's mirror resync snapshots under it, so it is in
+        # HOT_LOCKS — a blocking call inside it must be a finding
+        report = one(tmp_path, """
+            from nanotpu.analysis.witness import make_lock
+
+            class ThroughputModel:
+                def __init__(self):
+                    self._lock = make_lock("ThroughputModel._lock")
+
+                def observe_and_fetch(self):
+                    with self._lock:
+                        self.client.get_node("n")
+            """, "lock-discipline")
+        assert any(
+            "ThroughputModel._lock" in f.message and "blocking" in f.message
+            for f in report.findings
+        ), report.findings
+
+    def test_model_lock_arena_inversion_flagged(self, tmp_path):
+        # seeded inversion: production order is arena -> model lock
+        # (BatchScorer._sync_model_locked under the arena lock calls
+        # ThroughputModel.mirror_snapshot which takes the model lock);
+        # a model-side path that re-enters arena code under the model
+        # lock would complete the cycle — the pass must reject it
+        report = one(tmp_path, """
+            from nanotpu.analysis.witness import make_lock
+
+            class BatchScorer:
+                def __init__(self):
+                    self._lock = make_lock("BatchScorer.arena")
+
+            class ThroughputModel:
+                def __init__(self):
+                    self._lock = make_lock("ThroughputModel._lock")
+
+                def recalibrate(self, scorer: BatchScorer):
+                    with self._lock:
+                        with scorer._lock:
+                            pass
+
+            class Dealer:
+                def sync_model(self, scorer: BatchScorer,
+                               model: ThroughputModel):
+                    with scorer._lock:
+                        with model._lock:
+                            pass
+            """, "lock-discipline")
+        cycles = [f for f in report.findings if "cycle" in f.message]
+        assert any(
+            "ThroughputModel._lock" in f.message
+            and "BatchScorer._lock" in f.message
+            for f in cycles
+        ), report.findings
+
 
 # ---------------------------------------------------------------------------
 # snapshot-immutability
@@ -639,6 +696,39 @@ class TestMetricsCompleteness:
         msgs = [f.message for f in report.findings]
         assert any("ghosts" in m for m in msgs), msgs
         assert any("untracked" in m for m in msgs), msgs
+
+    def test_r9_attribution_counters_held_both_directions(self, tmp_path):
+        """The fastpath-miss split (hook_refusals) and the mirror-sync
+        counter (model_syncs) ride the same structural slots-vs-sites
+        check: a declared-but-never-bumped refusal counter, or a bumped-
+        but-undeclared sync counter, are both findings — in fixture and
+        (by the clean-tree test) on the production pair."""
+        report = lint(tmp_path, {
+            "perf.py": """
+                class PerfCounters:
+                    __slots__ = ("fastpath_misses", "hook_refusals",
+                                 "model_syncs")
+                """,
+            "dealer.py": """
+                class Dealer:
+                    def refuse(self):
+                        self.perf.fastpath_misses += 1
+                """,
+            "batch.py": """
+                class BatchScorer:
+                    def sync(self):
+                        self._perf.model_syncs += 1
+                        self._perf.mirror_rebuilds += 1
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        # declared, never bumped -> finding
+        assert any("hook_refusals" in m for m in msgs), msgs
+        # bumped, never declared -> finding
+        assert any("mirror_rebuilds" in m for m in msgs), msgs
+        # declared AND bumped -> clean
+        assert not any("model_syncs" in m for m in msgs), msgs
+        assert not any("fastpath_misses" in m for m in msgs), msgs
 
     # -- decision-audit reason codes (nanotpu/obs/decisions.py) ------------
     REASONS_DECL = """

@@ -382,22 +382,25 @@ class TestScoreTermsContract:
             s.close()
 
 
-class TestFusedRefusal:
-    def test_fused_path_refused_and_list_path_warm(self):
-        """The fused renderer cannot evaluate the model: every payload
-        call must refuse (counted as a miss), the dispatch answer stays
-        the normal wire shape, and steady-state requests do zero
-        view/renderer rebuilds — the refusal is a route change, not a
-        cache thrash."""
+class TestNativeFusedModel:
+    def test_fused_path_serves_model_rater(self):
+        """ABI 7 (docs/scoring.md): the fused native path evaluates the
+        quantized model formula in C, so a throughput dealer serves
+        Filter/Prioritize from ONE ctypes crossing like any default
+        rater — payload calls hit, no hook refusals, and steady-state
+        requests do zero view/renderer rebuilds."""
         if not native.available():
             pytest.skip("native allocator unavailable")
         s = _Stack()
         try:
+            assert s.dealer._native_model is s.dealer.rater.model
+            assert not s.dealer._hook_active
             pod = _pod(s.client, "p0", 100)
-            misses0 = s.dealer.perf.fastpath_misses
-            assert s.dealer.filter_payload(s.nodes, pod) is None
-            assert s.dealer.priorities_payload(s.nodes, pod) is None
-            assert s.dealer.perf.fastpath_misses == misses0 + 2
+            hits0 = s.dealer.perf.fastpath_hits
+            assert s.dealer.filter_payload(s.nodes, pod) is not None
+            assert s.dealer.priorities_payload(s.nodes, pod) is not None
+            assert s.dealer.perf.fastpath_hits == hits0 + 2
+            assert s.dealer.perf.hook_refusals == 0
             args = _args(pod, s.nodes)
             filt = json.loads(s.verb("/scheduler/filter", args))
             assert set(filt) == {"NodeNames", "FailedNodes", "Error"}
@@ -416,17 +419,317 @@ class TestFusedRefusal:
         finally:
             s.close()
 
-    def test_sharded_fused_path_also_refuses(self):
+    def test_native_path_matches_hook_path_bytes(self, monkeypatch):
+        """THE parity contract: the native fixed-point evaluation and
+        the Python row hook must answer byte-identically over the real
+        dispatch — filter AND priorities, with a calibrated contention
+        EWMA and a gang bonus in play."""
         if not native.available():
             pytest.skip("native allocator unavailable")
-        s = _Stack(shards="auto")
+        a = _Stack()  # native model path
+        monkeypatch.setenv("NANOTPU_NATIVE_MODEL", "0")
+        b = _Stack()  # forced Python hook path
+        try:
+            assert a.dealer._native_model is not None
+            assert b.dealer._native_model is None and b.dealer._hook_active
+            for s in (a, b):
+                for chip in range(4):
+                    s.dealer.update_chip_usage(
+                        "v5p-host-1", chip, core=0.7, now=50.0
+                    )
+            lead_a = _pod(a.client, "lead", 100, gang="gg")
+            lead_b = _pod(b.client, "lead", 100, gang="gg")
+            a.dealer.bind("v5p-host-0", lead_a)
+            b.dealer.bind("v5p-host-0", lead_b)
+            for percent in (50, 100, 400):
+                pod_a = _pod(a.client, f"p{percent}", percent, gang="gg")
+                pod_b = _pod(b.client, f"p{percent}", percent, gang="gg")
+                args_a, args_b = _args(pod_a, a.nodes), _args(pod_b, b.nodes)
+                assert a.verb("/scheduler/filter", args_a) == \
+                    b.verb("/scheduler/filter", args_b)
+                assert a.verb("/scheduler/priorities", args_a) == \
+                    b.verb("/scheduler/priorities", args_b)
+            # the two stacks really took different paths
+            assert a.dealer.perf.fastpath_hits > 0
+            assert a.dealer.perf.hook_refusals == 0
+            assert b.dealer.perf.fastpath_hits == 0
+            assert b.dealer.perf.hook_refusals > 0
+        finally:
+            a.close()
+            b.close()
+
+    def test_model_version_bump_retires_memo(self):
+        """A calibration sample between two Prioritize calls must change
+        the answer: the arena memo is keyed by the mirror version, so a
+        model-state move can never serve pre-sync scores."""
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        s = _Stack()
+        try:
+            pod = _pod(s.client, "p0", 100)
+            before = dict(s.dealer.score(s.nodes, pod))
+            syncs0 = s.dealer.perf.model_syncs
+            # calibrate one v5p node HOT: its score must drop
+            for chip in range(4):
+                s.dealer.update_chip_usage(
+                    "v5p-host-2", chip, core=1.0, now=10.0
+                )
+            after = dict(s.dealer.score(s.nodes, pod))
+            assert after["v5p-host-2"] < before["v5p-host-2"]
+            assert s.dealer.perf.model_syncs > syncs0
+        finally:
+            s.close()
+
+
+class TestFixedPointFuzz:
+    def test_native_scores_match_python_terms_exactly(self):
+        """Seeded property test for the ABI 7 parity contract
+        (docs/scoring.md): randomized tables, EWMA calibration states,
+        chip occupancy, demands, and gang bonuses — the native
+        fixed-point wire score must equal the Python ``_score_terms``
+        reconstruction EXACTLY (no tolerance: fixed point means there is
+        nothing to be approximately right about), including
+        SCORE_MIN/infeasible candidates, and the ledger breakdown's
+        ``total`` must equal the wire score for every candidate."""
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        import random
+
+        from nanotpu.dealer.gang import GangScorer
+
+        rng = random.Random(0xF1A7)
+        shapes = ["50", "100", "200", "400", "*"]
+        for round_no in range(6):
+            s = _Stack()
+            try:
+                model = s.dealer.rater.model
+                # randomized table + alpha (configure bumps the version,
+                # so cached plans/memos retire like a live reload)
+                entries = [
+                    ThroughputEntry(
+                        shape=rng.choice(shapes),
+                        slice_type=rng.choice(["v4", "v5p"]),
+                        value=round(rng.uniform(0.05, 2.0), 3),
+                    )
+                    for _ in range(rng.randint(0, 6))
+                ]
+                model.configure(ThroughputSpec(
+                    alpha=round(rng.uniform(0.05, 0.9), 3),
+                    entries=entries,
+                ))
+                # randomized calibration: some nodes hot, some never
+                # observed (the instantaneous-load fallback must agree
+                # too), EWMAs folded over several samples
+                for _ in range(rng.randint(0, 32)):
+                    s.dealer.update_chip_usage(
+                        rng.choice(s.nodes), rng.randrange(4),
+                        core=round(rng.random(), 4),
+                        now=float(rng.randrange(1, 100)),
+                    )
+                # randomized occupancy incl. full nodes -> infeasible
+                for i in range(rng.randint(0, 5)):
+                    victim = rng.choice(s.nodes)
+                    try:
+                        s.dealer.bind(victim, _pod(
+                            s.client, f"fill{round_no}-{i}",
+                            rng.choice([100, 200, 400]),
+                        ))
+                    except Exception:
+                        pass  # infeasible fill: fine, move on
+                # maybe a bound gang member so the bonus participates
+                gang = None
+                if rng.random() < 0.6:
+                    gang = f"g{round_no}"
+                    try:
+                        s.dealer.bind(rng.choice(s.nodes), _pod(
+                            s.client, f"lead{round_no}", 100, gang=gang,
+                        ))
+                    except Exception:
+                        gang = None
+                for probe_no in range(4):
+                    percent = rng.choice([20, 50, 100, 200, 400])
+                    pod = _pod(
+                        s.client, f"probe{round_no}-{probe_no}", percent,
+                        gang=gang,
+                    )
+                    demand = Demand.from_pod(pod)
+                    scored = dict(s.dealer.score(s.nodes, pod))  # native
+                    member = s.dealer._gang_member_slices(pod)
+                    gs = GangScorer(member) if member else None
+                    for name in s.nodes:
+                        info = s.dealer._nodes[name]
+                        if info.assume(demand, s.dealer.rater) is None:
+                            expect = types.SCORE_MIN
+                        else:
+                            expect = s.dealer.rater.rate_terms(
+                                info.chips, demand
+                            )["total"]
+                        if gs is not None:
+                            expect = min(
+                                types.SCORE_MAX,
+                                expect + gs.bonus(
+                                    info.slice_name, info.slice_coords
+                                ),
+                            )
+                        assert scored[name] == expect, (
+                            round_no, probe_no, name, percent,
+                        )
+                    # ledger contract: total == wire score, every
+                    # candidate, infeasible ones flagged
+                    terms = s.dealer.score_terms(s.nodes, pod)
+                    for name in s.nodes:
+                        assert terms[name]["total"] == scored[name], name
+            finally:
+                s.close()
+
+
+class TestHookRefusal:
+    def test_fused_path_refused_when_native_model_off(self, monkeypatch):
+        """With the native model path disabled the fused renderer cannot
+        evaluate the hook: every payload call refuses — counted as a
+        DEDICATED hook_refusal, NOT a generic fastpath miss (the
+        attribution split this counter exists for) — and the dispatch
+        answer keeps the normal wire shape with zero rebuilds."""
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        monkeypatch.setenv("NANOTPU_NATIVE_MODEL", "0")
+        s = _Stack()
         try:
             pod = _pod(s.client, "p0", 100)
             misses0 = s.dealer.perf.fastpath_misses
-            assert s.dealer.filter_payload(sorted(s.nodes), pod) is None
-            assert s.dealer.perf.fastpath_misses == misses0 + 1
+            refusals0 = s.dealer.perf.hook_refusals
+            assert s.dealer.filter_payload(s.nodes, pod) is None
+            assert s.dealer.priorities_payload(s.nodes, pod) is None
+            assert s.dealer.perf.hook_refusals == refusals0 + 2
+            assert s.dealer.perf.fastpath_misses == misses0
+            args = _args(pod, s.nodes)
+            filt = json.loads(s.verb("/scheduler/filter", args))
+            assert set(filt) == {"NodeNames", "FailedNodes", "Error"}
+            prio = json.loads(s.verb("/scheduler/priorities", args))
+            assert {p["Host"] for p in prio} == set(s.nodes)
+            builds0 = s.dealer.perf.view_builds
+            renders0 = s.dealer.perf.renderer_builds
+            for i in range(3):
+                p = _pod(s.client, f"w{i}", 100)
+                body = _args(p, s.nodes)
+                s.verb("/scheduler/filter", body)
+                s.verb("/scheduler/priorities", body)
+            assert s.dealer.perf.view_builds == builds0
+            assert s.dealer.perf.renderer_builds == renders0
         finally:
             s.close()
+
+    def test_sharded_fused_path_also_refuses(self, monkeypatch):
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        monkeypatch.setenv("NANOTPU_NATIVE_MODEL", "0")
+        s = _Stack(shards="auto")
+        try:
+            pod = _pod(s.client, "p0", 100)
+            refusals0 = s.dealer.perf.hook_refusals
+            assert s.dealer.filter_payload(sorted(s.nodes), pod) is None
+            assert s.dealer.perf.hook_refusals == refusals0 + 1
+        finally:
+            s.close()
+
+    def test_sharded_native_fused_matches_forced_hook(self, monkeypatch):
+        """Sharded fused splice parity: the per-shard native model
+        renders spliced bytewise must equal the forced-hook merged list
+        path over the same candidate order."""
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        a = _Stack(shards="auto")
+        monkeypatch.setenv("NANOTPU_NATIVE_MODEL", "0")
+        b = _Stack(shards="auto")
+        try:
+            nodes = sorted(a.nodes)  # contiguous per-family runs
+            pod_a = _pod(a.client, "p0", 100)
+            pod_b = _pod(b.client, "p0", 100)
+            fused = a.dealer.filter_payload(nodes, pod_a)
+            assert fused is not None
+            args_a = _args(pod_a, nodes)
+            args_b = _args(pod_b, nodes)
+            assert a.verb("/scheduler/filter", args_a) == \
+                b.verb("/scheduler/filter", args_b)
+            assert a.verb("/scheduler/priorities", args_a) == \
+                b.verb("/scheduler/priorities", args_b)
+        finally:
+            a.close()
+            b.close()
+
+
+class TestCloseHygiene:
+    def test_close_racing_metric_sync_never_leaks_mid_sync_mirror(self):
+        """``Dealer.close()`` racing a live metric-sync batch and a read
+        storm: nothing deadlocks, no exception escapes either loop, and
+        every published view's model-mirror box holds either None or a
+        FULLY-populated mirror whose version stamp corresponds to a
+        model version that really existed — a mirror is built complete
+        and swapped under the arena lock, never published half-filled,
+        and close() cannot interrupt that protocol."""
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        import threading
+        import time as _time
+
+        s = _Stack()
+        stop = threading.Event()
+        errors: list = []
+
+        def sync_loop():
+            i = 0
+            while not stop.is_set():
+                try:
+                    for chip in range(4):
+                        s.dealer.update_chip_usage(
+                            "v5p-host-0", chip, core=0.5,
+                            now=float(i), publish=False,
+                        )
+                    s.dealer.publish_usage(("v5p-host-0",))
+                except Exception as e:  # noqa: BLE001 — the assert IS
+                    errors.append(e)    # "nothing escapes"
+                i += 1
+
+        def read_loop():
+            pod = _pod(s.client, "r0", 100)
+            while not stop.is_set():
+                try:
+                    s.dealer.score(s.nodes, pod)
+                except RuntimeError:
+                    pass  # pool shut down mid-call by close(): allowed
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        threads = [
+            threading.Thread(target=sync_loop, daemon=True),
+            threading.Thread(target=read_loop, daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        _time.sleep(0.05)
+        s.dealer.close()  # mid-flight: the race under test
+        _time.sleep(0.02)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+            assert not t.is_alive(), "loop wedged across close()"
+        assert not errors, errors
+        model = s.dealer.rater.model
+        shard = s.dealer._default_shard
+        for entry in shard._published.views.values():
+            if entry is None:
+                continue
+            scorer = entry[0]
+            mirror = scorer._model_box[0]
+            if mirror is not None:
+                n = len(scorer.infos)
+                assert len(mirror.cont_sum) >= n
+                assert len(mirror.cont_cnt) >= n
+                assert 0 <= mirror.version <= model.version
+        # the dealer still answers reads after close (close releases
+        # pools, not the snapshot): the next score resyncs cleanly
+        after = dict(s.dealer.score(s.nodes, _pod(s.client, "r1", 100)))
+        assert set(after) == set(s.nodes)
 
 
 class TestCalibrationFlow:
