@@ -3,14 +3,15 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm sim-smoke sim-multipool sim-het chaos-soak obs-check fanout-4k image clean
+.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm sim-smoke sim-multipool sim-het sim-defrag chaos-soak obs-check fanout-4k image clean
 
 # Default verification tier: static analysis, then the fast inner loop
 # (test-fast includes sim-smoke), then the observability gate, then the
-# overload-resilience soak, then the sharded 4096-host fan-out gate
-# (FAST=1 skips it). The tier-1 gate (`pytest tests/ -m 'not slow'` over
+# overload-resilience soak, then the heterogeneity and capacity-recovery
+# certifications and the sharded 4096-host fan-out gate (FAST=1 skips
+# those three). The tier-1 gate (`pytest tests/ -m 'not slow'` over
 # everything) is unchanged — run it via `make test` / CI.
-all: native lint test-fast obs-check chaos-soak sim-het fanout-4k
+all: native lint test-fast obs-check chaos-soak sim-het sim-defrag fanout-4k
 
 # nanolint (docs/static-analysis.md): AST invariant passes over the
 # scheduler's concurrency & determinism contracts — lock discipline,
@@ -126,6 +127,34 @@ sim-het:
 			--seed 0 --check-determinism > /dev/null && \
 		python -m pytest tests/test_throughput.py -q -k certification; \
 	fi
+
+# Capacity-recovery certification gate (docs/defrag.md): the
+# gangs-vs-bursty scenario run TWICE (--check-determinism,
+# digest-reproducible), then the recovery-on-vs-off comparison asserts
+# the acceptance deltas — strict-gang wait p99 drops >=10x at equal
+# (+-2 pp) mean occupancy, mean fragmentation strictly lower, every
+# recovery counter (preempt/migrate/backfill/lease-expiry) nonzero,
+# zero invariant violations — plus the replay-safety suite (migration
+# under agent restart / bind failures / brownout converges to ground
+# truth). `FAST=1 make all` skips it (same rule as sim-het).
+sim-defrag:
+	@if [ "$(FAST)" = "1" ]; then \
+		echo "sim-defrag: skipped (FAST=1)"; \
+	else \
+		python -m nanotpu.sim --scenario examples/sim/gangs-vs-bursty.json \
+			--seed 0 --check-determinism > /dev/null && \
+		python -m pytest tests/test_recovery.py -q -k "certification or replay"; \
+	fi
+
+# The gang-storm bench row on its own (docs/defrag.md): a 1024-host
+# fragmented fleet driven through the REAL scheduling stack on virtual
+# time, recovery on vs off in one process, asserting the gang-wait p99
+# ratio and the standard zero-gen2-GC discipline around the timed
+# windows. A/B against a base ref with:
+#   make bench-ab AB_CMD="python bench.py --gang-storm-rep" \
+#        AB_KEY=gangstorm_events_per_s
+gang-storm: native
+	python bench.py --gang-storm
 
 # The 4096-host multi-pool churn scenario through the sharded dealer,
 # run TWICE (--check-determinism): exits nonzero on any invariant

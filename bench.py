@@ -901,6 +901,208 @@ def run_bind_storm_reps(reps: int = 3, max_reps: int = 5,
     }
 
 
+#: Gang-storm scenario builder (docs/defrag.md): a 1024-host fleet run
+#: hot (~66% steady occupancy) by whole-host serving jobs (4x4-chip
+#: replicas, exp 15s) with a 30/s fractional-churn stream contaminating
+#: the free pool, against three 1344-chip strict training gangs (336
+#: members x 4 chips, priority 100, all-or-nothing admission, 10s
+#: runtime from start) at fixed virtual times. The workload is a
+#: GENERATED TRACE — one bench-owned seeded rng, every arrival and
+#: lifetime explicit — so the gang arrivals (the thing the row
+#: measures) can never fall out of a thin poisson tail, and the two
+#: sides replay the identical stream.
+GANG_STORM_HOSTS = 1024
+GANG_STORM_GANG_SIZE = 336
+
+
+def _gang_storm_scenario() -> dict:
+    import random
+
+    rng = random.Random(20260803)
+    horizon = 75.0
+    arrivals = []
+    t = 0.0
+    while True:  # whole-host serving carriers: ~60% of the fleet
+        t += rng.expovariate(10.6)
+        if t >= horizon:
+            break
+        arrivals.append({
+            "t": round(t, 4), "config": "spread",
+            "lifetime_s": round(max(0.25, rng.expovariate(1 / 15.0)), 4),
+        })
+    t = 0.0
+    while True:  # fractional churn: the free-pool contamination
+        t += rng.expovariate(40.0)
+        if t >= horizon:
+            break
+        arrivals.append({
+            "t": round(t, 4), "config": "fractional",
+            "lifetime_s": round(max(0.25, rng.expovariate(1 / 1.5)), 4),
+        })
+    for gt in (25.0, 45.0, 62.0):
+        arrivals.append({
+            "t": gt, "config": "gang_llama", "lifetime_s": 10.0,
+            "gang_size": GANG_STORM_GANG_SIZE,
+        })
+    return {
+        "name": "gang-storm",
+        "fleet": {"pools": [{
+            "generation": "v5p", "hosts": GANG_STORM_HOSTS,
+            "slice_hosts": 64, "prefix": "v5p-host",
+        }]},
+        "policy": "binpack",
+        "horizon_s": horizon,
+        "workload": {
+            "kind": "trace",
+            "arrivals": arrivals,
+            "lifetime_overrides": {
+                "fractional": {"dist": "exp", "mean": 1.5},
+                "spread": {"dist": "exp", "mean": 15.0},
+                "gang_llama": {"dist": "fixed", "mean": 10.0},
+            },
+            "priorities": {"fractional": 0, "spread": 0,
+                           "gang_llama": 100},
+            "spread_percent": 400,
+            "gang_percent": 400,
+            "gang_strict": True,
+            "lifetime_from_bind": True,
+        },
+        "faults": {},
+        "resync_every_s": 10.0,
+        "sample_every_s": 1.0,
+        "retry_every_s": 0.25,
+        "invariant_every_events": 64,
+        "recovery": {
+            "enabled": True, "every_s": 0.25, "eviction_budget": 32,
+            "migration_budget": 64, "sweep_budget": 4, "backfill": True,
+            "lease_grace_s": 0.25, "gang_start_horizon_s": 3.0,
+            "hole_ttl_s": 20.0,
+        },
+    }
+
+
+def _recovery_available() -> bool:
+    """True when this tree ships the capacity-recovery plane — bench_ab
+    copies THIS bench file into the base worktree, where the subsystem
+    (and the scenario knobs that drive it) may not exist."""
+    try:
+        import nanotpu.recovery  # noqa: F401
+    except ImportError:
+        return False
+    from nanotpu.sim.scenario import normalize_scenario
+
+    return "recovery" in normalize_scenario(
+        {"fleet": {"pools": [{"generation": "v5p", "hosts": 1}]}}
+    )
+
+
+def _gang_storm_side(enabled: bool, seed: int) -> dict:
+    """One gang-storm sim run under the bench GC discipline: collect up
+    front, freeze the warmed interpreter heap, disable the automatic
+    collector, and assert ZERO gen-2 collections inside the timed run —
+    a recovery cycle that leaked allocation storms into the collector
+    would show up here, attributed, instead of as mystery wall-time."""
+    import copy
+    import gc
+
+    from nanotpu.sim.core import Simulator
+
+    scenario = _gang_storm_scenario()
+    if not _recovery_available():
+        scenario.pop("recovery", None)
+    elif not enabled:
+        scenario["recovery"]["enabled"] = False
+    sim = Simulator(scenario, seed)
+    gc.collect()
+    gc.freeze()
+    gc_before = gc.get_stats()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        report = sim.run()
+        wall = time.perf_counter() - t0
+    finally:
+        gc.enable()
+        gc_after = gc.get_stats()
+        gc.unfreeze()
+        gc.collect()
+    perf = sim.dealer.perf_totals()
+    sim.dealer.close()
+    gcd = _gc_deltas(gc_before, gc_after)
+    assert gcd["gen2_collections"] == 0, (
+        f"gen-2 GC inside the timed gang-storm window: {gcd}"
+    )
+    assert perf["renderer_builds"] == 0, (
+        "renderer builds in a payload-free sim run: "
+        f"{perf['renderer_builds']}"
+    )
+    assert report["invariants"]["violations"] == 0, (
+        report["invariants"]["first"]
+    )
+    waits = report["gangs"]["wait_s"]
+    return {
+        "wall_s": round(wall, 2),
+        "events_per_s": round(report["events_processed"] / wall, 1),
+        "pods_bound": report["pods"]["bound"],
+        "pending_final": report["pods"]["pending_final"],
+        "gangs": report["gangs"]["jobs"],
+        "wait_p50_s": waits.get("p50"),
+        "wait_p99_s": waits.get("p99"),
+        "occupancy_mean_pct": report["occupancy_pct"]["mean"],
+        "fragmentation_mean": report["fragmentation"]["mean"],
+        "recovery": report.get("recovery", {}).get("counters", {}),
+        "gc": gcd,
+        "attr": {k: perf[k] for k in (
+            "view_builds", "renderer_builds", "native_calls",
+            "fastpath_hits", "fastpath_misses",
+        )},
+    }
+
+
+def run_gang_storm(seed: int = 0) -> dict:
+    """The capacity-recovery write/planning row (docs/defrag.md):
+    recovery ON vs OFF over the identical (scenario, seed) in one
+    process, asserting the strict-gang wait-p99 drop and the standard
+    zero-gen2-GC / zero-renderer-rebuild discipline on BOTH timed
+    windows. Virtual-time outcome metrics (waits, occupancy,
+    fragmentation) are deterministic; ``events_per_s`` is the wall-clock
+    throughput of the real stack under the storm — the A/B key for
+    ``make bench-ab AB_CMD=\"python bench.py --gang-storm-rep\"``."""
+    load_start = [round(x, 2) for x in os.getloadavg()]
+    available = _recovery_available()
+    on = _gang_storm_side(True, seed)
+    off = _gang_storm_side(False, seed)
+    out = {
+        "gangstorm_hosts": GANG_STORM_HOSTS,
+        "gangstorm_gang_chips": GANG_STORM_GANG_SIZE * 4,
+        "gangstorm_seed": seed,
+        "gangstorm_recovery_available": available,
+        "gangstorm_on": on,
+        "gangstorm_off": off,
+        # the rate key bench_ab pairs on: wall throughput of the
+        # recovery-ON side (planning cycles included)
+        "gangstorm_events_per_s": on["events_per_s"],
+        "gangstorm_host_loadavg_1m": load_start,
+    }
+    if available:
+        p99_on = on["wait_p99_s"] or 0.001
+        p99_off = off["wait_p99_s"] or 0.0
+        ratio = round(p99_off / p99_on, 1)
+        out["gangstorm_wait_p99_ratio"] = ratio
+        assert on["gangs"] >= 2 and off["gangs"] >= 2, (
+            "gang-storm needs >=2 completed gangs per side to compare "
+            f"waits (on={on['gangs']}, off={off['gangs']})"
+        )
+        assert ratio >= 5.0, (
+            f"gang-wait p99 with recovery on ({p99_on}s) must be >=5x "
+            f"under the off side ({p99_off}s); got {ratio}x"
+        )
+        rec = on["recovery"]
+        assert rec.get("preempted_pods", 0) > 0, rec
+        assert rec.get("migrated_pods", 0) > 0, rec
+    return out
+
+
 def run_once() -> tuple[list[float], float, int, float]:
     """One full 32-pod scenario; returns (latencies, elapsed, bound, occ%)."""
     client = make_mock_cluster(N_HOSTS, CHIPS_PER_HOST)
@@ -1085,6 +1287,17 @@ if __name__ == "__main__":
         # rebuilds in the timed window) are the gate — an AssertionError
         # exits nonzero
         print(json.dumps(run_fanout_4k(reps=1, max_reps=1)))
+    elif "--gang-storm" in sys.argv:
+        # `make gang-storm`: the capacity-recovery row (recovery on vs
+        # off over one scenario+seed); the in-bench asserts (wait-p99
+        # ratio, zero gen-2 GC, zero renderer rebuilds, zero invariant
+        # violations) are the gate — an AssertionError exits nonzero
+        print(json.dumps(run_gang_storm()))
+    elif "--gang-storm-rep" in sys.argv:
+        # one rep, for bench_ab.py's interleaved A/B protocol
+        # (AB_KEY=gangstorm_events_per_s); the base side runs the same
+        # scenario with the recovery knobs feature-detected away
+        print(json.dumps(run_gang_storm()))
     elif "--bind-storm" in sys.argv:
         # the full bind-storm row (median of 3 reps, in-bench asserts)
         print(json.dumps(run_bind_storm_reps()))

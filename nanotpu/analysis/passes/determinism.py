@@ -46,7 +46,8 @@ PASS_NAME = "sim-determinism"
 #: the sim itself plus every module it drives (sim/core.py imports)
 SCOPE = (
     "nanotpu.sim", "nanotpu.dealer", "nanotpu.controller",
-    "nanotpu.scheduler", "nanotpu.allocator",
+    "nanotpu.scheduler", "nanotpu.allocator", "nanotpu.recovery",
+    "nanotpu.metrics.recovery",
     "nanotpu.k8s.objects", "nanotpu.k8s.client", "nanotpu.k8s.resilience",
     "nanotpu.k8s.events",
     "nanotpu.metrics.resilience", "nanotpu.metrics.stats",

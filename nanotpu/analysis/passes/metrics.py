@@ -27,6 +27,18 @@ promise has three string-ly typed seams this pass stitches shut:
   declared is a computed value no scrape ever sees. Both directions
   are findings.
 
+* **Recovery counters** (``nanotpu_sched_defrag_*`` /
+  ``nanotpu_gang_backfill_*``, docs/defrag.md): the exporter renders the
+  ``_RECOVERY_METRICS`` table of ``nanotpu/metrics/recovery.py`` over the
+  ``RecoveryCounters`` slots, and the plane bumps them as
+  ``self.counters.<slot> += 1``. Three-way check: every slot must appear
+  in the table (else the exporter KeyErrors at scrape time), every table
+  key must be a slot (else the render indexes a counter that does not
+  exist), and every slot must have a ``counters.<slot> += ...`` site
+  somewhere (else a forever-zero metric lies about the recovery plane
+  never acting) — with unknown ``counters.*`` bump sites flagged the
+  same way unknown ``perf.*`` bumps are.
+
 * **Decision-audit reason codes** (``REASON_*`` in
   ``nanotpu/obs/decisions.py``, docs/observability.md): a code recorded
   somewhere but not declared in the enum would ship an uncatalogued
@@ -193,6 +205,32 @@ def _gauge_value_keys(mod: Module) -> dict[str, tuple[str, int]]:
     return out
 
 
+def _declared_recovery_table(mod: Module) -> dict[str, int] | None:
+    """slot -> declaration line from the ``_RECOVERY_METRICS`` dict
+    literal; None when this module declares no such table."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.AnnAssign):
+            if node.value is None or not isinstance(node.target, ast.Name):
+                continue
+            targets, value = [node.target.id], node.value
+        elif isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        else:
+            continue
+        if "_RECOVERY_METRICS" not in targets:
+            continue
+        out: dict[str, int] = {}
+        if isinstance(value, ast.Dict):
+            for key in value.keys:
+                if isinstance(key, ast.Constant) and isinstance(
+                    key.value, str
+                ):
+                    out[key.value] = key.lineno
+        return out
+    return None
+
+
 def _declared_slots(mod: Module, cls_name: str) -> dict[str, int] | None:
     for node in mod.tree.body:
         if not isinstance(node, ast.ClassDef) or node.name != cls_name:
@@ -226,6 +264,10 @@ class _MetricsPass:
         reasons_mod: Module | None = None
         tgauges: dict[str, int] | None = None
         tgauges_mod: Module | None = None
+        rslots: dict[str, int] | None = None
+        rslots_mod: Module | None = None
+        rtable: dict[str, int] | None = None
+        rtable_mod: Module | None = None
         for mod in modules:
             d = _declared_resilience(mod)
             if d is not None:
@@ -233,6 +275,12 @@ class _MetricsPass:
             s = _declared_slots(mod, "PerfCounters")
             if s is not None:
                 slots, slots_mod = s, mod
+            rs = _declared_slots(mod, "RecoveryCounters")
+            if rs is not None:
+                rslots, rslots_mod = rs, mod
+            rt = _declared_recovery_table(mod)
+            if rt is not None:
+                rtable, rtable_mod = rt, mod
             r = _declared_reasons(mod)
             if r is not None:
                 (reasons, catalogue), reasons_mod = r, mod
@@ -242,6 +290,7 @@ class _MetricsPass:
 
         inc_sites: dict[str, tuple[str, int]] = {}
         perf_incs: dict[str, tuple[str, int]] = {}
+        recovery_incs: dict[str, tuple[str, int]] = {}
         for mod in modules:
             if decl_mod is not None and mod is decl_mod:
                 continue  # the ledger's own inc() plumbing is not a site
@@ -271,10 +320,21 @@ class _MetricsPass:
                     node.target, ast.Attribute
                 ):
                     base = dotted(node.target.value)
-                    if base is not None and base.split(".")[-1] in (
-                        "perf", "_perf"
-                    ):
+                    if base is None:
+                        continue
+                    leaf = base.split(".")[-1]
+                    if leaf in ("perf", "_perf"):
                         perf_incs.setdefault(
+                            node.target.attr, (str(mod.path), node.lineno)
+                        )
+                    elif leaf in ("counters", "_counters") and (
+                        mod is not rslots_mod
+                    ):
+                        # RecoveryCounters bump sites (the resilience
+                        # ledger's receivers use .inc() calls, matched
+                        # above, so an AugAssign through `counters` can
+                        # only mean the recovery plane's slots)
+                        recovery_incs.setdefault(
                             node.target.attr, (str(mod.path), node.lineno)
                         )
 
@@ -302,6 +362,43 @@ class _MetricsPass:
                         f"perf counter {slot!r} is incremented here but "
                         "is not a PerfCounters slot — it is never "
                         "exported (and will AttributeError at runtime)",
+                    ))
+        if rslots is not None and rslots_mod is not None:
+            for slot, line in sorted(rslots.items()):
+                if slot not in recovery_incs:
+                    findings.append(Finding(
+                        self.name, str(rslots_mod.path), line,
+                        f"RecoveryCounters slot {slot!r} is exported on "
+                        "/metrics but never incremented anywhere — a "
+                        "forever-zero metric reads as 'the recovery "
+                        "plane never does this'",
+                    ))
+                if rtable is not None and slot not in rtable:
+                    findings.append(Finding(
+                        self.name, str(rslots_mod.path), line,
+                        f"RecoveryCounters slot {slot!r} is missing from "
+                        "the _RECOVERY_METRICS table — the exporter "
+                        "renders the table, so this counter never "
+                        "reaches /metrics",
+                    ))
+            for slot, (path, line) in sorted(recovery_incs.items()):
+                if slot not in rslots:
+                    findings.append(Finding(
+                        self.name, path, line,
+                        f"recovery counter {slot!r} is incremented here "
+                        "but is not a RecoveryCounters slot — it is "
+                        "never exported (and will AttributeError at "
+                        "runtime)",
+                    ))
+        if rtable is not None and rtable_mod is not None and \
+                rslots is not None:
+            for slot, line in sorted(rtable.items()):
+                if slot not in rslots:
+                    findings.append(Finding(
+                        self.name, str(rtable_mod.path), line,
+                        f"_RECOVERY_METRICS references {slot!r} which is "
+                        "not a RecoveryCounters slot — the exporter will "
+                        "KeyError at scrape time",
                     ))
         if reasons is not None and reasons_mod is not None:
             findings.extend(self._check_reasons(

@@ -125,6 +125,26 @@ def build_app(argv: list[str] | None = None):
         "commits out over N bounded workers (recommended with --shards "
         "auto under bind/migration storms)",
     )
+    parser.add_argument(
+        "--recovery", action="store_true",
+        help="start the capacity-recovery plane (docs/defrag.md): a "
+        "periodic loop that preempts/migrates lower-priority pods for "
+        "parked strict gangs and leases short pods into the reserved "
+        "holes; actions land in the decision ledger and the "
+        "nanotpu_sched_defrag_* / nanotpu_gang_backfill_* metrics",
+    )
+    parser.add_argument(
+        "--recovery-period", type=float, default=2.0, metavar="SECONDS",
+        help="recovery-cycle cadence (with --recovery)",
+    )
+    parser.add_argument(
+        "--recovery-eviction-budget", type=int, default=8, metavar="N",
+        help="max preemptions per recovery cycle (the anti-thrash bound)",
+    )
+    parser.add_argument(
+        "--recovery-migration-budget", type=int, default=4, metavar="N",
+        help="max defrag migrations per recovery cycle",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -219,6 +239,27 @@ def main(argv: list[str] | None = None) -> int:
             policy=api.policy_watcher,
         )
 
+    recovery_loop = None
+    if args.recovery:
+        from nanotpu.metrics.recovery import RecoveryExporter
+        from nanotpu.recovery import (
+            RecoveryConfig,
+            RecoveryLoop,
+            RecoveryPlane,
+        )
+
+        plane = RecoveryPlane(
+            dealer, controller=controller, obs=api.obs,
+            config=RecoveryConfig(
+                eviction_budget=args.recovery_eviction_budget,
+                migration_budget=args.recovery_migration_budget,
+            ),
+        )
+        dealer.recovery = plane  # /debug/decisions surfaces its status
+        api.registry.register(RecoveryExporter(plane))
+        recovery_loop = RecoveryLoop(plane, period_s=args.recovery_period)
+        recovery_loop.start()
+
     server = serve(api, args.port)
     log.info(
         "nanotpu extender serving on :%d (policy=%s, mock=%s)",
@@ -232,6 +273,8 @@ def main(argv: list[str] | None = None) -> int:
             os._exit(1)
         stop["flag"] = True
         log.info("signal %s: shutting down", signum)
+        if recovery_loop is not None:
+            recovery_loop.stop()
         controller.stop()
         if api.policy_watcher is not None:
             api.policy_watcher.stop()

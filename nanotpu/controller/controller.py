@@ -252,6 +252,13 @@ class Controller:
                  force: bool = False) -> None:
         self._queue.put((pod.namespace, pod.name, attempt), force=force)
 
+    def requeue(self, pod: Pod) -> None:
+        """Repair-path re-enqueue for out-of-band state changes (the
+        capacity-recovery plane's preempt-and-requeue): force=True —
+        like resync and capped retries, the repair mechanism must never
+        shed itself on a full queue."""
+        self._enqueue(pod, force=True)
+
     def _pod_loop(self) -> None:
         for event in self._pod_watch:
             if self._stop.is_set():
@@ -404,13 +411,9 @@ class Controller:
         return expired
 
     def _expire_assumed(self, pod: Pod, ttl: float) -> bool:
-        stripped = pod.deepcopy()
-        ann = stripped.ensure_annotations()
-        ann.pop(types.ANNOTATION_ASSUME, None)
-        ann.pop(types.ANNOTATION_BOUND_POLICY, None)
-        for c in stripped.containers:
-            ann.pop(types.ANNOTATION_CONTAINER_FMT.format(name=c.name), None)
-        stripped.ensure_labels().pop(types.ANNOTATION_ASSUME, None)
+        # the one annotation-strip implementation, shared with the
+        # capacity-recovery plane's preempt path (docs/defrag.md)
+        stripped = podutil.strip_placement(pod)
         try:
             self.client.update_pod(stripped)
         except ConflictError:

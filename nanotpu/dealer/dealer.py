@@ -53,7 +53,12 @@ from nanotpu.allocator.core import Demand, Plan
 from nanotpu.analysis.witness import make_lock, make_rlock
 from nanotpu.allocator.rater import Rater
 from nanotpu.dealer.batch import BatchScorer
-from nanotpu.dealer.gang import GangBarrier, GangScorer, GangTracker
+from nanotpu.dealer.gang import (
+    GangBarrier,
+    GangScorer,
+    GangTracker,
+    WaitObservation,
+)
 from nanotpu.dealer.nodeinfo import NodeInfo
 from nanotpu.dealer.perf import PerfCounters
 from nanotpu.dealer.shard import (
@@ -254,6 +259,19 @@ class Dealer:
         #: bumped on any structural _nodes change; structural publishes
         #: rebuild the snapshot's node mapping and drop its views
         self._nodes_epoch = 0
+        #: optional capacity-recovery plane
+        #: (:class:`nanotpu.recovery.RecoveryPlane`), attached by the
+        #: process that owns one (cmd/main's --recovery, harnesses);
+        #: ``/debug/decisions`` surfaces its status when present
+        self.recovery = None
+        #: gang pods whose Filter found ZERO feasible candidates — the
+        #: production recovery trigger for gangs that cannot even
+        #: reserve (a member must reserve to park at the barrier, so a
+        #: fully-starved gang would otherwise be invisible to
+        #: :meth:`parked_gang_pods`). uid -> (pod, first-starved
+        #: monotonic); maintained only with a recovery plane attached,
+        #: bounded, entries retire on a feasible Filter / bind / TTL.
+        self._starved: dict[str, tuple[Pod, float]] = {}
         #: request-level hot-path attribution (bench deltas + /metrics);
         #: shard-level counters (publishes, view work, native calls) live
         #: on each shard's own PerfCounters — in single-shard mode the one
@@ -1125,6 +1143,12 @@ class Dealer:
         demand = self._demand_of(pod)
         if not demand.is_valid():
             return []
+        if self.recovery is not None:
+            blocked = self.recovery.blocks(pod, node_names)
+            if blocked:
+                node_names = [
+                    n for n in node_names if n not in blocked
+                ]
         if self._shard_fn is not None:
             plan = self._shard_plan(node_names)
             if plan is not None:
@@ -1234,6 +1258,23 @@ class Dealer:
 
     def filter_payload(self, node_names: list[str], pod: Pod) -> bytes | None:
         """ExtenderFilterResult JSON bytes, or None -> use assume()."""
+        if self.recovery is not None:
+            if self.recovery.blocks(pod, node_names):
+                # hole-reserved candidates need per-name failed reasons
+                # the pre-rendered fragments cannot express: list path
+                # (holes are rare and transient; one None check when no
+                # plane)
+                self.perf.fastpath_misses += 1
+                return None
+            gang = podutil.gang_of(pod)
+            if gang and gang[1] > 1:
+                # gang Filters take the (render-cached) list path so a
+                # zero-feasible verdict reaches the starvation trigger
+                # (_note_starvation) — fused bytes bypass assume(), and
+                # a fully-starved gang must not be invisible to the
+                # recovery plane (docs/defrag.md)
+                self.perf.fastpath_misses += 1
+                return None
         if self._hook_active:
             # explicit fused-path refusal (docs/scoring.md): the native
             # renderer cannot evaluate a Python-side score hook, and a
@@ -1266,6 +1307,11 @@ class Dealer:
         self, node_names: list[str], pod: Pod
     ) -> bytes | None:
         """HostPriorityList JSON bytes, or None -> use score()."""
+        if self.recovery is not None and self.recovery.blocks(
+            pod, node_names
+        ):
+            self.perf.fastpath_misses += 1
+            return None
         if self._hook_active:
             self.perf.hook_refusals += 1
             return None
@@ -1307,7 +1353,55 @@ class Dealer:
         locks or apiserver warming GETs — with DeadlineExceeded; the
         route layer answers 503 and kube-scheduler's retry carries on.
         ``trace`` (same threading) records which read path served the
-        request — snapshot batch vs warming per-node fan-out."""
+        request — snapshot batch vs warming per-node fan-out.
+
+        With a capacity-recovery plane attached (``self.recovery``,
+        docs/defrag.md), candidates earmarked for OTHER parked gangs'
+        holes answer infeasible with a typed reason — production
+        Filter enforces reservations the same way the sim's driver-side
+        filtering does. One attribute load when no plane is attached."""
+        if self.recovery is not None:
+            blocked = self.recovery.blocks(pod, node_names)
+            if blocked:
+                kept = [n for n in node_names if n not in blocked]
+                ok, failed = self._assume_inner(
+                    kept, pod, deadline, trace
+                )
+                for n in node_names:
+                    if n in blocked:
+                        failed[n] = types.REASON_HOLE_RESERVED
+            else:
+                ok, failed = self._assume_inner(
+                    node_names, pod, deadline, trace
+                )
+            self._note_starvation(pod, bool(ok))
+            return ok, failed
+        return self._assume_inner(node_names, pod, deadline, trace)
+
+    #: starved-gang entries retire after this long without a refresh
+    STARVED_TTL_S = 60.0
+    STARVED_MAX = 512
+
+    def _note_starvation(self, pod: Pod, feasible: bool) -> None:
+        """Track gang pods whose Filter answered zero feasible nodes —
+        the recovery plane's trigger for gangs that cannot reserve."""
+        gang = podutil.gang_of(pod)
+        if not gang or gang[1] <= 1:
+            return
+        with self._lock:
+            if feasible:
+                self._starved.pop(pod.uid, None)
+                return
+            if pod.uid in self._starved:
+                return
+            while len(self._starved) >= self.STARVED_MAX:
+                self._starved.pop(next(iter(self._starved)))
+            self._starved[pod.uid] = (pod, time.monotonic())
+
+    def _assume_inner(
+        self, node_names: list[str], pod: Pod,
+        deadline: Deadline | None = None, trace=None,
+    ) -> tuple[list[str], dict[str, str]]:
         deadline_check(deadline, "filter:start")
         if trace is not None:
             trace.event(
@@ -1420,6 +1514,29 @@ class Dealer:
     def score(self, node_names: list[str], pod: Pod,
               deadline: Deadline | None = None,
               trace=None) -> list[tuple[str, int]]:
+        if self.recovery is not None:
+            blocked = self.recovery.blocks(pod, node_names)
+            if blocked:
+                # hole-reserved candidates score SCORE_MIN in candidate
+                # order — Prioritize must answer every candidate, and
+                # Filter already marked these infeasible
+                kept = [n for n in node_names if n not in blocked]
+                scored = dict(
+                    self._score_inner(kept, pod, deadline, trace)
+                )
+                return [
+                    (
+                        n,
+                        types.SCORE_MIN if n in blocked
+                        else scored.get(n, types.SCORE_MIN),
+                    )
+                    for n in node_names
+                ]
+        return self._score_inner(node_names, pod, deadline, trace)
+
+    def _score_inner(self, node_names: list[str], pod: Pod,
+                     deadline: Deadline | None = None,
+                     trace=None) -> list[tuple[str, int]]:
         deadline_check(deadline, "priorities:start")
         if trace is not None:
             trace.event(
@@ -1660,9 +1777,14 @@ class Dealer:
     def _drop_gang_barrier(self, gang_key: str) -> None:
         """GangTracker on_gang_empty hook: a forgotten gang's barrier must
         not leave ``open=True`` behind for a re-submitted same-named gang
-        (that would silently bypass the all-or-nothing guarantee)."""
+        (that would silently bypass the all-or-nothing guarantee). The
+        recovery plane's hole for the gang dissolves with it — nothing
+        left to hold capacity for."""
         with self._lock:
             self._gang_barriers.pop(gang_key, None)
+        recovery = self.recovery
+        if recovery is not None:
+            recovery.gang_gone(gang_key)
 
     def _invalidate_reservation(self, uid: str, res: _Reservation) -> None:
         """Mark a parked reservation dead AND stop it counting toward its
@@ -1749,7 +1871,14 @@ class Dealer:
             trace.event("gang:parked", key)
         timeout = podutil.gang_timeout(pod)
         deadline = time.monotonic() + timeout
-        parked_t0 = time.monotonic()
+        # exactly-once park-window observation (gang.WaitObservation):
+        # every exit below flows through ONE latched observe, so no
+        # combination of timeout rollback, batched-result delivery, and
+        # recovery-driven de-parks can double-sample the histogram
+        wait_obs = WaitObservation(
+            self.obs.gang_wait if self.obs is not None else None,
+            time.monotonic(),
+        )
         try:
             try:
                 batch = None
@@ -1814,10 +1943,7 @@ class Dealer:
             finally:
                 # ONE observation point covering every exit from the
                 # park window — open, timeout, and unexpected raises
-                if self.obs is not None:
-                    self.obs.gang_wait.observe(
-                        time.monotonic() - parked_t0
-                    )
+                wait_obs.observe(time.monotonic())
         except BindError:
             if trace is not None:
                 trace.event("gang:timeout", key)
@@ -2063,6 +2189,18 @@ class Dealer:
             )
         if needs_replay:
             self._learn_bound_pod(annotated)
+        recovery = self.recovery
+        if recovery is not None:
+            # production lifecycle hooks (docs/defrag.md): a bind landing
+            # inside another gang's hole records its backfill lease, and
+            # the bind that completes a gang closes that gang's hole —
+            # the sim's driver-side calls are idempotent with these
+            recovery.note_bound(annotated, node_name)
+            gang = podutil.gang_of(annotated)
+            if gang and gang[1] > 1:
+                key = f"{annotated.namespace}/{gang[0]}"
+                if self.gangs.bound_count(key) >= gang[1]:
+                    recovery.gang_bound(key)
         return annotated
 
     def _write_annotations(self, pod: Pod, plan: Plan) -> Pod:
@@ -2138,6 +2276,13 @@ class Dealer:
                                 pod.key(), node, e,
                             )
         self.gangs.forget_pod(pod.uid)
+        recovery = self.recovery
+        if recovery is not None:
+            # lifecycle hook: a departed pod's backfill lease is cleaned
+            # without an eviction (the on-time case of the lease
+            # contract); gang-hole closure on emptied gangs rides the
+            # tracker's on_gang_empty callback (_drop_gang_barrier)
+            recovery.pod_gone(pod.uid)
         if released:
             self._republish((released_node,))
         return released
@@ -2154,6 +2299,179 @@ class Dealer:
         self._released[uid] = None
         while len(self._released) > RELEASED_TOMBSTONES_MAX:
             self._released.pop(next(iter(self._released)))
+
+    # -- migration (capacity recovery, docs/defrag.md) ---------------------
+    def migrate(self, pod: Pod, target_node: str, trace=None) -> Pod:
+        """Move a tracked pod's placement to ``target_node``: reserve on
+        the target, rewrite the pod's chip annotations + ``nodeName`` in
+        ONE apiserver write through the resilient client, then replay
+        accounting source→target (release + allocate — the same
+        assume/forget replay an agent restart performs, which is why an
+        interrupted migration converges: the durable annotations always
+        name exactly one placement).
+
+        Raises :class:`BindError` with the target reservation rolled
+        back — and the source placement untouched — on any failure, so a
+        brownout mid-defrag degrades to "nothing moved". The publishes
+        ride :meth:`_republish`, so with the commit pipeline on a
+        migration batch folds into one coalesced snapshot swap per shard
+        (docs/bind-pipeline.md)."""
+        with self._lock:
+            tracked = self._pods.get(pod.uid)
+        if tracked is None:
+            raise BindError(
+                f"pod {pod.key()} is not tracked; nothing to migrate",
+                reason=REASON_POD_RELEASED,
+            )
+        source = tracked.node_name
+        if source == target_node:
+            return tracked
+        old_plan = plan_from_pod(tracked)
+        if old_plan is None:
+            raise BindError(
+                f"pod {pod.key()} has no reconstructible plan; refusing "
+                "to migrate an unaccountable placement",
+            )
+        info_t = self._node_info(target_node)
+        if info_t is None:
+            raise BindError(
+                f"node {target_node} is not a known TPU node",
+                reason=REASON_NOT_TPU_NODE,
+            )
+        demand = self._demand_of(tracked)
+        plan_t = info_t.bind(demand, self.rater)
+        if plan_t is None:
+            raise BindError(
+                f"no feasible plan for pod {pod.key()} on node "
+                f"{target_node}",
+                reason=REASON_INSUFFICIENT_CHIPS,
+            )
+        # publish the target reservation NOW (same rule as _reserve):
+        # concurrent Filters must stop steering pods onto these chips
+        # while the annotation write is in flight
+        self._republish((target_node,))
+        if trace is not None:
+            trace.event("migrate:reserved", target_node)
+        try:
+            annotated = self._write_migration(tracked, plan_t, target_node)
+        except (ApiError, NotFoundError) as e:
+            info_t.unbind(plan_t)
+            self._republish((target_node,))
+            raise BindError(
+                f"migration of {pod.key()} to {target_node} failed: {e}",
+                reason=(
+                    REASON_BREAKER_OPEN
+                    if isinstance(e, BreakerOpenError)
+                    else REASON_API_ERROR
+                ),
+            ) from e
+        needs_replay = False
+        with self._lock:
+            if self._pods.get(pod.uid) is not tracked:
+                # released/forgotten while the write was in flight: the
+                # racer rolled the SOURCE accounting back; our target
+                # reservation must follow (the pod object itself is the
+                # racer's problem — deletion wins over migration)
+                raced = True
+            else:
+                raced = False
+                src_info = self._accounted.get(pod.uid)
+                current = self._nodes.get(target_node)
+                if current is None or current is info_t:
+                    self._pods[pod.uid] = annotated
+                    self._accounted[pod.uid] = info_t
+                    gang = podutil.gang_of(annotated)
+                    if gang:
+                        # membership node moves with the pod (same lock
+                        # as the map commit, mirroring _commit_reserved)
+                        self.gangs.record_bound(
+                            f"{annotated.namespace}/{gang[0]}", gang[1],
+                            annotated.uid, target_node,
+                        )
+                else:
+                    # target rebuilt mid-write: our chips live on an
+                    # orphaned NodeInfo — migrate via the replay path
+                    # (outside the lock), exactly as _commit_reserved
+                    self._pods.pop(pod.uid, None)
+                    self._accounted.pop(pod.uid, None)
+                    needs_replay = True
+                if src_info is not None and src_info is not info_t:
+                    src_info.release(old_plan)
+        if raced:
+            info_t.unbind(plan_t)
+            self._republish((target_node,))
+            raise BindError(
+                f"pod {pod.key()} was released while migration was in "
+                "flight",
+                reason=REASON_POD_RELEASED,
+            )
+        if needs_replay:
+            self._learn_bound_pod(annotated)
+        if trace is not None:
+            trace.event("migrate:committed", f"{source}->{target_node}")
+        self._republish(
+            (source, target_node) if source else (target_node,)
+        )
+        return annotated
+
+    def _write_migration(self, tracked: Pod, plan: Plan,
+                         target_node: str) -> Pod:
+        """The migration's single durable write: fresh GET (for the
+        resourceVersion), new chip annotations AND ``spec.nodeName`` in
+        one update, optimistic-retry on conflicts like
+        :meth:`_write_annotations`."""
+        assignments = plan.by_container_name()
+        current = self.client.get_pod(tracked.namespace, tracked.name)
+        for attempt in range(BIND_CONFLICT_RETRIES + 1):
+            annotated = podutil.annotated_pod(
+                current, assignments, policy=self.rater.name
+            )
+            annotated.raw.setdefault("spec", {})["nodeName"] = target_node
+            try:
+                return self.client.update_pod(annotated)
+            except ConflictError:
+                if attempt == BIND_CONFLICT_RETRIES:
+                    raise
+                current = self.client.get_pod(
+                    tracked.namespace, tracked.name
+                )
+        raise AssertionError("unreachable")
+
+    def has_reservation(self, uid: str) -> bool:
+        """True when ``uid`` holds a parked strict-gang reservation (its
+        capacity is already applied; the recovery plane must not clear
+        more for it)."""
+        with self._lock:
+            res = self._reserved.get(uid)
+            return res is not None and res.valid
+
+    def parked_gang_pods(self) -> list[Pod]:
+        """The production feed for
+        :meth:`nanotpu.recovery.RecoveryPlane.run_once`: pods parked at
+        strict-gang barriers (reservation applied, awaiting the rest of
+        the gang) PLUS recently-starved gang pods (Filter answered zero
+        feasible nodes — those members never reach the barrier, and
+        without them a fully-fragmented fleet would hide exactly the
+        gangs recovery exists for)."""
+        now = time.monotonic()
+        with self._lock:
+            pods = [
+                res.pod for res in self._reserved.values()
+                if res.valid and res.pod is not None
+            ]
+            stale = [
+                uid for uid, (p, t) in self._starved.items()
+                if now - t > self.STARVED_TTL_S
+                or uid in self._pods or uid in self._released
+            ]
+            for uid in stale:
+                self._starved.pop(uid, None)
+            seen = {p.uid for p in pods}
+            pods += [
+                p for uid, (p, _t) in self._starved.items()
+                if uid not in seen
+            ]
+        return sorted(pods, key=lambda p: p.name)
 
     # -- metrics ingestion (controller metric-sync writes here) ------------
     def update_chip_usage(

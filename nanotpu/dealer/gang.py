@@ -151,6 +151,41 @@ class GangBarrier:
         self.results: dict[str, object] = {}
 
 
+class WaitObservation:
+    """Exactly-once observation of one strict-gang park window.
+
+    The gang-wait histogram must record each park window EXACTLY once,
+    across every exit — barrier open, timeout rollback, batched-commit
+    result delivery, and the capacity-recovery paths that can now
+    de-park a member mid-window (a backfill lease expiring inside the
+    window must not let a retry-then-raise exit observe the same wait
+    twice). Call sites wrap the window in try/finally around
+    :meth:`observe`; the ``_done`` latch makes a second call — from a
+    nested finally, a re-raised rollback, or a future exit path — a
+    counted no-op instead of a duplicate histogram sample."""
+
+    __slots__ = ("hist", "t0", "_done")
+
+    def __init__(self, hist, t0: float):
+        #: the histogram (``Observability.gang_wait``), or None when no
+        #: observability bundle is attached — observe() then no-ops
+        self.hist = hist
+        self.t0 = t0
+        self._done = False
+
+    @property
+    def observed(self) -> bool:
+        return self._done
+
+    def observe(self, now: float) -> bool:
+        """Record the wait once; True iff THIS call recorded it."""
+        if self._done or self.hist is None:
+            return False
+        self._done = True
+        self.hist.observe(now - self.t0)
+        return True
+
+
 def gang_affinity_bonus(
     candidate_slice: str,
     candidate_coords: str,

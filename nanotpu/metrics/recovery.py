@@ -1,0 +1,161 @@
+"""``nanotpu_sched_defrag_*`` / ``nanotpu_gang_backfill_*`` exposition:
+the capacity-recovery plane's observable surface (docs/defrag.md).
+
+Every deliberate capacity-recovery action — a preempted pod, a defrag
+migration, a backfill lease granted or expired, a budget cap hit — is a
+counter here, under the same honesty contract the resilience counters
+live under: the :data:`_RECOVERY_METRICS` table (which the exporter
+renders) and the :class:`RecoveryCounters` slots (which the plane bumps
+as ``self.counters.<slot> += 1``) are cross-checked BOTH directions by
+the nanolint metrics-completeness pass, so a slot nobody bumps or a bump
+nobody exports is a lint finding, not a lying zero on ``/metrics``.
+
+Two live gauges ride along from plane state rather than the counters:
+open gang holes and active backfill leases.
+"""
+
+from __future__ import annotations
+
+
+class RecoveryCounters:
+    """Monotonic counters for the capacity-recovery plane. Bumped on the
+    recovery cycle (sim: the single event thread; production: the
+    recovery loop thread) — never on the verb hot path."""
+
+    __slots__ = (
+        "recovery_cycles",
+        "preempted_pods",
+        "preempt_infeasible",
+        "eviction_budget_hits",
+        "migrated_pods",
+        "migration_failures",
+        "migration_budget_hits",
+        "holes_opened",
+        "holes_closed",
+        "backfill_leases",
+        "backfill_lease_expiries",
+    )
+
+    def __init__(self):
+        #: run_once invocations (the defragmenter/preemption loop ticks)
+        self.recovery_cycles = 0
+        #: lower-priority pods evicted (placement stripped + requeued) for
+        #: a parked higher-priority gang
+        self.preempted_pods = 0
+        #: parked gang members no eviction set could make feasible this
+        #: cycle (fleet genuinely full at or above their priority)
+        self.preempt_infeasible = 0
+        #: cycles that stopped evicting because the per-cycle eviction
+        #: budget was exhausted (preemption can never thrash: the cap is
+        #: the proof)
+        self.eviction_budget_hits = 0
+        #: pods moved by the defragmenter (annotation rewrite +
+        #: assume/forget replay through Dealer.migrate)
+        self.migrated_pods = 0
+        #: migrations whose annotation write failed (brownout, breaker);
+        #: accounting rolled back, source placement intact
+        self.migration_failures = 0
+        #: cycles that stopped migrating at the per-cycle migration budget
+        self.migration_budget_hits = 0
+        #: gang holes opened (capacity earmarked for a parked gang) and
+        #: closed (gang bound / departed / hole TTL)
+        self.holes_opened = 0
+        self.holes_closed = 0
+        #: backfill leases granted (short low-priority pod admitted into a
+        #: reserved-but-waiting hole) and leases that EXPIRED with the pod
+        #: still running (pod evicted, reason ``lease_expired``)
+        self.backfill_leases = 0
+        self.backfill_lease_expiries = 0
+
+    def snapshot(self) -> dict[str, int]:
+        """Point-in-time copy (report sections / metrics render)."""
+        return {name: getattr(self, name) for name in self.__slots__}
+
+
+#: counter slot -> (full metric name, help). Keys must be exactly the
+#: RecoveryCounters slots — nanolint pins the equivalence both ways.
+_RECOVERY_METRICS: dict[str, tuple[str, str]] = {
+    "recovery_cycles": (
+        "nanotpu_sched_defrag_cycles_total",
+        "Capacity-recovery cycles run (preemption + defragmentation + "
+        "lease sweep)",
+    ),
+    "preempted_pods": (
+        "nanotpu_sched_defrag_preempted_pods_total",
+        "Lower-priority pods evicted and requeued for a parked "
+        "higher-priority gang",
+    ),
+    "preempt_infeasible": (
+        "nanotpu_sched_defrag_preempt_infeasible_total",
+        "Parked gang members no eviction set could make feasible",
+    ),
+    "eviction_budget_hits": (
+        "nanotpu_sched_defrag_eviction_budget_hits_total",
+        "Recovery cycles that stopped evicting at the per-cycle "
+        "eviction budget",
+    ),
+    "migrated_pods": (
+        "nanotpu_sched_defrag_migrated_pods_total",
+        "Pods moved by the defragmenter (annotation rewrite + "
+        "assume/forget replay)",
+    ),
+    "migration_failures": (
+        "nanotpu_sched_defrag_migration_failures_total",
+        "Migrations rolled back on a failed annotation write",
+    ),
+    "migration_budget_hits": (
+        "nanotpu_sched_defrag_migration_budget_hits_total",
+        "Recovery cycles that stopped migrating at the per-cycle "
+        "migration budget",
+    ),
+    "holes_opened": (
+        "nanotpu_sched_defrag_holes_opened_total",
+        "Gang holes opened (capacity earmarked for a parked gang)",
+    ),
+    "holes_closed": (
+        "nanotpu_sched_defrag_holes_closed_total",
+        "Gang holes closed (gang bound, departed, or hole TTL elapsed)",
+    ),
+    "backfill_leases": (
+        "nanotpu_gang_backfill_leases_total",
+        "Backfill leases granted inside reserved-but-waiting gang holes",
+    ),
+    "backfill_lease_expiries": (
+        "nanotpu_gang_backfill_lease_expiries_total",
+        "Backfill leases that expired with the pod still running "
+        "(pod evicted, reason lease_expired)",
+    ),
+}
+
+#: live-state gauges rendered from the plane, not the counters
+_HOLES_GAUGE = "nanotpu_sched_defrag_holes_open"
+_LEASES_GAUGE = "nanotpu_gang_backfill_active_leases"
+
+
+class RecoveryExporter:
+    """Registry-compatible renderer (``Registry.register``) for the
+    recovery plane's counters + live hole/lease gauges. Registered
+    exactly when a recovery plane is attached, so deployments without
+    one export nothing new."""
+
+    def __init__(self, plane):
+        self.plane = plane
+
+    def render(self) -> list[str]:
+        out: list[str] = []
+        snap = self.plane.counters.snapshot()
+        for slot in sorted(_RECOVERY_METRICS):
+            name, help_text = _RECOVERY_METRICS[slot]
+            out.append(f"# HELP {name} {help_text}")
+            out.append(f"# TYPE {name} counter")
+            out.append(f"{name} {snap[slot]}")
+        status = self.plane.status()
+        for name, help_text, value in (
+            (_HOLES_GAUGE, "Gang holes currently open", status["holes"]),
+            (_LEASES_GAUGE, "Backfill leases currently active",
+             status["leases"]),
+        ):
+            out.append(f"# HELP {name} {help_text}")
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {value}")
+        return out

@@ -40,6 +40,10 @@ REASON_BREAKER_OPEN = "breaker_open"
 REASON_DEADLINE_SHED = "deadline_shed"
 REASON_ADMISSION_SHED = "admission_shed"
 REASON_ASSUME_EXPIRED = "assume_expired"
+REASON_PREEMPTED = "preempted"
+REASON_MIGRATED = "migrated"
+REASON_BACKFILLED = "backfilled"
+REASON_LEASE_EXPIRED = "lease_expired"
 
 #: code -> operator-facing description. Keys must be exactly the
 #: ``REASON_*`` constants above (nanolint pins the equivalence).
@@ -68,6 +72,18 @@ REASONS: dict[str, str] = {
         "request shed by the admission gate (429 + Retry-After)",
     REASON_ASSUME_EXPIRED:
         "assumed-but-never-bound annotations expired by the TTL sweeper",
+    REASON_PREEMPTED:
+        "evicted by the capacity-recovery plane for a higher-priority "
+        "parked gang; placement stripped and the pod requeued",
+    REASON_MIGRATED:
+        "placement moved to another node by the defragmenter "
+        "(annotation rewrite + assume/forget replay)",
+    REASON_BACKFILLED:
+        "short low-priority pod leased into a reserved-but-waiting gang "
+        "hole until the gang's expected start",
+    REASON_LEASE_EXPIRED:
+        "backfill lease expired (the gang's start is due); pod evicted "
+        "from the hole and requeued",
 }
 
 

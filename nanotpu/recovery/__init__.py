@@ -1,0 +1,12 @@
+"""Capacity recovery: priority preemption, defragmentation, gang backfill.
+
+See :mod:`nanotpu.recovery.plane` (and docs/defrag.md) for the design.
+"""
+
+from nanotpu.recovery.plane import (  # noqa: F401
+    Hole,
+    Lease,
+    RecoveryConfig,
+    RecoveryLoop,
+    RecoveryPlane,
+)

@@ -1,0 +1,1049 @@
+"""The capacity-recovery plane: preemption, defragmentation, gang backfill.
+
+Long-lived fractional pods fragment the ICI torus until large gangs park
+forever — occupancy looks healthy while the fleet's *usable* large-slice
+capacity collapses (ROADMAP item 3; Tesserae's placement-quality-vs-
+partitioning tradeoff). This module turns the repo's existing machinery —
+the assume/forget annotation replay the agent-restart fault already
+proves convergent, the native batch scoring path, the coalescing
+controller queue — into an active recovery subsystem with three tools:
+
+* **preempt-and-requeue** — pods carry a priority class
+  (``tpu.io/priority``); a parked gang may evict strictly-lower-priority
+  non-gang pods (placement stripped through the same
+  :func:`~nanotpu.utils.pod.strip_placement` path the assume-TTL sweeper
+  uses, chips rolled back via ``Dealer.forget``, the sync requeued
+  through the coalescing queue with ``force=True``). A per-cycle
+  **eviction budget** bounds displaced work, so preemption can never
+  thrash: the budget is the proof.
+* **defragmentation** — fractional pods blocking whole chips are MOVED
+  instead of killed when spare capacity exists elsewhere:
+  ``Dealer.migrate`` rewrites the pod's chip annotations + nodeName in
+  one write through the resilient client and replays accounting
+  source→target (release + allocate, the same assume/forget replay a
+  restart performs), so an interrupted migration converges from the
+  durable annotations. Candidate targets come from the SAME native
+  scoring path Prioritize uses (``Dealer.top_candidates`` — the Q16
+  fixed-point engine for the throughput rater); the defrag cost model
+  then gates them — the steady-state sweep accepts a move only when the
+  fleet's whole-free chip count strictly improves (the monotone rule
+  that makes migration ping-pong impossible), gang clearing accepts any
+  non-hole absorber, cheapest loss first. Per-cycle **migration** and
+  **sweep budgets** bound churn.
+* **gang backfill** — capacity cleared for a parked gang is earmarked as
+  a :class:`Hole`: other pods are filtered away from hole nodes so churn
+  cannot refill them, EXCEPT short low-priority pods whose declared
+  runtime (``tpu.io/expected-runtime-s``) ends before the gang's
+  expected start — those bind under a :class:`Lease` (reason
+  ``backfilled``) and are evicted at expiry if still running (reason
+  ``lease_expired``), so reserved capacity never idles and never delays
+  the gang.
+
+Every action lands in the decision ledger as a typed reason code and in
+the ``nanotpu_sched_defrag_*`` / ``nanotpu_gang_backfill_*`` counters
+(:mod:`nanotpu.metrics.recovery`).
+
+Concurrency: :meth:`RecoveryPlane.run_once` runs on ONE driver at a time
+(the sim's event thread on virtual time, or the production
+:class:`RecoveryLoop` thread). The read hooks the scheduling path calls
+(:meth:`filter_candidates`, :meth:`note_bound`) read the hole map
+lock-free — individual dict probes are GIL-atomic, and a read racing a
+cycle at worst sees the previous cycle's holes, the same one-update
+staleness window every RCU read path in the dealer already tolerates.
+Client writes (the strip / migrate annotation updates) happen with no
+plane state mid-mutation, so a failed write leaves both the holes and
+the cluster exactly as they were.
+
+Determinism: the plane draws NOTHING random — victim and target choice
+are total orders (priority, displaced percent, name), every map is
+iterated sorted, and the injectable ``clock`` is the only time source —
+so a (scenario, seed) sim run that enables recovery is as
+byte-reproducible as one that does not.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from nanotpu.allocator.core import ChipResource, ChipSet, Demand
+from nanotpu.metrics.recovery import RecoveryCounters
+from nanotpu.obs.decisions import (
+    REASON_BACKFILLED,
+    REASON_LEASE_EXPIRED,
+    REASON_MIGRATED,
+    REASON_PREEMPTED,
+)
+from nanotpu.utils import pod as podutil
+
+log = logging.getLogger("nanotpu.recovery")
+
+
+@dataclass
+class RecoveryConfig:
+    """Knobs (scenario ``recovery`` section / cmd flags; docs/defrag.md)."""
+
+    #: max pods evicted per run_once — the anti-thrash bound
+    eviction_budget: int = 8
+    #: max pods migrated per run_once
+    migration_budget: int = 4
+    #: max migrations the STEADY-STATE defrag sweep may spend per cycle
+    #: (over and above gang clearing, but never past migration_budget's
+    #: leftover) — consolidation is a background trickle, not a storm
+    sweep_budget: int = 2
+    #: grant backfill leases inside gang holes at all
+    backfill: bool = True
+    #: margin a backfill pod's declared end must clear the gang's
+    #: expected start by (and the slack added to its lease expiry)
+    lease_grace_s: float = 0.5
+    #: how far ahead a freshly opened hole promises its gang will start —
+    #: the backfill window's right edge
+    gang_start_horizon_s: float = 5.0
+    #: a hole whose gang stopped appearing parked dissolves after this
+    hole_ttl_s: float = 30.0
+
+
+@dataclass
+class Lease:
+    """One backfilled pod's deadline lease inside a gang hole."""
+
+    uid: str
+    pod_name: str
+    namespace: str
+    node: str
+    expires_at: float
+    gang_key: str
+
+
+@dataclass
+class Hole:
+    """Reserved-but-waiting capacity earmarked for one parked gang."""
+
+    gang_key: str
+    priority: int
+    opened_t: float
+    expected_start: float
+    #: every node the gang's assembly plan counts on — cleared by
+    #: eviction/migration AND already-free nodes claimed virtually;
+    #: other pods are filtered away from them until the hole closes
+    nodes: set[str] = field(default_factory=set)
+    #: pod uid -> active backfill lease on a hole node
+    leases: dict[str, Lease] = field(default_factory=dict)
+    #: last virtual time the gang was seen parked (hole-TTL clock)
+    last_parked_t: float = 0.0
+
+
+def _scratch_chips(info) -> ChipSet:
+    """Copy of a NodeInfo's chip state for hypothetical evaluation
+    (eviction feasibility, migration gain) — never the live object."""
+    with info.lock:
+        chips = [
+            ChipResource(
+                percent_free=c.percent_free,
+                percent_total=c.percent_total,
+                load=c.load,
+                hbm_free_mib=c.hbm_free_mib,
+                hbm_total_mib=c.hbm_total_mib,
+            )
+            for c in info.chips.chips
+        ]
+    return ChipSet(info.chips.torus, chips, key=info.chips.key)
+
+
+def _whole_free(chips: ChipSet) -> int:
+    return sum(
+        1 for c in chips.chips if c.percent_free == c.percent_total
+    )
+
+
+def uniform_whole_host_total(totals, infos, allowed) -> int | None:
+    """The shared fast-path eligibility rule: identical whole-chip
+    demands on a fleet where every allowed node's capacity equals one
+    demand — virtual placement then reduces to counting fully-free
+    hosts. Returns the per-member total, or None (general packing
+    required). ONE implementation serves the sim's strict-gang gate and
+    the plane's clearing pass so the two can never drift."""
+    if not totals or len(set(totals)) != 1:
+        return None
+    t = totals[0]
+    if t < 100 or t % 100:
+        return None
+    for n in allowed:
+        if len(infos[n].chips.chips) * 100 != t:
+            return None
+    return t
+
+
+def demands_fit(infos, allowed, demands, rater) -> bool:
+    """All-or-nothing virtual placement: can EVERY demand place at once
+    on scratch copies of the live chip state, restricted to ``allowed``
+    nodes? Joint by construction — each placement consumes scratch
+    capacity the next one sees, so N whole-host demands need N hosts,
+    never the same one N times. The whole-host fast path is a free-host
+    count (O(hosts)); the general path runs the real packer over
+    lazily-copied scratch state. Shared by the sim's strict admission
+    gate and the recovery plane (docs/defrag.md)."""
+    t = uniform_whole_host_total(
+        [d.total for d in demands], infos, allowed
+    )
+    if t is not None:
+        free_hosts = sum(
+            1 for n in allowed
+            if all(
+                c.percent_free == c.percent_total
+                for c in infos[n].chips.chips
+            )
+        )
+        return free_hosts >= len(demands)
+    scratch: dict[str, ChipSet] = {}
+    for demand in demands:
+        placed = False
+        for name in allowed:
+            s = scratch.get(name)
+            if s is None:
+                s = scratch[name] = _scratch_chips(infos[name])
+            if not s.can_fit(demand):
+                continue
+            plan = rater.choose(s, demand)
+            if plan is not None:
+                s.allocate(plan)
+                placed = True
+                break
+        if not placed:
+            return False
+    return True
+
+
+class RecoveryPlane:
+    """See module docstring. One instance per scheduler process; the
+    driver (sim event loop or :class:`RecoveryLoop`) owns the cycle."""
+
+    def __init__(self, dealer, controller=None, obs=None,
+                 counters: RecoveryCounters | None = None,
+                 config: RecoveryConfig | None = None,
+                 clock=time.monotonic):
+        self.dealer = dealer
+        #: the coalescing-queue requeue hook (force=True — the repair
+        #: path must never shed itself); None in harnesses that own
+        #: their own requeue (the sim's pending list)
+        self.controller = controller
+        self.obs = obs
+        self.counters = counters or RecoveryCounters()
+        self.config = config or RecoveryConfig()
+        self.clock = clock
+        #: gang key -> Hole (read lock-free by filter_candidates)
+        self.holes: dict[str, Hole] = {}
+
+    # -- scheduling-path read hooks ---------------------------------------
+    def filter_candidates(self, pod, node_names: list[str],
+                          now: float | None = None) -> list[str]:
+        """Candidates minus other gangs' hole nodes. A pod that
+        qualifies for backfill (non-gang, strictly lower priority, a
+        declared runtime that ends ``lease_grace_s`` before the hole's
+        expected start) keeps the hole's nodes — the lease is granted if
+        it actually binds there (:meth:`note_bound`). May return an
+        empty list: a fleet fully earmarked for parked gangs is
+        deliberately closed to everything that would refill it."""
+        holes = self.holes
+        if not holes:
+            return node_names
+        now = self.clock() if now is None else now
+        gang = podutil.gang_of(pod)
+        my_key = f"{pod.namespace}/{gang[0]}" if gang else None
+        prio = podutil.priority_of(pod)
+        runtime = (
+            podutil.expected_runtime_s(pod) if gang is None else None
+        )
+        blocked: set[str] = set()
+        for key in sorted(holes):
+            hole = holes.get(key)
+            if hole is None or key == my_key:
+                continue
+            if (
+                self.config.backfill
+                and runtime is not None
+                and prio < hole.priority
+                and now + runtime + self.config.lease_grace_s
+                <= hole.expected_start
+            ):
+                continue  # backfill-eligible: the hole stays open to it
+            blocked.update(hole.nodes)
+        if not blocked:
+            return node_names
+        return [n for n in node_names if n not in blocked]
+
+    def blocks(self, pod, node_names: list[str],
+               now: float | None = None) -> set[str]:
+        """The candidates hole protection withholds from this pod —
+        empty for most pods most of the time (one truthiness check when
+        no hole is open). The dealer's read verbs consult this so
+        production Filter/Prioritize enforce reservations exactly the
+        way the sim's driver-side filtering does."""
+        if not self.holes:
+            return set()
+        allowed = self.filter_candidates(pod, node_names, now=now)
+        if len(allowed) == len(node_names):
+            return set()
+        allowed_set = set(allowed)
+        return {n for n in node_names if n not in allowed_set}
+
+    def note_bound(self, pod, node: str,
+                   now: float | None = None) -> str | None:
+        """Record the lease when a bind landed inside a hole. Returns
+        the gang key leased against (the bind was a backfill) or None
+        (a normal bind, or the gang landing in its own hole)."""
+        holes = self.holes
+        if not holes:
+            return None
+        now = self.clock() if now is None else now
+        gang = podutil.gang_of(pod)
+        my_key = f"{pod.namespace}/{gang[0]}" if gang else None
+        for key in sorted(holes):
+            hole = holes.get(key)
+            if hole is None or node not in hole.nodes:
+                continue
+            if key == my_key:
+                return None  # the gang itself claiming its hole
+            if pod.uid in hole.leases:
+                # idempotent: the dealer's commit hook and a driver-side
+                # caller (the sim) can both report the same bind — one
+                # lease, one counter bump, one audit record
+                return key
+            runtime = podutil.expected_runtime_s(pod) or 0.0
+            expires = min(
+                now + runtime + self.config.lease_grace_s,
+                hole.expected_start,
+            )
+            hole.leases[pod.uid] = Lease(
+                uid=pod.uid, pod_name=pod.name, namespace=pod.namespace,
+                node=node, expires_at=expires, gang_key=key,
+            )
+            self.counters.backfill_leases += 1
+            self._audit(pod.uid, pod.key(), node, REASON_BACKFILLED)
+            return key
+        return None
+
+    def pod_gone(self, uid: str) -> None:
+        """Departure/eviction cleanup: drop any lease the pod held."""
+        for key in sorted(self.holes):
+            hole = self.holes.get(key)
+            if hole is not None:
+                hole.leases.pop(uid, None)
+
+    def gang_bound(self, gang_key: str) -> None:
+        """The gang fully bound: its hole (and remaining leases) close."""
+        self._close_hole(gang_key)
+
+    def gang_gone(self, gang_key: str) -> None:
+        """The gang departed/was killed: nothing to hold capacity for."""
+        self._close_hole(gang_key)
+
+    def _close_hole(self, gang_key: str) -> None:
+        if self.holes.pop(gang_key, None) is not None:
+            self.counters.holes_closed += 1
+
+    def status(self) -> dict:
+        """Live plane state for ``/debug/decisions`` and the sim report."""
+        holes = sorted(self.holes)
+        detail = {}
+        for key in holes:
+            hole = self.holes.get(key)
+            if hole is None:
+                continue
+            detail[key] = {
+                "priority": hole.priority,
+                "nodes": sorted(hole.nodes),
+                "expected_start": round(hole.expected_start, 6),
+                "leases": len(hole.leases),
+            }
+        return {
+            "holes": len(detail),
+            "leases": sum(d["leases"] for d in detail.values()),
+            "gangs": detail,
+            "counters": self.counters.snapshot(),
+        }
+
+    # -- the recovery cycle ------------------------------------------------
+    def run_once(self, now: float | None = None,
+                 parked: list | None = None) -> dict:
+        """One recovery cycle: sweep expired leases, close stale holes,
+        clear capacity for parked gangs (migrate first, then preempt,
+        both budget-bounded), then spend leftover migration budget on a
+        general defrag sweep. ``parked`` is the driver's view of pods
+        awaiting placement (the sim's pending gang pods; production
+        passes ``dealer.parked_gang_pods()``). Returns::
+
+            {"evicted": [pod names whose placement was stripped],
+             "actions": [(kind, detail), ...]}   # journal-ready, in order
+        """
+        now = self.clock() if now is None else now
+        parked = parked or []
+        self.counters.recovery_cycles += 1
+        actions: list[tuple[str, str]] = []
+        evicted: list[str] = []
+        budgets = {
+            "evict": self.config.eviction_budget,
+            "migrate": self.config.migration_budget,
+        }
+
+        self._sweep_leases(now, actions, evicted)
+        gangs = self._parked_by_gang(parked)
+        self._sweep_holes(now, gangs, actions)
+
+        infos = self.dealer.debug_snapshot()["node_infos"]
+        by_node = self._tracked_by_node()
+        for key in sorted(
+            gangs, key=lambda k: (-gangs[k][0], k)
+        ):
+            prio, members = gangs[key]
+            self._clear_gang(
+                key, prio, members, now, infos, by_node, budgets,
+                actions, evicted,
+            )
+        sweep = min(budgets["migrate"], self.config.sweep_budget)
+        if sweep > 0:
+            self._defrag_sweep(
+                now, infos, by_node, {"migrate": sweep}, actions,
+            )
+        return {"evicted": evicted, "actions": actions}
+
+    # -- cycle internals ---------------------------------------------------
+    def _parked_by_gang(self, parked) -> dict[str, tuple[int, list]]:
+        """gang key -> (priority, pods needing capacity). Parked pods
+        already holding a dealer reservation (the strict-barrier
+        production path) need no clearing themselves, but the gang may
+        still be SHORT members the scheduler has not sent us — those are
+        covered by clearing clones of a parked member's demand."""
+        groups: dict[str, list] = {}
+        for pod in parked:
+            gang = podutil.gang_of(pod)
+            if not gang or gang[1] <= 1:
+                continue
+            groups.setdefault(
+                f"{pod.namespace}/{gang[0]}", []
+            ).append(pod)
+        out: dict[str, tuple[int, list]] = {}
+        for key in sorted(groups):
+            members = sorted(groups[key], key=lambda p: p.name)
+            prio = max(podutil.priority_of(p) for p in members)
+            reserved = [
+                p for p in members
+                if self.dealer.has_reservation(p.uid)
+            ]
+            needing = [
+                p for p in members
+                if not self.dealer.has_reservation(p.uid)
+            ]
+            size = max(podutil.gang_of(p)[1] for p in members)
+            short = (
+                size - self.dealer.gangs.bound_count(key) - len(reserved)
+            )
+            # members kube-scheduler has not even attempted yet: clear
+            # capacity for clones of the first parked member's demand
+            for _ in range(max(short - len(needing), 0)):
+                needing.append(members[0])
+            if needing:
+                out[key] = (prio, needing)
+        return out
+
+    def _tracked_by_node(self) -> dict[str, list]:
+        by_node: dict[str, list] = {}
+        for pod in sorted(self.dealer.tracked_pods(),
+                          key=lambda p: p.name):
+            if pod.node_name:
+                by_node.setdefault(pod.node_name, []).append(pod)
+        return by_node
+
+    def _sweep_leases(self, now: float, actions, evicted) -> None:
+        for key in sorted(self.holes):
+            hole = self.holes.get(key)
+            if hole is None:
+                continue
+            for uid in sorted(hole.leases):
+                lease = hole.leases[uid]
+                if not self.dealer.tracks(uid):
+                    hole.leases.pop(uid, None)  # departed on its own
+                    continue
+                if now < lease.expires_at:
+                    continue
+                # the gang's start is due and the pod overstayed its
+                # declared runtime: evict (lease contract, docs/defrag.md)
+                if self._evict(
+                    lease.namespace, lease.pod_name, uid,
+                    REASON_LEASE_EXPIRED,
+                ):
+                    self.counters.backfill_lease_expiries += 1
+                    evicted.append(lease.pod_name)
+                    actions.append((
+                        "lease-expire",
+                        f"{lease.pod_name} @ {lease.node} for {key}",
+                    ))
+                    hole.leases.pop(uid, None)
+                elif not self.dealer.tracks(uid):
+                    hole.leases.pop(uid, None)  # gone between checks
+                # else: transient strip failure (brownout) — the lease
+                # stays so the next cycle retries the eviction, matching
+                # _evict's "nothing changed" contract
+
+    def _sweep_holes(self, now: float, gangs, actions) -> None:
+        for key in sorted(self.holes):
+            hole = self.holes.get(key)
+            if hole is None:
+                continue
+            if key in gangs:
+                hole.last_parked_t = now
+                continue
+            if now - hole.last_parked_t >= self.config.hole_ttl_s:
+                self._close_hole(key)
+                actions.append(("hole-close", f"{key} ttl"))
+
+    def _hole_for(self, gang_key: str, priority: int,
+                  now: float, actions) -> Hole:
+        hole = self.holes.get(gang_key)
+        if hole is None:
+            hole = self.holes[gang_key] = Hole(
+                gang_key=gang_key, priority=priority, opened_t=now,
+                expected_start=now + self.config.gang_start_horizon_s,
+                last_parked_t=now,
+            )
+            self.counters.holes_opened += 1
+            actions.append(("hole-open", gang_key))
+        return hole
+
+    def _clear_gang(self, gang_key: str, prio: int, members: list,
+                    now: float, infos, by_node, budgets, actions,
+                    evicted) -> None:
+        """Assemble capacity for every member a parked gang still needs.
+
+        Joint feasibility is the point: the members are placed VIRTUALLY
+        against per-cycle scratch chip states (one copy per touched
+        node), so sixteen members needing sixteen whole hosts reserve
+        sixteen — a real-state check would let every member point at the
+        same free host and clear one node per cycle. For each member
+        that cannot place even virtually, the cheapest
+        eviction/migration set (least displaced percent, fewest victims,
+        name) clears one node: short-declared victims get leases (lazy
+        preemption), movable ones migrate (budgeted), the rest evict
+        (budgeted), and the node is earmarked into the gang's hole
+        BEFORE the evictions land so churn cannot refill it mid-clear."""
+        all_names = sorted(infos)
+        scratch: dict[str, ChipSet] = {}
+
+        def sc(name: str) -> ChipSet:
+            if name not in scratch:
+                s = scratch[name] = _scratch_chips(infos[name])
+                # a my-hole node whose only blockers are MY leased
+                # incumbents is promised capacity: their leases end
+                # before the gang's expected start, so virtual planning
+                # treats them as already gone (the REAL gate still waits
+                # for their departure/expiry — timing stays honest)
+                hole = self.holes.get(gang_key)
+                if hole is not None and name in hole.nodes:
+                    for p in by_node.get(name, []):
+                        if p.uid in hole.leases:
+                            lp = plan_from_pod(p)
+                            if lp is not None:
+                                try:
+                                    s.release(lp)
+                                except ValueError:
+                                    pass  # stale bookkeeping: keep real
+            return scratch[name]
+
+        from nanotpu.dealer.dealer import plan_from_pod
+
+        rater = self.dealer.rater
+        other_hole_nodes: set[str] = set()
+        for key in sorted(self.holes):
+            hole = self.holes.get(key)
+            if hole is not None and key != gang_key:
+                other_hole_nodes.update(hole.nodes)
+        leased = self._leased_uids()
+        #: nodes carrying VIRTUAL member placements this cycle: migration
+        #: targets must avoid them — the scratch and the real state would
+        #: otherwise diverge about the same chips (a real migration
+        #: landing where a virtual member sits would double-book the
+        #: cycle's own planning)
+        virtual_nodes: set[str] = set()
+        # one gang's members share annotations, so one candidate filter
+        # serves them all
+        allowed = (
+            self.filter_candidates(members[0], all_names, now=now)
+            if members else []
+        )
+        # whole-host fast path (the training-gang shape): identical
+        # whole-chip members on a uniform fleet fit exactly on
+        # fully-free hosts, so virtual placement is a pop from one
+        # precomputed pool — O(hosts) once — instead of O(members x
+        # hosts) trial packings per cycle
+        free_pool: list[str] | None = None
+        if uniform_whole_host_total(
+            [Demand.from_pod(p).total for p in members], infos, allowed,
+        ) is not None:
+            free_pool = [
+                n for n in allowed
+                if n not in scratch and all(
+                    c.percent_free == c.percent_total
+                    for c in infos[n].chips.chips
+                )
+            ]
+            free_pool.reverse()  # .pop() consumes in name order
+        for pod in members:
+            demand = Demand.from_pod(pod)
+            if not demand.is_valid():
+                continue
+            placed = None
+            if free_pool is not None:
+                while free_pool and placed is None:
+                    name = free_pool.pop()
+                    s = sc(name)
+                    plan = rater.choose(s, demand)
+                    if plan is not None:
+                        s.allocate(plan)
+                        virtual_nodes.add(name)
+                        placed = name
+            else:
+                for name in allowed:
+                    s = sc(name)
+                    if not s.can_fit(demand):
+                        continue
+                    plan = rater.choose(s, demand)
+                    if plan is not None:
+                        s.allocate(plan)
+                        virtual_nodes.add(name)
+                        placed = name
+                        break
+            if placed is not None:
+                # EVERY node the gang's assembly plan counts on is
+                # earmarked — not just the ones evictions cleared. An
+                # unearmarked free node would be eaten by the arrival
+                # stream (or by the very pods preemption just requeued)
+                # before the gang's next gate check, and the plane would
+                # clear another node for the same member next cycle,
+                # forever: eviction thrash with a budget-sized leak per
+                # cycle. Reservation must cover the whole plan.
+                self._hole_for(
+                    gang_key, prio, now, actions
+                ).nodes.add(placed)
+                continue
+            if budgets["evict"] <= 0 and budgets["migrate"] <= 0:
+                self.counters.eviction_budget_hits += 1
+                return
+            best = None  # (displaced, n_victims, node) + victims
+            # cheap pre-rank, full planning capped: the nearly-free
+            # nodes are where cheap eviction sets live, so rank every
+            # candidate by used percent (O(hosts) attribute sums) and
+            # run the real packer-backed planning only on the cheapest
+            # few — a 1024-host fleet must not pay 1024 trial packings
+            # per unplaced member
+            ranked = sorted(
+                (
+                    (
+                        scratch[name].percent_used()
+                        if name in scratch
+                        else infos[name].chips.percent_used(),
+                        name,
+                    )
+                    for name in all_names
+                    if name not in other_hole_nodes
+                ),
+            )[:48]
+            for _used, name in ranked:
+                plan = self._eviction_plan(
+                    sc(name), by_node.get(name, []), demand, prio,
+                    leased,
+                )
+                if plan is None:
+                    continue
+                victims, displaced = plan
+                # least displaced WORK first (a handful of fractional
+                # pods costs the fleet far less than one evicted 4-chip
+                # replica idling through a requeue), then fewest victims
+                cost = (displaced, len(victims), name)
+                if best is None or cost < best[0]:
+                    best = (cost, victims)
+            if best is None:
+                self.counters.preempt_infeasible += 1
+                continue
+            (_, _, node), victims = best
+            hole = self._hole_for(gang_key, prio, now, actions)
+            hole.nodes.add(node)
+            cleared = True
+            for victim in victims:
+                vplan = plan_from_pod(victim)
+                gone = False
+                declared = podutil.expected_runtime_s(victim)
+                if (
+                    self.config.backfill
+                    and declared is not None
+                    and now + declared + self.config.lease_grace_s
+                    <= hole.expected_start
+                ):
+                    # LAZY preemption: a short incumbent whose declared
+                    # runtime ends before the gang's expected start is
+                    # left RUNNING under a lease instead of evicted —
+                    # zero displaced work, and the hole's capacity is
+                    # busy instead of idle while the gang assembles (the
+                    # exact waste backfill exists to recoup). The lease
+                    # sweep evicts it at expiry if it overstays.
+                    hole.leases[victim.uid] = Lease(
+                        uid=victim.uid, pod_name=victim.name,
+                        namespace=victim.namespace, node=node,
+                        expires_at=min(
+                            now + declared + self.config.lease_grace_s,
+                            hole.expected_start,
+                        ),
+                        gang_key=gang_key,
+                    )
+                    self.counters.backfill_leases += 1
+                    self._audit(
+                        victim.uid, victim.key(), node, REASON_BACKFILLED,
+                    )
+                    actions.append((
+                        "lease",
+                        f"{victim.name} @ {node} for {gang_key}",
+                    ))
+                    gone = True
+                if not gone and budgets["migrate"] > 0:
+                    target = self._migration_target(
+                        victim, node, infos,
+                        other_hole_nodes | hole.nodes | virtual_nodes,
+                        require_gain=False,
+                    )
+                    if target is not None:
+                        moved_pod = self._migrate(victim, target, actions)
+                        if moved_pod is not None:
+                            budgets["migrate"] -= 1
+                            gone = True
+                            # keep cycle bookkeeping coherent with the
+                            # REWRITTEN pod: the target's scratch and
+                            # resident list must reflect the migrated-in
+                            # placement, or a later member's eviction
+                            # plan releases chips that were never there
+                            by_node.setdefault(target, []).append(
+                                moved_pod
+                            )
+                            if target in scratch:
+                                tplan = plan_from_pod(moved_pod)
+                                if tplan is not None:
+                                    scratch[target].allocate(tplan)
+                if not gone:
+                    if budgets["evict"] <= 0:
+                        self.counters.eviction_budget_hits += 1
+                        cleared = False
+                        break
+                    if self._evict(
+                        victim.namespace, victim.name, victim.uid,
+                        REASON_PREEMPTED,
+                    ):
+                        budgets["evict"] -= 1
+                        self.counters.preempted_pods += 1
+                        evicted.append(victim.name)
+                        actions.append((
+                            "preempt",
+                            f"{victim.name} @ {node} for {gang_key}",
+                        ))
+                        gone = True
+                    else:
+                        cleared = False
+                if gone:
+                    if vplan is not None:
+                        sc(node).release(vplan)
+                    by_node[node] = [
+                        p for p in by_node.get(node, [])
+                        if p.uid != victim.uid
+                    ]
+            if cleared:
+                plan = rater.choose(sc(node), demand)
+                if plan is not None:
+                    sc(node).allocate(plan)
+                    virtual_nodes.add(node)
+            # budgets may be spent now; the NEXT member's top-of-loop
+            # check accounts the hit (a spent budget with no member left
+            # to serve is not a hit)
+
+    def _eviction_plan(self, chips: ChipSet, residents, demand: Demand,
+                       prio: int, leased: set[str]):
+        """(victims, displaced percent) making ``demand`` fit on the
+        node by removing strictly-lower-priority non-gang pods — or
+        None. ``chips`` is the caller's scratch state (virtual member
+        placements included); the trial runs on a private copy. Leased
+        backfill pods are never planned victims — the lease sweep is
+        their only evictor (the lease contract), and their hole node is
+        already earmarked anyway. Feasibility is judged by the REAL
+        rater, so a plan the packer would refuse never evicts anyone."""
+        from nanotpu.dealer.dealer import plan_from_pod
+
+        candidates = []
+        for p in residents:
+            if podutil.gang_of(p):
+                continue  # never break another gang
+            if p.uid in leased:
+                continue
+            if podutil.priority_of(p) >= prio:
+                continue
+            vplan = plan_from_pod(p)
+            if vplan is None:
+                continue
+            candidates.append((
+                podutil.priority_of(p), Demand.from_pod(p).total,
+                p.name, p, vplan,
+            ))
+        trial = ChipSet(
+            chips.torus,
+            [
+                ChipResource(
+                    percent_free=c.percent_free,
+                    percent_total=c.percent_total,
+                    load=c.load,
+                    hbm_free_mib=c.hbm_free_mib,
+                    hbm_total_mib=c.hbm_total_mib,
+                )
+                for c in chips.chips
+            ],
+            key=chips.key,
+        )
+        if self.dealer.rater.choose(trial, demand) is not None:
+            return [], 0  # already fits: nothing to clear
+        if not candidates:
+            return None
+        # cheapest first: lowest priority, least displaced work, name
+        candidates.sort(key=lambda c: (c[0], c[1], c[2]))
+        victims, displaced = [], 0
+        for _vprio, total, _name, p, vplan in candidates:
+            trial.release(vplan)
+            victims.append(p)
+            displaced += total
+            if self.dealer.rater.choose(trial, demand) is not None:
+                return victims, displaced
+        return None
+
+    def _leased_uids(self) -> set[str]:
+        out: set[str] = set()
+        for key in sorted(self.holes):
+            hole = self.holes.get(key)
+            if hole is not None:
+                out.update(hole.leases)
+        return out
+
+    def _migration_target(self, pod, source: str, infos,
+                          excluded: set[str],
+                          require_gain: bool = True) -> str | None:
+        """Best node to absorb ``pod`` off ``source``: ranked by the
+        native scoring path (``top_candidates`` — the rater's own
+        packing preference, the Q16 fixed-point engine under the
+        throughput rater), then gated by the monotone whole-free rule.
+
+        ``require_gain=True`` (the defrag sweep): accept only when the
+        fleet's whole-free chip count strictly improves — source gain
+        from losing the pod must exceed target loss from absorbing it.
+        That strict inequality is what makes migration ping-pong
+        impossible: every accepted move increases a bounded integer.
+        ``require_gain=False`` (clearing a node for a gang, where the
+        source WILL be fully freed regardless): any feasible non-hole
+        target qualifies — keeping the victim BOUND through the clear is
+        worth more than its placement quality (an eviction would idle
+        its chips through a requeue) — but targets are still tried
+        cheapest-loss first, so the blockage prefers existing
+        fragmentation over fresh whole chips.
+
+        The native batch engine answers WHICH nodes are feasible
+        (``top_candidates`` — one memoized crossing, the Q16 fixed-point
+        path under the throughput rater); the defrag COST model then
+        orders those targets itself — most-used first, then name — so
+        consolidation packs regardless of the placement policy's own
+        preference (a spread fleet must still defrag toward packing).
+        Scratch trials are capped so a huge fleet never pays more than a
+        bounded number of hypothetical packings per move."""
+        demand = Demand.from_pod(pod)
+        src_info = infos.get(source)
+        if src_info is None:
+            return None
+        from nanotpu.dealer.dealer import plan_from_pod
+
+        vplan = plan_from_pod(pod)
+        if vplan is None:
+            return None
+        gain = 0
+        if require_gain:
+            src_scratch = _scratch_chips(src_info)
+            before = _whole_free(src_scratch)
+            src_scratch.release(vplan)
+            gain = _whole_free(src_scratch) - before
+            if gain <= 0:
+                # loss is never negative, so gain > loss cannot hold:
+                # skip the full-fleet scoring pass and the scratch
+                # trials outright (most shared-chip fractional pods land
+                # here every sweep cycle)
+                return None
+        feasible = self.dealer.top_candidates(
+            sorted(infos), pod, k=None
+        )
+        order = []
+        for name, _score in feasible:
+            if name == source or name in excluded:
+                continue
+            info = infos.get(name)
+            if info is None:
+                continue
+            order.append((-info.chips.usage(), name, info))
+        order.sort(key=lambda e: (e[0], e[1]))
+        best = None  # (loss, rank, name) — clear path keeps the cheapest
+        for rank, (_neg_usage, name, info) in enumerate(order[:32]):
+            scratch = _scratch_chips(info)
+            before_t = _whole_free(scratch)
+            tplan = self.dealer.rater.choose(scratch, demand)
+            if tplan is None:
+                continue
+            scratch.allocate(tplan)
+            loss = before_t - _whole_free(scratch)
+            if require_gain:
+                if gain > loss:
+                    return name
+                continue
+            if loss == 0:
+                return name  # absorbs into existing fragmentation
+            if best is None or (loss, rank) < best[:2]:
+                best = (loss, rank, name)
+        return best[2] if best is not None else None
+
+    def _migrate(self, pod, target: str, actions):
+        """Execute one migration; returns the REWRITTEN pod (new
+        annotations + nodeName — callers must book-keep with it, never
+        with the stale source-side object) or None on failure."""
+        from nanotpu.dealer.dealer import BindError
+
+        source = pod.node_name
+        try:
+            moved = self.dealer.migrate(pod, target)
+        except BindError as e:
+            self.counters.migration_failures += 1
+            log.warning(
+                "migration of %s to %s failed: %s", pod.key(), target, e,
+            )
+            return None
+        self.counters.migrated_pods += 1
+        self._audit(pod.uid, pod.key(), target, REASON_MIGRATED)
+        actions.append((
+            "migrate", f"{pod.name} {source}->{target}",
+        ))
+        return moved
+
+    def _defrag_sweep(self, now: float, infos, by_node, budgets,
+                      actions) -> None:
+        """Spend the sweep budget consolidating fractional
+        pods: sources ascending by the fractional load pinning them (the
+        cheapest nodes to fully free first), each move gated by the same
+        strict whole-free improvement rule."""
+        hole_nodes: set[str] = set()
+        for key in sorted(self.holes):
+            hole = self.holes.get(key)
+            if hole is not None:
+                hole_nodes.update(hole.nodes)
+        sources = []
+        for name in sorted(infos):
+            if name in hole_nodes:
+                continue
+            movable = [
+                p for p in by_node.get(name, [])
+                if not podutil.gang_of(p)
+                and Demand.from_pod(p).total < 100
+            ]
+            if not movable:
+                continue
+            load = sum(Demand.from_pod(p).total for p in movable)
+            sources.append((load, name, movable))
+        sources.sort()
+        for _load, name, movable in sources:
+            for pod in movable:
+                if budgets["migrate"] <= 0:
+                    self.counters.migration_budget_hits += 1
+                    return
+                target = self._migration_target(
+                    pod, name, infos, hole_nodes | {name},
+                )
+                if target is None:
+                    continue
+                moved_pod = self._migrate(pod, target, actions)
+                if moved_pod is not None:
+                    budgets["migrate"] -= 1
+                    by_node.setdefault(target, []).append(moved_pod)
+                    by_node[name] = [
+                        p for p in by_node.get(name, [])
+                        if p.uid != pod.uid
+                    ]
+
+    # -- execution helpers ---------------------------------------------------
+    def _evict(self, namespace: str, name: str, uid: str,
+               reason: str) -> bool:
+        """Preempt-and-requeue one pod: strip placement (annotations +
+        label + nodeName) through the resilient write path, roll chips
+        back via ``Dealer.forget``, requeue the sync via the coalescing
+        queue with force=True. A failed strip leaves everything exactly
+        as it was (the next cycle retries)."""
+        client = self.dealer.client
+        try:
+            fresh = client.get_pod(namespace, name)
+        except Exception:
+            return False  # already gone
+        if fresh.uid != uid:
+            return False  # name reused by a different incarnation
+        stripped = podutil.strip_placement(fresh, clear_node=True)
+        try:
+            client.update_pod(stripped)
+        except Exception as e:
+            log.warning("preemption strip of %s/%s failed: %s",
+                        namespace, name, e)
+            return False
+        self.dealer.forget(fresh)
+        self.pod_gone(uid)
+        if self.controller is not None:
+            self.controller.requeue(fresh)
+        self._audit(uid, fresh.key(), fresh.node_name or "", reason)
+        return True
+
+    def _audit(self, uid: str, pod_key: str, node: str,
+               reason: str) -> None:
+        """Close an audit cycle with the typed recovery reason — gated
+        on the pod's sticky sampling verdict exactly like the TTL
+        sweeper's expiry records (a mass preemption must not evict the
+        sampled pods' complete cycles from the bounded ring)."""
+        if self.obs is not None and self.obs.tracer.sampled(uid):
+            self.obs.ledger.bind_outcome(
+                uid, node, reason, False, pod=pod_key, final=True,
+            )
+
+
+class RecoveryLoop:
+    """Production driver: a daemon thread running
+    ``plane.run_once(clock(), dealer.parked_gang_pods())`` every
+    ``period_s``. The sim never uses this — it steps the plane
+    deterministically through its own ``recovery_cycle`` events."""
+
+    def __init__(self, plane: RecoveryPlane, period_s: float = 2.0):
+        self.plane = plane
+        self.period_s = period_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="recovery",
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.plane.run_once(
+                    self.plane.clock(),
+                    self.plane.dealer.parked_gang_pods(),
+                )
+            except Exception:  # the loop must outlive any one cycle
+                log.exception("recovery cycle failed")

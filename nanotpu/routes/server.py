@@ -547,7 +547,12 @@ class SchedulerAPI:
         records = self.obs.ledger.recent(limit)
         shard_status = getattr(self.dealer, "shard_status", None)
         pipeline_status = getattr(self.dealer, "pipeline_status", None)
+        recovery = getattr(self.dealer, "recovery", None)
         return 200, "application/json", json.dumps({
+            # capacity-recovery plane state (docs/defrag.md): open gang
+            # holes, active backfill leases, and the action counters —
+            # {} when no plane is attached
+            "recovery": recovery.status() if recovery is not None else {},
             "sampling": self.obs.tracer.sample,
             "count": len(records),
             "decisions": records,

@@ -92,6 +92,13 @@ class Simulator:
         # backoff jitter, whose sleeps are no-ops under virtual time
         self.rng_overload = random.Random(base + 5)
         self.rng_retry = random.Random(base + 6)
+        # the capacity-recovery plane's reserved stream: the plane itself
+        # draws nothing today (victim/target choice is a total order —
+        # nanotpu.recovery.plane), but the stream is allocated so any
+        # future recovery draw lives here and toggling `recovery.enabled`
+        # can never shift a sibling stream (same isolation rule as
+        # rng_overload; pinned by the defrag toggle test in test_sim.py)
+        self.rng_defrag = random.Random(base + 7)
 
         self.client = make_fleet(self.scenario["fleet"])
         self.faults = FaultPlan(self.scenario["faults"], self.rng_fault)
@@ -113,6 +120,36 @@ class Simulator:
             clock=lambda: self.now,
         )
         self._build_stack()
+        # the capacity-recovery plane (docs/defrag.md): priority
+        # preemption + defragmentation + gang backfill, stepped through
+        # scheduled "recovery_cycle" events on the virtual clock. Like
+        # the controller it survives agent restarts (holes/leases are
+        # control-plane intent, not dealer state) — _build_stack rewires
+        # its dealer. None when the scenario leaves it disabled, and
+        # every hook below gates on that, so default-path digests are
+        # byte-identical.
+        rec = self.scenario["recovery"]
+        if rec["enabled"]:
+            from nanotpu.recovery import RecoveryConfig, RecoveryPlane
+
+            self.plane = RecoveryPlane(
+                self.dealer,
+                controller=self.controller,
+                obs=self.obs,
+                config=RecoveryConfig(
+                    eviction_budget=rec["eviction_budget"],
+                    migration_budget=rec["migration_budget"],
+                    sweep_budget=rec["sweep_budget"],
+                    backfill=rec["backfill"],
+                    lease_grace_s=rec["lease_grace_s"],
+                    gang_start_horizon_s=rec["gang_start_horizon_s"],
+                    hole_ttl_s=rec["hole_ttl_s"],
+                ),
+                clock=lambda: self.now,
+            )
+            self.dealer.recovery = self.plane
+        else:
+            self.plane = None
         # the informer tap: the sim owns the watches and feeds the REAL
         # controller handlers, with the fault layer in between
         self._pod_watch = self.client.watch_pods()
@@ -155,6 +192,12 @@ class Simulator:
         self.prioritize = Prioritize(self.dealer, obs=self.obs)
         self.bind_verb = Bind(self.dealer, obs=self.obs)
         self.client.before_bind = self._bind_hook
+        plane = getattr(self, "plane", None)
+        if plane is not None:
+            # agent restart: the plane keeps its holes/leases (recovery
+            # intent, not dealer state) and points at the fresh dealer
+            plane.dealer = self.dealer
+            self.dealer.recovery = plane
         if hasattr(self, "controller"):
             self.controller.dealer = self.dealer
         else:
@@ -244,6 +287,12 @@ class Simulator:
             while t < horizon:
                 self._push(t, "assume_sweep", None)
                 t += ttl / 2
+        rec = self.scenario["recovery"]
+        if rec["enabled"] and rec["every_s"] > 0:
+            t = rec["every_s"]
+            while t < horizon:
+                self._push(t, "recovery_cycle", None)
+                t += rec["every_s"]
         metric_every, metric_delay = self.faults.metric_cadence()
         if metric_every > 0:
             t = metric_every
@@ -288,6 +337,8 @@ class Simulator:
             self._on_brownout(payload)
         elif kind == "assume_sweep":
             self._on_assume_sweep()
+        elif kind == "recovery_cycle":
+            self._on_recovery()
         else:  # pragma: no cover - event kinds are closed within this file
             raise AssertionError(f"unknown event kind {kind}")
 
@@ -342,8 +393,63 @@ class Simulator:
             set_current(None)
             self.obs.tracer.commit(trace)
 
+    def _gang_can_place(self, job: Job) -> bool:
+        """All-or-nothing placement check for a strict gang: virtually
+        place every UNBOUND member on scratch copies of the live chip
+        state (hole-filtered, same rule a real Filter sees). True iff
+        the whole remainder fits at once — the sim-level analogue of
+        the dealer's strict barrier, whose park a single-threaded
+        driver cannot express. The placement logic itself lives in
+        :func:`nanotpu.recovery.plane.demands_fit`, shared with the
+        plane's clearing pass so gate and plane can never drift
+        (docs/defrag.md)."""
+        from nanotpu.allocator.core import Demand
+        from nanotpu.recovery.plane import demands_fit
+
+        infos = self.dealer.debug_snapshot()["node_infos"]
+        names = sorted(infos)
+        unbound = [
+            p for p in job.pods
+            if p.name not in job.bound_t and p.name in self._pod_job
+        ]
+        if not unbound:
+            return True
+        # every member of one gang sees the same candidate filter (same
+        # annotations), so compute it once
+        allowed = names
+        if self.plane is not None:
+            allowed = self.plane.filter_candidates(
+                unbound[0], names, now=self.now
+            )
+        return demands_fit(
+            infos, allowed,
+            [Demand.from_pod(p) for p in unbound],
+            self.dealer.rater,
+        )
+
+    def _strict_gate(self, job: Job) -> bool:
+        """True when ``job`` may attempt member binds now (memoized per
+        virtual time so a 16-member retry costs one placement check)."""
+        if not (
+            job.gang and self.scenario["workload"]["gang_strict"]
+        ):
+            return True
+        if job.gate_t != self.now:
+            job.gate_ok = self._gang_can_place(job)
+            job.gate_t = self.now
+        return job.gate_ok
+
     def _try_schedule(self, job: Job, pod: Pod) -> bool:
+        if not self._strict_gate(job):
+            return False
         node_names = self._live_node_names()
+        if self.plane is not None:
+            # hole-aware candidate filtering (docs/defrag.md): nodes
+            # earmarked for other gangs are withheld unless this pod
+            # qualifies for a backfill lease
+            node_names = self.plane.filter_candidates(
+                pod, node_names, now=self.now
+            )
         if not node_names:
             return False
         args = {"Pod": pod.raw, "NodeNames": node_names}
@@ -376,13 +482,53 @@ class Simulator:
                 self.report.pods["bound"] += 1
                 self.report.config_count(job.config, "bound")
                 self.report.journal(self.now, f"bind {pod.name} -> {best}")
-                if job.gang and job.fully_bound():
+                if self.plane is not None:
+                    leased = self.plane.note_bound(
+                        pod, best, now=self.now
+                    )
+                    if leased is not None:
+                        self.report.journal(
+                            self.now,
+                            f"backfill {pod.name} @ {best} for {leased}",
+                        )
+                if (
+                    self.scenario["workload"]["lifetime_from_bind"]
+                    and not job.gang
+                    and not job.departure_scheduled
+                ):
+                    job.departure_scheduled = True
+                    self._push(
+                        self.now + job.lifetime_s, "departure", job
+                    )
+                if job.gang and job.fully_bound() and \
+                        not job.wait_recorded:
+                    # exactly-once: recovery paths can re-trigger the
+                    # fully_bound transition (a migrated member re-binds
+                    # through the replay path); the gang's wait is its
+                    # FIRST completion only
+                    job.wait_recorded = True
                     self.report.gang_waits_s.append(
                         round(self.now - job.arrival_t, 6)
                     )
                     self.report.journal(
                         self.now, f"gang-complete {job.gang}"
                     )
+                    if self.plane is not None:
+                        self.plane.gang_bound(
+                            f"{pod.namespace}/{job.gang}"
+                        )
+                    if (
+                        self.scenario["workload"]["lifetime_from_bind"]
+                        and not job.departure_scheduled
+                    ):
+                        # training holds its slice for lifetime_s FROM
+                        # START (full bind), not from submission — the
+                        # departure is scheduled here instead of at
+                        # admission (scenario knob; docs/defrag.md)
+                        job.departure_scheduled = True
+                        self._push(
+                            self.now + job.lifetime_s, "departure", job
+                        )
                 return True
             self.report.pods["bind_errors"] += 1
             self.report.journal(
@@ -406,7 +552,13 @@ class Simulator:
         for pod in job.pods:
             if not self._try_schedule(job, pod):
                 self._pending.append(pod.name)
-        self._push(self.now + job.lifetime_s, "departure", job)
+        if not self.scenario["workload"]["lifetime_from_bind"]:
+            job.departure_scheduled = True
+            self._push(self.now + job.lifetime_s, "departure", job)
+        # else: the departure is scheduled by the STARTING bind in
+        # _try_schedule (a job holds capacity lifetime_s from start:
+        # non-gang jobs start at their first bound pod, gangs at full
+        # bind); a job that never starts simply parks until the horizon
 
     def _on_arrival(self, payload: dict) -> None:
         w = self.scenario["workload"]
@@ -418,22 +570,38 @@ class Simulator:
         # shapes (drawn here, in arrival order, from rng_workload only)
         burst = bool(payload.get("burst"))
         rng = self.rng_overload if burst else self.rng_workload
+        config = payload["config"]
+        # per-config lifetime override (capacity-recovery scenarios give
+        # training gangs their own duration); absent == the shared spec,
+        # so existing scenarios draw byte-identically
+        life_spec = w["lifetime_overrides"].get(config) or w["lifetime_s"]
         # explicit trace overrides win even when falsy (lifetime_s: 0 ==
         # depart immediately); only absence falls back to the scenario
         life = trace.get("lifetime_s")
         if life is None:
-            life = draw_lifetime(w["lifetime_s"], rng)
+            life = draw_lifetime(life_spec, rng)
         gang_size = trace.get("gang_size")
         replicas = trace.get("replicas")
+        prio = w["priorities"].get(config)
         job = build_job(
             job_id=len(self.jobs),
-            config=payload["config"],
+            config=config,
             arrival_t=self.now,
             lifetime_s=float(life),
             rng=rng,
             uid_of=lambda name: self._uid(),
             gang_size=int(w["gang_size"] if gang_size is None else gang_size),
             replicas=int(w["replicas"] if replicas is None else replicas),
+            priority=prio,
+            # the DECLARED runtime is the config's mean, not the draw —
+            # the submitter's estimate, which the exp tail then exceeds
+            # (exactly what exercises the backfill lease contract)
+            declared_runtime_s=(
+                float(life_spec.get("mean", 15.0))
+                if prio is not None else None
+            ),
+            gang_percent=int(w["gang_percent"]),
+            spread_percent=int(w["spread_percent"]),
         )
         job.burst = burst
         self._admit_job(job)
@@ -456,6 +624,8 @@ class Simulator:
         if pod.name in self._pending:
             self._pending.remove(pod.name)
         self._pod_job.pop(pod.name, None)
+        if self.plane is not None:
+            self.plane.pod_gone(pod.uid)
 
     def _on_departure(self, job: Job) -> None:
         if job.departed:
@@ -471,6 +641,8 @@ class Simulator:
         self.report.pods["departed"] += n
         self.report.config_count(job.config, "departed", n)
         self.report.journal(self.now, f"depart {job.config}-{job.id} x{n}")
+        if job.gang and self.plane is not None:
+            self.plane.gang_gone(f"default/{job.gang}")
 
     def _on_flap(self) -> None:
         names = self._live_node_names()
@@ -507,6 +679,8 @@ class Simulator:
                 self.report.pods["evicted"] += 1
         self.faults.counts["gangs_killed"] += 1
         self.report.journal(self.now, f"gang-killed {job.gang}")
+        if self.plane is not None:
+            self.plane.gang_gone(f"default/{job.gang}")
         self._push(
             self.now + GANG_RESUBMIT_DELAY_S, "gang_resubmit",
             {"job": job, "incarnation": job.incarnation + 1},
@@ -516,15 +690,26 @@ class Simulator:
         old: Job = payload["job"]
         incarnation = payload.get("incarnation", 1)
         w = self.scenario["workload"]
+        life_spec = (
+            w["lifetime_overrides"].get(old.config) or w["lifetime_s"]
+        )
+        prio = w["priorities"].get(old.config)
         job = build_job(
             job_id=old.id,
             config=old.config,
             arrival_t=self.now,
-            lifetime_s=draw_lifetime(w["lifetime_s"], self.rng_lifecycle),
+            lifetime_s=draw_lifetime(life_spec, self.rng_lifecycle),
             rng=self.rng_lifecycle,
             uid_of=lambda name: self._uid(),
             gang_size=old.size,
             incarnation=incarnation,
+            priority=prio,
+            declared_runtime_s=(
+                float(life_spec.get("mean", 15.0))
+                if prio is not None else None
+            ),
+            gang_percent=int(w["gang_percent"]),
+            spread_percent=int(w["spread_percent"]),
         )
         self._admit_job(job)
 
@@ -575,6 +760,40 @@ class Simulator:
         self.report.journal(
             self.now, "brownout-start" if active else "brownout-end"
         )
+
+    def _on_recovery(self) -> None:
+        """One capacity-recovery cycle on virtual time: hand the plane
+        the pending GANG pods (the sim's view of a parked gang — the
+        single-threaded driver cannot park strict barriers, so pending
+        members stand in for parked reservations), journal every action
+        (the digest witnesses each preempt/migrate/lease decision), and
+        requeue evicted pods into the pending list — the sim-side half
+        of preempt-and-requeue (the coalescing-queue half runs inside
+        the plane via Controller.requeue)."""
+        parked = []
+        for name in self._pending:
+            job = self._pod_job.get(name)
+            if job is None or job.departed or not job.gang:
+                continue
+            try:
+                parked.append(self.client.get_pod("default", name))
+            except Exception:
+                continue
+        result = self.plane.run_once(self.now, parked)
+        for kind, detail in result["actions"]:
+            self.report.journal(self.now, f"{kind} {detail}")
+        for name in result["evicted"]:
+            job = self._pod_job.get(name)
+            if job is not None and not job.departed and \
+                    name not in self._pending:
+                self._pending.append(name)
+        if result["actions"]:
+            # a cycle that acted nudges an immediate retry — the sim
+            # analogue of the plane's force=True requeue through the
+            # coalescing queue: cleared capacity must not idle until the
+            # next retry tick (that idle is exactly the reserved-capacity
+            # waste the backfill half exists to recoup)
+            self._on_retry()
 
     def _on_assume_sweep(self) -> None:
         expired = self.controller.sweep_assumed_once(
@@ -740,6 +959,24 @@ class Simulator:
                 f"throughput agg={agg['aggregate']:.4f} "
                 f"oracle={agg['oracle']:.4f} "
                 f"loss={agg['loss_vs_oracle_pct']:.2f}%",
+            )
+        if self.plane is not None:
+            # deterministic recovery section: counters are bumped only on
+            # the sim thread (run_once / note_bound), so they are part of
+            # the determinism contract like the resilience slice
+            status = self.plane.status()
+            counters = self.plane.counters.snapshot()
+            self.report.recovery = {
+                "counters": counters,
+                "holes_final": status["holes"],
+                "leases_final": status["leases"],
+            }
+            self.report.journal(
+                horizon,
+                f"recovery preempted={counters['preempted_pods']} "
+                f"migrated={counters['migrated_pods']} "
+                f"backfilled={counters['backfill_leases']} "
+                f"lease_expired={counters['backfill_lease_expiries']}",
             )
 
 

@@ -111,6 +111,10 @@ class ReportBuilder:
         #: het-throughput certification metric, docs/scoring.md);
         #: empty == scenario did not enable throughput_report
         self.throughput: dict = {}
+        #: capacity-recovery counters + final hole/lease state
+        #: (docs/defrag.md); empty == recovery disabled, keeping
+        #: existing scenario reports (and digests) byte-identical
+        self.recovery: dict = {}
         self.restart_occupancy_drift = 0.0
         self.final_occupancy = 0.0
         self.final_fragmentation = 0.0
@@ -192,6 +196,16 @@ class ReportBuilder:
             report["throughput"] = {
                 k: self.throughput[k] for k in sorted(self.throughput)
             }
+        if self.recovery:
+            # same opt-in rule as the throughput section
+            rec: dict = {}
+            for k in sorted(self.recovery):
+                v = self.recovery[k]
+                rec[k] = (
+                    {kk: v[kk] for kk in sorted(v)}
+                    if isinstance(v, dict) else v
+                )
+            report["recovery"] = rec
         if include_timing:
             report["timing"] = {
                 "note": "wall-clock; excluded from the determinism contract",

@@ -63,6 +63,20 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
                                      # settle journal line — off keeps
                                      # existing scenario digests
                                      # byte-identical
+      "recovery": {                  # capacity-recovery plane
+                                     # (docs/defrag.md); absent/disabled
+                                     # keeps every existing digest
+                                     # byte-identical
+        "enabled": false,
+        "every_s": 0.5,              # recovery-cycle cadence
+        "eviction_budget": 8,        # max preemptions per cycle
+        "migration_budget": 4,       # max defrag migrations per cycle
+        "sweep_budget": 2,           # steady-state consolidation trickle
+        "backfill": true,            # lease short pods into gang holes
+        "lease_grace_s": 0.5,
+        "gang_start_horizon_s": 5.0, # hole's promised gang start
+        "hole_ttl_s": 30.0
+      },
       "lock_witness": false,         # true: instrument every lock and
                                      # assert acquisition-order acyclicity
                                      # at teardown (docs/static-analysis.md)
@@ -144,6 +158,53 @@ def normalize_scenario(raw: dict) -> dict:
     _require(float(life.get("mean", 0)) > 0, "lifetime_s.mean must be > 0")
     w.setdefault("gang_size", 8)
     w.setdefault("replicas", 4)
+    # capacity-recovery workload shaping (docs/defrag.md). Defaults keep
+    # every existing scenario's jobs — and digests — byte-identical.
+    overrides = w.setdefault("lifetime_overrides", {})
+    _require(
+        isinstance(overrides, dict)
+        and all(k in CONFIG_KINDS for k in overrides),
+        f"workload.lifetime_overrides keys must be among {CONFIG_KINDS}",
+    )
+    for key, spec in overrides.items():
+        _require(
+            isinstance(spec, dict)
+            and spec.get("dist", "exp") in ("exp", "fixed")
+            and float(spec.get("mean", 0)) > 0,
+            f"workload.lifetime_overrides[{key!r}] needs dist exp|fixed "
+            "and mean > 0",
+        )
+    priorities = w.setdefault("priorities", {})
+    _require(
+        isinstance(priorities, dict)
+        and all(k in CONFIG_KINDS for k in priorities),
+        f"workload.priorities keys must be among {CONFIG_KINDS}",
+    )
+    gang_percent = int(w.setdefault("gang_percent", 200))
+    _require(
+        gang_percent > 0
+        and (gang_percent < 100 or gang_percent % 100 == 0),
+        "workload.gang_percent must be a valid per-member chip demand",
+    )
+    spread_percent = int(w.setdefault("spread_percent", 100))
+    _require(
+        spread_percent > 0
+        and (spread_percent < 100 or spread_percent % 100 == 0),
+        "workload.spread_percent must be a valid per-replica chip demand",
+    )
+    # job semantics: departures fire lifetime_s after the job STARTS
+    # (non-gang: first pod bound; gang: fully bound) instead of after
+    # arrival — waiting delays service instead of destroying it, so a
+    # recovery-induced delay shifts work later rather than erasing
+    # chip-seconds (the occupancy-equality basis of the defrag
+    # certification, docs/defrag.md). Default False == the historical
+    # window semantics, byte-identical digests.
+    w.setdefault("lifetime_from_bind", False)
+    # all-or-nothing gang admission: members bind only when the WHOLE
+    # gang can place at once (no partial holds — the sim-level analogue
+    # of the dealer's strict barrier, which a single-threaded driver
+    # cannot park; docs/defrag.md "Strict gangs in the sim")
+    w.setdefault("gang_strict", False)
 
     f = dict(raw.get("faults") or {})
     for key in ("node_flap", "bind_failure", "drop_event", "dup_event",
@@ -171,6 +232,29 @@ def normalize_scenario(raw: dict) -> dict:
         and pipeline >= 1,
         f"pipeline must be an int >= 1, got {pipeline!r}",
     )
+    rec = dict(raw.get("recovery") or {})
+    recovery = {
+        "enabled": bool(rec.get("enabled", False)),
+        "every_s": float(rec.get("every_s", 0.5)),
+        "eviction_budget": int(rec.get("eviction_budget", 8)),
+        "migration_budget": int(rec.get("migration_budget", 4)),
+        "sweep_budget": int(rec.get("sweep_budget", 2)),
+        "backfill": bool(rec.get("backfill", True)),
+        "lease_grace_s": float(rec.get("lease_grace_s", 0.5)),
+        "gang_start_horizon_s": float(
+            rec.get("gang_start_horizon_s", 5.0)
+        ),
+        "hole_ttl_s": float(rec.get("hole_ttl_s", 30.0)),
+    }
+    _require(
+        not recovery["enabled"] or recovery["every_s"] > 0,
+        "recovery.every_s must be > 0 when recovery is enabled",
+    )
+    _require(
+        recovery["eviction_budget"] >= 0
+        and recovery["migration_budget"] >= 0,
+        "recovery budgets must be >= 0",
+    )
 
     return {
         "name": raw.get("name", "unnamed"),
@@ -188,6 +272,7 @@ def normalize_scenario(raw: dict) -> dict:
         "queue_max": int(raw.get("queue_max", 0)),
         "shards": shards,
         "pipeline": pipeline,
+        "recovery": recovery,
         "metric_from_allocation": bool(
             raw.get("metric_from_allocation", False)
         ),

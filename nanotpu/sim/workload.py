@@ -55,6 +55,18 @@ class Job:
     #: True for overload-fault burst arrivals (their draws live on the
     #: sim's rng_overload stream, never rng_workload)
     burst: bool = False
+    #: exactly-once latch for the gang-wait observation: recovery paths
+    #: (a member migrated and re-learned, a preempted-then-rebound
+    #: sibling) can re-trigger the fully_bound transition, and the wait
+    #: metric must record each gang's first completion only
+    wait_recorded: bool = False
+    #: strict-gate memo for the current virtual time (one all-or-nothing
+    #: placement check per gang per event, not one per member)
+    gate_t: float = -1.0
+    gate_ok: bool = False
+    #: latch: the job's departure event has been scheduled (exactly once,
+    #: whether at admission or — under lifetime_from_bind — at start)
+    departure_scheduled: bool = False
 
     @property
     def size(self) -> int:
@@ -80,14 +92,34 @@ def build_job(
     gang_size: int = 8,
     replicas: int = 4,
     incarnation: int = 0,
+    priority: int | None = None,
+    declared_runtime_s: float | None = None,
+    gang_percent: int = 200,
+    spread_percent: int = 100,
 ) -> Job:
     """Materialize a job's pods. ``uid_of(pod_name)`` must return a unique
     uid per call — K8s never reuses uids, and the dealer's released-uid
     tombstones rely on that (a resubmitted gang with recycled uids would
-    silently leak chips)."""
+    silently leak chips).
+
+    ``priority`` stamps the capacity-recovery priority class and
+    ``declared_runtime_s`` the submitter's runtime ESTIMATE (the
+    scenario's configured mean, not the drawn lifetime — backfill's
+    lease contract is exercised exactly by pods that outlive their
+    declaration); both default to absent so scenarios without a
+    ``priorities`` section build byte-identical pods. ``gang_percent``
+    shapes gang_llama members' per-member chip demand (default 200 ==
+    the historical 2-chip trainer)."""
     if config not in CONFIG_KINDS:
         raise ValueError(f"unknown workload config {config!r}")
     tag = f"{config}-{job_id}" + (f"-r{incarnation}" if incarnation else "")
+    extra: dict[str, str] = {}
+    if priority is not None:
+        extra[types.ANNOTATION_PRIORITY] = str(int(priority))
+    if declared_runtime_s is not None:
+        extra[types.ANNOTATION_EXPECTED_RUNTIME] = (
+            f"{float(declared_runtime_s):g}"
+        )
     gang = None
     pods: list[Pod] = []
     if config == "fractional":
@@ -95,12 +127,16 @@ def build_job(
         pods.append(_pod(
             f"{tag}-0", uid_of(f"{tag}-0"),
             [make_container("main", {types.RESOURCE_TPU_PERCENT: percent})],
+            annotations=dict(extra) if extra else None,
         ))
     elif config == "spread":
         for i in range(replicas):
             pods.append(_pod(
                 f"{tag}-{i}", uid_of(f"{tag}-{i}"),
-                [make_container("srv", {types.RESOURCE_TPU_PERCENT: 100})],
+                [make_container(
+                    "srv", {types.RESOURCE_TPU_PERCENT: spread_percent}
+                )],
+                annotations=dict(extra) if extra else None,
             ))
     elif config == "multi_container":
         pods.append(_pod(
@@ -109,16 +145,20 @@ def build_job(
                 make_container("actor", {types.RESOURCE_TPU_PERCENT: 100}),
                 make_container("learner", {types.RESOURCE_TPU_PERCENT: 100}),
             ],
+            annotations=dict(extra) if extra else None,
         ))
     elif config == "gang_llama":
         gang = f"llama3-{job_id}"
         for i in range(gang_size):
             pods.append(_pod(
                 f"{tag}-{i}", uid_of(f"{tag}-{i}"),
-                [make_container("trainer", {types.RESOURCE_TPU_PERCENT: 200})],
+                [make_container(
+                    "trainer", {types.RESOURCE_TPU_PERCENT: gang_percent}
+                )],
                 annotations={
                     types.ANNOTATION_GANG_NAME: gang,
                     types.ANNOTATION_GANG_SIZE: str(gang_size),
+                    **extra,
                 },
             ))
     elif config == "mixtral":
@@ -130,6 +170,7 @@ def build_job(
                 annotations={
                     types.ANNOTATION_GANG_NAME: gang,
                     types.ANNOTATION_GANG_SIZE: "8",
+                    **extra,
                 },
             ))
     return Job(

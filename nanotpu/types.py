@@ -52,6 +52,12 @@ HBM_MIB_PER_CHIP = {
 #: Reference: GPUPercentEachCard = 100 (pkg/types/types.go:10).
 PERCENT_PER_CHIP = 100
 
+#: FailedNodes reason for a candidate withheld because it is earmarked
+#: for a parked higher-priority gang (a capacity-recovery hole,
+#: docs/defrag.md) — kube-scheduler steers the pod elsewhere and the
+#: reservation survives the arrival stream.
+REASON_HOLE_RESERVED = "reserved for a parked gang (capacity-recovery hole)"
+
 #: FailedNodes reason for an infeasible candidate. One constant because
 #: TWO paths emit it — the fused native render (dealer/batch.py bakes it
 #: into pre-rendered fragments) and the assume() slow path (dealer.py) —
@@ -128,6 +134,25 @@ ANNOTATION_GANG_TIMEOUT = "tpu.io/gang-timeout-seconds"
 #: completes (quota, node failure) cannot wedge binds forever — the
 #: reservation rolls back and kube-scheduler retries the pod.
 GANG_BARRIER_TIMEOUT_S = 30.0
+
+# --------------------------------------------------------------------------
+# Capacity recovery: priority classes, preemption, gang backfill
+# (docs/defrag.md; no reference analogue).
+# --------------------------------------------------------------------------
+
+#: Pod priority class (int as string; default 0). The capacity-recovery
+#: plane may evict/migrate a lower-priority pod to place a higher-priority
+#: parked gang; equal or higher priority is never disturbed.
+ANNOTATION_PRIORITY = "tpu.io/priority"
+
+#: Default priority for pods that declare none.
+PRIORITY_DEFAULT = 0
+
+#: The submitter's runtime ESTIMATE (seconds, float as string) — what the
+#: backfill gate compares against a gang hole's expected start. A pod that
+#: outlives its declared runtime inside a hole is evicted when its lease
+#: expires (reason ``lease_expired``).
+ANNOTATION_EXPECTED_RUNTIME = "tpu.io/expected-runtime-s"
 
 # --------------------------------------------------------------------------
 # Placement-policy names (CLI flag values).

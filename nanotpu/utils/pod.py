@@ -126,6 +126,56 @@ def get_assigned_chips(pod: Pod) -> dict[str, list[int]] | None:
     return out
 
 
+# -- capacity-recovery helpers (docs/defrag.md) ----------------------------
+
+def priority_of(pod: Pod) -> int:
+    """The pod's priority class (``tpu.io/priority``); malformed or absent
+    values read as the default so a typo can never make a pod preemptible
+    by accident in one direction and unevictable in the other — it just
+    lands in the default class."""
+    raw = pod.annotations.get(types.ANNOTATION_PRIORITY)
+    if raw is None:
+        return types.PRIORITY_DEFAULT
+    try:
+        return int(raw)
+    except ValueError:
+        return types.PRIORITY_DEFAULT
+
+
+def expected_runtime_s(pod: Pod) -> float | None:
+    """The submitter's declared runtime estimate, or None when undeclared/
+    malformed — an undeclared runtime disqualifies the pod from backfill
+    (the lease contract needs an expiry to enforce)."""
+    import math
+
+    raw = pod.annotations.get(types.ANNOTATION_EXPECTED_RUNTIME)
+    if raw is None:
+        return None
+    try:
+        val = float(raw)
+    except ValueError:
+        return None
+    return val if math.isfinite(val) and val > 0 else None
+
+
+def strip_placement(pod: Pod, clear_node: bool = False) -> Pod:
+    """Deep-copied pod with every placement mark removed: the assume
+    annotation AND label, the bound-by policy, and each container's chip
+    annotation — exactly what the assume-TTL sweeper strips, shared here
+    so preemption (which additionally clears ``spec.nodeName``, the
+    requeue half of preempt-and-requeue) can never drift from it."""
+    out = pod.deepcopy()
+    ann = out.ensure_annotations()
+    ann.pop(types.ANNOTATION_ASSUME, None)
+    ann.pop(types.ANNOTATION_BOUND_POLICY, None)
+    for c in out.containers:
+        ann.pop(types.ANNOTATION_CONTAINER_FMT.format(name=c.name), None)
+    out.ensure_labels().pop(types.ANNOTATION_ASSUME, None)
+    if clear_node:
+        (out.raw.get("spec") or {}).pop("nodeName", None)
+    return out
+
+
 # -- gang helpers (new; BASELINE configs 3-4) ------------------------------
 
 def gang_of(pod: Pod) -> tuple[str, int] | None:

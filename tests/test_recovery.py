@@ -1,0 +1,634 @@
+"""Capacity-recovery plane tests (docs/defrag.md).
+
+Four tiers:
+
+* unit — priority/runtime/strip helpers, ``Dealer.migrate`` (including
+  write-failure rollback), victim selection, budgets, hole/lease
+  bookkeeping, the metrics exporter;
+* wiring — the ``/debug/decisions`` recovery surface and the decision
+  ledger's typed reason codes;
+* **certification** (the ``make sim-defrag`` gate) — the
+  gangs-vs-bursty scenario with recovery ON vs OFF: strict-gang wait
+  p99 drops >=10x at equal (+-2 pp) mean occupancy, mean fragmentation
+  strictly lower, every recovery counter nonzero, zero invariant
+  violations;
+* **replay safety** — migrations interrupted by agent restart /
+  bind-API failures / an API brownout must converge to ground truth
+  through the existing assume/forget replay, with a byte-reproducible
+  digest.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.core import Demand
+from nanotpu.allocator.rater import make_rater
+from nanotpu.dealer import Dealer
+from nanotpu.dealer.dealer import BindError, plan_from_pod
+from nanotpu.k8s.client import ApiError, FakeClientset
+from nanotpu.k8s.objects import make_container, make_pod
+from nanotpu.metrics.recovery import (
+    _RECOVERY_METRICS,
+    RecoveryCounters,
+    RecoveryExporter,
+)
+from nanotpu.obs.decisions import (
+    REASON_BACKFILLED,
+    REASON_LEASE_EXPIRED,
+    REASON_MIGRATED,
+    REASON_PREEMPTED,
+    REASONS,
+)
+from nanotpu.recovery import Hole, RecoveryConfig, RecoveryPlane
+from nanotpu.utils import pod as podutil
+from tests.harness import v5p_node
+
+CERT_SCENARIO = "examples/sim/gangs-vs-bursty.json"
+
+
+def small_cluster(n_nodes: int = 4):
+    client = FakeClientset()
+    for i in range(n_nodes):
+        client.create_node(v5p_node(f"host-{i}", coords=f"{i},0,0"))
+    return client
+
+
+def frac_pod(name, percent=25, priority=0, runtime=None, uid=None):
+    ann = {types.ANNOTATION_PRIORITY: str(priority)}
+    if runtime is not None:
+        ann[types.ANNOTATION_EXPECTED_RUNTIME] = str(runtime)
+    return make_pod(
+        name, uid=uid or f"uid-{name}",
+        containers=[
+            make_container("main", {types.RESOURCE_TPU_PERCENT: percent})
+        ],
+        annotations=ann,
+    )
+
+
+def gang_pod(name, gang, size, percent=400, priority=100, uid=None):
+    return make_pod(
+        name, uid=uid or f"uid-{name}",
+        containers=[
+            make_container("w", {types.RESOURCE_TPU_PERCENT: percent})
+        ],
+        annotations={
+            types.ANNOTATION_GANG_NAME: gang,
+            types.ANNOTATION_GANG_SIZE: str(size),
+            types.ANNOTATION_PRIORITY: str(priority),
+        },
+    )
+
+
+def bind_pod(client, dealer, pod, node):
+    created = client.create_pod(pod)
+    return dealer.bind(node, created)
+
+
+class TestPodHelpers:
+    def test_priority_default_and_parse(self):
+        assert podutil.priority_of(frac_pod("a")) == 0
+        assert podutil.priority_of(frac_pod("b", priority=7)) == 7
+        p = make_pod("c", containers=[make_container("m", {})])
+        assert podutil.priority_of(p) == types.PRIORITY_DEFAULT
+        p.ensure_annotations()[types.ANNOTATION_PRIORITY] = "oops"
+        assert podutil.priority_of(p) == types.PRIORITY_DEFAULT
+
+    def test_expected_runtime_parse(self):
+        assert podutil.expected_runtime_s(
+            frac_pod("a", runtime=2.5)
+        ) == 2.5
+        assert podutil.expected_runtime_s(frac_pod("b")) is None
+        bad = frac_pod("c")
+        bad.ensure_annotations()[
+            types.ANNOTATION_EXPECTED_RUNTIME
+        ] = "inf"
+        assert podutil.expected_runtime_s(bad) is None
+        bad.ensure_annotations()[
+            types.ANNOTATION_EXPECTED_RUNTIME
+        ] = "-3"
+        assert podutil.expected_runtime_s(bad) is None
+
+    def test_strip_placement_matches_sweeper_and_clears_node(self):
+        client = small_cluster(1)
+        dealer = Dealer(client, make_rater("binpack"))
+        bound = bind_pod(client, dealer, frac_pod("p"), "host-0")
+        assert bound.node_name == "host-0"
+        stripped = podutil.strip_placement(bound, clear_node=True)
+        assert not podutil.is_assumed(stripped)
+        assert stripped.node_name is None or stripped.node_name == ""
+        assert types.ANNOTATION_BOUND_POLICY not in stripped.annotations
+        for c in stripped.containers:
+            key = types.ANNOTATION_CONTAINER_FMT.format(name=c.name)
+            assert key not in stripped.annotations
+        # the priority annotation is NOT placement: it survives
+        assert types.ANNOTATION_PRIORITY in stripped.annotations
+        # without clear_node, spec.nodeName stays (the sweeper's shape)
+        kept = podutil.strip_placement(bound)
+        assert kept.node_name == "host-0"
+        dealer.close()
+
+
+class TestDealerMigrate:
+    def test_migrate_moves_annotations_and_accounting(self):
+        client = small_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        bound = bind_pod(client, dealer, frac_pod("p"), "host-0")
+        snap = dealer.debug_snapshot()
+        assert snap["node_infos"]["host-0"].chips.percent_used() == 25
+        moved = dealer.migrate(bound, "host-1")
+        assert moved.node_name == "host-1"
+        assert plan_from_pod(moved) is not None
+        snap = dealer.debug_snapshot()
+        assert snap["node_infos"]["host-0"].chips.percent_used() == 0
+        assert snap["node_infos"]["host-1"].chips.percent_used() == 25
+        assert snap["accounted"][bound.uid] == "host-1"
+        # the durable view moved too: a fresh dealer replays onto host-1
+        dealer2 = Dealer(client, make_rater("binpack"))
+        snap2 = dealer2.debug_snapshot()
+        assert snap2["node_infos"]["host-1"].chips.percent_used() == 25
+        assert snap2["node_infos"]["host-0"].chips.percent_used() == 0
+        dealer.close()
+        dealer2.close()
+
+    def test_migrate_same_node_is_noop(self):
+        client = small_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        bound = bind_pod(client, dealer, frac_pod("p"), "host-0")
+        again = dealer.migrate(bound, "host-0")
+        assert again.node_name == "host-0"
+        dealer.close()
+
+    def test_migrate_untracked_raises(self):
+        client = small_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        stranger = frac_pod("ghost")
+        with pytest.raises(BindError):
+            dealer.migrate(stranger, "host-1")
+        dealer.close()
+
+    def test_migrate_write_failure_rolls_back_target(self):
+        """A failed annotation write must leave the SOURCE placement
+        intact and the target reservation rolled back — a brownout
+        mid-defrag degrades to 'nothing moved'."""
+        client = small_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        bound = bind_pod(client, dealer, frac_pod("p"), "host-0")
+
+        def boom(pod):
+            raise ApiError("injected brownout", code=503)
+
+        client.before_update_pod = boom
+        with pytest.raises(BindError):
+            dealer.migrate(bound, "host-1")
+        client.before_update_pod = None
+        snap = dealer.debug_snapshot()
+        assert snap["node_infos"]["host-0"].chips.percent_used() == 25
+        assert snap["node_infos"]["host-1"].chips.percent_used() == 0
+        assert snap["accounted"][bound.uid] == "host-0"
+        live = client.get_pod("default", "p")
+        assert live.node_name == "host-0"
+        assert plan_from_pod(live) is not None
+        dealer.close()
+
+    def test_migrate_infeasible_target_raises_and_keeps_source(self):
+        client = small_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        # fill host-1 completely
+        bind_pod(
+            client, dealer,
+            make_pod("big", containers=[
+                make_container("m", {types.RESOURCE_TPU_PERCENT: 400})
+            ]),
+            "host-1",
+        )
+        bound = bind_pod(client, dealer, frac_pod("p"), "host-0")
+        with pytest.raises(BindError):
+            dealer.migrate(bound, "host-1")
+        snap = dealer.debug_snapshot()
+        assert snap["node_infos"]["host-0"].chips.percent_used() == 25
+        dealer.close()
+
+    def test_migrate_updates_gang_membership(self):
+        client = small_cluster(3)
+        dealer = Dealer(client, make_rater("binpack"))
+        bound = bind_pod(
+            client, dealer, gang_pod("g-0", "job", 2, percent=100),
+            "host-0",
+        )
+        assert dealer.gangs.bound_nodes("default/job") == ["host-0"]
+        dealer.migrate(bound, "host-2")
+        assert dealer.gangs.bound_nodes("default/job") == ["host-2"]
+        dealer.close()
+
+
+def make_plane(client, dealer, **cfg):
+    defaults = dict(
+        eviction_budget=8, migration_budget=4, sweep_budget=0,
+        backfill=True, lease_grace_s=0.25, gang_start_horizon_s=2.0,
+        hole_ttl_s=10.0,
+    )
+    defaults.update(cfg)
+    clock = {"now": 0.0}
+    plane = RecoveryPlane(
+        dealer, config=RecoveryConfig(**defaults),
+        clock=lambda: clock["now"],
+    )
+    return plane, clock
+
+
+class TestPlane:
+    def test_preempts_lower_priority_for_parked_gang(self):
+        client = small_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        plane, _ = make_plane(client, dealer, migration_budget=0)
+        # both hosts blocked by one fractional pod each (no migration
+        # budget -> must evict); no runtime declared -> no lazy lease
+        for i in range(2):
+            bind_pod(client, dealer, frac_pod(f"f-{i}"), f"host-{i}")
+        parked = [
+            client.create_pod(gang_pod(f"g-{i}", "train", 2))
+            for i in range(2)
+        ]
+        result = plane.run_once(0.0, parked)
+        assert plane.counters.preempted_pods == 2
+        assert sorted(result["evicted"]) == ["f-0", "f-1"]
+        assert plane.counters.holes_opened == 1
+        hole = plane.holes["default/train"]
+        assert hole.nodes == {"host-0", "host-1"}
+        # the evicted pods lost their placement durably
+        for i in range(2):
+            live = client.get_pod("default", f"f-{i}")
+            assert not podutil.is_assumed(live)
+            assert not live.node_name
+            assert not dealer.tracks(live.uid)
+        dealer.close()
+
+    def test_never_evicts_equal_priority_or_gang_pods(self):
+        client = small_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        plane, _ = make_plane(client, dealer, migration_budget=0)
+        bind_pod(
+            client, dealer, frac_pod("same", priority=100), "host-0"
+        )
+        bind_pod(
+            client, dealer,
+            gang_pod("other-0", "other", 2, percent=25, priority=0),
+            "host-1",
+        )
+        parked = [
+            client.create_pod(gang_pod(f"g-{i}", "train", 2))
+            for i in range(2)
+        ]
+        plane.run_once(0.0, parked)
+        assert plane.counters.preempted_pods == 0
+        assert plane.counters.preempt_infeasible > 0
+        assert dealer.tracks("uid-same")
+        assert dealer.tracks("uid-other-0")
+        dealer.close()
+
+    def test_eviction_budget_bounds_a_cycle(self):
+        client = small_cluster(4)
+        dealer = Dealer(client, make_rater("binpack"))
+        plane, _ = make_plane(
+            client, dealer, eviction_budget=2, migration_budget=0,
+        )
+        for i in range(4):
+            bind_pod(client, dealer, frac_pod(f"f-{i}"), f"host-{i}")
+        parked = [
+            client.create_pod(gang_pod(f"g-{i}", "train", 4))
+            for i in range(4)
+        ]
+        result = plane.run_once(0.0, parked)
+        assert len(result["evicted"]) == 2
+        assert plane.counters.preempted_pods == 2
+        assert plane.counters.eviction_budget_hits >= 1
+        dealer.close()
+
+    def test_migration_preferred_over_eviction(self):
+        client = small_cluster(3)
+        dealer = Dealer(client, make_rater("binpack"))
+        plane, _ = make_plane(client, dealer)
+        # host-2 already fractional: a loss-free migration target
+        bind_pod(client, dealer, frac_pod("anchor"), "host-2")
+        blocker = bind_pod(client, dealer, frac_pod("mv"), "host-0")
+        bind_pod(client, dealer, frac_pod("mv2"), "host-1")
+        parked = [
+            client.create_pod(gang_pod(f"g-{i}", "train", 2))
+            for i in range(2)
+        ]
+        plane.run_once(0.0, parked)
+        assert plane.counters.migrated_pods >= 1
+        moved = client.get_pod("default", "mv")
+        others = {p.name: p.node_name for p in client.list_pods()}
+        # the movable blockers left their hosts without losing placement
+        assert others["mv"] not in ("host-0",)
+        assert dealer.tracks(blocker.uid)
+        dealer.close()
+
+    def test_filter_candidates_protects_holes_and_admits_backfill(self):
+        client = small_cluster(3)
+        dealer = Dealer(client, make_rater("binpack"))
+        plane, clock = make_plane(client, dealer)
+        plane.holes["default/train"] = Hole(
+            gang_key="default/train", priority=100, opened_t=0.0,
+            expected_start=5.0, nodes={"host-0", "host-1"},
+            last_parked_t=0.0,
+        )
+        names = ["host-0", "host-1", "host-2"]
+        # a plain pod (no declared runtime) is filtered off hole nodes
+        assert plane.filter_candidates(
+            frac_pod("plain"), names, now=0.0
+        ) == ["host-2"]
+        # a short declared-runtime low-priority pod keeps them
+        assert plane.filter_candidates(
+            frac_pod("short", runtime=1.0), names, now=0.0
+        ) == names
+        # ... but not when its declared end crosses the expected start
+        assert plane.filter_candidates(
+            frac_pod("long", runtime=10.0), names, now=0.0
+        ) == ["host-2"]
+        # the gang's own members see their hole
+        assert plane.filter_candidates(
+            gang_pod("g-0", "train", 2), names, now=0.0
+        ) == names
+        # another gang does not
+        assert plane.filter_candidates(
+            gang_pod("h-0", "other", 2), names, now=0.0
+        ) == ["host-2"]
+        dealer.close()
+
+    def test_note_bound_grants_lease_and_expiry_evicts(self):
+        client = small_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        plane, clock = make_plane(client, dealer)
+        plane.holes["default/train"] = Hole(
+            gang_key="default/train", priority=100, opened_t=0.0,
+            expected_start=5.0, nodes={"host-0"}, last_parked_t=0.0,
+        )
+        bound = bind_pod(
+            client, dealer, frac_pod("bf", runtime=1.0), "host-0"
+        )
+        leased = plane.note_bound(bound, "host-0", now=0.0)
+        assert leased == "default/train"
+        assert plane.counters.backfill_leases == 1
+        lease = plane.holes["default/train"].leases[bound.uid]
+        assert lease.expires_at == pytest.approx(1.25)
+        # before expiry: a cycle leaves it alone
+        plane.run_once(1.0, [])
+        assert dealer.tracks(bound.uid)
+        # past expiry with the pod still running: evicted, typed reason
+        clock["now"] = 2.0
+        result = plane.run_once(2.0, [])
+        assert plane.counters.backfill_lease_expiries == 1
+        assert "bf" in result["evicted"]
+        assert not dealer.tracks(bound.uid)
+        assert any(k == "lease-expire" for k, _ in result["actions"])
+        dealer.close()
+
+    def test_lease_cleaned_when_pod_departs_naturally(self):
+        client = small_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        plane, clock = make_plane(client, dealer)
+        plane.holes["default/train"] = Hole(
+            gang_key="default/train", priority=100, opened_t=0.0,
+            expected_start=5.0, nodes={"host-0"}, last_parked_t=0.0,
+        )
+        bound = bind_pod(
+            client, dealer, frac_pod("bf", runtime=1.0), "host-0"
+        )
+        plane.note_bound(bound, "host-0", now=0.0)
+        dealer.forget(bound)  # departed on its own
+        plane.run_once(3.0, [])
+        assert plane.counters.backfill_lease_expiries == 0
+        assert not plane.holes["default/train"].leases
+        dealer.close()
+
+    def test_gang_bound_closes_hole(self):
+        client = small_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        plane, _ = make_plane(client, dealer)
+        plane.holes["default/train"] = Hole(
+            gang_key="default/train", priority=100, opened_t=0.0,
+            expected_start=5.0, nodes={"host-0"}, last_parked_t=0.0,
+        )
+        plane.counters.holes_opened += 1
+        plane.gang_bound("default/train")
+        assert not plane.holes
+        assert plane.counters.holes_closed == 1
+        dealer.close()
+
+    def test_hole_ttl_dissolves_stale_hole(self):
+        client = small_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        plane, _ = make_plane(client, dealer, hole_ttl_s=4.0)
+        plane.holes["default/train"] = Hole(
+            gang_key="default/train", priority=100, opened_t=0.0,
+            expected_start=2.0, nodes={"host-0"}, last_parked_t=0.0,
+        )
+        plane.run_once(3.0, [])
+        assert "default/train" in plane.holes
+        result = plane.run_once(5.0, [])
+        assert "default/train" not in plane.holes
+        assert ("hole-close", "default/train ttl") in result["actions"]
+        dealer.close()
+
+    def test_counters_surface_on_metrics_and_debug(self):
+        client = small_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        plane, _ = make_plane(client, dealer)
+        plane.counters.preempted_pods += 3
+        lines = RecoveryExporter(plane).render()
+        text = "\n".join(lines)
+        assert "nanotpu_sched_defrag_preempted_pods_total 3" in text
+        assert "nanotpu_gang_backfill_leases_total 0" in text
+        assert "nanotpu_sched_defrag_holes_open 0" in text
+        assert "nanotpu_gang_backfill_active_leases 0" in text
+        # the exporter table and the counter slots agree (nanolint pins
+        # this statically; the runtime pin keeps refactors honest)
+        assert set(_RECOVERY_METRICS) == set(RecoveryCounters.__slots__)
+        status = plane.status()
+        assert status["holes"] == 0 and status["leases"] == 0
+        assert status["counters"]["preempted_pods"] == 3
+        dealer.close()
+
+    def test_recovery_reasons_catalogued(self):
+        for reason in (REASON_PREEMPTED, REASON_MIGRATED,
+                       REASON_BACKFILLED, REASON_LEASE_EXPIRED):
+            assert reason in REASONS
+
+
+# ---------------------------------------------------------------------------
+# certification: the `make sim-defrag` acceptance gate (docs/defrag.md)
+# ---------------------------------------------------------------------------
+class TestCertification:
+    @pytest.fixture(scope="class")
+    def reports(self):
+        from nanotpu.sim.core import Simulator
+        from nanotpu.sim.scenario import load_scenario
+
+        out = {}
+        for enabled in (True, False):
+            scenario = load_scenario(CERT_SCENARIO)
+            scenario["recovery"]["enabled"] = enabled
+            sim = Simulator(scenario, seed=0)
+            out[enabled] = (sim, sim.run())
+            sim.dealer.close()
+        return out
+
+    def test_gang_wait_p99_drops_10x_at_equal_occupancy(self, reports):
+        """THE acceptance deltas (ISSUE 10): strict-gang wait p99 drops
+        >=10x with preempt+defrag+backfill on vs off, at equal (+-2 pp)
+        mean occupancy, with mean fragmentation strictly lower and all
+        gangs completing on both sides."""
+        _, on = reports[True]
+        _, off = reports[False]
+        assert on["invariants"]["violations"] == 0
+        assert off["invariants"]["violations"] == 0
+        assert on["gangs"]["jobs"] == off["gangs"]["jobs"] > 0
+        p99_on = on["gangs"]["wait_s"]["p99"]
+        p99_off = off["gangs"]["wait_s"]["p99"]
+        assert p99_on > 0 or p99_off == 0
+        assert p99_off >= 10.0 * max(p99_on, 1e-9), (p99_on, p99_off)
+        occ_on = on["occupancy_pct"]["mean"]
+        occ_off = off["occupancy_pct"]["mean"]
+        assert abs(occ_on - occ_off) <= 2.0, (occ_on, occ_off)
+        assert (
+            on["fragmentation"]["mean"] < off["fragmentation"]["mean"]
+        ), (on["fragmentation"], off["fragmentation"])
+
+    def test_every_recovery_tool_was_exercised(self, reports):
+        """All three tentpole mechanisms must have acted — a 10x win
+        from preemption alone would certify a smaller subsystem than
+        the one shipped."""
+        _, on = reports[True]
+        counters = on["recovery"]["counters"]
+        assert counters["preempted_pods"] > 0, counters
+        assert counters["migrated_pods"] > 0, counters
+        assert counters["backfill_leases"] > 0, counters
+        assert counters["backfill_lease_expiries"] > 0, counters
+        assert counters["holes_opened"] == counters["holes_closed"] > 0
+        assert on["recovery"]["holes_final"] == 0
+        _, off = reports[False]
+        assert "recovery" not in off
+
+    def test_typed_reasons_reach_the_ledger(self, reports):
+        """Every recovery action lands in the decision ledger as a
+        typed reason code — the audit half of the tentpole."""
+        sim, on = reports[True]
+        outcomes = [
+            r["outcome"] for r in sim.obs.ledger.dump()
+        ]
+        for reason in (REASON_PREEMPTED, REASON_MIGRATED,
+                       REASON_BACKFILLED, REASON_LEASE_EXPIRED):
+            assert reason in outcomes, reason
+
+
+# ---------------------------------------------------------------------------
+# replay safety: migration under faults converges to ground truth
+# ---------------------------------------------------------------------------
+class TestReplaySafety:
+    def _faulted(self, seed=0):
+        from nanotpu.sim.core import Simulator
+        from nanotpu.sim.scenario import load_scenario
+
+        scenario = load_scenario(CERT_SCENARIO)
+        scenario["horizon_s"] = 45.0
+        scenario["assume_ttl_s"] = 3.0
+        scenario["faults"] = {
+            "bind_failure": {"prob": 0.1},
+            "drop_event": {"prob": 0.02},
+            "dup_event": {"prob": 0.02},
+            "agent_restart": {"at_s": [20.0]},
+            "api_brownout": {"at_s": [14.0], "duration_s": 3.0},
+        }
+        sim = Simulator(scenario, seed)
+        report = sim.run()
+        return sim, report
+
+    def test_migration_under_faults_converges(self):
+        """Agent restart, injected bind failures, and an API brownout
+        mid-defrag: accounting must converge to the live annotations
+        (the assume/forget replay contract) with zero violations, and
+        failed migrations must be counted, not silent."""
+        sim, report = self._faulted()
+        assert report["invariants"]["violations"] == 0, (
+            report["invariants"]["first"]
+        )
+        counters = report["recovery"]["counters"]
+        assert counters["migrated_pods"] > 0
+        # the brownout window fails scheduler-side writes: at least one
+        # strip/migrate attempt ran into it and rolled back cleanly
+        assert (
+            counters["migration_failures"] > 0
+            or report["resilience"].get("api_failures", {})
+        )
+        assert report["faults"]["agent_restarts"] == 1
+        assert report["restart_occupancy_drift_pct"] == 0.0
+
+    def test_faulted_recovery_run_is_byte_reproducible(self):
+        _, a = self._faulted()
+        _, b = self._faulted()
+        assert a["digest"] == b["digest"]
+
+
+class TestProductionWiring:
+    """The dealer-level enforcement the live HTTP drive exercises:
+    holes answer typed FailedNodes reasons through assume(), and a
+    fully-starved gang (zero feasible Filter) feeds
+    ``parked_gang_pods`` so the RecoveryLoop can see it even though no
+    member ever reached the barrier."""
+
+    def test_assume_reports_hole_reserved(self):
+        client = small_cluster(3)
+        dealer = Dealer(client, make_rater("binpack"))
+        plane, _ = make_plane(client, dealer)
+        dealer.recovery = plane
+        plane.holes["default/train"] = Hole(
+            gang_key="default/train", priority=100, opened_t=0.0,
+            expected_start=5.0, nodes={"host-0"}, last_parked_t=0.0,
+        )
+        pod = client.create_pod(frac_pod("plain"))
+        ok, failed = dealer.assume(
+            ["host-0", "host-1", "host-2"], pod
+        )
+        assert "host-0" not in ok
+        assert failed["host-0"] == types.REASON_HOLE_RESERVED
+        assert set(ok) == {"host-1", "host-2"}
+        scored = dict(dealer.score(["host-0", "host-1"], pod))
+        assert scored["host-0"] == types.SCORE_MIN
+        # the fused render refuses while holes affect candidates: the
+        # list path carries the per-name reason
+        assert dealer.filter_payload(["host-0", "host-1"], pod) is None
+        dealer.close()
+
+    def test_starved_gang_feeds_parked_gang_pods(self):
+        client = small_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        plane, _ = make_plane(client, dealer)
+        dealer.recovery = plane
+        # every host blocked: a whole-host gang member filters to zero
+        for i in range(2):
+            bind_pod(client, dealer, frac_pod(f"f-{i}"), f"host-{i}")
+        member = client.create_pod(gang_pod("g-0", "train", 2))
+        ok, _failed = dealer.assume(["host-0", "host-1"], member)
+        assert ok == []
+        parked = dealer.parked_gang_pods()
+        assert [p.name for p in parked] == ["g-0"]
+        # ... and the entry retires the moment a Filter succeeds
+        dealer.forget(client.get_pod("default", "f-0"))
+        ok, _failed = dealer.assume(["host-0", "host-1"], member)
+        assert ok == ["host-0"]
+        assert dealer.parked_gang_pods() == []
+        dealer.close()
+
+    def test_starvation_ignored_without_plane(self):
+        client = small_cluster(1)
+        dealer = Dealer(client, make_rater("binpack"))
+        bind_pod(client, dealer, frac_pod("f"), "host-0")
+        member = client.create_pod(gang_pod("g-0", "train", 2))
+        dealer.assume(["host-0"], member)
+        assert dealer._starved == {}
+        dealer.close()

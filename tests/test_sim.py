@@ -569,6 +569,75 @@ class TestChaosScenario:
         assert on and on == off
 
 
+class TestDefragToggle:
+    """The capacity-recovery analogue of the overload-toggle matrix:
+    flipping ``recovery.enabled`` must change only what the plane DOES
+    (evictions, migrations, leases), never which base jobs arrive or
+    what shape they take — the plane draws nothing from the workload's
+    rng streams (its reserved stream is ``rng_defrag``), so the arrival
+    sequence stays a pure function of (scenario, seed)."""
+
+    def _scenario(self, enabled: bool) -> dict:
+        from nanotpu.sim.scenario import load_scenario
+
+        scenario = load_scenario("examples/sim/gangs-vs-bursty.json")
+        scenario["horizon_s"] = 20.0
+        scenario["recovery"]["enabled"] = enabled
+        return scenario
+
+    def test_defrag_toggle_does_not_reshape_base_jobs(self):
+        def job_shapes(enabled):
+            sim = Simulator(self._scenario(enabled), seed=3)
+            sim.run()
+            shapes = [
+                (j.config, round(j.lifetime_s, 9), j.size)
+                for j in sim.jobs if j.incarnation == 0
+            ]
+            sim.dealer.close()
+            return shapes
+
+        on = job_shapes(True)
+        off = job_shapes(False)
+        assert on and on == off
+
+    def test_defrag_toggle_does_not_shift_arrival_schedule(self):
+        def scheduled(enabled):
+            sim = Simulator(self._scenario(enabled), seed=3)
+            sim._schedule_static_events(20.0)
+            out = sorted(
+                (round(t, 9), payload["config"])
+                for t, _, kind, payload in sim._heap
+                if kind == "arrival"
+            )
+            sim.dealer.close()
+            return out
+
+        assert scheduled(True) == scheduled(False)
+
+    def test_recovery_stream_is_reserved(self):
+        """The plane's future draws live on rng_defrag: the stream
+        exists, is seeded per (seed), and is distinct from every
+        workload stream."""
+        sim = Simulator(self._scenario(True), seed=3)
+        others = {
+            id(sim.rng_workload), id(sim.rng_fault), id(sim.rng_metric),
+            id(sim.rng_lifecycle), id(sim.rng_overload),
+            id(sim.rng_retry),
+        }
+        assert id(sim.rng_defrag) not in others
+        twin = Simulator(self._scenario(True), seed=3)
+        assert sim.rng_defrag.random() == twin.rng_defrag.random()
+        sim.dealer.close()
+        twin.dealer.close()
+
+    def test_recovery_off_report_has_no_recovery_section(self):
+        sim = Simulator(self._scenario(False), seed=3)
+        report = sim.run()
+        assert "recovery" not in report
+        assert sim.plane is None
+        sim.dealer.close()
+
+
 @pytest.mark.slow
 class TestChurnSweep:
     """The acceptance-gate scenario at full length: a v5p-512 pool under
