@@ -3,7 +3,7 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm sim-smoke sim-multipool sim-het sim-defrag chaos-soak obs-check fanout-4k image clean
+.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm sim-smoke sim-multipool sim-het sim-defrag chaos-soak obs-check timeline-check fanout-4k image clean
 
 # Default verification tier: static analysis, then the fast inner loop
 # (test-fast includes sim-smoke), then the observability gate, then the
@@ -11,7 +11,7 @@ TAG ?= latest
 # certifications and the sharded 4096-host fan-out gate (FAST=1 skips
 # those three). The tier-1 gate (`pytest tests/ -m 'not slow'` over
 # everything) is unchanged — run it via `make test` / CI.
-all: native lint test-fast obs-check chaos-soak sim-het sim-defrag fanout-4k
+all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag fanout-4k
 
 # nanolint (docs/static-analysis.md): AST invariant passes over the
 # scheduler's concurrency & determinism contracts — lock discipline,
@@ -82,6 +82,19 @@ obs-check:
 	python -m pytest tests/test_obs.py tests/test_promtext.py -q
 	python -m nanotpu.sim --scenario examples/sim/smoke.json --seed 0 \
 		--horizon-s 12 --check-determinism > /dev/null
+
+# Telemetry gate (docs/observability.md "The telemetry timeline"):
+# timeline/SLO/flight-recorder tests (including the golden
+# /debug/timeline schema, regenerated via --regen-obs-golden like the
+# other /debug endpoints) + the chaos-style telemetry soak run TWICE
+# (--check-determinism): the report's `timeline` section — tick digest,
+# SLO breach counts, newest flight-bundle digest — must be
+# byte-reproducible, with at least one deterministic SLO breach and a
+# dealer-death bundle exercised in every run.
+timeline-check:
+	python -m pytest tests/test_timeline.py -q
+	python -m nanotpu.sim --scenario examples/sim/telemetry-soak.json \
+		--seed 0 --check-determinism > /dev/null
 
 # Overload-resilience gate (docs/robustness.md): smoke's faults + arrival
 # bursts + API brownouts through the resilient write path, bounded sync

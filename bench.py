@@ -31,6 +31,16 @@ from nanotpu.metrics.registry import Registry
 from nanotpu.metrics.stats import percentile
 from nanotpu.routes.server import SchedulerAPI, serve
 
+try:
+    # feature-detect (bench_ab runs this SAME file against base refs
+    # that predate the telemetry timeline): when present, every fan-out
+    # rep also captures a between-rep timeline tick so the artifact
+    # carries occupancy/whole-free/parked-gang state per rep — a dict,
+    # deliberately invisible to bench_ab's numeric attr diff
+    from nanotpu.obs.timeline import Timeline as _Timeline
+except ImportError:  # pragma: no cover - base-ref worktrees only
+    _Timeline = None
+
 N_HOSTS = 16
 CHIPS_PER_HOST = 4
 N_PODS = 32
@@ -363,6 +373,24 @@ def run_fanout(n_hosts: int = 256, n_pods: int = 256,
         }
     attr["payload_bytes"] = payload_bytes
     attr["inflight_peak"] = api.inflight_peak
+    if _Timeline is not None:
+        # between-rep telemetry tick (docs/observability.md): the rep's
+        # end-state rides in the artifact. OUTSIDE the timed window by
+        # construction, and a dict value — bench_ab's attribution diff
+        # sums numbers only, so A/B runs against pre-timeline bases stay
+        # byte-comparable (empty diff is the off-path cost proof)
+        tick = _Timeline(
+            dealer=dealer, verb_duration=api.verb_duration, capacity=1
+        ).tick()
+        attr["timeline"] = {
+            "occupancy": tick["fleet"]["occupancy"],
+            "whole_free_chips": tick["fleet"]["whole_free_chips"],
+            "parked_gangs": tick["fleet"]["parked_gangs"],
+            "verb_counts": {
+                verb: tick["verbs"][verb]["count"]
+                for verb in sorted(tick["verbs"])
+            },
+        }
     # the whole point of the discipline: no full collection may land
     # inside a timed window (it would be an unattributed multi-ms stall
     # charged to whatever pod it interrupts)

@@ -236,6 +236,21 @@ class ChipSet:
     def __len__(self) -> int:
         return len(self.chips)
 
+    def whole_free_indexes(self) -> frozenset:
+        """Indexes of fully-free chips — THE "whole free" definition
+        shared by the defragmenter's gain rule (nanotpu/recovery/plane.py),
+        the dealer's telemetry tap, and the fleet fragmentation walk
+        (nanotpu/dealer/frag.py), so the three can never silently
+        disagree on what counts as free."""
+        return frozenset(
+            i for i, c in enumerate(self.chips)
+            if c.percent_free == c.percent_total
+        )
+
+    def whole_free(self) -> int:
+        """Fully-free chip count (see :meth:`whole_free_indexes`)."""
+        return len(self.whole_free_indexes())
+
     # -- feasibility -------------------------------------------------------
     def can_fit(self, demand: Demand) -> bool:
         """Cheap OPTIMISTIC pre-filter using only *necessary* conditions —

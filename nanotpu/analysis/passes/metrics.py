@@ -27,6 +27,16 @@ promise has three string-ly typed seams this pass stitches shut:
   declared is a computed value no scrape ever sees. Both directions
   are findings.
 
+* **Timeline gauges** (``nanotpu_timeline_*``) and **SLO gauges**
+  (``nanotpu_slo_*``, docs/observability.md): the same exporter shape —
+  ``_TIMELINE_GAUGES`` (``nanotpu/metrics/timeline.py``) vs
+  ``Timeline.tick_gauge_values()`` and ``_SLO_GAUGES``
+  (``nanotpu/metrics/slo.py``) vs ``SLOWatchdog.slo_gauge_values()``,
+  each cross-checked both directions. The producer function names are
+  distinct per family on purpose: one shared name would pool the
+  produced sets and flag every gauge as an undeclared member of the
+  other families.
+
 * **Recovery counters** (``nanotpu_sched_defrag_*`` /
   ``nanotpu_gang_backfill_*``, docs/defrag.md): the exporter renders the
   ``_RECOVERY_METRICS`` table of ``nanotpu/metrics/recovery.py`` over the
@@ -158,9 +168,10 @@ def _reason_uses(mod: Module) -> dict[str, tuple[str, int]]:
     return uses
 
 
-def _declared_throughput_gauges(mod: Module) -> dict[str, int] | None:
-    """gauge suffix -> declaration line from the ``_THROUGHPUT_GAUGES``
-    dict literal; None when this module declares no such table."""
+def _declared_gauge_table(mod: Module, table: str) -> dict[str, int] | None:
+    """gauge suffix -> declaration line from a ``<table>`` dict literal
+    (``_THROUGHPUT_GAUGES`` / ``_TIMELINE_GAUGES`` / ``_SLO_GAUGES``);
+    None when this module declares no such table."""
     for node in mod.tree.body:
         if isinstance(node, ast.AnnAssign):
             if node.value is None or not isinstance(node.target, ast.Name):
@@ -171,7 +182,7 @@ def _declared_throughput_gauges(mod: Module) -> dict[str, int] | None:
             value = node.value
         else:
             continue
-        if "_THROUGHPUT_GAUGES" not in targets:
+        if table not in targets:
             continue
         out: dict[str, int] = {}
         if isinstance(value, ast.Dict):
@@ -184,13 +195,18 @@ def _declared_throughput_gauges(mod: Module) -> dict[str, int] | None:
     return None
 
 
-def _gauge_value_keys(mod: Module) -> dict[str, tuple[str, int]]:
+def _gauge_value_keys(mod: Module,
+                      fn_name: str = "gauge_values") -> dict[str, tuple[str, int]]:
     """gauge suffix -> first production site: string keys of dict
-    literals inside any function named ``gauge_values``."""
+    literals inside any function named ``fn_name``. The producer names
+    are DISTINCT per table on purpose (``gauge_values`` /
+    ``tick_gauge_values`` / ``slo_gauge_values``): a shared name would
+    cross-pollinate the tables' produced sets and flag every gauge as
+    an undeclared member of the other families."""
     out: dict[str, tuple[str, int]] = {}
     for node in ast.walk(mod.tree):
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                or node.name != "gauge_values":
+                or node.name != fn_name:
             continue
         for sub in ast.walk(node):
             if not isinstance(sub, ast.Dict):
@@ -208,27 +224,7 @@ def _gauge_value_keys(mod: Module) -> dict[str, tuple[str, int]]:
 def _declared_recovery_table(mod: Module) -> dict[str, int] | None:
     """slot -> declaration line from the ``_RECOVERY_METRICS`` dict
     literal; None when this module declares no such table."""
-    for node in mod.tree.body:
-        if isinstance(node, ast.AnnAssign):
-            if node.value is None or not isinstance(node.target, ast.Name):
-                continue
-            targets, value = [node.target.id], node.value
-        elif isinstance(node, ast.Assign):
-            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
-            value = node.value
-        else:
-            continue
-        if "_RECOVERY_METRICS" not in targets:
-            continue
-        out: dict[str, int] = {}
-        if isinstance(value, ast.Dict):
-            for key in value.keys:
-                if isinstance(key, ast.Constant) and isinstance(
-                    key.value, str
-                ):
-                    out[key.value] = key.lineno
-        return out
-    return None
+    return _declared_gauge_table(mod, "_RECOVERY_METRICS")
 
 
 def _declared_slots(mod: Module, cls_name: str) -> dict[str, int] | None:
@@ -268,6 +264,10 @@ class _MetricsPass:
         rslots_mod: Module | None = None
         rtable: dict[str, int] | None = None
         rtable_mod: Module | None = None
+        tlgauges: dict[str, int] | None = None
+        tlgauges_mod: Module | None = None
+        slogauges: dict[str, int] | None = None
+        slogauges_mod: Module | None = None
         for mod in modules:
             d = _declared_resilience(mod)
             if d is not None:
@@ -284,9 +284,15 @@ class _MetricsPass:
             r = _declared_reasons(mod)
             if r is not None:
                 (reasons, catalogue), reasons_mod = r, mod
-            t = _declared_throughput_gauges(mod)
+            t = _declared_gauge_table(mod, "_THROUGHPUT_GAUGES")
             if t is not None:
                 tgauges, tgauges_mod = t, mod
+            tl = _declared_gauge_table(mod, "_TIMELINE_GAUGES")
+            if tl is not None:
+                tlgauges, tlgauges_mod = tl, mod
+            sg = _declared_gauge_table(mod, "_SLO_GAUGES")
+            if sg is not None:
+                slogauges, slogauges_mod = sg, mod
 
         inc_sites: dict[str, tuple[str, int]] = {}
         perf_incs: dict[str, tuple[str, int]] = {}
@@ -404,28 +410,46 @@ class _MetricsPass:
             findings.extend(self._check_reasons(
                 modules, reasons, catalogue, reasons_mod
             ))
-        if tgauges is not None and tgauges_mod is not None:
-            produced: dict[str, tuple[str, int]] = {}
-            for mod in modules:
-                for suffix, site in _gauge_value_keys(mod).items():
-                    produced.setdefault(suffix, site)
-                    if suffix not in tgauges:
-                        findings.append(Finding(
-                            self.name, site[0], site[1],
-                            f"throughput gauge {suffix!r} is produced by "
-                            "gauge_values() here but not declared in "
-                            "_THROUGHPUT_GAUGES — it is computed on "
-                            "every scrape and never exported",
-                        ))
-            for suffix, line in sorted(tgauges.items()):
-                if suffix not in produced:
+        for family, table, table_mod, fn_name in (
+            ("throughput", tgauges, tgauges_mod, "gauge_values"),
+            ("timeline", tlgauges, tlgauges_mod, "tick_gauge_values"),
+            ("slo", slogauges, slogauges_mod, "slo_gauge_values"),
+        ):
+            if table is not None and table_mod is not None:
+                findings.extend(self._check_gauge_table(
+                    modules, family, table, table_mod, fn_name
+                ))
+        return findings
+
+    def _check_gauge_table(self, modules: list[Module], family: str,
+                           table: dict[str, int], table_mod: Module,
+                           fn_name: str) -> list[Finding]:
+        """One exported-gauge table vs its producer function, both
+        directions (throughput / timeline / SLO families all share the
+        same exporter shape: the exporter renders the table's keys by
+        indexing the producer's dict)."""
+        table_name = f"_{family.upper()}_GAUGES"
+        findings: list[Finding] = []
+        produced: dict[str, tuple[str, int]] = {}
+        for mod in modules:
+            for suffix, site in _gauge_value_keys(mod, fn_name).items():
+                produced.setdefault(suffix, site)
+                if suffix not in table:
                     findings.append(Finding(
-                        self.name, str(tgauges_mod.path), line,
-                        f"throughput gauge {suffix!r} is declared in "
-                        "_THROUGHPUT_GAUGES but no gauge_values() "
-                        "produces it — the exporter will KeyError at "
-                        "scrape time",
+                        self.name, site[0], site[1],
+                        f"{family} gauge {suffix!r} is produced by "
+                        f"{fn_name}() here but not declared in "
+                        f"{table_name} — it is computed on every scrape "
+                        "and never exported",
                     ))
+        for suffix, line in sorted(table.items()):
+            if suffix not in produced:
+                findings.append(Finding(
+                    self.name, str(table_mod.path), line,
+                    f"{family} gauge {suffix!r} is declared in "
+                    f"{table_name} but no {fn_name}() produces it — "
+                    "the exporter will KeyError at scrape time",
+                ))
         return findings
 
     def _check_reasons(self, modules: list[Module],

@@ -145,6 +145,27 @@ def build_app(argv: list[str] | None = None):
         "--recovery-migration-budget", type=int, default=4, metavar="N",
         help="max defrag migrations per recovery cycle",
     )
+    parser.add_argument(
+        "--timeline-period", type=float, default=0.0, metavar="S",
+        help="fleet telemetry timeline (docs/observability.md): sample "
+        "occupancy/fragmentation/shard health/counter deltas into a "
+        "bounded ring every S seconds, served on GET /debug/timeline "
+        "and as nanotpu_timeline_* gauges; 0 disables (zero overhead). "
+        "SLO objectives from policy.yaml's slo: section are evaluated "
+        "over the ring with two-window burn rates",
+    )
+    parser.add_argument(
+        "--timeline-capacity", type=int, default=512, metavar="N",
+        help="telemetry ticks retained in the ring (oldest evicted)",
+    )
+    parser.add_argument(
+        "--flight-recorder", default="", metavar="PATH",
+        help="crash flight recorder (docs/observability.md): dump a "
+        "post-mortem JSON bundle (recent timeline ticks, decisions + "
+        "traces joined, shard/pipeline/recovery status, counter totals) "
+        "to PATH on SLO breach, shutdown, and process exit; "
+        "faulthandler stacks land in PATH.stacks on hard crashes",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -260,6 +281,53 @@ def main(argv: list[str] | None = None) -> int:
         recovery_loop = RecoveryLoop(plane, period_s=args.recovery_period)
         recovery_loop.start()
 
+    telemetry_loop = None
+    if args.timeline_period > 0 or args.flight_recorder:
+        from nanotpu.metrics.slo import SLOWatchdog
+        from nanotpu.obs.flight import FlightRecorder
+        from nanotpu.obs.timeline import TelemetryLoop, Timeline
+
+        timeline = Timeline(
+            dealer=dealer, resilience=api.resilience,
+            verb_duration=api.verb_duration,
+            recovery=dealer.recovery,
+            model=getattr(dealer.rater, "model", None),
+            capacity=args.timeline_capacity,
+        )
+        watchdog = SLOWatchdog(timeline, obs=api.obs)
+        if api.policy_watcher is not None:
+            # chain onto the one policy watcher: the slo: section
+            # hot-applies like the throughput table (a table edit is a
+            # config push, not a deploy)
+            prev_reload = api.policy_watcher.on_reload
+
+            def _on_reload(spec, _prev=prev_reload):
+                if _prev is not None:
+                    _prev(spec)
+                if spec.slo is not None:
+                    watchdog.configure(spec.slo)
+
+            api.policy_watcher.on_reload = _on_reload
+            if api.policy_watcher.spec().slo is not None:
+                watchdog.configure(api.policy_watcher.spec().slo)
+        flight = FlightRecorder(
+            path=args.flight_recorder, timeline=timeline, obs=api.obs,
+            dealer=dealer, resilience=api.resilience,
+            config={
+                k: v for k, v in sorted(vars(args).items())
+                if not k.startswith("_")
+            },
+        )
+        if args.flight_recorder:
+            flight.install()
+        api.attach_telemetry(timeline, watchdog, flight)
+        if args.timeline_period > 0:
+            telemetry_loop = TelemetryLoop(
+                timeline, watchdog=watchdog, flight=flight,
+                period_s=args.timeline_period,
+            )
+            telemetry_loop.start()
+
     server = serve(api, args.port)
     log.info(
         "nanotpu extender serving on :%d (policy=%s, mock=%s)",
@@ -273,6 +341,12 @@ def main(argv: list[str] | None = None) -> int:
             os._exit(1)
         stop["flag"] = True
         log.info("signal %s: shutting down", signum)
+        if telemetry_loop is not None:
+            telemetry_loop.stop()
+        if api.flight is not None:
+            # the shutdown bundle: the last pre-exit state, before the
+            # stack starts tearing down underneath the taps
+            api.flight.dump("shutdown")
         if recovery_loop is not None:
             recovery_loop.stop()
         controller.stop()

@@ -134,10 +134,11 @@ class _Reservation:
     """
 
     __slots__ = ("node_name", "info", "plan", "valid", "gang_key", "pod",
-                 "trace")
+                 "trace", "parked_at")
 
     def __init__(self, node_name: str, info, plan: Plan, gang_key: str,
-                 pod: Pod | None = None, trace=None):
+                 pod: Pod | None = None, trace=None,
+                 parked_at: float = 0.0):
         self.node_name = node_name
         self.info = info
         self.plan = plan
@@ -149,6 +150,10 @@ class _Reservation:
         #: context rather than the opener's
         self.pod = pod
         self.trace = trace
+        #: park timestamp on the dealer's clock (obs clock when a bundle
+        #: is attached — virtual in the sim — else monotonic): the
+        #: telemetry timeline's oldest-park-age series reads it
+        self.parked_at = parked_at
 
 
 def plan_from_pod(pod: Pod) -> Plan | None:
@@ -225,6 +230,10 @@ class Dealer:
         #: gang-wait histograms observe through it; None costs nothing
         #: (SchedulerAPI attaches its own bundle when the dealer has none)
         self.obs = obs
+        #: the clock telemetry-visible timestamps use (reservation park
+        #: times): the bundle's injectable clock when one is attached —
+        #: virtual in the sim, so park ages are deterministic — else wall
+        self._clock = obs.tracer.clock if obs is not None else time.monotonic
         # K8s Events on bind outcomes — the reference built a recorder and
         # never emitted (controller.go:78-81, SURVEY §5); here `kubectl
         # describe pod` shows the placement decision
@@ -1855,7 +1864,10 @@ class Dealer:
         # member must never be visible in `parked` before its
         # reservation is registered — the committer would claim None and
         # fail a member whose chips are validly reserved
-        my_res = _Reservation(node_name, info, plan, key, pod, trace)
+        my_res = _Reservation(
+            node_name, info, plan, key, pod, trace,
+            parked_at=self._clock(),
+        )
         with self._lock:
             with barrier.cv:
                 if pod.uid in barrier.parked:
@@ -2522,6 +2534,64 @@ class Dealer:
         used = sum(i.chips.percent_used() for i in infos)
         total = sum(i.chips.percent_total() for i in infos)
         return used / total if total else 0.0
+
+    def capacity_status(self) -> dict:
+        """Telemetry-timeline tap (docs/observability.md): fleet + per-
+        pool occupancy and the whole-free chip count from ONE lock-held
+        node list. Pools are keyed like snapshot shards
+        (``generation/slice-family``) regardless of shard mode, so the
+        series stay comparable across a ``--shards`` change."""
+        with self._lock:
+            infos = list(self._nodes.values())
+        used = total = whole_free = 0
+        pools: dict[str, list] = {}
+        for info in infos:
+            u = info.chips.percent_used()
+            t = info.chips.percent_total()
+            used += u
+            total += t
+            whole_free += info.chips.whole_free()
+            agg = pools.setdefault(shard_key_of(info), [0, 0, 0])
+            agg[0] += u
+            agg[1] += t
+            agg[2] += 1
+        return {
+            "occupancy": round(used / total, 6) if total else 0.0,
+            "whole_free_chips": whole_free,
+            "pools": {
+                key: {
+                    "occupancy": (
+                        round(agg[0] / agg[1], 6) if agg[1] else 0.0
+                    ),
+                    "hosts": agg[2],
+                }
+                for key, agg in sorted(pools.items())
+            },
+        }
+
+    def gang_park_status(self, now: float | None = None) -> dict:
+        """Telemetry-timeline tap: DISTINCT gangs with members parked at
+        barriers, total parked member reservations, and the oldest
+        park's age on the dealer's clock (pass the sim's virtual now for
+        deterministic ages). Gangs and members are separate series on
+        purpose: a 64-member gang parked is ONE stuck gang, and an
+        alert on "gangs stuck" must not fire 64x."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            stamps = []
+            gangs = set()
+            for res in self._reserved.values():
+                if res.valid:
+                    stamps.append(res.parked_at)
+                    gangs.add(res.gang_key)
+        return {
+            "parked": len(gangs),
+            "parked_members": len(stamps),
+            "oldest_age_s": (
+                round(max(0.0, now - min(stamps)), 6) if stamps else 0.0
+            ),
+        }
 
     def shard_status(self) -> dict:
         """Per-shard publication state — generation, published host

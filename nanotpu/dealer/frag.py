@@ -1,0 +1,60 @@
+"""Fleet ICI-fragmentation: the two-level metric shared by the sim
+report's certification walk and the telemetry timeline's fleet tap.
+
+Fragmentation is two-level, matching how a gang actually lands: chips
+within a host must be ICI-contiguous on the host torus
+(:meth:`nanotpu.topology.Torus.compactness`), and a multi-host gang's
+hosts must be adjacent on the slice host-grid (the same
+``_grid_compactness`` the gang scorer awards its bonus with). Each level
+is a free-chip-weighted mean compactness of the FREE capacity; the fleet
+score is ``1 - intra * inter``, so 0.0 means every free chip sits in a
+contiguous block on a contiguous run of hosts (a new gang can land on
+ICI) and values toward 1.0 mean free capacity is scattered fragments no
+sub-torus demand can use. Host-level matters most: a 4-chip host's free
+set is almost always compact, but churn strews free HOSTS across the
+slice grid.
+
+This lives in the dealer package (not the sim) because the timeline
+samples it on every production tick — the sim imports it, never the
+other way around.
+"""
+
+from __future__ import annotations
+
+from nanotpu.dealer.gang import _grid_compactness
+from nanotpu.topology import parse_slice_coords
+
+
+def fragmentation_of(dealer) -> float:
+    """Fleet ICI-fragmentation in [0, 1] from the dealer's live accounting
+    (0 == all free capacity contiguous; see module docstring)."""
+    snap = dealer.debug_snapshot()
+    intra_weighted = 0.0
+    total_free = 0
+    # slice name -> (free-host coords, free whole chips on them)
+    slices: dict[str, tuple[list, int]] = {}
+    for name in sorted(snap["node_infos"]):
+        info = snap["node_infos"][name]
+        free = info.chips.whole_free_indexes()
+        if not free:
+            continue
+        intra_weighted += info.chips.torus.compactness(free) * len(free)
+        total_free += len(free)
+        # nodes without slice labels are their own singleton slice
+        key = info.slice_name or f"__solo__{name}"
+        try:
+            coord = parse_slice_coords(info.slice_coords)
+        except Exception:
+            coord = (0, 0, 0)
+        coords, chips = slices.get(key, ([], 0))
+        coords.append(coord)
+        slices[key] = (coords, chips + len(free))
+    if total_free == 0:
+        return 0.0  # nothing free: nothing to fragment
+    inter_weighted = sum(
+        _grid_compactness(coords) * chips
+        for coords, chips in slices.values()
+    )
+    intra = intra_weighted / total_free
+    inter = inter_weighted / total_free
+    return round(1.0 - intra * inter, 4)

@@ -113,6 +113,16 @@ class Histogram:
             series[1] += 1
             series[2] += value
 
+    def snapshot(self) -> dict[tuple, dict]:
+        """Per-label-set point-in-time copy — raw (non-cumulative)
+        bucket counts, total count, and sum — for delta consumers (the
+        telemetry timeline's per-tick verb-latency deltas)."""
+        with self._lock:
+            return {
+                key: {"raw": list(v[0]), "count": v[1], "sum": v[2]}
+                for key, v in self._series.items()
+            }
+
     def quantile(self, q: float, **labels: str) -> float:
         """Approximate quantile from buckets (upper bound of the bucket the
         q-th observation falls in). For bench reporting, not exposition."""

@@ -1,0 +1,376 @@
+"""SLO burn-rate watchdog over the telemetry timeline (`nanotpu_slo_*`).
+
+Objectives are DECLARED, not coded: the ``slo:`` section of policy.yaml
+(hot-reloaded through the existing :class:`~nanotpu.policy.PolicyWatcher`
+— a config push, not a deploy) or the sim scenario's ``telemetry.slo``
+list, both validated by :func:`parse_objectives`. Each objective names a
+timeline series and is evaluated with the classic TWO-WINDOW burn rate
+(docs/observability.md "SLO burn rates"):
+
+    bad_fraction(W) = bad events / total events over window W
+    burn_rate(W)    = bad_fraction(W) / (1 - target)
+
+A burn rate of 1.0 means the error budget is being consumed exactly at
+the rate that exhausts it over the objective's horizon; the watchdog
+trips when BOTH the long window (sustained — filters blips) and the
+short window (still happening — clears fast after recovery) reach the
+objective's ``burn`` factor. Breach/clear are edge-triggered: one typed
+ledger reason (``slo_breach``, aggregated uid-less so a breach storm can
+never evict placement records), one ``nanotpu_slo_breach_total{slo=}``
+bump, one journal line in the sim, one flight-recorder bundle.
+
+Three objective kinds, each reading per-tick data from the ring:
+
+* ``threshold`` — the tick is good iff ``series <op> threshold`` (e.g.
+  occupancy floor: ``fleet.occupancy ge 0.5``). One event per tick.
+* ``latency``  — ``series`` names a verb histogram section
+  (``verbs.filter``); good events are the requests in buckets
+  ``le <= threshold``, bad the remainder (Filter p99 vs the 2 s
+  extender read budget is ``threshold: 2.0, target: 0.99``).
+* ``ratio``    — ``bad`` and ``total`` name per-tick delta series
+  (e.g. bind error rate: bad = breaker fast-fails + API errors, total
+  = bind attempts).
+
+The unlabeled ``nanotpu_slo_*`` gauges are the keys of
+:data:`_SLO_GAUGES`, produced by :meth:`SLOWatchdog.slo_gauge_values` —
+the nanolint metrics-completeness pass cross-checks the two BOTH
+directions, the same honesty contract every other exported table lives
+under. Per-objective series (`breach_total`, `burn_rate`, `breached`)
+render labeled from watchdog state, like the throughput exporter's
+per-shard aggregate.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from nanotpu.analysis.witness import make_lock
+from nanotpu.metrics.registry import _escape_label_value
+from nanotpu.obs.decisions import REASON_SLO_BREACH
+
+_FAMILY = "nanotpu_slo_"
+
+#: gauge suffix -> help text. Keys must match slo_gauge_values() exactly
+#: — nanolint pins the equivalence both ways.
+_SLO_GAUGES: dict[str, str] = {
+    "objectives":
+        "SLO objectives currently configured (policy.yaml slo: section)",
+    "evaluations_total":
+        "Watchdog evaluation passes over the timeline ring",
+    "breaches_total":
+        "SLO breach transitions across all objectives (per-objective "
+        "counts ride on nanotpu_slo_breach_total{slo=})",
+    "objectives_breached":
+        "Objectives currently in breach (both burn windows over factor)",
+}
+
+_KINDS = ("threshold", "latency", "ratio")
+_OPS = ("ge", "le")
+
+
+@dataclass(frozen=True)
+class SLObjective:
+    """One declared objective (see module docstring for the kinds)."""
+
+    name: str
+    kind: str
+    series: str = ""       # threshold/latency: dotted tick path
+    bad: str = ""          # ratio: dotted path of the bad-event delta
+    total: str = ""        # ratio: dotted path of the total-event delta
+    op: str = "le"         # threshold kind: good iff value <op> threshold
+    threshold: float = 0.0
+    target: float = 0.99   # required good fraction; budget = 1 - target
+    long_s: float = 300.0
+    short_s: float = 30.0
+    burn: float = 1.0      # burn-rate factor that trips the alert
+
+
+def parse_objectives(raw) -> tuple[SLObjective, ...]:
+    """Validate a list of objective dicts (YAML ``slo:`` section /
+    scenario ``telemetry.slo``) into frozen :class:`SLObjective`s.
+    Raises ValueError naming the bad entry — a policy hot-reload with a
+    malformed section keeps the last good spec, a scenario fails load."""
+    if raw is None:
+        return ()
+    if not isinstance(raw, (list, tuple)):
+        raise ValueError("slo section must be a list of objectives")
+    out: list[SLObjective] = []
+    seen: set[str] = set()
+    for entry in raw:
+        if isinstance(entry, SLObjective):
+            # already parsed (scenario re-normalization is idempotent)
+            if entry.name in seen:
+                raise ValueError(f"duplicate slo objective {entry.name!r}")
+            seen.add(entry.name)
+            out.append(entry)
+            continue
+        if not isinstance(entry, dict):
+            raise ValueError(f"bad slo objective {entry!r}: not a mapping")
+        try:
+            name = str(entry["name"])
+            if not name or name in seen:
+                raise ValueError("name must be unique and non-empty")
+            seen.add(name)
+            kind = str(entry.get("kind", "threshold"))
+            if kind not in _KINDS:
+                raise ValueError(f"kind must be one of {_KINDS}")
+            series = str(entry.get("series", ""))
+            bad = str(entry.get("bad", ""))
+            total = str(entry.get("total", ""))
+            if kind == "ratio":
+                if not bad or not total:
+                    raise ValueError("ratio kind needs bad and total paths")
+            elif not series:
+                raise ValueError(f"{kind} kind needs a series path")
+            op = str(entry.get("op", "le"))
+            if op not in _OPS:
+                raise ValueError(f"op must be one of {_OPS}")
+            threshold = float(entry.get("threshold", 0.0))
+            if kind == "latency" and threshold <= 0:
+                # no histogram bucket bound is <= 0, so a defaulted/typoed
+                # threshold would classify EVERY request as bad and fire
+                # a spurious breach on the first evaluation with traffic
+                raise ValueError("latency kind needs threshold > 0")
+            target = float(entry.get("target", 0.99))
+            if not 0.0 < target < 1.0:
+                raise ValueError("target must be in (0, 1)")
+            long_s = float(entry.get("long_s", 300.0))
+            short_s = float(entry.get("short_s", 30.0))
+            if not 0.0 < short_s <= long_s:
+                raise ValueError("windows need 0 < short_s <= long_s")
+            burn = float(entry.get("burn", 1.0))
+            if burn <= 0:
+                raise ValueError("burn must be > 0")
+            out.append(SLObjective(
+                name=name, kind=kind, series=series, bad=bad, total=total,
+                op=op, threshold=threshold,
+                target=target, long_s=long_s, short_s=short_s, burn=burn,
+            ))
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"bad slo objective {entry!r}: {e}") from e
+    return tuple(out)
+
+
+def _resolve(tick: dict, path: str):
+    """Dotted-path lookup into a tick; None when any hop is missing."""
+    node = tick
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def _events(obj: SLObjective, tick: dict) -> tuple[float, float]:
+    """(good, bad) event counts one tick contributes to ``obj``."""
+    if obj.kind == "threshold":
+        value = _resolve(tick, obj.series)
+        if not isinstance(value, (int, float)):
+            return 0.0, 0.0
+        good = value >= obj.threshold if obj.op == "ge" \
+            else value <= obj.threshold
+        return (1.0, 0.0) if good else (0.0, 1.0)
+    if obj.kind == "latency":
+        section = _resolve(tick, obj.series)
+        if not isinstance(section, dict):
+            return 0.0, 0.0
+        count = float(section.get("count", 0) or 0)
+        if count <= 0:
+            return 0.0, 0.0
+        good = 0.0
+        for le, n in (section.get("le") or {}).items():
+            try:
+                bound = float(le)
+            except ValueError:
+                continue
+            if bound <= obj.threshold:
+                good += n
+        good = min(good, count)
+        return good, count - good
+    # ratio
+    bad = _resolve(tick, obj.bad)
+    total = _resolve(tick, obj.total)
+    bad = float(bad) if isinstance(bad, (int, float)) else 0.0
+    total = float(total) if isinstance(total, (int, float)) else 0.0
+    if total <= 0:
+        return 0.0, 0.0
+    bad = min(bad, total)
+    return total - bad, bad
+
+
+class SLOWatchdog:
+    """Evaluates declared objectives over the timeline ring; see module
+    docstring. ``configure`` is hot-reload-safe (PolicyWatcher.on_reload
+    hands it each new spec); state for objectives that survive a reload
+    is kept, so a table edit cannot reset breach counters."""
+
+    def __init__(self, timeline, obs=None, clock=time.monotonic):
+        self.timeline = timeline
+        self.obs = obs
+        self.clock = clock
+        self._lock = make_lock("SLOWatchdog._lock")
+        self._objectives: tuple[SLObjective, ...] = ()
+        #: name -> {"breached", "breaches", "burn_long", "burn_short"}
+        self._state: dict[str, dict] = {}
+        self.evaluations = 0
+
+    def configure(self, objectives) -> None:
+        """Install a new objective set (tuple of :class:`SLObjective`,
+        or raw dicts run through :func:`parse_objectives`)."""
+        if objectives and not isinstance(objectives[0], SLObjective):
+            objectives = parse_objectives(objectives)
+        objectives = tuple(objectives or ())
+        with self._lock:
+            self._objectives = objectives
+            names = {o.name for o in objectives}
+            for name in list(self._state):
+                if name not in names:
+                    del self._state[name]
+            for obj in objectives:
+                self._state.setdefault(obj.name, {
+                    "breached": False, "breaches": 0,
+                    "burn_long": 0.0, "burn_short": 0.0,
+                })
+
+    def _burn(self, obj: SLObjective, ticks: list[dict],
+              now: float, window_s: float) -> float:
+        good = bad = 0.0
+        for tick in ticks:
+            if tick["t"] < now - window_s:
+                continue
+            g, b = _events(obj, tick)
+            good += g
+            bad += b
+        total = good + bad
+        if total <= 0:
+            return 0.0  # no data is no burn, not a breach
+        return (bad / total) / max(1e-9, 1.0 - obj.target)
+
+    def evaluate(self, now: float | None = None) -> list[dict]:
+        """One watchdog pass: recompute both burn windows per objective
+        and return the edge transitions (``{"event": "breach"|"clear",
+        "name", "burn_long", "burn_short"}``). Breach transitions bump
+        the per-objective counter and land in the decision ledger as
+        the typed uid-less ``slo_breach`` aggregate."""
+        if now is None:
+            now = self.clock()
+        ticks = self.timeline.since(0)
+        transitions: list[dict] = []
+        with self._lock:
+            self.evaluations += 1
+            for obj in self._objectives:
+                state = self._state[obj.name]
+                burn_long = self._burn(obj, ticks, now, obj.long_s)
+                burn_short = self._burn(obj, ticks, now, obj.short_s)
+                state["burn_long"] = round(burn_long, 6)
+                state["burn_short"] = round(burn_short, 6)
+                breached = burn_long >= obj.burn and burn_short >= obj.burn
+                if breached and not state["breached"]:
+                    state["breached"] = True
+                    state["breaches"] += 1
+                    transitions.append({
+                        "event": "breach", "name": obj.name,
+                        "burn_long": state["burn_long"],
+                        "burn_short": state["burn_short"],
+                    })
+                elif state["breached"] and not breached:
+                    state["breached"] = False
+                    transitions.append({
+                        "event": "clear", "name": obj.name,
+                        "burn_long": state["burn_long"],
+                        "burn_short": state["burn_short"],
+                    })
+        if self.obs is not None:
+            for tr in transitions:
+                if tr["event"] == "breach":
+                    # uid-less aggregate ("slo_breach:<name>"), never a
+                    # ring record: a breach storm must not evict the
+                    # per-pod placement records (docs/observability.md)
+                    self.obs.ledger.abort(
+                        "", tr["name"], REASON_SLO_BREACH
+                    )
+        return transitions
+
+    # -- exposition --------------------------------------------------------
+    def status(self) -> dict:
+        """Per-objective state for ``/debug/timeline`` (sorted keys)."""
+        with self._lock:
+            return {
+                name: dict(self._state[name])
+                for name in sorted(self._state)
+            }
+
+    def slo_gauge_values(self) -> dict:
+        """Unlabeled ``nanotpu_slo_*`` gauge values. Keys must match
+        :data:`_SLO_GAUGES` exactly (nanolint pins both directions)."""
+        with self._lock:
+            return {
+                "objectives": len(self._objectives),
+                "evaluations_total": self.evaluations,
+                "breaches_total": sum(
+                    s["breaches"] for s in self._state.values()
+                ),
+                "objectives_breached": sum(
+                    1 for s in self._state.values() if s["breached"]
+                ),
+            }
+
+
+class SLOExporter:
+    """Registry-compatible renderer (``Registry.register``) for the
+    watchdog's gauges + per-objective series. Registered exactly when a
+    watchdog is attached, so deployments without telemetry export
+    nothing new."""
+
+    def __init__(self, watchdog: SLOWatchdog):
+        self.watchdog = watchdog
+
+    def render(self) -> list[str]:
+        out: list[str] = []
+        values = self.watchdog.slo_gauge_values()
+        for suffix in sorted(_SLO_GAUGES):
+            name = _FAMILY + suffix
+            out.append(f"# HELP {name} {_SLO_GAUGES[suffix]}")
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {float(values[suffix])}")
+        status = self.watchdog.status()
+        breach = _FAMILY + "breach_total"
+        out.append(
+            f"# HELP {breach} SLO breach transitions per objective "
+            "(two-window burn rate both over factor)"
+        )
+        out.append(f"# TYPE {breach} counter")
+        for name in sorted(status):
+            out.append(
+                f'{breach}{{slo="{_escape_label_value(name)}"}} '
+                f"{status[name]['breaches']}"
+            )
+        burn = _FAMILY + "burn_rate"
+        out.append(
+            f"# HELP {burn} Current error-budget burn rate per objective "
+            "and window (1.0 consumes the budget exactly at horizon)"
+        )
+        out.append(f"# TYPE {burn} gauge")
+        for name in sorted(status):
+            esc = _escape_label_value(name)
+            out.append(
+                f'{burn}{{slo="{esc}",window="long"}} '
+                f"{status[name]['burn_long']}"
+            )
+            out.append(
+                f'{burn}{{slo="{esc}",window="short"}} '
+                f"{status[name]['burn_short']}"
+            )
+        breached = _FAMILY + "breached"
+        out.append(
+            f"# HELP {breached} Whether each objective is currently in "
+            "breach (1) or inside SLO (0)"
+        )
+        out.append(f"# TYPE {breached} gauge")
+        for name in sorted(status):
+            out.append(
+                f'{breached}{{slo="{_escape_label_value(name)}"}} '
+                f"{int(status[name]['breached'])}"
+            )
+        return out

@@ -1,0 +1,85 @@
+"""``nanotpu_timeline_*`` exposition: the telemetry timeline's scrape
+surface (docs/observability.md "The telemetry timeline").
+
+Two kinds of series:
+
+* unlabeled tick gauges — the keys of :data:`_TIMELINE_GAUGES`, produced
+  by :meth:`Timeline.tick_gauge_values
+  <nanotpu.obs.timeline.Timeline.tick_gauge_values>` from the newest
+  retained tick. The nanolint metrics-completeness pass cross-checks the
+  two tables BOTH directions (a gauge declared here but never produced,
+  or produced there but never declared, is a lint finding) — the same
+  honesty contract the resilience/throughput/recovery tables live under.
+* ``nanotpu_timeline_pool_occupancy{pool=...}`` — per-pool occupancy
+  from the newest tick's ``pools`` section, labeled by the same
+  ``generation/slice-family`` key the snapshot shards use.
+
+Scrapes read the RING, not the fleet: a tick is taken on the telemetry
+cadence (sim event / production loop), so a scrape costs a dict walk
+and never touches the dealer.
+"""
+
+from __future__ import annotations
+
+from nanotpu.metrics.registry import _escape_label_value
+
+_FAMILY = "nanotpu_timeline_"
+
+#: gauge suffix -> help text. Keys must match Timeline.
+#: tick_gauge_values() exactly — nanolint pins the equivalence both ways.
+_TIMELINE_GAUGES: dict[str, str] = {
+    "tick":
+        "Sequence number of the newest telemetry tick (0 before the "
+        "first; a stalled value means the telemetry cadence died)",
+    "occupancy":
+        "Fleet chip occupancy fraction at the newest tick",
+    "fragmentation":
+        "Two-level ICI fragmentation at the newest tick (0 = all free "
+        "capacity contiguous)",
+    "whole_free_chips":
+        "Fully-free chips fleet-wide at the newest tick",
+    "parked_gangs":
+        "Distinct strict gangs with members parked at barriers at the "
+        "newest tick",
+    "parked_members":
+        "Total parked strict-gang member reservations at the newest tick",
+    "oldest_park_age_seconds":
+        "Age of the oldest parked strict-gang reservation",
+    "sources":
+        "External TimelineSource producers currently registered",
+}
+
+_POOL = _FAMILY + "pool_occupancy"
+
+
+class TimelineExporter:
+    """Registry-compatible renderer (``Registry.register``) for the
+    timeline's gauges. Registered exactly when a timeline is attached,
+    so deployments without telemetry export nothing new."""
+
+    def __init__(self, timeline):
+        self.timeline = timeline
+
+    def render(self) -> list[str]:
+        out: list[str] = []
+        values = self.timeline.tick_gauge_values()
+        for suffix in sorted(_TIMELINE_GAUGES):
+            name = _FAMILY + suffix
+            out.append(f"# HELP {name} {_TIMELINE_GAUGES[suffix]}")
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {float(values[suffix])}")
+        out.append(
+            f"# HELP {_POOL} Per-pool chip occupancy fraction at the "
+            "newest telemetry tick"
+        )
+        out.append(f"# TYPE {_POOL} gauge")
+        latest = self.timeline.latest()
+        pools = latest["pools"] if latest else {}
+        if not pools:
+            out.append(f'{_POOL}{{pool="all"}} 0.0')
+        for key in sorted(pools):
+            out.append(
+                f'{_POOL}{{pool="{_escape_label_value(key)}"}} '
+                f"{pools[key]['occupancy']}"
+            )
+        return out

@@ -44,6 +44,7 @@ REASON_PREEMPTED = "preempted"
 REASON_MIGRATED = "migrated"
 REASON_BACKFILLED = "backfilled"
 REASON_LEASE_EXPIRED = "lease_expired"
+REASON_SLO_BREACH = "slo_breach"
 
 #: code -> operator-facing description. Keys must be exactly the
 #: ``REASON_*`` constants above (nanolint pins the equivalence).
@@ -84,6 +85,9 @@ REASONS: dict[str, str] = {
     REASON_LEASE_EXPIRED:
         "backfill lease expired (the gang's start is due); pod evicted "
         "from the hole and requeued",
+    REASON_SLO_BREACH:
+        "an SLO objective's two-window burn rate crossed its factor "
+        "(aggregated uid-less per objective; docs/observability.md)",
 }
 
 

@@ -1,0 +1,253 @@
+"""Crash flight recorder: one post-mortem bundle per bad moment.
+
+When something goes wrong — an SLO breach, an invariant violation, a
+process death — the questions are always the same: what did the last
+few minutes look like, what was the scheduler deciding, and what state
+were the shards/pipeline/recovery plane in. The :class:`FlightRecorder`
+answers all three with ONE canonical-JSON bundle
+(docs/observability.md "The flight recorder"):
+
+* ``ticks`` — the last N telemetry timeline ticks (the time axis);
+* ``decisions`` + ``traces`` — the newest finalized decision records
+  joined with their pods' retained traces (the causal record);
+* ``shards`` / ``pipeline`` / ``recovery`` / ``gangs`` — the dealer's
+  live status taps (the control-plane state);
+* ``perf`` / ``resilience`` — counter totals (the attribution);
+* ``config_fingerprint`` — sha256 of the canonical config the process
+  booted with, so a bundle names the exact configuration it describes.
+
+Triggers: the SLO watchdog's breach transitions and the sim's invariant
+checker call :meth:`dump` explicitly; :meth:`install` arms process-death
+capture — an ``atexit`` hook writes a final bundle on interpreter exit,
+and ``faulthandler`` is enabled onto a ``<path>.stacks`` sidecar so hard
+crashes (segfault, fatal signal) leave at least the thread stacks where
+the JSON hook can no longer run.
+
+Every tap guards itself: the recorder exists for the moments when parts
+of the stack are ALREADY dead (the sim proves a bundle survives killing
+the dealer mid-run), so a raising tap contributes an ``"error"`` marker
+instead of aborting the dump. With the sim's virtual clock and
+``deterministic=True`` the bundle bytes are byte-reproducible and the
+report digests them (part of the determinism contract).
+"""
+
+from __future__ import annotations
+
+import atexit
+import hashlib
+import json
+import logging
+import os
+import time
+
+from nanotpu.analysis.witness import make_lock
+from nanotpu.obs.timeline import _flatten_resilience
+
+log = logging.getLogger("nanotpu.obs.flight")
+
+
+def config_fingerprint(config: dict | None) -> str:
+    """sha256 over the canonical serialization of the boot config."""
+    blob = json.dumps(
+        config or {}, sort_keys=True, separators=(",", ":"), default=str
+    ).encode()
+    return "sha256:" + hashlib.sha256(blob).hexdigest()
+
+
+class FlightRecorder:
+    """Builds (and optionally writes) post-mortem bundles; see module
+    docstring. ``path`` empty keeps bundles in memory only (the sim's
+    digest pin reads :meth:`digest`); non-empty writes each bundle
+    atomically (tmp + rename) so a reader never sees a torn file."""
+
+    def __init__(self, path: str = "", timeline=None, obs=None,
+                 dealer=None, resilience=None, config: dict | None = None,
+                 ticks: int = 64, decisions: int = 64,
+                 clock=time.monotonic, deterministic: bool = False):
+        self.path = str(path)
+        self.timeline = timeline
+        self.obs = obs
+        self.dealer = dealer
+        self.resilience = resilience
+        self.config_fingerprint = config_fingerprint(config)
+        self.ticks = int(ticks)
+        self.decisions = int(decisions)
+        self.clock = clock
+        self.deterministic = bool(deterministic)
+        self._lock = make_lock("FlightRecorder._lock")
+        self.bundles = 0
+        self._last_bytes: bytes | None = None
+        self._installed = False
+        #: an INCIDENT bundle (breach / violation / death) was written
+        #: to ``path`` this process: lifecycle dumps must not clobber it
+        self._incident_on_disk = False
+
+    # -- bundle assembly ---------------------------------------------------
+    def bundle(self, trigger: str, now: float | None = None) -> dict:
+        """Assemble one bundle dict. Never raises: each tap degrades to
+        an ``{"error": ...}`` marker so a half-dead stack still yields a
+        complete (and honest) post-mortem."""
+        if now is None:
+            now = self.clock()
+        out: dict = {
+            "trigger": str(trigger),
+            "t": round(now, 6),
+            "config_fingerprint": self.config_fingerprint,
+        }
+        out["ticks"] = self._tap(
+            lambda: self.timeline.since(0, limit=self.ticks)
+            if self.timeline is not None else []
+        )
+        out["decisions"] = self._tap(
+            lambda: self.obs.ledger.recent(self.decisions)
+            if self.obs is not None else []
+        )
+        out["aborts"] = self._tap(
+            lambda: self.obs.ledger.abort_summary()
+            if self.obs is not None else {}
+        )
+        # join against the EXACT records bundled above (a second ring
+        # walk could see a different pod set mid-churn)
+        bundled = out["decisions"] if isinstance(out["decisions"], list) \
+            else []
+        out["traces"] = self._tap(lambda: self._joined_traces(bundled))
+        dealer = self.dealer
+        out["shards"] = self._tap(
+            lambda: dealer.shard_status() if dealer is not None else {}
+        )
+        out["pipeline"] = self._tap(
+            lambda: dealer.pipeline_status() if dealer is not None else {}
+        )
+        out["gangs"] = self._tap(
+            lambda: dealer.gang_park_status(now=now)
+            if dealer is not None else {}
+        )
+        out["recovery"] = self._tap(
+            lambda: dealer.recovery.status()
+            if dealer is not None and dealer.recovery is not None else {}
+        )
+        out["perf"] = self._tap(
+            lambda: dealer.perf_totals() if dealer is not None else {}
+        )
+        out["resilience"] = self._tap(self._resilience)
+        return out
+
+    @staticmethod
+    def _tap(fn):
+        try:
+            return fn()
+        except Exception as e:
+            # the dead subsystem names itself instead of killing the dump
+            log.exception("flight-recorder tap failed")
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def _joined_traces(self, records: list) -> dict:
+        """Retained traces for the pods in the bundle's OWN decision
+        records — the recent-traces+decisions join every post-mortem
+        read starts from, covering exactly the bundled pod set."""
+        if self.obs is None:
+            return {}
+        out: dict = {}
+        for rec in records:
+            uid = rec.get("uid")
+            if uid and uid not in out:
+                traces = self.obs.tracer.get(uid)
+                if traces:
+                    out[uid] = traces
+        return {k: out[k] for k in sorted(out)}
+
+    def _resilience(self) -> dict:
+        if self.resilience is None:
+            return {}
+        return _flatten_resilience(
+            self.resilience.snapshot(), self.deterministic
+        )
+
+    #: triggers that mark a genuine incident; later LIFECYCLE dumps
+    #: (shutdown, process_exit) divert to ``<path>.exit`` instead of
+    #: clobbering the at-incident forensics the recorder exists for
+    _LIFECYCLE_TRIGGERS = ("shutdown", "process_exit")
+
+    # -- dumping -----------------------------------------------------------
+    def dump(self, trigger: str, now: float | None = None) -> bytes:
+        """Build a bundle, remember its bytes (for :meth:`digest`), and
+        atomically write it when a ``path`` is configured. Incident
+        triggers (SLO breach, invariant violation, dealer death) always
+        own ``path`` — newest incident wins; lifecycle triggers write to
+        ``path`` only while no incident bundle sits there, and to
+        ``<path>.exit`` otherwise, so a clean shutdown after a breach
+        cannot replace the breach-time state with a healthy goodbye."""
+        data = json.dumps(
+            self.bundle(trigger, now=now),
+            sort_keys=True, separators=(",", ":"),
+        ).encode()
+        lifecycle = trigger in self._LIFECYCLE_TRIGGERS
+        with self._lock:
+            self.bundles += 1
+            self._last_bytes = data
+            # target selection AND the write stay under the lock: a
+            # shutdown dump racing a breach dump must not decide
+            # "no incident yet" and then land its write after the
+            # incident's (dumps are rare and off every hot path)
+            if self.path:
+                divert = lifecycle and self._incident_on_disk
+                target = f"{self.path}.exit" if divert else self.path
+                try:
+                    tmp = f"{target}.tmp.{os.getpid()}"
+                    with open(tmp, "wb") as fh:
+                        fh.write(data)
+                    os.replace(tmp, target)
+                    # latch only once the incident bundle is really on
+                    # disk — a failed write must not divert later
+                    # lifecycle dumps away from the (empty) path
+                    if not lifecycle:
+                        self._incident_on_disk = True
+                except OSError:
+                    log.exception(
+                        "flight-recorder write to %s failed", target
+                    )
+        return data
+
+    def last_bundle(self) -> dict | None:
+        """Parse of the newest bundle's bytes (None before the first)."""
+        with self._lock:
+            if self._last_bytes is None:
+                return None
+            return json.loads(self._last_bytes)
+
+    def digest(self) -> str:
+        """sha256 of the newest bundle's bytes ("" before the first) —
+        the sim report pins this, so the whole post-mortem surface is
+        byte-reproducible on the virtual clock."""
+        with self._lock:
+            if self._last_bytes is None:
+                return ""
+            return "sha256:" + hashlib.sha256(self._last_bytes).hexdigest()
+
+    # -- process-death hooks -----------------------------------------------
+    def install(self) -> None:
+        """Arm process-death capture: an atexit bundle (trigger
+        ``process_exit``) plus faulthandler onto ``<path>.stacks`` for
+        deaths Python code cannot survive. Idempotent."""
+        if self._installed:
+            return
+        self._installed = True
+        atexit.register(self._on_exit)
+        if self.path:
+            try:
+                import faulthandler
+
+                # the sidecar stays open for the process lifetime by
+                # design: faulthandler writes to a raw fd at crash time
+                self._stacks_file = open(  # noqa: SIM115
+                    f"{self.path}.stacks", "w"
+                )
+                faulthandler.enable(file=self._stacks_file)
+            except OSError:
+                log.exception("flight-recorder faulthandler arm failed")
+
+    def _on_exit(self) -> None:
+        try:
+            self.dump("process_exit")
+        except Exception:  # atexit must never raise
+            log.exception("flight-recorder exit dump failed")

@@ -1,0 +1,453 @@
+"""Fleet telemetry timeline: the control plane's time axis.
+
+PR 5 gave the scheduler per-request traces and a decision ledger —
+point-in-time answers to "why did THIS pod land there". What it could
+not answer is the operator's first question after an incident: *what did
+occupancy, gang-wait, and shard health look like over the last five
+minutes, and were we inside SLO when the dealer died?* The
+:class:`Timeline` is that surface: an injectable-clock cadence collector
+that snapshots a fixed typed schema per tick into a bounded ring
+(docs/observability.md "The telemetry timeline").
+
+One tick is a nested dict of sorted-key sections, every value derived
+from counters, chip accounting, or the injectable clock:
+
+* ``fleet`` — occupancy, two-level ICI fragmentation, whole-free chips,
+  parked strict-gang count + oldest park age (``Dealer.capacity_status``
+  / ``Dealer.gang_park_status`` taps);
+* ``pools`` — per-pool occupancy + host count, keyed by the same
+  ``generation/slice-family`` key the snapshot shards use;
+* ``shards`` — per-shard snapshot generation / membership epoch /
+  published epoch (a shard whose gen stops moving names itself);
+* ``perf`` — hot-path attribution counter DELTAS since the previous
+  tick (``Dealer.perf_totals``);
+* ``verbs`` — per-verb latency histogram deltas (count, sum, nonzero
+  per-bucket counts) from the route layer's duration histogram;
+* ``resilience`` / ``recovery`` — degradation + capacity-recovery
+  counter deltas;
+* ``throughput`` — model calibration age + modeled aggregate
+  (docs/scoring.md), present when a throughput model is attached;
+* ``ext`` — anything registered through the :class:`TimelineSource`
+  duck protocol (serving tok/s, queue depth, KV occupancy — ROADMAP
+  item 1 publishes here without timeline code changes).
+
+Determinism contract: the sim drives ticks as virtual-time
+``telemetry_tick`` events with ``deterministic=True`` (wall-clock-bred
+series — the events_* resilience counters — are filtered, exactly like
+the report's resilience slice), so the ring digests byte-identically
+across runs and the report's ``timeline`` section is part of the
+determinism contract. Production runs a :class:`TelemetryLoop` thread
+instead.
+
+Cost contract: a tick runs OFF the verb hot path (sim event thread /
+telemetry thread / bench between-rep points) and reads only public
+snapshot taps; with no timeline constructed the scheduler does not
+change by a single allocation (the bench's A/B attribution diff pins
+this).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+import time
+
+from nanotpu.analysis.witness import make_lock
+
+log = logging.getLogger("nanotpu.obs.timeline")
+
+
+class TimelineSource:
+    """Duck protocol for external series producers (ROADMAP item 1: the
+    serving engine's per-replica tok/s, queue depth, KV occupancy).
+
+    Anything with a ``name`` attribute and a ``sample() -> dict[str,
+    float]`` method registers via :meth:`Timeline.register_source`; its
+    values land under ``ext.<name>.<key>`` in every subsequent tick and
+    are addressable by SLO objectives like any built-in series. This
+    class is documentation + a trivial base, not a requirement — the
+    timeline never isinstance-checks."""
+
+    name = "source"
+
+    def sample(self) -> dict:  # pragma: no cover - interface stub
+        return {}
+
+
+def _flatten_resilience(snapshot: dict, deterministic: bool) -> dict:
+    """ResilienceCounters snapshot -> flat ``{field[.key]: value}``.
+    ``deterministic`` drops the Event recorder's share (events_* scalars
+    and the "events" write target), the same rule the sim report's
+    resilience slice applies — those counters move on a wall-clock
+    background thread and must not enter a digest-pinned tick."""
+    out: dict[str, float] = {}
+    for field in sorted(snapshot):
+        value = snapshot[field]
+        if deterministic and field.startswith("events_"):
+            continue
+        if isinstance(value, dict):
+            for key in sorted(value):
+                if deterministic and key == "events":
+                    continue
+                out[f"{field}.{key}"] = value[key]
+        else:
+            out[field] = value
+    return out
+
+
+class Timeline:
+    """Bounded ring of telemetry ticks over injectable components.
+
+    Every component is optional — the timeline samples whatever is
+    attached and emits an empty section for the rest, so the sim (no
+    route layer, virtual clock), production (everything), and the bench
+    (dealer only, between reps) share one collector. ``clock`` stamps
+    tick times: wall in production, virtual in the sim."""
+
+    def __init__(self, dealer=None, resilience=None,
+                 verb_duration=None, recovery=None, model=None,
+                 capacity: int = 512, clock=time.monotonic,
+                 deterministic: bool = False):
+        if capacity <= 0:
+            raise ValueError(f"timeline capacity must be > 0, got {capacity}")
+        self.dealer = dealer
+        self.resilience = resilience
+        self.verb_duration = verb_duration
+        self.recovery = recovery
+        self.model = model
+        self.capacity = int(capacity)
+        self.clock = clock
+        self.deterministic = bool(deterministic)
+        self._lock = make_lock("Timeline._lock")
+        self._ring: list[dict] = []
+        self._slot = 0
+        self._n = 0  # ticks taken (monotonic tick sequence number)
+        #: previous cumulative counter states for delta arithmetic
+        self._prev_perf: dict | None = None
+        self._prev_verbs: dict | None = None
+        self._prev_resilience: dict | None = None
+        self._prev_recovery: dict | None = None
+        self._sources: list = []
+
+    # -- registration ------------------------------------------------------
+    def rewire_dealer(self, dealer, model=None) -> None:
+        """Point the timeline at a REPLACEMENT dealer (the sim's
+        agent-restart fault; a future HA failover). The perf-delta
+        baseline resets with it: the fresh dealer's counters start at
+        zero, and deltas computed against the dead dealer's totals
+        would be large negative garbage on the first post-restart
+        tick."""
+        with self._lock:
+            self.dealer = dealer
+            self.model = model
+            self._prev_perf = None
+
+    def register_source(self, source) -> None:
+        """Adopt an external producer (:class:`TimelineSource` duck:
+        ``.name`` + ``.sample()``). Its values appear under
+        ``ext.<name>.*`` from the next tick on."""
+        name = getattr(source, "name", "")
+        if not name or not callable(getattr(source, "sample", None)):
+            raise ValueError(
+                "timeline source needs a .name and a .sample() method"
+            )
+        with self._lock:
+            if any(
+                str(getattr(s, "name", "")) == str(name)
+                for s in self._sources
+            ):
+                raise ValueError(
+                    f"timeline source {name!r} already registered"
+                )
+            self._sources.append(source)
+
+    # -- sampling ----------------------------------------------------------
+    def tick(self, now: float | None = None) -> dict:
+        """Snapshot one tick into the ring and return it. Safe to call
+        from any thread (one collector at a time under the lock); each
+        tap guards itself so a dead dealer still yields a tick (the
+        flight recorder dumps AFTER deaths)."""
+        if now is None:
+            now = self.clock()
+        # external producers run OUTSIDE the lock: sample() is foreign
+        # code (the TimelineSource contract) — a slow producer must not
+        # park every concurrent scrape/debug read, and one that calls
+        # back into the timeline must not deadlock
+        ext = self._sample_sources()
+        with self._lock:
+            self._n += 1
+            tick: dict = {"tick": self._n, "t": round(now, 6)}
+            tick["fleet"], tick["pools"] = self._sample_fleet(now)
+            tick["shards"] = self._sample_shards()
+            tick["perf"] = self._sample_perf()
+            tick["verbs"] = self._sample_verbs()
+            tick["resilience"] = self._sample_resilience()
+            tick["recovery"] = self._sample_recovery()
+            tick["throughput"] = self._sample_throughput(now)
+            tick["ext"] = ext
+            if len(self._ring) < self.capacity:
+                self._ring.append(tick)
+            else:
+                self._ring[self._slot] = tick
+                self._slot = (self._slot + 1) % self.capacity
+            return tick
+
+    def _sample_fleet(self, now: float) -> tuple[dict, dict]:
+        fleet = {
+            "occupancy": 0.0, "fragmentation": 0.0, "whole_free_chips": 0,
+            "parked_gangs": 0, "parked_members": 0,
+            "oldest_park_age_s": 0.0,
+        }
+        pools: dict = {}
+        if self.dealer is None:
+            return fleet, pools
+        try:
+            cap = self.dealer.capacity_status()
+            fleet["occupancy"] = cap["occupancy"]
+            fleet["whole_free_chips"] = cap["whole_free_chips"]
+            pools = cap["pools"]
+            park = self.dealer.gang_park_status(now=now)
+            fleet["parked_gangs"] = park["parked"]
+            fleet["parked_members"] = park["parked_members"]
+            fleet["oldest_park_age_s"] = park["oldest_age_s"]
+            # the same two-level ICI metric the sim report certifies on
+            from nanotpu.dealer.frag import fragmentation_of
+
+            fleet["fragmentation"] = fragmentation_of(self.dealer)
+        except Exception:  # a dying dealer must not kill telemetry
+            log.exception("timeline fleet tap failed")
+        return fleet, pools
+
+    def _sample_shards(self) -> dict:
+        if self.dealer is None:
+            return {}
+        try:
+            status = self.dealer.shard_status()
+        except Exception:
+            log.exception("timeline shard tap failed")
+            return {}
+        return {
+            key: {
+                "gen": s["gen"], "epoch": s["epoch"],
+                "published_epoch": s["published_epoch"],
+                "hosts": s["hosts"],
+            }
+            for key, s in sorted(status.items())
+        }
+
+    def _sample_perf(self) -> dict:
+        if self.dealer is None:
+            return {}
+        try:
+            totals = self.dealer.perf_totals()
+        except Exception:
+            log.exception("timeline perf tap failed")
+            return {}
+        prev = self._prev_perf or {}
+        self._prev_perf = totals
+        return {
+            name: totals[name] - prev.get(name, 0)
+            for name in sorted(totals)
+        }
+
+    def _sample_verbs(self) -> dict:
+        if self.verb_duration is None:
+            return {}
+        snap = self.verb_duration.snapshot()
+        prev = self._prev_verbs or {}
+        self._prev_verbs = snap
+        buckets = self.verb_duration.buckets
+        out: dict = {}
+        for key in sorted(snap):
+            verb = dict(key).get("verb", "?")
+            cur, old = snap[key], prev.get(key)
+            raw_old = old["raw"] if old else [0] * len(buckets)
+            le = {
+                repr(b): cur["raw"][i] - raw_old[i]
+                for i, b in enumerate(buckets)
+                if cur["raw"][i] - raw_old[i]
+            }
+            out[verb] = {
+                "count": cur["count"] - (old["count"] if old else 0),
+                "sum_s": round(cur["sum"] - (old["sum"] if old else 0.0), 6),
+                "le": le,
+            }
+        return out
+
+    def _sample_resilience(self) -> dict:
+        if self.resilience is None:
+            return {}
+        flat = _flatten_resilience(
+            self.resilience.snapshot(), self.deterministic
+        )
+        prev = self._prev_resilience or {}
+        self._prev_resilience = flat
+        return {k: flat[k] - prev.get(k, 0) for k in sorted(flat)}
+
+    def _sample_recovery(self) -> dict:
+        if self.recovery is None:
+            return {}
+        try:
+            snap = self.recovery.counters.snapshot()
+        except Exception:
+            log.exception("timeline recovery tap failed")
+            return {}
+        prev = self._prev_recovery or {}
+        self._prev_recovery = snap
+        return {k: snap[k] - prev.get(k, 0) for k in sorted(snap)}
+
+    def _sample_throughput(self, now: float) -> dict:
+        if self.model is None:
+            return {}
+        try:
+            values = self.model.gauge_values(now=now)
+            out = {
+                "calibration_age_s": round(
+                    values["calibration_age_seconds"], 6
+                ),
+                "calibrated_nodes": values["calibrated_nodes"],
+            }
+            if self.dealer is not None:
+                from nanotpu.metrics.throughput import (
+                    modeled_aggregate_by_shard,
+                )
+
+                by_shard = modeled_aggregate_by_shard(self.dealer, self.model)
+                out["modeled_aggregate"] = round(
+                    sum(by_shard.values()), 4
+                )
+            return out
+        except Exception:
+            log.exception("timeline throughput tap failed")
+            return {}
+
+    def _sample_sources(self) -> dict:
+        out: dict = {}
+        for source in list(self._sources):
+            try:
+                values = source.sample()
+            except Exception:
+                # a crashing producer is visible, not fatal: its section
+                # carries an error marker instead of silently vanishing
+                log.exception(
+                    "timeline source %r failed", getattr(source, "name", "?")
+                )
+                values = {"error": 1}
+            out[str(source.name)] = {
+                k: values[k] for k in sorted(values)
+            }
+        return out
+
+    # -- retrieval ---------------------------------------------------------
+    @property
+    def latest_tick(self) -> int:
+        """Sequence number of the newest tick (0 before the first)."""
+        with self._lock:
+            return self._n
+
+    def latest(self) -> dict | None:
+        with self._lock:
+            if not self._ring:
+                return None
+            if len(self._ring) < self.capacity:
+                return self._ring[-1]
+            return self._ring[(self._slot - 1) % self.capacity]
+
+    def since(self, tick: int = 0, limit: int | None = None) -> list[dict]:
+        """Every retained tick with sequence number > ``tick``, oldest
+        first (the ``GET /debug/timeline?since=`` contract: a poller
+        passes the last tick it saw and receives only the delta),
+        optionally capped to the newest ``limit``."""
+        with self._lock:
+            if len(self._ring) < self.capacity:
+                ticks = list(self._ring)
+            else:
+                ticks = (
+                    self._ring[self._slot:] + self._ring[:self._slot]
+                )
+        out = [t for t in ticks if t["tick"] > tick]
+        if limit is not None and limit >= 0:
+            out = out[len(out) - min(len(out), limit):]
+        return out
+
+    def digest(self) -> str:
+        """sha256 over the canonical serialization of every retained
+        tick — with the sim's virtual clock and deterministic mode this
+        is byte-reproducible and lands in the report's ``timeline``
+        section (part of the determinism contract)."""
+        blob = json.dumps(
+            self.since(0), sort_keys=True, separators=(",", ":")
+        ).encode()
+        return "sha256:" + hashlib.sha256(blob).hexdigest()
+
+    def tick_gauge_values(self) -> dict:
+        """The unlabeled ``nanotpu_timeline_*`` gauge values from the
+        newest tick (zeros before the first). Keys must match the
+        ``_TIMELINE_GAUGES`` table in nanotpu/metrics/timeline.py exactly
+        — the nanolint metrics-completeness pass pins the equivalence
+        both ways, the same honesty contract the throughput gauges live
+        under."""
+        latest = self.latest()
+        fleet = latest["fleet"] if latest else {}
+        return {
+            "tick": latest["tick"] if latest else 0,
+            "occupancy": fleet.get("occupancy", 0.0),
+            "fragmentation": fleet.get("fragmentation", 0.0),
+            "whole_free_chips": fleet.get("whole_free_chips", 0),
+            "parked_gangs": fleet.get("parked_gangs", 0),
+            "parked_members": fleet.get("parked_members", 0),
+            "oldest_park_age_seconds": fleet.get("oldest_park_age_s", 0.0),
+            "sources": len(self._sources),
+        }
+
+
+class TelemetryLoop:
+    """Production cadence driver: one daemon thread ticking the timeline
+    every ``period_s``, evaluating the SLO watchdog, and handing breach
+    transitions to the flight recorder (the sim drives the same three
+    objects as virtual-time ``telemetry_tick`` events instead —
+    docs/observability.md)."""
+
+    def __init__(self, timeline: Timeline, watchdog=None, flight=None,
+                 period_s: float = 5.0):
+        if period_s <= 0:
+            raise ValueError(f"telemetry period must be > 0, got {period_s}")
+        self.timeline = timeline
+        self.watchdog = watchdog
+        self.flight = flight
+        self.period_s = float(period_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="telemetry"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.timeline.tick()
+                if self.watchdog is None:
+                    continue
+                for tr in self.watchdog.evaluate():
+                    if tr["event"] == "breach":
+                        log.warning(
+                            "SLO breach: %s (burn long=%.3f short=%.3f)",
+                            tr["name"], tr["burn_long"], tr["burn_short"],
+                        )
+                        if self.flight is not None:
+                            self.flight.dump(f"slo:{tr['name']}")
+                    else:
+                        log.info("SLO recovered: %s", tr["name"])
+            except Exception:  # telemetry must never kill the process
+                log.exception("telemetry tick failed")

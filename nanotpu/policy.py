@@ -99,6 +99,11 @@ class PolicySpec:
     priorities: tuple[PriorityWeight, ...] = ()
     #: None == no throughput section (the rater keeps its seed defaults)
     throughput: ThroughputSpec | None = None
+    #: declared SLO objectives over telemetry-timeline series
+    #: (``slo:`` section, docs/observability.md) — hot-reloaded into the
+    #: SLO watchdog via on_reload like the throughput table; None == no
+    #: slo section (the watchdog keeps its current objective set)
+    slo: tuple | None = None
 
     def period_for(self, metric: str, default: float = 15.0) -> float:
         for sp in self.sync_periods:
@@ -143,11 +148,13 @@ def parse_policy(text: str) -> PolicySpec:
         body = doc
     if not isinstance(body, dict):
         raise ValueError("policy document must be a mapping")
-    if not any(k in body for k in ("syncPeriod", "priority", "throughput")):
+    if not any(
+        k in body for k in ("syncPeriod", "priority", "throughput", "slo")
+    ):
         # any YAML mapping parses "successfully"; require at least one known
         # key so unrelated/garbage files don't silently become empty policy
         raise ValueError(
-            "policy document has none of syncPeriod/priority/throughput"
+            "policy document has none of syncPeriod/priority/throughput/slo"
         )
     periods = []
     for entry in body.get("syncPeriod") or []:
@@ -194,9 +201,16 @@ def parse_policy(text: str) -> PolicySpec:
                     f"bad throughput table entry {entry!r}: {e}"
                 ) from e
         throughput = ThroughputSpec(alpha=alpha, entries=tuple(entries))
+    slo = None
+    if "slo" in body:
+        # shared validator with the sim scenario's telemetry.slo list —
+        # one schema, two config carriers (docs/observability.md)
+        from nanotpu.metrics.slo import parse_objectives
+
+        slo = parse_objectives(body.get("slo") or [])
     return PolicySpec(
         sync_periods=tuple(periods), priorities=tuple(weights),
-        throughput=throughput,
+        throughput=throughput, slo=slo,
     )
 
 

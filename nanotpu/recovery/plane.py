@@ -153,9 +153,7 @@ def _scratch_chips(info) -> ChipSet:
 
 
 def _whole_free(chips: ChipSet) -> int:
-    return sum(
-        1 for c in chips.chips if c.percent_free == c.percent_total
-    )
+    return chips.whole_free()
 
 
 def uniform_whole_host_total(totals, infos, allowed) -> int | None:

@@ -57,6 +57,18 @@ log = logging.getLogger("nanotpu.routes")
 
 VERSION = "0.1.0"
 
+#: every GET ``/debug/*`` route prefix dispatch() serves. ALL of them
+#: are admission-gate-exempt like /healthz — an overloaded scheduler is
+#: exactly when its diagnostics matter — and the overload tests
+#: parametrize over this tuple so a new endpoint joins the exemption
+#: pin automatically (docs/observability.md).
+DEBUG_ROUTES = (
+    "/debug/pprof",
+    "/debug/traces/",
+    "/debug/decisions",
+    "/debug/timeline",
+)
+
 
 def error_body(reason: str, message: str, **extra) -> str:
     """The ONE JSON error envelope every non-200 answer uses — the
@@ -251,6 +263,12 @@ class SchedulerAPI:
         #: kube-scheduler cycle), so the second verb skips its JSON decode.
         #: Tuple swap is atomic under the GIL; a miss just re-parses.
         self._parse_cache: tuple[bytes, dict] | None = None
+        #: telemetry surface (docs/observability.md): timeline sampler,
+        #: SLO watchdog, flight recorder — attached by attach_telemetry
+        #: exactly when cmd/main enables them, None costs nothing
+        self.timeline = None
+        self.slo = None
+        self.flight = None
         #: NodeNames-span bytes -> parsed list. nodeCacheCapable payloads
         #: repeat the identical candidate list across every pod's Filter,
         #: and that list is most of the body — the pre-tokenized fast path
@@ -285,6 +303,8 @@ class SchedulerAPI:
                 return self._debug_traces(path)
             if method == "GET" and path.startswith("/debug/decisions"):
                 return self._debug_decisions(path)
+            if method == "GET" and path.startswith("/debug/timeline"):
+                return self._debug_timeline(path)
             return 404, "application/json", error_body(
                 "NotFound", f"no route {path}"
             )
@@ -480,6 +500,23 @@ class SchedulerAPI:
         # the lone span was nested (not the top-level key): reparse fully
         return json.loads(body)
 
+    # -- telemetry (docs/observability.md) ---------------------------------
+    def attach_telemetry(self, timeline, watchdog=None,
+                         flight=None) -> None:
+        """Adopt the telemetry surface: serve ``GET /debug/timeline``
+        from ``timeline``'s ring and register the ``nanotpu_timeline_*``
+        / ``nanotpu_slo_*`` exporters. Deployments that never call this
+        export nothing new and 404 the endpoint."""
+        from nanotpu.metrics.slo import SLOExporter
+        from nanotpu.metrics.timeline import TimelineExporter
+
+        self.timeline = timeline
+        self.slo = watchdog
+        self.flight = flight
+        self.registry.register(TimelineExporter(timeline))
+        if watchdog is not None:
+            self.registry.register(SLOExporter(watchdog))
+
     # -- readiness ---------------------------------------------------------
     def add_ready_check(self, name: str, fn) -> None:
         """Register a readiness gate; ``fn()`` truthy == ready. cmd/main
@@ -573,6 +610,43 @@ class SchedulerAPI:
             "pipeline": (
                 pipeline_status() if pipeline_status is not None else {}
             ),
+        }, sort_keys=True)
+
+    def _debug_timeline(self, path: str) -> tuple[int, str, str]:
+        """``GET /debug/timeline?since=<tick>&limit=N``: retained
+        telemetry ticks newer than ``since`` (oldest first — a poller
+        passes the last tick it saw and receives only the delta), plus
+        the SLO watchdog's per-objective state. Admission-exempt like
+        every /debug route."""
+        if self.timeline is None:
+            return 404, "application/json", error_body(
+                "NotFound",
+                "telemetry timeline disabled; enable with "
+                "--timeline-period (docs/observability.md)",
+            )
+        _, _, query = path.partition("?")
+        params = dict(
+            kv.split("=", 1) for kv in query.split("&") if "=" in kv
+        )
+        try:
+            since = int(params.get("since", 0))
+            limit = min(
+                max(int(params.get("limit", self.timeline.capacity)), 1),
+                self.timeline.capacity,
+            )
+        except ValueError:
+            return 400, "application/json", error_body(
+                "BadRequest", "since and limit must be integers"
+            )
+        ticks = self.timeline.since(since, limit=limit)
+        return 200, "application/json", json.dumps({
+            "latest": self.timeline.latest_tick,
+            "since": since,
+            "count": len(ticks),
+            "ticks": ticks,
+            # per-objective burn-rate state ({} with no watchdog): the
+            # "were we inside SLO" half of the post-mortem read
+            "slo": self.slo.status() if self.slo is not None else {},
         }, sort_keys=True)
 
     # -- idle-time GC (the between-burst half of the GC discipline) --------

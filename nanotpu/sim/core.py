@@ -150,6 +150,42 @@ class Simulator:
             self.dealer.recovery = self.plane
         else:
             self.plane = None
+        # telemetry plane (docs/observability.md): timeline ticks as
+        # virtual-time events, SLO watchdog over the ring, flight
+        # recorder dumping on breach / dealer death. Deterministic mode
+        # filters wall-clock-bred series, so the report's `timeline`
+        # section (tick digest + bundle digest) joins the determinism
+        # contract. Like the obs bundle it survives agent restarts (the
+        # run's measurement, not the dealer's state) — _build_stack
+        # rewires the dealer refs.
+        tel = self.scenario["telemetry"]
+        if tel["enabled"]:
+            from nanotpu.metrics.slo import SLOWatchdog
+            from nanotpu.obs.flight import FlightRecorder
+            from nanotpu.obs.timeline import Timeline
+
+            self.timeline = Timeline(
+                dealer=self.dealer,
+                resilience=self.resilience,
+                recovery=self.plane,
+                model=getattr(self.dealer.rater, "model", None),
+                capacity=tel["capacity"],
+                clock=lambda: self.now, deterministic=True,
+            )
+            self.watchdog = SLOWatchdog(
+                self.timeline, obs=self.obs, clock=lambda: self.now
+            )
+            self.watchdog.configure(tel["slo"])
+            self.flight = FlightRecorder(
+                path=tel["flight_path"], timeline=self.timeline,
+                obs=self.obs, dealer=self.dealer,
+                resilience=self.resilience,
+                config={"scenario": self.scenario["name"], "seed": seed},
+                ticks=tel["flight_ticks"],
+                clock=lambda: self.now, deterministic=True,
+            )
+        else:
+            self.timeline = self.watchdog = self.flight = None
         # the informer tap: the sim owns the watches and feeds the REAL
         # controller handlers, with the fault layer in between
         self._pod_watch = self.client.watch_pods()
@@ -198,6 +234,16 @@ class Simulator:
             # intent, not dealer state) and points at the fresh dealer
             plane.dealer = self.dealer
             self.dealer.recovery = plane
+        timeline = getattr(self, "timeline", None)
+        if timeline is not None:
+            # agent restart: telemetry is the run's measurement — the
+            # ring and SLO state persist, only the dealer refs move
+            # (rewire_dealer also resets the perf-delta baseline: the
+            # fresh dealer's counters restart at zero)
+            timeline.rewire_dealer(
+                self.dealer, getattr(self.dealer.rater, "model", None)
+            )
+            self.flight.dealer = self.dealer
         if hasattr(self, "controller"):
             self.controller.dealer = self.dealer
         else:
@@ -293,6 +339,12 @@ class Simulator:
             while t < horizon:
                 self._push(t, "recovery_cycle", None)
                 t += rec["every_s"]
+        tel = self.scenario["telemetry"]
+        if tel["enabled"]:
+            t = tel["every_s"]
+            while t < horizon:
+                self._push(t, "telemetry_tick", None)
+                t += tel["every_s"]
         metric_every, metric_delay = self.faults.metric_cadence()
         if metric_every > 0:
             t = metric_every
@@ -339,6 +391,8 @@ class Simulator:
             self._on_assume_sweep()
         elif kind == "recovery_cycle":
             self._on_recovery()
+        elif kind == "telemetry_tick":
+            self._on_telemetry()
         else:  # pragma: no cover - event kinds are closed within this file
             raise AssertionError(f"unknown event kind {kind}")
 
@@ -726,6 +780,13 @@ class Simulator:
     def _on_agent_restart(self) -> None:
         occ_before = self.dealer.occupancy()
         self.dealer.close()
+        if self.flight is not None:
+            # post-mortem against the DEAD dealer, before the rebuild:
+            # the bundle must come out complete even though the process
+            # it describes is gone (the acceptance drill for real
+            # crash-time dumps; every tap degrades, never raises)
+            self.flight.dump("dealer_death", now=self.now)
+            self.report.journal(self.now, "flight-dump dealer_death")
         self._build_stack()
         occ_after = self.dealer.occupancy()
         # the rebuilt dealer must agree with the DURABLE state (live pod
@@ -794,6 +855,30 @@ class Simulator:
             # next retry tick (that idle is exactly the reserved-capacity
             # waste the backfill half exists to recoup)
             self._on_retry()
+
+    def _on_telemetry(self) -> None:
+        """One telemetry tick on virtual time: snapshot the timeline,
+        run the SLO watchdog's two-window burn evaluation, journal the
+        tick and every breach/clear transition (digest-witnessed), and
+        hand breach transitions to the flight recorder — exactly the
+        production TelemetryLoop body, driven deterministically."""
+        tick = self.timeline.tick(now=self.now)
+        self.report.journal(
+            self.now,
+            f"telemetry tick={tick['tick']} "
+            f"occ={tick['fleet']['occupancy']:.6f} "
+            f"frag={tick['fleet']['fragmentation']:.4f} "
+            f"whole_free={tick['fleet']['whole_free_chips']}",
+        )
+        for tr in self.watchdog.evaluate(now=self.now):
+            self.report.journal(
+                self.now,
+                f"slo-{tr['event']} {tr['name']} "
+                f"burn_long={tr['burn_long']:.6f} "
+                f"burn_short={tr['burn_short']:.6f}",
+            )
+            if tr["event"] == "breach":
+                self.flight.dump(f"slo:{tr['name']}", now=self.now)
 
     def _on_assume_sweep(self) -> None:
         expired = self.controller.sweep_assumed_once(
@@ -906,6 +991,11 @@ class Simulator:
                 f"VIOLATIONS {len(violations)} "
                 + ",".join(sorted({v['kind'] for v in violations})),
             )
+            if self.flight is not None:
+                # the flight recorder's third trigger: a broken
+                # invariant IS the incident, and the bundle captures the
+                # state that broke it (deterministic: violations are)
+                self.flight.dump("invariant_violation", now=self.now)
 
     def _deterministic_resilience(self) -> dict:
         """The resilience-counter snapshot MINUS the Event recorder's
@@ -959,6 +1049,28 @@ class Simulator:
                 f"throughput agg={agg['aggregate']:.4f} "
                 f"oracle={agg['oracle']:.4f} "
                 f"loss={agg['loss_vs_oracle_pct']:.2f}%",
+            )
+        if self.timeline is not None:
+            # deterministic telemetry section: every tick is virtual-time
+            # data sampled on the sim thread, so the ring digest AND the
+            # newest flight bundle's byte digest join the determinism
+            # contract (docs/observability.md)
+            breaches = {
+                name: state["breaches"]
+                for name, state in self.watchdog.status().items()
+            }
+            self.report.timeline = {
+                "ticks": self.timeline.latest_tick,
+                "digest": self.timeline.digest(),
+                "breaches": breaches,
+                "bundles": self.flight.bundles,
+                "bundle_digest": self.flight.digest(),
+            }
+            self.report.journal(
+                horizon,
+                f"telemetry ticks={self.timeline.latest_tick} "
+                f"breaches={sum(breaches.values())} "
+                f"bundles={self.flight.bundles}",
             )
         if self.plane is not None:
             # deterministic recovery section: counters are bumped only on

@@ -13,18 +13,10 @@ The report splits in two:
   reproducible, so this section is opt-in (``--timing`` / include_timing)
   and never feeds the digest.
 
-Fragmentation is two-level, matching how a gang actually lands: chips
-within a host must be ICI-contiguous on the host torus
-(:meth:`nanotpu.topology.Torus.compactness`), and a multi-host gang's
-hosts must be adjacent on the slice host-grid (the same
-``_grid_compactness`` the gang scorer awards its bonus with). Each level
-is a free-chip-weighted mean compactness of the FREE capacity; the fleet
-score is ``1 - intra * inter``, so 0.0 means every free chip sits in a
-contiguous block on a contiguous run of hosts (a new gang can land on
-ICI) and values toward 1.0 mean free capacity is scattered fragments no
-sub-torus demand can use. Host-level matters most: a 4-chip host's free
-set is almost always compact, but churn strews free HOSTS across the
-slice grid.
+Fragmentation is the two-level fleet ICI metric from
+:mod:`nanotpu.dealer.frag` (shared with the timeline's production tap —
+see that module's docstring for the math); ``fragmentation_of`` is
+re-exported here for the sim's callers.
 """
 
 from __future__ import annotations
@@ -32,47 +24,8 @@ from __future__ import annotations
 import hashlib
 import json
 
-from nanotpu.dealer.gang import _grid_compactness
+from nanotpu.dealer.frag import fragmentation_of  # noqa: F401  (re-export)
 from nanotpu.metrics.stats import summarize
-from nanotpu.topology import parse_slice_coords
-
-
-def fragmentation_of(dealer) -> float:
-    """Fleet ICI-fragmentation in [0, 1] from the dealer's live accounting
-    (0 == all free capacity contiguous; see module docstring)."""
-    snap = dealer.debug_snapshot()
-    intra_weighted = 0.0
-    total_free = 0
-    # slice name -> (free-host coords, free whole chips on them)
-    slices: dict[str, tuple[list, int]] = {}
-    for name in sorted(snap["node_infos"]):
-        info = snap["node_infos"][name]
-        free = frozenset(
-            i for i, c in enumerate(info.chips.chips)
-            if c.percent_free == c.percent_total
-        )
-        if not free:
-            continue
-        intra_weighted += info.chips.torus.compactness(free) * len(free)
-        total_free += len(free)
-        # nodes without slice labels are their own singleton slice
-        key = info.slice_name or f"__solo__{name}"
-        try:
-            coord = parse_slice_coords(info.slice_coords)
-        except Exception:
-            coord = (0, 0, 0)
-        coords, chips = slices.get(key, ([], 0))
-        coords.append(coord)
-        slices[key] = (coords, chips + len(free))
-    if total_free == 0:
-        return 0.0  # nothing free: nothing to fragment
-    inter_weighted = sum(
-        _grid_compactness(coords) * chips
-        for coords, chips in slices.values()
-    )
-    intra = intra_weighted / total_free
-    inter = inter_weighted / total_free
-    return round(1.0 - intra * inter, 4)
 
 
 class ReportBuilder:
@@ -115,6 +68,11 @@ class ReportBuilder:
         #: (docs/defrag.md); empty == recovery disabled, keeping
         #: existing scenario reports (and digests) byte-identical
         self.recovery: dict = {}
+        #: telemetry-timeline summary (tick count + ring digest, SLO
+        #: breach counts, flight-bundle count + newest bundle digest —
+        #: docs/observability.md); empty == telemetry disabled, same
+        #: opt-in digest rule as throughput/recovery
+        self.timeline: dict = {}
         self.restart_occupancy_drift = 0.0
         self.final_occupancy = 0.0
         self.final_fragmentation = 0.0
@@ -206,6 +164,16 @@ class ReportBuilder:
                     if isinstance(v, dict) else v
                 )
             report["recovery"] = rec
+        if self.timeline:
+            # same opt-in rule again (docs/observability.md)
+            tl: dict = {}
+            for k in sorted(self.timeline):
+                v = self.timeline[k]
+                tl[k] = (
+                    {kk: v[kk] for kk in sorted(v)}
+                    if isinstance(v, dict) else v
+                )
+            report["timeline"] = tl
         if include_timing:
             report["timing"] = {
                 "note": "wall-clock; excluded from the determinism contract",

@@ -77,6 +77,20 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
         "gang_start_horizon_s": 5.0, # hole's promised gang start
         "hole_ttl_s": 30.0
       },
+      "telemetry": {                 # fleet telemetry timeline + SLO
+                                     # watchdog + flight recorder
+                                     # (docs/observability.md); absent/
+                                     # disabled keeps every existing
+                                     # digest byte-identical
+        "enabled": false,
+        "every_s": 1.0,              # telemetry_tick cadence (virtual)
+        "capacity": 512,             # timeline ring depth
+        "flight_ticks": 64,          # ticks bundled per flight dump
+        "flight_path": "",           # bundle file ("" = in-memory only,
+                                     # digest still pinned in the report)
+        "slo": []                    # SLO objectives (same schema as
+                                     # policy.yaml's slo: section)
+      },
       "lock_witness": false,         # true: instrument every lock and
                                      # assert acquisition-order acyclicity
                                      # at teardown (docs/static-analysis.md)
@@ -232,6 +246,30 @@ def normalize_scenario(raw: dict) -> dict:
         and pipeline >= 1,
         f"pipeline must be an int >= 1, got {pipeline!r}",
     )
+    tel = dict(raw.get("telemetry") or {})
+    try:
+        from nanotpu.metrics.slo import parse_objectives
+
+        slo = parse_objectives(tel.get("slo") or [])
+    except ValueError as e:
+        raise ValueError(f"bad scenario: telemetry.slo: {e}") from e
+    telemetry = {
+        "enabled": bool(tel.get("enabled", False)),
+        "every_s": float(tel.get("every_s", 1.0)),
+        "capacity": int(tel.get("capacity", 512)),
+        "flight_ticks": int(tel.get("flight_ticks", 64)),
+        "flight_path": str(tel.get("flight_path", "")),
+        "slo": slo,
+    }
+    _require(
+        not telemetry["enabled"] or telemetry["every_s"] > 0,
+        "telemetry.every_s must be > 0 when telemetry is enabled",
+    )
+    _require(
+        telemetry["capacity"] > 0 and telemetry["flight_ticks"] > 0,
+        "telemetry.capacity and telemetry.flight_ticks must be > 0",
+    )
+
     rec = dict(raw.get("recovery") or {})
     recovery = {
         "enabled": bool(rec.get("enabled", False)),
@@ -273,6 +311,7 @@ def normalize_scenario(raw: dict) -> dict:
         "shards": shards,
         "pipeline": pipeline,
         "recovery": recovery,
+        "telemetry": telemetry,
         "metric_from_allocation": bool(
             raw.get("metric_from_allocation", False)
         ),

@@ -899,6 +899,99 @@ class TestMetricsCompleteness:
         assert not any("gauge" in f.message for f in report.findings), \
             report.findings
 
+    # -- timeline gauge family (nanotpu/metrics/timeline.py) ---------------
+    def test_timeline_gauge_produced_but_undeclared(self, tmp_path):
+        report = lint(tmp_path, {
+            "exporter.py": """
+                _TIMELINE_GAUGES = {"occupancy": "occ"}
+                """,
+            "timeline.py": """
+                class Timeline:
+                    def tick_gauge_values(self):
+                        return {"occupancy": 0.5, "ghost_tick_gauge": 1.0}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("ghost_tick_gauge" in m and "not declared" in m
+                   for m in msgs), msgs
+
+    def test_timeline_gauge_declared_but_never_produced(self, tmp_path):
+        report = lint(tmp_path, {
+            "exporter.py": """
+                _TIMELINE_GAUGES = {
+                    "occupancy": "occ",
+                    "dead_tick_gauge": "declared but never produced",
+                }
+                """,
+            "timeline.py": """
+                class Timeline:
+                    def tick_gauge_values(self):
+                        return {"occupancy": 0.5}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("dead_tick_gauge" in m and "KeyError" in m
+                   for m in msgs), msgs
+        assert not any("'occupancy'" in m for m in msgs), msgs
+
+    # -- SLO gauge family (nanotpu/metrics/slo.py) -------------------------
+    def test_slo_gauge_produced_but_undeclared(self, tmp_path):
+        report = lint(tmp_path, {
+            "slo.py": """
+                _SLO_GAUGES = {"objectives": "n"}
+
+                class SLOWatchdog:
+                    def slo_gauge_values(self):
+                        return {"objectives": 2, "ghost_slo_gauge": 1}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("ghost_slo_gauge" in m and "not declared" in m
+                   for m in msgs), msgs
+
+    def test_slo_gauge_declared_but_never_produced(self, tmp_path):
+        report = lint(tmp_path, {
+            "slo.py": """
+                _SLO_GAUGES = {
+                    "objectives": "n",
+                    "dead_slo_gauge": "declared but never produced",
+                }
+
+                class SLOWatchdog:
+                    def slo_gauge_values(self):
+                        return {"objectives": 2}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("dead_slo_gauge" in m and "KeyError" in m
+                   for m in msgs), msgs
+
+    def test_gauge_families_do_not_cross_pollinate(self, tmp_path):
+        # distinct producer names per family: a timeline tick gauge must
+        # not be held against the throughput/SLO tables (and vice versa)
+        report = lint(tmp_path, {
+            "exporters.py": """
+                _THROUGHPUT_GAUGES = {"calibrated_nodes": "n"}
+                _TIMELINE_GAUGES = {"occupancy": "occ"}
+                _SLO_GAUGES = {"objectives": "n"}
+                """,
+            "producers.py": """
+                class Model:
+                    def gauge_values(self, now=None):
+                        return {"calibrated_nodes": 3.0}
+
+                class Timeline:
+                    def tick_gauge_values(self):
+                        return {"occupancy": 0.5}
+
+                class SLOWatchdog:
+                    def slo_gauge_values(self):
+                        return {"objectives": 2}
+                """,
+        }, ["metrics-completeness"])
+        assert not any("gauge" in f.message for f in report.findings), \
+            report.findings
+
 
 # ---------------------------------------------------------------------------
 # the ignore budget

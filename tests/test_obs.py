@@ -352,13 +352,29 @@ class TestGoldenDebugSchema:
         }
 
     def _live_schema(self):
-        client, _, api = _traced_api()
+        from nanotpu.metrics.slo import SLOWatchdog, parse_objectives
+        from nanotpu.obs.timeline import Timeline
+
+        client, dealer, api = _traced_api()
         uid, _ = _schedule_one(client, api)
+        timeline = Timeline(
+            dealer=dealer, verb_duration=api.verb_duration,
+        )
+        watchdog = SLOWatchdog(timeline, obs=api.obs)
+        watchdog.configure(parse_objectives([{
+            "name": "occupancy-floor", "kind": "threshold",
+            "series": "fleet.occupancy", "op": "ge", "threshold": 0.01,
+        }]))
+        api.attach_telemetry(timeline, watchdog)
+        timeline.tick()
+        watchdog.evaluate()
         _, _, traces = api.dispatch("GET", f"/debug/traces/{uid}", b"")
         _, _, decisions = api.dispatch("GET", "/debug/decisions?limit=5", b"")
+        _, _, tl = api.dispatch("GET", "/debug/timeline?limit=5", b"")
         return {
             "debug_traces": self._shape(json.loads(traces)),
             "debug_decisions": self._shape(json.loads(decisions)),
+            "debug_timeline": self._shape(json.loads(tl)),
         }
 
     def test_debug_json_matches_golden_schema(self, request):

@@ -1,0 +1,824 @@
+"""Fleet telemetry timeline, SLO burn-rate watchdog, and crash flight
+recorder (docs/observability.md): ring/delta mechanics, the
+TimelineSource duck protocol, two-window burn-rate math over all three
+objective kinds, breach edge-triggering into the ledger + counters, the
+flight recorder's post-mortem bundles (including against a dead dealer
+and at process exit), the /debug/timeline endpoint, the parametrized
+admission-gate exemption for EVERY /debug route, and the sim's
+deterministic timeline report section.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.cmd.main import make_mock_cluster
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.objects import make_container, make_pod
+from nanotpu.metrics.registry import Histogram, Registry
+from nanotpu.metrics.slo import (
+    _SLO_GAUGES,
+    SLObjective,
+    SLOExporter,
+    SLOWatchdog,
+    parse_objectives,
+)
+from nanotpu.metrics.timeline import _TIMELINE_GAUGES, TimelineExporter
+from nanotpu.obs import Observability
+from nanotpu.obs.flight import FlightRecorder
+from nanotpu.obs.timeline import TelemetryLoop, Timeline
+from nanotpu.policy import parse_policy
+from nanotpu.routes.server import (
+    DEBUG_ROUTES,
+    OverloadConfig,
+    SchedulerAPI,
+)
+from nanotpu.sim.core import Simulator
+from nanotpu.sim.report import render, strip_timing
+
+
+def _stack(n_hosts=2, sample=1, **overload_kw):
+    client = make_mock_cluster(n_hosts)
+    dealer = Dealer(client, make_rater(types.POLICY_BINPACK))
+    api = SchedulerAPI(
+        dealer, Registry(), obs=Observability(sample=sample),
+        overload=OverloadConfig(**overload_kw) if overload_kw else None,
+    )
+    return client, dealer, api
+
+
+def _schedule_one(client, api, name="job-0", percent=200):
+    pod = make_pod(
+        name,
+        containers=[make_container(
+            "main", {types.RESOURCE_TPU_PERCENT: percent}
+        )],
+    )
+    client.create_pod(pod)
+    server_pod = client.get_pod("default", name)
+    args = json.dumps({
+        "Pod": server_pod.raw,
+        "NodeNames": ["v5p-host-0", "v5p-host-1"],
+    }).encode()
+    code, _, filt = api.dispatch("POST", "/scheduler/filter", args)
+    assert code == 200, filt
+    api.dispatch("POST", "/scheduler/priorities", args)
+    best = json.loads(filt)["NodeNames"][0]
+    code, _, bound = api.dispatch("POST", "/scheduler/bind", json.dumps({
+        "PodName": name, "PodNamespace": "default",
+        "PodUID": server_pod.uid, "Node": best,
+    }).encode())
+    assert code == 200 and json.loads(bound)["Error"] == "", bound
+    return server_pod.uid
+
+
+class _FakeSource:
+    """TimelineSource duck: controllable external series."""
+
+    def __init__(self, name="src", values=None):
+        self.name = name
+        self.values = values if values is not None else {"value": 0.0}
+
+    def sample(self):
+        return dict(self.values)
+
+
+# ---------------------------------------------------------------------------
+# timeline ring + delta mechanics
+# ---------------------------------------------------------------------------
+class TestTimeline:
+    def test_tick_snapshots_fleet_and_pools(self):
+        client, dealer, api = _stack(sample=0)
+        _schedule_one(client, api)
+        tl = Timeline(dealer=dealer, clock=lambda: 7.0)
+        tick = tl.tick()
+        assert tick["tick"] == 1 and tick["t"] == 7.0
+        assert 0.0 < tick["fleet"]["occupancy"] < 1.0
+        # 2 hosts x 4 chips, one 2-chip pod bound -> 6 whole-free
+        assert tick["fleet"]["whole_free_chips"] == 6
+        assert tick["fleet"]["parked_gangs"] == 0
+        (pool_key, pool), = tick["pools"].items()
+        assert pool["hosts"] == 2
+        assert pool["occupancy"] == tick["fleet"]["occupancy"]
+        assert pool_key.startswith("v5p/")
+        assert tick["shards"]  # per-shard gen/epoch present
+
+    def test_perf_deltas_are_per_tick_not_cumulative(self):
+        client, dealer, api = _stack(sample=0)
+        tl = Timeline(dealer=dealer)
+        tl.tick()
+        _schedule_one(client, api)
+        second = tl.tick()
+        assert second["perf"]["native_calls"] > 0
+        third = tl.tick()  # nothing happened since
+        assert third["perf"]["native_calls"] == 0
+
+    def test_verb_histogram_deltas(self):
+        hist = Histogram("nanotpu_verb_duration_seconds", "t")
+        tl = Timeline(verb_duration=hist)
+        hist.observe(0.001, verb="filter")
+        hist.observe(3.0, verb="filter")
+        tick = tl.tick()
+        filt = tick["verbs"]["filter"]
+        assert filt["count"] == 2
+        assert filt["sum_s"] == pytest.approx(3.001)
+        # 3.0s overflows every bucket: only the 0.001 landed in an le
+        assert sum(filt["le"].values()) == 1
+        assert tl.tick()["verbs"]["filter"]["count"] == 0
+
+    def test_ring_bounded_and_since_contract(self):
+        tl = Timeline(capacity=3, clock=lambda: 0.0)
+        for _ in range(5):
+            tl.tick()
+        ticks = tl.since(0)
+        assert [t["tick"] for t in ticks] == [3, 4, 5]  # oldest evicted
+        assert [t["tick"] for t in tl.since(3)] == [4, 5]
+        assert [t["tick"] for t in tl.since(3, limit=1)] == [5]
+        assert tl.since(99) == []
+        assert tl.latest()["tick"] == 5
+        assert tl.latest_tick == 5
+
+    def test_sources_register_and_survive_errors(self):
+        tl = Timeline()
+        src = _FakeSource("serving", {"tok_s": 123.0, "queue": 4})
+        tl.register_source(src)
+
+        class Broken:
+            name = "broken"
+
+            def sample(self):
+                raise RuntimeError("dead producer")
+
+        tl.register_source(Broken())
+        tick = tl.tick()
+        assert tick["ext"]["serving"] == {"queue": 4, "tok_s": 123.0}
+        assert tick["ext"]["broken"] == {"error": 1}
+        with pytest.raises(ValueError):
+            tl.register_source(object())  # no name/sample
+        # a duplicate name would silently shadow the first producer's
+        # section in every tick (and any SLO over ext.<name>.* would
+        # judge an arbitrary winner) — rejected at registration
+        with pytest.raises(ValueError):
+            tl.register_source(_FakeSource("serving", {"tok_s": 1.0}))
+
+    def test_gauge_values_match_declared_table_exactly(self):
+        # the runtime half of the nanolint pin: same key sets, both ways
+        tl = Timeline()
+        assert set(tl.tick_gauge_values()) == set(_TIMELINE_GAUGES)
+        tl.tick()
+        assert set(tl.tick_gauge_values()) == set(_TIMELINE_GAUGES)
+
+    def test_exporter_renders_pool_series(self):
+        client, dealer, api = _stack(sample=0)
+        _schedule_one(client, api)
+        tl = Timeline(dealer=dealer)
+        tl.tick()
+        text = "\n".join(TimelineExporter(tl).render())
+        assert "nanotpu_timeline_occupancy " in text
+        assert 'nanotpu_timeline_pool_occupancy{pool="v5p/' in text
+        # empty timeline renders a zero default, never a broken family
+        empty = "\n".join(TimelineExporter(Timeline()).render())
+        assert 'nanotpu_timeline_pool_occupancy{pool="all"} 0.0' in empty
+
+    def test_rewire_dealer_resets_perf_delta_baseline(self):
+        # agent restart: the fresh dealer's counters restart at zero —
+        # deltas against the dead dealer's totals were negative garbage
+        client, dealer, api = _stack(sample=0)
+        tl = Timeline(dealer=dealer)
+        _schedule_one(client, api)
+        tl.tick()
+        fresh_client = make_mock_cluster(2)
+        fresh = Dealer(fresh_client, make_rater(types.POLICY_BINPACK))
+        tl.rewire_dealer(fresh)
+        tick = tl.tick()
+        assert all(v >= 0 for v in tick["perf"].values()), tick["perf"]
+        fresh.close()
+
+    def test_parked_gangs_counts_gangs_not_members(self):
+        from nanotpu.dealer.dealer import _Reservation
+
+        _, dealer, _ = _stack(sample=0)
+        # three members parked, two distinct gangs (poked directly —
+        # parking real barriers needs threads; the tap only reads
+        # valid/gang_key/parked_at)
+        for i, (gang, t) in enumerate(
+            [("g1", 5.0), ("g1", 3.0), ("g2", 8.0)]
+        ):
+            dealer._reserved[f"uid-{i}"] = _Reservation(
+                "n", None, None, gang, parked_at=t
+            )
+        park = dealer.gang_park_status(now=10.0)
+        assert park["parked"] == 2          # distinct gangs
+        assert park["parked_members"] == 3  # member reservations
+        assert park["oldest_age_s"] == 7.0  # vs the t=3.0 park
+        tick = Timeline(dealer=dealer, clock=lambda: 10.0).tick()
+        assert tick["fleet"]["parked_gangs"] == 2
+        assert tick["fleet"]["parked_members"] == 3
+
+    def test_source_may_call_back_into_the_timeline(self):
+        # sample() runs OUTSIDE the timeline lock: a producer that
+        # reads timeline state must not deadlock the tick
+        tl = Timeline()
+
+        class Reentrant:
+            name = "reentrant"
+
+            def sample(self):
+                latest = tl.latest()
+                return {"last_tick": latest["tick"] if latest else 0}
+
+        tl.register_source(Reentrant())
+        assert tl.tick()["ext"]["reentrant"] == {"last_tick": 0}
+        assert tl.tick()["ext"]["reentrant"] == {"last_tick": 1}
+
+    def test_deterministic_mode_filters_event_counters(self):
+        from nanotpu.metrics.resilience import ResilienceCounters
+
+        res = ResilienceCounters()
+        res.inc("events_failopen")
+        res.inc("api_retries", "bind")
+        res.inc("api_retries", "events")
+        det = Timeline(resilience=res, deterministic=True).tick()
+        live = Timeline(resilience=res).tick()
+        assert "events_failopen" not in det["resilience"]
+        assert "api_retries.events" not in det["resilience"]
+        assert det["resilience"]["api_retries.bind"] == 1
+        assert live["resilience"]["events_failopen"] == 1
+        assert live["resilience"]["api_retries.events"] == 1
+
+
+# ---------------------------------------------------------------------------
+# SLO parsing + burn-rate math + edge triggering
+# ---------------------------------------------------------------------------
+def _threshold_obj(**kw):
+    base = dict(
+        name="floor", kind="threshold", series="ext.src.value", op="ge",
+        threshold=0.5, target=0.9, long_s=4.0, short_s=2.0, burn=1.0,
+    )
+    base.update(kw)
+    return base
+
+
+class TestSLOParsing:
+    def test_valid_objectives_parse(self):
+        objs = parse_objectives([
+            _threshold_obj(),
+            {"name": "p99", "kind": "latency", "series": "verbs.filter",
+             "threshold": 2.0, "target": 0.99},
+            {"name": "errs", "kind": "ratio", "bad": "perf.a",
+             "total": "perf.b"},
+        ])
+        assert [o.name for o in objs] == ["floor", "p99", "errs"]
+        assert objs[0].op == "ge" and objs[1].kind == "latency"
+        # idempotent: re-parsing parsed objectives passes through
+        assert parse_objectives(objs) == objs
+
+    @pytest.mark.parametrize("bad", [
+        "not-a-list",
+        [{"name": "x", "kind": "bogus", "series": "a"}],
+        [{"name": "x", "kind": "threshold"}],          # no series
+        [{"name": "x", "kind": "ratio", "bad": "a"}],  # no total
+        # latency with a defaulted/zero threshold would class EVERY
+        # request bad and breach spuriously on first traffic
+        [{"name": "x", "kind": "latency", "series": "verbs.filter"}],
+        [_threshold_obj(target=1.5)],
+        [_threshold_obj(op="gt")],
+        [_threshold_obj(long_s=1.0, short_s=5.0)],
+        [_threshold_obj(burn=0)],
+        [_threshold_obj(), _threshold_obj()],          # duplicate name
+    ])
+    def test_malformed_objectives_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_objectives(bad)
+
+    def test_policy_yaml_slo_section(self):
+        spec = parse_policy("""
+policy:
+  slo:
+    - name: filter-p99
+      kind: latency
+      series: verbs.filter
+      threshold: 2.0
+      target: 0.99
+      long_s: 300
+      short_s: 30
+""")
+        assert spec.slo is not None and spec.slo[0].name == "filter-p99"
+        assert spec.slo[0].threshold == 2.0
+        # no slo key -> None (watchdog keeps its current set on reload)
+        assert parse_policy("policy:\n  priority: []\n").slo is None
+        with pytest.raises(ValueError):
+            parse_policy("policy:\n  slo:\n    - name: x\n")
+
+
+class TestBurnRates:
+    def _rig(self, objective):
+        tl = Timeline(clock=lambda: 0.0)
+        src = _FakeSource("src")
+        tl.register_source(src)
+        obs = Observability(sample=1, clock=lambda: 0.0)
+        dog = SLOWatchdog(tl, obs=obs, clock=lambda: 0.0)
+        dog.configure(parse_objectives([objective]))
+        return tl, src, dog, obs
+
+    def test_threshold_breach_needs_both_windows(self):
+        tl, src, dog, _ = self._rig(_threshold_obj())
+        # budget = 0.1; burn 1.0 trips at bad_fraction >= 0.1
+        src.values["value"] = 1.0
+        for t in range(4):
+            tl.tick(now=float(t))
+            assert dog.evaluate(now=float(t)) == []
+        # one bad tick inside a 4s long window = 25% bad -> long burns,
+        # and the 2s short window (2 ticks) burns too -> breach
+        src.values["value"] = 0.0
+        tl.tick(now=4.0)
+        (tr,) = dog.evaluate(now=4.0)
+        assert tr["event"] == "breach" and tr["name"] == "floor"
+        assert tr["burn_long"] >= 1.0 and tr["burn_short"] >= 1.0
+        # good ticks push the SHORT window clean -> clear fires even
+        # while the long window still remembers the bad tick
+        src.values["value"] = 1.0
+        cleared = []
+        for t in (5.0, 6.0, 7.0, 8.0, 9.0):
+            tl.tick(now=t)
+            cleared += dog.evaluate(now=t)
+        assert [tr["event"] for tr in cleared] == ["clear"]
+        state = dog.status()["floor"]
+        assert state["breaches"] == 1 and not state["breached"]
+
+    def test_no_data_is_no_burn(self):
+        tl, _, dog, _ = self._rig(_threshold_obj(series="ext.ghost.value"))
+        tl.tick(now=0.0)
+        assert dog.evaluate(now=0.0) == []
+        assert dog.status()["floor"]["burn_long"] == 0.0
+
+    def test_latency_kind_counts_requests_not_ticks(self):
+        hist = Histogram("nanotpu_verb_duration_seconds", "t")
+        tl = Timeline(verb_duration=hist, clock=lambda: 0.0)
+        dog = SLOWatchdog(tl, clock=lambda: 0.0)
+        dog.configure(parse_objectives([{
+            "name": "p99", "kind": "latency", "series": "verbs.filter",
+            "threshold": 1.0, "target": 0.9,
+            "long_s": 10.0, "short_s": 5.0, "burn": 1.0,
+        }]))
+        # 97 fast + 3 over-threshold = 3% bad; budget 10% -> burn 0.3
+        for _ in range(97):
+            hist.observe(0.01, verb="filter")
+        for _ in range(3):
+            hist.observe(2.0, verb="filter")
+        tl.tick(now=1.0)
+        assert dog.evaluate(now=1.0) == []
+        assert dog.status()["p99"]["burn_long"] == pytest.approx(0.3)
+        # a 20%-bad blip: the 5s short window (this tick only) burns,
+        # but the 10s long window still holds the 97 good requests —
+        # the long window filters blips, so NO breach yet
+        for _ in range(8):
+            hist.observe(0.01, verb="filter")
+        for _ in range(2):
+            hist.observe(2.0, verb="filter")
+        tl.tick(now=11.0)
+        assert dog.evaluate(now=11.0) == []
+        state = dog.status()["p99"]
+        assert state["burn_short"] >= 1.0 > state["burn_long"]
+        # sustained badness ages the good requests out of the long
+        # window too -> both windows burn -> breach
+        for _ in range(10):
+            hist.observe(2.0, verb="filter")
+        tl.tick(now=12.0)
+        (tr,) = dog.evaluate(now=12.0)
+        assert tr["event"] == "breach"
+
+    def test_ratio_kind(self):
+        tl = Timeline(clock=lambda: 0.0)
+        src = _FakeSource("src", {"bad": 0, "total": 100})
+        tl.register_source(src)
+        dog = SLOWatchdog(tl, clock=lambda: 0.0)
+        dog.configure(parse_objectives([{
+            "name": "errs", "kind": "ratio", "bad": "ext.src.bad",
+            "total": "ext.src.total", "target": 0.95,
+            "long_s": 10.0, "short_s": 5.0, "burn": 1.0,
+        }]))
+        tl.tick(now=1.0)
+        assert dog.evaluate(now=1.0) == []
+        src.values["bad"] = 50
+        tl.tick(now=2.0)
+        (tr,) = dog.evaluate(now=2.0)
+        assert tr["event"] == "breach"
+        # bad fraction 50/200 over the window, budget 5% -> burn 5.0
+        assert dog.status()["errs"]["burn_long"] == pytest.approx(5.0)
+
+    def test_breach_reaches_ledger_as_uidless_aggregate(self):
+        tl, src, dog, obs = self._rig(
+            _threshold_obj(long_s=2.0, short_s=1.0)
+        )
+        src.values["value"] = 0.0
+        tl.tick(now=0.0)
+        dog.evaluate(now=0.0)
+        assert obs.ledger.abort_summary() == {"slo_breach:floor": 1}
+        assert obs.ledger.dump() == []  # aggregate, never a ring record
+
+    def test_configure_reload_keeps_surviving_state(self):
+        tl, src, dog, _ = self._rig(
+            _threshold_obj(long_s=2.0, short_s=1.0)
+        )
+        src.values["value"] = 0.0
+        tl.tick(now=0.0)
+        dog.evaluate(now=0.0)
+        assert dog.status()["floor"]["breaches"] == 1
+        # hot reload with the same objective + a new one: breach count
+        # survives (a table edit must not reset history)
+        dog.configure(parse_objectives([
+            _threshold_obj(), _threshold_obj(name="other"),
+        ]))
+        assert dog.status()["floor"]["breaches"] == 1
+        assert dog.status()["other"]["breaches"] == 0
+        # dropping an objective drops its state
+        dog.configure(parse_objectives([_threshold_obj(name="other")]))
+        assert set(dog.status()) == {"other"}
+
+    def test_exporter_and_gauge_table_agree(self):
+        tl, src, dog, _ = self._rig(
+            _threshold_obj(long_s=2.0, short_s=1.0)
+        )
+        assert set(dog.slo_gauge_values()) == set(_SLO_GAUGES)
+        src.values["value"] = 0.0
+        tl.tick(now=0.0)
+        dog.evaluate(now=0.0)
+        text = "\n".join(SLOExporter(dog).render())
+        assert 'nanotpu_slo_breach_total{slo="floor"} 1' in text
+        assert 'nanotpu_slo_breached{slo="floor"} 1' in text
+        assert 'nanotpu_slo_burn_rate{slo="floor",window="long"}' in text
+        assert "nanotpu_slo_objectives 1" in text
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def _recorder(self, tmp_path=None, **kw):
+        client, dealer, api = _stack(sample=1)
+        uid = _schedule_one(client, api)
+        tl = Timeline(dealer=dealer, clock=lambda: 5.0)
+        tl.tick()
+        rec = FlightRecorder(
+            path=str(tmp_path / "flight.json") if tmp_path else "",
+            timeline=tl, obs=api.obs, dealer=dealer,
+            config={"flag": 1}, clock=lambda: 6.0, **kw,
+        )
+        return rec, dealer, uid
+
+    def test_bundle_is_complete_and_joined(self):
+        rec, _, uid = self._recorder()
+        bundle = rec.bundle("slo:floor")
+        assert bundle["trigger"] == "slo:floor"
+        assert bundle["config_fingerprint"].startswith("sha256:")
+        assert bundle["ticks"][0]["fleet"]["occupancy"] > 0
+        assert any(d["uid"] == uid for d in bundle["decisions"])
+        # traces joined on the decision records' uids
+        assert uid in bundle["traces"]
+        assert bundle["shards"] and "pending" in bundle["pipeline"]
+        assert bundle["perf"]["native_calls"] > 0
+        assert bundle["gangs"]["parked"] == 0
+
+    def test_dump_writes_atomically_and_digests(self, tmp_path):
+        rec, _, _ = self._recorder(tmp_path)
+        data = rec.dump("shutdown")
+        on_disk = (tmp_path / "flight.json").read_bytes()
+        assert on_disk == data
+        assert json.loads(on_disk)["trigger"] == "shutdown"
+        assert rec.digest().startswith("sha256:")
+        assert rec.bundles == 1
+        assert rec.last_bundle()["trigger"] == "shutdown"
+        assert not list(tmp_path.glob("*.tmp.*"))  # tmp renamed away
+
+    def test_lifecycle_dump_never_clobbers_incident_bundle(self, tmp_path):
+        rec, _, _ = self._recorder(tmp_path)
+        path = tmp_path / "flight.json"
+        # no incident yet: lifecycle bundles own the path
+        rec.dump("shutdown")
+        assert json.loads(path.read_text())["trigger"] == "shutdown"
+        # an incident takes the path over...
+        rec.dump("slo:floor")
+        assert json.loads(path.read_text())["trigger"] == "slo:floor"
+        # ...and later lifecycle dumps divert to <path>.exit instead of
+        # replacing the breach-time forensics with a healthy goodbye
+        rec.dump("process_exit")
+        assert json.loads(path.read_text())["trigger"] == "slo:floor"
+        exit_bundle = json.loads((tmp_path / "flight.json.exit").read_text())
+        assert exit_bundle["trigger"] == "process_exit"
+        # a newer incident still wins the path (newest incident wins)
+        rec.dump("dealer_death")
+        assert json.loads(path.read_text())["trigger"] == "dealer_death"
+
+    def test_failed_incident_write_does_not_divert_lifecycle(
+        self, tmp_path, monkeypatch
+    ):
+        # an incident whose WRITE fails (ENOSPC, EACCES) never landed on
+        # disk, so it must not latch incident ownership of the path: the
+        # next lifecycle dump still writes there instead of diverting a
+        # complete bundle to <path>.exit while path stays empty
+        rec, _, _ = self._recorder(tmp_path)
+        path = tmp_path / "flight.json"
+        import nanotpu.obs.flight as flight_mod
+
+        def boom(src, dst):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(flight_mod.os, "replace", boom)
+        rec.dump("slo:floor")  # write fails, swallowed + logged
+        assert not path.exists()
+        monkeypatch.undo()
+        rec.dump("shutdown")
+        assert json.loads(path.read_text())["trigger"] == "shutdown"
+        assert not (tmp_path / "flight.json.exit").exists()
+
+    def test_bundle_survives_dead_dealer(self):
+        rec, dealer, _ = self._recorder()
+        dealer.close()
+        # a half-dead stack still yields a complete, honest bundle:
+        # live taps answer, a broken tap degrades to an error marker
+        dealer.shard_status = None  # simulate a torn-down attribute
+        bundle = rec.bundle("dealer_death")
+        assert "error" in bundle["shards"]
+        assert bundle["ticks"] and bundle["decisions"]
+
+    def test_atexit_hook_dumps_on_process_exit(self, tmp_path):
+        # a real interpreter exit (the only honest way to test atexit)
+        path = tmp_path / "exit.json"
+        code = (
+            "from nanotpu.obs.flight import FlightRecorder\n"
+            f"rec = FlightRecorder(path={str(path)!r}, config={{'a': 1}})\n"
+            "rec.install()\n"
+        )
+        subprocess.run(
+            [sys.executable, "-c", code], check=True, timeout=60,
+            cwd=str(Path(__file__).parent.parent),
+        )
+        bundle = json.loads(path.read_text())
+        assert bundle["trigger"] == "process_exit"
+        # faulthandler sidecar armed alongside
+        assert (tmp_path / "exit.json.stacks").exists()
+
+
+# ---------------------------------------------------------------------------
+# /debug/timeline + the admission-gate exemption for every /debug route
+# ---------------------------------------------------------------------------
+class TestDebugTimelineEndpoint:
+    def _telemetry_api(self):
+        client, dealer, api = _stack(sample=1)
+        tl = Timeline(dealer=dealer)
+        dog = SLOWatchdog(tl, obs=api.obs)
+        dog.configure(parse_objectives([_threshold_obj(
+            series="fleet.occupancy", threshold=2.0,
+        )]))
+        api.attach_telemetry(tl, dog, FlightRecorder(timeline=tl))
+        return client, dealer, api, tl, dog
+
+    def test_disabled_404s_with_envelope(self):
+        _, _, api = _stack(sample=0)
+        code, _, payload = api.dispatch("GET", "/debug/timeline", b"")
+        body = json.loads(payload)
+        assert code == 404 and body["Reason"] == "NotFound"
+        assert "--timeline-period" in body["Error"]
+
+    def test_since_pagination_and_slo_state(self):
+        client, _, api, tl, dog = self._telemetry_api()
+        _schedule_one(client, api)
+        for _ in range(3):
+            tl.tick()
+        dog.evaluate()
+        code, _, payload = api.dispatch(
+            "GET", "/debug/timeline?since=1&limit=2", b""
+        )
+        assert code == 200
+        body = json.loads(payload)
+        assert body["latest"] == 3 and body["since"] == 1
+        assert [t["tick"] for t in body["ticks"]] == [2, 3]
+        assert body["slo"]["floor"]["breaches"] >= 1  # occ never >= 2.0
+        code, _, payload = api.dispatch(
+            "GET", "/debug/timeline?since=bogus", b""
+        )
+        assert code == 400
+        assert json.loads(payload)["Reason"] == "BadRequest"
+
+    def test_metrics_exposes_timeline_and_slo_families(self):
+        client, _, api, tl, dog = self._telemetry_api()
+        _schedule_one(client, api)
+        tl.tick()
+        dog.evaluate()
+        text = api.registry.render()
+        assert "nanotpu_timeline_occupancy" in text
+        assert "nanotpu_slo_breach_total" in text
+
+
+#: a served representative path per DEBUG_ROUTES prefix — the
+#: parametrization below fails if a new prefix lands without one
+_DEBUG_PATHS = {
+    "/debug/pprof": "/debug/pprof/cmdline",
+    "/debug/traces/": "/debug/traces/some-uid",
+    "/debug/decisions": "/debug/decisions?limit=5",
+    "/debug/timeline": "/debug/timeline",
+}
+
+
+class TestDebugAdmissionExemption:
+    """EVERY /debug route answers while the admission gate sheds — one
+    parametrized pin over routes.server.DEBUG_ROUTES, replacing the
+    per-endpoint ad-hoc assertions (an overloaded scheduler is exactly
+    when its diagnostics matter)."""
+
+    def test_route_table_fully_covered(self):
+        assert set(_DEBUG_PATHS) == set(DEBUG_ROUTES), (
+            "a /debug route joined DEBUG_ROUTES without a representative "
+            "path in the exemption pin"
+        )
+
+    @pytest.mark.parametrize("prefix", DEBUG_ROUTES)
+    def test_debug_route_exempt_while_gate_sheds(self, prefix):
+        _, _, api = _stack(sample=1, max_inflight=0)
+        # gate armed: every sheddable verb answers 429 immediately
+        code, _, payload = api.dispatch(
+            "POST", "/scheduler/filter", b"{}"
+        )
+        assert code == 429, payload
+        code, _, payload = api.dispatch("GET", _DEBUG_PATHS[prefix], b"")
+        assert code not in (429, 503), (prefix, code, payload)
+
+
+class TestAbortsUnder429Burst:
+    def test_uidless_429_burst_aggregates_and_preserves_records(self):
+        """The DecisionLedger satellite, driven through the REAL gate: a
+        sustained pre-parse 429 burst lands in the uid-less `aborts`
+        aggregate and cannot evict per-pod placement records from the
+        bounded ring."""
+        client, _, api = _stack(sample=1)
+        uid = _schedule_one(client, api)
+        api.overload.max_inflight = 0  # saturate: every filter sheds
+        for _ in range(200):
+            code, _, _ = api.dispatch("POST", "/scheduler/filter", b"{}")
+            assert code == 429
+        summary = api.obs.ledger.abort_summary()
+        assert summary == {"admission_shed:filter": 200}, summary
+        # the bound pod's record survived the burst
+        records = api.obs.ledger.get(uid)
+        assert records and records[-1]["outcome"] == "bound"
+        code, _, payload = api.dispatch(
+            "GET", "/debug/decisions?limit=5", b""
+        )
+        body = json.loads(payload)
+        assert body["aborts"]["admission_shed:filter"] == 200
+        assert any(r["uid"] == uid for r in body["decisions"])
+
+
+# ---------------------------------------------------------------------------
+# production telemetry loop
+# ---------------------------------------------------------------------------
+class TestTelemetryLoop:
+    def test_loop_ticks_and_stops(self):
+        client, dealer, api = _stack(sample=0)
+        tl = Timeline(dealer=dealer)
+        loop = TelemetryLoop(tl, period_s=0.02)
+        loop.start()
+        loop.start()  # idempotent
+        deadline = time.monotonic() + 10
+        while tl.latest_tick < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        loop.stop()
+        assert tl.latest_tick >= 2
+        settled = tl.latest_tick
+        time.sleep(0.1)
+        assert tl.latest_tick <= settled + 1  # stopped
+        with pytest.raises(ValueError):
+            TelemetryLoop(tl, period_s=0)
+
+    def test_breach_triggers_flight_dump(self):
+        client, dealer, api = _stack(sample=1)
+        tl = Timeline(dealer=dealer)
+        dog = SLOWatchdog(tl, obs=api.obs)
+        dog.configure(parse_objectives([_threshold_obj(
+            series="fleet.occupancy", threshold=2.0,
+            long_s=60.0, short_s=60.0,
+        )]))
+        flight = FlightRecorder(timeline=tl, obs=api.obs, dealer=dealer)
+        loop = TelemetryLoop(tl, watchdog=dog, flight=flight,
+                             period_s=0.02)
+        loop.start()
+        deadline = time.monotonic() + 10
+        while flight.bundles == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        loop.stop()
+        assert flight.bundles >= 1
+        assert flight.last_bundle()["trigger"] == "slo:floor"
+
+
+# ---------------------------------------------------------------------------
+# sim integration: deterministic timeline section, breach, dead dealer
+# ---------------------------------------------------------------------------
+TEL_SCENARIO = {
+    "name": "tel-mini",
+    "fleet": {"pools": [
+        {"generation": "v5p", "hosts": 4, "prefix": "v5p-host"},
+    ]},
+    "policy": "binpack",
+    "horizon_s": 10.0,
+    "workload": {
+        "kind": "poisson", "rate_per_s": 1.0,
+        "mix": {"fractional": 0.5, "spread": 0.5},
+        "lifetime_s": {"dist": "exp", "mean": 6.0},
+    },
+    "faults": {
+        "bind_failure": {"prob": 0.2},
+        "agent_restart": {"at_s": [5.0]},
+    },
+    "resync_every_s": 2.0,
+    "telemetry": {
+        "enabled": True,
+        "every_s": 1.0,
+        "slo": [{
+            "name": "occ-floor", "kind": "threshold",
+            "series": "fleet.occupancy", "op": "ge", "threshold": 0.99,
+            "target": 0.95, "long_s": 3.0, "short_s": 1.0, "burn": 1.0,
+        }],
+    },
+}
+
+
+class TestSimTelemetry:
+    def test_disabled_keeps_report_shape(self):
+        scenario = dict(TEL_SCENARIO)
+        scenario["telemetry"] = {"enabled": False}
+        report = Simulator(scenario, seed=3).run()
+        assert "timeline" not in report  # opt-in: digests stay stable
+
+    def test_timeline_section_is_deterministic(self):
+        a = Simulator(dict(TEL_SCENARIO), seed=3).run()
+        b = Simulator(dict(TEL_SCENARIO), seed=3).run()
+        assert render(strip_timing(a)) == render(strip_timing(b))
+        tl = a["timeline"]
+        assert tl["ticks"] == 9
+        assert tl["digest"].startswith("sha256:")
+        assert tl["bundle_digest"].startswith("sha256:")
+
+    def test_breach_reaches_journal_ledger_and_bundle(self):
+        sim = Simulator(dict(TEL_SCENARIO), seed=3)
+        report = sim.run()
+        tl = report["timeline"]
+        assert tl["breaches"]["occ-floor"] >= 1
+        # typed reason in the ledger's uid-less aggregate
+        assert sim.obs.ledger.abort_summary().get(
+            "slo_breach:occ-floor", 0
+        ) >= 1
+        # breach + dealer_death both dumped
+        assert tl["bundles"] >= 2
+
+    def test_dealer_kill_still_yields_complete_bundle(self, tmp_path):
+        scenario = json.loads(json.dumps(TEL_SCENARIO))
+        scenario["telemetry"]["slo"] = []  # only the death can dump
+        scenario["telemetry"]["flight_path"] = str(tmp_path / "f.json")
+        sim = Simulator(scenario, seed=3)
+        report = sim.run()
+        assert report["timeline"]["bundles"] == 1
+        bundle = json.loads((tmp_path / "f.json").read_text())
+        assert bundle["trigger"] == "dealer_death"
+        # complete post-mortem despite the dead dealer: time axis,
+        # decisions, control-plane status, counters all present
+        assert bundle["ticks"] and bundle["decisions"]
+        assert bundle["shards"] and bundle["perf"]["native_calls"] > 0
+        assert sim.flight.last_bundle() == bundle
+
+    def test_invariant_violation_triggers_flight_dump(self):
+        # the recorder's third trigger: a seeded corruption fires the
+        # invariant checker, and the bundle captures the broken state
+        scenario = json.loads(json.dumps(TEL_SCENARIO))
+        scenario["telemetry"]["slo"] = []
+        scenario["faults"] = {}
+        sim = Simulator(scenario, seed=3)
+        infos = sim.dealer.debug_snapshot()["node_infos"]
+        infos["v5p-host-0"].chips.chips[0].percent_free = -20
+        sim._check(converged=False)
+        assert sim.flight.bundles == 1
+        assert sim.flight.last_bundle()["trigger"] == "invariant_violation"
+
+    def test_external_source_series_feed_slos(self):
+        # the ROADMAP item 1 contract: a producer registered through the
+        # duck protocol is SLO-addressable with no timeline code changes
+        scenario = json.loads(json.dumps(TEL_SCENARIO))
+        scenario["telemetry"]["slo"] = [{
+            "name": "queue-depth", "kind": "threshold",
+            "series": "ext.serving.queue", "op": "le", "threshold": 10.0,
+            "target": 0.9, "long_s": 3.0, "short_s": 1.0, "burn": 1.0,
+        }]
+        sim = Simulator(scenario, seed=3)
+        sim.timeline.register_source(
+            _FakeSource("serving", {"queue": 99.0})
+        )
+        report = sim.run()
+        assert report["timeline"]["breaches"]["queue-depth"] >= 1
