@@ -3,7 +3,7 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm sim-smoke sim-multipool sim-het sim-defrag chaos-soak obs-check timeline-check fanout-4k image clean
+.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm batch-4k sim-smoke sim-multipool sim-het sim-defrag sim-batch chaos-soak obs-check timeline-check fanout-4k image clean
 
 # Default verification tier: static analysis, then the fast inner loop
 # (test-fast includes sim-smoke), then the observability gate, then the
@@ -11,7 +11,7 @@ TAG ?= latest
 # certifications and the sharded 4096-host fan-out gate (FAST=1 skips
 # those three). The tier-1 gate (`pytest tests/ -m 'not slow'` over
 # everything) is unchanged — run it via `make test` / CI.
-all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag fanout-4k
+all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag sim-batch fanout-4k batch-4k
 
 # nanolint (docs/static-analysis.md): AST invariant passes over the
 # scheduler's concurrency & determinism contracts — lock discipline,
@@ -157,6 +157,38 @@ sim-defrag:
 		python -m nanotpu.sim --scenario examples/sim/gangs-vs-bursty.json \
 			--seed 0 --check-determinism > /dev/null && \
 		python -m pytest tests/test_recovery.py -q -k "certification or replay"; \
+	fi
+
+# The joint batch-admission row (docs/batch-admission.md): the 4096-host
+# fleet admits the same 384-pod workload pod-at-a-time vs through ONE
+# /scheduler/batchadmit cycle (fused per-shard nanotpu_batch_pack, ABI 8),
+# plus the packing-quality proof on the dedicated 128-host fleet. The
+# asserts run IN-bench (>=5x ratio, equal bound count, strictly-lower
+# two-level fragmentation, zero stranded holes, ledger batch_cycle
+# records, zero gen-2 GC / rebuilds in both timed windows) — an
+# AssertionError exits nonzero. `FAST=1 make all` skips it (perf gate).
+# A/B against a pre-ABI-8 base ref with:
+#   make bench-ab AB_CMD="python bench.py --batch-4k-rep" \
+#        AB_KEY=batch4k_pods_per_s
+batch-4k: native
+	@if [ "$(FAST)" = "1" ]; then \
+		echo "batch-4k: skipped (FAST=1)"; \
+	else \
+		python bench.py --batch-4k; \
+	fi
+
+# Batch-admission sim certification (docs/batch-admission.md): the
+# batch-admit scenario — sharded dealer, virtual-time batch_admit cycles
+# draining the pending queue into one fused native solve, under flaps /
+# drops / dups / injected bind failures / an agent restart — run TWICE
+# (--check-determinism): exits nonzero on any invariant violation or
+# digest divergence. `FAST=1 make all` skips it (same rule as sim-het).
+sim-batch:
+	@if [ "$(FAST)" = "1" ]; then \
+		echo "sim-batch: skipped (FAST=1)"; \
+	else \
+		python -m nanotpu.sim --scenario examples/sim/batch-admit.json \
+			--seed 0 --check-determinism > /dev/null; \
 	fi
 
 # The gang-storm bench row on its own (docs/defrag.md): a 1024-host

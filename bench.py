@@ -81,6 +81,16 @@ class HttpClient:
             ).encode()
             + body
         )
+        return self._read_body()
+
+    def get_raw(self, path: str) -> bytes:
+        """Raw response body of a GET (the /debug scrapes)."""
+        self.sock.sendall(
+            f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        return self._read_body()
+
+    def _read_body(self) -> bytes:
         head = self._read_until(b"\r\n\r\n")
         length = 0
         for line in head.split(b"\r\n")[1:]:
@@ -929,6 +939,425 @@ def run_bind_storm_reps(reps: int = 3, max_reps: int = 5,
     }
 
 
+#: Dealer feature probe for the batch-admission row (docs/batch-
+#: admission.md): bench_ab runs this SAME file inside a base-ref
+#: worktree whose Dealer may predate ABI 8 — on such a base the row
+#: reports the pod-at-a-time rate under the same key, which is exactly
+#: the A/B bench_ab prices.
+_DEALER_HAS_BATCH = hasattr(Dealer, "pack_pods")
+
+#: The batch-admission row's workload (docs/batch-admission.md): 3-chip
+#: "big" pods + 1-chip "fill" pods on 4-chip hosts — the textbook shape
+#: where packing ORDER decides fragmentation. The ARRIVAL order is
+#: adversarial (fills first: pod-at-a-time stacks them on fresh hosts,
+#: then every big strands a 1-chip hole no later demand fills); the
+#: admitter's canonical solve order (name ascending — "big-*" < "fill-*")
+#: is first-fit-decreasing, so the joint solve lands every fill in a
+#: big's hole. Counts are equal so both orders bind everything (the
+#: frag comparison is at EQUAL bound count, per the acceptance).
+BATCH_BIGS = 192
+BATCH_FILLS = 192
+BATCH_WARM_FILLS = 16
+
+
+def _batch_row_pods(client):
+    """(warm, fills, bigs) pod lists. Warm placements are order-
+    independent (uniform fills stack deterministically), so both sides
+    start the timed window from the IDENTICAL fleet state — asserted by
+    the caller via the post-warm (occupancy, fragmentation) pair."""
+    def tpu(name, pct):
+        return client.create_pod(make_pod(
+            name,
+            containers=[make_container(
+                "t", {types.RESOURCE_TPU_PERCENT: pct}
+            )],
+        ))
+
+    warm = [tpu(f"awarm-{i:03d}", 100) for i in range(BATCH_WARM_FILLS)]
+    fills = [tpu(f"fill-{i:04d}", 100) for i in range(BATCH_FILLS)]
+    bigs = [tpu(f"big-{i:04d}", 300) for i in range(BATCH_BIGS)]
+    return warm, fills, bigs
+
+
+def _batch_row_stack(pipeline: int = 1, with_admitter: bool = False):
+    from nanotpu.sim.fleet import make_fleet
+
+    client = make_fleet(FLEET_4K)
+    nodes = sorted(n.name for n in client.list_nodes())
+    kw = {}
+    if _DEALER_HAS_PIPELINE:
+        kw["pipeline_depth"] = pipeline
+    dealer = Dealer(client, make_rater("binpack"), shards="auto", **kw)
+    if with_admitter:
+        from nanotpu.dealer.admit import BatchAdmitter
+
+        dealer.batch = BatchAdmitter(
+            dealer, max_batch=BATCH_BIGS + BATCH_FILLS,
+        )
+    api = SchedulerAPI(dealer, Registry())
+    server = serve(api, 0, host="127.0.0.1")
+    api.stop_idle_gc()
+    conn = HttpClient("127.0.0.1", server.server_address[1])
+    return client, dealer, api, server, conn, nodes
+
+
+def _frag_state(dealer):
+    from nanotpu.dealer.frag import fragmentation_of
+
+    cap = dealer.capacity_status()
+    return {
+        "occupancy": cap["occupancy"],
+        "whole_free_chips": cap["whole_free_chips"],
+        "fragmentation": fragmentation_of(dealer),
+    }
+
+
+def _batch_single_side() -> dict:
+    """Pod-at-a-time admission of the batch-row workload in ARRIVAL
+    order: per-pod Filter -> Prioritize -> Bind over live HTTP, every
+    Filter fanning over all 4096 candidates — the exact fanout-4k shape
+    the acceptance's >=5x is priced against, on the frag-adversarial
+    arrival order."""
+    import gc
+
+    client, dealer, api, server, conn, nodes = _batch_row_stack()
+    warm, fills, bigs = _batch_row_pods(client)
+    node_bytes = [n.encode() for n in nodes]
+    prepared = []
+    for seq, pod in enumerate(warm + fills + bigs):
+        args = json.dumps(
+            {"Pod": pod.raw, "NodeNames": nodes}, separators=_GO_SEP
+        ).encode()
+        bind_prefix = (
+            f'{{"PodName":"{pod.name}","PodNamespace":"default",'
+            f'"PodUID":"{pod.uid}","Node":"'
+        ).encode()
+        prepared.append((seq - len(warm), pod, args, bind_prefix))
+    gc.collect()
+    gc.disable()
+    warm_state = gc_before = perf_before = None
+    n_timed = len(fills) + len(bigs)
+    try:
+        started = time.perf_counter()
+        for i, pod, args, bind_prefix in prepared:
+            if i == 0:  # warm pods above are scheduled but not timed
+                warm_state = _frag_state(dealer)
+                gc.collect()
+                gc.freeze()
+                gc_before = gc.get_stats()
+                perf_before = dealer.perf_totals()
+                started = time.perf_counter()
+            filt = conn.post_raw("/scheduler/filter", args)
+            prio = conn.post_raw("/scheduler/priorities", args)
+            best = _scan_best(prio, _scan_feasible(filt), node_bytes)
+            if i % 64 == 0:
+                _check_scan(filt, prio, best)
+            result = conn.post_raw(
+                "/scheduler/bind", bind_prefix + best.encode() + b'"}'
+            )
+            assert b'"Error":""' in result, result
+        elapsed = time.perf_counter() - started
+        gc_after = gc.get_stats()
+        perf_after = dealer.perf_totals()
+    finally:
+        gc.enable()
+        gc.unfreeze()
+        conn.close()
+        server.shutdown()
+        dealer.close()
+    gc.collect()
+    attr = _gc_deltas(gc_before, gc_after)
+    attr.update((k, perf_after[k] - perf_before[k]) for k in perf_after)
+    assert attr["gen2_collections"] == 0, attr
+    assert attr["view_builds"] == 0, attr
+    assert attr["renderer_builds"] == 0, attr
+    assert attr["fastpath_misses"] == 0, attr
+    return {
+        "mode": "single",
+        "pods_per_s": round(n_timed / elapsed, 1),
+        "bound": n_timed,
+        "warm_state": warm_state,
+        "final": _frag_state(dealer),
+        "attr": attr,
+    }
+
+
+def _batch_batch_side(ledger_proof: bool = False) -> dict:
+    """Joint batch admission of the SAME workload: the whole pending
+    set posted to /scheduler/batchadmit in one cycle — ONE fused native
+    solve per shard (nanotpu_batch_pack, ABI 8) against the frozen Q16
+    rows, deterministic cross-shard reduce, winners committed through
+    the r7 pipelined write path (publish coalescing at depth 16)."""
+    import gc
+
+    client, dealer, api, server, conn, nodes = _batch_row_stack(
+        pipeline=16, with_admitter=True,
+    )
+    warm, fills, bigs = _batch_row_pods(client)
+    # warm cycle: builds the per-shard frozen views + admitter path
+    warm_body = json.dumps(
+        {"Pods": [p.raw for p in warm]}, separators=_GO_SEP
+    ).encode()
+    out = json.loads(conn.post_raw("/scheduler/batchadmit", warm_body))
+    assert not out["FellBack"] and all(
+        r["Outcome"] == "bound" for r in out["Results"]
+    ), out
+    # the pending queue, drained whole into one admission cycle; the
+    # body is the arrival-order stream — the admitter's solve order is
+    # its own (canonical, arrival-independent)
+    body = json.dumps(
+        {"Pods": [p.raw for p in fills + bigs]}, separators=_GO_SEP
+    ).encode()
+    n_timed = len(fills) + len(bigs)
+    gc.collect()
+    gc.disable()
+    try:
+        warm_state = _frag_state(dealer)
+        gc.collect()
+        gc.freeze()
+        gc_before = gc.get_stats()
+        perf_before = dealer.perf_totals()
+        started = time.perf_counter()
+        result = conn.post_raw("/scheduler/batchadmit", body)
+        elapsed = time.perf_counter() - started
+        gc_after = gc.get_stats()
+        perf_after = dealer.perf_totals()
+    finally:
+        gc.enable()
+        gc.unfreeze()
+    out = json.loads(result)
+    attr = _gc_deltas(gc_before, gc_after)
+    attr.update((k, perf_after[k] - perf_before[k]) for k in perf_after)
+    try:
+        assert not out["FellBack"], out
+        outcomes = [r["Outcome"] for r in out["Results"]]
+        assert outcomes == ["bound"] * n_timed, outcomes[:8]
+        assert attr["gen2_collections"] == 0, attr
+        assert attr["view_builds"] == 0, attr
+        assert attr["renderer_builds"] == 0, attr
+        assert attr["batch_cycles"] == 1, attr
+        assert attr["batch_packed"] == n_timed, attr
+        assert attr["batch_fallbacks"] == 0, attr
+        final = _frag_state(dealer)
+        proof = None
+        if ledger_proof:
+            # audit proof (untimed): with sampling on, packed pods'
+            # decision records carry the batch cycle id + the typed
+            # batch_packed reason, served on /debug/decisions
+            api.obs.tracer.sample = 1
+            extra = [
+                client.create_pod(make_pod(
+                    f"zproof-{i}",
+                    containers=[make_container(
+                        "t", {types.RESOURCE_TPU_PERCENT: 100}
+                    )],
+                ))
+                for i in range(4)
+            ]
+            out2 = json.loads(conn.post_raw(
+                "/scheduler/batchadmit",
+                json.dumps({"Pods": [p.raw for p in extra]},
+                           separators=_GO_SEP).encode(),
+            ))
+            assert all(
+                r["Outcome"] == "bound" for r in out2["Results"]
+            ), out2
+            dbg = json.loads(conn.get_raw("/debug/decisions?limit=16"))
+            cycle = out2["Cycle"]
+            stamped = [
+                r for r in dbg["decisions"]
+                if r.get("batch_cycle") == cycle
+                and r["binds"]
+                and r["binds"][-1]["reason"] == "batch_packed"
+            ]
+            assert len(stamped) == len(extra), dbg["decisions"][:2]
+            assert dbg["batch"]["enabled"], dbg["batch"]
+            proof = {
+                "cycle": cycle,
+                "stamped_records": len(stamped),
+                "batch_status": dbg["batch"],
+            }
+    finally:
+        conn.close()
+        server.shutdown()
+        dealer.close()
+    gc.collect()
+    side = {
+        "mode": "batch",
+        "pods_per_s": round(n_timed / elapsed, 1),
+        "bound": n_timed,
+        "warm_state": warm_state,
+        "final": final,
+        "attr": attr,
+    }
+    if proof is not None:
+        side["ledger_proof"] = proof
+    return side
+
+
+#: The packing-proof fleet (docs/batch-admission.md "Joint beats
+#: arrival order"): two v5p-64 pools, 4x4 slice grids — small enough
+#: that the two-level fragmentation metric RESOLVES the difference
+#: between 32 stranded 1-chip holes and 32 preserved whole hosts (on
+#: the 4096-host fleet the untouched capacity drowns the signal below
+#: the metric's 4-decimal rounding).
+PACKING_FLEET = {
+    "pools": [{
+        "generation": "v5p", "hosts": 64, "slice_hosts": 16,
+        "prefix": "v5p-pool", "count": 2,
+    }]
+}
+
+
+def _batch_packing_proof(n_bigs: int = 32, n_fills: int = 32) -> dict:
+    """The packing-quality half of the acceptance: the SAME pod set
+    admitted in arrival order (fills before bigs, pod-at-a-time argmax)
+    vs through one joint batch solve (canonical solve order = first-fit-
+    decreasing; lookahead best-fit). Asserts — all deterministic — that
+    at EQUAL bound count the joint side's two-level fragmentation is
+    STRICTLY lower, it strands ZERO 1-chip hole hosts where arrival
+    order strands one per big pod, and it leaves strictly more fully-
+    free hosts for gangs."""
+    from nanotpu.dealer.admit import BatchAdmitter
+    from nanotpu.dealer.frag import fragmentation_of
+    from nanotpu.sim.fleet import make_fleet
+
+    def one_side(mode: str):
+        client = make_fleet(PACKING_FLEET)
+        dealer = Dealer(client, make_rater("binpack"), shards="auto")
+        fills = [client.create_pod(make_pod(
+            f"fill-{i:04d}",
+            containers=[make_container(
+                "t", {types.RESOURCE_TPU_PERCENT: 100}
+            )],
+        )) for i in range(n_fills)]
+        bigs = [client.create_pod(make_pod(
+            f"big-{i:04d}",
+            containers=[make_container(
+                "t", {types.RESOURCE_TPU_PERCENT: 300}
+            )],
+        )) for i in range(n_bigs)]
+        if mode == "single":
+            # arrival order, one pod at a time: fills stack on fresh
+            # hosts, then every big strands a 1-chip hole
+            for pod in fills + bigs:
+                top = dealer.top_candidates(dealer.node_names(), pod, 1)
+                assert top, pod.name
+                dealer.bind(top[0][0], pod)
+        else:
+            admitter = BatchAdmitter(dealer, max_batch=n_bigs + n_fills)
+            dealer.batch = admitter
+            result = admitter.admit(fills + bigs, dealer.node_names())
+            assert not result.fell_back and not result.failed, result
+            assert not result.unplaced, result.unplaced
+        snap = dealer.debug_snapshot()["node_infos"]
+        holes = sum(
+            1 for info in snap.values()
+            if 0 < len(info.chips.whole_free_indexes()) < 4
+        )
+        whole_hosts = sum(
+            1 for info in snap.values()
+            if len(info.chips.whole_free_indexes()) == 4
+        )
+        bound = sum(
+            1 for p in fills + bigs if dealer.tracks(p.uid)
+        )
+        frag = fragmentation_of(dealer)
+        dealer.close()
+        return {"bound": bound, "fragmentation": frag,
+                "hole_hosts": holes, "whole_free_hosts": whole_hosts}
+
+    single = one_side("single")
+    joint = one_side("batch")
+    assert single["bound"] == joint["bound"] == n_bigs + n_fills, (
+        single, joint,
+    )
+    assert joint["fragmentation"] < single["fragmentation"], (
+        joint, single,
+    )
+    assert joint["hole_hosts"] == 0 and \
+        single["hole_hosts"] == n_bigs, (joint, single)
+    assert joint["whole_free_hosts"] > single["whole_free_hosts"], (
+        joint, single,
+    )
+    return {
+        "packing_hosts": 128,
+        "packing_pods": n_bigs + n_fills,
+        "packing_fragmentation": joint["fragmentation"],
+        "packing_single_fragmentation": single["fragmentation"],
+        "packing_hole_hosts": joint["hole_hosts"],
+        "packing_single_hole_hosts": single["hole_hosts"],
+        "packing_whole_free_hosts": joint["whole_free_hosts"],
+        "packing_single_whole_free_hosts": single["whole_free_hosts"],
+    }
+
+
+def run_batch_4k(require_ratio: float | None = 5.0) -> dict:
+    """The joint batch-admission row (docs/batch-admission.md): the
+    4096-host four-pool fleet admits the SAME 384-pod workload two ways
+    in one process — pod-at-a-time (per-pod Filter/Prioritize/Bind over
+    HTTP, adversarial arrival order) vs ONE batch-admission cycle
+    (POST /scheduler/batchadmit: fused per-shard native solve +
+    pipelined commits). In-bench asserts: all pods bound on BOTH sides
+    (equal bound count), identical post-warm state, zero gen-2 GC and
+    zero view/renderer rebuilds in both timed windows, ledger records
+    carrying batch_cycle + batch_packed over /debug/decisions, and
+    (``require_ratio``) the batch rate >= that multiple of the
+    same-process pod-at-a-time rate. The packing-quality proof (joint
+    strictly beats arrival order on the two-level fragmentation metric
+    at equal bound count) runs on the dedicated PACKING_FLEET where the
+    metric resolves it — ``packing_*`` keys."""
+    single = _batch_single_side()
+    import gc
+
+    gc.collect()
+    batch = _batch_batch_side(ledger_proof=True)
+    assert single["bound"] == batch["bound"], (single, batch)
+    assert single["warm_state"] == batch["warm_state"], (
+        single["warm_state"], batch["warm_state"],
+    )
+    gc.collect()
+    packing = _batch_packing_proof()
+    ratio = round(batch["pods_per_s"] / single["pods_per_s"], 2)
+    if require_ratio is not None:
+        assert ratio >= require_ratio, (
+            batch["pods_per_s"], single["pods_per_s"], ratio,
+        )
+    out = {
+        "batch4k_hosts": 4096,
+        "batch4k_pods": batch["bound"],
+        "batch4k_pods_per_s": batch["pods_per_s"],
+        "batch4k_single_pods_per_s": single["pods_per_s"],
+        "batch4k_ratio": ratio,
+        "batch4k_contended": batch["attr"]["batch_contended"],
+        "batch4k_ledger_proof": batch["ledger_proof"],
+        "batch4k_attr": batch["attr"],
+        "batch4k_single_attr": single["attr"],
+        "batch4k_loadavg_1m": round(os.getloadavg()[0], 2),
+    }
+    out.update(packing)
+    return out
+
+
+def run_batch_4k_rep() -> dict:
+    """One side only, for bench_ab.py's interleaved A/B protocol
+    (AB_KEY=batch4k_pods_per_s): on a batch-capable tree the batch
+    side, on a pre-ABI-8 base the pod-at-a-time side — the ratio
+    bench_ab reports IS the acceptance's same-day >=5x vs the r11
+    re-measure, both sides driving the identical 384-pod workload."""
+    if _DEALER_HAS_BATCH:
+        side = _batch_batch_side()
+    else:
+        side = _batch_single_side()
+    return {
+        "batch4k_mode": side["mode"],
+        "batch4k_pods_per_s": side["pods_per_s"],
+        "batch4k_fragmentation": side["final"]["fragmentation"],
+        "batch4k_whole_free_chips": side["final"]["whole_free_chips"],
+        "attr": side["attr"],
+    }
+
+
 #: Gang-storm scenario builder (docs/defrag.md): a 1024-host fleet run
 #: hot (~66% steady occupancy) by whole-host serving jobs (4x4-chip
 #: replicas, exp 15s) with a 30/s fractional-churn stream contaminating
@@ -1224,6 +1653,11 @@ def run() -> dict:
     # must not depress the read-path rows measured above
     bindstorm = run_bind_storm_reps()
     gc.collect()
+    # batch4k_* = the joint batch-admission row (docs/batch-admission.md):
+    # in-process pod-at-a-time vs one fused /scheduler/batchadmit cycle,
+    # plus the packing-quality proof (packing_*) on the dedicated fleet
+    batch4k = run_batch_4k()
+    gc.collect()
     run_once()  # warmup: module-level caches (topology link bounds, demand
     # hashes, compactness) persist across repetitions, as in a live scheduler
     latencies: list[float] = []
@@ -1284,6 +1718,7 @@ def run() -> dict:
     out.update(fanout4k)
     out.update(het)
     out.update(bindstorm)
+    out.update(batch4k)
     out["host_loadavg_start"] = load_start
     out["host_loadavg_end"] = [round(x, 2) for x in os.getloadavg()]
     out["host_cpu_count"] = os.cpu_count()
@@ -1326,6 +1761,17 @@ if __name__ == "__main__":
         # (AB_KEY=gangstorm_events_per_s); the base side runs the same
         # scenario with the recovery knobs feature-detected away
         print(json.dumps(run_gang_storm()))
+    elif "--batch-4k" in sys.argv:
+        # `make batch-4k`: the joint batch-admission row (both sides in
+        # one process); the in-bench asserts (>=5x ratio, equal bound
+        # count, strictly-lower fragmentation, ledger proof, zero gen-2
+        # GC / rebuilds) are the gate — an AssertionError exits nonzero
+        print(json.dumps(run_batch_4k()))
+    elif "--batch-4k-rep" in sys.argv:
+        # one side, for bench_ab.py's interleaved A/B protocol
+        # (AB_KEY=batch4k_pods_per_s): batch on this tree, pod-at-a-time
+        # on a pre-ABI-8 base — the r11-vs-r12 acceptance ratio
+        print(json.dumps(run_batch_4k_rep()))
     elif "--bind-storm" in sys.argv:
         # the full bind-storm row (median of 3 reps, in-bench asserts)
         print(json.dumps(run_bind_storm_reps()))

@@ -57,9 +57,15 @@ SCOPE = (
 #: view's mirror resync snapshots under it while HOLDING the arena lock
 #: — a blocking call inside it would stall both calibration and the
 #: Filter/Prioritize read path at once.
+#: ``BatchAdmitter._lock`` guards the batch admitter's cycle counter +
+#: last-cycle summary (docs/batch-admission.md): the admitter's solve
+#: (a GIL-releasing native crossing) and its commit fan-out (apiserver
+#: writes) both run OUTSIDE it by contract — a blocking call inside it
+#: would serialize /debug scrapes behind a batch cycle.
 HOT_LOCKS = (
     "Dealer._lock", "Dealer._publish_lock", "_Shard._publish_lock",
     "_Shard._pending_lock", "ThroughputModel._lock",
+    "BatchAdmitter._lock",
 )
 
 #: per-node reservation locks (docs/bind-pipeline.md): the commit

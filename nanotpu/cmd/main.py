@@ -146,6 +146,30 @@ def build_app(argv: list[str] | None = None):
         help="max defrag migrations per recovery cycle",
     )
     parser.add_argument(
+        "--batch", action="store_true",
+        help="joint batch admission (docs/batch-admission.md): a "
+        "periodic loop drains the controller's unscheduled TPU pods "
+        "into ONE fused native solve (nanotpu_batch_pack, ABI 8) that "
+        "packs them jointly against the frozen scoring views, then "
+        "commits winners through the pipelined write path; losers fall "
+        "back to the pod-at-a-time extender cycle untouched. Also "
+        "serves POST /scheduler/batchadmit",
+    )
+    parser.add_argument(
+        "--batch-period", type=float, default=0.5, metavar="S",
+        help="batch-admission cycle cadence (with --batch)",
+    )
+    parser.add_argument(
+        "--batch-lookahead", type=int, default=4, metavar="L",
+        help="joint-solve lookahead: the top-L candidates per pick are "
+        "re-ranked best-fit (fewest post-placement whole-free chips); "
+        "1 is the exact pod-at-a-time argmax",
+    )
+    parser.add_argument(
+        "--batch-max", type=int, default=256, metavar="K",
+        help="max demands per joint solve cycle (with --batch)",
+    )
+    parser.add_argument(
         "--timeline-period", type=float, default=0.0, metavar="S",
         help="fleet telemetry timeline (docs/observability.md): sample "
         "occupancy/fragmentation/shard health/counter deltas into a "
@@ -260,6 +284,19 @@ def main(argv: list[str] | None = None) -> int:
             policy=api.policy_watcher,
         )
 
+    batch_loop = None
+    if args.batch:
+        from nanotpu.dealer.admit import BatchAdmitter, BatchLoop
+
+        admitter = BatchAdmitter(
+            dealer, controller=controller,
+            lookahead=args.batch_lookahead, max_batch=args.batch_max,
+            obs=api.obs,
+        )
+        dealer.batch = admitter  # /debug/decisions + /scheduler/batchadmit
+        batch_loop = BatchLoop(admitter, period_s=args.batch_period)
+        batch_loop.start()
+
     recovery_loop = None
     if args.recovery:
         from nanotpu.metrics.recovery import RecoveryExporter
@@ -349,6 +386,8 @@ def main(argv: list[str] | None = None) -> int:
             api.flight.dump("shutdown")
         if recovery_loop is not None:
             recovery_loop.stop()
+        if batch_loop is not None:
+            batch_loop.stop()
         controller.stop()
         if api.policy_watcher is not None:
             api.policy_watcher.stop()

@@ -248,6 +248,20 @@ class Controller:
         with self._cache_lock:
             return self._pod_cache.get(key)
 
+    def unscheduled_pods(self) -> list[Pod]:
+        """Every cached TPU-sharing pod with no node assignment yet —
+        the batch admitter's drain source (docs/batch-admission.md).
+        The informer cache is the same eventually-consistent view the
+        coalescing queue works from: a pod bound milliseconds ago may
+        still appear, which is safe — its bind answers idempotent
+        success or ALREADY_BOUND and the admitter counts a fallback."""
+        with self._cache_lock:
+            pods = list(self._pod_cache.values())
+        return [
+            p for p in pods
+            if not p.node_name and not podutil.is_completed_pod(p)
+        ]
+
     def _enqueue(self, pod: Pod, attempt: int = 0,
                  force: bool = False) -> None:
         self._queue.put((pod.namespace, pod.name, attempt), force=force)

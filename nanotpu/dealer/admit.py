@@ -1,0 +1,419 @@
+"""Batch admission: one fused native solve for the whole pending queue.
+
+The extender contract is pod-at-a-time and it shows: every pending pod
+costs a full Filter -> Prioritize -> Bind round trip, so a 4096-host
+fleet clears ~hundreds of pods/s through the read path while the r7
+bind-storm row proves the write path alone absorbs ~1.5k binds/s — the
+per-pod solve, not the committer, is the bottleneck. Batched placement
+(Tesserae; Gavel's round-based joint solve over the per-(shape x
+slice-type) throughput table) fixes both the throughput and the
+packing-quality half: arrival order is a bad packing order, and a solver
+that sees the whole batch can best-fit it.
+
+:class:`BatchAdmitter` is that mode, strictly OPT-IN (``dealer.batch``
+is None by default and every existing path is byte-identical without
+it):
+
+* **drain** — :meth:`collect` pulls the controller's view of
+  unscheduled TPU pods (the coalescing queue's cache), minus pods
+  already mid-bind (barrier-parked gang members hold reservations; their
+  not-yet-bound SIBLINGS are exactly what the batch serves, completing
+  the barrier);
+* **solve** — :meth:`plan` sorts the batch into the canonical solve
+  order (namespace, name, uid — so the same pending SET in any arrival
+  order yields the identical assignment, byte for byte) and hands it to
+  ``Dealer.pack_pods``: one ``nanotpu_batch_pack`` crossing per shard
+  (ABI 8) packing all K demands jointly against the frozen Q16 scoring
+  rows with in-C scratch occupancy, then the deterministic cross-shard
+  reduce (score desc, name asc — ``merge_top_k``'s total order);
+* **commit** — winners bind through the UNCHANGED r7 write path
+  (``Dealer.bind``: reserve -> annotate -> bind subresource, publish
+  coalescing, per-member rollback). Strict-gang winners are dispatched
+  on their own threads (kube-scheduler's async-bind shape: every member
+  must be able to park at the barrier concurrently) and never awaited;
+  everything else commits inline. Losers — no feasible candidate, bind
+  failure, or no batch plan at all — fall back to the pod-at-a-time
+  path untouched.
+
+Every cycle is attributed (``PerfCounters.batch_*``), audited (typed
+ledger reason ``batch_packed`` + the per-pod batch cycle id, sampling-
+gated like the assume-TTL sweeper), and surfaced on
+``/debug/decisions``'s ``batch`` field. See docs/batch-admission.md for
+the solve-order/lookahead/determinism/fallback contracts.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from nanotpu.analysis.witness import make_lock
+from nanotpu.dealer.dealer import BindError
+from nanotpu.obs.decisions import REASON_BATCH_PACKED
+from nanotpu.utils import pod as podutil
+
+log = logging.getLogger("nanotpu.admit")
+
+#: default finalists re-ranked per pick (docs/batch-admission.md "The
+#: lookahead rule"): the top L candidates by (score desc, index asc) are
+#: re-ranked by fewest post-placement whole-free chips — best-fit, which
+#: preserves whole hosts for gangs. 1 == the exact pod-at-a-time argmax.
+DEFAULT_LOOKAHEAD = 4
+
+#: default cap on demands per cycle: bounds the native crossing's scratch
+#: work and the commit burst behind it.
+DEFAULT_MAX_BATCH = 256
+
+
+class AdmitResult:
+    """One batch-admission cycle's outcome, in solve order."""
+
+    __slots__ = ("cycle", "planned", "bound", "dispatched", "failed",
+                 "unplaced", "deferred", "fell_back")
+
+    def __init__(self, cycle: int):
+        self.cycle = cycle
+        #: (pod, node, score) picks the joint solve produced
+        self.planned: list[tuple] = []
+        #: (pod, node, score) whose bind committed inline
+        self.bound: list[tuple] = []
+        #: (pod, node, score) strict-gang winners handed to async bind
+        #: threads (outcome arrives through the normal gang machinery)
+        self.dispatched: list[tuple] = []
+        #: (pod, BindError) whose commit failed (accounting rolled back
+        #: by Dealer.bind; the pod-at-a-time path retries them)
+        self.failed: list[tuple] = []
+        #: pods the joint solve found no feasible candidate for
+        self.unplaced: list = []
+        #: pods beyond ``max_batch`` this cycle never offered to the
+        #: solve — NOT fallbacks: the next cycle (or a re-post) serves
+        #: them, and the route reports them so no pod silently vanishes
+        self.deferred: list = []
+        #: True when no batch plan existed at all (cold candidates, hook
+        #: rater, recovery plane, native off) and EVERY pod fell back
+        self.fell_back = False
+
+
+class BatchAdmitter:
+    """See module docstring. One instance per dealer; attach via
+    ``dealer.batch = admitter`` (the /debug surface reads it there)."""
+
+    def __init__(self, dealer, controller=None,
+                 lookahead: int = DEFAULT_LOOKAHEAD,
+                 max_batch: int = DEFAULT_MAX_BATCH, obs=None,
+                 cycle_base: int = 0):
+        if lookahead < 1:
+            raise ValueError(f"lookahead must be >= 1, got {lookahead!r}")
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch!r}")
+        if cycle_base < 0:
+            raise ValueError(f"cycle_base must be >= 0, got {cycle_base!r}")
+        self.dealer = dealer
+        self.controller = controller
+        self.lookahead = int(lookahead)
+        self.max_batch = int(max_batch)
+        #: explicit observability bundle for the audit trail; None
+        #: falls back to the dealer's CURRENT bundle at audit time (the
+        #: serving layer attaches its bundle to the dealer after
+        #: construction, and an admitter built earlier must not freeze
+        #: that None in)
+        self._obs = obs
+        #: guards the cycle counter + last-cycle summary ONLY — never
+        #: held across the solve or any apiserver write (nanolint
+        #: HOT_LOCKS holds that discipline)
+        self._lock = make_lock("BatchAdmitter._lock")
+        #: ``cycle_base`` lets a rebuilt admitter (the sim's agent
+        #: restart) keep cycle ids monotonic: the surviving ledger still
+        #: holds the old cycles' records, and a reused id would merge
+        #: two unrelated joint solves in a batch_cycle join
+        self._cycles = int(cycle_base)
+        self._last: dict = {}
+        #: uids the last solved cycle found unplaced — collect() demotes
+        #: them behind fresh pods when the queue overflows max_batch
+        self._unplaced_prev: set[str] = set()
+        #: uids handed to an async strict-gang bind thread that has not
+        #: finished yet: they hold no reservation until the thread
+        #: reaches Dealer.bind's reserve step, so collect() must skip
+        #: them or the next cycle would pack (and bind) them again
+        self._inflight: set[str] = set()
+
+    # -- drain -------------------------------------------------------------
+    @staticmethod
+    def solve_order(pods) -> list:
+        """THE canonical solve order: (namespace, name, uid) ascending,
+        deduplicated by uid — falling back to namespace/name for pods
+        the apiserver has not stamped a uid on, so two DISTINCT uid-less
+        pods never collapse into one (first copy wins — a retrying
+        client's duplicate entry is the same pod, and packing it twice
+        would double-charge scratch occupancy and race two binds).
+        Determinism contract (docs/batch-admission.md): the same pending
+        SET in any arrival order enters the solver identically, so the
+        joint assignment is a pure function of (set, fleet state)."""
+        seen: set[str] = set()
+        out = []
+        for p in sorted(pods, key=lambda p: (p.namespace, p.name, p.uid)):
+            key = p.uid or p.key()
+            if key not in seen:
+                seen.add(key)
+                out.append(p)
+        return out
+
+    def collect(self) -> list:
+        """Production drain: the controller's unscheduled TPU pods,
+        minus uids already holding reservations (barrier-parked gang
+        members are MID-bind; packing them again would trip the
+        already-bound idempotency guard — their unbound siblings are
+        what completes the barrier). When the queue overflows
+        ``max_batch``, pods the PREVIOUS cycle found unplaced are
+        demoted behind fresh ones before the cap — a persistently-
+        infeasible front would otherwise occupy every batch slot forever
+        and starve later-sorting pods out of the batch path entirely
+        (they re-enter on the very next cycle; this is a one-cycle
+        rotation, not a drop)."""
+        if self.controller is None:
+            return []
+        with self._lock:
+            inflight = set(self._inflight)
+            unplaced_prev = set(self._unplaced_prev)
+        pods = [
+            p for p in self.controller.unscheduled_pods()
+            if not self.dealer.has_reservation(p.uid)
+            and p.uid not in inflight
+        ]
+        ordered = self.solve_order(pods)
+        if len(ordered) > self.max_batch and unplaced_prev:
+            ordered = (
+                [p for p in ordered if p.uid not in unplaced_prev]
+                + [p for p in ordered if p.uid in unplaced_prev]
+            )
+        return ordered[: self.max_batch]
+
+    # -- solve -------------------------------------------------------------
+    def plan(self, pods, node_names: list[str]):
+        """Joint solve only (no commits): returns ``(ordered pods,
+        per-pod picks)`` where picks is ``Dealer.pack_pods``'s answer —
+        None for "no batch plan, fall back whole"."""
+        ordered = self.solve_order(pods)[: self.max_batch]
+        return ordered, self._solve(ordered, node_names)
+
+    def _solve(self, ordered, node_names: list[str]):
+        """The native crossing for an ALREADY-canonical (solve-ordered,
+        deduped, capped) batch — so admit() sorts exactly once."""
+        if not ordered:
+            return []
+        return self.dealer.pack_pods(
+            ordered, node_names, lookahead=self.lookahead
+        )
+
+    # -- commit ------------------------------------------------------------
+    def admit(self, pods, node_names: list[str] | None = None,
+              bind=None) -> AdmitResult:
+        """One full batch-admission cycle: solve, then commit winners
+        through the r7 write path. ``bind(node, pod)`` overrides the
+        committer — the sim passes a virtual-time binder and commits
+        INLINE for determinism; the default is ``Dealer.bind`` with
+        strict-gang winners dispatched on their own threads (every
+        member must be able to park at the gang barrier concurrently —
+        a sequential committer would wedge on the first member). Losers
+        fall back to the pod-at-a-time path untouched."""
+        if node_names is None:
+            node_names = self.dealer.node_names()
+        with self._lock:
+            self._cycles += 1
+            cycle = self._cycles
+        result = AdmitResult(cycle)
+        perf = self.dealer.perf
+        perf.batch_cycles += 1
+        ordered_all = self.solve_order(pods)
+        # beyond-cap pods are DEFERRED, visibly: the next cycle (or the
+        # caller's re-post) serves them — never silently dropped
+        result.deferred = ordered_all[self.max_batch:]
+        ordered = ordered_all[: self.max_batch]
+        picks = self._solve(ordered, node_names)
+        if picks is None:
+            result.fell_back = True
+            result.unplaced = list(ordered)
+            perf.batch_fallbacks += len(ordered)
+            self._note_cycle(result)
+            return result
+        binder = bind if bind is not None else self._bind_default
+        for pod, pick in zip(ordered, picks):
+            if pick is None:
+                result.unplaced.append(pod)
+                continue
+            node, score = pick
+            result.planned.append((pod, node, score))
+            self._audit_planned(pod, cycle)
+            if bind is None and podutil.gang_is_strict(pod):
+                gang = podutil.gang_of(pod)
+                if gang and gang[1] > 1:
+                    self._dispatch_strict(pod, node, cycle)
+                    result.dispatched.append((pod, node, score))
+                    continue
+            try:
+                binder(node, pod)
+            except BindError as e:
+                result.failed.append((pod, e))
+                self._audit_outcome(pod, node, e.reason, False)
+                continue
+            result.bound.append((pod, node, score))
+            self._audit_outcome(pod, node, REASON_BATCH_PACKED, True)
+        perf.batch_packed += len(result.bound) + len(result.dispatched)
+        perf.batch_fallbacks += len(result.unplaced) + len(result.failed)
+        self._note_cycle(result)
+        return result
+
+    def run_once(self) -> AdmitResult | None:
+        """Drain + admit (the production BatchLoop body). None when the
+        pending queue is empty."""
+        pods = self.collect()
+        if not pods:
+            return None
+        return self.admit(pods)
+
+    def _bind_default(self, node: str, pod) -> None:
+        self.dealer.bind(node, pod)
+
+    def _dispatch_strict(self, pod, node: str, cycle: int) -> None:
+        """Async bind for a strict-gang winner: the bind parks at the
+        gang barrier until the siblings (packed in this same cycle, each
+        on its own thread) arrive — exactly kube-scheduler's concurrent
+        bind-goroutine shape the strict mode was designed against.
+        Outcomes flow through the normal gang machinery (barrier open /
+        timeout rollback / K8s Events); the admitter never waits."""
+
+        def run():
+            try:
+                self.dealer.bind(node, pod)
+                self._audit_outcome(pod, node, REASON_BATCH_PACKED, True)
+            except BindError as e:
+                self._audit_outcome(pod, node, e.reason, False)
+                log.info(
+                    "batch cycle %d: strict gang member %s -> %s failed: "
+                    "%s (pod-at-a-time path retries)",
+                    cycle, pod.key(), node, e,
+                )
+            except Exception:
+                log.exception(
+                    "batch cycle %d: strict gang member %s -> %s died",
+                    cycle, pod.key(), node,
+                )
+            finally:
+                with self._lock:
+                    self._inflight.discard(pod.uid)
+
+        with self._lock:
+            self._inflight.add(pod.uid)
+        threading.Thread(
+            target=run, daemon=True, name=f"batch-bind-{pod.name}"
+        ).start()
+
+    # -- audit + status ----------------------------------------------------
+    @property
+    def obs(self):
+        """The audit bundle: the explicit one, else the dealer's."""
+        if self._obs is not None:
+            return self._obs
+        return getattr(self.dealer, "obs", None)
+
+    def _sampled(self, uid: str) -> bool:
+        obs = self.obs
+        return (
+            obs is not None and obs.enabled and obs.tracer.sampled(uid)
+        )
+
+    def _audit_planned(self, pod, cycle: int) -> None:
+        """Stamp the pod's building decision cycle with this batch cycle
+        id (sampling-gated like the sweeper's expiry audit): the record
+        that eventually finalizes carries ``batch_cycle`` — the ledger's
+        proof the placement came from a joint solve, joinable across the
+        whole batch."""
+        if self._sampled(pod.uid):
+            self.obs.ledger.batch_cycle(pod.uid, cycle, pod=pod.key())
+
+    def _audit_outcome(self, pod, node: str, reason: str,
+                       bound: bool) -> None:
+        if self._sampled(pod.uid):
+            self.obs.ledger.bind_outcome(
+                pod.uid, node, reason, bound, pod=pod.key()
+            )
+
+    def _note_cycle(self, result: AdmitResult) -> None:
+        with self._lock:
+            # whole-batch fallbacks say nothing about individual
+            # feasibility, so they reset the demotion set rather than
+            # demote every offered pod
+            self._unplaced_prev = (
+                set() if result.fell_back
+                else {p.uid for p in result.unplaced if p.uid}
+            )
+            self._last = {
+                "cycle": result.cycle,
+                "offered": len(result.planned) + len(result.unplaced),
+                "planned": len(result.planned),
+                "bound": len(result.bound),
+                "dispatched": len(result.dispatched),
+                "failed": len(result.failed),
+                "unplaced": len(result.unplaced),
+                "deferred": len(result.deferred),
+                "fell_back": result.fell_back,
+            }
+
+    @property
+    def cycles(self) -> int:
+        """Lifetime cycle count — the ``cycle_base`` seed for a rebuilt
+        admitter (agent restart) so batch cycle ids stay monotonic."""
+        with self._lock:
+            return self._cycles
+
+    def status(self) -> dict:
+        """``/debug/decisions``'s ``batch`` field (docs/observability.md
+        + docs/batch-admission.md): knobs, lifetime counters, and the
+        last cycle's shape."""
+        perf = self.dealer.perf_totals()
+        with self._lock:
+            last = dict(self._last)
+            cycles = self._cycles
+        return {
+            "enabled": True,
+            "lookahead": self.lookahead,
+            "max_batch": self.max_batch,
+            "cycles": cycles,
+            "packed": perf["batch_packed"],
+            "fallbacks": perf["batch_fallbacks"],
+            "contended": perf["batch_contended"],
+            "last": last,
+        }
+
+
+class BatchLoop:
+    """Production cadence driver (cmd/main's ``--batch``): drain the
+    pending queue into one admission cycle every ``period_s``. The sim
+    never uses this — it steps the admitter through virtual-time
+    ``batch_admit`` events instead (docs/simulation.md)."""
+
+    def __init__(self, admitter: BatchAdmitter, period_s: float = 0.5):
+        if period_s <= 0:
+            raise ValueError(f"period_s must be > 0, got {period_s!r}")
+        self.admitter = admitter
+        self.period_s = period_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="batch-admit"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.admitter.run_once()
+            except Exception:  # the loop must outlive any cycle
+                log.exception("batch admission cycle failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

@@ -488,6 +488,73 @@ class BatchScorer:
                 return feasible, score_hook(self, demand, feasible)
             return feasible, list(score[:n])
 
+    def pack(
+        self, demands, prefer_used: bool, lookahead: int = 1
+    ) -> list[tuple[int, int, list[list[int]]]]:
+        """Joint greedy-with-lookahead pack of ``demands`` against this
+        view's frozen rows in ONE native crossing (ABI 8,
+        docs/batch-admission.md). Caller order IS the solve order: the
+        native solver keeps a scratch occupancy copy updated in C
+        between picks, so demand ``j`` is scored against the state
+        demand ``i``'s placement produced. Returns ``(row index, score,
+        per-container chip ids)`` per demand, row index -1 when no
+        candidate can host it. Scores exclude the gang bonus (the joint
+        solve packs capacity; gang affinity keeps shaping the
+        pod-at-a-time path) and are byte-equal to the pod-at-a-time
+        wire score otherwise — ``lookahead=1`` IS the per-pod argmax,
+        the K=1 parity contract tests/test_admit.py pins. Results never
+        touch the arena memo: the scratch outputs are per-call arrays,
+        so an in-flight Filter's memoized scores stay valid. Raises
+        :class:`native.NativeUnavailable` when the caller should fall
+        back to the pod-at-a-time path."""
+        with self._lock:
+            if self._mutable:
+                self._refresh()
+            # signature grouping: equal (percents, hbm) demands share the
+            # solver's per-signature feasibility/score cache, so a
+            # K-demand pack costs O(#signatures x nodes + K x dirty)
+            # placement evaluations instead of O(K x nodes)
+            sig_of: dict[tuple, int] = {}
+            reps: list = []
+            sigs: list[int] = []
+            pcts: list[list[int]] = []
+            hbms: list[list[int]] = []
+            for d in demands:
+                pct = list(d.percents)
+                hbm = [d.hbm_of(i) for i in range(len(pct))]
+                key = (tuple(pct), tuple(hbm))
+                sig = sig_of.get(key)
+                if sig is None:
+                    sig = sig_of[key] = len(reps)
+                    reps.append(d)
+                sigs.append(sig)
+                pcts.append(pct)
+                hbms.append(hbm)
+            model_args = None
+            if self._model is not None:
+                # per-SIGNATURE base rows (each demand shape resolves its
+                # own table row), same Q16 integers the ABI 7 path feeds
+                mirror = self._ensure_mirror_locked()
+                flat: list[int] = []
+                for rep in reps:
+                    flat.extend(
+                        self._model.base_q_for(rep, self.generations)
+                    )
+                c_base = (ctypes.c_int32 * max(len(flat), 1))(*flat)
+                model_args = (
+                    self.gen_idx, c_base, len(self.generations),
+                    mirror.cont_sum, mirror.cont_cnt, self.load_q,
+                )
+            self._perf.native_calls += 1
+            return native.batch_pack(
+                self.dims, len(self.infos), self.free, self.total,
+                self.load, pcts, prefer_used, types.PERCENT_PER_CHIP,
+                hbm_flat=self.hbm, demand_hbm=hbms,
+                demand_sig=sigs, n_sigs=max(len(reps), 1),
+                model=model_args,
+                lookahead=max(1, min(int(lookahead), 64)),
+            )
+
     # -- fused score+render (the Filter/Prioritize fan-out fast path) ------
 
     def ensure_renderer(self, names_key: tuple[str, ...]) -> bool:

@@ -108,6 +108,15 @@ ASSUME_COLD_POOL_THRESHOLD = 2
 #: so eviction only risks re-releasing ancient, long-deleted pods).
 RELEASED_TOMBSTONES_MAX = 100_000
 
+#: Cross-shard pack refinement cap (docs/batch-admission.md "The
+#: cross-shard reduce"): each round re-packs every shard with (its
+#: reduce winners + the still-unplaced demands) so the leftovers price
+#: against the true residual capacity instead of round 1's everything-
+#: lands-here over-charge. The error shrinks geometrically — a deep
+#: batch normally converges in one extra round; the cap only bounds the
+#: genuinely-infeasible tail, which falls back pod-at-a-time anyway.
+_PACK_REFINE_ROUNDS = 3
+
 
 class BindError(Exception):
     """Bind failed; chip accounting has been rolled back. ``reason`` is
@@ -273,6 +282,13 @@ class Dealer:
         #: process that owns one (cmd/main's --recovery, harnesses);
         #: ``/debug/decisions`` surfaces its status when present
         self.recovery = None
+        #: optional batch admitter
+        #: (:class:`nanotpu.dealer.admit.BatchAdmitter`), attached by the
+        #: process that owns one (cmd/main's --batch, the sim's batch
+        #: scenario knob, harnesses); ``/debug/decisions`` surfaces its
+        #: status when present. None == batch admission off == zero new
+        #: code on any existing path (docs/batch-admission.md).
+        self.batch = None
         #: gang pods whose Filter found ZERO feasible candidates — the
         #: production recovery trigger for gangs that cannot even
         #: reserve (a member must reserve to park at the barrier, so a
@@ -1191,6 +1207,152 @@ class Dealer:
         return merge_top_k(
             [[(n, s) for n, s in scored if n in feasible_set]], k
         )
+
+    def pack_pods(self, pods: list[Pod], node_names: list[str],
+                  lookahead: int = 4):
+        """Joint batch pack (ABI 8, docs/batch-admission.md): place every
+        pod of ``pods`` — in the GIVEN order, which is the solve order —
+        against the published frozen views in one fused native crossing
+        per shard, scratch occupancy updated in C between picks so pod
+        ``j`` sees pod ``i``'s placement.
+
+        Returns a per-pod list of ``(node name, score)`` picks (``None``
+        for pods the joint solve cannot place — invalid demands, or no
+        feasible candidate), or ``None`` when the batch path is
+        unavailable as a whole (cold/unknown candidates, a hook rater
+        the native engine cannot evaluate, a recovery plane holding gang
+        holes the pack cannot see, native off) — the caller then falls
+        back to the pod-at-a-time path untouched.
+
+        Sharded dealers pack every shard in parallel (the native call
+        releases the GIL) and reduce per demand in solve order: the
+        winning proposal is chosen score-descending then node-name-
+        ascending — :func:`~nanotpu.dealer.shard.merge_top_k`'s total
+        order, so a shard split can never change a SINGLE demand's pick
+        (pinned by tests/test_admit.py). Per-shard scratch states are
+        independent, which makes cross-shard packing CONSERVATIVE: a
+        shard prices every demand as if all K landed on it, so a
+        diverted demand only ever leaves the chosen shard with more
+        capacity than the solve assumed — but a batch whose aggregate
+        demand exceeds one shard's free capacity would strand the tail
+        of the solve order (every shard virtually fills up and reports
+        it infeasible). Bounded refinement rounds repair that: each
+        shard re-packs (its reduce winners + the still-unplaced tail)
+        so leftovers are priced against the true residual, winners keep
+        their earlier picks, and the loop stops when a round places
+        nothing new or after ``_PACK_REFINE_ROUNDS``. Rounds are a pure
+        function of (batch, fleet state), so the determinism contract
+        holds; placements stay feasible, never oversubscribed (and the
+        commit path re-plans under the node lock regardless)."""
+        if self._hook_active or self.recovery is not None:
+            # hook raters: the native pack cannot evaluate a Python row
+            # hook. Recovery plane: gang holes filter candidates per pod
+            # (recovery.blocks), which a joint solve over one shared row
+            # set cannot express — both fall back whole (docs/batch-
+            # admission.md "Fallback semantics").
+            return None
+        out: list[tuple[str, int] | None] = [None] * len(pods)
+        demands = []
+        valid_idx = []
+        for i, pod in enumerate(pods):
+            d = self._demand_of(pod)
+            if d.is_valid() and d.total > 0:
+                valid_idx.append(i)
+                demands.append(d)
+        if not valid_idx:
+            return out
+        if self._shard_fn is None:
+            batch = self._batch_plan(node_names)
+            if batch is None:
+                return None
+            scorer, names_key, non_tpu, prefer = batch
+            if non_tpu or len(names_key) != len(node_names):
+                return None
+            try:
+                results = scorer.pack(demands, prefer, lookahead)
+            except native.NativeUnavailable:
+                return None
+            for i, (row, score, _assign) in zip(valid_idx, results):
+                if row >= 0:
+                    out[i] = (names_key[row], score)
+            return out
+        plan = self._shard_plan(node_names)
+        if plan is None:
+            return None
+        resolved, non_tpu, _contiguous, prefer = plan
+        if non_tpu:
+            return None
+
+        def pack_one(item):
+            return item[1][0].pack(demands, prefer, lookahead)
+
+        try:
+            if len(resolved) == 1:
+                runs = [pack_one(resolved[0])]
+            else:
+                runs = list(self._pool.map(pack_one, resolved))
+        except native.NativeUnavailable:
+            return None
+        k = len(valid_idx)
+        positions: list[list[int]] = [list(range(k)) for _ in resolved]
+        won_by: list[list[int]] = [[] for _ in resolved]
+        shard_of_node = {
+            name: s for s, item in enumerate(resolved) for name in item[2]
+        }
+        remaining = list(range(k))
+        for round_no in range(1 + _PACK_REFINE_ROUNDS):
+            if round_no:
+                if len(resolved) == 1 or not remaining:
+                    break
+                # refinement: round 1's independent scratches charged
+                # every shard with demands the reduce sent elsewhere, so
+                # a batch bigger than one shard's free capacity strands
+                # the tail of the solve order. Re-pack each shard with
+                # (its winners + the leftovers) — leftovers now price
+                # against the true residual; winners keep their picks.
+                positions = [sorted(w + remaining) for w in won_by]
+
+                def pack_sub(item_pos):
+                    item, pos = item_pos
+                    return item[1][0].pack(
+                        [demands[j] for j in pos], prefer, lookahead
+                    )
+
+                try:
+                    runs = list(self._pool.map(
+                        pack_sub, list(zip(resolved, positions))
+                    ))
+                except native.NativeUnavailable:
+                    break  # keep earlier picks; leftovers fall back
+            pos_of = [
+                {j: r for r, j in enumerate(pos)} for pos in positions
+            ]
+            placed: list[int] = []
+            for j in remaining:
+                proposals = []
+                for s, (item, results) in enumerate(zip(resolved, runs)):
+                    r = pos_of[s].get(j)
+                    if r is None:
+                        continue
+                    row, score, _assign = results[r]
+                    if row >= 0:
+                        proposals.append((item[2][row], score))
+                if not proposals:
+                    continue
+                if len(proposals) > 1:
+                    # more than one shard bid for this demand: the
+                    # reduce resolved a genuine contention (attribution
+                    # for the bench + /debug/decisions' batch status)
+                    self.perf.batch_contended += 1
+                pick = merge_top_k([proposals], 1)[0]
+                out[valid_idx[j]] = pick
+                won_by[shard_of_node[pick[0]]].append(j)
+                placed.append(j)
+            if not placed:
+                break
+            placed_set = set(placed)
+            remaining = [j for j in remaining if j not in placed_set]
+        return out
 
     # -- fused verb fast paths ---------------------------------------------
     #

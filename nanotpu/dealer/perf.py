@@ -45,6 +45,10 @@ class PerfCounters:
         "gang_batched_commits",
         "hook_refusals",
         "model_syncs",
+        "batch_cycles",
+        "batch_packed",
+        "batch_fallbacks",
+        "batch_contended",
     )
 
     def __init__(self):
@@ -92,6 +96,17 @@ class PerfCounters:
         #: one per model-version movement per view chain — a metric-sync
         #: batch costs one, a steady read window costs none
         self.model_syncs = 0
+        #: batch-admission attribution (ABI 8, docs/batch-admission.md):
+        #: joint-solve cycles run, demands the fused native pack placed,
+        #: demands that fell back to the pod-at-a-time path (no batch
+        #: plan, bind failure, invalid demand), and demands whose
+        #: cross-shard reduce had more than one shard's proposal to
+        #: resolve (the score-desc/name-asc contention the merge exists
+        #: for)
+        self.batch_cycles = 0
+        self.batch_packed = 0
+        self.batch_fallbacks = 0
+        self.batch_contended = 0
 
     def snapshot(self) -> dict[str, int]:
         """Point-in-time copy (bench delta arithmetic / metrics render)."""

@@ -31,7 +31,7 @@ _SRC = os.path.join(_REPO_ROOT, "native", "allocator.cc")
 _LIB = os.path.join(_PKG_DIR, "libnanotpu_alloc.so")
 
 #: must match nanotpu_abi_version() in allocator.cc
-ABI_VERSION = 7
+ABI_VERSION = 8
 
 _lock = make_lock("native._lock")
 _lib: ctypes.CDLL | None = None
@@ -174,6 +174,36 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.c_int32,  # out_cap
             ]
         )
+        lib.nanotpu_batch_pack.restype = ctypes.c_int32
+        lib.nanotpu_batch_pack.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),  # dims[3]
+            ctypes.c_int32,  # n_nodes
+            ctypes.POINTER(ctypes.c_int32),  # free [n*chips]
+            ctypes.POINTER(ctypes.c_int32),  # total [n*chips]
+            ctypes.POINTER(ctypes.c_double),  # load [n*chips]
+            ctypes.POINTER(ctypes.c_int32),  # hbm_free [n*chips] (nullable)
+            ctypes.c_int32,  # prefer_used
+            ctypes.c_int32,  # percent_per_chip
+            ctypes.c_int32,  # n_demands
+            ctypes.POINTER(ctypes.c_int32),  # demand_percents (flattened)
+            ctypes.POINTER(ctypes.c_int32),  # demand_off [K+1]
+            ctypes.POINTER(ctypes.c_int32),  # demand_hbm (nullable)
+            ctypes.POINTER(ctypes.c_int32),  # demand_sig [K]
+            ctypes.c_int32,  # n_sigs
+            # throughput-model mirror (ABI 7 layout; base_q PER SIGNATURE)
+            ctypes.POINTER(ctypes.c_int32),  # model_gen [n]
+            ctypes.POINTER(ctypes.c_int32),  # model_base_q [n_sigs*n_gens]
+            ctypes.c_int32,  # model_n_gens
+            ctypes.POINTER(ctypes.c_int32),  # model_cont_sum [n]
+            ctypes.POINTER(ctypes.c_int32),  # model_cont_cnt [n]
+            ctypes.POINTER(ctypes.c_int32),  # model_load_q [n*chips]
+            ctypes.c_int32,  # lookahead
+            ctypes.POINTER(ctypes.c_int32),  # out_node [K]
+            ctypes.POINTER(ctypes.c_int32),  # out_score [K]
+            ctypes.POINTER(ctypes.c_int32),  # out_assign
+            ctypes.c_int32,  # out_assign_cap
+            ctypes.POINTER(ctypes.c_int32),  # out_counts
+        ]
         lib.nanotpu_render_priorities.restype = ctypes.c_int32
         lib.nanotpu_render_priorities.argtypes = [
             ctypes.c_char_p,  # frags blob
@@ -339,6 +369,105 @@ def score_render(
     if w < 0:
         raise NativeUnavailable(f"native score_render error {w}")
     return ctypes.string_at(out_buf, w)
+
+
+def batch_pack(
+    dims: tuple[int, int, int],
+    n_nodes: int,
+    free_flat,
+    total_flat,
+    load_flat,
+    demand_percents: list[list[int]],
+    prefer_used: bool,
+    percent_per_chip: int,
+    hbm_flat=None,
+    demand_hbm: list[list[int]] | None = None,
+    demand_sig: list[int] | None = None,
+    n_sigs: int | None = None,
+    model=None,
+    lookahead: int = 1,
+):
+    """Joint greedy-with-lookahead pack of K demands against one frozen
+    candidate pool in ONE native crossing (ABI 8, docs/batch-admission.md).
+
+    ``demand_percents`` is one per-container percent list PER demand;
+    caller order is the solve order. ``demand_sig``/``n_sigs`` group
+    identical (percents, hbm) demands so feasibility/score caches are
+    shared (None derives the trivial per-demand grouping). ``model`` is
+    ``(gen_of, base_q_by_sig_and_gen, n_gens, cont_sum, cont_cnt,
+    load_q)`` — the score_batch mirror except ``base_q`` carries one row
+    per SIGNATURE. Returns ``(node_idx, score, assignments)`` per demand
+    where ``node_idx`` is -1 for demands no candidate can host and
+    ``assignments`` the per-container sorted chip-id lists on the chosen
+    node. Raises :class:`NativeUnavailable` when the caller should fall
+    back to the pod-at-a-time path."""
+    lib = _load()
+    if lib is None:
+        raise NativeUnavailable("native allocator unavailable")
+    # the C side reserves lookahead slots per pick — clamp at the ABI
+    # boundary so no caller can turn a big value into a bad_alloc
+    lookahead = max(1, min(int(lookahead), 64))
+    k = len(demand_percents)
+    offsets = [0]
+    flat_pct: list[int] = []
+    for pct in demand_percents:
+        flat_pct.extend(pct)
+        offsets.append(len(flat_pct))
+    if demand_sig is None:
+        sig_of: dict[tuple, int] = {}
+        demand_sig = []
+        for i, pct in enumerate(demand_percents):
+            key = (
+                tuple(pct),
+                tuple(demand_hbm[i]) if demand_hbm else (),
+            )
+            demand_sig.append(sig_of.setdefault(key, len(sig_of)))
+        n_sigs = max(len(sig_of), 1)
+    elif n_sigs is None:
+        n_sigs = (max(demand_sig) + 1) if demand_sig else 1
+    c_dims = (ctypes.c_int32 * 3)(*dims)
+    c_pct = (ctypes.c_int32 * max(len(flat_pct), 1))(*flat_pct)
+    c_off = (ctypes.c_int32 * (k + 1))(*offsets)
+    c_sig = (ctypes.c_int32 * max(k, 1))(*demand_sig)
+    flat_hbm: list[int] = []
+    if demand_hbm:
+        for h in demand_hbm:
+            flat_hbm.extend(h)
+    c_hbmd = (
+        (ctypes.c_int32 * max(len(flat_hbm), 1))(*flat_hbm)
+        if flat_hbm and any(flat_hbm) else None
+    )
+    m = model if model is not None else (None, None, 0, None, None, None)
+    assign_cap = sum(
+        max(1, p // percent_per_chip) for pct in demand_percents for p in pct
+        if p > 0
+    ) or 1
+    out_node = (ctypes.c_int32 * max(k, 1))()
+    out_score = (ctypes.c_int32 * max(k, 1))()
+    out_assign = (ctypes.c_int32 * assign_cap)()
+    out_counts = (ctypes.c_int32 * max(len(flat_pct), 1))()
+    rc = lib.nanotpu_batch_pack(
+        c_dims, n_nodes, free_flat, total_flat, load_flat,
+        hbm_flat if c_hbmd is not None else None,
+        1 if prefer_used else 0, percent_per_chip,
+        k, c_pct, c_off, c_hbmd, c_sig, n_sigs,
+        m[0], m[1], m[2], m[3], m[4], m[5],
+        lookahead,
+        out_node, out_score, out_assign, assign_cap, out_counts,
+    )
+    if rc != OK:
+        raise NativeUnavailable(f"native batch_pack error {rc}")
+    results = []
+    cursor = 0
+    for i in range(k):
+        lo, hi = offsets[i], offsets[i + 1]
+        assigns: list[list[int]] = []
+        for j in range(lo, hi):
+            cnt = out_counts[j] if out_node[i] >= 0 else 0
+            assigns.append([out_assign[cursor + x] for x in range(cnt)])
+            cursor += cnt
+        results.append((out_node[i], out_score[i], assigns))
+    return results
 
 
 def render_priorities(frags: bytes, frag_off, scores, n: int,

@@ -45,6 +45,7 @@ REASON_MIGRATED = "migrated"
 REASON_BACKFILLED = "backfilled"
 REASON_LEASE_EXPIRED = "lease_expired"
 REASON_SLO_BREACH = "slo_breach"
+REASON_BATCH_PACKED = "batch_packed"
 
 #: code -> operator-facing description. Keys must be exactly the
 #: ``REASON_*`` constants above (nanolint pins the equivalence).
@@ -88,6 +89,10 @@ REASONS: dict[str, str] = {
     REASON_SLO_BREACH:
         "an SLO objective's two-window burn rate crossed its factor "
         "(aggregated uid-less per objective; docs/observability.md)",
+    REASON_BATCH_PACKED:
+        "placed by a joint batch-admission solve and committed through "
+        "the batch admitter (docs/batch-admission.md); the record's "
+        "batch_cycle joins every pod of the same cycle",
 }
 
 
@@ -95,7 +100,7 @@ class _Cycle:
     """One pod scheduling cycle under construction (see ledger)."""
 
     __slots__ = ("uid", "pod", "seq", "t", "policy", "verdicts", "scores",
-                 "score_terms", "binds", "outcome")
+                 "score_terms", "binds", "outcome", "batch_cycle")
 
     def __init__(self, uid: str, pod: str, seq: int, t: float):
         self.uid = uid
@@ -111,6 +116,11 @@ class _Cycle:
         self.score_terms: dict[str, dict[str, int]] = {}
         self.binds: list[dict] = []
         self.outcome = ""
+        #: batch-admission cycle id (docs/batch-admission.md), or 0 when
+        #: the pod was placed pod-at-a-time — present in as_dict only
+        #: when set, so non-batch record bytes (and trace digests) are
+        #: unchanged
+        self.batch_cycle = 0
 
     def as_dict(self) -> dict:
         out = {
@@ -131,6 +141,8 @@ class _Cycle:
                 k: dict(self.score_terms[k])
                 for k in sorted(self.score_terms)
             }
+        if self.batch_cycle:
+            out["batch_cycle"] = self.batch_cycle
         return out
 
 
@@ -220,6 +232,16 @@ class DecisionLedger:
             cyc.score_terms = {
                 name: dict(t) for name, t in terms.items()
             }
+
+    def batch_cycle(self, uid: str, cycle_id: int, pod: str = "") -> None:
+        """Stamp the pod's building cycle with the batch-admission cycle
+        that planned it (docs/batch-admission.md). The record that
+        eventually finalizes — the admitter's ``batch_packed`` commit,
+        or a failed attempt's retry roll — carries ``batch_cycle``, so
+        one joint solve's placements are joinable in the audit ring."""
+        with self._lock:
+            cyc = self._cycle_locked(uid, pod)
+            cyc.batch_cycle = int(cycle_id)
 
     def bind_outcome(self, uid: str, node: str, reason: str,
                      bound: bool, pod: str = "", final: bool = False) -> None:

@@ -285,6 +285,11 @@ class SchedulerAPI:
                 return self._verb(self.prioritize, body)
             if method == "POST" and path == "/scheduler/bind":
                 return self._verb(self.bind, body)
+            if method == "POST" and path == "/scheduler/batchadmit":
+                # batch admission (docs/batch-admission.md): 404 unless a
+                # BatchAdmitter is attached — the default wire surface is
+                # byte-identical to a batch-less build
+                return self._batchadmit(body)
             if method == "POST" and path == "/status":
                 return 200, "application/json", json.dumps(self.dealer.status())
             if method == "GET" and path == "/version":
@@ -457,6 +462,102 @@ class SchedulerAPI:
             self.verb_duration.observe(elapsed, verb=verb.name)
             self.verb_total.inc(verb=verb.name, code=str(code))
 
+    def _batchadmit(self, body: bytes) -> tuple[int, str, str]:
+        """``POST /scheduler/batchadmit``: one joint batch-admission
+        cycle over the posted pods (docs/batch-admission.md). Body:
+        ``{"Pods": [<pod objects>], "NodeNames": [...]}`` (NodeNames
+        optional — defaults to every known TPU node). Admission-gate
+        EXEMPT like Bind: the cycle commits binds, and shedding it
+        strands the whole batch where a retry is pure waste. Answers the
+        per-pod outcome in solve order; losers are the caller's to
+        retry pod-at-a-time."""
+        admitter = getattr(self.dealer, "batch", None)
+        if admitter is None:
+            return 404, "application/json", error_body(
+                "NotFound",
+                "batch admission disabled (start with --batch; "
+                "docs/batch-admission.md)",
+            )
+        started = time.perf_counter()
+        code = 200
+        try:
+            try:
+                args = json.loads(body or b"{}")
+            except json.JSONDecodeError as e:
+                code = 400
+                return 400, "application/json", error_body(
+                    "BadRequest", f"malformed JSON: {e}"
+                )
+            raw_pods = args.get("Pods") if isinstance(args, dict) else None
+            if not isinstance(raw_pods, list) or not all(
+                isinstance(p, dict) for p in raw_pods
+            ):
+                code = 400
+                return 400, "application/json", error_body(
+                    "BadRequest", "Pods must be a list of pod objects"
+                )
+            node_names = args.get("NodeNames")
+            if node_names is not None and not (
+                isinstance(node_names, list)
+                and all(type(n) is str for n in node_names)
+            ):
+                code = 400
+                return 400, "application/json", error_body(
+                    "BadRequest", "NodeNames must be a list of strings"
+                )
+            from nanotpu.k8s.objects import Pod
+
+            result = admitter.admit(
+                [Pod(p) for p in raw_pods], node_names
+            )
+            outcomes = {id(p): ("unplaced", "", 0, "") for p in
+                        result.unplaced}
+            for p in result.deferred:
+                # beyond max_batch this cycle: not offered to the solve;
+                # the caller re-posts (or the production loop's next
+                # cycle drains) them — reported so no pod vanishes
+                outcomes[id(p)] = ("deferred", "", 0, "")
+            for pod, node, score in result.bound:
+                outcomes[id(pod)] = ("bound", node, score, "")
+            for pod, node, score in result.dispatched:
+                outcomes[id(pod)] = ("dispatched", node, score, "")
+            for pod, err in result.failed:
+                outcomes[id(pod)] = ("failed", "", 0, str(err))
+            ordered = admitter.solve_order(
+                result.unplaced
+                + result.deferred
+                + [p for p, _n, _s in result.bound]
+                + [p for p, _n, _s in result.dispatched]
+                + [p for p, _e in result.failed]
+            )
+            results = [
+                {
+                    "Pod": p.key(),
+                    "PodUID": p.uid,
+                    "Outcome": outcomes[id(p)][0],
+                    "Node": outcomes[id(p)][1],
+                    "Score": outcomes[id(p)][2],
+                    "Error": outcomes[id(p)][3],
+                }
+                for p in ordered
+            ]
+            payload = json.dumps({
+                "Cycle": result.cycle,
+                "FellBack": result.fell_back,
+                "Results": results,
+            }, separators=(",", ":"))
+            self.verb_bytes.inc(len(payload), verb="batchadmit")
+            return 200, "application/json", payload
+        except Exception:
+            code = 500
+            raise
+        finally:
+            self._last_request = time.monotonic()
+            self.verb_duration.observe(
+                time.perf_counter() - started, verb="batchadmit"
+            )
+            self.verb_total.inc(verb="batchadmit", code=str(code))
+
     def _parse_args(self, body: bytes):
         """json.loads with a pre-tokenized fast path for nodeCacheCapable
         payloads: the ``"NodeNames":[...]`` span repeats byte-identically
@@ -585,7 +686,12 @@ class SchedulerAPI:
         shard_status = getattr(self.dealer, "shard_status", None)
         pipeline_status = getattr(self.dealer, "pipeline_status", None)
         recovery = getattr(self.dealer, "recovery", None)
+        batch = getattr(self.dealer, "batch", None)
         return 200, "application/json", json.dumps({
+            # batch-admission status (docs/batch-admission.md): knobs,
+            # lifetime pack/fallback/contention counters, and the last
+            # cycle's shape — {} when no admitter is attached
+            "batch": batch.status() if batch is not None else {},
             # capacity-recovery plane state (docs/defrag.md): open gang
             # holes, active backfill leases, and the action counters —
             # {} when no plane is attached

@@ -227,6 +227,26 @@ class Simulator:
         self.predicate = Predicate(self.dealer, obs=self.obs)
         self.prioritize = Prioritize(self.dealer, obs=self.obs)
         self.bind_verb = Bind(self.dealer, obs=self.obs)
+        bat = self.scenario["batch"]
+        if bat["enabled"]:
+            # joint batch admission (docs/batch-admission.md), stepped
+            # through virtual-time "batch_admit" events; rebuilt with the
+            # dealer on agent restart like the verbs (its state is knobs
+            # + counters the dealer's PerfCounters carry). cycle_base
+            # keeps cycle ids monotonic across the restart: the ledger
+            # survives it, and a reused id would merge two unrelated
+            # joint solves in a batch_cycle join
+            from nanotpu.dealer.admit import BatchAdmitter
+
+            prev = getattr(self, "admitter", None)
+            self.admitter = BatchAdmitter(
+                self.dealer, lookahead=bat["lookahead"],
+                max_batch=bat["max_batch"], obs=self.obs,
+                cycle_base=prev.cycles if prev is not None else 0,
+            )
+            self.dealer.batch = self.admitter
+        else:
+            self.admitter = None
         self.client.before_bind = self._bind_hook
         plane = getattr(self, "plane", None)
         if plane is not None:
@@ -345,6 +365,12 @@ class Simulator:
             while t < horizon:
                 self._push(t, "telemetry_tick", None)
                 t += tel["every_s"]
+        bat = self.scenario["batch"]
+        if bat["enabled"] and bat["every_s"] > 0:
+            t = bat["every_s"]
+            while t < horizon:
+                self._push(t, "batch_admit", None)
+                t += bat["every_s"]
         metric_every, metric_delay = self.faults.metric_cadence()
         if metric_every > 0:
             t = metric_every
@@ -393,6 +419,8 @@ class Simulator:
             self._on_recovery()
         elif kind == "telemetry_tick":
             self._on_telemetry()
+        elif kind == "batch_admit":
+            self._on_batch_admit()
         else:  # pragma: no cover - event kinds are closed within this file
             raise AssertionError(f"unknown event kind {kind}")
 
@@ -532,63 +560,70 @@ class Simulator:
             }, pod.uid)
             self.report.observe_verb("bind", time.perf_counter() - t0)
             if not result["Error"]:
-                job.bound_t[pod.name] = self.now
-                self.report.pods["bound"] += 1
-                self.report.config_count(job.config, "bound")
-                self.report.journal(self.now, f"bind {pod.name} -> {best}")
-                if self.plane is not None:
-                    leased = self.plane.note_bound(
-                        pod, best, now=self.now
-                    )
-                    if leased is not None:
-                        self.report.journal(
-                            self.now,
-                            f"backfill {pod.name} @ {best} for {leased}",
-                        )
-                if (
-                    self.scenario["workload"]["lifetime_from_bind"]
-                    and not job.gang
-                    and not job.departure_scheduled
-                ):
-                    job.departure_scheduled = True
-                    self._push(
-                        self.now + job.lifetime_s, "departure", job
-                    )
-                if job.gang and job.fully_bound() and \
-                        not job.wait_recorded:
-                    # exactly-once: recovery paths can re-trigger the
-                    # fully_bound transition (a migrated member re-binds
-                    # through the replay path); the gang's wait is its
-                    # FIRST completion only
-                    job.wait_recorded = True
-                    self.report.gang_waits_s.append(
-                        round(self.now - job.arrival_t, 6)
-                    )
-                    self.report.journal(
-                        self.now, f"gang-complete {job.gang}"
-                    )
-                    if self.plane is not None:
-                        self.plane.gang_bound(
-                            f"{pod.namespace}/{job.gang}"
-                        )
-                    if (
-                        self.scenario["workload"]["lifetime_from_bind"]
-                        and not job.departure_scheduled
-                    ):
-                        # training holds its slice for lifetime_s FROM
-                        # START (full bind), not from submission — the
-                        # departure is scheduled here instead of at
-                        # admission (scenario knob; docs/defrag.md)
-                        job.departure_scheduled = True
-                        self._push(
-                            self.now + job.lifetime_s, "departure", job
-                        )
+                self._note_bound(job, pod, best)
                 return True
             self.report.pods["bind_errors"] += 1
             self.report.journal(
                 self.now, f"bind-error {pod.name} @ {best}"
             )
         return False
+
+    def _note_bound(self, job: Job, pod: Pod, best: str) -> None:
+        """Post-bind bookkeeping shared by the pod-at-a-time cycle and
+        the batch-admission cycle (one copy: departure scheduling, gang
+        completion, and the recovery plane's lease hook must not drift
+        between the two admission paths)."""
+        job.bound_t[pod.name] = self.now
+        self.report.pods["bound"] += 1
+        self.report.config_count(job.config, "bound")
+        self.report.journal(self.now, f"bind {pod.name} -> {best}")
+        if self.plane is not None:
+            leased = self.plane.note_bound(
+                pod, best, now=self.now
+            )
+            if leased is not None:
+                self.report.journal(
+                    self.now,
+                    f"backfill {pod.name} @ {best} for {leased}",
+                )
+        if (
+            self.scenario["workload"]["lifetime_from_bind"]
+            and not job.gang
+            and not job.departure_scheduled
+        ):
+            job.departure_scheduled = True
+            self._push(
+                self.now + job.lifetime_s, "departure", job
+            )
+        if job.gang and job.fully_bound() and \
+                not job.wait_recorded:
+            # exactly-once: recovery paths can re-trigger the
+            # fully_bound transition (a migrated member re-binds
+            # through the replay path); the gang's wait is its
+            # FIRST completion only
+            job.wait_recorded = True
+            self.report.gang_waits_s.append(
+                round(self.now - job.arrival_t, 6)
+            )
+            self.report.journal(
+                self.now, f"gang-complete {job.gang}"
+            )
+            if self.plane is not None:
+                self.plane.gang_bound(
+                    f"{pod.namespace}/{job.gang}"
+                )
+            if (
+                self.scenario["workload"]["lifetime_from_bind"]
+                and not job.departure_scheduled
+            ):
+                # training holds its slice for lifetime_s FROM
+                # START (full bind), not from submission — the
+                # departure is scheduled here instead of at
+                # admission (scenario knob; docs/defrag.md)
+                job.departure_scheduled = True
+                self._push(
+                    self.now + job.lifetime_s, "departure", job
+                )
 
     # -- event handlers ------------------------------------------------------
     def _admit_job(self, job: Job) -> None:
@@ -879,6 +914,58 @@ class Simulator:
             )
             if tr["event"] == "breach":
                 self.flight.dump(f"slo:{tr['name']}", now=self.now)
+
+    def _on_batch_admit(self) -> None:
+        """One joint batch-admission cycle on virtual time
+        (docs/batch-admission.md): drain the pending queue — the sim's
+        analogue of the controller's coalescing queue — into ONE fused
+        native solve, commit winners INLINE through the real
+        ``Dealer.bind`` (the sim is single-threaded, so the inline
+        committer is the deterministic stand-in for the production
+        commit fan-out), journal every action (digest-witnessed), and
+        leave losers pending for the pod-at-a-time retry path
+        untouched."""
+        if not self._pending:
+            return
+        offered: list = []
+        by_name: dict[str, object] = {}
+        for name in self._pending:
+            job = self._pod_job.get(name)
+            if job is None or job.departed:
+                continue
+            if not self._strict_gate(job):
+                # all-or-nothing gangs wait for the sim-level gate just
+                # as they do on the pod-at-a-time path
+                continue
+            try:
+                pod = self.client.get_pod("default", name)
+            except Exception:
+                continue
+            offered.append(pod)
+            by_name[name] = job
+            if len(offered) >= self.scenario["batch"]["max_batch"]:
+                break
+        if not offered:
+            return
+        result = self.admitter.admit(
+            offered, self._live_node_names(),
+            bind=lambda node, pod: self.dealer.bind(node, pod),
+        )
+        self.report.journal(
+            self.now,
+            f"batch-admit cycle={result.cycle} offered={len(offered)} "
+            f"bound={len(result.bound)} failed={len(result.failed)} "
+            f"unplaced={len(result.unplaced)}"
+            + (" fellback" if result.fell_back else ""),
+        )
+        for pod, node, _score in result.bound:
+            self._pending.remove(pod.name)
+            self._note_bound(by_name[pod.name], pod, node)
+        for pod, _err in result.failed:
+            self.report.pods["bind_errors"] += 1
+            self.report.journal(
+                self.now, f"batch-bind-error {pod.name}"
+            )
 
     def _on_assume_sweep(self) -> None:
         expired = self.controller.sweep_assumed_once(

@@ -91,6 +91,15 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
         "slo": []                    # SLO objectives (same schema as
                                      # policy.yaml's slo: section)
       },
+      "batch": {                     # joint batch admission
+                                     # (docs/batch-admission.md); absent/
+                                     # disabled keeps every existing
+                                     # digest byte-identical
+        "enabled": false,
+        "every_s": 0.5,              # batch_admit cycle cadence (virtual)
+        "lookahead": 4,              # best-fit finalists per pick
+        "max_batch": 128             # demands per joint solve
+      },
       "lock_witness": false,         # true: instrument every lock and
                                      # assert acquisition-order acyclicity
                                      # at teardown (docs/static-analysis.md)
@@ -270,6 +279,22 @@ def normalize_scenario(raw: dict) -> dict:
         "telemetry.capacity and telemetry.flight_ticks must be > 0",
     )
 
+    bat = dict(raw.get("batch") or {})
+    batch = {
+        "enabled": bool(bat.get("enabled", False)),
+        "every_s": float(bat.get("every_s", 0.5)),
+        "lookahead": int(bat.get("lookahead", 4)),
+        "max_batch": int(bat.get("max_batch", 128)),
+    }
+    _require(
+        not batch["enabled"] or batch["every_s"] > 0,
+        "batch.every_s must be > 0 when batch admission is enabled",
+    )
+    _require(
+        batch["lookahead"] >= 1 and batch["max_batch"] >= 1,
+        "batch.lookahead and batch.max_batch must be >= 1",
+    )
+
     rec = dict(raw.get("recovery") or {})
     recovery = {
         "enabled": bool(rec.get("enabled", False)),
@@ -310,6 +335,7 @@ def normalize_scenario(raw: dict) -> dict:
         "queue_max": int(raw.get("queue_max", 0)),
         "shards": shards,
         "pipeline": pipeline,
+        "batch": batch,
         "recovery": recovery,
         "telemetry": telemetry,
         "metric_from_allocation": bool(

@@ -444,12 +444,58 @@ int model_score(const int32_t* free_n, const int32_t* total_n,
   return static_cast<int>(score);
 }
 
+// The post-placement score of ONE node (gang bonus excluded), shared by
+// nanotpu_score_batch and the batch-pack solver so the two paths cannot
+// round apart: with `use_model` the fixed-point throughput formula, else
+// the default Rate formula + compactness band over the assigned masks.
+int score_placed(const Torus& t, const Adjacency& adj,
+                 const int32_t* free_n, const int32_t* total_n,
+                 const double* load_n, const uint64_t* masks,
+                 int n_demands, int prefer_used,
+                 bool use_model, int64_t base_q,
+                 int64_t cont_sum, int64_t cont_cnt,
+                 const int32_t* load_q_n) {
+  if (use_model)
+    return model_score(free_n, total_n, load_q_n, t.n,
+                       base_q, cont_sum, cont_cnt);
+
+  // Rate on the PRE-assignment state (rater.py Binpack/Spread.rate)
+  long total_sum = 0, used_sum = 0, avail = 0;
+  int free_chips = 0;
+  double load_sum = 0.0;
+  for (int c = 0; c < t.n; ++c) {
+    total_sum += total_n[c];
+    used_sum += total_n[c] - free_n[c];
+    avail += free_n[c];
+    if (free_n[c] == total_n[c]) ++free_chips;
+    load_sum += load_n[c];
+  }
+  double mean_load = t.n ? load_sum / t.n : 0.0;
+  int base;
+  if (prefer_used) {
+    double usage = total_sum ? (double)used_sum / total_sum : 0.0;
+    base = clamp_score(usage * 100.0 - mean_load * 50.0);
+  } else {
+    double denom = total_sum ? (double)total_sum : 1.0;
+    double score = 60.0 * ((double)free_chips / (t.n ? t.n : 1)) +
+                   40.0 * ((double)avail / denom);
+    base = clamp_score(score - mean_load * 50.0);
+  }
+
+  // compactness band over the union of assigned chips (rater._finalize;
+  // COMPACTNESS_BAND = 10)
+  uint64_t all_mask = 0;
+  for (int i = 0; i < n_demands; ++i) all_mask |= masks[i];
+  double compact = all_mask ? set_compactness(t, adj, all_mask) : 1.0;
+  return clamp_score(std::min(base, 100 - 10) + compact * 10.0);
+}
+
 }  // namespace
 
 extern "C" {
 
 // ABI version so the ctypes loader can reject stale builds.
-int32_t nanotpu_abi_version() { return 7; }
+int32_t nanotpu_abi_version() { return 8; }
 
 // Place `n_demands` container demands onto one node's torus.
 //
@@ -674,57 +720,302 @@ int32_t nanotpu_score_batch(const int32_t dims[3],
     if (rc != NANOTPU_OK) return rc;
     out_feasible[nidx] = 1;
 
+    // throughput-model formula (ABI 7) when the mirror is wired, else
+    // the default Rate + compactness — one shared body (score_placed),
+    // then the gang bonus folded in exactly as the Python hook path
+    // does (Dealer._hook_gang_bonus: min(SCORE_MAX, score + bonus))
+    int64_t base_q = 0;
     if (model_gen) {
-      // throughput-model formula (ABI 7): base − contention +
-      // fragmentation over the quantized mirror, then the gang bonus
-      // folded in exactly as the Python hook path does
-      // (Dealer._hook_gang_bonus: min(SCORE_MAX, score + bonus))
       int gidx = model_gen[nidx];
-      int64_t base_q =
-          (gidx >= 0 && gidx < model_n_gens) ? model_base_q[gidx] : 0;
-      int score = model_score(free_n, total_n,
-                              model_load_q + (size_t)nidx * t.n, t.n,
-                              base_q, model_cont_sum[nidx],
-                              model_cont_cnt[nidx]);
-      score += gang_bonus(nidx);
-      if (score > 100) score = 100;
-      out_score[nidx] = score;
-      continue;
+      base_q = (gidx >= 0 && gidx < model_n_gens) ? model_base_q[gidx] : 0;
     }
-
-    // Rate on the PRE-assignment state (rater.py Binpack/Spread.rate)
-    long total_sum = 0, used_sum = 0, avail = 0;
-    int free_chips = 0;
-    double load_sum = 0.0;
-    for (int c = 0; c < t.n; ++c) {
-      total_sum += total_n[c];
-      used_sum += total_n[c] - free_n[c];
-      avail += free_n[c];
-      if (free_n[c] == total_n[c]) ++free_chips;
-      load_sum += load_n[c];
-    }
-    double mean_load = t.n ? load_sum / t.n : 0.0;
-    int base;
-    if (prefer_used) {
-      double usage = total_sum ? (double)used_sum / total_sum : 0.0;
-      base = clamp_score(usage * 100.0 - mean_load * 50.0);
-    } else {
-      double denom = total_sum ? (double)total_sum : 1.0;
-      double score = 60.0 * ((double)free_chips / (t.n ? t.n : 1)) +
-                     40.0 * ((double)avail / denom);
-      base = clamp_score(score - mean_load * 50.0);
-    }
-
-    // compactness band over the union of assigned chips (rater._finalize;
-    // COMPACTNESS_BAND = 10)
-    uint64_t all_mask = 0;
-    for (int i = 0; i < n_demands; ++i) all_mask |= masks[i];
-    double compact = all_mask ? set_compactness(t, adj, all_mask) : 1.0;
-    int score = clamp_score(std::min(base, 100 - 10) + compact * 10.0);
-
+    int score = score_placed(
+        t, adj, free_n, total_n, load_n, masks.data(), n_demands,
+        prefer_used, model_gen != nullptr, base_q,
+        model_gen ? model_cont_sum[nidx] : 0,
+        model_gen ? model_cont_cnt[nidx] : 0,
+        model_gen ? model_load_q + (size_t)nidx * t.n : nullptr);
     score += gang_bonus(nidx);
     if (score > 100) score = 100;
     out_score[nidx] = score;
+  }
+  return NANOTPU_OK;
+}
+
+// -- joint batch pack (ABI 8, docs/batch-admission.md) --------------------
+//
+// ONE native crossing packs K pending demands jointly against a frozen
+// view's row arrays: a scratch copy of per-chip free/HBM state is updated
+// in-C between picks, so demand j is scored against the state demand i's
+// placement produced — the admission-order blindness of pod-at-a-time
+// scheduling is what this entry point removes (ROADMAP open item 2;
+// Tesserae's batched-placement result is the reference).
+//
+//   free/total/load/hbm   the FROZEN view rows (never written; the
+//                         scratch copies live and die inside this call)
+//   demand_percents/off   K demands' per-container chip-percents,
+//                         flattened with [K+1] offsets — caller order IS
+//                         the solve order (the admitter sorts
+//                         deterministically; docs/batch-admission.md)
+//   demand_hbm            per-container HBM MiB (same offsets; nullable)
+//   demand_sig[n] / n_sigs
+//                         signature id per demand: equal ids promise
+//                         IDENTICAL (percents, hbm) vectors, which is
+//                         what lets feasibility+score caches be shared
+//                         across same-shape demands — after a pick only
+//                         the one touched node re-scores per signature,
+//                         so a K-demand pack costs
+//                         O(#signatures x nodes + K x dirty) placement
+//                         evaluations instead of O(K x nodes)
+//   model_*               the quantized throughput mirror (ABI 7), with
+//                         model_base_q now PER SIGNATURE
+//                         ([n_sigs x n_gens]): each demand shape has its
+//                         own base row
+//   lookahead             finalists considered per pick: candidates are
+//                         ranked (score desc, index asc) and the top L
+//                         re-ranked by fewest post-placement whole-free
+//                         chips on the node (best-fit — preserves whole
+//                         hosts for gangs), ties back to score/index.
+//                         L=1 is the exact pod-at-a-time argmax (the
+//                         K=1 parity contract in tests/test_admit.py)
+//   out_node[K]           chosen node index, -1 when infeasible
+//   out_score[K]          the pick's score against the scratch state at
+//                         its turn (SCORE_MIN convention does not apply:
+//                         infeasible demands report -1/0)
+//   out_assign/out_counts packed chip ids + per-container counts,
+//                         flattened exactly like demand_percents
+//
+// The caller passes candidates in NAME-ASCENDING order, so "index asc"
+// here IS the merge_top_k name-asc tie-break — shard splits cannot
+// change a pick (pinned by tests/test_admit.py).
+int32_t nanotpu_batch_pack(const int32_t dims[3],
+                           int32_t n_nodes,
+                           const int32_t* free_percent,
+                           const int32_t* total_percent,
+                           const double* load,
+                           const int32_t* hbm_free,
+                           int32_t prefer_used,
+                           int32_t percent_per_chip,
+                           int32_t n_demands,
+                           const int32_t* demand_percents,
+                           const int32_t* demand_off,
+                           const int32_t* demand_hbm,
+                           const int32_t* demand_sig,
+                           int32_t n_sigs,
+                           const int32_t* model_gen,
+                           const int32_t* model_base_q,
+                           int32_t model_n_gens,
+                           const int32_t* model_cont_sum,
+                           const int32_t* model_cont_cnt,
+                           const int32_t* model_load_q,
+                           int32_t lookahead,
+                           int32_t* out_node,
+                           int32_t* out_score,
+                           int32_t* out_assign,
+                           int32_t out_assign_cap,
+                           int32_t* out_counts) {
+  if (!dims || !free_percent || !total_percent || !load ||
+      !demand_percents || !demand_off || !demand_sig || !out_node ||
+      !out_score || !out_assign || !out_counts || n_nodes < 0 ||
+      n_demands < 0 || percent_per_chip <= 0 || lookahead < 1 ||
+      (n_demands > 0 && n_sigs < 1))
+    return NANOTPU_ERR_BAD_ARGS;
+  if (model_gen && (!model_base_q || model_n_gens <= 0 ||
+                    !model_cont_sum || !model_cont_cnt || !model_load_q))
+    return NANOTPU_ERR_BAD_ARGS;
+  Torus t(dims);
+  if (t.n <= 0 || t.n > kMaxChips) return NANOTPU_ERR_TOO_BIG;
+  Adjacency adj(t);
+  PlacementCache placements(t);
+
+  // scratch occupancy: the joint solve's whole point — demand j's
+  // feasibility and score see demand i's placement
+  std::vector<int32_t> sfree(free_percent,
+                             free_percent + (size_t)n_nodes * t.n);
+  std::vector<int32_t> shbm;
+  if (hbm_free)
+    shbm.assign(hbm_free, hbm_free + (size_t)n_nodes * t.n);
+
+  int max_containers = 0;
+  for (int i = 0; i < n_demands; ++i) {
+    int nc = demand_off[i + 1] - demand_off[i];
+    if (nc < 0) return NANOTPU_ERR_BAD_ARGS;
+    if (nc > max_containers) max_containers = nc;
+    if (demand_sig[i] < 0 || demand_sig[i] >= n_sigs)
+      return NANOTPU_ERR_BAD_ARGS;
+  }
+  std::vector<uint64_t> masks(std::max(max_containers, 1), 0);
+
+  // per-signature feasibility/score cache + per-node dirty stamps
+  struct SigCache {
+    bool built = false;
+    int64_t stamp = 0;
+    std::vector<uint8_t> feas;
+    std::vector<int32_t> score;
+  };
+  std::vector<SigCache> cache(std::max<int32_t>(n_sigs, 1));
+  std::vector<int64_t> node_stamp(std::max<int32_t>(n_nodes, 1), 0);
+  int64_t pick_seq = 0;
+
+  // evaluate one (node, demand-slice): feasibility + gang-free score on
+  // the CURRENT scratch state; fills `masks` for the demand's containers
+  auto eval_node = [&](int nidx, int di) -> std::pair<bool, int> {
+    int lo = demand_off[di], nc = demand_off[di + 1] - demand_off[di];
+    const int32_t* pct = demand_percents + lo;
+    const int32_t* hbm_d = demand_hbm ? demand_hbm + lo : nullptr;
+    const int32_t* free_n = sfree.data() + (size_t)nidx * t.n;
+    const int32_t* total_n = total_percent + (size_t)nidx * t.n;
+    const double* load_n = load + (size_t)nidx * t.n;
+    const int32_t* hbm_n =
+        hbm_free ? shbm.data() + (size_t)nidx * t.n : nullptr;
+    int rc = choose_node(t, adj, free_n, total_n, load_n, nc, pct,
+                         prefer_used, percent_per_chip, masks.data(),
+                         hbm_n, hbm_d, &placements);
+    if (rc != NANOTPU_OK) return {false, 0};
+    int64_t base_q = 0;
+    if (model_gen) {
+      int gidx = model_gen[nidx];
+      int sig = demand_sig[di];
+      base_q = (gidx >= 0 && gidx < model_n_gens)
+                   ? model_base_q[(size_t)sig * model_n_gens + gidx]
+                   : 0;
+    }
+    int score = score_placed(
+        t, adj, free_n, total_n, load_n, masks.data(), nc, prefer_used,
+        model_gen != nullptr, base_q,
+        model_gen ? model_cont_sum[nidx] : 0,
+        model_gen ? model_cont_cnt[nidx] : 0,
+        model_gen ? model_load_q + (size_t)nidx * t.n : nullptr);
+    return {true, score};
+  };
+
+  // whole-free chips remaining on the node after a hypothetical apply of
+  // `masks` — the lookahead's best-fit criterion
+  auto wf_after = [&](int nidx, int di) {
+    int lo = demand_off[di], nc = demand_off[di + 1] - demand_off[di];
+    const int32_t* pct = demand_percents + lo;
+    size_t base = (size_t)nidx * t.n;
+    int wf = 0;
+    for (int c = 0; c < t.n; ++c) {
+      int32_t f = sfree[base + c];
+      for (int i = 0; i < nc; ++i) {
+        if (masks[i] >> c & 1) {
+          int p = pct[i];
+          f -= (p >= percent_per_chip) ? percent_per_chip : p;
+        }
+      }
+      if (f == total_percent[base + c] && total_percent[base + c] > 0)
+        ++wf;
+    }
+    return wf;
+  };
+
+  int32_t cursor = 0;
+  for (int di = 0; di < n_demands; ++di) {
+    int sig = demand_sig[di];
+    SigCache& sc = cache[sig];
+    if (!sc.built) {
+      sc.feas.assign(std::max<int32_t>(n_nodes, 1), 0);
+      sc.score.assign(std::max<int32_t>(n_nodes, 1), 0);
+      for (int nidx = 0; nidx < n_nodes; ++nidx) {
+        auto fs = eval_node(nidx, di);
+        sc.feas[nidx] = fs.first ? 1 : 0;
+        sc.score[nidx] = fs.second;
+      }
+      sc.built = true;
+      sc.stamp = pick_seq;
+    } else if (sc.stamp < pick_seq) {
+      for (int nidx = 0; nidx < n_nodes; ++nidx) {
+        if (node_stamp[nidx] > sc.stamp) {
+          auto fs = eval_node(nidx, di);
+          sc.feas[nidx] = fs.first ? 1 : 0;
+          sc.score[nidx] = fs.second;
+        }
+      }
+      sc.stamp = pick_seq;
+    }
+
+    // finalists: top-`lookahead` by (score desc, index asc)
+    struct Cand { int idx; int score; };
+    std::vector<Cand> top;
+    top.reserve(lookahead);
+    for (int nidx = 0; nidx < n_nodes; ++nidx) {
+      if (!sc.feas[nidx]) continue;
+      int s = sc.score[nidx];
+      // insertion keeps (score desc, idx asc): a strictly-greater score
+      // displaces; equal scores keep the earlier (lower) index first
+      size_t pos = top.size();
+      while (pos > 0 && top[pos - 1].score < s) --pos;
+      if ((int)top.size() < lookahead) {
+        top.insert(top.begin() + pos, {nidx, s});
+      } else if (pos < top.size()) {
+        top.insert(top.begin() + pos, {nidx, s});
+        top.pop_back();
+      }
+    }
+
+    int lo = demand_off[di], nc = demand_off[di + 1] - demand_off[di];
+    if (top.empty()) {
+      out_node[di] = -1;
+      out_score[di] = 0;
+      for (int i = 0; i < nc; ++i) out_counts[lo + i] = 0;
+      continue;
+    }
+
+    // lookahead re-rank: fewest post-placement whole-free chips wins
+    // (best-fit); the vector is already (score desc, idx asc), so a
+    // strict '<' walk preserves that order for ties. masks end holding
+    // the WINNER's placement.
+    int best = 0;
+    if (top.size() > 1) {
+      int best_wf = -1;
+      for (size_t j = 0; j < top.size(); ++j) {
+        eval_node(top[j].idx, di);  // refills `masks` for this node
+        int wf = wf_after(top[j].idx, di);
+        if (best_wf < 0 || wf < best_wf) {
+          best_wf = wf;
+          best = (int)j;
+        }
+      }
+    }
+    int win = top[best].idx;
+    eval_node(win, di);  // deterministic re-fill of `masks` for `win`
+
+    // apply to scratch: demand j+1 sees this placement
+    const int32_t* pct = demand_percents + lo;
+    const int32_t* hbm_d = demand_hbm ? demand_hbm + lo : nullptr;
+    size_t nbase = (size_t)win * t.n;
+    for (int i = 0; i < nc; ++i) {
+      int p = pct[i];
+      if (p <= 0) continue;
+      int per = (p >= percent_per_chip) ? percent_per_chip : p;
+      int h = hbm_d ? hbm_d[i] : 0;
+      uint64_t rest = masks[i];
+      while (rest) {
+        int c = __builtin_ctzll(rest);
+        rest &= rest - 1;
+        sfree[nbase + c] -= per;
+        if (sfree[nbase + c] < 0) sfree[nbase + c] = 0;  // defensive
+        if (h > 0 && hbm_free && shbm[nbase + c] >= 0)
+          shbm[nbase + c] -= h;
+      }
+    }
+    node_stamp[win] = ++pick_seq;
+
+    out_node[di] = win;
+    out_score[di] = top[best].score;
+    for (int i = 0; i < nc; ++i) {
+      int32_t count = 0;
+      uint64_t rest = masks[i];
+      while (rest) {
+        int c = __builtin_ctzll(rest);  // ascending scan == sorted ids
+        rest &= rest - 1;
+        if (cursor >= out_assign_cap) return NANOTPU_ERR_TOO_BIG;
+        out_assign[cursor++] = c;
+        ++count;
+      }
+      out_counts[lo + i] = count;
+    }
   }
   return NANOTPU_OK;
 }

@@ -1,0 +1,570 @@
+"""Joint batch admission (docs/batch-admission.md, ABI 8).
+
+The four contracts the ISSUE pins:
+
+* **byte-determinism of the joint solve** — the same pending SET in any
+  arrival order produces the identical assignment (the admitter's
+  canonical solve order + the solver's per-signature caches + the
+  deterministic cross-shard reduce);
+* **K=1 parity** — a single demand packed with ``lookahead=1`` lands on
+  exactly the node the pod-at-a-time path (``Dealer.top_candidates``)
+  picks, with the identical score;
+* **fallback-path wire parity** — attaching an (idle) admitter changes
+  ZERO bytes on the existing verb wire, and the fallback cases (hook
+  rater, recovery plane) fall back whole instead of half-packing;
+* **contended-node reduce pin** — when multiple shards bid for a
+  demand, the winner is (score desc, name asc) regardless of shard
+  split or candidate order.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from nanotpu import native, types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.dealer import Dealer
+from nanotpu.dealer.admit import AdmitResult, BatchAdmitter
+from nanotpu.k8s.objects import Pod, make_container, make_pod
+from nanotpu.metrics.registry import Registry
+from nanotpu.obs import Observability
+from nanotpu.routes.server import SchedulerAPI
+from nanotpu.sim.fleet import make_fleet
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native allocator unavailable"
+)
+
+
+def tpu_pod(name, percents=(200,), namespace="default", annotations=None):
+    return make_pod(
+        name,
+        namespace=namespace,
+        annotations=annotations,
+        containers=[
+            make_container(f"c{i}", {types.RESOURCE_TPU_PERCENT: str(p)})
+            for i, p in enumerate(percents)
+        ],
+    )
+
+
+def two_pool_fleet(hosts=8):
+    """Two v5p pools -> two shards under ``shards='auto'``."""
+    return make_fleet({"pools": [
+        {"generation": "v5p", "hosts": hosts, "slice_hosts": 4,
+         "prefix": "a", "slice_prefix": "as"},
+        {"generation": "v5p", "hosts": hosts, "slice_hosts": 4,
+         "prefix": "b", "slice_prefix": "bs"},
+    ]})
+
+
+def stack(client, shards="auto", rater="binpack", sample=1, **admit_kw):
+    obs = Observability(sample=sample)
+    dealer = Dealer(client, make_rater(rater), shards=shards, obs=obs)
+    admitter = BatchAdmitter(dealer, obs=obs, **admit_kw)
+    dealer.batch = admitter
+    return dealer, admitter, obs
+
+
+def picks_by_name(ordered, picks):
+    return {p.name: pick for p, pick in zip(ordered, picks)}
+
+
+MIXED_SHAPES = [(100,), (200,), (400,), (50,), (100, 100), (200, 50)]
+
+
+def mixed_pods(client, n=12):
+    return [
+        client.create_pod(
+            tpu_pod(f"pod-{i:02d}", MIXED_SHAPES[i % len(MIXED_SHAPES)])
+        )
+        for i in range(n)
+    ]
+
+
+class TestSolveDeterminism:
+    def test_any_arrival_order_same_assignment(self):
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client)
+        pods = mixed_pods(client)
+        node_names = dealer.node_names()
+        ordered, picks = admitter.plan(list(pods), node_names)
+        assert picks is not None and any(p is not None for p in picks)
+        baseline = picks_by_name(ordered, picks)
+        for arrival in (list(reversed(pods)),
+                        pods[1::2] + pods[0::2],
+                        pods[3:] + pods[:3]):
+            ordered2, picks2 = admitter.plan(arrival, node_names)
+            assert [p.name for p in ordered2] == [p.name for p in ordered]
+            assert picks_by_name(ordered2, picks2) == baseline
+        dealer.close()
+
+    def test_repeat_and_candidate_order_stable(self):
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client)
+        pods = mixed_pods(client)
+        node_names = dealer.node_names()
+        ordered, picks = admitter.plan(pods, node_names)
+        base = picks_by_name(ordered, picks)
+        # candidate order reversed: per-shard runs flip order, but each
+        # shard's candidates stay name-ascending WITHIN the request in
+        # production callers; the reduce itself is order-free. Reversing
+        # whole-shard blocks keeps that invariant and must not move a
+        # single pick.
+        a_names = [n for n in node_names if n.startswith("a-")]
+        b_names = [n for n in node_names if n.startswith("b-")]
+        ordered2, picks2 = admitter.plan(pods, b_names + a_names)
+        assert picks_by_name(ordered2, picks2) == base
+        ordered3, picks3 = admitter.plan(pods, node_names)
+        assert picks_by_name(ordered3, picks3) == base
+        dealer.close()
+
+    def test_solve_order_is_canonical(self):
+        pods = [tpu_pod("z"), tpu_pod("a"), tpu_pod("m", namespace="aa")]
+        ordered = BatchAdmitter.solve_order(pods)
+        assert [(p.namespace, p.name) for p in ordered] == [
+            ("aa", "m"), ("default", "a"), ("default", "z"),
+        ]
+
+    def test_uidless_pods_stay_distinct(self):
+        # pods the apiserver has not stamped a uid on (Pod.uid == "")
+        # must NOT collapse into one through the uid dedup — every
+        # posted pod answers (the route's no-pod-vanishes contract);
+        # only a genuine duplicate (same namespace/name) dedups
+        raw = {"spec": {"containers": []}}
+        a = Pod({"metadata": {"name": "a", "namespace": "default"},
+                 **raw})
+        b = Pod({"metadata": {"name": "b", "namespace": "default"},
+                 **raw})
+        b2 = Pod({"metadata": {"name": "b", "namespace": "default"},
+                  **raw})
+        assert a.uid == "" and b.uid == ""
+        ordered = BatchAdmitter.solve_order([b, a, b2])
+        assert [p.name for p in ordered] == ["a", "b"]
+
+
+class TestK1Parity:
+    @pytest.mark.parametrize("shards", [1, "auto"])
+    @pytest.mark.parametrize("shape", MIXED_SHAPES)
+    def test_lookahead1_is_pod_at_a_time_argmax(self, shards, shape):
+        client = two_pool_fleet(hosts=4)
+        dealer, admitter, _ = stack(client, shards=shards, lookahead=1)
+        # evolve state so the argmax is non-trivial
+        for i, warm in enumerate([(200,), (100,), (50,)]):
+            pod = client.create_pod(tpu_pod(f"warm-{i}", warm))
+            top = dealer.top_candidates(dealer.node_names(), pod, 1)
+            dealer.bind(top[0][0], pod)
+        pod = client.create_pod(tpu_pod("probe", shape))
+        node_names = dealer.node_names()
+        expected = dealer.top_candidates(node_names, pod, 1)
+        _ordered, picks = admitter.plan([pod], node_names)
+        assert picks is not None
+        assert picks[0] == expected[0], (shape, picks, expected)
+        dealer.close()
+
+
+class TestContendedReduce:
+    def test_equal_score_contention_resolves_name_asc(self):
+        # two IDENTICAL empty pools: both shards bid the same score for
+        # a single demand, and the reduce must settle on the name-asc
+        # node — a-0 — no matter how the shards are ordered
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client)
+        pod = client.create_pod(tpu_pod("probe", (200,)))
+        before = dealer.perf.batch_contended
+        node_names = dealer.node_names()
+        _ordered, picks = admitter.plan([pod], node_names)
+        assert picks[0][0] == "a-0", picks
+        assert dealer.perf.batch_contended == before + 1
+        a_names = [n for n in node_names if n.startswith("a-")]
+        b_names = [n for n in node_names if n.startswith("b-")]
+        _ordered, picks2 = admitter.plan([pod], b_names + a_names)
+        assert picks2[0] == picks[0]
+        dealer.close()
+
+    def test_shard_split_cannot_change_a_single_demand(self):
+        # one demand sees no scratch interaction, so the sharded reduce
+        # must agree with the single-shard solve bit for bit
+        client1 = two_pool_fleet()
+        d1, a1, _ = stack(client1, shards=1)
+        client2 = two_pool_fleet()
+        d2, a2, _ = stack(client2, shards="auto")
+        pod1 = client1.create_pod(tpu_pod("probe", (400,)))
+        pod2 = client2.create_pod(tpu_pod("probe", (400,)))
+        _, picks1 = a1.plan([pod1], d1.node_names())
+        _, picks2 = a2.plan([pod2], d2.node_names())
+        assert picks1 == picks2
+        d1.close()
+        d2.close()
+
+    def test_deep_batch_across_shards_places_the_whole_fleet(self):
+        # a batch whose aggregate demand exceeds ONE shard's free
+        # capacity: round 1's independent per-shard scratches would
+        # strand the tail of the solve order (every shard virtually
+        # fills and reports it infeasible) — the refinement rounds must
+        # recover every demand the single-shard solve can place
+        client1 = two_pool_fleet()
+        d1, a1, _ = stack(client1, shards=1)
+        client2 = two_pool_fleet()
+        d2, a2, _ = stack(client2, shards="auto")
+        pods1 = mixed_pods(client1, n=24)
+        pods2 = mixed_pods(client2, n=24)
+        _, picks1 = a1.plan(pods1, d1.node_names())
+        ordered2, picks2 = a2.plan(pods2, d2.node_names())
+        placed1 = sum(p is not None for p in picks1)
+        placed2 = sum(p is not None for p in picks2)
+        assert placed1 == len(pods1)  # the fleet hosts the whole batch
+        assert placed2 == placed1, (picks2, picks1)
+        # and the refined sharded solve stays a pure function of the
+        # pending SET: any arrival order, the identical assignment
+        base = picks_by_name(ordered2, picks2)
+        ordered3, picks3 = a2.plan(list(reversed(pods2)),
+                                   d2.node_names())
+        assert picks_by_name(ordered3, picks3) == base
+        d1.close()
+        d2.close()
+
+
+class TestFallback:
+    def test_hook_rater_falls_back_whole(self, monkeypatch):
+        monkeypatch.setenv("NANOTPU_NATIVE_MODEL", "0")
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client, rater="throughput")
+        assert dealer._hook_active
+        pods = [client.create_pod(tpu_pod("p0", (200,)))]
+        result = admitter.admit(pods, dealer.node_names())
+        assert result.fell_back and result.unplaced == pods
+        assert not result.bound
+        assert dealer.perf.batch_fallbacks == 1
+        # the pod is untouched: the pod-at-a-time path still owns it
+        assert not dealer.tracks(pods[0].uid)
+        dealer.close()
+
+    def test_recovery_plane_falls_back_whole(self):
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client)
+        dealer.recovery = object()  # any attached plane forces fallback
+        pod = client.create_pod(tpu_pod("p0", (200,)))
+        _ordered, picks = admitter.plan([pod], dealer.node_names())
+        assert picks is None
+        dealer.recovery = None
+        dealer.close()
+
+    def test_idle_admitter_changes_zero_wire_bytes(self):
+        # fallback-path wire parity: a dealer WITH an (idle) admitter
+        # attached must answer filter/priorities/bind byte-identically
+        # to a batch-less dealer — batch=off/idle cannot perturb the
+        # extender surface
+        responses = []
+        for attach in (False, True):
+            client = two_pool_fleet(hosts=4)
+            obs = Observability()
+            dealer = Dealer(client, make_rater("binpack"),
+                            shards="auto", obs=obs)
+            if attach:
+                dealer.batch = BatchAdmitter(dealer, obs=obs)
+            api = SchedulerAPI(dealer, Registry(), obs=obs)
+            api.stop_idle_gc()
+            pod = client.create_pod(tpu_pod("wire", (200,)))
+            args = json.dumps({
+                "Pod": pod.raw, "NodeNames": dealer.node_names(),
+            }).encode()
+            trio = []
+            for path in ("/scheduler/filter", "/scheduler/priorities"):
+                code, _, payload = api.dispatch("POST", path, args)
+                assert code == 200
+                trio.append(payload)
+            code, _, payload = api.dispatch(
+                "POST", "/scheduler/bind",
+                json.dumps({
+                    "PodName": pod.name, "PodNamespace": pod.namespace,
+                    "PodUID": pod.uid, "Node": "a-0",
+                }).encode(),
+            )
+            assert code == 200
+            trio.append(payload)
+            responses.append(trio)
+            dealer.close()
+        assert responses[0] == responses[1]
+
+    def test_invalid_demand_is_unplaced_not_packed(self):
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client)
+        bad = client.create_pod(tpu_pod("bad", (150,)))  # invalid multi-chip
+        good = client.create_pod(tpu_pod("good", (200,)))
+        result = admitter.admit([bad, good], dealer.node_names())
+        assert [p.name for p in result.unplaced] == ["bad"]
+        assert [p.name for p, _n, _s in result.bound] == ["good"]
+        dealer.close()
+
+
+class TestAdmitCommit:
+    def test_admit_binds_audits_and_counts(self):
+        client = two_pool_fleet()
+        dealer, admitter, obs = stack(client)
+        pods = [client.create_pod(tpu_pod(f"p{i}", (200,)))
+                for i in range(4)]
+        result = admitter.admit(pods, dealer.node_names())
+        assert len(result.bound) == 4 and not result.failed
+        for pod, node, _score in result.bound:
+            fresh = client.get_pod(pod.namespace, pod.name)
+            assert fresh.node_name == node
+            assert dealer.tracks(pod.uid)
+            recs = obs.ledger.get(pod.uid)
+            assert recs and recs[-1]["batch_cycle"] == result.cycle
+            assert recs[-1]["binds"][-1]["reason"] == "batch_packed"
+            assert recs[-1]["outcome"] == "bound"
+        assert dealer.perf.batch_cycles == 1
+        assert dealer.perf.batch_packed == 4
+        assert dealer.perf.batch_fallbacks == 0
+        status = admitter.status()
+        assert status["cycles"] == 1 and status["packed"] == 4
+        assert status["last"]["bound"] == 4
+        dealer.close()
+
+    def test_bind_failure_rolls_back_and_falls_back(self):
+        from nanotpu.k8s.client import ApiError
+
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client)
+
+        def boom(namespace, name, node):
+            if name == "p1":
+                raise ApiError("injected bind failure")
+
+        client.before_bind = boom
+        pods = [client.create_pod(tpu_pod(f"p{i}", (200,)))
+                for i in range(3)]
+        result = admitter.admit(pods, dealer.node_names())
+        assert [p.name for p, _e in result.failed] == ["p1"]
+        assert {p.name for p, _n, _s in result.bound} == {"p0", "p2"}
+        assert not dealer.tracks(pods[1].uid)  # accounting rolled back
+        assert dealer.perf.batch_fallbacks == 1
+        dealer.close()
+
+    def test_unplaced_when_fleet_is_full(self):
+        client = make_fleet({"pools": [
+            {"generation": "v5p", "hosts": 1, "slice_hosts": 1,
+             "prefix": "solo"},
+        ]})
+        dealer, admitter, _ = stack(client, shards=1)
+        pods = [client.create_pod(tpu_pod(f"p{i}", (400,)))
+                for i in range(3)]
+        result = admitter.admit(pods, dealer.node_names())
+        assert len(result.bound) == 1
+        assert len(result.unplaced) == 2
+        assert dealer.perf.batch_fallbacks == 2
+        dealer.close()
+
+    def test_max_batch_caps_the_cycle(self):
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client, max_batch=2)
+        pods = [client.create_pod(tpu_pod(f"p{i}", (100,)))
+                for i in range(5)]
+        ordered, picks = admitter.plan(pods, dealer.node_names())
+        assert len(ordered) == 2 and len(picks) == 2
+        # the cap takes the FIRST of the solve order, deterministically
+        assert [p.name for p in ordered] == ["p0", "p1"]
+        dealer.close()
+
+    def test_collect_skips_reserved_uids(self):
+        from nanotpu.controller.controller import Controller
+
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client)
+        controller = Controller(client, dealer, resync_period_s=0,
+                                assume_ttl_s=0)
+        admitter.controller = controller
+        parked = client.create_pod(tpu_pod("parked", (200,)))
+        waiting = client.create_pod(tpu_pod("waiting", (200,)))
+        for event_pod in (parked, waiting):
+            controller._remember(event_pod)
+        # simulate a barrier-parked member: a registered reservation
+        from nanotpu.dealer.dealer import _Reservation
+
+        dealer._reserved[parked.uid] = _Reservation(
+            "a-0", None, None, "default/g"
+        )
+        names = [p.name for p in admitter.collect()]
+        assert names == ["waiting"]
+        dealer.close()
+
+    def test_collect_skips_inflight_dispatch_uids(self):
+        from nanotpu.controller.controller import Controller
+
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client)
+        controller = Controller(client, dealer, resync_period_s=0,
+                                assume_ttl_s=0)
+        admitter.controller = controller
+        flying = client.create_pod(tpu_pod("flying", (200,)))
+        waiting = client.create_pod(tpu_pod("waiting", (200,)))
+        for event_pod in (flying, waiting):
+            controller._remember(event_pod)
+        # a strict-gang winner handed to its async bind thread holds no
+        # reservation until that thread reaches the reserve step — the
+        # in-flight set is what keeps the next cycle from re-packing it
+        with admitter._lock:
+            admitter._inflight.add(flying.uid)
+        assert [p.name for p in admitter.collect()] == ["waiting"]
+        dealer.close()
+
+    def test_collect_demotes_last_cycles_unplaced_on_overflow(self):
+        from nanotpu.controller.controller import Controller
+
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client, max_batch=2)
+        controller = Controller(client, dealer, resync_period_s=0,
+                                assume_ttl_s=0)
+        admitter.controller = controller
+        # two infeasible pods sort FIRST: without the demotion they
+        # would occupy both batch slots every cycle and ccc/ddd would
+        # never enter a joint solve
+        for name, shape in (("aaa", (4000,)), ("bbb", (4000,)),
+                            ("ccc", (200,)), ("ddd", (200,))):
+            controller._remember(client.create_pod(tpu_pod(name, shape)))
+        result = admitter.run_once()
+        assert [p.name for p in result.unplaced] == ["aaa", "bbb"]
+        # next drain: the unplaced front rotates behind the fresh pods
+        assert [p.name for p in admitter.collect()] == ["ccc", "ddd"]
+        # ...for ONE cycle only — once the queue no longer overflows,
+        # the demoted pods are offered again (conditions change)
+        result = admitter.run_once()
+        assert [p.name for p, _n, _s in result.bound] == ["ccc", "ddd"]
+        dealer.close()
+
+    def test_overflow_is_deferred_not_dropped(self):
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client, max_batch=2)
+        pods = [client.create_pod(tpu_pod(f"p{i}", (100,)))
+                for i in range(5)]
+        result = admitter.admit(pods, dealer.node_names())
+        assert [p.name for p, _n, _s in result.bound] == ["p0", "p1"]
+        # the overflow is visible — and NOT a fallback: the next cycle
+        # (or a re-post) serves it
+        assert [p.name for p in result.deferred] == ["p2", "p3", "p4"]
+        assert dealer.perf.batch_fallbacks == 0
+        assert admitter.status()["last"]["deferred"] == 3
+        dealer.close()
+
+    def test_duplicate_uid_packed_once(self):
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client)
+        pod = client.create_pod(tpu_pod("dup", (200,)))
+        result = admitter.admit([pod, pod], dealer.node_names())
+        assert len(result.bound) == 1 and not result.failed
+        assert not result.unplaced and not result.deferred
+        assert dealer.perf.batch_packed == 1
+        dealer.close()
+
+
+class TestHttpRoute:
+    def test_404_without_admitter(self):
+        client = two_pool_fleet(hosts=2)
+        dealer = Dealer(client, make_rater("binpack"))
+        api = SchedulerAPI(dealer, Registry())
+        api.stop_idle_gc()
+        code, _, payload = api.dispatch(
+            "POST", "/scheduler/batchadmit", b"{}"
+        )
+        assert code == 404
+        assert json.loads(payload)["Reason"] == "NotFound"
+        dealer.close()
+
+    def test_batchadmit_roundtrip(self):
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client)
+        api = SchedulerAPI(dealer, Registry(), obs=admitter.obs)
+        api.stop_idle_gc()
+        pods = [client.create_pod(tpu_pod(f"p{i}", (200,)))
+                for i in range(3)]
+        body = json.dumps({"Pods": [p.raw for p in pods]}).encode()
+        code, _, payload = api.dispatch(
+            "POST", "/scheduler/batchadmit", body
+        )
+        assert code == 200, payload
+        out = json.loads(payload)
+        assert out["Cycle"] == 1 and not out["FellBack"]
+        assert [r["Outcome"] for r in out["Results"]] == ["bound"] * 3
+        for r in out["Results"]:
+            ns, name = r["Pod"].split("/")
+            assert client.get_pod(ns, name).node_name == r["Node"]
+        # the batch status surfaces on /debug/decisions
+        code, _, payload = api.dispatch("GET", "/debug/decisions", b"")
+        assert code == 200
+        batch = json.loads(payload)["batch"]
+        assert batch["enabled"] and batch["cycles"] == 1
+        assert batch["packed"] == 3
+        dealer.close()
+
+    def test_oversize_body_reports_deferred(self):
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client, max_batch=2)
+        api = SchedulerAPI(dealer, Registry(), obs=admitter.obs)
+        api.stop_idle_gc()
+        pods = [client.create_pod(tpu_pod(f"p{i}", (100,)))
+                for i in range(4)]
+        body = json.dumps({"Pods": [p.raw for p in pods]}).encode()
+        code, _, payload = api.dispatch(
+            "POST", "/scheduler/batchadmit", body
+        )
+        assert code == 200, payload
+        out = json.loads(payload)
+        # every posted pod answers: no entry silently vanishes past the
+        # max_batch cap — the overflow says "deferred" (re-post it)
+        by_name = {r["Pod"].split("/")[1]: r["Outcome"]
+                   for r in out["Results"]}
+        assert by_name == {"p0": "bound", "p1": "bound",
+                           "p2": "deferred", "p3": "deferred"}
+        dealer.close()
+
+    def test_cycle_base_survives_rebuild(self):
+        client = two_pool_fleet()
+        dealer, admitter, _ = stack(client)
+        pod = client.create_pod(tpu_pod("one", (200,)))
+        result = admitter.admit([pod], dealer.node_names())
+        assert result.cycle == 1
+        # an agent restart rebuilds the admitter (sim/core.py); seeding
+        # cycle_base keeps ledger batch_cycle ids monotonic across it
+        rebuilt = BatchAdmitter(dealer, cycle_base=admitter.cycles)
+        pod2 = client.create_pod(tpu_pod("two", (200,)))
+        result2 = rebuilt.admit([pod2], dealer.node_names())
+        assert result2.cycle == 2
+        dealer.close()
+
+    def test_bad_bodies_answer_400(self):
+        client = two_pool_fleet(hosts=2)
+        dealer, admitter, _ = stack(client)
+        api = SchedulerAPI(dealer, Registry(), obs=admitter.obs)
+        api.stop_idle_gc()
+        for body in (b"{not json", b'{"Pods": "nope"}',
+                     b'{"Pods": [], "NodeNames": "x"}'):
+            code, _, payload = api.dispatch(
+                "POST", "/scheduler/batchadmit", body
+            )
+            assert code == 400, (body, payload)
+        dealer.close()
+
+
+class TestAdmitterValidation:
+    def test_bad_knobs_rejected(self):
+        client = two_pool_fleet(hosts=2)
+        dealer = Dealer(client, make_rater("binpack"))
+        with pytest.raises(ValueError):
+            BatchAdmitter(dealer, lookahead=0)
+        with pytest.raises(ValueError):
+            BatchAdmitter(dealer, max_batch=0)
+        with pytest.raises(ValueError):
+            BatchAdmitter(dealer, cycle_base=-1)
+        from nanotpu.dealer.admit import BatchLoop
+
+        with pytest.raises(ValueError):
+            BatchLoop(BatchAdmitter(dealer), period_s=0)
+        dealer.close()
+
+    def test_admit_result_shape(self):
+        r = AdmitResult(7)
+        assert r.cycle == 7 and not r.fell_back
+        assert r.bound == [] and r.unplaced == []

@@ -380,6 +380,58 @@ class TestLockDiscipline:
             for f in cycles
         ), report.findings
 
+    def test_blocking_under_admitter_lock_flagged(self, tmp_path):
+        # BatchAdmitter._lock is in HOT_LOCKS (ISSUE r12 satellite,
+        # docs/batch-admission.md): the admitter's contract is that the
+        # joint solve and the commit fan-out both run OUTSIDE its lock —
+        # an apiserver write inside it must be a finding
+        report = one(tmp_path, """
+            from nanotpu.analysis.witness import make_lock
+
+            class BatchAdmitter:
+                def __init__(self):
+                    self._lock = make_lock("BatchAdmitter._lock")
+
+                def admit_and_commit(self):
+                    with self._lock:
+                        self.dealer.client.update_pod(None)
+            """, "lock-discipline")
+        assert any(
+            "BatchAdmitter._lock" in f.message and "blocking" in f.message
+            for f in report.findings
+        ), report.findings
+
+    def test_admitter_dealer_lock_inversion_flagged(self, tmp_path):
+        # seeded inversion (ISSUE r12 satellite): production only ever
+        # takes the admitter lock on its own (counters + last-cycle
+        # summary) — a path nesting it with the dealer lock in BOTH
+        # orders is the canonical batch-admission deadlock the pass must
+        # name
+        report = one(tmp_path, """
+            from nanotpu.analysis.witness import make_lock
+
+            class BatchAdmitter:
+                def __init__(self):
+                    self._lock = make_lock("BatchAdmitter._lock")
+
+            class Dealer:
+                def admit_under_dealer(self, adm: BatchAdmitter):
+                    with self._lock:
+                        with adm._lock:
+                            pass
+
+                def status_under_admitter(self, adm: BatchAdmitter):
+                    with adm._lock:
+                        with self._lock:
+                            pass
+            """, "lock-discipline")
+        cycles = [f for f in report.findings if "cycle" in f.message]
+        assert any(
+            "BatchAdmitter._lock" in f.message
+            and "Dealer._lock" in f.message
+            for f in cycles
+        ), report.findings
+
 
 # ---------------------------------------------------------------------------
 # snapshot-immutability
@@ -644,6 +696,35 @@ class TestSimDeterminism:
             """, "sim-determinism")
         assert report.findings == []
 
+    def test_batch_admission_module_in_scope(self):
+        """ISSUE r12 satellite: the sim drives the batch admitter
+        (virtual-time batch_admit events), so the determinism pass's
+        SCOPE must cover nanotpu.dealer.admit — a wall clock or
+        unordered-set drain there would silently break the batch
+        scenario's digest contract."""
+        from nanotpu.analysis.core import collect_modules
+        from nanotpu.analysis.passes.determinism import SCOPE
+
+        modules, _errors = collect_modules(NANOTPU_ROOT)
+        admit = [m for m in modules if m.name == "nanotpu.dealer.admit"]
+        assert admit, "nanotpu/dealer/admit.py missing from the tree"
+        assert admit[0].in_scope(SCOPE), SCOPE
+
+    def test_admitter_wall_clock_flagged(self, tmp_path):
+        # the contract the scope pin above protects, demonstrated on a
+        # seeded admit-shaped violation
+        report = one(tmp_path, """
+            import time
+
+            class BatchAdmitter:
+                def admit(self, pods):
+                    started = time.time()
+                    return started
+            """, "sim-determinism")
+        assert any(
+            "time.time" in f.message for f in report.findings
+        ), report.findings
+
 
 # ---------------------------------------------------------------------------
 # metrics-completeness
@@ -729,6 +810,37 @@ class TestMetricsCompleteness:
         # declared AND bumped -> clean
         assert not any("model_syncs" in m for m in msgs), msgs
         assert not any("fastpath_misses" in m for m in msgs), msgs
+
+    def test_r12_batch_counters_held_both_directions(self, tmp_path):
+        """The batch-admission attribution slots (batch_cycles /
+        batch_packed / batch_fallbacks / batch_contended) ride the same
+        structural slots-vs-sites check as every PerfCounters family:
+        a declared-but-never-bumped cycle counter, or a bumped-but-
+        undeclared one, are both findings — in fixture and (by the
+        clean-tree test) on the production quad."""
+        report = lint(tmp_path, {
+            "perf.py": """
+                class PerfCounters:
+                    __slots__ = ("batch_cycles", "batch_packed",
+                                 "batch_fallbacks", "batch_contended")
+                """,
+            "admit.py": """
+                class BatchAdmitter:
+                    def admit(self):
+                        self.dealer.perf.batch_cycles += 1
+                        self.dealer.perf.batch_packed += 1
+                        self.dealer.perf.batch_fallbacks += 1
+                        self.dealer.perf.batch_skips += 1
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        # declared, never bumped -> finding
+        assert any("batch_contended" in m for m in msgs), msgs
+        # bumped, never declared -> finding
+        assert any("batch_skips" in m for m in msgs), msgs
+        # declared AND bumped -> clean
+        assert not any("batch_cycles" in m for m in msgs), msgs
+        assert not any("batch_packed" in m for m in msgs), msgs
 
     # -- decision-audit reason codes (nanotpu/obs/decisions.py) ------------
     REASONS_DECL = """
