@@ -3,7 +3,7 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm batch-4k sim-smoke sim-multipool sim-het sim-defrag sim-batch chaos-soak obs-check timeline-check fanout-4k image clean
+.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm batch-4k sim-smoke sim-multipool sim-het sim-defrag sim-batch sim-serve chaos-soak obs-check timeline-check fanout-4k image clean
 
 # Default verification tier: static analysis, then the fast inner loop
 # (test-fast includes sim-smoke), then the observability gate, then the
@@ -11,7 +11,7 @@ TAG ?= latest
 # certifications and the sharded 4096-host fan-out gate (FAST=1 skips
 # those three). The tier-1 gate (`pytest tests/ -m 'not slow'` over
 # everything) is unchanged — run it via `make test` / CI.
-all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag sim-batch fanout-4k batch-4k
+all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag sim-batch sim-serve fanout-4k batch-4k
 
 # nanolint (docs/static-analysis.md): AST invariant passes over the
 # scheduler's concurrency & determinism contracts — lock discipline,
@@ -189,6 +189,22 @@ sim-batch:
 	else \
 		python -m nanotpu.sim --scenario examples/sim/batch-admit.json \
 			--seed 0 --check-determinism > /dev/null; \
+	fi
+
+# Scheduler<->serving loop certification (docs/serving-loop.md): the
+# diurnal million-user trace — REAL Dealer + batch admitter + recovery
+# plane + replica autoscaler + serving tap on virtual time — run TWICE
+# (--check-determinism), then the interleaved ON-vs-OFF A/B asserts
+# (higher tokens/s-per-chip at equal-or-better TTFT p99 vs the static
+# fleet, same trace, plus the pinned SLO breach->clear edges).
+# `FAST=1 make all` skips it (same rule as sim-het).
+sim-serve:
+	@if [ "$(FAST)" = "1" ]; then \
+		echo "sim-serve: skipped (FAST=1)"; \
+	else \
+		python -m nanotpu.sim --scenario examples/sim/serve-diurnal.json \
+			--seed 0 --check-determinism > /dev/null && \
+		python -m pytest tests/test_serving_loop.py -q; \
 	fi
 
 # The gang-storm bench row on its own (docs/defrag.md): a 1024-host

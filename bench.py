@@ -1516,6 +1516,179 @@ def _gang_storm_side(enabled: bool, seed: int) -> dict:
     }
 
 
+def _serving_available() -> bool:
+    """True when this tree ships the scheduler<->serving loop — bench_ab
+    copies THIS bench file into the base worktree, where the serving
+    plane (and its scenario section) may not exist."""
+    try:
+        import nanotpu.serving.autoscale  # noqa: F401
+        import nanotpu.sim.serve  # noqa: F401
+    except ImportError:
+        return False
+    from nanotpu.sim.scenario import normalize_scenario
+
+    return "serving" in normalize_scenario(
+        {"fleet": {"pools": [{"generation": "v5p", "hosts": 1}]}}
+    )
+
+
+def _serve_loop_scenario() -> dict:
+    """One diurnal period of the serve-diurnal certification trace
+    (examples/sim/serve-diurnal.json shortened to a single 120s cycle:
+    trough -> peak -> trough exercises both scale directions). Inline —
+    the base worktree of a bench_ab run may predate the scenario file."""
+    return {
+        "name": "serve-loop-bench",
+        "fleet": {"pools": [{
+            "generation": "v5p", "hosts": 32, "slice_hosts": 8,
+            "prefix": "v5p-host",
+        }]},
+        "policy": "throughput",
+        "horizon_s": 120.0,
+        "workload": {
+            "kind": "poisson",
+            "rate_per_s": 0.4,
+            "mix": {"fractional": 1.0},
+            "lifetime_s": {"dist": "exp", "mean": 20.0},
+        },
+        "faults": {},
+        "resync_every_s": 5.0,
+        "sample_every_s": 2.0,
+        "retry_every_s": 0.5,
+        "invariant_every_events": 64,
+        "assume_ttl_s": 3.0,
+        "queue_max": 16,
+        "batch": {"enabled": True, "every_s": 0.5, "lookahead": 4,
+                  "max_batch": 64},
+        "recovery": {"enabled": True, "every_s": 1.0},
+        "serving": {
+            "enabled": True,
+            "every_s": 0.25,
+            "users": 1000000,
+            "requests_per_user_h": 1.08,
+            "diurnal": {"period_s": 120.0, "trough_frac": 0.2},
+            "tokens_out_mean": 64.0,
+            "prefill_s": 0.15,
+            "slots_per_replica": 64,
+            "tok_s_per_chip": 400.0,
+            "tok_s_per_request": 25.0,
+            "replica_percent": 400,
+            "replica_priority": 50,
+            "degraded": {"every": 4, "derate": 0.4},
+            "feedback": True,
+            "static_replicas": 14,
+            "autoscale": {
+                "enabled": True, "every_s": 1.0, "min": 2, "max": 16,
+                "target_util": 0.75, "up_cooldown_s": 0.0,
+                "down_cooldown_s": 5.0, "drain_deadline_s": 10.0,
+            },
+        },
+    }
+
+
+def _serve_loop_side(enabled: bool, seed: int) -> dict:
+    """One serve-loop sim run under the bench GC discipline (same rules
+    as the gang-storm sides: freeze, disable, assert zero gen-2
+    collections and zero renderer builds in the timed window)."""
+    import gc
+
+    from nanotpu.sim.core import Simulator
+
+    scenario = _serve_loop_scenario()
+    if not _serving_available():
+        scenario.pop("serving", None)
+    elif not enabled:
+        scenario["serving"]["autoscale"]["enabled"] = False
+        scenario["serving"]["feedback"] = False
+    sim = Simulator(scenario, seed)
+    gc.collect()
+    gc.freeze()
+    gc_before = gc.get_stats()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        report = sim.run()
+        wall = time.perf_counter() - t0
+    finally:
+        gc.enable()
+        gc_after = gc.get_stats()
+        gc.unfreeze()
+        gc.collect()
+    perf = sim.dealer.perf_totals()
+    sim.dealer.close()
+    gcd = _gc_deltas(gc_before, gc_after)
+    assert gcd["gen2_collections"] == 0, (
+        f"gen-2 GC inside the timed serve-loop window: {gcd}"
+    )
+    assert perf["renderer_builds"] == 0, (
+        "renderer builds in a payload-free sim run: "
+        f"{perf['renderer_builds']}"
+    )
+    assert report["invariants"]["violations"] == 0, (
+        report["invariants"]["first"]
+    )
+    serving = report.get("serving", {})
+    return {
+        "wall_s": round(wall, 2),
+        "events_per_s": round(report["events_processed"] / wall, 1),
+        "tok_s_per_chip": serving.get("tok_s_per_chip", 0.0),
+        "ttft_p99_ms": (serving.get("ttft_ms") or {}).get("p99"),
+        "requests_completed": (
+            serving.get("requests", {}).get("completed", 0)
+        ),
+        "replicas_peak": serving.get("replicas", {}).get("peak", 0),
+        "feedback_samples": serving.get("feedback", {}).get("samples", 0),
+        "autoscale": serving.get("autoscale", {}),
+        "gc": gcd,
+        "attr": {k: perf[k] for k in (
+            "view_builds", "renderer_builds", "native_calls",
+            "fastpath_hits", "fastpath_misses",
+        )},
+    }
+
+
+def run_serve_loop(seed: int = 0) -> dict:
+    """The scheduler<->serving loop row (docs/serving-loop.md):
+    feedback+autoscaler ON vs the static fleet over the identical
+    diurnal (scenario, seed) in one process. Virtual-time outcome
+    metrics (tokens/s-per-chip, TTFT) are deterministic;
+    ``events_per_s`` is the wall-clock throughput of the real stack
+    driving the loop — the A/B key for
+    ``make bench-ab AB_CMD=\"python bench.py --serve-rep\"``."""
+    load_start = [round(x, 2) for x in os.getloadavg()]
+    available = _serving_available()
+    on = _serve_loop_side(True, seed)
+    off = _serve_loop_side(False, seed)
+    out = {
+        "serveloop_seed": seed,
+        "serveloop_supported": int(available),
+        "serveloop_on": on,
+        "serveloop_off": off,
+        # the rate key bench_ab pairs on: wall throughput of the
+        # loop-ON side (autoscale + feedback cycles included)
+        "serveloop_events_per_s": on["events_per_s"],
+        "serveloop_host_loadavg_1m": load_start,
+    }
+    if available:
+        ratio = round(
+            on["tok_s_per_chip"] / max(off["tok_s_per_chip"], 1e-9), 3
+        )
+        out["serveloop_tok_s_per_chip_ratio"] = ratio
+        assert ratio > 1.0, (
+            f"loop ON tokens/s-per-chip ({on['tok_s_per_chip']}) must "
+            f"beat the static fleet ({off['tok_s_per_chip']})"
+        )
+        assert on["ttft_p99_ms"] <= off["ttft_p99_ms"], (
+            f"loop ON TTFT p99 ({on['ttft_p99_ms']}ms) must not exceed "
+            f"the static fleet's ({off['ttft_p99_ms']}ms)"
+        )
+        auto = on["autoscale"]
+        assert auto.get("scale_ups", 0) > 0, auto
+        assert auto.get("scale_downs", 0) > 0, auto
+        assert on["feedback_samples"] > 0
+    return out
+
+
 def run_gang_storm(seed: int = 0) -> dict:
     """The capacity-recovery write/planning row (docs/defrag.md):
     recovery ON vs OFF over the identical (scenario, seed) in one
@@ -1750,6 +1923,19 @@ if __name__ == "__main__":
         # rebuilds in the timed window) are the gate — an AssertionError
         # exits nonzero
         print(json.dumps(run_fanout_4k(reps=1, max_reps=1)))
+    elif "--serve-loop" in sys.argv:
+        # the scheduler<->serving loop row (loop on vs static fleet over
+        # one diurnal cycle); the in-bench asserts (tok/s-per-chip
+        # ratio > 1 at TTFT p99 no worse, both scale directions
+        # exercised, zero gen-2 GC / renderer builds) are the gate —
+        # an AssertionError exits nonzero
+        print(json.dumps(run_serve_loop()))
+    elif "--serve-rep" in sys.argv:
+        # one rep, for bench_ab.py's interleaved A/B protocol
+        # (AB_KEY=serveloop_events_per_s); a pre-serving base runs the
+        # same scenario with the serving section feature-detected away,
+        # so the rate key exists on both sides
+        print(json.dumps(run_serve_loop()))
     elif "--gang-storm" in sys.argv:
         # `make gang-storm`: the capacity-recovery row (recovery on vs
         # off over one scenario+seed); the in-bench asserts (wait-p99

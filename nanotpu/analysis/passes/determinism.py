@@ -48,6 +48,13 @@ SCOPE = (
     "nanotpu.sim", "nanotpu.dealer", "nanotpu.controller",
     "nanotpu.scheduler", "nanotpu.allocator", "nanotpu.recovery",
     "nanotpu.metrics.recovery",
+    # the scheduler<->serving loop (docs/serving-loop.md): the sim
+    # drives the REAL tap/source and autoscaler, so both must draw
+    # time/randomness only from what the sim injects. The engine
+    # itself stays out of scope — the sim never imports it (the
+    # virtual replica fleet stands in for it)
+    "nanotpu.serving.feedback", "nanotpu.serving.autoscale",
+    "nanotpu.metrics.serving",
     "nanotpu.k8s.objects", "nanotpu.k8s.client", "nanotpu.k8s.resilience",
     "nanotpu.k8s.events",
     "nanotpu.metrics.resilience", "nanotpu.metrics.stats",

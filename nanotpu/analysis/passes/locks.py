@@ -42,6 +42,12 @@ SCOPE = (
     "nanotpu.scheduler", "nanotpu.k8s", "nanotpu.metrics", "nanotpu.sim",
     "nanotpu.native", "nanotpu.policy", "nanotpu.utils",
     "nanotpu.analysis", "nanotpu.allocator",
+    # the replica autoscaler + serving feedback tap (docs/serving-loop.md):
+    # ReplicaAutoscaler._lock nests with nothing by contract — every
+    # client write and plane call runs outside it. The serving ENGINE
+    # stays out of scope: its _cv legitimately wraps device-blocking
+    # decode work, a different discipline than the scheduler's locks.
+    "nanotpu.serving.feedback", "nanotpu.serving.autoscale",
 )
 
 #: locks whose critical sections are the scheduling hot path: blocking

@@ -37,6 +37,14 @@ promise has three string-ly typed seams this pass stitches shut:
   produced sets and flag every gauge as an undeclared member of the
   other families.
 
+* **Serving gauges** (``nanotpu_serving_*``, docs/serving-loop.md):
+  the ``_SERVING_GAUGES`` table (``nanotpu/metrics/serving.py``) vs
+  ``ServingMetricsSource.serving_gauge_values()``
+  (``nanotpu/serving/feedback.py``) — the producer is also the
+  timeline source's ``sample()`` body, so this check pins the scrape
+  surface, the ``ext.serving.*`` tick series, and the SLO-addressable
+  fields to one table, both directions.
+
 * **Recovery counters** (``nanotpu_sched_defrag_*`` /
   ``nanotpu_gang_backfill_*``, docs/defrag.md): the exporter renders the
   ``_RECOVERY_METRICS`` table of ``nanotpu/metrics/recovery.py`` over the
@@ -268,6 +276,8 @@ class _MetricsPass:
         tlgauges_mod: Module | None = None
         slogauges: dict[str, int] | None = None
         slogauges_mod: Module | None = None
+        srvgauges: dict[str, int] | None = None
+        srvgauges_mod: Module | None = None
         for mod in modules:
             d = _declared_resilience(mod)
             if d is not None:
@@ -293,6 +303,9 @@ class _MetricsPass:
             sg = _declared_gauge_table(mod, "_SLO_GAUGES")
             if sg is not None:
                 slogauges, slogauges_mod = sg, mod
+            sv = _declared_gauge_table(mod, "_SERVING_GAUGES")
+            if sv is not None:
+                srvgauges, srvgauges_mod = sv, mod
 
         inc_sites: dict[str, tuple[str, int]] = {}
         perf_incs: dict[str, tuple[str, int]] = {}
@@ -414,6 +427,7 @@ class _MetricsPass:
             ("throughput", tgauges, tgauges_mod, "gauge_values"),
             ("timeline", tlgauges, tlgauges_mod, "tick_gauge_values"),
             ("slo", slogauges, slogauges_mod, "slo_gauge_values"),
+            ("serving", srvgauges, srvgauges_mod, "serving_gauge_values"),
         ):
             if table is not None and table_mod is not None:
                 findings.extend(self._check_gauge_table(

@@ -190,6 +190,14 @@ def build_app(argv: list[str] | None = None):
         "to PATH on SLO breach, shutdown, and process exit; "
         "faulthandler stacks land in PATH.stacks on hard crashes",
     )
+    parser.add_argument(
+        "--serving-stats-url", default="", metavar="URL",
+        help="scheduler<->serving feedback (docs/serving-loop.md): poll "
+        "a serving replica's /v1/stats at URL, export the fleet's "
+        "nanotpu_serving_* gauges, and (with --timeline-period) publish "
+        "the ext.serving.* timeline series that policy.yaml slo: "
+        "objectives can address; empty disables (zero overhead)",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -364,6 +372,28 @@ def main(argv: list[str] | None = None) -> int:
                 period_s=args.timeline_period,
             )
             telemetry_loop.start()
+
+    if args.serving_stats_url:
+        # the serving feedback surface (docs/serving-loop.md): one
+        # remote-stats provider feeds the nanotpu_serving_* gauges AND —
+        # when the timeline is on — the ext.serving.* tick series the
+        # policy.yaml slo: objectives address. The throughput-model tap
+        # (ServingTap) stays with whatever drives replica lifecycle (the
+        # sim's serving plane here; a replica controller in production)
+        # — this flag wires the measurement path, which has no
+        # write-side effects to misconfigure.
+        from nanotpu.metrics.serving import ServingExporter
+        from nanotpu.serving.feedback import (
+            RemoteStatsProvider,
+            ServingMetricsSource,
+        )
+
+        serving_source = ServingMetricsSource(
+            RemoteStatsProvider(args.serving_stats_url)
+        )
+        api.registry.register(ServingExporter(serving_source))
+        if api.timeline is not None:
+            api.timeline.register_source(serving_source)
 
     server = serve(api, args.port)
     log.info(

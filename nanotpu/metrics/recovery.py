@@ -34,6 +34,8 @@ class RecoveryCounters:
         "holes_closed",
         "backfill_leases",
         "backfill_lease_expiries",
+        "drain_leases",
+        "drain_lease_expiries",
     )
 
     def __init__(self):
@@ -66,6 +68,12 @@ class RecoveryCounters:
         #: still running (pod evicted, reason ``lease_expired``)
         self.backfill_leases = 0
         self.backfill_lease_expiries = 0
+        #: scale-down drain leases granted (a serving replica finishing
+        #: in-flight requests under a deadline, docs/serving-loop.md) and
+        #: leases that EXPIRED with requests still in flight (pod
+        #: deleted, reason ``drain_expired``)
+        self.drain_leases = 0
+        self.drain_lease_expiries = 0
 
     def snapshot(self) -> dict[str, int]:
         """Point-in-time copy (report sections / metrics render)."""
@@ -124,6 +132,16 @@ _RECOVERY_METRICS: dict[str, tuple[str, str]] = {
         "nanotpu_gang_backfill_lease_expiries_total",
         "Backfill leases that expired with the pod still running "
         "(pod evicted, reason lease_expired)",
+    ),
+    "drain_leases": (
+        "nanotpu_serving_drain_leases_total",
+        "Scale-down drain leases granted (serving replica finishing "
+        "in-flight requests under a deadline, docs/serving-loop.md)",
+    ),
+    "drain_lease_expiries": (
+        "nanotpu_serving_drain_lease_expiries_total",
+        "Drain leases that expired with requests still in flight "
+        "(replica pod deleted, reason drain_expired)",
     ),
 }
 

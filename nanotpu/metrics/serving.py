@@ -1,0 +1,92 @@
+"""``nanotpu_serving_*`` exposition: the serving fleet's scrape surface
+(docs/serving-loop.md).
+
+The gauge values come from ONE producer —
+:meth:`ServingMetricsSource.serving_gauge_values
+<nanotpu.serving.feedback.ServingMetricsSource.serving_gauge_values>` —
+which is also the timeline source's ``sample()`` body, so the scrape
+surface, the ``ext.serving.*`` tick series, and the SLO-addressable
+fields are one table that cannot drift. The nanolint
+metrics-completeness pass cross-checks :data:`_SERVING_GAUGES` against
+that producer BOTH directions (a suffix declared here but never
+produced, or produced there but never declared, is a lint finding) —
+the same honesty contract the throughput/timeline/SLO families live
+under.
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger("nanotpu.metrics.serving")
+
+_FAMILY = "nanotpu_serving_"
+
+#: gauge suffix -> help text. Keys must match
+#: ServingMetricsSource.serving_gauge_values() exactly — nanolint pins
+#: the equivalence both ways.
+_SERVING_GAUGES: dict[str, str] = {
+    "tok_s":
+        "Realized decode tokens/s EWMA across the serving fleet "
+        "(cold/compile-contaminated chunks excluded)",
+    "tok_s_per_chip":
+        "Realized decode tokens/s per allocated chip — the placement "
+        "objective the scheduler feedback loop optimizes",
+    "queue_depth":
+        "Generation requests queued and not yet admitted to a slot",
+    "active_slots":
+        "Slot-batch rows currently decoding across the fleet",
+    "slots":
+        "Total decode slots provisioned across the fleet",
+    "kv_occupancy":
+        "Fraction of KV-cache positions holding live context "
+        "(admission pressure: near 1.0 means slots are long-context)",
+    "chips":
+        "Chips currently allocated to serving replicas",
+    "replicas":
+        "Live serving replica pods (bound + draining; the autoscaler's "
+        "view when one is attached)",
+    "ttft_p99_ms":
+        "Time-to-first-token p99 over the recent request window "
+        "(milliseconds) — the SLO-addressable latency objective "
+        "(ext.serving.ttft_p99_ms)",
+}
+
+
+class ServingExporter:
+    """Registry-compatible renderer (``Registry.register``) for the
+    serving gauges. Registered exactly when a serving source is
+    attached, so deployments without one export nothing new.
+
+    The source may sit on a network poll (``RemoteStatsProvider`` over
+    a replica's ``/v1/stats``), so a failing provider must degrade to
+    ``nanotpu_serving_up 0`` instead of 500ing the WHOLE /metrics
+    exposition — losing every scheduler metric family exactly when the
+    serving fleet is unreachable would be the opposite of observability
+    (the timeline source guard makes the same call with its
+    ``{"error": 1}`` marker)."""
+
+    def __init__(self, source):
+        self.source = source
+
+    def render(self) -> list[str]:
+        up = _FAMILY + "up"
+        out: list[str] = [
+            f"# HELP {up} Whether the serving stats source answered "
+            "the last scrape (0 = provider unreachable/raising; the "
+            "value gauges below are omitted while down)",
+            f"# TYPE {up} gauge",
+        ]
+        try:
+            values = self.source.serving_gauge_values()
+        except Exception:
+            log.warning("serving stats source failed", exc_info=True)
+            out.append(f"{up} 0")
+            return out
+        out.append(f"{up} 1")
+        for suffix in sorted(_SERVING_GAUGES):
+            name = _FAMILY + suffix
+            out.append(f"# HELP {name} {_SERVING_GAUGES[suffix]}")
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {float(values[suffix])}")
+        return out

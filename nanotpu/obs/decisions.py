@@ -46,6 +46,8 @@ REASON_BACKFILLED = "backfilled"
 REASON_LEASE_EXPIRED = "lease_expired"
 REASON_SLO_BREACH = "slo_breach"
 REASON_BATCH_PACKED = "batch_packed"
+REASON_DRAINING = "draining"
+REASON_DRAIN_EXPIRED = "drain_expired"
 
 #: code -> operator-facing description. Keys must be exactly the
 #: ``REASON_*`` constants above (nanolint pins the equivalence).
@@ -93,6 +95,12 @@ REASONS: dict[str, str] = {
         "placed by a joint batch-admission solve and committed through "
         "the batch admitter (docs/batch-admission.md); the record's "
         "batch_cycle joins every pod of the same cycle",
+    REASON_DRAINING:
+        "serving replica chosen for scale-down: finishing in-flight "
+        "requests under a drain deadline lease (docs/serving-loop.md)",
+    REASON_DRAIN_EXPIRED:
+        "drain lease expired with requests still in flight; replica "
+        "pod deleted by the recovery plane's lease sweep",
 }
 
 

@@ -69,9 +69,12 @@ import time
 from dataclasses import dataclass, field
 
 from nanotpu.allocator.core import ChipResource, ChipSet, Demand
+from nanotpu.k8s.client import NotFoundError
 from nanotpu.metrics.recovery import RecoveryCounters
 from nanotpu.obs.decisions import (
     REASON_BACKFILLED,
+    REASON_DRAIN_EXPIRED,
+    REASON_DRAINING,
     REASON_LEASE_EXPIRED,
     REASON_MIGRATED,
     REASON_PREEMPTED,
@@ -233,6 +236,11 @@ class RecoveryPlane:
         self.clock = clock
         #: gang key -> Hole (read lock-free by filter_candidates)
         self.holes: dict[str, Hole] = {}
+        #: uid -> drain Lease (docs/serving-loop.md): a scale-down
+        #: victim finishing in-flight requests under a deadline; the
+        #: lease sweep DELETES an overstayer (the replica is leaving the
+        #: fleet — stripping + requeueing it would reschedule it)
+        self.drains: dict[str, Lease] = {}
 
     # -- scheduling-path read hooks ---------------------------------------
     def filter_candidates(self, pod, node_names: list[str],
@@ -323,12 +331,28 @@ class RecoveryPlane:
             return key
         return None
 
+    def note_drain(self, uid: str, pod_name: str, namespace: str,
+                   node: str, expires_at: float) -> None:
+        """Register a scale-down drain lease (docs/serving-loop.md): the
+        replica autoscaler's victim keeps serving its in-flight requests
+        until ``expires_at``; past it the lease sweep deletes the pod.
+        Idempotent per uid (the autoscaler may re-report a drain)."""
+        if uid in self.drains:
+            return
+        self.drains[uid] = Lease(
+            uid=uid, pod_name=pod_name, namespace=namespace,
+            node=node, expires_at=expires_at, gang_key="",
+        )
+        self.counters.drain_leases += 1
+        self._audit(uid, f"{namespace}/{pod_name}", node, REASON_DRAINING)
+
     def pod_gone(self, uid: str) -> None:
         """Departure/eviction cleanup: drop any lease the pod held."""
         for key in sorted(self.holes):
             hole = self.holes.get(key)
             if hole is not None:
                 hole.leases.pop(uid, None)
+        self.drains.pop(uid, None)
 
     def gang_bound(self, gang_key: str) -> None:
         """The gang fully bound: its hole (and remaining leases) close."""
@@ -359,6 +383,7 @@ class RecoveryPlane:
         return {
             "holes": len(detail),
             "leases": sum(d["leases"] for d in detail.values()),
+            "drains": len(self.drains),
             "gangs": detail,
             "counters": self.counters.snapshot(),
         }
@@ -386,6 +411,7 @@ class RecoveryPlane:
             "migrate": self.config.migration_budget,
         }
 
+        self._sweep_drains(now, actions)
         self._sweep_leases(now, actions, evicted)
         gangs = self._parked_by_gang(parked)
         self._sweep_holes(now, gangs, actions)
@@ -453,6 +479,52 @@ class RecoveryPlane:
             if pod.node_name:
                 by_node.setdefault(pod.node_name, []).append(pod)
         return by_node
+
+    def _sweep_drains(self, now: float, actions) -> None:
+        """Enforce scale-down drain deadlines: a draining replica still
+        tracked past its lease expiry is DELETED through the resilient
+        client (not stripped-and-requeued — it is leaving the fleet).
+        A failed delete keeps the lease so the next cycle retries, the
+        same nothing-changed contract as ``_evict``."""
+        for uid in sorted(self.drains):
+            lease = self.drains[uid]
+            if not self.dealer.tracks(uid):
+                self.drains.pop(uid, None)  # drained/deleted on its own
+                continue
+            if now < lease.expires_at:
+                continue
+            client = self.dealer.client
+            try:
+                fresh = client.get_pod(lease.namespace, lease.pod_name)
+            except NotFoundError:
+                self.drains.pop(uid, None)  # already gone
+                continue
+            except Exception as e:
+                # transient read failure (brownout, timeout): KEEP the
+                # lease and retry next cycle — dropping it here would
+                # silently cancel the deadline on a replica that may be
+                # wedged (same nothing-changed contract as _sweep_leases)
+                log.warning("drain-lease probe of %s/%s failed: %s",
+                            lease.namespace, lease.pod_name, e)
+                continue
+            if fresh.uid != uid:
+                self.drains.pop(uid, None)  # name reused
+                continue
+            try:
+                client.delete_pod(lease.namespace, lease.pod_name)
+            except Exception as e:
+                log.warning("drain-lease delete of %s/%s failed: %s",
+                            lease.namespace, lease.pod_name, e)
+                continue
+            self.counters.drain_lease_expiries += 1
+            self._audit(
+                uid, f"{lease.namespace}/{lease.pod_name}", lease.node,
+                REASON_DRAIN_EXPIRED,
+            )
+            actions.append((
+                "drain-expire", f"{lease.pod_name} @ {lease.node}",
+            ))
+            self.drains.pop(uid, None)
 
     def _sweep_leases(self, now: float, actions, evicted) -> None:
         for key in sorted(self.holes):
@@ -823,6 +895,9 @@ class RecoveryPlane:
             hole = self.holes.get(key)
             if hole is not None:
                 out.update(hole.leases)
+        # draining replicas are leaving the fleet: migrating one would
+        # replay a placement that is about to be deleted
+        out.update(self.drains)
         return out
 
     def _migration_target(self, pod, source: str, infos,

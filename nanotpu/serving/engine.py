@@ -901,6 +901,12 @@ class Engine:
         # stats (served by /metrics and /v1/stats)
         self.requests_total = 0
         self.tokens_total = 0
+        #: realized decode tokens/s EWMA over timed chunks (cold/compile-
+        #: contaminated chunks dropped, same rule as the bandit) — the
+        #: feedback signal the scheduler's ThroughputModel consumes via
+        #: the serving tap (docs/serving-loop.md). None until the first
+        #: warm chunk; read/written under self._cv.
+        self.tok_s_ewma: float | None = None
         #: MoE only: tokens dropped by expert-capacity pressure during
         #: admission prefills (decode routes at full capacity — only the
         #: padded-bucket prefill can drop; see prefill_request)
@@ -1131,7 +1137,48 @@ class Engine:
             self._cv.notify()
         self._thread.join(timeout=10)
 
+    def metrics(self) -> dict:
+        """Cheap feedback snapshot (docs/serving-loop.md): the fields the
+        scheduler's timeline source, the ``nanotpu_serving_*`` gauges,
+        and the throughput-model tap consume. Host-side state only — no
+        device sync, no jit: safe to call from a scrape thread at any
+        rate. Key set is the serving-provider contract shared with the
+        sim's virtual replica fleet (pinned by tests), so SLO objectives
+        addressing ``ext.serving.*`` mean the same thing against either
+        producer."""
+        from nanotpu.metrics.stats import percentile
+
+        with self._cv:
+            queued = len(self._queue)
+            tok_s = self.tok_s_ewma
+            ttft_p99 = percentile(list(self.ttft_samples), 0.99)
+        active = 0
+        kv_used = 0
+        for req in self._slot_req:
+            if req is None:
+                continue
+            active += 1
+            kv_used += min(self.max_len, len(req.prompt) + len(req.out))
+        chips = self.mesh.devices.size if self.mesh is not None else 1
+        return {
+            "tok_s": round(tok_s, 4) if tok_s is not None else 0.0,
+            "queue_depth": float(queued),
+            "active": float(active),
+            "slots": float(self.slots),
+            "kv_occupancy": round(
+                kv_used / (self.slots * self.max_len), 6
+            ),
+            "chips": float(chips),
+            "ttft_p99_ms": (
+                round(ttft_p99 * 1e3, 2) if ttft_p99 is not None else 0.0
+            ),
+        }
+
     def stats(self) -> dict:
+        # ONE metrics() snapshot feeds the feedback fields below, so
+        # /v1/stats and the provider contract stay definitionally
+        # identical (metrics() takes _cv itself — call it before ours)
+        m = self.metrics()
         # snapshot the sample deques under the same lock the engine loop
         # appends under — sorting a deque another thread mutates raises
         # RuntimeError, which would 500 /v1/stats under live traffic
@@ -1153,6 +1200,11 @@ class Engine:
             "slots": self.slots,
             "active": active,
             "queued": queued,
+            # feedback surface (metrics()): the remote serving source the
+            # scheduler polls reads these three off /v1/stats
+            "tok_s": m["tok_s"],
+            "kv_occupancy": m["kv_occupancy"],
+            "chips": int(m["chips"]),
             "requests_total": self.requests_total,
             "tokens_total": self.tokens_total,
             "moe_prefill_dropped_total": self.moe_prefill_dropped_total,
@@ -1601,6 +1653,21 @@ class Engine:
             n_active, k, self.tokens_total - toks_before, dt_chunk,
             flavor=flavor, cold=cold,
         )
+        emitted = self.tokens_total - toks_before
+        if not cold and emitted > 0 and dt_chunk > 0:
+            # realized tokens/s EWMA, every policy (the bandit's table is
+            # measured-mode-only and per-(bucket, flavor); this is the one
+            # whole-engine rate the feedback tap and /v1/stats consume).
+            # Cold chunks are dropped for the same reason as in
+            # _bandit_update: their dt is about the compiler.
+            rate = emitted / dt_chunk
+            with self._cv:  # metrics()/stats() read concurrently
+                cur = self.tok_s_ewma
+                self.tok_s_ewma = (
+                    rate if cur is None
+                    else (1 - self.BANDIT_ALPHA) * cur
+                    + self.BANDIT_ALPHA * rate
+                )
 
     def _loop(self) -> None:
         while True:

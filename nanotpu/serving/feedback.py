@@ -1,0 +1,199 @@
+"""Serving→scheduler feedback: close the placement loop (docs/serving-loop.md).
+
+The serving engine measures its own decode tokens/s (the speculation
+bandit's realized-rate EWMAs, ``Engine.metrics()``), and the scheduler's
+:class:`~nanotpu.allocator.throughput.ThroughputModel` already calibrates
+per-card contention online from every usage write
+``Dealer.update_chip_usage`` ingests — but until this module the two
+never met: placement was calibrated by chip-load proxies while the real
+objective (tokens/s-per-chip, TTFT) went unmeasured. DOPPLER (PAPERS.md,
+dual-policy device assignment learned from measured throughput) is the
+reference for why the measured rate, not the load proxy, should drive
+assignment.
+
+Two pieces:
+
+* :class:`ServingTap` — the metric-sync-style ingestion path. One
+  replica sample is ``(node, chips, measured tok/s, expected tok/s)``;
+  the tap converts the *shortfall* ``1 - measured/expected`` into the
+  per-card load signal and writes it through the EXACT metric-sync
+  discipline: ``Dealer.update_chip_usage(..., publish=False)`` per card,
+  one ``publish_usage`` per batch. Everything downstream is the existing
+  machinery, untouched: the model's ``observe`` EWMA + version bump, the
+  Q16 native mirror resync, arena memo retirement, and the decision
+  ledger's per-term breakdowns all reprice from measured serving
+  throughput with ZERO new hot-path code (the parity pin in
+  tests/test_autoscale.py holds a tap sample byte-equal to a metric-sync
+  sample end to end).
+
+* :class:`ServingMetricsSource` — the PR-11 ``TimelineSource`` for the
+  serving fleet. ``sample()`` returns exactly the ``nanotpu_serving_*``
+  gauge values (one producer, one honesty contract — the nanolint
+  metrics-completeness pass pins :data:`_SERVING_GAUGES
+  <nanotpu.metrics.serving._SERVING_GAUGES>` against
+  :meth:`serving_gauge_values` both directions), so SLO objectives in
+  policy.yaml's ``slo:`` section address ``ext.serving.tok_s_per_chip``
+  / ``ext.serving.queue_depth`` like any built-in series.
+
+The *provider* duck protocol: anything with ``metrics() -> dict``
+carrying ``tok_s, queue_depth, active, slots, kv_occupancy, chips`` —
+the real :class:`~nanotpu.serving.engine.Engine`, the sim's virtual
+replica fleet (:mod:`nanotpu.sim.serve`), or
+:class:`RemoteStatsProvider` polling a replica's ``/v1/stats`` over
+HTTP. The key set is pinned by tests so every producer means the same
+thing.
+
+Determinism: no ambient clock or rng — ``ingest`` takes the injectable
+``now`` the sim threads through, and the source only reads its
+provider, so both run under the nanolint sim-determinism pass.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+from dataclasses import dataclass
+
+log = logging.getLogger("nanotpu.serving.feedback")
+
+
+@dataclass(frozen=True)
+class ReplicaSample:
+    """One replica's measured decode rate against its placement.
+
+    ``chips`` are the card indices the replica's pod holds on ``node``
+    (the dealer's assigned-chip annotation); ``expected_tok_s`` is the
+    uncontended rate this placement should sustain (table value x
+    per-chip rate) — the denominator that turns a measurement into a
+    calibration signal."""
+
+    node: str
+    chips: tuple[int, ...]
+    measured_tok_s: float
+    expected_tok_s: float
+
+    def shortfall(self) -> float:
+        """``1 - measured/expected`` clamped to [0, 1]: the fraction of
+        this placement's modeled throughput the replica is NOT getting —
+        fed as the per-card load so the model's contention EWMA (and the
+        Q16 contention term) prices it exactly like observed co-residency
+        heat."""
+        if self.expected_tok_s <= 0:
+            return 0.0
+        return min(1.0, max(
+            0.0, 1.0 - self.measured_tok_s / self.expected_tok_s
+        ))
+
+
+class ServingTap:
+    """Feed measured serving throughput into the scheduler's online
+    calibration — the metric-sync-style write path (module docstring)."""
+
+    def __init__(self, dealer):
+        self.dealer = dealer
+        #: replica samples ingested (all-time; introspection/tests)
+        self.samples_ingested = 0
+        #: per-card usage writes issued (chips x samples)
+        self.cards_observed = 0
+
+    def ingest(self, samples, now: float | None = None) -> int:
+        """Write one batch of :class:`ReplicaSample`s through
+        ``update_chip_usage(..., publish=False)`` + ONE
+        ``publish_usage`` — the same batching discipline the metric-sync
+        sweep uses, so a tap batch costs one snapshot publish, not one
+        view clone per card. Samples are applied in sorted (node, chips)
+        order so ingestion is deterministic regardless of caller
+        iteration order. Returns the number of samples applied."""
+        applied = 0
+        touched: set[str] = set()
+        for sample in sorted(
+            samples, key=lambda s: (s.node, s.chips)
+        ):
+            if not sample.chips:
+                continue
+            load = sample.shortfall()
+            for chip in sample.chips:
+                self.dealer.update_chip_usage(
+                    sample.node, chip, core=load, now=now, publish=False,
+                )
+                self.cards_observed += 1
+            touched.add(sample.node)
+            applied += 1
+        if touched:
+            self.dealer.publish_usage(tuple(sorted(touched)))
+        self.samples_ingested += applied
+        return applied
+
+
+class ServingMetricsSource:
+    """The serving fleet's ``TimelineSource`` (PR-11 duck protocol:
+    ``.name`` + ``.sample()``) AND the ``nanotpu_serving_*`` gauge
+    producer — one body so the timeline's ``ext.serving.*`` series and
+    the scrape surface can never drift."""
+
+    def __init__(self, provider, name: str = "serving", replicas=None):
+        self.provider = provider
+        self.name = name
+        #: callable -> live replica count (the autoscaler's view), or
+        #: None when no replica controller is attached (gauge reads 0
+        #: unless the provider itself reports a fleet size)
+        self._replicas = replicas
+
+    def serving_gauge_values(self) -> dict:
+        """The unlabeled ``nanotpu_serving_*`` gauge values, keyed by
+        metric suffix. Keys must match ``_SERVING_GAUGES`` in
+        nanotpu/metrics/serving.py exactly — the nanolint
+        metrics-completeness pass pins the equivalence both ways, the
+        same honesty contract the throughput/timeline/SLO gauges live
+        under."""
+        m = self.provider.metrics()
+        chips = float(m.get("chips", 0) or 0)
+        tok_s = float(m.get("tok_s", 0) or 0.0)
+        if self._replicas is not None:
+            replicas = float(self._replicas())
+        else:
+            replicas = float(m.get("replicas", 0) or 0)
+        return {
+            "tok_s": round(tok_s, 4),
+            "tok_s_per_chip": round(tok_s / chips, 4) if chips else 0.0,
+            "queue_depth": float(m.get("queue_depth", 0) or 0),
+            "active_slots": float(m.get("active", 0) or 0),
+            "slots": float(m.get("slots", 0) or 0),
+            "kv_occupancy": round(float(m.get("kv_occupancy", 0) or 0), 6),
+            "chips": chips,
+            "replicas": replicas,
+            "ttft_p99_ms": round(
+                float(m.get("ttft_p99_ms", 0) or 0), 2
+            ),
+        }
+
+    def sample(self) -> dict:
+        return self.serving_gauge_values()
+
+
+class RemoteStatsProvider:
+    """Provider over a replica's ``/v1/stats`` endpoint — the
+    production transport for a scheduler-side timeline source
+    (``cmd/main --serving-stats-url``). A failed poll raises; the
+    timeline's source guard turns that into an honest ``{"error": 1}``
+    section instead of a stalled last-good value."""
+
+    def __init__(self, url: str, timeout_s: float = 2.0):
+        self.url = url
+        self.timeout_s = float(timeout_s)
+
+    def metrics(self) -> dict:
+        with urllib.request.urlopen(
+            self.url, timeout=self.timeout_s
+        ) as resp:
+            stats = json.load(resp)
+        return {
+            "tok_s": stats.get("tok_s", 0) or 0,
+            "queue_depth": stats.get("queued", 0) or 0,
+            "active": stats.get("active", 0) or 0,
+            "slots": stats.get("slots", 0) or 0,
+            "kv_occupancy": stats.get("kv_occupancy", 0) or 0,
+            "chips": stats.get("chips", 1) or 1,
+            "ttft_p99_ms": stats.get("ttft_p99_ms", 0) or 0,
+        }

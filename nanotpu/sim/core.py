@@ -99,6 +99,12 @@ class Simulator:
         # can never shift a sibling stream (same isolation rule as
         # rng_overload; pinned by the defrag toggle test in test_sim.py)
         self.rng_defrag = random.Random(base + 7)
+        # the serving plane's dedicated stream (docs/serving-loop.md):
+        # diurnal arrival-count jitter + per-cohort output-length draws
+        # live here exclusively, so toggling `serving.enabled` (or the
+        # autoscaler/feedback inside it) can never shift the base
+        # workload's arrival or lifetime draws (same isolation rule)
+        self.rng_serve = random.Random(base + 8)
 
         self.client = make_fleet(self.scenario["fleet"])
         self.faults = FaultPlan(self.scenario["faults"], self.rng_fault)
@@ -186,6 +192,51 @@ class Simulator:
             )
         else:
             self.timeline = self.watchdog = self.flight = None
+        # scheduler<->serving loop (docs/serving-loop.md): a virtual
+        # replica fleet served on the diurnal trace, with the REAL
+        # autoscaler deciding fleet size and the REAL serving tap
+        # feeding measured tok/s into the throughput model. Like the
+        # recovery plane it survives agent restarts (replicas/queue are
+        # workload state, not dealer state) — _build_stack rewires the
+        # tap's dealer. None when disabled; every hook gates on that,
+        # so default-path digests are byte-identical.
+        srv = self.scenario["serving"]
+        if srv["enabled"]:
+            from nanotpu.serving.feedback import (
+                ServingMetricsSource,
+                ServingTap,
+            )
+            from nanotpu.sim.serve import ServeSim
+
+            tap = ServingTap(self.dealer) if srv["feedback"] else None
+            self.serve = ServeSim(
+                srv, self.client, self.rng_serve, tap=tap
+            )
+            if srv["autoscale"]["enabled"]:
+                from nanotpu.serving.autoscale import ReplicaAutoscaler
+
+                self.autoscaler = ReplicaAutoscaler(
+                    self.client, self._autoscale_config(),
+                    plane=self.plane, clock=lambda: self.now,
+                    uid_of=self._uid,
+                )
+            else:
+                self.autoscaler = None
+            self.serve_source = ServingMetricsSource(
+                self.serve,
+                replicas=(
+                    self.autoscaler.replica_count
+                    if self.autoscaler is not None
+                    else self.serve.bound_replicas
+                ),
+            )
+            if self.timeline is not None:
+                # the PR-11 TimelineSource registration: serving series
+                # land under ext.serving.* and are SLO-addressable with
+                # zero timeline changes
+                self.timeline.register_source(self.serve_source)
+        else:
+            self.serve = self.autoscaler = self.serve_source = None
         # the informer tap: the sim owns the watches and feeds the REAL
         # controller handlers, with the fault layer in between
         self._pod_watch = self.client.watch_pods()
@@ -254,6 +305,11 @@ class Simulator:
             # intent, not dealer state) and points at the fresh dealer
             plane.dealer = self.dealer
             self.dealer.recovery = plane
+        serve = getattr(self, "serve", None)
+        if serve is not None and serve.tap is not None:
+            # agent restart: the serving tap writes through the fresh
+            # dealer (the fleet/queue state is the run's workload)
+            serve.tap.dealer = self.dealer
         timeline = getattr(self, "timeline", None)
         if timeline is not None:
             # agent restart: telemetry is the run's measurement — the
@@ -371,6 +427,23 @@ class Simulator:
             while t < horizon:
                 self._push(t, "batch_admit", None)
                 t += bat["every_s"]
+        srv = self.scenario["serving"]
+        if srv["enabled"]:
+            t = srv["every_s"]
+            while t < horizon:
+                self._push(t, "serving_tick", None)
+                t += srv["every_s"]
+            if srv["autoscale"]["enabled"]:
+                # cycle 0 at t=0 bootstraps min_replicas before the
+                # first serving tick — the same cold start the static
+                # fleet's t=0 bootstrap gets, so an ON-vs-OFF A/B
+                # compares ramps, not boot order
+                t = 0.0
+                while t < horizon:
+                    self._push(t, "autoscale_cycle", None)
+                    t += srv["autoscale"]["every_s"]
+            else:
+                self._push(0.0, "serve_bootstrap", None)
         metric_every, metric_delay = self.faults.metric_cadence()
         if metric_every > 0:
             t = metric_every
@@ -421,6 +494,12 @@ class Simulator:
             self._on_telemetry()
         elif kind == "batch_admit":
             self._on_batch_admit()
+        elif kind == "serving_tick":
+            self._on_serving_tick()
+        elif kind == "autoscale_cycle":
+            self._on_autoscale()
+        elif kind == "serve_bootstrap":
+            self._on_serve_bootstrap()
         else:  # pragma: no cover - event kinds are closed within this file
             raise AssertionError(f"unknown event kind {kind}")
 
@@ -967,6 +1046,132 @@ class Simulator:
                 self.now, f"batch-bind-error {pod.name}"
             )
 
+    # -- the scheduler<->serving loop (docs/serving-loop.md) -----------------
+    def _autoscale_config(self):
+        from nanotpu.serving.autoscale import AutoscaleConfig
+
+        srv = self.scenario["serving"]
+        a = srv["autoscale"]
+        return AutoscaleConfig(
+            min_replicas=a["min"], max_replicas=a["max"],
+            slots_per_replica=srv["slots_per_replica"],
+            target_utilization=a["target_util"],
+            up_cooldown_s=a["up_cooldown_s"],
+            down_cooldown_s=a["down_cooldown_s"],
+            drain_deadline_s=a["drain_deadline_s"],
+            replica_percent=srv["replica_percent"],
+            priority=srv["replica_priority"],
+        )
+
+    def _sync_replicas(self) -> None:
+        """Mirror the cluster's replica-pod state into the virtual fleet:
+        a bind activates the replica (capacity from its node's
+        generation), a vanished pod (drain complete, drain-lease kill,
+        flap eviction) requeues its in-flight cohorts. The cluster is
+        the source of truth — the same contract the autoscaler's
+        reconcile lives under — so the fluid model can never serve on a
+        placement the scheduler does not hold."""
+        for name in sorted(self.serve.replicas):
+            try:
+                pod = self.client.get_pod("default", name)
+            except Exception:
+                self.serve.replica_gone(name)
+                self._pod_job.pop(name, None)
+                if name in self._pending:
+                    self._pending.remove(name)
+                continue
+            if pod.node_name:
+                # the dealer's per-container assignment annotation names
+                # the ACTUAL cards the replica holds — the tap must
+                # reprice those, not a fabricated 0..n-1 (a sub-host
+                # replica sharing a host with a sibling would otherwise
+                # write its shortfall onto the co-resident's cards)
+                ann = pod.annotations.get(
+                    types.ANNOTATION_CONTAINER_FMT.format(name="decode"),
+                    "",
+                )
+                chips = tuple(
+                    int(c) for c in ann.split(",") if c.strip().isdigit()
+                )
+                self.serve.replica_bound(name, pod.node_name, chips)
+
+    def _admit_replica_pod(self, pod: Pod) -> None:
+        """Admission for a replica pod the autoscaler (or the static
+        bootstrap) already created in the cluster: it enters the normal
+        scheduling path as a single-pod job with no departure — the
+        replica's lifetime belongs to the autoscaler, not the workload.
+        With the batch admitter on, scale-ups park in the pending queue
+        and the next batch_admit cycle places the whole step in ONE
+        joint native solve (docs/batch-admission.md); without it they
+        schedule pod-at-a-time inline."""
+        job = Job(
+            id=len(self.jobs), config="serve", arrival_t=self.now,
+            lifetime_s=0.0, gang=None, pods=[pod],
+            departure_scheduled=True,
+        )
+        self.jobs.append(job)
+        self._pod_job[pod.name] = job
+        self.report.pods["arrived"] += 1
+        self.report.config_count("serve", "arrived")
+        self.report.journal(self.now, f"serve-replica {pod.name}")
+        if self.admitter is not None:
+            self._pending.append(pod.name)
+        elif not self._try_schedule(job, pod):
+            self._pending.append(pod.name)
+
+    def _on_serving_tick(self) -> None:
+        """Advance the virtual serving fleet by one tick: sync replica
+        state from the cluster, then arrivals -> decode -> completions ->
+        admissions on the fluid model — which also feeds the serving tap
+        (measured tok/s into the ThroughputModel) when feedback is on.
+        The tick summary is journaled, so the whole serving trajectory
+        is part of the determinism digest."""
+        self._sync_replicas()
+        s = self.serve.tick(self.now, self.scenario["serving"]["every_s"])
+        self.report.journal(
+            self.now,
+            f"serve arrivals={s['arrivals']} queued={s['queued']} "
+            f"active={s['active']} replicas={s['replicas']} "
+            f"tokens={s['tokens']} completed={s['completed']}",
+        )
+
+    def _on_autoscale(self) -> None:
+        """One autoscale cycle on virtual time: the REAL
+        ReplicaAutoscaler decides against the fleet's demand snapshot;
+        the sim routes its pod writes back through the event loop —
+        scale-ups into the admission path, drains into the virtual
+        fleet's no-new-work state, deletes into cohort requeue."""
+        self._sync_replicas()
+        result = self.autoscaler.run_once(self.now, self.serve.signal())
+        for kind, detail in result["actions"]:
+            self.report.journal(self.now, f"{kind} {detail}")
+        for name in result["draining"]:
+            self.serve.drain(name)
+        for name, _uid in result["deleted"]:
+            self.serve.replica_gone(name)
+            self._pod_job.pop(name, None)
+            if name in self._pending:
+                self._pending.remove(name)
+        for pod in result["created"]:
+            self.serve.register_pending(pod.name)
+            self._admit_replica_pod(pod)
+
+    def _on_serve_bootstrap(self) -> None:
+        """Static fleet (autoscaler OFF — the A/B control): submit
+        ``static_replicas`` replica pods once at t=0, byte-identical
+        specs to the autoscaler's (shared make_replica_pod), so the
+        ON-vs-OFF comparison is pure policy, not pod shape."""
+        from nanotpu.serving.autoscale import make_replica_pod
+
+        cfg = self._autoscale_config()
+        for i in range(1, self.scenario["serving"]["static_replicas"] + 1):
+            name = f"{cfg.pod_prefix}-{i}"
+            pod = self.client.create_pod(
+                make_replica_pod(name, cfg, uid=self._uid())
+            )
+            self.serve.register_pending(name)
+            self._admit_replica_pod(pod)
+
     def _on_assume_sweep(self) -> None:
         expired = self.controller.sweep_assumed_once(
             self.scenario["assume_ttl_s"], now=self.now
@@ -1176,6 +1381,32 @@ class Simulator:
                 f"migrated={counters['migrated_pods']} "
                 f"backfilled={counters['backfill_leases']} "
                 f"lease_expired={counters['backfill_lease_expiries']}",
+            )
+        if self.serve is not None:
+            # deterministic serving section (docs/serving-loop.md): the
+            # certification metrics — tokens/s-per-chip, TTFT
+            # percentiles, replica trajectory, feedback sample counts —
+            # all derived from virtual time and the dedicated rng_serve
+            # stream, so the section (and its journal line) joins the
+            # determinism contract like recovery/timeline
+            self._sync_replicas()
+            summary = self.serve.summary()
+            if self.autoscaler is not None:
+                a = self.autoscaler.status()
+                summary["autoscale"] = {
+                    k: a[k] for k in (
+                        "scale_ups", "scale_downs", "drains_started",
+                        "drains_completed", "drain_kills",
+                    )
+                }
+            self.report.serving = summary
+            self.report.journal(
+                horizon,
+                f"serving tok_s_per_chip={summary['tok_s_per_chip']} "
+                f"ttft_p99_ms={summary['ttft_ms']['p99']} "
+                f"completed={summary['requests']['completed']} "
+                f"replicas={summary['replicas']['final']} "
+                f"feedback_samples={summary['feedback']['samples']}",
             )
 
 

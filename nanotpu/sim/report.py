@@ -73,6 +73,11 @@ class ReportBuilder:
         #: docs/observability.md); empty == telemetry disabled, same
         #: opt-in digest rule as throughput/recovery
         self.timeline: dict = {}
+        #: scheduler<->serving loop summary (requests, tokens/s-per-chip,
+        #: TTFT percentiles, replica trajectory, feedback sample counts,
+        #: autoscale action counters — docs/serving-loop.md); empty ==
+        #: serving disabled, same opt-in digest rule as the sections above
+        self.serving: dict = {}
         self.restart_occupancy_drift = 0.0
         self.final_occupancy = 0.0
         self.final_fragmentation = 0.0
@@ -174,6 +179,10 @@ class ReportBuilder:
                     if isinstance(v, dict) else v
                 )
             report["timeline"] = tl
+        if self.serving:
+            # same opt-in rule (docs/serving-loop.md); render() sorts
+            # keys globally, so nested sections need no manual ordering
+            report["serving"] = self.serving
         if include_timing:
             report["timing"] = {
                 "note": "wall-clock; excluded from the determinism contract",

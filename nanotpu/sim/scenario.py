@@ -100,6 +100,30 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
         "lookahead": 4,              # best-fit finalists per pick
         "max_batch": 128             # demands per joint solve
       },
+      "serving": {                   # scheduler<->serving loop
+                                     # (docs/serving-loop.md); absent/
+                                     # disabled keeps every existing
+                                     # digest byte-identical
+        "enabled": false,
+        "every_s": 0.25,             # serving_tick cadence (virtual)
+        "users": 1000000,            # synthetic user base
+        "requests_per_user_h": 1.08, # per-user request rate at PEAK
+        "diurnal": {"period_s": 120.0, "trough_frac": 0.2},
+        "tokens_out_mean": 64.0,     # drawn decode length per request
+        "prefill_s": 0.15,           # admission prefill latency
+        "slots_per_replica": 64,
+        "tok_s_per_chip": 350.0,     # v5p-normalized decode rate
+        "tok_s_per_request": 25.0,   # single-row decode ceiling
+        "replica_percent": 400,      # chips per replica pod (tp=4)
+        "replica_priority": 50,
+        "degraded": {"every": 0, "derate": 0.5},  # hidden host derate
+        "feedback": true,            # serving tap -> ThroughputModel
+        "static_replicas": 0,        # fixed fleet when autoscale off
+        "autoscale": {"enabled": true, "every_s": 0.5, "min": 1,
+                      "max": 16, "target_util": 0.75,
+                      "up_cooldown_s": 0.0, "down_cooldown_s": 5.0,
+                      "drain_deadline_s": 10.0}
+      },
       "lock_witness": false,         # true: instrument every lock and
                                      # assert acquisition-order acyclicity
                                      # at teardown (docs/static-analysis.md)
@@ -295,6 +319,90 @@ def normalize_scenario(raw: dict) -> dict:
         "batch.lookahead and batch.max_batch must be >= 1",
     )
 
+    srv = dict(raw.get("serving") or {})
+    asc = dict(srv.get("autoscale") or {})
+    diurnal = dict(srv.get("diurnal") or {})
+    degraded = dict(srv.get("degraded") or {})
+    serving = {
+        "enabled": bool(srv.get("enabled", False)),
+        "every_s": float(srv.get("every_s", 0.25)),
+        "users": int(srv.get("users", 1_000_000)),
+        "requests_per_user_h": float(srv.get("requests_per_user_h", 1.08)),
+        "diurnal": {
+            "period_s": float(diurnal.get("period_s", 120.0)),
+            "trough_frac": float(diurnal.get("trough_frac", 0.2)),
+        },
+        "tokens_out_mean": float(srv.get("tokens_out_mean", 64.0)),
+        "prefill_s": float(srv.get("prefill_s", 0.15)),
+        "slots_per_replica": int(srv.get("slots_per_replica", 64)),
+        "tok_s_per_chip": float(srv.get("tok_s_per_chip", 350.0)),
+        "tok_s_per_request": float(srv.get("tok_s_per_request", 25.0)),
+        "replica_percent": int(srv.get("replica_percent", 400)),
+        "replica_priority": int(srv.get("replica_priority", 50)),
+        "degraded": {
+            "every": int(degraded.get("every", 0)),
+            "derate": float(degraded.get("derate", 0.5)),
+        },
+        "feedback": bool(srv.get("feedback", True)),
+        "static_replicas": int(srv.get("static_replicas", 0)),
+        "autoscale": {
+            "enabled": bool(asc.get("enabled", True)),
+            "every_s": float(asc.get("every_s", 0.5)),
+            "min": int(asc.get("min", 1)),
+            "max": int(asc.get("max", 16)),
+            "target_util": float(asc.get("target_util", 0.75)),
+            "up_cooldown_s": float(asc.get("up_cooldown_s", 0.0)),
+            "down_cooldown_s": float(asc.get("down_cooldown_s", 5.0)),
+            "drain_deadline_s": float(asc.get("drain_deadline_s", 10.0)),
+        },
+    }
+    if serving["enabled"]:
+        _require(serving["every_s"] > 0,
+                 "serving.every_s must be > 0 when serving is enabled")
+        _require(
+            serving["users"] > 0 and serving["requests_per_user_h"] > 0,
+            "serving.users and serving.requests_per_user_h must be > 0",
+        )
+        _require(serving["diurnal"]["period_s"] > 0,
+                 "serving.diurnal.period_s must be > 0")
+        _require(0.0 <= serving["diurnal"]["trough_frac"] <= 1.0,
+                 "serving.diurnal.trough_frac must be in [0, 1]")
+        _require(
+            serving["tokens_out_mean"] > 0
+            and serving["tok_s_per_chip"] > 0
+            and serving["tok_s_per_request"] > 0,
+            "serving token rates must be > 0",
+        )
+        _require(
+            serving["slots_per_replica"] >= 1,
+            "serving.slots_per_replica must be >= 1",
+        )
+        pct = serving["replica_percent"]
+        _require(
+            pct > 0 and (pct < 100 or pct % 100 == 0),
+            "serving.replica_percent must be a valid chip demand",
+        )
+        _require(
+            0.0 <= serving["degraded"]["derate"] < 1.0,
+            "serving.degraded.derate must be in [0, 1)",
+        )
+        a = serving["autoscale"]
+        if a["enabled"]:
+            _require(
+                a["every_s"] > 0 and 0 <= a["min"] <= a["max"],
+                "serving.autoscale needs every_s > 0 and 0 <= min <= max",
+            )
+            _require(
+                0.0 < a["target_util"] <= 1.0,
+                "serving.autoscale.target_util must be in (0, 1]",
+            )
+        else:
+            _require(
+                serving["static_replicas"] >= 1,
+                "serving.static_replicas must be >= 1 when the "
+                "autoscaler is off (a serving scenario needs a fleet)",
+            )
+
     rec = dict(raw.get("recovery") or {})
     recovery = {
         "enabled": bool(rec.get("enabled", False)),
@@ -338,6 +446,7 @@ def normalize_scenario(raw: dict) -> dict:
         "batch": batch,
         "recovery": recovery,
         "telemetry": telemetry,
+        "serving": serving,
         "metric_from_allocation": bool(
             raw.get("metric_from_allocation", False)
         ),

@@ -1,0 +1,430 @@
+"""Virtual serving fleet: the diurnal million-user certification plant.
+
+The sim cannot run the real JAX engine, but it CAN run everything the
+scheduler↔serving loop is made of against a *fluid-model* replica fleet:
+the REAL Dealer places the replica pods, the REAL batch admitter admits
+scale-ups, the REAL recovery plane sweeps drain leases, the REAL
+:class:`~nanotpu.serving.autoscale.ReplicaAutoscaler` decides fleet
+size, and the REAL :class:`~nanotpu.serving.feedback.ServingTap` feeds
+measured tokens/s into the REAL
+:class:`~nanotpu.allocator.throughput.ThroughputModel`. Only the decode
+arithmetic is virtual (docs/serving-loop.md "The trace model"):
+
+* **Demand** — a diurnal cosine rate curve over ``users`` synthetic
+  users (``rate(t) = peak x (trough_frac + (1-trough_frac) x
+  (1 - cos(2πt/P))/2)``, starting at the trough). Arrivals aggregate
+  into per-tick *cohorts* (one arrival timestamp, one drawn output
+  length) on the dedicated ``rng_serve`` stream, so a million-user day
+  costs O(ticks), not O(requests), while TTFT percentiles stay exact at
+  cohort granularity.
+* **Replicas** — one bound replica pod = ``slots`` decode slots at
+  capacity ``tok_s_per_chip x chips x table(generation) x
+  (1 - derate)``: the throughput table's value is what the scheduler
+  KNOWS; ``derate`` (the degraded-host set) is what only measurement
+  can discover — exactly the signal the serving tap closes the loop on.
+* **Service** — per tick, each replica splits its capacity over its
+  in-flight requests (per-request rate capped at ``tok_s_per_request``,
+  the single-row decode ceiling); cohorts complete when their drawn
+  output length is served. Admission fills free slots from the global
+  FIFO queue; TTFT = queue wait + ``prefill_s``.
+
+Determinism: every draw is on ``rng_serve``, every timestamp is virtual
+time, all floats round at the edge — the per-tick journal line makes
+the serving trajectory part of the run digest.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from nanotpu.allocator.throughput import ThroughputModel
+from nanotpu.serving.feedback import ReplicaSample
+
+#: ttft samples retained for the rolling ext.serving.ttft_p99_ms gauge
+#: (cohort entries, not requests — the SLO window, not the report stat)
+_TTFT_WINDOW = 512
+
+
+def weighted_percentile(pairs, p: float) -> float | None:
+    """Exact weighted nearest-rank percentile over ``(value, weight)``
+    pairs — the cohort-granular analogue of
+    :func:`nanotpu.metrics.stats.percentile` (same convention: smallest
+    value whose cumulative weight reaches ``ceil(p x total)``)."""
+    if not pairs:
+        return None
+    total = sum(w for _, w in pairs)
+    if total <= 0:
+        return None
+    rank = max(1, math.ceil(p * total))
+    cum = 0
+    for value, weight in sorted(pairs):
+        cum += weight
+        if cum >= rank:
+            return value
+    return sorted(pairs)[-1][0]
+
+
+@dataclass
+class _Cohort:
+    """Requests that arrived in one tick and share one drawn length."""
+
+    arrival_t: float
+    n: int
+    tokens_per_req: float
+    #: remaining decode tokens per request (in-flight cohorts only)
+    remaining: float = 0.0
+    #: TTFT already recorded (a requeued in-flight cohort must not
+    #: re-record at its second admission)
+    ttft_recorded: bool = False
+
+
+@dataclass
+class _Replica:
+    name: str
+    state: str = "pending"  # pending -> active -> draining
+    node: str = ""
+    chips: int = 0
+    #: the card indices the dealer actually assigned (the pod's
+    #: container annotation) — the tap's write targets. Empty falls
+    #: back to 0..chips-1 (a sub-host replica sharing a host with a
+    #: sibling MUST carry real ids or its shortfall would reprice the
+    #: co-resident's cards)
+    chip_ids: tuple = ()
+    #: uncontended capacity the MODEL expects (table x per-chip rate)
+    expected_tok_s: float = 0.0
+    #: true capacity (expected x (1 - hidden derate))
+    capacity_tok_s: float = 0.0
+    slots: int = 0
+    active: list = field(default_factory=list)  # in-flight _Cohorts
+    #: tokens actually decoded last tick / dt (0.0 when idle)
+    measured_tok_s: float = 0.0
+
+    def inflight(self) -> int:
+        return sum(c.n for c in self.active)
+
+
+class ServeSim:
+    """See module docstring. Driven by the simulator's ``serving_tick``
+    events; exposes the provider/signal surfaces the REAL feedback
+    source and autoscaler consume."""
+
+    def __init__(self, spec: dict, client, rng, tap=None):
+        self.spec = spec
+        self.client = client
+        self.rng = rng
+        #: the REAL ServingTap (None when scenario feedback is off)
+        self.tap = tap
+        self.replicas: dict[str, _Replica] = {}
+        self.queue: list[_Cohort] = []
+        self._carry = 0.0
+        #: ground truth the scheduler cannot see: node -> serving derate
+        self.derate_by_node = self._degraded_map()
+        #: the table the scheduler DOES see (generation factors) — one
+        #: fixed default model, the same convention as throughput_report
+        self._table = ThroughputModel()
+        # trajectory accounting (the report's serving section)
+        self.arrived = 0
+        self.admitted = 0
+        self.completed = 0
+        self.tokens_served = 0.0
+        self.chip_seconds = 0.0
+        self.ttft_samples: list[tuple[float, int]] = []
+        self._ttft_window: list[tuple[float, int]] = []
+        self.replica_peak = 0
+        self.replica_min = -1
+        self.ticks = 0
+        self._last_tok_s = 0.0
+
+    # -- fleet ground truth ------------------------------------------------
+    def _degraded_map(self) -> dict[str, float]:
+        """Every ``degraded.every``-th host (sorted names, from index 0)
+        serves at ``1 - degraded.derate`` of its modeled rate — the
+        hidden hardware/noisy-neighbor loss only measurement finds.
+        Computed once at boot; deterministic."""
+        deg = self.spec["degraded"]
+        every = int(deg.get("every", 0))
+        if every <= 0:
+            return {}
+        names = sorted(n.name for n in self.client.list_nodes())
+        return {
+            name: float(deg["derate"])
+            for i, name in enumerate(names) if i % every == 0
+        }
+
+    # -- replica lifecycle (sim hooks) -------------------------------------
+    def knows(self, name: str) -> bool:
+        return name in self.replicas
+
+    def register_pending(self, name: str) -> None:
+        if name not in self.replicas:
+            self.replicas[name] = _Replica(name=name)
+
+    def replica_bound(self, name: str, node: str,
+                      chips: tuple = ()) -> None:
+        rep = self.replicas.get(name)
+        if rep is None or rep.state != "pending":
+            return
+        rep.chip_ids = tuple(chips)
+        from nanotpu import types
+
+        chips = max(1, int(self.spec["replica_percent"]) // 100)
+        try:
+            node_obj = self.client.get_node(node)
+            generation = node_obj.labels.get(
+                types.LABEL_TPU_GENERATION, ""
+            )
+        except Exception:
+            generation = ""
+        if not generation:
+            # fleet nodes name themselves "<gen>-host-N"; fall back to
+            # the leading token
+            generation = node.split("-", 1)[0]
+        factor = self._table.base_fraction("*", generation)
+        rate = float(self.spec["tok_s_per_chip"]) * chips
+        rep.state = "active"
+        rep.node = node
+        rep.chips = chips
+        rep.slots = int(self.spec["slots_per_replica"])
+        rep.expected_tok_s = rate * factor
+        rep.capacity_tok_s = rep.expected_tok_s * (
+            1.0 - self.derate_by_node.get(node, 0.0)
+        )
+
+    def drain(self, name: str) -> None:
+        rep = self.replicas.get(name)
+        if rep is not None and rep.state == "active":
+            rep.state = "draining"
+
+    def replica_gone(self, name: str) -> None:
+        """Pod deleted (drain complete, drain kill, flap): requeue its
+        in-flight cohorts at their ORIGINAL arrival time (the client
+        retries; TTFT was recorded at first admission and is not
+        re-recorded)."""
+        rep = self.replicas.pop(name, None)
+        if rep is None:
+            return
+        for cohort in rep.active:
+            self.queue.append(_Cohort(
+                arrival_t=cohort.arrival_t, n=cohort.n,
+                tokens_per_req=cohort.remaining,
+                ttft_recorded=True,
+            ))
+
+    # -- demand ------------------------------------------------------------
+    def rate(self, now: float) -> float:
+        """Diurnal arrival rate (requests/s) at virtual time ``now``."""
+        peak = (
+            float(self.spec["users"])
+            * float(self.spec["requests_per_user_h"]) / 3600.0
+        )
+        d = self.spec["diurnal"]
+        period = float(d["period_s"])
+        trough = float(d["trough_frac"])
+        wave = 0.5 * (1.0 - math.cos(2.0 * math.pi * now / period))
+        return peak * (trough + (1.0 - trough) * wave)
+
+    def _arrivals(self, now: float, dt: float) -> int:
+        lam = self.rate(now) * dt
+        # +-10% multiplicative noise from the dedicated stream: enough
+        # jitter to be a trace, still byte-stable under the seed
+        noisy = lam * (0.9 + 0.2 * self.rng.random()) + self._carry
+        count = int(noisy)
+        self._carry = noisy - count
+        if count <= 0:
+            return 0
+        tokens = float(self.spec["tokens_out_mean"]) * (
+            0.5 + self.rng.random()
+        )
+        self.queue.append(_Cohort(
+            arrival_t=now, n=count, tokens_per_req=tokens,
+        ))
+        self.arrived += count
+        return count
+
+    # -- one tick ----------------------------------------------------------
+    def tick(self, now: float, dt: float) -> dict:
+        """Advance the fleet by ``dt``: arrivals -> decode -> completions
+        -> admissions -> accounting -> feedback. Returns the journal
+        summary (rounded — it feeds the run digest)."""
+        self.ticks += 1
+        arrivals = self._arrivals(now, dt)
+        cap = float(self.spec["tok_s_per_request"])
+        prefill = float(self.spec["prefill_s"])
+        served_tokens = 0.0
+        completed = 0
+        samples = []
+        chips_now = 0
+        for name in sorted(self.replicas):
+            rep = self.replicas[name]
+            if rep.state == "pending":
+                continue
+            chips_now += rep.chips
+            inflight = rep.inflight()
+            if inflight > 0:
+                per_req = min(cap, rep.capacity_tok_s / inflight) * dt
+                still: list[_Cohort] = []
+                for cohort in rep.active:
+                    chunk = min(cohort.remaining, per_req)
+                    served_tokens += chunk * cohort.n
+                    cohort.remaining -= chunk
+                    if cohort.remaining <= 1e-9:
+                        completed += cohort.n
+                    else:
+                        still.append(cohort)
+                rep.active = still
+                # the engine's measured decode rate: extrapolated full
+                # rate while decoding (what the bandit EWMA converges to)
+                rep.measured_tok_s = round(rep.capacity_tok_s, 4)
+                if self.tap is not None and rep.node:
+                    samples.append(ReplicaSample(
+                        node=rep.node,
+                        chips=rep.chip_ids or tuple(range(rep.chips)),
+                        measured_tok_s=rep.capacity_tok_s,
+                        expected_tok_s=rep.expected_tok_s,
+                    ))
+            else:
+                rep.measured_tok_s = 0.0
+        # admissions: fill free slots from the global FIFO queue
+        # (draining replicas take nothing new — the drain contract)
+        for name in sorted(self.replicas):
+            rep = self.replicas[name]
+            if rep.state != "active":
+                continue
+            free = rep.slots - rep.inflight()
+            while free > 0 and self.queue:
+                head = self.queue[0]
+                take = min(free, head.n)
+                if not head.ttft_recorded:
+                    # first admission: TTFT = queue wait + prefill
+                    ttft = round(now - head.arrival_t + prefill, 6)
+                    self.ttft_samples.append((ttft, take))
+                    self._ttft_window.append((ttft, take))
+                    if len(self._ttft_window) > _TTFT_WINDOW:
+                        del self._ttft_window[
+                            : len(self._ttft_window) - _TTFT_WINDOW
+                        ]
+                rep.active.append(_Cohort(
+                    arrival_t=head.arrival_t, n=take,
+                    tokens_per_req=head.tokens_per_req,
+                    remaining=head.tokens_per_req,
+                ))
+                self.admitted += take
+                free -= take
+                if take == head.n:
+                    self.queue.pop(0)
+                else:
+                    head.n -= take
+        self.completed += completed
+        self.tokens_served += served_tokens
+        self.chip_seconds += chips_now * dt
+        self._last_tok_s = round(served_tokens / dt, 4) if dt else 0.0
+        live = sum(
+            1 for r in self.replicas.values() if r.state != "pending"
+        )
+        self.replica_peak = max(self.replica_peak, live)
+        if self.replica_min < 0 or live < self.replica_min:
+            self.replica_min = live
+        if self.tap is not None and samples:
+            self.tap.ingest(samples, now=now)
+        queued = sum(c.n for c in self.queue)
+        return {
+            "arrivals": arrivals,
+            "queued": queued,
+            "active": sum(
+                r.inflight() for r in self.replicas.values()
+            ),
+            "replicas": live,
+            "tokens": round(served_tokens, 2),
+            "completed": completed,
+        }
+
+    # -- provider / signal surfaces ----------------------------------------
+    def metrics(self) -> dict:
+        """The serving-provider contract (same key set as
+        ``Engine.metrics()``, pinned by tests) — what the REAL
+        ``ServingMetricsSource`` samples into ``ext.serving.*``."""
+        active = 0
+        slots = 0
+        chips = 0
+        for rep in self.replicas.values():
+            if rep.state == "pending":
+                continue
+            active += rep.inflight()
+            slots += rep.slots
+            chips += rep.chips
+        p99 = weighted_percentile(self._ttft_window, 0.99)
+        return {
+            "tok_s": self._last_tok_s,
+            "queue_depth": float(sum(c.n for c in self.queue)),
+            "active": float(active),
+            "slots": float(slots),
+            "kv_occupancy": round(active / slots, 6) if slots else 0.0,
+            "chips": float(chips),
+            "ttft_p99_ms": (
+                round(p99 * 1e3, 2) if p99 is not None else 0.0
+            ),
+        }
+
+    def signal(self):
+        """The autoscaler's demand snapshot."""
+        from nanotpu.serving.autoscale import ServingSignal
+
+        return ServingSignal(
+            queued=sum(c.n for c in self.queue),
+            replicas={
+                name: {
+                    "active": rep.inflight(),
+                    "tok_s": rep.measured_tok_s,
+                }
+                for name, rep in sorted(self.replicas.items())
+                if rep.state != "pending"
+            },
+        )
+
+    def bound_replicas(self) -> int:
+        return sum(
+            1 for r in self.replicas.values() if r.state != "pending"
+        )
+
+    # -- final report section ----------------------------------------------
+    def summary(self) -> dict:
+        ttft = self.ttft_samples
+        tok_per_chip = (
+            self.tokens_served / self.chip_seconds
+            if self.chip_seconds else 0.0
+        )
+
+        def pct(p: float):
+            v = weighted_percentile(ttft, p)
+            return round(v * 1e3, 2) if v is not None else None
+
+        return {
+            "requests": {
+                "arrived": self.arrived,
+                "admitted": self.admitted,
+                "completed": self.completed,
+                "queued_final": sum(c.n for c in self.queue),
+                "inflight_final": sum(
+                    r.inflight() for r in self.replicas.values()
+                ),
+            },
+            "tokens_served": round(self.tokens_served, 2),
+            "chip_seconds": round(self.chip_seconds, 2),
+            "tok_s_per_chip": round(tok_per_chip, 4),
+            "ttft_ms": {
+                "p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99),
+            },
+            "replicas": {
+                "final": self.bound_replicas(),
+                "peak": self.replica_peak,
+                "min": max(self.replica_min, 0),
+            },
+            "feedback": {
+                "samples": (
+                    self.tap.samples_ingested if self.tap else 0
+                ),
+                "cards": self.tap.cards_observed if self.tap else 0,
+            },
+            "ticks": self.ticks,
+        }

@@ -154,6 +154,11 @@ PRIORITY_DEFAULT = 0
 #: expires (reason ``lease_expired``).
 ANNOTATION_EXPECTED_RUNTIME = "tpu.io/expected-runtime-s"
 
+#: Marks a pod as a serving replica managed by the replica autoscaler
+#: (docs/serving-loop.md): reconcile adopts pods carrying "1", and
+#: scale-down drains them under a deadline lease instead of deleting.
+ANNOTATION_SERVING_REPLICA = "tpu.io/serving-replica"
+
 # --------------------------------------------------------------------------
 # Placement-policy names (CLI flag values).
 # Reference: PriorityBinPack/PrioritySpread (pkg/types/types.go:18-21);

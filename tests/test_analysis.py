@@ -432,6 +432,37 @@ class TestLockDiscipline:
             for f in cycles
         ), report.findings
 
+    def test_autoscaler_dealer_lock_inversion_flagged(self, tmp_path):
+        # seeded inversion (ISSUE r13 satellite): production never nests
+        # ReplicaAutoscaler._lock with anything — every client write and
+        # recovery-plane call runs outside it. A path nesting it with
+        # the dealer lock in BOTH orders is the deadlock the discipline
+        # forbids, and the witness-named lock makes the pass name it.
+        report = one(tmp_path, """
+            from nanotpu.analysis.witness import make_lock
+
+            class ReplicaAutoscaler:
+                def __init__(self):
+                    self._lock = make_lock("ReplicaAutoscaler._lock")
+
+            class Dealer:
+                def scale_under_dealer(self, asc: ReplicaAutoscaler):
+                    with self._lock:
+                        with asc._lock:
+                            pass
+
+                def status_under_autoscaler(self, asc: ReplicaAutoscaler):
+                    with asc._lock:
+                        with self._lock:
+                            pass
+            """, "lock-discipline")
+        cycles = [f for f in report.findings if "cycle" in f.message]
+        assert any(
+            "ReplicaAutoscaler._lock" in f.message
+            and "Dealer._lock" in f.message
+            for f in cycles
+        ), report.findings
+
 
 # ---------------------------------------------------------------------------
 # snapshot-immutability
@@ -1078,6 +1109,40 @@ class TestMetricsCompleteness:
         assert any("dead_slo_gauge" in m and "KeyError" in m
                    for m in msgs), msgs
 
+    # -- serving gauge family (nanotpu/metrics/serving.py) -----------------
+    def test_serving_gauge_produced_but_undeclared(self, tmp_path):
+        # ISSUE r13 satellite: the serving table <-> producer held both
+        # directions, same structural check as the other gauge families
+        report = lint(tmp_path, {
+            "serving.py": """
+                _SERVING_GAUGES = {"tok_s": "decode rate"}
+
+                class ServingMetricsSource:
+                    def serving_gauge_values(self):
+                        return {"tok_s": 100.0, "ghost_serving_gauge": 1}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("ghost_serving_gauge" in m and "not declared" in m
+                   for m in msgs), msgs
+
+    def test_serving_gauge_declared_but_never_produced(self, tmp_path):
+        report = lint(tmp_path, {
+            "serving.py": """
+                _SERVING_GAUGES = {
+                    "tok_s": "decode rate",
+                    "dead_serving_gauge": "declared but never produced",
+                }
+
+                class ServingMetricsSource:
+                    def serving_gauge_values(self):
+                        return {"tok_s": 100.0}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("dead_serving_gauge" in m and "KeyError" in m
+                   for m in msgs), msgs
+
     def test_gauge_families_do_not_cross_pollinate(self, tmp_path):
         # distinct producer names per family: a timeline tick gauge must
         # not be held against the throughput/SLO tables (and vice versa)
@@ -1086,6 +1151,7 @@ class TestMetricsCompleteness:
                 _THROUGHPUT_GAUGES = {"calibrated_nodes": "n"}
                 _TIMELINE_GAUGES = {"occupancy": "occ"}
                 _SLO_GAUGES = {"objectives": "n"}
+                _SERVING_GAUGES = {"tok_s": "decode rate"}
                 """,
             "producers.py": """
                 class Model:
@@ -1099,6 +1165,10 @@ class TestMetricsCompleteness:
                 class SLOWatchdog:
                     def slo_gauge_values(self):
                         return {"objectives": 2}
+
+                class ServingMetricsSource:
+                    def serving_gauge_values(self):
+                        return {"tok_s": 100.0}
                 """,
         }, ["metrics-completeness"])
         assert not any("gauge" in f.message for f in report.findings), \

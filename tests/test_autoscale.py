@@ -1,0 +1,501 @@
+"""The scheduler<->serving loop's building blocks (ISSUE r13,
+docs/serving-loop.md): the replica autoscaler, the serving feedback tap,
+and the ``nanotpu_serving_*`` exposition surface.
+
+Load-bearing pins:
+
+* **tap parity** (satellite) — a serving tok/s sample ingested through
+  :class:`ServingTap` moves the ThroughputModel's contention EWMAs and
+  the model version EXACTLY like the equivalent metric-sync sample, and
+  the next Prioritize reprices identically — pinned at the decision
+  ledger's ``score_terms`` breakdown, so the two calibration paths can
+  never drift;
+* **provider contract** — every ``metrics()`` producer (the sim's
+  virtual fleet here; the engine and the remote-stats poller by the
+  same key set) speaks the exact gauge-table vocabulary, both
+  directions, at runtime (the static nanolint pass checks the same
+  equivalence lexically);
+* **drain-lease semantics** — scale-down victims finish in-flight work
+  under a recovery-plane lease; the plane's sweep deletes overstayers
+  (reason ``drain_expired``) and an idle drain completes on the next
+  cycle.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.objects import make_container, make_pod
+from nanotpu.metrics.serving import _SERVING_GAUGES, ServingExporter
+from nanotpu.obs import Observability
+from nanotpu.scheduler.verbs import Prioritize
+from nanotpu.serving.autoscale import (
+    AutoscaleConfig,
+    ReplicaAutoscaler,
+    ServingSignal,
+    make_replica_pod,
+)
+from nanotpu.serving.feedback import (
+    ReplicaSample,
+    ServingMetricsSource,
+    ServingTap,
+)
+from nanotpu.sim.fleet import make_fleet
+
+V5P_FLEET = {
+    "pools": [
+        {"generation": "v5p", "hosts": 4, "slice_hosts": 4,
+         "prefix": "v5p-host"},
+    ]
+}
+
+
+def _stack():
+    client = make_fleet(V5P_FLEET)
+    dealer = Dealer(client, make_rater("throughput"))
+    return client, dealer
+
+
+def _uid_counter():
+    n = [0]
+
+    def uid():
+        n[0] += 1
+        return f"uid-{n[0]}"
+
+    return uid
+
+
+class _FakeProvider:
+    """Minimal ``metrics()`` producer speaking the provider contract."""
+
+    def __init__(self, **overrides):
+        self.values = {
+            "tok_s": 1234.5, "queue_depth": 7.0, "active": 48.0,
+            "slots": 64.0, "kv_occupancy": 0.75, "chips": 4.0,
+            "ttft_p99_ms": 210.0,
+        }
+        self.values.update(overrides)
+
+    def metrics(self) -> dict:
+        return dict(self.values)
+
+
+# ---------------------------------------------------------------------------
+# the feedback tap: serving sample == metric-sync sample, end to end
+# ---------------------------------------------------------------------------
+class TestTapParity:
+    def test_shortfall_clamps(self):
+        s = ReplicaSample("n", (0,), measured_tok_s=900.0,
+                          expected_tok_s=1000.0)
+        assert s.shortfall() == pytest.approx(0.1)
+        assert ReplicaSample("n", (0,), 2000.0, 1000.0).shortfall() == 0.0
+        assert ReplicaSample("n", (0,), -5.0, 1000.0).shortfall() == 1.0
+        assert ReplicaSample("n", (0,), 100.0, 0.0).shortfall() == 0.0
+
+    def test_tap_sample_equals_metric_sync_sample(self):
+        """The parity pin (ISSUE satellite): same node, same cards, same
+        load -> same EWMAs, same model version, same next-Prioritize
+        score breakdown in the ledger."""
+        ca, da = _stack()
+        cb, db = _stack()
+        try:
+            node = "v5p-host-1"
+            load = 0.4  # == shortfall of serving 60% of expected
+            # path A: the serving tap
+            tap = ServingTap(da)
+            applied = tap.ingest([ReplicaSample(
+                node, (0, 1, 2, 3),
+                measured_tok_s=960.0, expected_tok_s=1600.0,
+            )], now=5.0)
+            assert applied == 1
+            assert tap.samples_ingested == 1
+            assert tap.cards_observed == 4
+            # path B: the metric-sync discipline, by hand
+            for chip in range(4):
+                db.update_chip_usage(
+                    node, chip, core=load, now=5.0, publish=False
+                )
+            db.publish_usage((node,))
+
+            ma, mb = da.rater.model, db.rater.model
+            assert ma.contention(node) == pytest.approx(
+                mb.contention(node)
+            )
+            assert ma.version == mb.version
+            # the reprice pin: the ledger's per-term breakdown for the
+            # NEXT Prioritize must be byte-equal between the two paths
+            pod_raw = make_pod("probe", uid="probe-uid", containers=[
+                make_container("t", {types.RESOURCE_TPU_PERCENT: 100})
+            ]).raw
+            nodes = sorted(n.name for n in ca.list_nodes())
+            terms = []
+            for dealer in (da, db):
+                obs = Observability(sample=1, clock=lambda: 9.0)
+                trace = obs.tracer.begin("priorities", "probe-uid")
+                Prioritize(dealer, obs=obs).handle(
+                    {"Pod": pod_raw, "NodeNames": nodes}, trace=trace
+                )
+                obs.tracer.commit(trace)
+                # the cycle is still building (no bind finalized it):
+                # get() returns in-progress records too
+                recs = [
+                    r for r in obs.ledger.get("probe-uid")
+                    if r.get("score_terms")
+                ]
+                assert recs, "Prioritize recorded no score_terms"
+                terms.append(recs[-1]["score_terms"])
+            assert terms[0] == terms[1]
+            # and the contention term actually moved on the touched node
+            assert terms[0][node]["contention"] < max(
+                t["contention"] for n, t in terms[0].items() if n != node
+            )
+        finally:
+            da.close()
+            db.close()
+
+    def test_tap_batches_one_publish(self):
+        """A tap batch costs ONE snapshot publish (the metric-sync
+        batching discipline), regardless of sample count."""
+        _, dealer = _stack()
+        try:
+            calls = []
+            orig = dealer.publish_usage
+            dealer.publish_usage = lambda nodes: (
+                calls.append(tuple(nodes)), orig(nodes),
+            )
+            tap = ServingTap(dealer)
+            tap.ingest([
+                ReplicaSample("v5p-host-0", (0, 1), 700.0, 1600.0),
+                ReplicaSample("v5p-host-2", (0,), 1600.0, 1600.0),
+                ReplicaSample("v5p-host-1", (), 0.0, 0.0),  # chipless: skipped
+            ], now=1.0)
+            assert calls == [("v5p-host-0", "v5p-host-2")]
+            assert tap.samples_ingested == 2
+            assert tap.cards_observed == 3
+        finally:
+            dealer.close()
+
+
+# ---------------------------------------------------------------------------
+# provider contract + exposition
+# ---------------------------------------------------------------------------
+class TestServingGauges:
+    def test_source_produces_exact_gauge_table(self):
+        """Runtime arm of the nanolint both-directions check: the
+        source's value keys == the declared gauge suffixes."""
+        source = ServingMetricsSource(_FakeProvider())
+        values = source.serving_gauge_values()
+        assert set(values) == set(_SERVING_GAUGES)
+        assert source.sample() == values  # timeline source == producer
+
+    def test_tok_s_per_chip_and_replicas(self):
+        source = ServingMetricsSource(
+            _FakeProvider(tok_s=800.0, chips=4.0), replicas=lambda: 3
+        )
+        v = source.serving_gauge_values()
+        assert v["tok_s_per_chip"] == pytest.approx(200.0)
+        assert v["replicas"] == 3.0
+        # no replica controller attached -> provider's count (absent: 0)
+        v0 = ServingMetricsSource(_FakeProvider()).serving_gauge_values()
+        assert v0["replicas"] == 0.0
+
+    def test_exporter_renders_every_gauge(self):
+        out = ServingExporter(
+            ServingMetricsSource(_FakeProvider())
+        ).render()
+        text = "\n".join(out)
+        assert "nanotpu_serving_up 1" in text
+        for suffix in _SERVING_GAUGES:
+            assert f"nanotpu_serving_{suffix} " in text, suffix
+        # one HELP + TYPE + value line per gauge, plus the up triplet
+        assert len(out) == 3 * len(_SERVING_GAUGES) + 3
+
+    def test_exporter_degrades_when_provider_raises(self):
+        """A dead replica endpoint must NOT 500 the whole /metrics
+        exposition: the exporter answers nanotpu_serving_up 0 and omits
+        the value gauges (the scrape-path arm of the timeline source's
+        {"error": 1} guard)."""
+        class _Dead:
+            def serving_gauge_values(self):
+                raise OSError("connection refused")
+
+        out = ServingExporter(_Dead()).render()
+        text = "\n".join(out)
+        assert "nanotpu_serving_up 0" in text
+        assert "nanotpu_serving_tok_s" not in text
+
+    def test_sim_fleet_speaks_the_provider_contract(self):
+        """The virtual replica fleet's metrics() carries exactly the
+        provider key set the source consumes — so SLOs addressing
+        ext.serving.* mean the same thing against the sim and the
+        engine."""
+        from nanotpu.sim.serve import ServeSim
+        import random
+
+        client = make_fleet(V5P_FLEET)
+        spec = {
+            "every_s": 0.25, "users": 1000, "requests_per_user_h": 3.6,
+            "diurnal": {"period_s": 60.0, "trough_frac": 0.5},
+            "tokens_out_mean": 16.0, "prefill_s": 0.1,
+            "slots_per_replica": 8, "tok_s_per_chip": 400.0,
+            "tok_s_per_request": 25.0, "replica_percent": 400,
+            "degraded": {"every": 0, "derate": 0.0},
+        }
+        sim = ServeSim(spec, client, random.Random(7))
+        assert set(sim.metrics()) == {
+            "tok_s", "queue_depth", "active", "slots", "kv_occupancy",
+            "chips", "ttft_p99_ms",
+        }
+        # source over the virtual fleet renders the full table
+        values = ServingMetricsSource(sim).serving_gauge_values()
+        assert set(values) == set(_SERVING_GAUGES)
+
+
+# ---------------------------------------------------------------------------
+# the autoscaler
+# ---------------------------------------------------------------------------
+class TestAutoscaler:
+    def _scaler(self, client, **kw):
+        defaults = dict(
+            min_replicas=1, max_replicas=4, slots_per_replica=8,
+            target_utilization=0.75, up_cooldown_s=0.0,
+            down_cooldown_s=0.0, drain_deadline_s=5.0,
+            replica_percent=400,
+        )
+        defaults.update(kw)
+        clock = [0.0]
+        scaler = ReplicaAutoscaler(
+            client, AutoscaleConfig(**defaults),
+            clock=lambda: clock[0], uid_of=_uid_counter(),
+        )
+        return scaler, clock
+
+    def test_config_validation(self):
+        client, dealer = _stack()
+        dealer.close()
+        with pytest.raises(ValueError):
+            ReplicaAutoscaler(
+                client, AutoscaleConfig(min_replicas=3, max_replicas=2)
+            )
+
+    def test_desired_tracks_demand_and_clamps(self):
+        client = make_fleet(V5P_FLEET)
+        scaler, _ = self._scaler(client)
+        # 8 slots/replica x 0.75 util = 6 demand units per replica
+        assert scaler.desired(ServingSignal(queued=0)) == 1
+        assert scaler.desired(ServingSignal(queued=12)) == 2
+        assert scaler.desired(ServingSignal(
+            queued=6, replicas={"r": {"active": 6}}
+        )) == 2
+        assert scaler.desired(ServingSignal(queued=10_000)) == 4  # max
+
+    def test_scale_up_submits_annotated_pods(self):
+        client = make_fleet(V5P_FLEET)
+        scaler, _ = self._scaler(client)
+        result = scaler.run_once(0.0, ServingSignal(queued=12))
+        assert len(result["created"]) == 2
+        assert scaler.replica_count() == 2
+        for pod in result["created"]:
+            assert pod.annotations[types.ANNOTATION_SERVING_REPLICA] == "1"
+            assert pod.uid  # sim-injected uid reached the server copy
+        # the pods really are in the cluster
+        names = {p.name for p in client.list_pods()}
+        assert {p.name for p in result["created"]} <= names
+
+    def test_reconcile_learns_binds_and_adopts(self):
+        client = make_fleet(V5P_FLEET)
+        scaler, _ = self._scaler(client)
+        scaler.run_once(0.0, ServingSignal(queued=6))
+        [name] = scaler.replica_names()
+        # bind it out-of-band (the scheduler's job, not the autoscaler's)
+        client.bind_pod("default", name, "v5p-host-2")
+        result = scaler.run_once(1.0, ServingSignal(queued=6))
+        assert ("replica-bound", f"{name} @ v5p-host-2") in result["actions"]
+        # a pre-existing static replica is adopted on sight
+        client.create_pod(make_replica_pod(
+            "static-1", scaler.config, uid="static-uid-1"
+        ))
+        result = scaler.run_once(2.0, ServingSignal(queued=12))
+        assert ("replica-adopt", "static-1") in result["actions"]
+        assert "static-1" in scaler.replica_names()
+
+    def test_scale_down_drains_lowest_measured_tok_s(self):
+        client = make_fleet(V5P_FLEET)
+        scaler, _ = self._scaler(client, min_replicas=1)
+        scaler.run_once(0.0, ServingSignal(queued=18))  # 3 replicas
+        names = scaler.replica_names()
+        for i, name in enumerate(names):
+            client.bind_pod("default", name, f"v5p-host-{i}")
+        scaler.run_once(1.0, ServingSignal(queued=0, replicas={
+            n: {"active": 6, "tok_s": 100.0} for n in names
+        }))  # reconcile learns the binds; demand holds at 3 replicas
+        assert scaler.replica_count() == 3
+        # demand halves; the degraded replica (lowest tok/s) drains
+        victim = names[1]
+        stats = {
+            names[0]: {"active": 4, "tok_s": 1600.0},
+            names[1]: {"active": 4, "tok_s": 900.0},
+            names[2]: {"active": 4, "tok_s": 1500.0},
+        }
+        result = scaler.run_once(2.0, ServingSignal(
+            queued=0, replicas=stats
+        ))
+        assert result["draining"] == [victim]
+        assert scaler.drains_started == 1
+        # still tracked (finishing in-flight), taking no new work
+        assert victim in scaler.replica_names()
+        # next cycle: victim reports idle -> deleted, drain complete
+        stats[victim] = {"active": 0, "tok_s": 0.0}
+        result = scaler.run_once(3.0, ServingSignal(
+            queued=0, replicas=stats
+        ))
+        assert (victim, ) == tuple(n for n, _ in result["deleted"])
+        assert scaler.drains_completed == 1
+        assert victim not in scaler.replica_names()
+
+    def test_idle_or_unbound_victims_skip_the_drain_window(self):
+        client = make_fleet(V5P_FLEET)
+        scaler, _ = self._scaler(client, min_replicas=0)
+        scaler.run_once(0.0, ServingSignal(queued=12))  # 2 replicas
+        names = scaler.replica_names()
+        # neither ever bound: scale-down deletes outright, no drain
+        result = scaler.run_once(1.0, ServingSignal(queued=0))
+        assert scaler.drains_started == 0
+        assert sorted(n for n, _ in result["deleted"]) == names
+        assert scaler.replica_count() == 0
+
+    def test_drain_deadline_enforced_without_plane(self):
+        client = make_fleet(V5P_FLEET)
+        scaler, _ = self._scaler(client, min_replicas=0,
+                                 drain_deadline_s=5.0)
+        scaler.run_once(0.0, ServingSignal(queued=12))
+        victim, keeper = scaler.replica_names()
+        client.bind_pod("default", victim, "v5p-host-0")
+        client.bind_pod("default", keeper, "v5p-host-1")
+        busy = {
+            victim: {"active": 3, "tok_s": 800.0},
+            keeper: {"active": 3, "tok_s": 1600.0},
+        }
+        # demand halves: the slower bound-and-busy replica drains
+        scaler.run_once(1.0, ServingSignal(queued=0, replicas=busy))
+        assert scaler.drains_started == 1
+        # still busy before the deadline (1.0 + 5.0): kept
+        scaler.run_once(4.0, ServingSignal(queued=0, replicas=busy))
+        assert scaler.replica_count() == 2
+        # past the deadline: killed mid-flight
+        result = scaler.run_once(8.0, ServingSignal(
+            queued=0, replicas=busy
+        ))
+        assert scaler.drain_kills == 1
+        assert [victim] == [n for n, _ in result["deleted"]]
+
+    def test_down_cooldown_throttles_scale_downs_not_ups(self):
+        client = make_fleet(V5P_FLEET)
+        scaler, _ = self._scaler(client, min_replicas=0,
+                                 down_cooldown_s=10.0)
+        scaler.run_once(0.0, ServingSignal(queued=24))
+        assert scaler.replica_count() == 4
+        # first down-step lands (never-bound victims delete outright)
+        scaler.run_once(1.0, ServingSignal(queued=6))
+        assert scaler.replica_count() == 1
+        # an up-step inside the down cooldown is NOT throttled
+        # (per-direction cooldowns: a ramp must not wait out a trough)
+        scaler.run_once(2.0, ServingSignal(queued=24))
+        assert scaler.replica_count() == 4
+        # a second down-step inside the cooldown IS throttled...
+        scaler.run_once(3.0, ServingSignal(queued=0))
+        assert scaler.replica_count() == 4
+        # ...and lands once the cooldown passes
+        scaler.run_once(20.0, ServingSignal(queued=0))
+        assert scaler.replica_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# drain leases on the recovery plane
+# ---------------------------------------------------------------------------
+class TestDrainLeases:
+    def _plane(self, dealer):
+        from nanotpu.recovery import RecoveryConfig, RecoveryPlane
+
+        return RecoveryPlane(
+            dealer, config=RecoveryConfig(), clock=lambda: 0.0
+        )
+
+    def _bound_replica(self, client, dealer, name="serve-8b-1",
+                       node="v5p-host-0"):
+        cfg = AutoscaleConfig()
+        pod = client.create_pod(
+            make_replica_pod(name, cfg, uid=f"{name}-uid")
+        )
+        dealer.bind(node, pod)
+        return client.get_pod("default", name)
+
+    def test_sweep_deletes_overstayer_and_audits(self):
+        client, dealer = _stack()
+        try:
+            plane = self._plane(dealer)
+            pod = self._bound_replica(client, dealer)
+            plane.note_drain(
+                pod.uid, pod.name, "default", "v5p-host-0",
+                expires_at=10.0,
+            )
+            assert plane.counters.drain_leases == 1
+            assert plane.status()["drains"] == 1
+            # before expiry: untouched
+            plane.run_once(5.0, [])
+            assert client.get_pod("default", pod.name) is not None
+            # past expiry with the dealer still tracking it: DELETED
+            result = plane.run_once(11.0, [])
+            assert ("drain-expire", f"{pod.name} @ v5p-host-0") in \
+                result["actions"]
+            assert plane.counters.drain_lease_expiries == 1
+            assert plane.status()["drains"] == 0
+            with pytest.raises(Exception):
+                client.get_pod("default", pod.name)
+        finally:
+            dealer.close()
+
+    def test_clean_drain_drops_lease_without_expiry(self):
+        client, dealer = _stack()
+        try:
+            plane = self._plane(dealer)
+            pod = self._bound_replica(client, dealer)
+            plane.note_drain(
+                pod.uid, pod.name, "default", "v5p-host-0",
+                expires_at=10.0,
+            )
+            # note_drain is idempotent per uid
+            plane.note_drain(
+                pod.uid, pod.name, "default", "v5p-host-0",
+                expires_at=99.0,
+            )
+            assert plane.counters.drain_leases == 1
+            # the replica drained on its own (autoscaler deleted it)
+            client.delete_pod("default", pod.name)
+            plane.pod_gone(pod.uid)
+            result = plane.run_once(11.0, [])
+            assert plane.counters.drain_lease_expiries == 0
+            assert not any(
+                k == "drain-expire" for k, _ in result["actions"]
+            )
+        finally:
+            dealer.close()
+
+    def test_draining_replica_is_not_a_migration_candidate(self):
+        """A replica that is leaving the fleet must never be migrated —
+        its lease joins the leased-uid exclusion set."""
+        client, dealer = _stack()
+        try:
+            plane = self._plane(dealer)
+            pod = self._bound_replica(client, dealer)
+            plane.note_drain(
+                pod.uid, pod.name, "default", "v5p-host-0",
+                expires_at=10.0,
+            )
+            assert pod.uid in plane._leased_uids()
+        finally:
+            dealer.close()
