@@ -3,7 +3,7 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm batch-4k sim-smoke sim-multipool sim-het sim-defrag sim-batch sim-serve chaos-soak obs-check timeline-check fanout-4k image clean
+.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm batch-4k sim-smoke sim-multipool sim-het sim-defrag sim-batch sim-serve chaos-soak obs-check timeline-check fanout-4k ha-soak image clean
 
 # Default verification tier: static analysis, then the fast inner loop
 # (test-fast includes sim-smoke), then the observability gate, then the
@@ -11,7 +11,7 @@ TAG ?= latest
 # certifications and the sharded 4096-host fan-out gate (FAST=1 skips
 # those three). The tier-1 gate (`pytest tests/ -m 'not slow'` over
 # everything) is unchanged — run it via `make test` / CI.
-all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag sim-batch sim-serve fanout-4k batch-4k
+all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag sim-batch sim-serve fanout-4k batch-4k ha-soak
 
 # nanolint (docs/static-analysis.md): AST invariant passes over the
 # scheduler's concurrency & determinism contracts — lock discipline,
@@ -216,6 +216,28 @@ sim-serve:
 #        AB_KEY=gangstorm_events_per_s
 gang-storm: native
 	python bench.py --gang-storm
+
+# HA failover gate (docs/ha.md): the chaos fault plan with the ACTIVE
+# dealer killed in every phase (quiet/burst/brownout/post-restart/late)
+# and a warm standby promoting each time, run TWICE
+# (--check-determinism) — exits nonzero on any invariant violation
+# (double-binds, promoted-vs-truth or standby-vs-truth drift) or digest
+# divergence — then the HA test suite, then the bench half: the
+# kill-mid-bind-storm failover row (p99 < 1s, zero view/renderer builds
+# on the standby's first post-promotion Filter, asserted in-bench) and
+# the warm-restart A/B (local checkpoint >= 5x faster than the full
+# annotation replay over the apiserver). `FAST=1 make all` skips it
+# (same rule as sim-het).
+ha-soak: native
+	@if [ "$(FAST)" = "1" ]; then \
+		echo "ha-soak: skipped (FAST=1)"; \
+	else \
+		NANOTPU_LOCK_WITNESS=1 python -m nanotpu.sim \
+			--scenario examples/sim/ha-crash.json --seed 0 \
+			--check-determinism > /dev/null && \
+		python -m pytest tests/test_ha.py -q && \
+		python bench.py --ha-soak; \
+	fi
 
 # The 4096-host multi-pool churn scenario through the sharded dealer,
 # run TWICE (--check-determinism): exits nonzero on any invariant

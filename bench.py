@@ -1733,6 +1733,417 @@ def run_gang_storm(seed: int = 0) -> dict:
     return out
 
 
+def _ha_available() -> bool:
+    """Feature detection (bench_ab runs this SAME file against base refs
+    that predate the HA plane): the failover/warm-restart rows no-op
+    there instead of crashing the whole bench."""
+    try:
+        import nanotpu.ha  # noqa: F401
+    except ImportError:  # pragma: no cover - base-ref worktrees only
+        return False
+    return True
+
+
+def run_failover(n_failovers: int = 6, n_hosts: int = 256,
+                 n_pods: int = 192, workers: int = 4,
+                 lease_ttl_s: float = 0.25,
+                 ha_period_s: float = 0.02) -> dict:
+    """The failover row (docs/ha.md): kill the active mid-bind-storm,
+    measure failover-to-first-successful-bind.
+
+    Per repetition: an ACTIVE dealer (HTTP server, leader lease, delta
+    log) and a WARM STANDBY (own dealer + standby-mode controller with
+    live informer watches + HACoordinator tailing the log via an HALoop
+    thread, own HTTP server answering binds 503 NotLeader) share one
+    mock cluster. ``workers`` binder threads replay pre-placed binds
+    over live HTTP; at half the workload the active is KILLED (loop
+    stopped, server shut down, dealer closed — it stops renewing the
+    lease), the binders retarget the standby's port, and the clock runs
+    from the kill to the first bind the PROMOTED standby commits — so
+    the measured latency includes the full detection path: lease TTL
+    expiry, steal, promotion reconcile, and the first write.
+
+    In-bench asserts: every pod binds exactly once across the failover
+    (idempotent retries — zero double-binds by uid), the standby's
+    FIRST post-promotion Filter performs zero view/renderer builds (its
+    views were warmed by the streamed `view` hints), and failover p99
+    < 1s."""
+    from nanotpu.controller.controller import Controller
+    from nanotpu.ha import DeltaLog, HACoordinator, HALoop, LeaderLease
+
+    import gc
+
+    nodes = [f"v5p-host-{i}" for i in range(n_hosts)]
+    failover_s: list[float] = []
+    apply_rates: list[float] = []
+    emit_rates: list[float] = []
+    first_filter_attrs: list[dict] = []
+    reconciled: list[int] = []
+    for rep in range(n_failovers):
+        client = make_mock_cluster(n_hosts, CHIPS_PER_HOST)
+        log_ = DeltaLog()
+        active = Dealer(client, make_rater("binpack"), ha_log=log_)
+        lease_a = LeaderLease(client, "rep-a", ttl_s=lease_ttl_s)
+        assert lease_a.try_acquire()
+        co_a = HACoordinator(active, role="active", log_=log_,
+                             lease=lease_a)
+        api_a = SchedulerAPI(active, Registry())
+        api_a.attach_ha(co_a)
+        srv_a = serve(api_a, 0, host="127.0.0.1")
+        api_a.stop_idle_gc()
+        loop_a = HALoop(co_a, period_s=ha_period_s)
+        loop_a.start()
+
+        standby = Dealer(client, make_rater("binpack"))
+        sc = Controller(client, standby, resync_period_s=0,
+                        assume_ttl_s=0)
+        sc.enter_standby()
+        co_b = HACoordinator(
+            standby, role="standby", source=log_, controller=sc,
+            lease=LeaderLease(client, "rep-b", ttl_s=lease_ttl_s),
+        )
+        api_b = SchedulerAPI(standby, Registry())
+        api_b.attach_ha(co_b)
+        srv_b = serve(api_b, 0, host="127.0.0.1")
+        api_b.stop_idle_gc()
+        sc.start()  # live informer watches feed the dirty window
+        promoted = threading.Event()
+        loop_b = HALoop(co_b, period_s=ha_period_s,
+                        on_promote=promoted.set)
+        loop_b.start()
+
+        # standby pre-promotion leader gate: binds answer NotLeader
+        probe = HttpClient("127.0.0.1", srv_b.server_address[1])
+        r = probe.post_raw("/scheduler/bind", {
+            "PodName": "gate-probe", "PodNamespace": "default",
+            "PodUID": "gate-probe", "Node": nodes[0],
+        })
+        assert b"NotLeader" in r, r
+
+        # warm the full-candidate view on the ACTIVE: its build streams
+        # a `view` hint the standby applies, which is what makes the
+        # post-promotion zero-build assert meaningful
+        warm_pod = make_pod("fo-warm", containers=[
+            make_container("t", {types.RESOURCE_TPU_PERCENT: 100})
+        ])
+        args = json.dumps({"Pod": warm_pod.raw, "NodeNames": nodes},
+                          separators=_GO_SEP).encode()
+        conn_a = HttpClient("127.0.0.1", srv_a.server_address[1])
+        conn_a.post_raw("/scheduler/filter", args)
+        conn_a.post_raw("/scheduler/priorities", args)
+
+        prepared: "queue.Queue[tuple[str, bytes]]" = queue.Queue()
+        for i in range(n_pods):
+            name = f"fo{rep}-{i}"
+            pod = client.create_pod(make_pod(name, containers=[
+                make_container("t", {types.RESOURCE_TPU_PERCENT: 100})
+            ]))
+            body = json.dumps({
+                "PodName": name, "PodNamespace": "default",
+                "PodUID": pod.uid, "Node": nodes[i % n_hosts],
+            }).encode()
+            prepared.put((name, body))
+
+        endpoint = {"port": srv_a.server_address[1]}
+        standby_port = srv_b.server_address[1]
+        t_kill = [0.0]
+        first_ok = [0.0]
+        bound_n = [0]
+        count_lock = threading.Lock()
+        binder_errors: list[str] = []
+
+        def binder():
+            conn = None
+            conn_port = -1
+            while True:
+                try:
+                    _name, body = prepared.get_nowait()
+                except queue.Empty:
+                    return
+                deadline = time.monotonic() + 30.0
+                while True:
+                    if time.monotonic() > deadline:
+                        binder_errors.append("bind retry timeout")
+                        return
+                    port = endpoint["port"]
+                    try:
+                        if conn is None or conn_port != port:
+                            conn = HttpClient("127.0.0.1", port)
+                            conn_port = port
+                        r = conn.post_raw("/scheduler/bind", body)
+                    except (ConnectionError, OSError):
+                        conn = None
+                        time.sleep(0.002)
+                        continue
+                    if b'"Error":""' in r:
+                        with count_lock:
+                            bound_n[0] += 1
+                            # the failover clock stops at the first bind
+                            # the PROMOTED replica commits — a straggler
+                            # completing on the dying active's keep-alive
+                            # socket is not a failover success
+                            if (
+                                t_kill[0]
+                                and not first_ok[0]
+                                and conn_port == standby_port
+                            ):
+                                first_ok[0] = time.perf_counter()
+                        break
+                    # NotLeader/dead-dealer answer: spaced retry, like
+                    # kube-scheduler's own backoff
+                    time.sleep(0.002)
+
+        storm_t0 = time.perf_counter()
+        threads = [threading.Thread(target=binder, daemon=True)
+                   for _ in range(workers)]
+        for t in threads:
+            t.start()
+        # kill mid-storm: wait for half the pods, then pull the plug
+        while True:
+            with count_lock:
+                if bound_n[0] >= n_pods // 2:
+                    break
+            time.sleep(0.001)
+        applied_pre_kill = co_b.applied_deltas
+        storm_elapsed = time.perf_counter() - storm_t0
+        kill_t0 = time.perf_counter()
+        with count_lock:
+            t_kill[0] = kill_t0
+        # the kill, in crash order: the gate dies first (in-flight
+        # requests on kept-alive sockets answer NotLeader, exactly as a
+        # demoted replica would), binders retarget, then the process
+        # teardown — lease renewals stop, sockets close, pools drain.
+        # One in-flight write may still land (a real crash has the same
+        # window; bind idempotency covers the retry).
+        co_a.role = "standby"
+        endpoint["port"] = standby_port
+        loop_a.stop()
+        srv_a.shutdown()
+        srv_a.server_close()
+        active.close()
+        assert promoted.wait(timeout=10.0), "standby never promoted"
+        # first post-promotion Filter: must cost zero view/renderer
+        # builds — the streamed view hints left the standby warm
+        pre = standby.perf_totals()
+        r = probe.post_raw("/scheduler/filter", args)
+        assert b"NodeNames" in r, r
+        post = standby.perf_totals()
+        attr = {
+            "view_builds": post["view_builds"] - pre["view_builds"],
+            "renderer_builds": (
+                post["renderer_builds"] - pre["renderer_builds"]
+            ),
+        }
+        first_filter_attrs.append(attr)
+        assert attr["view_builds"] == 0, attr
+        assert attr["renderer_builds"] == 0, attr
+        for t in threads:
+            t.join(timeout=40.0)
+        assert not binder_errors, binder_errors
+        assert bound_n[0] == n_pods, (bound_n[0], n_pods)
+        assert first_ok[0] > 0.0
+        failover_s.append(first_ok[0] - kill_t0)
+        reconciled.append(co_b.reconciled_pods)
+        # zero double-binds: exactly n_pods placements in the durable
+        # annotations, and the promoted dealer converges to tracking
+        # every one (its live controller drains any sync still in
+        # flight from the promotion window)
+        occ_truth = sum(1 for p in client.list_pods() if p.node_name)
+        assert occ_truth == n_pods, (occ_truth, n_pods)
+        deadline = time.monotonic() + 5.0
+        while True:
+            tracked = standby.debug_snapshot()["tracked_uids"]
+            if len(tracked) == n_pods:
+                break
+            assert time.monotonic() < deadline, (len(tracked), n_pods)
+            time.sleep(0.01)
+        apply_rates.append(
+            applied_pre_kill / storm_elapsed if storm_elapsed else 0.0
+        )
+        emit_rates.append(
+            log_.seq / storm_elapsed if storm_elapsed else 0.0
+        )
+        # teardown
+        loop_b.stop()
+        srv_b.shutdown()
+        srv_b.server_close()
+        sc.stop()
+        standby.close()
+        gc.collect()
+    failover_s.sort()
+    p50 = percentile(failover_s, 0.50)
+    p99 = percentile(failover_s, 0.99)
+    assert p99 < 1.0, (
+        f"failover-to-first-bind p99 {p99 * 1000:.1f}ms >= 1s budget",
+        failover_s,
+    )
+    return {
+        "failover_to_first_bind_ms_p50": round(p50 * 1000, 2),
+        "failover_to_first_bind_ms_p99": round(p99 * 1000, 2),
+        "failover_ms_all": [round(s * 1000, 2) for s in failover_s],
+        "failover_reps": n_failovers,
+        "failover_lease_ttl_ms": round(lease_ttl_s * 1000, 1),
+        "failover_reconciled_pods": reconciled,
+        "failover_apply_per_s_median": round(
+            statistics.median(apply_rates), 1
+        ),
+        "failover_emit_per_s_median": round(
+            statistics.median(emit_rates), 1
+        ),
+        "failover_first_filter_attr": first_filter_attrs,
+    }
+
+
+class _MiniApiServer:
+    """Read-only apiserver over a FakeClientset, served through the
+    repo's own lean HTTP handler (routes.serve): the cold-restart
+    baseline's list calls cross real HTTP+JSON exactly as a production
+    restart's do (the ISSUE's motivation is literally 'a cold O(fleet)
+    annotation replay over the apiserver') — while the warm restart
+    reads a local file and makes ZERO apiserver calls. That gap is the
+    feature being measured."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def dispatch(self, method: str, path: str,
+                 body: bytes) -> tuple[int, str, str]:
+        import urllib.parse
+
+        path, _, query = path.partition("?")
+        if method != "GET":
+            return 404, "application/json", "{}"
+        if path == "/api/v1/nodes":
+            return 200, "application/json", json.dumps(
+                {"items": [n.raw for n in self.client.list_nodes()]},
+                separators=_GO_SEP,
+            )
+        if path == "/api/v1/pods":
+            sel = None
+            params = urllib.parse.parse_qs(query)
+            if params.get("labelSelector"):
+                sel = dict(
+                    kv.split("=", 1)
+                    for kv in params["labelSelector"][0].split(",")
+                    if "=" in kv
+                )
+            return 200, "application/json", json.dumps(
+                {"items": [
+                    p.raw
+                    for p in self.client.list_pods(label_selector=sel)
+                ]},
+                separators=_GO_SEP,
+            )
+        if path.startswith("/api/v1/nodes/"):
+            try:
+                node = self.client.get_node(path.rsplit("/", 1)[1])
+            except Exception:
+                return 404, "application/json", "{}"
+            return 200, "application/json", json.dumps(
+                node.raw, separators=_GO_SEP
+            )
+        return 404, "application/json", "{}"
+
+
+def run_warm_restart(n_hosts: int = 4096, n_pods: int = 2048,
+                     reps: int = 5,
+                     require_ratio: float | None = 5.0) -> dict:
+    """The warm-restart row (docs/ha.md): a 4096-host dealer rebuilt
+    from its local checkpoint (snapshot + delta tail) vs the full
+    annotation replay over the apiserver, interleaved A/B in one
+    process so both sides see the same heap and the same box-noise
+    minute. The cold side boots through a RestClientset against an
+    HTTP apiserver shim (real wire bytes, both list calls); the warm
+    side boots through the SAME client but never calls it — the local
+    checkpoint is the whole point. Both paths must reconstruct the
+    exact same occupancy; the ratio is the acceptance number
+    (checkpoint >= ``require_ratio`` x faster)."""
+    import gc
+    import tempfile
+
+    from nanotpu.k8s.rest import RestClientset
+
+    client = make_mock_cluster(n_hosts, CHIPS_PER_HOST)
+    nodes = [f"v5p-host-{i}" for i in range(n_hosts)]
+    setup = Dealer(client, make_rater("binpack"))
+    for i in range(n_pods):
+        pod = client.create_pod(make_pod(f"wr-{i}", containers=[
+            make_container("t", {types.RESOURCE_TPU_PERCENT: 200})
+        ]))
+        setup.bind(nodes[i % n_hosts], pod)
+    occ = setup.occupancy()
+    path = tempfile.mktemp(prefix="nanotpu-ckpt-")
+    setup.write_checkpoint(path)
+    setup.close()
+    apiserver = serve(_MiniApiServer(client), 0, host="127.0.0.1")
+    rest = RestClientset(
+        f"http://127.0.0.1:{apiserver.server_address[1]}"
+    )
+    cold_s: list[float] = []
+    warm_s: list[float] = []
+    try:
+        for _ in range(reps):
+            gc.collect()
+            t0 = time.perf_counter()
+            d = Dealer(rest, make_rater("binpack"))
+            cold_s.append(time.perf_counter() - t0)
+            assert abs(d.occupancy() - occ) < 1e-9, (d.occupancy(), occ)
+            d.close()
+            gc.collect()
+            t0 = time.perf_counter()
+            d = Dealer(rest, make_rater("binpack"), restore_from=path)
+            warm_s.append(time.perf_counter() - t0)
+            assert abs(d.occupancy() - occ) < 1e-9, (d.occupancy(), occ)
+            assert len(d.debug_snapshot()["tracked_uids"]) == n_pods
+            d.close()
+    finally:
+        apiserver.shutdown()
+        apiserver.server_close()
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    cold = statistics.median(cold_s)
+    warm = statistics.median(warm_s)
+    ratio = cold / warm if warm else 0.0
+    if require_ratio is not None:
+        assert ratio >= require_ratio, (
+            f"warm restart only {ratio:.2f}x faster than annotation "
+            f"replay (cold {cold:.3f}s vs warm {warm:.3f}s)",
+            cold_s, warm_s,
+        )
+    return {
+        "warmrestart_hosts": n_hosts,
+        "warmrestart_pods": n_pods,
+        "warmrestart_cold_s_median": round(cold, 4),
+        "warmrestart_cold_s_all": [round(s, 4) for s in cold_s],
+        "warmrestart_warm_s_median": round(warm, 4),
+        "warmrestart_warm_s_all": [round(s, 4) for s in warm_s],
+        "warmrestart_ratio": round(ratio, 2),
+        "warmrestart_note": (
+            "cold = full annotation replay over HTTP (RestClientset "
+            "against an in-process apiserver shim serving the same "
+            "FakeClientset state); warm = local checkpoint snapshot + "
+            "delta tail, zero apiserver calls"
+        ),
+    }
+
+
+def run_ha_soak() -> dict:
+    """``make ha-soak``'s bench half: the failover row + the
+    warm-restart A/B, with every acceptance assert in-bench (an
+    AssertionError exits nonzero). No-ops with a note on pre-HA bases
+    (bench_ab compatibility)."""
+    if not _ha_available():
+        return {"ha_skipped": "nanotpu.ha unavailable on this ref"}
+    out = run_failover()
+    import gc
+
+    gc.collect()
+    out.update(run_warm_restart())
+    return out
+
+
 def run_once() -> tuple[list[float], float, int, float]:
     """One full 32-pod scenario; returns (latencies, elapsed, bound, occ%)."""
     client = make_mock_cluster(N_HOSTS, CHIPS_PER_HOST)
@@ -1831,6 +2242,11 @@ def run() -> dict:
     # plus the packing-quality proof (packing_*) on the dedicated fleet
     batch4k = run_batch_4k()
     gc.collect()
+    # ha_* = the failover + warm-restart rows (docs/ha.md), feature-
+    # detected away on pre-HA base refs; measured last so their server
+    # churn cannot depress the read-path rows above
+    ha = run_ha_soak()
+    gc.collect()
     run_once()  # warmup: module-level caches (topology link bounds, demand
     # hashes, compactness) persist across repetitions, as in a live scheduler
     latencies: list[float] = []
@@ -1892,6 +2308,7 @@ def run() -> dict:
     out.update(het)
     out.update(bindstorm)
     out.update(batch4k)
+    out.update(ha)
     out["host_loadavg_start"] = load_start
     out["host_loadavg_end"] = [round(x, 2) for x in os.getloadavg()]
     out["host_cpu_count"] = os.cpu_count()
@@ -1958,6 +2375,22 @@ if __name__ == "__main__":
         # (AB_KEY=batch4k_pods_per_s): batch on this tree, pod-at-a-time
         # on a pre-ABI-8 base — the r11-vs-r12 acceptance ratio
         print(json.dumps(run_batch_4k_rep()))
+    elif "--ha-soak" in sys.argv:
+        # `make ha-soak`'s bench half (docs/ha.md): the failover row
+        # (kill the active mid-bind-storm; p99 < 1s, zero double-binds,
+        # zero view/renderer builds on the standby's first
+        # post-promotion Filter) + the warm-restart A/B (checkpoint >=
+        # 5x faster than the annotation replay over the apiserver) —
+        # every acceptance assert runs in-bench, an AssertionError
+        # exits nonzero. No-ops with a note on pre-HA base refs.
+        print(json.dumps(run_ha_soak()))
+    elif "--failover-rep" in sys.argv:
+        # one failover rep, for bench_ab.py-style drives; answers a
+        # stub on pre-HA bases so the same file runs everywhere
+        print(json.dumps(
+            run_failover(n_failovers=1) if _ha_available()
+            else {"ha_skipped": "nanotpu.ha unavailable on this ref"}
+        ))
     elif "--bind-storm" in sys.argv:
         # the full bind-storm row (median of 3 reps, in-bench asserts)
         print(json.dumps(run_bind_storm_reps()))

@@ -21,8 +21,25 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass, field
 
+from functools import lru_cache
+
 from nanotpu import types
 from nanotpu.topology import Torus
+
+
+@lru_cache(maxsize=64)
+def _torus_for(chip_count: int, topology_spec: str,
+               generation: str) -> Torus:
+    """Shared Torus per (count, topology, generation) — a fleet has a
+    handful of shapes, and the warm-restart path resolves one per node.
+    Tori are immutable, so sharing is safe (ChipSet already relies on
+    that: ``for_node`` instances share cached spec parses)."""
+    if topology_spec:
+        torus = Torus.from_spec(topology_spec, generation)
+        if torus.num_chips != chip_count:
+            torus = Torus((chip_count, 1, 1), generation)
+        return torus
+    return Torus((chip_count, 1, 1), generation)
 
 
 @dataclass
@@ -232,6 +249,37 @@ class ChipSet:
                 for _ in range(torus.num_chips)
             ],
         )
+
+    @staticmethod
+    def restore(chip_count: int, topology_spec: str | None,
+                generation: str, rows: list) -> "ChipSet":
+        """Rebuild from checkpointed per-chip state (docs/ha.md warm
+        restart): ``rows`` = ``[percent_free, percent_total,
+        hbm_free_mib, hbm_total_mib, load]`` per chip, exactly what
+        :meth:`chip_rows` wrote. Bypasses the dataclass constructor —
+        the restart path builds tens of thousands of chips and the
+        field-by-field ``__init__`` was a measured quarter of the whole
+        warm boot."""
+        torus = _torus_for(chip_count, topology_spec or "", generation)
+        chips: list[ChipResource] = []
+        for free, total, hbm_free, hbm_total, load in rows:
+            c = ChipResource.__new__(ChipResource)
+            c.percent_free = free
+            c.percent_total = total
+            c.load = load
+            c.hbm_free_mib = hbm_free
+            c.hbm_total_mib = hbm_total
+            chips.append(c)
+        return ChipSet(torus, chips)
+
+    def chip_rows(self) -> list[list]:
+        """Checkpoint serialization of per-chip state (see
+        :meth:`restore`)."""
+        return [
+            [c.percent_free, c.percent_total, c.hbm_free_mib,
+             c.hbm_total_mib, round(c.load, 6)]
+            for c in self.chips
+        ]
 
     def __len__(self) -> int:
         return len(self.chips)

@@ -55,6 +55,10 @@ SCOPE = (
     # virtual replica fleet stands in for it)
     "nanotpu.serving.feedback", "nanotpu.serving.autoscale",
     "nanotpu.metrics.serving",
+    # the HA plane (docs/ha.md): the sim drives the REAL delta log,
+    # lease, and coordinator on virtual time, so all three must draw
+    # time only from their injectable clocks
+    "nanotpu.ha", "nanotpu.metrics.ha",
     "nanotpu.k8s.objects", "nanotpu.k8s.client", "nanotpu.k8s.resilience",
     "nanotpu.k8s.events",
     "nanotpu.metrics.resilience", "nanotpu.metrics.stats",

@@ -48,6 +48,10 @@ SCOPE = (
     # stays out of scope: its _cv legitimately wraps device-blocking
     # decode work, a different discipline than the scheduler's locks.
     "nanotpu.serving.feedback", "nanotpu.serving.autoscale",
+    # the HA plane (docs/ha.md): the delta log is appended on the bind
+    # hot path, and the coordinator's role lock nests with nothing by
+    # contract — promotion's reconcile (apiserver syncs) runs outside it
+    "nanotpu.ha",
 )
 
 #: locks whose critical sections are the scheduling hot path: blocking
@@ -68,10 +72,14 @@ SCOPE = (
 #: (a GIL-releasing native crossing) and its commit fan-out (apiserver
 #: writes) both run OUTSIDE it by contract — a blocking call inside it
 #: would serialize /debug scrapes behind a batch cycle.
+#: ``DeltaLog._lock`` guards the HA delta ring (docs/ha.md): every
+#: commit point on the write path appends under it, so its critical
+#: sections must stay append-only — checkpoint file I/O batches OUTSIDE
+#: it by contract.
 HOT_LOCKS = (
     "Dealer._lock", "Dealer._publish_lock", "_Shard._publish_lock",
     "_Shard._pending_lock", "ThroughputModel._lock",
-    "BatchAdmitter._lock",
+    "BatchAdmitter._lock", "DeltaLog._lock",
 )
 
 #: per-node reservation locks (docs/bind-pipeline.md): the commit

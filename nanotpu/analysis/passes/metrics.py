@@ -278,6 +278,8 @@ class _MetricsPass:
         slogauges_mod: Module | None = None
         srvgauges: dict[str, int] | None = None
         srvgauges_mod: Module | None = None
+        hagauges: dict[str, int] | None = None
+        hagauges_mod: Module | None = None
         for mod in modules:
             d = _declared_resilience(mod)
             if d is not None:
@@ -306,6 +308,9 @@ class _MetricsPass:
             sv = _declared_gauge_table(mod, "_SERVING_GAUGES")
             if sv is not None:
                 srvgauges, srvgauges_mod = sv, mod
+            hg = _declared_gauge_table(mod, "_HA_GAUGES")
+            if hg is not None:
+                hagauges, hagauges_mod = hg, mod
 
         inc_sites: dict[str, tuple[str, int]] = {}
         perf_incs: dict[str, tuple[str, int]] = {}
@@ -428,6 +433,7 @@ class _MetricsPass:
             ("timeline", tlgauges, tlgauges_mod, "tick_gauge_values"),
             ("slo", slogauges, slogauges_mod, "slo_gauge_values"),
             ("serving", srvgauges, srvgauges_mod, "serving_gauge_values"),
+            ("ha", hagauges, hagauges_mod, "ha_gauge_values"),
         ):
             if table is not None and table_mod is not None:
                 findings.extend(self._check_gauge_table(

@@ -271,6 +271,18 @@ def make_rlock(name: str):
     return threading.RLock()
 
 
+def rlock_factory(name: str):
+    """A zero-arg RLock constructor with ``active()`` resolved ONCE —
+    for bulk construction sites (the warm restart builds thousands of
+    NodeInfo locks, and one env probe per lock was a measured slice of
+    the whole boot). Same witness coverage as :func:`make_rlock`: the
+    activation decision just moves to factory creation time, which is
+    when the per-lock decision was made anyway."""
+    if active():
+        return lambda: _WitnessLock(threading.RLock(), name, _GLOBAL)
+    return threading.RLock
+
+
 def make_condition(name: str):
     """A ``threading.Condition`` whose underlying RLock is instrumented;
     ``wait()`` releases/re-acquires THROUGH the witness so the held

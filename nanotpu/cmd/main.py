@@ -191,6 +191,36 @@ def build_app(argv: list[str] | None = None):
         "faulthandler stacks land in PATH.stacks on hard crashes",
     )
     parser.add_argument(
+        "--ha", action="store_true",
+        help="HA replica pair (docs/ha.md): race for the leader lease; "
+        "the winner serves as the ACTIVE (emitting its delta stream on "
+        "GET /debug/ha), the loser runs as a warm STANDBY — informer "
+        "cache + delta tail, /readyz 503 NotReady with Role standby, "
+        "binds gated 503 NotLeader — and promotes in <1s on lease loss",
+    )
+    parser.add_argument(
+        "--ha-peer", default="", metavar="URL",
+        help="the active replica's base URL (with --ha): the standby "
+        "tails GET /debug/ha from it; without a peer the standby "
+        "promotes via one full resync instead of the O(lag) window",
+    )
+    parser.add_argument(
+        "--ha-checkpoint", default="", metavar="PATH",
+        help="local delta checkpoint (with --ha): the active appends "
+        "its delta stream to PATH, and a restart warm-boots from the "
+        "snapshot+tail instead of the O(fleet) annotation scan",
+    )
+    parser.add_argument(
+        "--ha-lease-ttl", type=float, default=3.0, metavar="S",
+        help="leader lease TTL: a standby steals the lease (and "
+        "promotes) once the active's renewTime is this stale",
+    )
+    parser.add_argument(
+        "--ha-period", type=float, default=0.5, metavar="S",
+        help="HA loop cadence: lease renew/steal probes and the "
+        "standby's delta tail (must be < ha-lease-ttl / 2)",
+    )
+    parser.add_argument(
         "--serving-stats-url", default="", metavar="URL",
         help="scheduler<->serving feedback (docs/serving-loop.md): poll "
         "a serving replica's /v1/stats at URL, export the fleet's "
@@ -249,6 +279,10 @@ def build_app(argv: list[str] | None = None):
         client, rater, recorder=recorder, obs=obs,
         shards="auto" if args.shards == "auto" else 1,
         pipeline_depth=max(args.pipeline_depth, 1),
+        # warm restart (docs/ha.md): boot from the local checkpoint's
+        # snapshot + delta tail when one exists; a missing/corrupt file
+        # falls back to the full annotation replay inside Dealer
+        restore_from=(args.ha_checkpoint if args.ha else ""),
     )
     registry = Registry()
     api = SchedulerAPI(
@@ -292,6 +326,87 @@ def main(argv: list[str] | None = None) -> int:
             policy=api.policy_watcher,
         )
 
+    # HA role machinery (docs/ha.md): decide the role by racing for the
+    # leader lease, then run the HALoop (renew as active; tail + steal
+    # as standby). A standby defers the write-side loops (recovery,
+    # batch) to promotion — their restart-safe start() makes that a
+    # plain callback.
+    ha_loop = None
+    #: write-side loops (recovery, batch): started when this replica IS
+    #: the leader, stopped on demotion (the HTTP gate only covers
+    #: bind/batchadmit — these loops commit apiserver writes
+    #: in-process), restarted on promotion (start() is restart-safe)
+    write_loops: list = []
+    if args.ha:
+        import socket as _socket
+
+        from nanotpu.ha import (
+            DeltaLog,
+            HACoordinator,
+            HALoop,
+            LeaderLease,
+        )
+        from nanotpu.ha.standby import HttpDeltaSource
+
+        holder = f"{_socket.gethostname()}-{os.getpid()}"
+        lease = LeaderLease(client, holder, ttl_s=args.ha_lease_ttl)
+        if lease.try_acquire():
+            ha_log = DeltaLog(path=args.ha_checkpoint)
+            if args.ha_checkpoint:
+                # fresh snapshot so the NEXT restart replays only the
+                # tail appended after this point
+                dealer.write_checkpoint(args.ha_checkpoint)
+            dealer.ha = ha_log
+            coordinator = HACoordinator(
+                dealer, role="active", log_=ha_log, lease=lease,
+            )
+            log.info("HA: leader lease acquired; serving as ACTIVE")
+        else:
+            source = (
+                HttpDeltaSource(args.ha_peer) if args.ha_peer else None
+            )
+            coordinator = HACoordinator(
+                dealer, role="standby", source=source,
+                controller=controller, lease=lease,
+            )
+            if source is None:
+                # no stream to tail: promotion falls back to one full
+                # resync (still bounded by the informer list)
+                coordinator.stale = True
+            controller.enter_standby()
+            log.info(
+                "HA: lease held elsewhere; serving as warm STANDBY "
+                "(peer=%s)", args.ha_peer or "<none: resync-on-promote>",
+            )
+        # a promotion's fresh delta log keeps persisting the restart
+        # checkpoint (the warm-restart feature must survive its own
+        # failover)
+        coordinator.checkpoint_path = args.ha_checkpoint
+        api.attach_ha(coordinator)
+
+        def _on_promote():
+            for loop in write_loops:
+                loop.start()  # restart-safe by contract
+
+        def _on_demote():
+            for loop in write_loops:
+                loop.stop()
+
+        ha_loop = HALoop(
+            coordinator, period_s=args.ha_period,
+            on_promote=_on_promote, on_demote=_on_demote,
+        )
+
+    def _start_or_defer(loop) -> None:
+        """Track a write-side loop for leadership transitions, starting
+        it now only when this replica IS the leader (single replica /
+        active) — a standby must never preempt, migrate, or
+        batch-commit."""
+        write_loops.append(loop)
+        if not (args.ha and api.ha is not None
+                and not api.ha.is_leader()):
+            loop.start()
+
     batch_loop = None
     if args.batch:
         from nanotpu.dealer.admit import BatchAdmitter, BatchLoop
@@ -303,7 +418,7 @@ def main(argv: list[str] | None = None) -> int:
         )
         dealer.batch = admitter  # /debug/decisions + /scheduler/batchadmit
         batch_loop = BatchLoop(admitter, period_s=args.batch_period)
-        batch_loop.start()
+        _start_or_defer(batch_loop)
 
     recovery_loop = None
     if args.recovery:
@@ -324,7 +439,7 @@ def main(argv: list[str] | None = None) -> int:
         dealer.recovery = plane  # /debug/decisions surfaces its status
         api.registry.register(RecoveryExporter(plane))
         recovery_loop = RecoveryLoop(plane, period_s=args.recovery_period)
-        recovery_loop.start()
+        _start_or_defer(recovery_loop)
 
     telemetry_loop = None
     if args.timeline_period > 0 or args.flight_recorder:
@@ -395,6 +510,13 @@ def main(argv: list[str] | None = None) -> int:
         if api.timeline is not None:
             api.timeline.register_source(serving_source)
 
+    if ha_loop is not None:
+        # started after the telemetry/flight wiring so a promotion's
+        # flight dump has a recorder to land in
+        if api.flight is not None:
+            api.ha.flight = api.flight
+        ha_loop.start()
+
     server = serve(api, args.port)
     log.info(
         "nanotpu extender serving on :%d (policy=%s, mock=%s)",
@@ -414,6 +536,17 @@ def main(argv: list[str] | None = None) -> int:
             # the shutdown bundle: the last pre-exit state, before the
             # stack starts tearing down underneath the taps
             api.flight.dump("shutdown")
+        if ha_loop is not None:
+            ha_loop.stop()
+            if api.ha is not None and api.ha.is_leader():
+                # cooperative handoff (the zero-downtime upgrade path,
+                # docs/ha.md): blank the lease so the standby's next
+                # probe acquires instantly instead of waiting out the
+                # TTL, and leave a fresh checkpoint for our own restart
+                if args.ha_checkpoint:
+                    dealer.write_checkpoint(args.ha_checkpoint)
+                if api.ha.lease is not None:
+                    api.ha.lease.release()
         if recovery_loop is not None:
             recovery_loop.stop()
         if batch_loop is not None:

@@ -181,6 +181,19 @@ class Controller:
         #: set once the initial list (or a later resync) has fed the dealer
         #: — the informer-sync half of /readyz
         self._synced = threading.Event()
+        #: HA standby mode (docs/ha.md): True while this process is the
+        #: warm standby. Informer events then update the cache and the
+        #: dirty-key window ONLY — the delta stream drives the standby's
+        #: dealer; node events still apply (pure in-memory, idempotent
+        #: with the stream's node records).
+        self.standby = False
+        #: pod key -> (event type, pod) for events seen while standby
+        #: whose matching delta has not arrived; at promotion the
+        #: remainder IS the reconcile window — O(delta), not O(fleet).
+        #: Bounded by HA_DIRTY_MAX: overflow latches
+        #: ``_dirty_overflow`` and promotion full-resyncs instead.
+        self._dirty: dict[str, tuple] = {}
+        self._dirty_overflow = False
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -220,6 +233,65 @@ class Controller:
         """True once a full pod list has fed the dealer at least once (the
         informer WaitForCacheSync analogue) — /readyz gates on this."""
         return self._synced.is_set()
+
+    # -- HA standby mode (docs/ha.md) --------------------------------------
+    def enter_standby(self) -> None:
+        self.standby = True
+
+    #: dirty-window bound: past it the window overflows and promotion
+    #: falls back to ONE full resync — a peer-less or long-stalled
+    #: standby must not grow an unbounded map it may never drain
+    HA_DIRTY_MAX = 8192
+
+    def exit_standby(self) -> None:
+        """Leave standby mode. Events that arrived DURING the promotion
+        reconcile (after ``ha_take_dirty`` drained the window, while the
+        controller was still routing events into it) are not stale —
+        they are the promotion race window. Flip live first, then hand
+        every leftover to the now-live sync machinery: a completed pod's
+        release must not wait for the next periodic resync."""
+        self.standby = False
+        with self._cache_lock:
+            dirty, self._dirty = self._dirty, {}
+            self._dirty_overflow = False
+        for _key, (etype, pod) in sorted(dirty.items()):
+            if etype == "DELETED":
+                self.dealer.forget(pod)
+            else:
+                self._enqueue(pod, force=True)
+
+    def ha_clear_dirty(self, key: str, kind: str = "released") -> None:
+        """A delta covering this pod arrived: its informer event no
+        longer needs promotion-time reconciliation.
+
+        Kind-aware on purpose: the stream trails the informer, so a
+        ``bound`` record can arrive AFTER the pod's completed/DELETED
+        event was marked dirty — clearing that entry would strand the
+        release in the lost lag window forever (the pod stays tracked on
+        the promoted dealer; caught as a real double-accounting bug by
+        the crash soak). A ``bound`` record therefore only clears
+        non-terminal dirt; ``released`` clears everything."""
+        with self._cache_lock:
+            entry = self._dirty.get(key)
+            if entry is None:
+                return
+            if kind == "bound":
+                etype, pod = entry
+                if etype == "DELETED" or podutil.is_completed_pod(pod):
+                    return  # the terminal event still needs the reconcile
+            self._dirty.pop(key, None)
+
+    def ha_take_dirty(self) -> dict[str, tuple]:
+        """Drain the dirty window (promotion reconcile input)."""
+        with self._cache_lock:
+            dirty, self._dirty = self._dirty, {}
+        return dirty
+
+    def sync_key(self, namespace: str, name: str) -> None:
+        """One synchronous pod sync by key — the promotion reconcile's
+        entry into the exact rules ``_sync_pod`` applies (completed ->
+        release, assumed+placed -> allocate, vanished -> forget)."""
+        self._sync_pod(namespace, name)
 
     def stop(self) -> None:
         self._stop.set()
@@ -264,6 +336,10 @@ class Controller:
 
     def _enqueue(self, pod: Pod, attempt: int = 0,
                  force: bool = False) -> None:
+        if self.standby:
+            # a standby queues no syncs (the delta stream + dirty window
+            # cover it; boot lists and resyncs land in the cache only)
+            return
         self._queue.put((pod.namespace, pod.name, attempt), force=force)
 
     def requeue(self, pod: Pod) -> None:
@@ -287,6 +363,43 @@ class Controller:
         watch stream through here too, so there is exactly one dispatch."""
         pod = event.obj
         if not podutil.is_tpu_sharing_pod(pod):
+            return
+        if self.standby:
+            # standby tailing (docs/ha.md): cache + dirty window only.
+            # The dirty predicate mirrors the active's enqueue rules —
+            # an event the active would not act on needs no
+            # promotion-time reconcile either.
+            key = pod.key()
+            with self._cache_lock:
+                old = self._pod_cache.get(key)
+                mark = None
+                if event.type == "DELETED":
+                    self._pod_cache.pop(key, None)
+                    mark = ("DELETED", pod)
+                else:
+                    self._pod_cache[key] = pod
+                    if podutil.is_completed_pod(pod) or (
+                        podutil.is_assumed(pod)
+                        and (old is None or not podutil.is_assumed(old))
+                    ):
+                        mark = (event.type, pod)
+                if mark is not None and not self._dirty_overflow:
+                    if (
+                        key not in self._dirty
+                        and len(self._dirty) >= self.HA_DIRTY_MAX
+                    ):
+                        # overflow: free the map, latch the flag — the
+                        # promotion reconcile falls back to ONE full
+                        # resync instead of a window nobody can trust
+                        self._dirty.clear()
+                        self._dirty_overflow = True
+                        log.warning(
+                            "ha dirty window overflowed (> %d pods); "
+                            "promotion will full-resync",
+                            self.HA_DIRTY_MAX,
+                        )
+                    else:
+                        self._dirty[key] = mark
             return
         if event.type == "ADDED":
             self._remember(pod)
@@ -334,6 +447,15 @@ class Controller:
                 log.warning("resync failed: %s", e)
 
     def resync_once(self) -> None:
+        if self.standby:
+            # standby: refresh the informer cache + the synced() gate
+            # only; dealer repairs belong to the delta stream until
+            # promotion (docs/ha.md)
+            for pod in self.client.list_pods():
+                if podutil.is_tpu_sharing_pod(pod):
+                    self._remember(pod)
+            self._synced.set()
+            return
         # snapshot BEFORE the list: a pod bound after the list was taken is
         # tracked but legitimately missing from the (older) list — only pods
         # tracked before AND absent after are genuinely gone

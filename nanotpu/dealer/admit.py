@@ -401,6 +401,13 @@ class BatchLoop:
         self._thread: threading.Thread | None = None
 
     def start(self) -> None:
+        """Idempotent AND restart-safe (the old unguarded start stacked
+        a second daemon thread on a double call; an HA promotion
+        restarts the loop against the promoted dealer — pinned by the
+        promote-under-load test)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="batch-admit"
         )
@@ -414,6 +421,9 @@ class BatchLoop:
                 log.exception("batch admission cycle failed")
 
     def stop(self) -> None:
+        """Idempotent; joins (not from the loop's own thread) so the
+        caller can close the dealer immediately after."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=2.0)

@@ -200,6 +200,8 @@ class Dealer:
         shards: int | str = 1,
         pipeline_depth: int = 1,
         coalesce: bool | None = None,
+        ha_log=None,
+        restore_from: str = "",
     ):
         self.client = client
         self.rater = rater
@@ -354,7 +356,42 @@ class Dealer:
             if pipeline_depth > 1 else None
         )
         self._publish_enabled = False
-        self._warm_from_cluster()
+        self._closed = False
+        #: HA delta stream (docs/ha.md): when a
+        #: :class:`nanotpu.ha.delta.DeltaLog` is attached, every commit
+        #: point that already calls ``_republish`` also appends ONE typed
+        #: record (node register/evict, bind/release, usage batches, gang
+        #: park/unpark, view warms) for the warm standby to tail. None ==
+        #: HA off == one attribute check per commit point, zero
+        #: allocations (the bench A/B attribution diff pins it).
+        self.ha = ha_log
+        #: usage samples accumulated between deferred publishes — one
+        #: ``usage`` delta per metric sweep, not one per chip
+        self._ha_usage: list = []
+        # boot-time GC pause (both boot paths): state reconstruction is
+        # an allocation storm — tens of thousands of NodeInfos, chips,
+        # and pod objects — and the cyclic collector's threshold passes
+        # fire repeatedly mid-boot on garbage that is all still live,
+        # measurably stretching restart latency (the same discipline the
+        # bench applies around timed windows)
+        import gc as _gc
+
+        gc_was = _gc.isenabled()
+        if gc_was:
+            _gc.disable()
+        try:
+            restored = False
+            if restore_from:
+                # replay-free warm restart (docs/ha.md): rebuild from
+                # the local checkpoint's snapshot + delta tail instead
+                # of the O(fleet) annotation scan; any failure falls
+                # back whole
+                restored = self._restore_from_checkpoint(restore_from)
+            if not restored:
+                self._warm_from_cluster()
+        finally:
+            if gc_was:
+                _gc.enable()
         self._publish_enabled = True
         self._republish()
         if self._coalesce:
@@ -437,6 +474,7 @@ class Dealer:
                 self.gangs.record_bound(
                     f"{pod.namespace}/{gang[0]}", gang[1], pod.uid, pod.node_name
                 )
+        self._ha_emit("bound", pod=pod.raw)
         return True
 
     # -- node registry -----------------------------------------------------
@@ -484,6 +522,7 @@ class Dealer:
             # THIS node, which the line above just put in _nodes, so the
             # nested _node_info hits the map and never GETs the apiserver
             self._replay_tracked(name)
+        self._ha_emit("node", raw=node.raw)
         return new_info
 
     def _register_node(self, name: str, info: NodeInfo) -> None:
@@ -566,6 +605,7 @@ class Dealer:
         self.usage.forget_node(name)
         if self._rater_forget is not None:
             self._rater_forget(name)
+        self._ha_emit("node_gone", name=name)
         self._republish()
 
     def refresh_node(self, node: Node) -> bool:
@@ -602,6 +642,7 @@ class Dealer:
             # freshly present in _nodes — the nested _node_info never GETs
             self._replay_tracked(node.name)
             self._migrate_reservations(node.name)
+        self._ha_emit("node", raw=node.raw)
         self._republish()
         log.info("node %s rebuilt (new/resized/relabeled)", node.name)
         return info is not None
@@ -927,6 +968,13 @@ class Dealer:
             snap.views[key] = entry
             if entry is None or shard._commit_seq == seq:
                 break
+        if built and entry is not None:
+            # view warm hint (docs/ha.md): the standby pre-builds the
+            # same frozen view + renderer so its first post-promotion
+            # Filter costs zero view/renderer builds. Builds are rare
+            # (structural changes only), so this never rides a steady
+            # request.
+            self._ha_emit("view", names=list(key))
         return entry
 
     def _build_view(self, snap: _Snapshot, key: tuple, perf: PerfCounters):
@@ -2041,6 +2089,7 @@ class Dealer:
                     )
                 barrier.parked.add(pod.uid)
             self._reserved[pod.uid] = my_res
+        self._ha_emit("gang_park", uid=pod.uid, gang=key, node=node_name)
         if trace is not None:
             trace.event("gang:parked", key)
         timeout = podutil.gang_timeout(pod)
@@ -2134,6 +2183,7 @@ class Dealer:
                 res = self._reserved.pop(pod.uid, None)
             if res is not None and res.valid:
                 res.info.unbind(res.plan)
+            self._ha_emit("gang_unpark", uid=pod.uid, gang=key)
             raise
         with barrier.cv:
             barrier.parked.discard(pod.uid)
@@ -2362,7 +2412,9 @@ class Dealer:
                 reason=REASON_POD_RELEASED,
             )
         if needs_replay:
-            self._learn_bound_pod(annotated)
+            self._learn_bound_pod(annotated)  # emits its own HA record
+        else:
+            self._ha_emit("bound", pod=annotated.raw)
         recovery = self.recovery
         if recovery is not None:
             # production lifecycle hooks (docs/defrag.md): a bind landing
@@ -2450,6 +2502,12 @@ class Dealer:
                                 pod.key(), node, e,
                             )
         self.gangs.forget_pod(pod.uid)
+        # every first-sight release emits (tracked or not): the standby
+        # must tombstone the uid too, or a late replayed `bound` could
+        # resurrect a departed pod's chips on its side
+        self._ha_emit(
+            "released", uid=pod.uid, namespace=pod.namespace, name=pod.name,
+        )
         recovery = self.recovery
         if recovery is not None:
             # lifecycle hook: a departed pod's backfill lease is cleaned
@@ -2580,7 +2638,11 @@ class Dealer:
                 reason=REASON_POD_RELEASED,
             )
         if needs_replay:
-            self._learn_bound_pod(annotated)
+            self._learn_bound_pod(annotated)  # emits its own HA record
+        else:
+            # a move is just a `bound` with the new node: the standby's
+            # applier releases the old placement first (docs/ha.md)
+            self._ha_emit("bound", pod=annotated.raw)
         if trace is not None:
             trace.event("migrate:committed", f"{source}->{target_node}")
         self._republish(
@@ -2666,15 +2728,28 @@ class Dealer:
             # version, retiring every plan cached under the old one
             self._rater_observe(node, chip, load, now=now)
         info = self._node_info(node)
+        if self.ha is not None and self._publish_enabled:
+            # batched like the publish itself: one `usage` delta per
+            # sweep (flushed below or by publish_usage), not one per chip
+            self._ha_usage.append([node, chip, core, memory, now])
         if info is not None:
             info.set_chip_load(chip, load)
             if publish:
                 self._republish((node,))
+        if publish:
+            self._ha_flush_usage()
 
     def publish_usage(self, nodes: tuple[str, ...]) -> None:
         """One snapshot publish covering a batch of deferred
         ``update_chip_usage(..., publish=False)`` calls."""
         self._republish(tuple(nodes))
+        self._ha_flush_usage()
+
+    def _ha_flush_usage(self) -> None:
+        if not self._ha_usage:
+            return
+        batch, self._ha_usage = self._ha_usage, []
+        self._ha_emit("usage", samples=batch)
 
     # -- introspection (dealer.go:303-309, routes.go:212-240) --------------
     def status(self) -> dict:
@@ -2834,11 +2909,249 @@ class Dealer:
             + sum(1 for shard in shards if shard._pending_all),
         }
 
+    # -- HA delta stream + checkpoint (docs/ha.md) -------------------------
+    def _ha_emit(self, kind: str, **data) -> None:
+        """Append one record to the attached delta stream. One attribute
+        check when HA is off; boot-time replay never emits (the standby
+        gets boot state from its own warm boot / the checkpoint
+        snapshot, not the stream)."""
+        log_ = self.ha
+        if log_ is not None and self._publish_enabled:
+            log_.emit(kind, data)
+
+    def apply_delta(self, rec: dict) -> bool:
+        """Apply ONE state delta emitted by an active dealer into THIS
+        dealer's live accounting + RCU snapshot chain (the warm
+        standby's tail loop, and the checkpoint tail on warm restart).
+        Every kind is idempotent — re-applied records (the bootstrap
+        overlap window, duplicate tails) converge to the same state:
+        ``bound`` is uid-guarded, ``released`` is tombstoned, node
+        records compare fingerprints. Returns False exactly when a
+        ``bound`` record could not be accounted (a conflict with stale
+        local state) — the applier must then keep the pod in its
+        reconcile window."""
+        kind = rec.get("kind")
+        data = rec.get("data") or {}
+        if kind == "node":
+            self.refresh_node(Node(data["raw"]))
+        elif kind == "node_gone":
+            self.remove_node(str(data.get("name", "")))
+        elif kind == "bound":
+            return self._apply_bound(Pod(data["pod"]))
+        elif kind == "released":
+            self.release(Pod({"metadata": {
+                "uid": str(data.get("uid", "")),
+                "namespace": str(data.get("namespace", "default")),
+                "name": str(data.get("name", "")),
+            }}))
+        elif kind == "usage":
+            touched: set[str] = set()
+            for row in data.get("samples") or []:
+                node, chip, core, memory, now = row
+                self.update_chip_usage(
+                    node, int(chip), core=core, memory=memory, now=now,
+                    publish=False,
+                )
+                touched.add(node)
+            if touched:
+                self.publish_usage(tuple(sorted(touched)))
+        # note kinds (gang_park/unpark, hole, lease, view) are the
+        # coordinator's bookkeeping, not dealer state — it routes them
+        return True
+
+    def _apply_bound(self, pod: Pod) -> bool:
+        """Fold a streamed placement into accounting. A uid tracked on a
+        DIFFERENT node is a migration: release the old placement first
+        (then clear the tombstone the release minted so the re-learn is
+        not refused). Returns True when the placement is accounted
+        (learned now, or already tracked on this node) — False means a
+        CONFLICT (stale local state holds the chips) and the caller must
+        keep the pod in its reconcile window instead of assuming the
+        apply landed."""
+        if not pod.node_name:
+            return False
+        with self._lock:
+            tracked = self._pods.get(pod.uid)
+            moved = (
+                tracked is not None
+                and tracked.node_name
+                and tracked.node_name != pod.node_name
+            )
+            already = (
+                tracked is not None
+                and tracked.node_name == pod.node_name
+            )
+        if already:
+            return True
+        if moved:
+            self.release(tracked)
+            with self._lock:
+                self._released.pop(pod.uid, None)
+        learned = self._learn_bound_pod(pod)
+        self._republish((pod.node_name,))
+        if learned:
+            return True
+        with self._lock:
+            # _learn_bound_pod also answers False for an idempotent
+            # replay (uid already tracked/tombstoned) — only a genuine
+            # allocation conflict counts as a failed apply
+            return pod.uid in self._pods or pod.uid in self._released
+
+    def warm_views(self, node_names: list[str]) -> bool:
+        """Pre-build the frozen scoring view(s) + renderer(s) for a
+        candidate tuple (the standby applying a ``view`` warm hint).
+        After this, a Filter/Prioritize over the same tuple costs zero
+        view/renderer builds — the property the failover bench pins on
+        the first post-promotion Filter."""
+        if not node_names:
+            return False
+        if self._shard_fn is None:
+            if self._batch_prefer() is None:
+                return False
+            entry = self._view_for(self._default_shard, tuple(node_names))
+            if entry is None:
+                return False
+            entry[0].ensure_renderer(entry[1])
+            return True
+        plan = self._shard_plan(list(node_names))
+        if plan is None:
+            return False
+        for _shard, entry, names, _pos in plan[0]:
+            entry[0].ensure_renderer(names)
+        return True
+
+    def checkpoint_state(self) -> dict:
+        """Full restorable state snapshot (docs/ha.md): per node the
+        DERIVED placement state — fingerprint tuple + per-chip rows —
+        instead of the raw node object (the restart then pays none of
+        the label/quantity parsing, and the snapshot bytes stay small:
+        a minimal raw is synthesized from the fingerprint on restore);
+        per pod the raw object (annotations are what later releases
+        reconstruct plans from). Chip state is captured under each
+        node's own lock; pod maps under the dealer lock. Deterministic
+        ordering throughout."""
+        with self._lock:
+            infos = sorted(self._nodes.values(), key=lambda i: i.name)
+            pods = sorted(self._pods.values(), key=lambda p: p.uid)
+            node_entries = []
+            for info in infos:
+                with info.lock:
+                    node_entries.append([
+                        info.name,
+                        list(info.fingerprint()),
+                        info.chips.chip_rows(),
+                    ])
+            pod_entries = []
+            for p in pods:
+                gang = podutil.gang_of(p)
+                # row layout: [uid, node, gang key, gang size, raw] —
+                # the restore loop then touches no property chains and
+                # re-parses no annotations
+                pod_entries.append([
+                    p.uid, p.node_name,
+                    f"{p.namespace}/{gang[0]}" if gang else "",
+                    gang[1] if gang else 0,
+                    p.raw,
+                ])
+            return {"v": 3, "nodes": node_entries, "pods": pod_entries}
+
+    def write_checkpoint(self, path: str) -> None:
+        """Write a fresh checkpoint snapshot (atomic tmp+rename); a
+        DeltaLog constructed with the same path appends the tail."""
+        from nanotpu.ha.delta import write_checkpoint as _write
+
+        log_ = self.ha
+        _write(
+            path, self.checkpoint_state(),
+            seq=log_.seq if log_ is not None else 0,
+        )
+
+    def _restore_from_checkpoint(self, path: str) -> bool:
+        """Warm restart: snapshot + delta-tail replay from the local
+        checkpoint (docs/ha.md) — O(file), no apiserver round-trips, no
+        per-raw deep copies, no annotation re-parse for pods whose plan
+        was pre-resolved. Returns False (caller falls back to the full
+        annotation replay) when the file is missing/corrupt."""
+        from nanotpu.ha.delta import load_checkpoint
+
+        state, records = load_checkpoint(path)
+        if state is None:
+            return False
+        self._restore_state(state)
+        for rec in records:
+            try:
+                self.apply_delta(rec)
+            except Exception:
+                log.exception(
+                    "checkpoint tail replay failed at seq %s",
+                    rec.get("seq"),
+                )
+        log.info(
+            "warm restart from %s: %d nodes, %d pods, %d tail deltas",
+            path, len(state.get("nodes") or []),
+            len(state.get("pods") or []), len(records),
+        )
+        return True
+
+    def _restore_state(self, state: dict) -> None:
+        """Single-threaded boot work under one lock hold: no chip
+        allocation happens here — the node rows carry the chip state
+        the snapshot captured, which already reflects every tracked
+        pod — and no annotation re-parsing (the pod rows carry the
+        pre-derived uid/node/gang fields)."""
+        from nanotpu.analysis.witness import rlock_factory
+
+        lock_factory = rlock_factory("NodeInfo.lock")
+        with self._lock:
+            nodes = self._nodes
+            for row in state.get("nodes") or []:
+                try:
+                    name = row[0]
+                    # node_raw None on purpose: nothing reads it on the
+                    # restore path (checkpoints store the fingerprint,
+                    # node deltas carry the informer's raw), and
+                    # synthesizing 4096 raws was a measured third of
+                    # the whole warm boot
+                    self._register_node(
+                        name,
+                        NodeInfo.restore(name, None, tuple(row[1]),
+                                         row[2],
+                                         lock_factory=lock_factory),
+                    )
+                except Exception:
+                    log.exception("checkpoint node row unrestorable")
+            pods_map = self._pods
+            accounted = self._accounted
+            released = self._released
+            record_bound = self.gangs.record_bound
+            for uid, node, gang_key, gang_size, raw in (
+                state.get("pods") or []
+            ):
+                if uid in pods_map or uid in released:
+                    continue
+                info = nodes.get(node)
+                if info is None:
+                    continue
+                pods_map[uid] = Pod(raw)
+                accounted[uid] = info
+                if gang_key:
+                    record_bound(gang_key, gang_size, uid, node)
+
     def close(self) -> None:
         """Release the assume thread pool (and the commit pool when the
-        pipeline is on). Only needed by harnesses that churn dealers (the
-        sim's agent-restart fault builds a fresh dealer per restart); a
-        live scheduler keeps one dealer for its lifetime."""
+        pipeline is on). Needed by harnesses that churn dealers (the
+        sim's agent-restart/scheduler-crash faults build a fresh dealer
+        per incarnation) and by the HA pair's demoted side. IDEMPOTENT
+        and safe to race a promotion mid-cycle: a second close (the old
+        active's shutdown path and the coordinator's rewire both call
+        it) is a no-op, and a flush of the delta checkpoint happens
+        exactly once (pinned by the promote-under-load test)."""
+        if self._closed:
+            return
+        self._closed = True
         self._pool.shutdown(wait=False)
         if self._commit_pool is not None:
             self._commit_pool.shutdown(wait=False)
+        log_ = self.ha
+        if log_ is not None:
+            log_.flush()

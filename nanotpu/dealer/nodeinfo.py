@@ -54,6 +54,11 @@ class NodeInfo:
 
     def __init__(self, node: Node):
         self.name = node.name
+        #: the node object this accounting was built from — the HA delta
+        #: stream and checkpoint snapshot (docs/ha.md) need the raw to
+        #: rebuild an identical NodeInfo on the standby/restart side
+        #: (no copy: client reads hand out private objects already)
+        self.node_raw = node.raw
         self.lock = make_rlock("NodeInfo.lock")
         (
             chip_count, generation, topo, self.slice_name, self.slice_coords,
@@ -75,6 +80,35 @@ class NodeInfo:
         #: bumped on every chip-state mutation; the batch scorer
         #: (dealer/batch.py) uses it to refresh only changed rows
         self.version = 0
+
+    @classmethod
+    def restore(cls, name: str, node_raw: dict | None, fp: tuple,
+                chip_rows: list, lock_factory=None) -> "NodeInfo":
+        """Rebuild from checkpointed derived state (docs/ha.md warm
+        restart): the fingerprint tuple and per-chip rows were computed
+        once at checkpoint time, so the restart pays none of the label /
+        quantity parsing ``__init__`` derives from the node object.
+        ``lock_factory`` (witness.rlock_factory) amortizes the witness
+        activation probe across a bulk restore."""
+        self = cls.__new__(cls)
+        self.name = name
+        self.node_raw = node_raw
+        self.lock = (
+            lock_factory() if lock_factory is not None
+            else make_rlock("NodeInfo.lock")
+        )
+        (
+            self.chip_count, self.generation, self.topology,
+            self.slice_name, self.slice_coords,
+        ) = fp
+        self.chips = ChipSet.restore(
+            self.chip_count, self.topology, self.generation, chip_rows
+        )
+        self.chips.key = name
+        self._plan_cache = {}
+        self._plan_cache_token = None
+        self.version = 0
+        return self
 
     def _bump(self) -> None:
         # caller holds self.lock; also advances the process-wide change

@@ -1,0 +1,30 @@
+"""HA control plane: replicated dealer, incremental state streaming,
+replay-free warm restart (docs/ha.md).
+
+* :class:`DeltaLog` — the monotonically-sequenced stream of dealer
+  commits, doubling as the local restart checkpoint;
+* :class:`LeaderLease` — acquire/renew/steal over a coordination lease;
+* :class:`HACoordinator` / :class:`HALoop` — the per-replica role
+  machine: standby tail+apply, one-step promotion, leader gating.
+"""
+
+from nanotpu.ha.delta import (
+    NOTE_KINDS,
+    STATE_KINDS,
+    DeltaLog,
+    load_checkpoint,
+    write_checkpoint,
+)
+from nanotpu.ha.lease import LeaderLease
+from nanotpu.ha.standby import HACoordinator, HALoop
+
+__all__ = [
+    "DeltaLog",
+    "HACoordinator",
+    "HALoop",
+    "LeaderLease",
+    "NOTE_KINDS",
+    "STATE_KINDS",
+    "load_checkpoint",
+    "write_checkpoint",
+]

@@ -1,0 +1,231 @@
+"""The incremental state stream: every dealer commit, as one appended record.
+
+The HA design (docs/ha.md) treats failover latency as a STREAMING
+problem, not a consensus problem: everything the warm standby needs
+already exists in incremental form — the dealer's commit points all call
+``_republish`` with the nodes they touched, so the same commit points
+append one typed record to this bounded ring. The standby tails the ring
+(in-process in the sim and bench, ``GET /debug/ha?since=`` across
+processes) and applies each record into its OWN live Dealer + RCU
+snapshot chain, staying within a bounded lag of the active.
+
+Record schema (monotonically sequenced; the sequence is the protocol)::
+
+    {"seq": N, "t": <emit clock>, "kind": <kind>, "data": {...}}
+
+State kinds (applied via :meth:`Dealer.apply_delta`):
+
+* ``node``       — a node registered/rebuilt (``data.raw`` = node object)
+* ``node_gone``  — a node evicted (``data.name``)
+* ``bound``      — a pod's placement committed/learned/migrated
+  (``data.pod`` = the annotated pod object; a move is just a ``bound``
+  with a new node — the applier releases the old placement first)
+* ``released``   — a pod's chips returned (``data.uid/namespace/name``)
+* ``usage``      — one metric-sync batch (``data.samples`` =
+  ``[node, chip, core, memory, now]`` rows)
+
+Note kinds (coordinator bookkeeping, never dealer state — parked
+reservations and holes are control-plane INTENT that dies with the
+active; the assume-TTL sweeper + bind idempotency make that safe):
+
+* ``gang_park`` / ``gang_unpark`` — strict-gang barrier membership
+* ``hole`` / ``lease``            — recovery-plane earmarks
+* ``view``                        — a candidate-tuple the active's read
+  path warmed; the standby pre-builds the same frozen view + renderer so
+  its FIRST post-promotion Filter costs zero view/renderer builds
+
+The same records double as the local **checkpoint**: a :class:`DeltaLog`
+constructed with a ``path`` appends every record to a JSONL file whose
+first line is a full state snapshot (:func:`write_checkpoint`), so a
+single-process cold restart replays the log tail
+(:meth:`Dealer.__init__` ``restore_from=``) instead of the O(fleet)
+annotation scan.
+
+Cost contract: with no log attached (``dealer.ha is None``) the hot path
+pays ONE attribute check per commit point and allocates nothing — the
+bench's A/B attribution diff pins it. With a log attached a commit pays
+one dict + one list append under a tiny dedicated lock; file I/O happens
+in batches OUTSIDE the lock.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from nanotpu.analysis.witness import make_lock
+
+log = logging.getLogger("nanotpu.ha")
+
+#: record kinds a standby applies into its dealer's chip accounting
+STATE_KINDS = ("node", "node_gone", "bound", "released", "usage")
+
+#: record kinds the coordinator tracks as bookkeeping only
+NOTE_KINDS = ("gang_park", "gang_unpark", "hole", "lease", "view")
+
+#: buffered checkpoint lines before emit() hands a batch to the file
+#: (written outside the lock; flush() forces the remainder out)
+_FLUSH_EVERY = 256
+
+
+class DeltaLog:
+    """Bounded, monotonically-sequenced ring of state deltas.
+
+    One instance lives on the ACTIVE dealer (``dealer.ha``); every commit
+    point appends through :meth:`emit`. Readers (the standby's tail loop,
+    the ``/debug/ha`` route) page through :meth:`since`. Sequence numbers
+    are contiguous by construction — one emit, one seq — which is what
+    makes ``since`` an index computation instead of a scan and lag a
+    subtraction instead of a search."""
+
+    def __init__(self, capacity: int = 65536, path: str = "",
+                 clock=time.monotonic):
+        if capacity <= 0:
+            raise ValueError(f"delta capacity must be > 0, got {capacity}")
+        self.capacity = int(capacity)
+        self.path = str(path or "")
+        self.clock = clock
+        self._lock = make_lock("DeltaLog._lock")
+        self._ring: list[dict] = []
+        self.seq = 0
+        #: checkpoint lines buffered for the next batched file append
+        self._pending_file: list[str] = []
+
+    # -- write side --------------------------------------------------------
+    def emit(self, kind: str, data: dict) -> int:
+        """Append one record; returns its sequence number. The only work
+        under the lock is two appends — file I/O batches outside it."""
+        lines: list[str] | None = None
+        with self._lock:
+            self.seq += 1
+            rec = {
+                "seq": self.seq,
+                "t": round(self.clock(), 6),
+                "kind": kind,
+                "data": data,
+            }
+            self._ring.append(rec)
+            if len(self._ring) > self.capacity:
+                # amortized trim: drop the oldest quarter in one slice
+                del self._ring[: max(1, self.capacity // 4)]
+            if self.path:
+                self._pending_file.append(
+                    json.dumps(rec, sort_keys=True, separators=(",", ":"))
+                )
+                if len(self._pending_file) >= _FLUSH_EVERY:
+                    lines, self._pending_file = self._pending_file, []
+            seq = self.seq
+        if lines:
+            self._append_lines(lines)
+        return seq
+
+    def flush(self) -> None:
+        """Force buffered checkpoint lines to disk (no-op without a path)."""
+        with self._lock:
+            lines, self._pending_file = self._pending_file, []
+        if lines:
+            self._append_lines(lines)
+
+    def _append_lines(self, lines: list[str]) -> None:
+        try:
+            with open(self.path, "a", encoding="utf-8") as fh:
+                fh.write("\n".join(lines) + "\n")
+        except OSError:
+            # a full/broken disk degrades the checkpoint, never the
+            # scheduler: the ring (and the apiserver) stay authoritative
+            log.exception("delta checkpoint append failed (%s)", self.path)
+
+    def compact(self, state: dict) -> None:
+        """Rewrite the checkpoint file as one fresh snapshot (atomic
+        tmp+rename), discarding the replayed tail. Callers pass
+        ``dealer.checkpoint_state()``; cadence is theirs (the production
+        loop compacts every few thousand deltas)."""
+        if not self.path:
+            return
+        with self._lock:
+            self._pending_file = []
+            seq = self.seq
+        write_checkpoint(self.path, state, seq=seq)
+
+    # -- read side ---------------------------------------------------------
+    def since(self, seq: int, limit: int | None = None) -> list[dict] | None:
+        """Every retained record with sequence number > ``seq``, oldest
+        first, optionally capped to the first ``limit``. Returns ``None``
+        when ``seq`` has already been evicted from the ring — the tail is
+        STALE and the reader must resync from durable state instead of
+        pretending the gap never happened."""
+        with self._lock:
+            if not self._ring:
+                return [] if seq >= self.seq else None
+            newest = self._ring[-1]["seq"]
+            oldest = self._ring[0]["seq"]
+            if seq >= newest:
+                return []
+            if seq < oldest - 1:
+                return None
+            start = len(self._ring) - (newest - seq)
+            end = len(self._ring) if limit is None else start + int(limit)
+            return self._ring[start:end]
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "seq": self.seq,
+                "retained": len(self._ring),
+                "capacity": self.capacity,
+                "checkpoint": self.path,
+            }
+
+
+# -- checkpoint file format ------------------------------------------------
+def write_checkpoint(path: str, state: dict, seq: int = 0) -> None:
+    """Write a fresh checkpoint: one snapshot line (full dealer state),
+    ready for delta lines to append after it. Atomic via tmp+rename so a
+    crash mid-write leaves the previous checkpoint intact."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps(
+            {"kind": "snapshot", "seq": seq, "state": state},
+            sort_keys=True, separators=(",", ":"),
+        ) + "\n")
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str) -> tuple[dict | None, list[dict]]:
+    """``(snapshot state | None, [delta records])`` from a checkpoint
+    file. A missing/corrupt file returns ``(None, [])`` — the caller
+    falls back to the full annotation replay; a corrupt TAIL line keeps
+    the records before it (the apiserver resync covers the remainder)."""
+    if not os.path.exists(path):
+        # first boot: no checkpoint yet is the normal case, not a
+        # warning-with-traceback
+        return None, []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            first = fh.readline()
+            if not first:
+                return None, []
+            head = json.loads(first)
+            if head.get("kind") != "snapshot":
+                return None, []
+            records: list[dict] = []
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    log.warning(
+                        "checkpoint %s: corrupt tail line ignored "
+                        "(%d records kept)", path, len(records),
+                    )
+                    break
+                records.append(rec)
+            return head.get("state") or None, records
+    except (OSError, json.JSONDecodeError, ValueError):
+        log.warning("checkpoint %s unreadable; full replay", path,
+                    exc_info=True)
+        return None, []

@@ -1,0 +1,188 @@
+"""Leader election over a coordination Lease object (docs/ha.md).
+
+The contract is the standard K8s leader-lease dance, with the clock
+injectable so the sim can drive acquire/renew/steal on virtual time:
+
+* the ACTIVE acquires the lease (create, or update when expired) and
+  renews it every ``renew_every_s`` (< ttl/2);
+* the STANDBY watches the lease; the moment the holder's ``renewTime``
+  is older than ``ttl_s`` it STEALS it (one optimistic-concurrency
+  update — a conflict means someone else won, which is an answer, not an
+  error) and promotes;
+* a clean handoff (zero-downtime upgrade) is the same steal with the old
+  active's cooperation: it stops renewing and releases, so the standby's
+  next probe acquires instantly instead of waiting out the TTL.
+
+All writes go through the injected clientset — production wraps it in
+the ResilientClientset, so lease traffic shares the retry-budget and
+breaker discipline every other apiserver write lives under
+(docs/robustness.md)."""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from nanotpu.k8s.client import ApiError, ConflictError, NotFoundError
+
+log = logging.getLogger("nanotpu.ha.lease")
+
+DEFAULT_LEASE_NAME = "nanotpu-dealer"
+DEFAULT_LEASE_NAMESPACE = "kube-system"
+
+
+class LeaderLease:
+    """One participant's view of the shared leader lease."""
+
+    def __init__(self, client, holder: str,
+                 name: str = DEFAULT_LEASE_NAME,
+                 namespace: str = DEFAULT_LEASE_NAMESPACE,
+                 ttl_s: float = 3.0, clock=None):
+        if ttl_s <= 0:
+            raise ValueError(f"lease ttl must be > 0, got {ttl_s}")
+        if clock is None:
+            # WALL clock on purpose (never monotonic): acquire/renew
+            # times are written by one replica and judged by ANOTHER on
+            # a different host — the deploy manifest's anti-affinity
+            # guarantees that — and CLOCK_MONOTONIC is seconds since
+            # each host's own boot, meaningless across hosts (a standby
+            # on a younger host would never see the lease expire; on an
+            # older one it would steal from a live leader). The sim and
+            # tests inject their own shared (virtual) clock, so no
+            # simulated path ever reads wall time.
+            clock = time.time
+        self.client = client
+        self.holder = str(holder)
+        self.name = name
+        self.namespace = namespace
+        self.ttl_s = float(ttl_s)
+        self.clock = clock
+        #: acquisitions that displaced a live-but-expired holder
+        self.steals = 0
+
+    # -- raw object helpers ------------------------------------------------
+    def _spec(self, now: float, acquired_at: float | None = None) -> dict:
+        return {
+            "holderIdentity": self.holder,
+            "leaseDurationSeconds": self.ttl_s,
+            "acquireTime": now if acquired_at is None else acquired_at,
+            "renewTime": now,
+        }
+
+    def _get(self) -> dict | None:
+        try:
+            return self.client.get_lease(self.namespace, self.name)
+        except NotFoundError:
+            return None
+        except ApiError:
+            return None
+
+    @staticmethod
+    def _holder_of(raw: dict) -> str:
+        return str((raw.get("spec") or {}).get("holderIdentity") or "")
+
+    def _expired(self, raw: dict, now: float) -> bool:
+        spec = raw.get("spec") or {}
+        renew = spec.get("renewTime")
+        ttl = float(spec.get("leaseDurationSeconds") or self.ttl_s)
+        if renew is None:
+            return True
+        return now - float(renew) > ttl
+
+    # -- the protocol ------------------------------------------------------
+    def try_acquire(self, now: float | None = None) -> bool:
+        """Become (or remain) the holder. Create when absent, renew when
+        already ours, STEAL when the current holder's renewTime is a full
+        TTL stale. Any conflict/API failure answers False — the caller
+        stays (or becomes) standby and probes again next period."""
+        if now is None:
+            now = self.clock()
+        raw = self._get()
+        if raw is None:
+            try:
+                self.client.create_lease(self.namespace, self.name, {
+                    "metadata": {
+                        "name": self.name, "namespace": self.namespace,
+                    },
+                    "spec": self._spec(now),
+                })
+                return True
+            except (ConflictError, ApiError):
+                return False  # racer created it first; probe again
+        holder = self._holder_of(raw)
+        if holder == self.holder:
+            return self._renew_raw(raw, now)
+        if not self._expired(raw, now):
+            return False
+        stolen = self._renew_raw(raw, now, acquired_at=now)
+        if stolen:
+            self.steals += 1
+            log.warning(
+                "lease %s/%s stolen from expired holder %r",
+                self.namespace, self.name, holder,
+            )
+        return stolen
+
+    def renew(self, now: float | None = None) -> bool:
+        """Refresh renewTime; False means we LOST the lease (someone else
+        holds it, it vanished, or the write failed) — the caller must
+        drop leadership, not keep serving writes on a stale claim."""
+        if now is None:
+            now = self.clock()
+        raw = self._get()
+        if raw is None or self._holder_of(raw) != self.holder:
+            return False
+        return self._renew_raw(raw, now)
+
+    def _renew_raw(self, raw: dict, now: float,
+                   acquired_at: float | None = None) -> bool:
+        updated = {
+            "metadata": dict(raw.get("metadata") or {}),
+            "spec": self._spec(
+                now,
+                acquired_at=(
+                    acquired_at if acquired_at is not None
+                    else (raw.get("spec") or {}).get("acquireTime", now)
+                ),
+            ),
+        }
+        try:
+            self.client.update_lease(self.namespace, self.name, updated)
+            return True
+        except (ConflictError, NotFoundError):
+            return False  # lost the optimistic race: the other side won
+        except ApiError:
+            return False
+
+    def release(self, now: float | None = None) -> bool:
+        """Cooperative handoff: blank the holder so a standby's next
+        probe acquires instantly instead of waiting out the TTL (the
+        zero-downtime upgrade path, docs/ha.md)."""
+        if now is None:
+            now = self.clock()
+        raw = self._get()
+        if raw is None or self._holder_of(raw) != self.holder:
+            return False
+        updated = {
+            "metadata": dict(raw.get("metadata") or {}),
+            "spec": {
+                "holderIdentity": "",
+                "leaseDurationSeconds": self.ttl_s,
+                "acquireTime": None,
+                "renewTime": None,
+            },
+        }
+        try:
+            self.client.update_lease(self.namespace, self.name, updated)
+            return True
+        except (ConflictError, NotFoundError, ApiError):
+            return False
+
+    def holder_now(self, now: float | None = None) -> str:
+        """The current UNEXPIRED holder identity ('' when free)."""
+        if now is None:
+            now = self.clock()
+        raw = self._get()
+        if raw is None or self._expired(raw, now):
+            return ""
+        return self._holder_of(raw)

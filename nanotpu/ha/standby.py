@@ -1,0 +1,490 @@
+"""The warm standby: tail, apply, stay warm, promote in one step.
+
+:class:`HACoordinator` is one replica's HA state machine, used by BOTH
+roles (docs/ha.md):
+
+* role ``active`` — owns the :class:`~nanotpu.ha.delta.DeltaLog` the
+  dealer emits into, renews the leader lease, serves ``/debug/ha``;
+* role ``standby`` — tails a delta source (the active's log in-process,
+  or an HTTP poller across processes) and applies every record into its
+  OWN live Dealer + RCU snapshot chain via :meth:`Dealer.apply_delta`,
+  while its Controller runs in standby mode (informer cache + dirty-key
+  tracking, no dealer writes). ``view`` records pre-build the active's
+  candidate-tuple views + renderers, so the standby's first
+  post-promotion Filter costs zero view/renderer builds (bench-pinned).
+
+Promotion (:meth:`promote`) is ONE step because the views are already
+warm: flip the role, reconcile only the DIRTY window — pod keys whose
+informer events arrived without a matching delta, O(delta) not O(fleet)
+— through the controller's own sync rules, dump a flight-recorder
+bundle, and start emitting into a fresh delta log for the NEXT standby.
+Zero double-binds need no consensus: parked reservations die with the
+active (their HTTP binds die too, and kube-scheduler retries against the
+new leader), half-written annotations are healed by the assume-TTL
+sweeper, and re-issued binds are idempotent by uid.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from nanotpu.analysis.witness import make_lock
+from nanotpu.ha.delta import NOTE_KINDS, STATE_KINDS, DeltaLog
+
+log = logging.getLogger("nanotpu.ha")
+
+
+class HACoordinator:
+    """One replica's HA role + stream bookkeeping (see module docstring)."""
+
+    def __init__(self, dealer, role: str = "active",
+                 log_: DeltaLog | None = None, source=None,
+                 controller=None, lease=None, flight=None,
+                 lag_events: int = 0, clock=time.monotonic):
+        if role not in ("active", "standby"):
+            raise ValueError(f"role must be active|standby, got {role!r}")
+        self._lock = make_lock("HACoordinator._lock")
+        self.dealer = dealer
+        self.role = role
+        #: the active's emitting log (standby: None until promoted)
+        self.log = log_
+        #: the standby's tail source: anything with ``.seq`` and
+        #: ``.since(seq, limit=)`` — a DeltaLog in-process, an
+        #: HttpDeltaSource across processes
+        self.source = source
+        self.controller = controller
+        self.lease = lease
+        self.flight = flight
+        self.clock = clock
+        #: applied records trail the source by this many (the sim's
+        #: stream-latency model; production applies as fast as it polls)
+        self.lag_events = int(lag_events)
+        self.applied_seq = 0
+        self.applied_deltas = 0
+        self.last_applied_t = 0.0
+        self.promotions = 0
+        self.reconciled_pods = 0
+        #: `bound` records that conflicted with stale local state (their
+        #: dirty entries survive for the next reconcile)
+        self.apply_failures = 0
+        #: cross-process tails anchor at the active's CURRENT seq on
+        #: first contact (warm boot covered the history); in-process
+        #: sources have their start seq set explicitly by the builder
+        self._anchored = False
+        #: checkpoint path handed to the fresh DeltaLog a promotion
+        #: mints — the new leader keeps persisting its restart snapshot
+        self.checkpoint_path = ""
+        #: True when the tail fell off the source ring (resync needed);
+        #: promote() then reconciles via a full resync instead of the
+        #: dirty window
+        self.stale = False
+        #: uids the active reported parked at strict-gang barriers
+        #: (bookkeeping only — reservations die with the active)
+        self.parked: set[str] = set()
+        #: recovery-plane earmark counts mirrored from note records
+        self.holes_open = 0
+        self.leases_active = 0
+
+    def is_leader(self) -> bool:
+        return self.role == "active"
+
+    # -- standby: tail + apply ---------------------------------------------
+    def tail_once(self, limit: int | None = None) -> int:
+        """Apply every available record up to ``source.seq -
+        lag_events``. Returns the number applied. A stale tail (fell off
+        the ring) marks the coordinator for full-resync promotion
+        instead of silently skipping the gap."""
+        source = self.source
+        if self.role != "standby" or source is None:
+            return 0
+        poll = getattr(source, "poll", None)
+        if poll is not None:
+            # cross-process sources fetch their window on demand
+            # (HttpDeltaSource); an in-process DeltaLog needs no poll
+            poll(self.applied_seq)
+            if not self._anchored and source.seq > 0:
+                # first contact with a live active: anchor at ITS
+                # current seq. This standby's warm boot already covered
+                # the history — replaying the whole retained ring would
+                # be redundant at best, and against a long-lived active
+                # whose early records fell off the ring it would latch
+                # `stale` permanently, degrading every future promotion
+                # to the O(fleet) resync this subsystem exists to avoid
+                self.applied_seq = source.seq
+                self._anchored = True
+                return 0
+        if source.seq < self.applied_seq:
+            # the stream RESET under us (the active restarted with a
+            # fresh log): records between our position and the old head
+            # died with the old process — rebase and reconcile the
+            # dirty window NOW so lost records cannot strand stale
+            # accounting that later applies would conflict with
+            self.rebase(source)
+            return 0
+        high = source.seq - self.lag_events
+        if high <= self.applied_seq:
+            return 0
+        records = source.since(self.applied_seq, limit=limit)
+        if records is None:
+            if not self.stale:
+                self.stale = True
+                log.warning(
+                    "delta tail fell off the source ring at seq %d; "
+                    "promotion will full-resync", self.applied_seq,
+                )
+            # jump the gap: resume tailing from the present so the lag
+            # stays bounded even though the gap itself is lost
+            self.applied_seq = high
+            return 0
+        n = 0
+        for rec in records:
+            if rec["seq"] > high:
+                break
+            self.apply(rec)
+            n += 1
+        return n
+
+    def apply(self, rec: dict) -> None:
+        """Apply ONE record (standby side). State kinds go through the
+        dealer; note kinds update coordinator bookkeeping; ``view``
+        records warm the dealer's frozen views + renderers."""
+        kind = rec["kind"]
+        data = rec.get("data") or {}
+        if kind in STATE_KINDS:
+            landed = self.dealer.apply_delta(rec)
+            if not landed:
+                # a `bound` that conflicted with stale local state: keep
+                # its dirty entry — the reconcile (rebase or promotion,
+                # releases first) is what heals it
+                self.apply_failures += 1
+            if self.controller is not None and landed:
+                # a delta that covers a pod retires its informer dirty
+                # entry: the promotion reconcile window is exactly the
+                # events whose deltas never arrived
+                if kind == "bound":
+                    meta = (data.get("pod") or {}).get("metadata") or {}
+                    self.controller.ha_clear_dirty(
+                        f"{meta.get('namespace', 'default')}"
+                        f"/{meta.get('name', '')}",
+                        kind="bound",
+                    )
+                elif kind == "released":
+                    self.controller.ha_clear_dirty(
+                        f"{data.get('namespace', 'default')}"
+                        f"/{data.get('name', '')}",
+                        kind="released",
+                    )
+        elif kind == "view":
+            self.dealer.warm_views(list(data.get("names") or []))
+        elif kind == "gang_park":
+            self.parked.add(str(data.get("uid", "")))
+        elif kind == "gang_unpark":
+            self.parked.discard(str(data.get("uid", "")))
+        elif kind == "hole":
+            self.holes_open += 1 if data.get("action") == "open" else -1
+            self.holes_open = max(self.holes_open, 0)
+        elif kind == "lease":
+            self.leases_active += (
+                1 if data.get("action") == "grant" else -1
+            )
+            self.leases_active = max(self.leases_active, 0)
+        elif kind not in NOTE_KINDS:  # forward compat: unknown kinds skip
+            log.debug("unknown delta kind %r skipped", kind)
+        self.applied_seq = rec["seq"]
+        self.applied_deltas += 1
+        self.last_applied_t = float(rec.get("t", 0.0))
+
+    def rebase(self, source) -> int:
+        """Re-point the tail at a NEW stream (the active restarted with
+        a fresh log): records between our applied position and the old
+        log's head died with the old process. Immediately reconcile the
+        dirty window against informer state — GETs plus local
+        accounting only, which a standby may do — so the lost records
+        cannot strand stale accounting that later applies would
+        conflict with. Returns the number of pods reconciled."""
+        self.source = source
+        self.applied_seq = 0
+        n = self._reconcile_dirty()
+        if n:
+            log.info("stream rebase reconciled %d pods", n)
+        return n
+
+    # -- promotion ---------------------------------------------------------
+    def promote(self, now: float | None = None) -> dict:
+        """Take over in one step: role flip, O(delta) reconcile of the
+        dirty window, flight-recorder bundle, fresh emit log for the
+        next standby. Idempotent (a second call is a no-op summary)."""
+        if now is None:
+            now = self.clock()
+        with self._lock:
+            if self.role == "active":
+                return {"promoted": False, "reconciled": 0}
+            self.role = "active"
+            self.promotions += 1
+        reconciled = self._reconcile(now)
+        self.reconciled_pods = reconciled
+        if self.log is None:
+            # start the next generation's stream: this dealer is now the
+            # emitter the NEXT standby tails — with the SAME checkpoint
+            # path the process was configured with, so the new leader
+            # keeps persisting its restart snapshot (a crash after
+            # promotion must stay warm-restartable)
+            self.log = DeltaLog(
+                path=self.checkpoint_path, clock=self.clock
+            )
+            if self.checkpoint_path:
+                try:
+                    self.dealer.write_checkpoint(self.checkpoint_path)
+                except Exception:
+                    log.exception("post-promotion checkpoint failed")
+        self.dealer.ha = self.log
+        if self.controller is not None:
+            self.controller.exit_standby()
+        if self.flight is not None:
+            try:
+                self.flight.dump("ha_promotion", now=now)
+            except Exception:  # the takeover must not die on forensics
+                log.exception("promotion flight dump failed")
+        log.warning(
+            "promoted to active: reconciled %d pods "
+            "(applied_seq=%d, stale=%s)",
+            reconciled, self.applied_seq, self.stale,
+        )
+        return {"promoted": True, "reconciled": reconciled,
+                "stale": self.stale}
+
+    def _reconcile(self, now: float) -> int:
+        """Close the lag window against informer state. Dirty keys are
+        pod events the standby cached without a matching delta — each
+        one runs the controller's own sync rules (completed -> release,
+        assumed+placed -> allocate, vanished -> forget). O(dirty); a
+        stale tail falls back to one full resync instead."""
+        controller = self.controller
+        if controller is None:
+            return 0
+        if getattr(controller, "_dirty_overflow", False):
+            # the dirty window overflowed its bound (a peer-less or
+            # long-stalled standby): the window cannot be trusted —
+            # same remedy as a stale tail
+            self.stale = True
+        if self.stale:
+            try:
+                controller.ha_take_dirty()
+                controller.exit_standby()
+                controller.resync_once()
+                controller.drain_sync()
+            except Exception:
+                log.exception("stale-tail full resync failed")
+            return -1
+        return self._reconcile_dirty()
+
+    def _reconcile_dirty(self) -> int:
+        """Drain the dirty window through the controller's sync rules —
+        shared by promotion and a stream rebase (a standby may run it:
+        GETs + local accounting, never an apiserver write)."""
+        from nanotpu.utils import pod as podutil
+
+        controller = self.controller
+        if controller is None:
+            return 0
+        dirty = controller.ha_take_dirty()
+        # releases FIRST: a departed pod's chips must free before a
+        # streamed-but-lost bind re-allocates — name order alone once
+        # left a gang member's allocate colliding with a not-yet-
+        # forgotten pod's chips (caught by the crash soak)
+        ordered = sorted(
+            dirty.items(),
+            key=lambda kv: (
+                0 if (
+                    kv[1][0] == "DELETED"
+                    or podutil.is_completed_pod(kv[1][1])
+                ) else 1,
+                kv[0],
+            ),
+        )
+        n = 0
+        for key, (etype, pod) in ordered:
+            try:
+                if etype == "DELETED":
+                    self.dealer.forget(pod)
+                else:
+                    controller.sync_key(pod.namespace, pod.name)
+                n += 1
+            except Exception:
+                # transient sync failure: hand it to the (now live)
+                # workqueue instead of losing the repair
+                log.exception("promotion reconcile of %s failed", key)
+                try:
+                    controller.requeue(pod)
+                except Exception:
+                    pass
+        return n
+
+    # -- observability -----------------------------------------------------
+    def lag(self) -> int:
+        """Records emitted by the source but not yet applied."""
+        source = self.source
+        if self.role != "standby" or source is None:
+            return 0
+        return max(0, source.seq - self.applied_seq)
+
+    def lag_seconds(self, now: float | None = None) -> float:
+        """Age of the newest APPLIED record while records are pending —
+        how far behind the stream the standby's state is, in time."""
+        if self.lag() == 0 or not self.last_applied_t:
+            return 0.0
+        if now is None:
+            now = self.clock()
+        return round(max(0.0, now - self.last_applied_t), 6)
+
+    def ha_gauge_values(self, now: float | None = None) -> dict:
+        """The ``nanotpu_ha_*`` gauge values. Keys must match the
+        ``_HA_GAUGES`` table in nanotpu/metrics/ha.py exactly — the
+        nanolint metrics-completeness pass pins the equivalence both
+        ways (a value produced here but never exported, or declared
+        there but never produced, is a lint finding)."""
+        log_ = self.log
+        return {
+            "role": 1.0 if self.role == "active" else 0.0,
+            "lag_events": self.lag(),
+            "lag_seconds": self.lag_seconds(now=now),
+            "applied_deltas": self.applied_deltas,
+            "emitted_deltas": log_.seq if log_ is not None else 0,
+            "promotions": self.promotions,
+            "reconciled_pods": max(self.reconciled_pods, 0),
+            "apply_failures": self.apply_failures,
+            "tail_stale": 1.0 if self.stale else 0.0,
+            "parked_noted": len(self.parked),
+        }
+
+    def status(self, now: float | None = None) -> dict:
+        """``/debug/ha`` + timeline ``ha`` section body (sans records)."""
+        out = {
+            "role": self.role,
+            "applied_seq": self.applied_seq,
+            "applied_deltas": self.applied_deltas,
+            "lag_events": self.lag(),
+            "promotions": self.promotions,
+            "reconciled_pods": self.reconciled_pods,
+            "stale": self.stale,
+        }
+        if self.log is not None:
+            out["log"] = self.log.status()
+        return out
+
+
+class HttpDeltaSource:
+    """Cross-process tail source: polls the active's ``GET
+    /debug/ha?since=`` and presents the DeltaLog read surface
+    (``.seq`` + ``.since()``) the coordinator tails. One GET per
+    :meth:`poll`; a dead active (connection refused — the exact moment
+    the lease is about to expire) just yields an empty window, and the
+    lease steal does the rest."""
+
+    def __init__(self, base_url: str, timeout_s: float = 2.0,
+                 page: int = 2048):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self.page = int(page)
+        self.seq = 0
+        self._records: list[dict] = []
+        self._stale = False
+        #: polls that failed to reach the active (telemetry only)
+        self.poll_errors = 0
+
+    def poll(self, since: int) -> None:
+        import json as _json
+        import urllib.request
+
+        url = f"{self.base_url}/debug/ha?since={int(since)}&limit={self.page}"
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+                body = _json.loads(resp.read())
+        except Exception:
+            self.poll_errors += 1
+            self._records = []
+            return
+        self._stale = bool(body.get("stale_tail"))
+        self._records = list(body.get("records") or [])
+        self.seq = int((body.get("log") or {}).get("seq") or 0)
+
+    def since(self, seq: int, limit: int | None = None):
+        if self._stale:
+            return None
+        out = [r for r in self._records if r["seq"] > seq]
+        if limit is not None:
+            out = out[: int(limit)]
+        return out
+
+
+class HALoop:
+    """Production cadence driver: one daemon thread running the lease
+    dance + (standby) the delta tail every ``period_s``. The sim never
+    uses this — it steps the coordinator deterministically through its
+    own events (docs/simulation.md). ``on_promote`` fires exactly once,
+    AFTER the coordinator promoted (the process wires its server/loops
+    rewiring there). start/stop are idempotent and restart-safe — the
+    same contract the telemetry/recovery/batch loops honor, pinned by
+    the promote-under-load test."""
+
+    def __init__(self, coordinator: HACoordinator, period_s: float = 0.5,
+                 on_promote=None, on_demote=None):
+        if period_s <= 0:
+            raise ValueError(f"period_s must be > 0, got {period_s!r}")
+        self.coordinator = coordinator
+        self.period_s = float(period_s)
+        self.on_promote = on_promote
+        #: fired exactly when leadership is LOST (renew failed and the
+        #: re-acquire lost too): the process must stop its write-side
+        #: loops — the HTTP gate only covers bind/batchadmit, while a
+        #: recovery or batch loop commits apiserver writes in-process
+        self.on_demote = on_demote
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ha",
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        co = self.coordinator
+        while not self._stop.wait(self.period_s):
+            try:
+                if co.role == "standby":
+                    co.tail_once()
+                    lease = co.lease
+                    if lease is not None and lease.try_acquire():
+                        co.promote()
+                        if self.on_promote is not None:
+                            self.on_promote()
+                else:
+                    lease = co.lease
+                    if lease is not None and not (
+                        lease.renew() or lease.try_acquire()
+                    ):
+                        # leadership lost: a split brain on the write
+                        # path is the one thing the lease exists to
+                        # prevent — demote loudly. The HTTP gate 503s
+                        # binds; on_demote stops the IN-PROCESS write
+                        # loops (recovery/batch) that never cross it.
+                        log.error(
+                            "leader lease lost; demoting to standby"
+                        )
+                        co.role = "standby"
+                        if self.on_demote is not None:
+                            self.on_demote()
+            except Exception:  # the loop must outlive any one cycle
+                log.exception("ha cycle failed")

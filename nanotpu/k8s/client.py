@@ -75,6 +75,12 @@ class Clientset(Protocol):
 
     def update_event(self, namespace: str, name: str, event: dict) -> None: ...
 
+    def get_lease(self, namespace: str, name: str) -> dict: ...
+
+    def create_lease(self, namespace: str, name: str, lease: dict) -> dict: ...
+
+    def update_lease(self, namespace: str, name: str, lease: dict) -> dict: ...
+
 
 class Watch:
     """A watch stream: blocking iterator of WatchEvents with a stop()."""
@@ -121,6 +127,10 @@ class FakeClientset:
         self._lock = make_rlock("FakeClientset._lock")
         self._pods: dict[str, dict] = {}  # key ns/name -> raw
         self._nodes: dict[str, dict] = {}
+        #: coordination leases (ns/name -> raw) — the HA leader-election
+        #: object (docs/ha.md), with the same optimistic-concurrency
+        #: semantics pods/nodes have
+        self._leases: dict[str, dict] = {}
         self._rv = itertools.count(start=2)
         self._pod_watches: list[Watch] = []
         self._node_watches: list[Watch] = []
@@ -273,6 +283,43 @@ class FakeClientset:
                     self.events[i] = plain_copy(event)
                     return
             raise NotFoundError(f"event {namespace}/{name} not found")
+
+    # -- leases (coordination.k8s.io) ---------------------------------------
+    def get_lease(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            if key not in self._leases:
+                raise NotFoundError(f"lease {key} not found")
+            return plain_copy(self._leases[key])
+
+    def create_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            if key in self._leases:
+                raise ApiError(f"lease {key} already exists", code=409)
+            raw = self._bump(plain_copy(lease))
+            self._leases[key] = raw
+            return plain_copy(raw)
+
+    def update_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            if key not in self._leases:
+                raise NotFoundError(f"lease {key} not found")
+            current = self._leases[key]
+            cur_rv = (current.get("metadata") or {}).get(
+                "resourceVersion", ""
+            )
+            new_rv = (lease.get("metadata") or {}).get("resourceVersion", "")
+            if new_rv != cur_rv:
+                raise ConflictError(
+                    f"Operation cannot be fulfilled on leases {key!r}: "
+                    f"please apply your changes to the latest version and "
+                    f"try again"
+                )
+            raw = self._bump(plain_copy(lease))
+            self._leases[key] = raw
+            return plain_copy(raw)
 
     # -- watches -----------------------------------------------------------
     def watch_pods(self) -> Watch:

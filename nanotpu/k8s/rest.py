@@ -151,6 +151,30 @@ class RestClientset:
             },
         )
 
+    # -- leases (coordination.k8s.io — the HA leader lease, docs/ha.md) ----
+    _LEASE_BASE = "/apis/coordination.k8s.io/v1/namespaces"
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        return self._request(
+            "GET", f"{self._LEASE_BASE}/{namespace}/leases/{name}"
+        )
+
+    def create_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        return self._request(
+            "POST",
+            f"{self._LEASE_BASE}/{namespace}/leases",
+            {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+             **lease},
+        )
+
+    def update_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        return self._request(
+            "PUT",
+            f"{self._LEASE_BASE}/{namespace}/leases/{name}",
+            {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+             **lease},
+        )
+
     # -- events ------------------------------------------------------------
     def create_event(self, namespace: str, event: dict) -> None:
         self._request(

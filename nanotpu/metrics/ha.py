@@ -1,0 +1,78 @@
+"""``nanotpu_ha_*`` exposition: the HA pair's scrape surface (docs/ha.md).
+
+The gauge values come from ONE producer —
+:meth:`HACoordinator.ha_gauge_values
+<nanotpu.ha.standby.HACoordinator.ha_gauge_values>` — so the scrape
+surface and the timeline's ``ha`` section read the same numbers. The
+nanolint metrics-completeness pass cross-checks :data:`_HA_GAUGES`
+against that producer BOTH directions (a suffix declared here but never
+produced, or produced there but never declared, is a lint finding) —
+the same honesty contract the throughput/timeline/SLO/serving families
+live under."""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger("nanotpu.metrics.ha")
+
+_FAMILY = "nanotpu_ha_"
+
+#: gauge suffix -> help text. Keys must match
+#: HACoordinator.ha_gauge_values() exactly — nanolint pins the
+#: equivalence both ways.
+_HA_GAUGES: dict[str, str] = {
+    "role":
+        "This replica's HA role: 1 = active (holds the leader lease, "
+        "serves writes), 0 = warm standby (tails the delta stream)",
+    "lag_events":
+        "Delta records the active has emitted that this standby has not "
+        "yet applied (0 on the active)",
+    "lag_seconds":
+        "Age of the newest applied delta while records are pending — "
+        "how far behind the stream the standby's state is, in seconds",
+    "applied_deltas":
+        "Delta records this replica has applied into its own dealer "
+        "since boot",
+    "emitted_deltas":
+        "Delta records this replica has emitted as the active (its "
+        "standby tails these)",
+    "promotions":
+        "Standby-to-active promotions this process has performed",
+    "reconciled_pods":
+        "Pods reconciled against informer state during the last "
+        "promotion (the lag window — O(delta), not O(fleet))",
+    "apply_failures":
+        "`bound` records that conflicted with stale local accounting "
+        "(kept in the dirty window; the next reconcile heals them)",
+    "tail_stale":
+        "1 when the delta tail fell off the source ring and the next "
+        "promotion must full-resync instead of the O(delta) window",
+    "parked_noted":
+        "Strict-gang reservations the active reported parked "
+        "(bookkeeping only: reservations die with the active)",
+}
+
+
+class HAExporter:
+    """Registry-compatible renderer (``Registry.register``) for the HA
+    gauges. Registered exactly when a coordinator is attached
+    (``SchedulerAPI.attach_ha``), so single-replica deployments export
+    nothing new."""
+
+    def __init__(self, coordinator):
+        self.coordinator = coordinator
+
+    def render(self) -> list[str]:
+        out: list[str] = []
+        try:
+            values = self.coordinator.ha_gauge_values()
+        except Exception:
+            log.warning("ha gauge producer failed", exc_info=True)
+            return out
+        for suffix in sorted(_HA_GAUGES):
+            name = _FAMILY + suffix
+            out.append(f"# HELP {name} {_HA_GAUGES[suffix]}")
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {float(values[suffix])}")
+        return out

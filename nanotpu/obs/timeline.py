@@ -117,6 +117,11 @@ class Timeline:
         self.verb_duration = verb_duration
         self.recovery = recovery
         self.model = model
+        #: optional HA coordinator (docs/ha.md): when attached, every
+        #: tick gains an ``ha`` section (role, stream seq/lag,
+        #: promotions). The key is PRESENT ONLY THEN, so single-replica
+        #: tick bytes — and every pinned scenario digest — are unchanged.
+        self.ha = None
         self.capacity = int(capacity)
         self.clock = clock
         self.deterministic = bool(deterministic)
@@ -186,6 +191,8 @@ class Timeline:
             tick["resilience"] = self._sample_resilience()
             tick["recovery"] = self._sample_recovery()
             tick["throughput"] = self._sample_throughput(now)
+            if self.ha is not None:
+                tick["ha"] = self._sample_ha()
             tick["ext"] = ext
             if len(self._ring) < self.capacity:
                 self._ring.append(tick)
@@ -323,6 +330,20 @@ class Timeline:
             log.exception("timeline throughput tap failed")
             return {}
 
+    def _sample_ha(self) -> dict:
+        try:
+            status = self.ha.status()
+        except Exception:  # a mid-promotion coordinator must not kill a tick
+            log.exception("timeline ha tap failed")
+            return {"error": 1}
+        return {
+            "role": status["role"],
+            "applied_seq": status["applied_seq"],
+            "lag_events": status["lag_events"],
+            "promotions": status["promotions"],
+            "reconciled_pods": status["reconciled_pods"],
+        }
+
     def _sample_sources(self) -> dict:
         out: dict = {}
         for source in list(self._sources):
@@ -431,7 +452,14 @@ class TelemetryLoop:
         self._thread.start()
 
     def stop(self) -> None:
+        """Idempotent; joins (not from the loop's own thread) so a
+        promotion's rewire cannot race a tick against the dead dealer
+        (same contract as RecoveryLoop/BatchLoop — pinned by the
+        promote-under-load test)."""
         self._stop.set()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=2.0)
 
     def _run(self) -> None:
         while not self._stop.wait(self.period_s):

@@ -327,6 +327,7 @@ class RecoveryPlane:
                 node=node, expires_at=expires, gang_key=key,
             )
             self.counters.backfill_leases += 1
+            self._ha_note("lease", uid=pod.uid, action="grant")
             self._audit(pod.uid, pod.key(), node, REASON_BACKFILLED)
             return key
         return None
@@ -344,6 +345,7 @@ class RecoveryPlane:
             node=node, expires_at=expires_at, gang_key="",
         )
         self.counters.drain_leases += 1
+        self._ha_note("lease", uid=uid, action="grant")
         self._audit(uid, f"{namespace}/{pod_name}", node, REASON_DRAINING)
 
     def pod_gone(self, uid: str) -> None:
@@ -365,6 +367,15 @@ class RecoveryPlane:
     def _close_hole(self, gang_key: str) -> None:
         if self.holes.pop(gang_key, None) is not None:
             self.counters.holes_closed += 1
+            self._ha_note("hole", gang=gang_key, action="close")
+
+    def _ha_note(self, kind: str, **data) -> None:
+        """Mirror a hole/lease transition into the HA delta stream
+        (docs/ha.md): earmarks are control-plane intent the standby
+        tracks as bookkeeping — one attribute check when HA is off."""
+        emit = getattr(self.dealer, "_ha_emit", None)
+        if emit is not None:
+            emit(kind, **data)
 
     def status(self) -> dict:
         """Live plane state for ``/debug/decisions`` and the sim report."""
@@ -517,6 +528,7 @@ class RecoveryPlane:
                             lease.namespace, lease.pod_name, e)
                 continue
             self.counters.drain_lease_expiries += 1
+            self._ha_note("lease", uid=uid, action="expire")
             self._audit(
                 uid, f"{lease.namespace}/{lease.pod_name}", lease.node,
                 REASON_DRAIN_EXPIRED,
@@ -545,6 +557,7 @@ class RecoveryPlane:
                     REASON_LEASE_EXPIRED,
                 ):
                     self.counters.backfill_lease_expiries += 1
+                    self._ha_note("lease", uid=uid, action="expire")
                     evicted.append(lease.pod_name)
                     actions.append((
                         "lease-expire",
@@ -579,6 +592,7 @@ class RecoveryPlane:
                 last_parked_t=now,
             )
             self.counters.holes_opened += 1
+            self._ha_note("hole", gang=gang_key, action="open")
             actions.append(("hole-open", gang_key))
         return hole
 
@@ -767,6 +781,7 @@ class RecoveryPlane:
                         gang_key=gang_key,
                     )
                     self.counters.backfill_leases += 1
+                    self._ha_note("lease", uid=victim.uid, action="grant")
                     self._audit(
                         victim.uid, victim.key(), node, REASON_BACKFILLED,
                     )
@@ -1101,15 +1116,27 @@ class RecoveryLoop:
         self._thread: threading.Thread | None = None
 
     def start(self) -> None:
-        if self._thread is not None:
+        """Idempotent AND restart-safe: a live loop is left alone, a
+        stopped one restarts (an HA promotion stops the standby-side
+        loops and restarts them against the promoted dealer — the old
+        guard latched `_thread` forever, so the restart silently
+        no-opped; pinned by the promote-under-load test)."""
+        if self._thread is not None and self._thread.is_alive():
             return
+        self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="recovery",
         )
         self._thread.start()
 
     def stop(self) -> None:
+        """Idempotent; joins so teardown ordering is safe (the caller
+        may close the dealer right after — a cycle still in flight must
+        not race the closed pools). Safe from the loop's own thread."""
         self._stop.set()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=2.0)
 
     def _loop(self) -> None:
         while not self._stop.wait(self.period_s):

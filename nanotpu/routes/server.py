@@ -67,6 +67,7 @@ DEBUG_ROUTES = (
     "/debug/traces/",
     "/debug/decisions",
     "/debug/timeline",
+    "/debug/ha",
 )
 
 
@@ -269,6 +270,11 @@ class SchedulerAPI:
         self.timeline = None
         self.slo = None
         self.flight = None
+        #: HA coordinator (docs/ha.md), attached by attach_ha: gates the
+        #: write verbs on leadership, stamps /readyz with the role, and
+        #: serves GET /debug/ha. None == single-replica == zero new code
+        #: on any request path.
+        self.ha = None
         #: NodeNames-span bytes -> parsed list. nodeCacheCapable payloads
         #: repeat the identical candidate list across every pod's Filter,
         #: and that list is most of the body — the pre-tokenized fast path
@@ -310,6 +316,8 @@ class SchedulerAPI:
                 return self._debug_decisions(path)
             if method == "GET" and path.startswith("/debug/timeline"):
                 return self._debug_timeline(path)
+            if method == "GET" and path.startswith("/debug/ha"):
+                return self._debug_ha(path)
             return 404, "application/json", error_body(
                 "NotFound", f"no route {path}"
             )
@@ -322,6 +330,26 @@ class SchedulerAPI:
             )
 
     def _verb(self, verb, body: bytes) -> tuple[int, str, str]:
+        if (
+            verb.name == "bind"
+            and self.ha is not None
+            and not self.ha.is_leader()
+        ):
+            # leader gate on the WRITE verb (docs/ha.md): a standby must
+            # never commit chips or apiserver writes — kube-scheduler's
+            # retry lands on the active (readiness steers the Service
+            # there; this gate is the backstop for direct traffic).
+            # Filter/Prioritize stay answerable: reads off the warm
+            # snapshots are harmless and keep the standby's caches hot.
+            self.resilience.inc("shed", verb.name)
+            self.verb_total.inc(verb=verb.name, code="503")
+            return 503, "application/json", error_body(
+                "NotLeader",
+                "this replica is the warm standby; binds commit only "
+                "on the leader (docs/ha.md)",
+                Role=self.ha.role,
+                RetryAfterSeconds=self.overload.retry_after_s,
+            )
         shed_inflight = -1
         with self._inflight_lock:
             # admission gate: once the box is chewing max_inflight verb
@@ -478,6 +506,15 @@ class SchedulerAPI:
                 "batch admission disabled (start with --batch; "
                 "docs/batch-admission.md)",
             )
+        if self.ha is not None and not self.ha.is_leader():
+            # the batch cycle commits binds — same leader gate as /bind
+            return 503, "application/json", error_body(
+                "NotLeader",
+                "this replica is the warm standby; batch admission "
+                "commits only on the leader (docs/ha.md)",
+                Role=self.ha.role,
+                RetryAfterSeconds=self.overload.retry_after_s,
+            )
         started = time.perf_counter()
         code = 200
         try:
@@ -618,6 +655,55 @@ class SchedulerAPI:
         if watchdog is not None:
             self.registry.register(SLOExporter(watchdog))
 
+    # -- HA (docs/ha.md) ---------------------------------------------------
+    def attach_ha(self, coordinator) -> None:
+        """Adopt the replica's HA coordinator: register the
+        ``nanotpu_ha_*`` exporter, gate the write verbs on leadership,
+        add the leader readiness gate (a standby answers /readyz 503 so
+        the Service steers kube-scheduler to the active — failover flips
+        it within one probe period), and serve ``GET /debug/ha``.
+        Single-replica deployments never call this and change by
+        nothing."""
+        from nanotpu.metrics.ha import HAExporter
+
+        self.ha = coordinator
+        self.registry.register(HAExporter(coordinator))
+        self.add_ready_check("ha-leader", coordinator.is_leader)
+
+    def _debug_ha(self, path: str) -> tuple[int, str, str]:
+        """``GET /debug/ha?since=<seq>&limit=N``: role + stream status,
+        plus retained delta records newer than ``since`` — the
+        cross-process standby tail transport AND the operator's lag
+        view. Admission-exempt like every /debug route."""
+        if self.ha is None:
+            return 404, "application/json", error_body(
+                "NotFound",
+                "HA disabled; start a replicated pair (docs/ha.md)",
+            )
+        _, _, query = path.partition("?")
+        params = dict(
+            kv.split("=", 1) for kv in query.split("&") if "=" in kv
+        )
+        try:
+            since = int(params.get("since", -1))
+            limit = min(max(int(params.get("limit", 512)), 1), 4096)
+        except ValueError:
+            return 400, "application/json", error_body(
+                "BadRequest", "since and limit must be integers"
+            )
+        body = dict(self.ha.status())
+        if since >= 0 and self.ha.log is not None:
+            records = self.ha.log.since(since, limit=limit)
+            if records is None:
+                # the tail fell off the ring: the poller must resync
+                # from durable state, and silently skipping the gap
+                # would be a lie
+                body["stale_tail"] = True
+                body["records"] = []
+            else:
+                body["records"] = records
+        return 200, "application/json", json.dumps(body, sort_keys=True)
+
     # -- readiness ---------------------------------------------------------
     def add_ready_check(self, name: str, fn) -> None:
         """Register a readiness gate; ``fn()`` truthy == ready. cmd/main
@@ -634,14 +720,21 @@ class SchedulerAPI:
                 ready = False
             if not ready:
                 waiting.append(name)
+        # the HA role rides along exactly when a coordinator is attached
+        # (docs/ha.md): single-replica bodies stay byte-identical
+        extra = {"Role": self.ha.role} if self.ha is not None else {}
         if waiting:
             return 503, "application/json", error_body(
                 "NotReady",
                 f"not ready: waiting on {', '.join(waiting)}",
                 Waiting=waiting,
                 RetryAfterSeconds=self.overload.retry_after_s,
+                **extra,
             )
-        return 200, "application/json", json.dumps({"ready": True})
+        body = {"ready": True}
+        if self.ha is not None:
+            body["role"] = self.ha.role
+        return 200, "application/json", json.dumps(body)
 
     # -- decision/trace introspection (docs/observability.md) --------------
     def _debug_traces(self, path: str) -> tuple[int, str, str]:

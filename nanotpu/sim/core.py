@@ -61,6 +61,23 @@ GANG_RESUBMIT_DELAY_S = 1.0
 BIND_RETRIES_PER_CYCLE = 2
 
 
+class _StandbyStack:
+    """The warm-standby replica's process-equivalent inside the sim: its
+    own dealer, standby-mode controller, informer watches, and the
+    coordinator tailing the active's delta stream (docs/ha.md)."""
+
+    __slots__ = ("dealer", "controller", "coordinator", "pod_watch",
+                 "node_watch")
+
+    def __init__(self, dealer, controller, coordinator, pod_watch,
+                 node_watch):
+        self.dealer = dealer
+        self.controller = controller
+        self.coordinator = coordinator
+        self.pod_watch = pod_watch
+        self.node_watch = node_watch
+
+
 class Simulator:
     def __init__(self, scenario: dict, seed: int = 0):
         self.scenario = normalize_scenario(scenario)
@@ -105,6 +122,13 @@ class Simulator:
         # autoscaler/feedback inside it) can never shift the base
         # workload's arrival or lifetime draws (same isolation rule)
         self.rng_serve = random.Random(base + 8)
+        # the HA plane's reserved stream (docs/ha.md): the pair itself
+        # draws nothing today (crash times are scheduled, the delta
+        # stream and promotion are total orders), but the stream is
+        # allocated so any future HA draw lives here and toggling
+        # `ha.enabled` can never shift a sibling stream (same isolation
+        # rule as rng_defrag; pinned by the crash toggle test)
+        self.rng_crash = random.Random(base + 9)
 
         self.client = make_fleet(self.scenario["fleet"])
         self.faults = FaultPlan(self.scenario["faults"], self.rng_fault)
@@ -178,6 +202,10 @@ class Simulator:
                 capacity=tel["capacity"],
                 clock=lambda: self.now, deterministic=True,
             )
+            # HA scenarios: every tick gains the `ha` section (role,
+            # stream seq/lag, promotions) — absent otherwise, so
+            # existing tick digests stay byte-identical (docs/ha.md)
+            self.timeline.ha = self.ha_active
             self.watchdog = SLOWatchdog(
                 self.timeline, obs=self.obs, clock=lambda: self.now
             )
@@ -241,6 +269,13 @@ class Simulator:
         # controller handlers, with the fault layer in between
         self._pod_watch = self.client.watch_pods()
         self._node_watch = self.client.watch_nodes()
+        # the warm standby (docs/ha.md): built AFTER the active's watches
+        # so both informer taps see the same event stream from here on
+        self._ha_promotions = 0
+        self._ha_reconciled = 0
+        self.standby = None
+        if self.scenario["ha"]["enabled"]:
+            self._build_standby()
 
         self.report = ReportBuilder(self.scenario, seed)
         self._heap: list[tuple[float, int, object, object]] = []
@@ -275,6 +310,31 @@ class Simulator:
             obs=self.obs, shards=self.scenario["shards"],
             pipeline_depth=self.scenario["pipeline"],
         )
+        if self.scenario["ha"]["enabled"]:
+            # the HA pair (docs/ha.md): the active emits its delta
+            # stream; an agent restart mints a fresh log and the
+            # standing standby re-tails it from the start (its state is
+            # already consistent with the durable annotations, and
+            # overlapping records apply idempotently)
+            from nanotpu.ha import DeltaLog, HACoordinator
+
+            self.dealer.ha = DeltaLog(clock=lambda: self.now)
+            self.ha_active = HACoordinator(
+                self.dealer, role="active", log_=self.dealer.ha,
+                clock=lambda: self.now,
+            )
+            sb = getattr(self, "standby", None)
+            if sb is not None:
+                sb.coordinator.rebase(self.dealer.ha)
+        else:
+            self.ha_active = None
+        self._wire_dealer()
+
+    def _wire_dealer(self) -> None:
+        """Point every stack component at ``self.dealer`` — boot, the
+        agent-restart rebuild, and a scheduler-crash promotion all share
+        this one rewiring (the promotion adopts the standby's dealer
+        instead of building one, docs/ha.md)."""
         self.predicate = Predicate(self.dealer, obs=self.obs)
         self.prioritize = Prioritize(self.dealer, obs=self.obs)
         self.bind_verb = Bind(self.dealer, obs=self.obs)
@@ -302,9 +362,13 @@ class Simulator:
         plane = getattr(self, "plane", None)
         if plane is not None:
             # agent restart: the plane keeps its holes/leases (recovery
-            # intent, not dealer state) and points at the fresh dealer
+            # intent, not dealer state) and points at the fresh dealer.
+            # A promotion also moves its requeue target — the dead
+            # active's workqueue drains nowhere (docs/ha.md)
             plane.dealer = self.dealer
             self.dealer.recovery = plane
+            if getattr(self, "controller", None) is not None:
+                plane.controller = self.controller
         serve = getattr(self, "serve", None)
         if serve is not None and serve.tap is not None:
             # agent restart: the serving tap writes through the fresh
@@ -319,6 +383,7 @@ class Simulator:
             timeline.rewire_dealer(
                 self.dealer, getattr(self.dealer.rater, "model", None)
             )
+            timeline.ha = self.ha_active
             self.flight.dealer = self.dealer
         if hasattr(self, "controller"):
             self.controller.dealer = self.dealer
@@ -333,6 +398,49 @@ class Simulator:
                 resilience=self.resilience,
                 obs=self.obs,
             )
+
+    def _build_standby(self) -> None:
+        """A fresh warm standby behind the CURRENT active — at boot and
+        after every promotion (production restarts the dead replica,
+        which comes back as the new standby). Its dealer warm-boots from
+        the durable annotations, then tails the active's delta log from
+        the seq that boot covered (overlap applies idempotently); its
+        controller runs in standby mode (cache + dirty window only).
+        Its informer watches are fault-free — the faults under test
+        live on the ACTIVE's tap."""
+        from nanotpu.ha import HACoordinator
+
+        start_seq = self.dealer.ha.seq
+        api_client = ResilientClientset(
+            BrownoutClient(self.client, self.faults),
+            counters=self.resilience,
+            clock=lambda: self.now,
+            sleep=lambda s: None,
+            rng=self.rng_retry,
+        )
+        sd = Dealer(
+            api_client, make_rater(self.scenario["policy"]),
+            assume_workers=2, obs=self.obs,
+            shards=self.scenario["shards"],
+            pipeline_depth=self.scenario["pipeline"],
+        )
+        sc = Controller(
+            self.client, sd, resync_period_s=0,
+            queue_max=self.scenario["queue_max"], assume_ttl_s=0,
+            resilience=self.resilience, obs=self.obs,
+        )
+        sc.enter_standby()
+        sc.resync_once()  # standby mode: cache prime + synced() gate
+        coordinator = HACoordinator(
+            sd, role="standby", source=self.dealer.ha, controller=sc,
+            lag_events=self.scenario["ha"]["lag_events"],
+            clock=lambda: self.now,
+        )
+        coordinator.applied_seq = start_seq
+        self.standby = _StandbyStack(
+            sd, sc, coordinator,
+            self.client.watch_pods(), self.client.watch_nodes(),
+        )
 
     def _push(self, t: float, kind: str, payload=None) -> None:
         heapq.heappush(self._heap, (t, next(self._seq), kind, payload))
@@ -400,6 +508,8 @@ class Simulator:
             self._push(t, "flap", None)
         for t in self.faults.restart_times(horizon):
             self._push(t, "agent_restart", None)
+        for t in self.faults.crash_times(horizon):
+            self._push(t, "scheduler_crash", None)
         for start, end in self.faults.brownout_windows(horizon):
             self._push(start, "brownout", True)
             self._push(end, "brownout", False)
@@ -472,6 +582,8 @@ class Simulator:
             self._on_flap_restore(payload)
         elif kind == "agent_restart":
             self._on_agent_restart()
+        elif kind == "scheduler_crash":
+            self._on_scheduler_crash()
         elif kind == "metric_sync":
             self._on_metric_sync(payload)
         elif kind == "metric_apply":
@@ -532,6 +644,26 @@ class Simulator:
                         )
                         handler(event)
         self.controller.drain_sync()
+        self._pump_standby()
+
+    def _pump_standby(self) -> None:
+        """Deliver the standby's informer events (fault-free: the
+        faults under test live on the active's tap) and tail the delta
+        stream within the configured lag — the standby replica's event
+        loop, stepped deterministically on the sim thread."""
+        sb = self.standby
+        if sb is None:
+            return
+        for watch, handler in (
+            (sb.node_watch, sb.controller.handle_node_event),
+            (sb.pod_watch, sb.controller.handle_pod_event),
+        ):
+            while True:
+                event = watch.poll(timeout=0.0)
+                if event is None:
+                    break
+                handler(event)
+        sb.coordinator.tail_once()
 
     # -- scheduling cycle ----------------------------------------------------
     def _live_node_names(self) -> list[str]:
@@ -927,6 +1059,68 @@ class Simulator:
                     f"{occ_truth:.6f}"
                 ),
             })
+
+    def _on_scheduler_crash(self) -> None:
+        """Kill the ACTIVE dealer mid-run (docs/ha.md): its delta stream
+        stops where the standby's applied_seq stands — records past it
+        died with the process — the warm standby promotes in ONE step
+        (O(lag-window) reconcile against its informer dirty keys), the
+        sim adopts the standby's stack as the active, and a FRESH
+        standby boots behind the new leader. Convergence is judged
+        against the durable annotations exactly like the agent-restart
+        fault — the promoted dealer must agree with ground truth."""
+        sb = self.standby
+        if sb is None:
+            return
+        occ_before = self.dealer.occupancy()
+        if self.flight is not None:
+            # post-mortem of the dying active, exactly like the
+            # agent-restart drill: the bundle must come out complete
+            self.flight.dump("dealer_death", now=self.now)
+            self.report.journal(self.now, "flight-dump dealer_death")
+        self.dealer.close()
+        # the dead active's informer watches die with it
+        self._pod_watch.stop()
+        self._node_watch.stop()
+        result = sb.coordinator.promote(now=self.now)
+        self.faults.counts["scheduler_crashes"] += 1
+        self._ha_promotions += 1
+        self._ha_reconciled += max(result["reconciled"], 0)
+        # adopt the standby's stack as the active's
+        self.dealer = sb.dealer
+        self.controller = sb.controller
+        self._pod_watch = sb.pod_watch
+        self._node_watch = sb.node_watch
+        self.ha_active = sb.coordinator
+        self._wire_dealer()
+        # anything the reconcile requeued drains now, on the sim thread
+        self.controller.drain_sync()
+        occ_after = self.dealer.occupancy()
+        occ_truth = ground_truth_occupancy(self.dealer, self.client)
+        drift = abs(occ_after - occ_truth)
+        self.report.restart_occupancy_drift = max(
+            self.report.restart_occupancy_drift, drift
+        )
+        self.report.journal(
+            self.now,
+            f"scheduler-crash occ {occ_before:.6f} -> {occ_after:.6f} "
+            f"(truth {occ_truth:.6f}) reconciled={result['reconciled']}",
+        )
+        if drift > 1e-9:
+            self.report.violations.append({
+                "kind": "failover_occupancy_drift",
+                "detail": (
+                    f"promoted standby holds occupancy {occ_after:.6f} "
+                    f"but live annotations say {occ_truth:.6f}"
+                ),
+            })
+        # pending pods retry against the new leader immediately — the
+        # sim analogue of kube-scheduler's retry landing on the freshly
+        # ready replica
+        self._on_retry()
+        # production restarts the dead replica; it returns as the new
+        # standby behind the promoted leader
+        self._build_standby()
 
     def _on_brownout(self, active: bool) -> None:
         self.faults.brownout_active = active
@@ -1381,6 +1575,49 @@ class Simulator:
                 f"migrated={counters['migrated_pods']} "
                 f"backfilled={counters['backfill_leases']} "
                 f"lease_expired={counters['backfill_lease_expiries']}",
+            )
+        if self.scenario["ha"]["enabled"]:
+            # deterministic HA section (docs/ha.md): the standby drains
+            # its remaining lag at settle and must then agree with the
+            # durable annotations exactly — the "converged dealer-vs-
+            # cluster equality" half of the failover certification, for
+            # the replica that did NOT serve the traffic
+            sb = self.standby
+            sb_drift = 0.0
+            if sb is not None:
+                sb.coordinator.lag_events = 0
+                self._pump_standby()
+                sb_occ = sb.dealer.occupancy()
+                sb_truth = ground_truth_occupancy(sb.dealer, self.client)
+                sb_drift = abs(sb_occ - sb_truth)
+                if sb_drift > 1e-9:
+                    self.report.violations.append({
+                        "kind": "standby_occupancy_drift",
+                        "detail": (
+                            f"settled standby holds occupancy "
+                            f"{sb_occ:.6f} but live annotations say "
+                            f"{sb_truth:.6f}"
+                        ),
+                    })
+            self.report.ha = {
+                "crashes": self.faults.counts["scheduler_crashes"],
+                "promotions": self._ha_promotions,
+                "reconciled_pods": self._ha_reconciled,
+                "applied_deltas": (
+                    sb.coordinator.applied_deltas if sb is not None else 0
+                ),
+                "emitted_deltas": (
+                    self.dealer.ha.seq if self.dealer.ha is not None else 0
+                ),
+                "standby_drift_pct": round(100 * sb_drift, 6),
+            }
+            self.report.journal(
+                horizon,
+                f"ha crashes={self.report.ha['crashes']} "
+                f"promotions={self._ha_promotions} "
+                f"reconciled={self._ha_reconciled} "
+                f"applied={self.report.ha['applied_deltas']} "
+                f"standby_drift={sb_drift:.6f}",
             )
         if self.serve is not None:
             # deterministic serving section (docs/serving-loop.md): the

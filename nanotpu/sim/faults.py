@@ -32,6 +32,12 @@ it injected. The taxonomy (scenario ``faults`` section):
   resilient client wrapper must retry, trip its breaker, fast-fail, and
   recover through a half-open probe once the window closes — with chip
   accounting exact throughout.
+* ``scheduler_crash`` — the ACTIVE dealer process is killed at the
+  listed times (docs/ha.md): its delta stream stops mid-lag, the warm
+  standby promotes (reconciling only the lag window against informer
+  state), and a FRESH standby boots behind the new active. Requires the
+  scenario's ``ha`` section enabled; converged equality and zero
+  double-binds are the certification.
 """
 
 from __future__ import annotations
@@ -90,6 +96,7 @@ class FaultPlan:
             "overload_arrivals": 0,
             "brownouts": 0,
             "brownout_rejections": 0,
+            "scheduler_crashes": 0,
         }
 
     # -- schedule-time queries (used once, at sim setup) --------------------
@@ -109,6 +116,13 @@ class FaultPlan:
     def restart_times(self, horizon_s: float) -> list[float]:
         return sorted(
             float(t) for t in self.spec["agent_restart"].get("at_s", [])
+            if 0 < float(t) < horizon_s
+        )
+
+    def crash_times(self, horizon_s: float) -> list[float]:
+        """Active-dealer kill times (the HA failover fault, docs/ha.md)."""
+        return sorted(
+            float(t) for t in self.spec["scheduler_crash"].get("at_s", [])
             if 0 < float(t) < horizon_s
         )
 

@@ -78,6 +78,10 @@ class ReportBuilder:
         #: autoscale action counters — docs/serving-loop.md); empty ==
         #: serving disabled, same opt-in digest rule as the sections above
         self.serving: dict = {}
+        #: HA pair summary (crashes survived, promotions, deltas
+        #: applied, reconcile-window sizes, standby-vs-truth drift —
+        #: docs/ha.md); empty == ha disabled, same opt-in digest rule
+        self.ha: dict = {}
         self.restart_occupancy_drift = 0.0
         self.final_occupancy = 0.0
         self.final_fragmentation = 0.0
@@ -183,6 +187,9 @@ class ReportBuilder:
             # same opt-in rule (docs/serving-loop.md); render() sorts
             # keys globally, so nested sections need no manual ordering
             report["serving"] = self.serving
+        if self.ha:
+            # same opt-in rule (docs/ha.md)
+            report["ha"] = {k: self.ha[k] for k in sorted(self.ha)}
         if include_timing:
             report["timing"] = {
                 "note": "wall-clock; excluded from the determinism contract",

@@ -29,7 +29,19 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
         "agent_restart": {"at_s": [15.0]},
         "overload": {"burst_every_s": 8.0, "burst_s": 3.0,
                      "rate_multiplier": 4.0},
-        "api_brownout": {"at_s": [12.0], "duration_s": 4.0}
+        "api_brownout": {"at_s": [12.0], "duration_s": 4.0},
+        "scheduler_crash": {"at_s": [20.0]}  # kill the ACTIVE dealer —
+                                     # requires ha.enabled (docs/ha.md)
+      },
+      "ha": {                        # warm-standby dealer pair
+                                     # (docs/ha.md); absent/disabled
+                                     # keeps every existing digest
+                                     # byte-identical
+        "enabled": false,
+        "lag_events": 8              # delta records the standby's apply
+                                     # trails the stream by (the sim's
+                                     # stream-latency model; the crash's
+                                     # reconcile window)
       },
       "resync_every_s": 5.0,
       "sample_every_s": 1.0,
@@ -255,7 +267,8 @@ def normalize_scenario(raw: dict) -> dict:
 
     f = dict(raw.get("faults") or {})
     for key in ("node_flap", "bind_failure", "drop_event", "dup_event",
-                "metric_sync", "agent_restart", "overload", "api_brownout"):
+                "metric_sync", "agent_restart", "overload", "api_brownout",
+                "scheduler_crash"):
         f.setdefault(key, {})
     for key in ("bind_failure", "drop_event", "dup_event"):
         prob = float(f[key].get("prob", 0.0))
@@ -403,6 +416,21 @@ def normalize_scenario(raw: dict) -> dict:
                 "autoscaler is off (a serving scenario needs a fleet)",
             )
 
+    ha_raw = dict(raw.get("ha") or {})
+    ha = {
+        "enabled": bool(ha_raw.get("enabled", False)),
+        "lag_events": int(ha_raw.get("lag_events", 8)),
+    }
+    _require(
+        ha["lag_events"] >= 0,
+        "ha.lag_events must be >= 0",
+    )
+    _require(
+        not f["scheduler_crash"].get("at_s") or ha["enabled"],
+        "faults.scheduler_crash requires ha.enabled (there is no "
+        "standby to promote otherwise)",
+    )
+
     rec = dict(raw.get("recovery") or {})
     recovery = {
         "enabled": bool(rec.get("enabled", False)),
@@ -444,6 +472,7 @@ def normalize_scenario(raw: dict) -> dict:
         "shards": shards,
         "pipeline": pipeline,
         "batch": batch,
+        "ha": ha,
         "recovery": recovery,
         "telemetry": telemetry,
         "serving": serving,

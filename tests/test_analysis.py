@@ -463,6 +463,60 @@ class TestLockDiscipline:
             for f in cycles
         ), report.findings
 
+    def test_blocking_under_delta_log_lock_flagged(self, tmp_path):
+        # DeltaLog._lock is in HOT_LOCKS (ISSUE r14 satellite,
+        # docs/ha.md): every commit point on the write path appends
+        # under it, so its critical sections are append-only by
+        # contract — checkpoint file I/O batches OUTSIDE the lock, and
+        # an apiserver round-trip inside it must be a finding
+        report = one(tmp_path, """
+            from nanotpu.analysis.witness import make_lock
+
+            class DeltaLog:
+                def __init__(self):
+                    self._lock = make_lock("DeltaLog._lock")
+
+                def emit_and_post(self):
+                    with self._lock:
+                        self.client.update_pod(None)
+            """, "lock-discipline")
+        assert any(
+            "DeltaLog._lock" in f.message and "blocking" in f.message
+            for f in report.findings
+        ), report.findings
+
+    def test_standby_coordinator_dealer_inversion_flagged(self, tmp_path):
+        # seeded inversion (ISSUE r14 satellite): the coordinator's
+        # witness-named standby lock guards only the role flip —
+        # promotion's reconcile (apiserver syncs, dealer accounting)
+        # runs OUTSIDE it by contract. A path nesting it with the
+        # dealer lock in BOTH orders is the promotion deadlock the
+        # discipline forbids.
+        report = one(tmp_path, """
+            from nanotpu.analysis.witness import make_lock
+
+            class HACoordinator:
+                def __init__(self):
+                    self._lock = make_lock("HACoordinator._lock")
+
+            class Dealer:
+                def apply_under_dealer(self, co: HACoordinator):
+                    with self._lock:
+                        with co._lock:
+                            pass
+
+                def promote_under_coordinator(self, co: HACoordinator):
+                    with co._lock:
+                        with self._lock:
+                            pass
+            """, "lock-discipline")
+        cycles = [f for f in report.findings if "cycle" in f.message]
+        assert any(
+            "HACoordinator._lock" in f.message
+            and "Dealer._lock" in f.message
+            for f in cycles
+        ), report.findings
+
 
 # ---------------------------------------------------------------------------
 # snapshot-immutability
@@ -1143,6 +1197,40 @@ class TestMetricsCompleteness:
         assert any("dead_serving_gauge" in m and "KeyError" in m
                    for m in msgs), msgs
 
+    # -- HA gauge family (nanotpu/metrics/ha.py) ---------------------------
+    def test_ha_gauge_produced_but_undeclared(self, tmp_path):
+        # ISSUE r14 satellite: the nanotpu_ha_* table <-> producer held
+        # both directions, same structural check as the other families
+        report = lint(tmp_path, {
+            "ha.py": """
+                _HA_GAUGES = {"role": "active/standby"}
+
+                class HACoordinator:
+                    def ha_gauge_values(self, now=None):
+                        return {"role": 1.0, "ghost_ha_gauge": 1}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("ghost_ha_gauge" in m and "not declared" in m
+                   for m in msgs), msgs
+
+    def test_ha_gauge_declared_but_never_produced(self, tmp_path):
+        report = lint(tmp_path, {
+            "ha.py": """
+                _HA_GAUGES = {
+                    "role": "active/standby",
+                    "dead_ha_gauge": "declared but never produced",
+                }
+
+                class HACoordinator:
+                    def ha_gauge_values(self, now=None):
+                        return {"role": 1.0}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("dead_ha_gauge" in m and "KeyError" in m
+                   for m in msgs), msgs
+
     def test_gauge_families_do_not_cross_pollinate(self, tmp_path):
         # distinct producer names per family: a timeline tick gauge must
         # not be held against the throughput/SLO tables (and vice versa)
@@ -1152,6 +1240,7 @@ class TestMetricsCompleteness:
                 _TIMELINE_GAUGES = {"occupancy": "occ"}
                 _SLO_GAUGES = {"objectives": "n"}
                 _SERVING_GAUGES = {"tok_s": "decode rate"}
+                _HA_GAUGES = {"role": "active/standby"}
                 """,
             "producers.py": """
                 class Model:
@@ -1169,6 +1258,10 @@ class TestMetricsCompleteness:
                 class ServingMetricsSource:
                     def serving_gauge_values(self):
                         return {"tok_s": 100.0}
+
+                class HACoordinator:
+                    def ha_gauge_values(self, now=None):
+                        return {"role": 1.0}
                 """,
         }, ["metrics-completeness"])
         assert not any("gauge" in f.message for f in report.findings), \

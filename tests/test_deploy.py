@@ -33,10 +33,43 @@ def test_all_manifests_parse():
         "kube-scheduler-config.yaml",
         "nanotpu-agent.yaml",
         "nanotpu-policy-cm.yaml",
+        "nanotpu-scheduler-ha.yaml",
         "nanotpu-scheduler.yaml",
     ]
     for n in names:
         assert _docs(n)
+
+
+def test_ha_manifest_matches_cli_and_lease_rbac():
+    """The HA pair manifest (docs/ha.md): two anti-affine replicas, the
+    --ha flag family spelled exactly as cmd/main registers it, a
+    leader-aware readiness probe, and lease RBAC for the acquire /
+    renew / steal dance."""
+    docs = _docs("nanotpu-scheduler-ha.yaml")
+    (dep,) = _by_kind(docs, "Deployment")
+    assert dep["spec"]["replicas"] == 2
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    args = c["args"]
+    assert "--ha" in args
+    assert any(a.startswith("--ha-peer=") for a in args)
+    assert any(a.startswith("--ha-checkpoint=") for a in args)
+    ttl = next(
+        float(a.split("=", 1)[1]) for a in args
+        if a.startswith("--ha-lease-ttl=")
+    )
+    period = next(
+        float(a.split("=", 1)[1]) for a in args
+        if a.startswith("--ha-period=")
+    )
+    assert period < ttl / 2  # the renew cadence the lease contract needs
+    assert c["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    anti = dep["spec"]["template"]["spec"]["affinity"]["podAntiAffinity"]
+    assert anti["requiredDuringSchedulingIgnoredDuringExecution"]
+    (role,) = _by_kind(docs, "ClusterRole")
+    (rule,) = role["rules"]
+    assert rule["apiGroups"] == ["coordination.k8s.io"]
+    assert rule["resources"] == ["leases"]
+    assert {"get", "create", "update"} <= set(rule["verbs"])
 
 
 def test_scheduler_deployment_args_match_cli():

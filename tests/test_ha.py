@@ -1,0 +1,813 @@
+"""HA control plane (docs/ha.md): delta stream semantics, warm-standby
+convergence, one-step promotion with the O(lag) reconcile, leader lease
+acquire/renew/steal, leader gating on the write verbs, checkpoint
+round-trip + warm restart, the nanotpu_ha_* exporter/producer key
+equivalence, and the promote-under-load shutdown-idempotency pins for
+Dealer.close + the Recovery/Batch/Telemetry loops."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.cmd.main import make_mock_cluster
+from nanotpu.controller.controller import Controller
+from nanotpu.dealer import Dealer
+from nanotpu.ha import (
+    DeltaLog,
+    HACoordinator,
+    HALoop,
+    LeaderLease,
+    load_checkpoint,
+)
+from nanotpu.k8s.client import FakeClientset, WatchEvent
+from nanotpu.k8s.objects import make_container, make_pod
+from nanotpu.metrics.registry import Registry
+from nanotpu.routes.server import SchedulerAPI
+
+
+def tpu_pod(name, percent=100, uid=None, gang=None, gang_size=0):
+    ann = {}
+    if gang:
+        ann = {
+            types.ANNOTATION_GANG_NAME: gang,
+            types.ANNOTATION_GANG_SIZE: str(gang_size),
+        }
+    return make_pod(
+        name, uid=uid,
+        containers=[
+            make_container("t", {types.RESOURCE_TPU_PERCENT: percent})
+        ],
+        annotations=ann,
+    )
+
+
+def make_pair(n_hosts=4, lag_events=0):
+    """(client, active dealer+log, standby dealer+controller+coordinator)."""
+    client = make_mock_cluster(n_hosts)
+    log_ = DeltaLog()
+    active = Dealer(client, make_rater("binpack"), ha_log=log_)
+    standby = Dealer(client, make_rater("binpack"))
+    sc = Controller(client, standby, resync_period_s=0, assume_ttl_s=0)
+    sc.enter_standby()
+    sc.resync_once()
+    co = HACoordinator(
+        standby, role="standby", source=log_, controller=sc,
+        lag_events=lag_events,
+    )
+    return client, active, log_, standby, sc, co
+
+
+def pump_standby(client_watches, controller):
+    for watch in client_watches:
+        while True:
+            ev = watch.poll(timeout=0.0)
+            if ev is None:
+                break
+            if isinstance(ev.obj, type(ev.obj)):
+                pass
+            controller.handle_pod_event(ev)
+
+
+def equal_state(a: Dealer, b: Dealer):
+    sa, sb = a.debug_snapshot(), b.debug_snapshot()
+    assert sa["tracked_uids"] == sb["tracked_uids"]
+    assert sa["accounted"] == sb["accounted"]
+    assert abs(a.occupancy() - b.occupancy()) < 1e-12
+
+
+class TestDeltaLog:
+    def test_seq_monotonic_and_since_window(self):
+        log_ = DeltaLog(capacity=8)
+        for i in range(5):
+            assert log_.emit("bound", {"i": i}) == i + 1
+        recs = log_.since(2)
+        assert [r["seq"] for r in recs] == [3, 4, 5]
+        assert log_.since(5) == []
+        assert log_.since(2, limit=2)[-1]["seq"] == 4
+
+    def test_ring_eviction_reports_stale_not_a_gap(self):
+        log_ = DeltaLog(capacity=8)
+        for i in range(64):
+            log_.emit("bound", {"i": i})
+        # seq 1 fell off the ring long ago: a reader must be told to
+        # resync, not silently handed a stream with a hole in it
+        assert log_.since(1) is None
+        newest = log_.status()["seq"]
+        assert log_.since(newest - 1)[-1]["seq"] == newest
+
+    def test_stream_kinds_cover_the_commit_points(self):
+        client, active, log_, standby, sc, co = make_pair()
+        pod = client.create_pod(tpu_pod("p1"))
+        ok, _ = active.assume(active.node_names(), pod)
+        bound = active.bind(ok[0], pod)
+        active.update_chip_usage(ok[0], 0, core=0.5)
+        active.release(bound)
+        kinds = {r["kind"] for r in log_.since(0)}
+        assert {"bound", "usage", "released"} <= kinds
+        active.close()
+        standby.close()
+
+
+class TestStandbyConvergence:
+    def test_binds_and_releases_stream_to_equal_state(self):
+        client, active, log_, standby, sc, co = make_pair()
+        pods = [client.create_pod(tpu_pod(f"p{i}")) for i in range(6)]
+        bound = []
+        for pod in pods:
+            ok, _ = active.assume(active.node_names(), pod)
+            bound.append(active.bind(ok[0], pod))
+        co.tail_once()
+        equal_state(active, standby)
+        active.release(bound[0])
+        active.release(bound[1])
+        co.tail_once()
+        equal_state(active, standby)
+        active.close()
+        standby.close()
+
+    def test_usage_stream_calibrates_standby_loads(self):
+        client, active, log_, standby, sc, co = make_pair()
+        node = active.node_names()[0]
+        active.update_chip_usage(node, 0, core=0.7, now=1.0)
+        co.tail_once()
+        a = active.debug_snapshot()["node_infos"][node]
+        s = standby.debug_snapshot()["node_infos"][node]
+        assert a.chips.chips[0].load == s.chips.chips[0].load != 0.0
+        active.close()
+        standby.close()
+
+    def test_migration_is_a_bound_with_a_new_node(self):
+        client, active, log_, standby, sc, co = make_pair()
+        pod = client.create_pod(tpu_pod("mig"))
+        ok, _ = active.assume(active.node_names(), pod)
+        active.bind(ok[0], pod)
+        co.tail_once()
+        target = next(n for n in active.node_names() if n != ok[0])
+        active.migrate(pod, target)
+        co.tail_once()
+        equal_state(active, standby)
+        assert standby.debug_snapshot()["accounted"][pod.uid] == target
+        active.close()
+        standby.close()
+
+    def test_lag_bounds_the_apply_window(self):
+        client, active, log_, standby, sc, co = make_pair(lag_events=3)
+        pods = [client.create_pod(tpu_pod(f"p{i}")) for i in range(5)]
+        for pod in pods:
+            ok, _ = active.assume(active.node_names(), pod)
+            active.bind(ok[0], pod)
+        co.tail_once()
+        assert co.applied_seq <= log_.seq - 3
+        assert co.lag() >= 3
+        co.lag_events = 0
+        co.tail_once()
+        assert co.lag() == 0
+        equal_state(active, standby)
+        active.close()
+        standby.close()
+
+    def test_duplicate_records_apply_idempotently(self):
+        client, active, log_, standby, sc, co = make_pair()
+        pod = client.create_pod(tpu_pod("dup"))
+        ok, _ = active.assume(active.node_names(), pod)
+        active.bind(ok[0], pod)
+        co.tail_once()
+        occ = standby.occupancy()
+        for rec in log_.since(0):
+            assert standby.apply_delta(rec) is True
+        assert standby.occupancy() == occ
+        active.close()
+        standby.close()
+
+    def test_view_hint_prewarms_standby_views_and_renderers(self):
+        client, active, log_, standby, sc, co = make_pair(n_hosts=8)
+        nodes = active.node_names()
+        pod = tpu_pod("warm")
+        active.assume(nodes, pod)
+        active.score(nodes, pod)
+        assert any(r["kind"] == "view" for r in log_.since(0))
+        co.tail_once()
+        pre = standby.perf_totals()
+        assert pre["view_builds"] >= 1  # the warm built it
+        ok, _ = standby.assume(nodes, tpu_pod("probe"))
+        post = standby.perf_totals()
+        assert ok
+        assert post["view_builds"] == pre["view_builds"]
+        assert post["renderer_builds"] == pre["renderer_builds"]
+        active.close()
+        standby.close()
+
+
+class TestPromotion:
+    def _feed_standby_watch(self, client, sc):
+        pod_watch = client.watch_pods()
+        node_watch = client.watch_nodes()
+
+        def pump():
+            for watch, handler in (
+                (node_watch, sc.handle_node_event),
+                (pod_watch, sc.handle_pod_event),
+            ):
+                while True:
+                    ev = watch.poll(timeout=0.0)
+                    if ev is None:
+                        break
+                    handler(ev)
+        return pump
+
+    def test_promote_reconciles_only_the_lag_window(self):
+        client, active, log_, standby, sc, co = make_pair(
+            n_hosts=4, lag_events=100,
+        )
+        pump = self._feed_standby_watch(client, sc)
+        pods = [client.create_pod(tpu_pod(f"p{i}")) for i in range(4)]
+        for pod in pods:
+            ok, _ = active.assume(active.node_names(), pod)
+            active.bind(ok[0], pod)
+        pump()
+        co.tail_once()  # lag 100: nothing applies — the crash window
+        assert standby.occupancy() == 0.0
+        assert len(sc._dirty) == 4  # the crash window, informer-tracked
+        result = co.promote()
+        assert result["promoted"] and result["reconciled"] == 4
+        assert co.is_leader()
+        equal_state(active, standby)
+        # the promoted dealer emits its own stream for the NEXT standby
+        assert standby.ha is not None and standby.ha is not log_
+        pod = client.create_pod(tpu_pod("post"))
+        ok, _ = standby.assume(standby.node_names(), pod)
+        standby.bind(ok[0], pod)
+        assert any(
+            r["kind"] == "bound" for r in standby.ha.since(0)
+        )
+        active.close()
+        standby.close()
+
+    def test_promote_forgets_deleted_pods_before_allocating(self):
+        """The reconcile-order pin: a departed pod's chips must free
+        BEFORE a streamed-but-lost bind re-allocates them (name order
+        alone once collided — caught by the crash soak)."""
+        client, active, log_, standby, sc, co = make_pair(
+            n_hosts=1, lag_events=100,
+        )
+        pump = self._feed_standby_watch(client, sc)
+        node = active.node_names()[0]
+        # fill the single host entirely
+        a = client.create_pod(tpu_pod("a-first", percent=400))
+        ok, _ = active.assume([node], a)
+        bound_a = active.bind(node, a)
+        pump()
+        co.tail_once()  # lag: nothing applied; dirty has a-first
+        # departure + a new pod onto the freed chips, all in the window
+        client.delete_pod(bound_a.namespace, bound_a.name)
+        active.forget(bound_a)
+        z = client.create_pod(tpu_pod("z-second", percent=400))
+        active.bind(node, z)
+        pump()
+        result = co.promote()
+        assert result["promoted"]
+        equal_state(active, standby)
+        active.close()
+        standby.close()
+
+    def test_promote_is_idempotent(self):
+        client, active, log_, standby, sc, co = make_pair()
+        assert co.promote()["promoted"] is True
+        assert co.promote()["promoted"] is False
+        assert co.promotions == 1
+        active.close()
+        standby.close()
+
+    def test_stale_tail_promotion_full_resyncs(self):
+        client, active, log_, standby, sc, co = make_pair()
+        co.source = DeltaLog(capacity=4)
+        for i in range(32):
+            co.source.emit("gang_park", {"uid": f"u{i}"})
+        pod = client.create_pod(tpu_pod("p1"))
+        ok, _ = active.assume(active.node_names(), pod)
+        active.bind(ok[0], pod)
+        co.tail_once()  # fell off the ring -> stale
+        assert co.stale
+        result = co.promote()
+        assert result["promoted"] and result["reconciled"] == -1
+        equal_state(active, standby)
+        active.close()
+        standby.close()
+
+
+class TestTailResilience:
+    """The review-hardening pins: seq-regression auto-rebase, first-poll
+    anchoring, demotion callback, promotion checkpoint retention,
+    exit_standby draining (not discarding) the race window, and the
+    bounded dirty window."""
+
+    def test_stream_reset_auto_rebases(self):
+        """A production standby polls a fresh log after the active
+        restarted: source.seq < applied_seq must trigger a rebase (the
+        old guard just returned 0 forever — silent permanent drift)."""
+        client, active, log_, standby, sc, co = make_pair()
+        pod = client.create_pod(tpu_pod("p1"))
+        ok, _ = active.assume(active.node_names(), pod)
+        active.bind(ok[0], pod)
+        co.tail_once()
+        assert co.applied_seq >= 1
+        fresh = DeltaLog()  # the restarted active's new stream
+        co.source = fresh
+        pod2 = client.create_pod(tpu_pod("p2"))
+        # a fresh emitter: seq restarts at 1, below co.applied_seq
+        fresh.emit("bound", {"pod": pod2.raw})
+        assert fresh.seq < co.applied_seq
+        co.tail_once()  # detects the reset, rebases
+        assert co.applied_seq <= fresh.seq
+        co.tail_once()
+        assert co.applied_seq == fresh.seq  # tailing the new stream
+        active.close()
+        standby.close()
+
+    def test_http_source_anchors_at_current_seq_not_zero(self):
+        """First contact with a long-lived active whose early records
+        fell off the ring must ANCHOR at its current seq — not latch
+        stale and doom every promotion to the O(fleet) resync."""
+        client, active, log_, standby, sc, co = make_pair()
+
+        class FakePollSource:
+            def __init__(self, inner):
+                self.inner = inner
+                self.seq = 0
+
+            def poll(self, since):
+                self.seq = self.inner.seq
+
+            def since(self, seq, limit=None):
+                return self.inner.since(seq, limit=limit)
+
+        ring = DeltaLog(capacity=4)
+        for i in range(64):  # far past the ring: seq 1 is long gone
+            ring.emit("gang_park", {"uid": f"u{i}"})
+        co.source = FakePollSource(ring)
+        co.applied_seq = 0
+        assert co.tail_once() == 0
+        assert co._anchored and co.applied_seq == ring.seq
+        assert not co.stale
+        active.close()
+        standby.close()
+
+    def test_haloop_demotion_fires_on_demote(self):
+        client = FakeClientset()
+        lease = LeaderLease(client, "a", ttl_s=30.0)
+        assert lease.try_acquire()  # wall clock: the loop's own domain
+        co = HACoordinator(None, role="active", lease=lease)
+        demoted = threading.Event()
+        loop = HALoop(co, period_s=0.01, on_demote=demoted.set)
+        # steal the lease out from under the active with a FRESH
+        # renewTime: its next renew fails, the re-acquire sees an
+        # unexpired foreign holder, and the loop must demote AND fire
+        # the callback (the in-process write loops never cross the
+        # HTTP gate)
+        other = LeaderLease(client, "b", ttl_s=30.0)
+        raw = client.get_lease(other.namespace, other.name)
+        raw["spec"]["holderIdentity"] = "b"
+        raw["spec"]["renewTime"] = time.time()
+        client.update_lease(other.namespace, other.name, raw)
+        loop.start()
+        assert demoted.wait(timeout=5.0)
+        assert co.role == "standby"
+        loop.stop()
+
+    def test_promotion_keeps_the_checkpoint_path(self, tmp_path):
+        client, active, log_, standby, sc, co = make_pair()
+        pod = client.create_pod(tpu_pod("p1"))
+        ok, _ = active.assume(active.node_names(), pod)
+        active.bind(ok[0], pod)
+        co.tail_once()
+        path = str(tmp_path / "ckpt")
+        co.checkpoint_path = path
+        co.promote()
+        # the fresh log persists to the configured path, and the
+        # promotion snapshotted the promoted state
+        assert standby.ha.path == path
+        state, _ = load_checkpoint(path)
+        assert state is not None and len(state["pods"]) == 1
+        # a post-promotion commit appends to the same file on flush
+        pod2 = client.create_pod(tpu_pod("p2"))
+        ok2, _ = standby.assume(standby.node_names(), pod2)
+        standby.bind(ok2[0], pod2)
+        standby.ha.flush()
+        _, records = load_checkpoint(path)
+        assert any(r["kind"] == "bound" for r in records)
+        active.close()
+        standby.close()
+
+    def test_exit_standby_drains_race_window_instead_of_discarding(self):
+        client = make_mock_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        sc = Controller(client, dealer, resync_period_s=0,
+                        assume_ttl_s=0)
+        sc.enter_standby()
+        # a pod completes in the promotion race window (after
+        # ha_take_dirty, before exit_standby)
+        pod = client.create_pod(tpu_pod("race"))
+        ok, _ = dealer.assume(dealer.node_names(), pod)
+        bound = dealer.bind(ok[0], pod)
+        assert sc.ha_take_dirty() == {}  # window already drained
+        fresh = client.get_pod("default", "race")
+        fresh.raw.setdefault("status", {})["phase"] = "Succeeded"
+        done = client.update_pod(fresh)
+        sc.handle_pod_event(WatchEvent("MODIFIED", done))
+        assert "default/race" in sc._dirty
+        sc.exit_standby()
+        # the leftover became a QUEUED sync, not a discard
+        assert sc._queue.unfinished_tasks == 1
+        sc.drain_sync()
+        assert not dealer.tracks(bound.uid)  # the release ran
+        dealer.close()
+
+    def test_dirty_overflow_bounds_the_window_and_forces_resync(self):
+        client = make_mock_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        sc = Controller(client, dealer, resync_period_s=0,
+                        assume_ttl_s=0)
+        sc.enter_standby()
+        sc.HA_DIRTY_MAX = 4
+        for i in range(8):
+            pod = tpu_pod(f"ov{i}", uid=f"ov-{i}")
+            pod.ensure_annotations()[types.ANNOTATION_ASSUME] = "true"
+            pod.ensure_labels()[types.ANNOTATION_ASSUME] = "true"
+            sc.handle_pod_event(WatchEvent("MODIFIED", pod))
+        assert sc._dirty_overflow
+        assert len(sc._dirty) == 0  # freed, not grown
+        co = HACoordinator(dealer, role="standby", controller=sc)
+        co.promote()
+        assert co.stale  # promotion took the full-resync path
+        dealer.close()
+
+
+class TestLeaderLease:
+    def test_acquire_renew_steal(self):
+        client = FakeClientset()
+        a = LeaderLease(client, "a", ttl_s=2.0)
+        b = LeaderLease(client, "b", ttl_s=2.0)
+        assert a.try_acquire(now=0.0)
+        assert not b.try_acquire(now=1.0)  # unexpired: no steal
+        assert a.renew(now=1.5)
+        assert b.holder_now(now=1.6) == "a"
+        assert b.try_acquire(now=4.0)  # a's renew is 2.5s stale: steal
+        assert b.steals == 1
+        assert not a.renew(now=4.1)  # a must notice it lost
+        assert b.holder_now(now=4.2) == "b"
+
+    def test_release_is_the_instant_handoff(self):
+        client = FakeClientset()
+        a = LeaderLease(client, "a", ttl_s=30.0)
+        b = LeaderLease(client, "b", ttl_s=30.0)
+        assert a.try_acquire(now=0.0)
+        assert not b.try_acquire(now=0.1)
+        assert a.release(now=0.2)
+        # no TTL wait: the zero-downtime upgrade path
+        assert b.try_acquire(now=0.3)
+
+
+class TestLeaderGate:
+    def _api_pair(self):
+        client = make_mock_cluster(2)
+        log_ = DeltaLog()
+        active = Dealer(client, make_rater("binpack"), ha_log=log_)
+        standby = Dealer(client, make_rater("binpack"))
+        co = HACoordinator(standby, role="standby", source=log_)
+        api = SchedulerAPI(standby, Registry())
+        api.attach_ha(co)
+        return client, active, standby, co, api
+
+    def test_standby_binds_answer_503_notleader(self):
+        client, active, standby, co, api = self._api_pair()
+        code, _, payload = api.dispatch(
+            "POST", "/scheduler/bind",
+            json.dumps({
+                "PodName": "x", "PodNamespace": "default",
+                "PodUID": "u1", "Node": "v5p-host-0",
+            }).encode(),
+        )
+        assert code == 503
+        body = json.loads(payload)
+        assert body["Reason"] == "NotLeader"
+        assert body["Role"] == "standby"
+        # reads stay answerable: the warm standby's caches serve them
+        pod = tpu_pod("r")
+        code, _, payload = api.dispatch(
+            "POST", "/scheduler/filter",
+            json.dumps({
+                "Pod": pod.raw, "NodeNames": standby.node_names(),
+            }).encode(),
+        )
+        assert code == 200
+        active.close()
+        standby.close()
+
+    def test_readyz_gates_on_leadership_and_carries_role(self):
+        client, active, standby, co, api = self._api_pair()
+        api.add_ready_check("dealer-warm", lambda: True)
+        code, _, payload = api.dispatch("GET", "/readyz", b"")
+        assert code == 503
+        body = json.loads(payload)
+        assert body["Role"] == "standby"
+        assert "ha-leader" in body["Waiting"]
+        co.promote()
+        code, _, payload = api.dispatch("GET", "/readyz", b"")
+        assert code == 200
+        assert json.loads(payload)["role"] == "active"
+        # promoted: binds flow
+        code, _, payload = api.dispatch(
+            "POST", "/scheduler/bind",
+            json.dumps({
+                "PodName": "x", "PodNamespace": "default",
+                "PodUID": "u1", "Node": "v5p-host-0",
+            }).encode(),
+        )
+        assert code == 200  # (bind fails pod-not-found, but not gated)
+        active.close()
+        standby.close()
+
+    def test_debug_ha_serves_status_and_records(self):
+        client, active, standby, co, api = self._api_pair()
+        # standby role first: status but no log
+        code, _, payload = api.dispatch("GET", "/debug/ha?since=0", b"")
+        assert code == 200
+        assert json.loads(payload)["role"] == "standby"
+        # active role serves the record window
+        log_ = active.ha
+        api2 = SchedulerAPI(active, Registry())
+        co_a = HACoordinator(active, role="active", log_=log_)
+        api2.attach_ha(co_a)
+        pod = client.create_pod(tpu_pod("p1"))
+        ok, _ = active.assume(active.node_names(), pod)
+        active.bind(ok[0], pod)
+        code, _, payload = api2.dispatch("GET", "/debug/ha?since=0", b"")
+        body = json.loads(payload)
+        assert body["role"] == "active"
+        assert body["log"]["seq"] >= 1
+        assert [r["seq"] for r in body["records"]] == list(
+            range(1, body["log"]["seq"] + 1)
+        )
+        # 404 with no coordinator attached
+        api3 = SchedulerAPI(standby, Registry())
+        code, _, _ = api3.dispatch("GET", "/debug/ha", b"")
+        assert code == 404
+        active.close()
+        standby.close()
+
+    def test_ha_metrics_render_from_the_one_producer(self):
+        client, active, standby, co, api = self._api_pair()
+        text = api.registry.render()
+        assert "nanotpu_ha_role 0.0" in text
+        assert "nanotpu_ha_promotions 0.0" in text
+        co.promote()
+        text = api.registry.render()
+        assert "nanotpu_ha_role 1.0" in text
+        assert "nanotpu_ha_promotions 1.0" in text
+        active.close()
+        standby.close()
+
+    def test_gauge_table_matches_producer_keys(self):
+        from nanotpu.metrics.ha import _HA_GAUGES
+
+        co = HACoordinator(None, role="active")
+        assert set(co.ha_gauge_values()) == set(_HA_GAUGES)
+
+
+class TestCheckpoint:
+    def _bound_cluster(self, n_hosts=4, n_pods=6):
+        client = make_mock_cluster(n_hosts)
+        dealer = Dealer(client, make_rater("binpack"))
+        nodes = dealer.node_names()
+        for i in range(n_pods):
+            pod = client.create_pod(tpu_pod(
+                f"p{i}", gang="g0" if i < 2 else None, gang_size=2,
+            ))
+            dealer.bind(nodes[i % n_hosts], pod)
+        return client, dealer
+
+    def test_snapshot_roundtrip_restores_equal_state(self, tmp_path):
+        client, dealer = self._bound_cluster()
+        path = str(tmp_path / "ckpt")
+        dealer.write_checkpoint(path)
+        restored = Dealer(
+            client, make_rater("binpack"), restore_from=path
+        )
+        equal_state(dealer, restored)
+        # gang membership survives (the barrier bookkeeping reads it)
+        assert restored.gangs.bound_count("default/g0") == 2
+        # chip-level state matches exactly, node by node
+        a = dealer.debug_snapshot()["node_infos"]
+        b = restored.debug_snapshot()["node_infos"]
+        for name in a:
+            assert a[name].chips.chip_rows() == b[name].chips.chip_rows()
+        dealer.close()
+        restored.close()
+
+    def test_restored_dealer_still_binds_and_releases(self, tmp_path):
+        client, dealer = self._bound_cluster()
+        path = str(tmp_path / "ckpt")
+        dealer.write_checkpoint(path)
+        dealer.close()
+        restored = Dealer(
+            client, make_rater("binpack"), restore_from=path
+        )
+        pod = client.create_pod(tpu_pod("fresh"))
+        ok, _ = restored.assume(restored.node_names(), pod)
+        assert ok
+        bound = restored.bind(ok[0], pod)
+        assert restored.release(bound)
+        restored.close()
+
+    def test_delta_tail_replays_after_the_snapshot(self, tmp_path):
+        client, dealer = self._bound_cluster(n_pods=2)
+        path = str(tmp_path / "ckpt")
+        dealer.write_checkpoint(path)
+        # attach a checkpointing log AFTER the snapshot: new commits
+        # append to the same file as the tail
+        dealer.ha = DeltaLog(path=path)
+        pod = client.create_pod(tpu_pod("tail"))
+        ok, _ = dealer.assume(dealer.node_names(), pod)
+        dealer.bind(ok[0], pod)
+        dealer.ha.flush()
+        state, records = load_checkpoint(path)
+        assert state is not None
+        assert any(r["kind"] == "bound" for r in records)
+        restored = Dealer(
+            client, make_rater("binpack"), restore_from=path
+        )
+        equal_state(dealer, restored)
+        dealer.close()
+        restored.close()
+
+    def test_corrupt_checkpoint_falls_back_to_full_replay(self, tmp_path):
+        client, dealer = self._bound_cluster()
+        path = tmp_path / "ckpt"
+        path.write_text("not json at all\n")
+        restored = Dealer(
+            client, make_rater("binpack"), restore_from=str(path)
+        )
+        equal_state(dealer, restored)  # annotation replay covered it
+        dealer.close()
+        restored.close()
+
+    def test_corrupt_tail_line_keeps_the_prefix(self, tmp_path):
+        client, dealer = self._bound_cluster(n_pods=2)
+        path = str(tmp_path / "ckpt")
+        dealer.write_checkpoint(path)
+        with open(path, "a") as fh:
+            fh.write('{"seq": 99, "kind": "bound", "data"')  # truncated
+        state, records = load_checkpoint(path)
+        assert state is not None and records == []
+        dealer.close()
+
+
+class TestPromoteUnderLoad:
+    """The shutdown-idempotency satellite: Dealer.close and the three
+    production loops must be safe to stop/re-start in any order while a
+    promotion rewires them mid-cycle."""
+
+    def test_dealer_close_is_idempotent_and_flushes_once(self, tmp_path):
+        client = make_mock_cluster(2)
+        path = str(tmp_path / "ckpt")
+        dealer = Dealer(
+            client, make_rater("binpack"),
+            ha_log=DeltaLog(path=path),
+        )
+        pod = client.create_pod(tpu_pod("p"))
+        ok, _ = dealer.assume(dealer.node_names(), pod)
+        dealer.bind(ok[0], pod)
+        dealer.close()
+        size = len(open(path).read().splitlines())
+        dealer.close()  # second close: no-op, no double flush
+        dealer.close()
+        assert len(open(path).read().splitlines()) == size
+
+    def test_loops_stop_start_stop_safely(self):
+        from nanotpu.dealer.admit import BatchAdmitter, BatchLoop
+        from nanotpu.obs.timeline import TelemetryLoop, Timeline
+        from nanotpu.recovery import (
+            RecoveryConfig,
+            RecoveryLoop,
+            RecoveryPlane,
+        )
+
+        client = make_mock_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        plane = RecoveryPlane(dealer, config=RecoveryConfig())
+        admitter = BatchAdmitter(dealer)
+        timeline = Timeline(dealer=dealer)
+        loops = [
+            RecoveryLoop(plane, period_s=0.01),
+            BatchLoop(admitter, period_s=0.01),
+            TelemetryLoop(timeline, period_s=0.01),
+        ]
+        for loop in loops:
+            loop.start()
+            loop.start()  # double start: one thread, not two
+            first = loop._thread
+            assert first is not None
+            loop.start()
+            assert loop._thread is first
+        time.sleep(0.05)
+        for loop in loops:
+            loop.stop()
+            loop.stop()  # idempotent
+            assert not loop._thread.is_alive()
+        # restart-safe: a promotion restarts the loops against the
+        # promoted dealer (the old start() guard latched forever)
+        for loop in loops:
+            loop.start()
+            assert loop._thread.is_alive()
+            loop.stop()
+        dealer.close()
+
+    def test_promote_under_live_loops(self):
+        """A promotion while the HA loop + telemetry tick concurrently:
+        no deadlock, no double promotion, the gate flips exactly once."""
+        from nanotpu.obs.timeline import TelemetryLoop, Timeline
+
+        client = make_mock_cluster(4)
+        log_ = DeltaLog()
+        active = Dealer(client, make_rater("binpack"), ha_log=log_)
+        lease_a = LeaderLease(client, "a", ttl_s=0.2)
+        assert lease_a.try_acquire()
+        standby = Dealer(client, make_rater("binpack"))
+        sc = Controller(
+            client, standby, resync_period_s=0, assume_ttl_s=0
+        )
+        sc.enter_standby()
+        sc.resync_once()
+        co = HACoordinator(
+            standby, role="standby", source=log_, controller=sc,
+            lease=LeaderLease(client, "b", ttl_s=0.2),
+        )
+        timeline = Timeline(dealer=standby)
+        timeline.ha = co
+        tloop = TelemetryLoop(timeline, period_s=0.005)
+        tloop.start()
+        promoted = threading.Event()
+        hloop = HALoop(co, period_s=0.01, on_promote=promoted.set)
+        hloop.start()
+        # drive some binds, then let the lease expire (active stops
+        # renewing) while everything is live
+        for i in range(4):
+            pod = client.create_pod(tpu_pod(f"p{i}"))
+            ok, _ = active.assume(active.node_names(), pod)
+            active.bind(ok[0], pod)
+        active.close()
+        active.close()  # the dying active double-closes; must be safe
+        assert promoted.wait(timeout=5.0)
+        assert co.is_leader()
+        assert co.promotions == 1
+        hloop.stop()
+        tloop.stop()
+        sc.stop()
+        equal_state(active, standby)
+        standby.close()
+
+
+class TestStandbyController:
+    def test_dirty_window_tracks_and_clears_by_kind(self):
+        client = make_mock_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        sc = Controller(client, dealer, resync_period_s=0,
+                        assume_ttl_s=0)
+        sc.enter_standby()
+        pod = tpu_pod("d1", uid="u1")
+        annotated = tpu_pod("d1", uid="u1")
+        annotated.ensure_labels()[types.ANNOTATION_ASSUME] = "true"
+        annotated.ensure_annotations()[types.ANNOTATION_ASSUME] = "true"
+        sc.handle_pod_event(WatchEvent("ADDED", pod))
+        assert sc.ha_take_dirty() == {}  # unplaced ADDED: nothing to do
+        sc.handle_pod_event(WatchEvent("MODIFIED", annotated))
+        assert "default/d1" in sc._dirty  # assume transition
+        # a bound delta clears assume dirt...
+        sc.ha_clear_dirty("default/d1", kind="bound")
+        assert "default/d1" not in sc._dirty
+        # ...but NOT terminal dirt (the stream trails the informer)
+        sc.handle_pod_event(WatchEvent("DELETED", annotated))
+        sc.ha_clear_dirty("default/d1", kind="bound")
+        assert "default/d1" in sc._dirty
+        sc.ha_clear_dirty("default/d1", kind="released")
+        assert "default/d1" not in sc._dirty
+        dealer.close()
+
+    def test_standby_queue_stays_inert_and_resync_primes_cache(self):
+        client = make_mock_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        sc = Controller(client, dealer, resync_period_s=0,
+                        assume_ttl_s=0)
+        sc.enter_standby()
+        client.create_pod(tpu_pod("q1"))
+        sc.resync_once()
+        assert sc.synced()
+        assert sc._queue.unfinished_tasks == 0
+        assert sc._known("default/q1") is not None
+        dealer.close()

@@ -662,3 +662,94 @@ class TestChurnSweep:
         report = run_scenario(scenario, seed=0)
         assert report["invariants"]["violations"] == 0
         assert report["pods"]["bound"] > 0
+
+
+class TestSchedulerCrash:
+    """The HA failover fault (docs/ha.md): killing the active at every
+    phase of the chaos soak must converge with zero invariant
+    violations (no double-binds, no promoted-vs-truth drift), settle
+    the standby to ground-truth equality, and stay byte-deterministic —
+    with the dedicated ``rng_crash`` stream holding the standard
+    toggle-isolation pin (HA on/off cannot reshape the base jobs)."""
+
+    def _scenario(self, ha: bool = True, crashes=None) -> dict:
+        scenario = load_scenario(EXAMPLES / "ha-crash.json")
+        scenario["horizon_s"] = 25.0
+        scenario["lock_witness"] = False
+        scenario["ha"]["enabled"] = ha
+        scenario["faults"]["scheduler_crash"]["at_s"] = (
+            [5.0, 10.0, 14.0, 21.0] if crashes is None else crashes
+        ) if ha else []
+        return scenario
+
+    def test_crash_at_every_phase_converges_deterministically(self):
+        r1 = run_scenario(self._scenario(), seed=0)
+        r2 = run_scenario(self._scenario(), seed=0)
+        assert r1["invariants"]["violations"] == 0, (
+            r1["invariants"]["first"]
+        )
+        assert r1["faults"]["scheduler_crashes"] == 4
+        assert r1["ha"]["promotions"] == 4
+        assert r1["ha"]["standby_drift_pct"] == 0.0
+        assert r1["restart_occupancy_drift_pct"] == 0.0
+        assert r1["digest"] == r2["digest"]
+        assert r1["pods"]["bound"] > 0
+
+    def test_ha_off_keeps_the_report_shape_and_digest_rules(self):
+        report = run_scenario(self._scenario(ha=False), seed=0)
+        assert "ha" not in report  # opt-in section, like recovery/serving
+        assert report["invariants"]["violations"] == 0
+
+    def test_crash_toggle_does_not_reshape_base_jobs(self):
+        def job_shapes(ha):
+            sim = Simulator(self._scenario(ha=ha), seed=3)
+            sim.run()
+            shapes = [
+                (j.config, round(j.lifetime_s, 9), j.size)
+                for j in sim.jobs if j.incarnation == 0 and not j.burst
+            ]
+            sim.dealer.close()
+            return shapes
+
+        on = job_shapes(True)
+        off = job_shapes(False)
+        assert on and on == off
+
+    def test_crash_toggle_does_not_shift_arrival_schedule(self):
+        def scheduled(ha):
+            sim = Simulator(self._scenario(ha=ha), seed=3)
+            sim._schedule_static_events(25.0)
+            out = sorted(
+                (round(t, 9), payload["config"])
+                for t, _, kind, payload in sim._heap
+                if kind == "arrival"
+            )
+            sim.dealer.close()
+            return out
+
+        assert scheduled(True) == scheduled(False)
+
+    def test_crash_stream_is_reserved(self):
+        """Future HA draws live on rng_crash: the stream exists, is
+        seeded per (seed), and is distinct from every sibling stream
+        (same isolation rule as rng_defrag)."""
+        sim = Simulator(self._scenario(), seed=3)
+        others = {
+            id(sim.rng_workload), id(sim.rng_fault), id(sim.rng_metric),
+            id(sim.rng_lifecycle), id(sim.rng_overload),
+            id(sim.rng_retry), id(sim.rng_defrag), id(sim.rng_serve),
+        }
+        assert id(sim.rng_crash) not in others
+        twin = Simulator(self._scenario(), seed=3)
+        assert sim.rng_crash.random() == twin.rng_crash.random()
+        sim.dealer.close()
+        twin.dealer.close()
+
+    def test_crash_without_ha_is_rejected(self):
+        from nanotpu.sim.scenario import normalize_scenario
+
+        with pytest.raises(ValueError, match="scheduler_crash"):
+            normalize_scenario({
+                "fleet": {"pools": [{"generation": "v5p", "hosts": 2}]},
+                "faults": {"scheduler_crash": {"at_s": [5.0]}},
+            })

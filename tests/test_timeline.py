@@ -626,6 +626,7 @@ _DEBUG_PATHS = {
     "/debug/traces/": "/debug/traces/some-uid",
     "/debug/decisions": "/debug/decisions?limit=5",
     "/debug/timeline": "/debug/timeline",
+    "/debug/ha": "/debug/ha?since=0",
 }
 
 
