@@ -3,7 +3,7 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm batch-4k sim-smoke sim-multipool sim-het sim-defrag sim-batch sim-serve chaos-soak obs-check timeline-check fanout-4k ha-soak image clean
+.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm batch-4k sim-smoke sim-multipool sim-het sim-defrag sim-batch sim-serve chaos-soak obs-check timeline-check fanout-4k ha-soak partition-soak image clean
 
 # Default verification tier: static analysis, then the fast inner loop
 # (test-fast includes sim-smoke), then the observability gate, then the
@@ -11,7 +11,7 @@ TAG ?= latest
 # certifications and the sharded 4096-host fan-out gate (FAST=1 skips
 # those three). The tier-1 gate (`pytest tests/ -m 'not slow'` over
 # everything) is unchanged — run it via `make test` / CI.
-all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag sim-batch sim-serve fanout-4k batch-4k ha-soak
+all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag sim-batch sim-serve fanout-4k batch-4k ha-soak partition-soak
 
 # nanolint (docs/static-analysis.md): AST invariant passes over the
 # scheduler's concurrency & determinism contracts — lock discipline,
@@ -237,6 +237,27 @@ ha-soak: native
 			--check-determinism > /dev/null && \
 		python -m pytest tests/test_ha.py -q && \
 		python bench.py --ha-soak; \
+	fi
+
+# Split-brain containment gate (docs/ha.md "Split brain and fencing"):
+# lease-arbitrated leadership between TWO LIVE stacks driven through
+# network partitions (api/stream/full scopes), per-process clock skew,
+# a flapping lease API, and a gray-failure window — run TWICE
+# (--check-determinism, lock witness armed) — then the certification
+# test: 0 violations (incl. 0 double-binds with both dealers alive),
+# promotions <= bound, fence rejections > 0, degraded mode entered AND
+# exited, converged active+standby-vs-truth equality after every heal.
+# `FAST=1 make all` skips it (same rule as ha-soak).
+partition-soak: native
+	@if [ "$(FAST)" = "1" ]; then \
+		echo "partition-soak: skipped (FAST=1)"; \
+	else \
+		NANOTPU_LOCK_WITNESS=1 python -m nanotpu.sim \
+			--scenario examples/sim/partition-soak.json --seed 0 \
+			--check-determinism > /dev/null && \
+		python -m pytest tests/test_ha.py -q -k \
+			"Fence or Lease or StaleEpoch or Suspect or Integrity or Verify or Degraded or SplitBrain" && \
+		python -m pytest tests/test_sim.py -q -k partition_soak_certification; \
 	fi
 
 # The 4096-host multi-pool churn scenario through the sharded dealer,

@@ -2047,7 +2047,7 @@ class _MiniApiServer:
 
 def run_warm_restart(n_hosts: int = 4096, n_pods: int = 2048,
                      reps: int = 5,
-                     require_ratio: float | None = 5.0) -> dict:
+                     require_ratio: float | None = 4.0) -> dict:
     """The warm-restart row (docs/ha.md): a 4096-host dealer rebuilt
     from its local checkpoint (snapshot + delta tail) vs the full
     annotation replay over the apiserver, interleaved A/B in one
@@ -2057,7 +2057,14 @@ def run_warm_restart(n_hosts: int = 4096, n_pods: int = 2048,
     side boots through the SAME client but never calls it — the local
     checkpoint is the whole point. Both paths must reconstruct the
     exact same occupancy; the ratio is the acceptance number
-    (checkpoint >= ``require_ratio`` x faster)."""
+    (checkpoint >= ``require_ratio`` x faster).
+
+    The gate moved 5.0 -> 4.0 when restore gained integrity
+    verification (docs/ha.md "State integrity"): the line-CRC check
+    adds a few ms of REAL work at this scale (measured ~45 -> ~48 ms
+    same-day), and the pre-integrity 5x sat one box-noise swing above
+    the verified path's typical 4.5-5.3x — the gate prices the
+    verified restore, which is the only restore that ships."""
     import gc
     import tempfile
 
@@ -2142,6 +2149,217 @@ def run_ha_soak() -> dict:
     gc.collect()
     out.update(run_warm_restart())
     return out
+
+
+def _fencing_available() -> bool:
+    """Feature detection for the split-brain containment layer (the
+    same bench file runs on pre-fencing base refs under bench_ab): the
+    partition row still measures availability + heal there, minus the
+    degraded-mode attribution."""
+    try:
+        import nanotpu.ha.degraded  # noqa: F401
+        import nanotpu.ha.fence  # noqa: F401
+    except ImportError:  # pragma: no cover - base-ref worktrees only
+        return False
+    return True
+
+
+class _CuttablePodWrites:
+    """Clientset proxy failing scheduler-side pod writes while ``cut``
+    — the bench's apiserver partition (the sim's BrownoutClient shape,
+    local so the row runs on any base ref)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.cut = False
+
+    def _check(self, what: str) -> None:
+        if self.cut:
+            from nanotpu.k8s.client import ApiError
+
+            raise ApiError(f"bench partition ({what})", code=503)
+
+    def update_pod(self, pod):
+        self._check("update_pod")
+        return self._inner.update_pod(pod)
+
+    def bind_pod(self, namespace, name, node_name):
+        self._check("bind_pod")
+        return self._inner.bind_pod(namespace, name, node_name)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def run_partition(n_hosts: int = 64, n_pods: int = 256, workers: int = 4,
+                  partition_s: float = 0.5,
+                  degraded_budget_s: float = 0.1) -> dict:
+    """The split-brain containment row (docs/ha.md "Degraded mode"):
+    bind availability and shed attribution through a mid-storm
+    apiserver partition, plus heal-to-converged latency.
+
+    One replica (HTTP server, resilient client, degraded monitor) takes
+    a continuous bind storm; mid-storm the apiserver link is CUT for
+    ``partition_s``. During the window every bind answer must be a
+    TYPED shed (503 Degraded with Retry-After once the monitor latches,
+    attributable breaker/API errors before it) — never a success, never
+    an unexplained hang. At heal the row measures the time to the first
+    committed bind and to dealer-vs-truth convergence, and asserts the
+    storm finishes with every pod bound exactly once.
+
+    On pre-fencing base refs the same row runs without the monitor
+    (feature-detected) so ``bench_ab`` still pairs on
+    ``partition_pods_per_s``."""
+    from nanotpu.k8s.resilience import ResilientClientset
+
+    fenced = _fencing_available()
+    client = make_mock_cluster(n_hosts, CHIPS_PER_HOST)
+    tap = _CuttablePodWrites(client)
+    # tight breaker cooldown: heal latency is breaker-probe-bound (the
+    # degraded probe can only observe the heal when the breaker lets a
+    # real request through), and this row measures the containment
+    # machinery, not the default 5s production cooldown
+    resilient = ResilientClientset(tap, max_attempts=2, cooldown_s=0.2)
+    monitor = None
+    if fenced:
+        from nanotpu.ha.degraded import DegradedMonitor
+
+        monitor = DegradedMonitor(budget_s=degraded_budget_s)
+        resilient.degraded = monitor
+    dealer = Dealer(resilient, make_rater("binpack"))
+    api = SchedulerAPI(dealer, Registry())
+    if monitor is not None:
+        api.attach_degraded(monitor)
+    server = serve(api, 0, host="127.0.0.1")
+    api.stop_idle_gc()
+    port = server.server_address[1]
+    nodes = [f"v5p-host-{i}" for i in range(n_hosts)]
+
+    prepared: "queue.Queue[bytes]" = queue.Queue()
+    for i in range(n_pods):
+        name = f"pt-{i}"
+        pod = client.create_pod(make_pod(name, containers=[
+            make_container("t", {types.RESOURCE_TPU_PERCENT: 100})
+        ]))
+        prepared.put(json.dumps({
+            "PodName": name, "PodNamespace": "default",
+            "PodUID": pod.uid, "Node": nodes[i % n_hosts],
+        }).encode())
+
+    window = {"open": False}
+    counts = {"ok_in_window": 0, "degraded_503": 0, "typed_errors": 0,
+              "bound_total": 0}
+    count_lock = threading.Lock()
+    heal_first_bind = [0.0]
+    t_heal = [0.0]
+
+    def binder():
+        conn = HttpClient("127.0.0.1", port)
+        while True:
+            try:
+                body = prepared.get_nowait()
+            except queue.Empty:
+                return
+            deadline = time.monotonic() + 30.0
+            while True:
+                assert time.monotonic() < deadline, "bind retry timeout"
+                try:
+                    r = conn.post_raw("/scheduler/bind", body)
+                except (ConnectionError, OSError):
+                    conn = HttpClient("127.0.0.1", port)
+                    continue
+                ok = b'"Error":""' in r
+                if window["open"] or (t_heal[0] and not heal_first_bind[0]):
+                    with count_lock:
+                        if window["open"]:
+                            if ok:
+                                counts["ok_in_window"] += 1
+                            elif b"Degraded" in r:
+                                counts["degraded_503"] += 1
+                            elif b"Error" in r:
+                                counts["typed_errors"] += 1
+                        elif ok and t_heal[0] and not heal_first_bind[0]:
+                            heal_first_bind[0] = time.perf_counter()
+                if ok:
+                    with count_lock:
+                        counts["bound_total"] += 1
+                    break
+                time.sleep(0.002)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=binder) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    # cut the link MID-storm: wait until a third of the workload has
+    # committed, so binds are provably in flight both sides of the cut
+    deadline = time.monotonic() + 30.0
+    while counts["bound_total"] < n_pods // 3:
+        assert time.monotonic() < deadline, "storm never established"
+        time.sleep(0.001)
+    tap.cut = True
+    t_cut = time.perf_counter()
+    # measurement window strictly INSIDE the cut: requests already past
+    # the tap when it closed may legitimately commit, and answers after
+    # the heal legitimately succeed — neither is a containment failure
+    time.sleep(0.03)
+    window["open"] = True
+    time.sleep(partition_s)
+    window["open"] = False
+    time.sleep(0.005)
+    tap.cut = False
+    t_heal[0] = time.perf_counter()
+    for t in threads:
+        t.join(timeout=60.0)
+    total_s = time.perf_counter() - t0
+
+    # heal-to-converged: the dealer's accounting must agree with the
+    # durable annotations once the storm drains
+    if fenced:
+        from nanotpu.ha.verify import verify_state
+
+        converged = verify_state(dealer, client.list_pods())["match"]
+    else:
+        from nanotpu.sim.invariants import ground_truth_occupancy
+
+        converged = abs(
+            dealer.occupancy() - ground_truth_occupancy(dealer, client)
+        ) < 1e-9
+    t_conv = time.perf_counter()
+
+    bound = sum(1 for p in client.list_pods() if p.node_name)
+    shed = dict(counts)
+    if monitor is not None:
+        vals = monitor.degraded_gauge_values()
+        shed["degraded_entries"] = int(vals["entries"])
+        shed["degraded_exits"] = int(vals["exits"])
+        shed["binds_rejected"] = int(vals["binds_rejected"])
+
+    # in-bench asserts: zero successes through the cut link, every pod
+    # bound exactly once after it, typed attribution for the window
+    assert counts["ok_in_window"] == 0, counts
+    assert bound == n_pods, (bound, n_pods)
+    assert converged, "dealer-vs-truth divergence after heal"
+    assert counts["degraded_503"] + counts["typed_errors"] > 0, counts
+    if monitor is not None:
+        assert shed["degraded_entries"] >= 1, shed
+        assert shed["degraded_exits"] >= 1, shed
+        assert shed["binds_rejected"] > 0, shed
+
+    server.shutdown()
+    dealer.close()
+    return {
+        "partition_pods_per_s": round(n_pods / total_s, 1),
+        "partition_window_s": partition_s,
+        "partition_heal_to_first_bind_s": round(
+            max(0.0, heal_first_bind[0] - t_heal[0]), 4
+        ),
+        "partition_heal_to_converged_s": round(t_conv - t_heal[0], 4),
+        "partition_cut_detect_note": (
+            "window opened %.3fs into the storm" % (t_cut - t0)
+        ),
+        "partition_attr": shed,
+        "partition_fenced_build": fenced,
+    }
 
 
 def run_once() -> tuple[list[float], float, int, float]:
@@ -2391,6 +2609,20 @@ if __name__ == "__main__":
             run_failover(n_failovers=1) if _ha_available()
             else {"ha_skipped": "nanotpu.ha unavailable on this ref"}
         ))
+    elif "--partition" in sys.argv:
+        # the split-brain containment row (docs/ha.md): bind
+        # availability + typed shed attribution through a mid-storm
+        # apiserver partition, heal-to-first-bind and heal-to-converged
+        # latency — every assert in-bench (zero successes through the
+        # cut, every pod bound exactly once after it, degraded mode
+        # entered AND exited on the fencing build)
+        print(json.dumps(run_partition()))
+    elif "--partition-rep" in sys.argv:
+        # one rep, for bench_ab.py's interleaved A/B protocol
+        # (AB_KEY=partition_pods_per_s): the degraded-mode attribution
+        # keys are feature-detected away on pre-fencing bases, the
+        # availability/heal keys pair on both sides
+        print(json.dumps(run_partition()))
     elif "--bind-storm" in sys.argv:
         # the full bind-storm row (median of 3 reps, in-bench asserts)
         print(json.dumps(run_bind_storm_reps()))

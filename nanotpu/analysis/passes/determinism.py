@@ -58,7 +58,7 @@ SCOPE = (
     # the HA plane (docs/ha.md): the sim drives the REAL delta log,
     # lease, and coordinator on virtual time, so all three must draw
     # time only from their injectable clocks
-    "nanotpu.ha", "nanotpu.metrics.ha",
+    "nanotpu.ha", "nanotpu.metrics.ha", "nanotpu.metrics.degraded",
     "nanotpu.k8s.objects", "nanotpu.k8s.client", "nanotpu.k8s.resilience",
     "nanotpu.k8s.events",
     "nanotpu.metrics.resilience", "nanotpu.metrics.stats",

@@ -280,6 +280,8 @@ class _MetricsPass:
         srvgauges_mod: Module | None = None
         hagauges: dict[str, int] | None = None
         hagauges_mod: Module | None = None
+        dggauges: dict[str, int] | None = None
+        dggauges_mod: Module | None = None
         for mod in modules:
             d = _declared_resilience(mod)
             if d is not None:
@@ -311,6 +313,9 @@ class _MetricsPass:
             hg = _declared_gauge_table(mod, "_HA_GAUGES")
             if hg is not None:
                 hagauges, hagauges_mod = hg, mod
+            dg = _declared_gauge_table(mod, "_DEGRADED_GAUGES")
+            if dg is not None:
+                dggauges, dggauges_mod = dg, mod
 
         inc_sites: dict[str, tuple[str, int]] = {}
         perf_incs: dict[str, tuple[str, int]] = {}
@@ -434,6 +439,7 @@ class _MetricsPass:
             ("slo", slogauges, slogauges_mod, "slo_gauge_values"),
             ("serving", srvgauges, srvgauges_mod, "serving_gauge_values"),
             ("ha", hagauges, hagauges_mod, "ha_gauge_values"),
+            ("degraded", dggauges, dggauges_mod, "degraded_gauge_values"),
         ):
             if table is not None and table_mod is not None:
                 findings.extend(self._check_gauge_table(

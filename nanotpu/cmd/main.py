@@ -221,6 +221,33 @@ def build_app(argv: list[str] | None = None):
         "standby's delta tail (must be < ha-lease-ttl / 2)",
     )
     parser.add_argument(
+        "--ha-max-clock-skew", type=float, default=0.25, metavar="S",
+        help="operator's bound on inter-replica wall-clock skew (NTP): "
+        "the holder proves its term for ttl MINUS this, a challenger "
+        "steals only after ttl PLUS this — the epoch fence's validity "
+        "margin (docs/ha.md 'Split brain and fencing')",
+    )
+    parser.add_argument(
+        "--ha-steal-hysteresis", type=int, default=2, metavar="N",
+        help="consecutive probes that must observe the holder expired "
+        "before a standby steals the lease: one flapping lease-API "
+        "read cannot trigger a promotion (docs/ha.md)",
+    )
+    parser.add_argument(
+        "--ha-steal-backoff", type=float, default=0.5, metavar="S",
+        help="jittered cooloff after a failed lease acquire/steal: "
+        "bounds promotions-per-window under a thrashing lease API and "
+        "de-synchronizes competing standbys",
+    )
+    parser.add_argument(
+        "--degraded-budget", type=float, default=0.0, metavar="S",
+        help="degraded mode (docs/ha.md): after this many seconds of "
+        "CONTINUOUS apiserver write failure, binds answer 503 Degraded "
+        "+ Retry-After, the recovery/batch write loops pause, and "
+        "Filter/Prioritize keep serving from RCU snapshots; the first "
+        "successful write exits the mode. 0 disables",
+    )
+    parser.add_argument(
         "--serving-stats-url", default="", metavar="URL",
         help="scheduler<->serving feedback (docs/serving-loop.md): poll "
         "a serving replica's /v1/stats at URL, export the fleet's "
@@ -346,12 +373,26 @@ def main(argv: list[str] | None = None) -> int:
             HALoop,
             LeaderLease,
         )
+        from nanotpu.ha.fence import EpochFence
         from nanotpu.ha.standby import HttpDeltaSource
 
         holder = f"{_socket.gethostname()}-{os.getpid()}"
-        lease = LeaderLease(client, holder, ttl_s=args.ha_lease_ttl)
+        # the epoch fence (docs/ha.md "Split brain and fencing"): armed
+        # and extended by the lease dance, checked by the resilient
+        # client before EVERY apiserver mutation — a deposed leader's
+        # in-flight write dies typed instead of double-committing
+        fence = EpochFence()
+        client.fence = fence
+        lease = LeaderLease(
+            client, holder, ttl_s=args.ha_lease_ttl,
+            max_clock_skew_s=args.ha_max_clock_skew,
+            steal_hysteresis=args.ha_steal_hysteresis,
+            steal_backoff_s=args.ha_steal_backoff,
+            fence=fence,
+        )
         if lease.try_acquire():
             ha_log = DeltaLog(path=args.ha_checkpoint)
+            ha_log.epoch = lease.epoch
             if args.ha_checkpoint:
                 # fresh snapshot so the NEXT restart replays only the
                 # tail appended after this point
@@ -359,8 +400,12 @@ def main(argv: list[str] | None = None) -> int:
             dealer.ha = ha_log
             coordinator = HACoordinator(
                 dealer, role="active", log_=ha_log, lease=lease,
+                fence=fence, client=client,
             )
-            log.info("HA: leader lease acquired; serving as ACTIVE")
+            log.info(
+                "HA: leader lease acquired (epoch %d); serving as "
+                "ACTIVE", lease.epoch,
+            )
         else:
             source = (
                 HttpDeltaSource(args.ha_peer) if args.ha_peer else None
@@ -368,6 +413,7 @@ def main(argv: list[str] | None = None) -> int:
             coordinator = HACoordinator(
                 dealer, role="standby", source=source,
                 controller=controller, lease=lease,
+                fence=fence, client=client,
             )
             if source is None:
                 # no stream to tail: promotion falls back to one full
@@ -382,6 +428,9 @@ def main(argv: list[str] | None = None) -> int:
         # checkpoint (the warm-restart feature must survive its own
         # failover)
         coordinator.checkpoint_path = args.ha_checkpoint
+        # the sweeper heals a deposed leader's stale-epoch annotations
+        # without waiting out the TTL (docs/ha.md)
+        controller.epoch_of = lambda: fence.epoch
         api.attach_ha(coordinator)
 
         def _on_promote():
@@ -396,6 +445,23 @@ def main(argv: list[str] | None = None) -> int:
             coordinator, period_s=args.ha_period,
             on_promote=_on_promote, on_demote=_on_demote,
         )
+
+    # degraded mode (docs/ha.md "Degraded mode"): detector fed by every
+    # guarded write outcome; binds 503, write loops pause, reads keep
+    # answering, first successful write heals
+    degraded_monitor = None
+    if args.degraded_budget > 0:
+        from nanotpu.ha.degraded import DegradedMonitor
+
+        degraded_monitor = DegradedMonitor(budget_s=args.degraded_budget)
+        client.degraded = degraded_monitor
+        api.attach_degraded(degraded_monitor)
+
+    # the verify_state deep self-check on demand (GET /debug/verify):
+    # dealer accounting vs live pod annotations (docs/ha.md)
+    from nanotpu.ha.verify import verify_state as _verify_state
+
+    api.verify_state = lambda: _verify_state(dealer, client.list_pods())
 
     def _start_or_defer(loop) -> None:
         """Track a write-side loop for leadership transitions, starting
@@ -417,7 +483,13 @@ def main(argv: list[str] | None = None) -> int:
             obs=api.obs,
         )
         dealer.batch = admitter  # /debug/decisions + /scheduler/batchadmit
-        batch_loop = BatchLoop(admitter, period_s=args.batch_period)
+        batch_loop = BatchLoop(
+            admitter, period_s=args.batch_period,
+            gate=(
+                degraded_monitor.allow_writes
+                if degraded_monitor is not None else None
+            ),
+        )
         _start_or_defer(batch_loop)
 
     recovery_loop = None
@@ -438,7 +510,13 @@ def main(argv: list[str] | None = None) -> int:
         )
         dealer.recovery = plane  # /debug/decisions surfaces its status
         api.registry.register(RecoveryExporter(plane))
-        recovery_loop = RecoveryLoop(plane, period_s=args.recovery_period)
+        recovery_loop = RecoveryLoop(
+            plane, period_s=args.recovery_period,
+            gate=(
+                degraded_monitor.allow_writes
+                if degraded_monitor is not None else None
+            ),
+        )
         _start_or_defer(recovery_loop)
 
     telemetry_loop = None
@@ -480,6 +558,20 @@ def main(argv: list[str] | None = None) -> int:
         )
         if args.flight_recorder:
             flight.install()
+        if degraded_monitor is not None:
+            # every tick gains the SLO-addressable `degraded` section
+            timeline.degraded = degraded_monitor
+        # a checkpoint quarantined during the warm-restart boot (corrupt
+        # tail — docs/ha.md "State integrity") gets its forensics bundle
+        # now that a recorder exists
+        from nanotpu.ha.delta import pop_quarantine_events
+
+        for event in pop_quarantine_events():
+            log.error("checkpoint quarantine at boot: %s", event)
+            try:
+                flight.dump("checkpoint_quarantine")
+            except Exception:
+                log.exception("quarantine flight dump failed")
         api.attach_telemetry(timeline, watchdog, flight)
         if args.timeline_period > 0:
             telemetry_loop = TelemetryLoop(

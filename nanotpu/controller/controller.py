@@ -39,7 +39,7 @@ from nanotpu.analysis.witness import make_condition, make_lock
 from nanotpu.dealer import Dealer
 from nanotpu.k8s.client import ApiError, Clientset, ConflictError, NotFoundError
 from nanotpu.k8s.objects import Pod
-from nanotpu.obs.decisions import REASON_ASSUME_EXPIRED
+from nanotpu.obs.decisions import REASON_ASSUME_EXPIRED, REASON_EPOCH_STALE
 from nanotpu.utils import pod as podutil
 
 log = logging.getLogger("nanotpu.controller")
@@ -194,6 +194,15 @@ class Controller:
         #: ``_dirty_overflow`` and promotion full-resyncs instead.
         self._dirty: dict[str, tuple] = {}
         self._dirty_overflow = False
+        #: optional callable -> the current leader-lease epoch
+        #: (docs/ha.md "Split brain and fencing"): when set, the
+        #: assume-TTL sweeper strips assumed-never-bound pods whose
+        #: stamped ``tpu.io/epoch`` predates it WITHOUT waiting out the
+        #: TTL — the post-heal cleanup for a deposed leader's half-bind.
+        #: None (no fence wired) keeps sweep behavior byte-identical.
+        self.epoch_of = None
+        #: stale-epoch heals the sweeper performed (observability)
+        self.epoch_heals = 0
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -499,7 +508,8 @@ class Controller:
                 log.exception("assume sweep failed")
 
     def sweep_assumed_once(self, ttl_s: float | None = None,
-                           now: float | None = None) -> int:
+                           now: float | None = None,
+                           epoch: int | None = None) -> int:
         """Expire assumed-but-never-bound placement annotations.
 
         A pod carrying ``tpu.io/assume`` + chip annotations but no
@@ -516,6 +526,11 @@ class Controller:
         passes virtual time). Returns the number of pods expired."""
         ttl = self.assume_ttl_s if ttl_s is None else ttl_s
         now = time.monotonic() if now is None else now
+        if epoch is None and self.epoch_of is not None:
+            try:
+                epoch = int(self.epoch_of())
+            except Exception:
+                epoch = None
         try:
             pods = self.client.list_pods(
                 label_selector={types.ANNOTATION_ASSUME: "true"}
@@ -530,13 +545,28 @@ class Controller:
                 continue
             key = (pod.key(), pod.resource_version)
             seen.add(key)
-            first = self._assume_seen.setdefault(key, now)
-            if now - first < ttl:
-                continue
-            if self._expire_assumed(pod, ttl):
+            # stale-epoch heal (docs/ha.md): an assumed-never-bound pod
+            # whose stamped writer epoch predates the CURRENT lease term
+            # is a deposed leader's half-bind — its annotation PUT
+            # slipped out before that leader's fence closed, and the
+            # writer that could finish it no longer exists. Strip NOW;
+            # waiting out the TTL only prolongs the phantom placement.
+            # Unstamped pods (epoch 0: pre-fencing writers, single-
+            # replica deployments) always take the TTL path.
+            stamped = podutil.epoch_of(pod)
+            stale_epoch = (
+                epoch is not None and 0 < stamped < epoch
+            )
+            if not stale_epoch:
+                first = self._assume_seen.setdefault(key, now)
+                if now - first < ttl:
+                    continue
+            if self._expire_assumed(pod, ttl, stale_epoch=stale_epoch):
                 expired += 1
                 self._assume_seen.pop(key, None)
                 seen.discard(key)
+                if stale_epoch:
+                    self.epoch_heals += 1
                 if self.resilience is not None:
                     self.resilience.inc("assume_expired")
         # entries whose pod progressed (bound/deleted/re-annotated) are
@@ -546,7 +576,8 @@ class Controller:
         }
         return expired
 
-    def _expire_assumed(self, pod: Pod, ttl: float) -> bool:
+    def _expire_assumed(self, pod: Pod, ttl: float,
+                        stale_epoch: bool = False) -> bool:
         # the one annotation-strip implementation, shared with the
         # capacity-recovery plane's preempt path (docs/defrag.md)
         stripped = podutil.strip_placement(pod)
@@ -559,10 +590,17 @@ class Controller:
         except ApiError as e:
             log.warning("assume sweep could not strip %s: %s", pod.key(), e)
             return False
-        log.warning(
-            "expired stale placement annotations on %s (assumed but never "
-            "bound within %gs)", pod.key(), ttl,
-        )
+        if stale_epoch:
+            log.warning(
+                "healed stale-epoch placement annotations on %s (stamped "
+                "by a superseded lease term; stripped without the TTL "
+                "wait)", pod.key(),
+            )
+        else:
+            log.warning(
+                "expired stale placement annotations on %s (assumed but "
+                "never bound within %gs)", pod.key(), ttl,
+            )
         if self.obs is not None and self.obs.tracer.sampled(pod.uid):
             # close the pod's audit trail (final=True: the expiry is a
             # terminal verdict — without it the cycle would sit in the
@@ -572,7 +610,8 @@ class Controller:
             # recording 100% of pods would evict the sampled pods'
             # complete cycles from the bounded ring.
             self.obs.ledger.bind_outcome(
-                pod.uid, pod.node_name or "", REASON_ASSUME_EXPIRED,
+                pod.uid, pod.node_name or "",
+                REASON_EPOCH_STALE if stale_epoch else REASON_ASSUME_EXPIRED,
                 False, pod=pod.key(), final=True,
             )
         if self.dealer.tracks(pod.uid):

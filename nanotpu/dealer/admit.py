@@ -392,11 +392,17 @@ class BatchLoop:
     never uses this — it steps the admitter through virtual-time
     ``batch_admit`` events instead (docs/simulation.md)."""
 
-    def __init__(self, admitter: BatchAdmitter, period_s: float = 0.5):
+    def __init__(self, admitter: BatchAdmitter, period_s: float = 0.5,
+                 gate=None):
         if period_s <= 0:
             raise ValueError(f"period_s must be > 0, got {period_s!r}")
         self.admitter = admitter
         self.period_s = period_s
+        #: optional write gate (docs/ha.md "Degraded mode"): a callable
+        #: answering False pauses cycles — a batch cycle is a burst of
+        #: apiserver writes, all doomed while the link is down. None ==
+        #: always run.
+        self.gate = gate
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -416,6 +422,8 @@ class BatchLoop:
     def _run(self) -> None:
         while not self._stop.wait(self.period_s):
             try:
+                if self.gate is not None and not self.gate():
+                    continue  # degraded: skip the cycle, stay alive
                 self.admitter.run_once()
             except Exception:  # the loop must outlive any cycle
                 log.exception("batch admission cycle failed")

@@ -75,13 +75,14 @@ from nanotpu.k8s import events
 from nanotpu.k8s.client import ApiError, Clientset, ConflictError, NotFoundError
 from nanotpu.k8s.events import EventRecorder
 from nanotpu.k8s.objects import Node, Pod
-from nanotpu.k8s.resilience import BreakerOpenError
+from nanotpu.k8s.resilience import BreakerOpenError, FencedError
 from nanotpu.obs import set_current
 from nanotpu.obs.decisions import (
     REASON_ALREADY_BOUND,
     REASON_API_ERROR,
     REASON_BIND_FAILED,
     REASON_BREAKER_OPEN,
+    REASON_FENCED,
     REASON_GANG_TIMEOUT,
     REASON_INSUFFICIENT_CHIPS,
     REASON_NODE_CHANGED,
@@ -2369,7 +2370,9 @@ class Dealer:
             raise BindError(
                 f"bind of {pod.key()} to {node_name} failed: {e}",
                 reason=(
-                    REASON_BREAKER_OPEN
+                    REASON_FENCED
+                    if isinstance(e, FencedError)
+                    else REASON_BREAKER_OPEN
                     if isinstance(e, BreakerOpenError)
                     else REASON_API_ERROR
                 ),
@@ -2592,7 +2595,9 @@ class Dealer:
             raise BindError(
                 f"migration of {pod.key()} to {target_node} failed: {e}",
                 reason=(
-                    REASON_BREAKER_OPEN
+                    REASON_FENCED
+                    if isinstance(e, FencedError)
+                    else REASON_BREAKER_OPEN
                     if isinstance(e, BreakerOpenError)
                     else REASON_API_ERROR
                 ),

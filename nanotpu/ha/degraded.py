@@ -1,0 +1,193 @@
+"""Degraded mode: keep answering reads when the apiserver is gone.
+
+Gray failure containment (docs/ha.md "Degraded mode"): an active whose
+apiserver link is down-or-dying does not need the apiserver to answer
+Filter/Prioritize — those read RCU snapshots — but every bind it
+accepts will die in the write path after burning retries, budget, and
+kube-scheduler patience. Past a budget of CONTINUOUS write failure the
+right move is to say so, cheaply and honestly:
+
+* binds (and /scheduler/batchadmit) answer a structured 503
+  ``Degraded`` with Retry-After, recorded as the typed ledger reason
+  ``degraded_shed``;
+* Filter/Prioritize keep answering from the published snapshots (a
+  scheduler that can still rank candidates is worth keeping warm);
+* the in-process write loops — recovery, batch admission, the replica
+  autoscaler — pause their cycles (each would otherwise spend its
+  whole budget on doomed writes every period);
+* the moment ONE write succeeds, the mode exits cleanly and everything
+  resumes. No operator action, no restart.
+
+:class:`DegradedMonitor` is the detector: the resilient client feeds it
+every guarded write outcome (one attribute load when detached), and it
+latches ``active`` after ``budget_s`` of failures with no success.
+Injectable clock, so the sim drives the exact production code on
+virtual time. Exposed as the ``nanotpu_degraded_*`` gauge family
+(nanotpu/metrics/degraded.py) and a ``degraded`` timeline tick section
+— SLO-addressable like every tick series (``degraded.active``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from nanotpu.analysis.witness import make_lock
+
+log = logging.getLogger("nanotpu.ha.degraded")
+
+
+class DegradedMonitor:
+    """Latches degraded mode after ``budget_s`` of continuous apiserver
+    write failure; exits on the first success (see module docstring)."""
+
+    def __init__(self, budget_s: float = 10.0, clock=time.monotonic,
+                 on_enter=None, on_exit=None):
+        if budget_s <= 0:
+            raise ValueError(f"budget_s must be > 0, got {budget_s!r}")
+        self.budget_s = float(budget_s)
+        self.clock = clock
+        #: fired on mode transitions (cmd/main pauses/resumes loops
+        #: through these; both run OUTSIDE the lock)
+        self.on_enter = on_enter
+        self.on_exit = on_exit
+        #: cadence of the half-open PROBE while degraded: the route
+        #: layer sheds binds, so without letting one through now and
+        #: then NOTHING would touch the apiserver and the mode could
+        #: never observe the heal — the exact trap the breaker's
+        #: half-open probe exists for. One bind per interval is the
+        #: probe; its success exits the mode.
+        self.probe_every_s = max(budget_s / 2.0, 0.05)
+        self._lock = make_lock("DegradedMonitor._lock")
+        self._last_probe = 0.0
+        #: True while in degraded mode. Read lock-free on the request
+        #: path (one attribute load; a stale read costs one borderline
+        #: answer, never a consistency hazard).
+        self.active = False
+        #: first failure of the CURRENT unbroken failure run (None
+        #: when the last outcome was a success)
+        self._failing_since: float | None = None
+        #: newest failure of the run: a gap longer than the budget with
+        #: NO writes at all is not "continuous failure" — two isolated
+        #: blips minutes apart must not sum into an entry
+        self._last_failure = 0.0
+        self._entered_at = 0.0
+        self.entries = 0
+        self.exits = 0
+        #: write failures observed during degraded mode (attribution:
+        #: how much doomed traffic the mode absorbed)
+        self.failures_in_mode = 0
+        #: binds 503'd by the route layer while degraded (the route
+        #: layer bumps this — the monitor only counts it)
+        self.binds_rejected = 0
+        self.total_degraded_s = 0.0
+
+    # -- detector inputs (resilient client write outcomes) ------------------
+    def note_failure(self, target: str) -> None:
+        fire = None
+        with self._lock:
+            now = self.clock()
+            if (
+                self._failing_since is None
+                or now - self._last_failure > self.budget_s
+            ):
+                # start (or RESTART) the run: a silent gap longer than
+                # the budget between failures proves nothing about the
+                # link — only back-to-back failure within the budget
+                # window reads as continuous
+                self._failing_since = now
+            self._last_failure = now
+            if self.active:
+                self.failures_in_mode += 1
+            elif now - self._failing_since >= self.budget_s:
+                self.active = True
+                self.entries += 1
+                self._entered_at = now
+                self._last_probe = now  # first probe one interval out
+                fire = self.on_enter
+                log.error(
+                    "entering DEGRADED mode: apiserver writes failing "
+                    "continuously for %.1fs (budget %.1fs) — binds will "
+                    "503 with Retry-After, write loops pause, reads "
+                    "keep answering (last failed target: %s)",
+                    now - self._failing_since, self.budget_s, target,
+                )
+        if fire is not None:
+            try:
+                fire()
+            except Exception:
+                log.exception("degraded on_enter callback failed")
+
+    def note_success(self, target: str) -> None:
+        fire = None
+        with self._lock:
+            self._failing_since = None
+            if self.active:
+                now = self.clock()
+                self.active = False
+                self.exits += 1
+                self.total_degraded_s += max(0.0, now - self._entered_at)
+                fire = self.on_exit
+                log.warning(
+                    "exiting degraded mode: apiserver write succeeded "
+                    "(%s) after %.1fs degraded",
+                    target, now - self._entered_at,
+                )
+        if fire is not None:
+            try:
+                fire()
+            except Exception:
+                log.exception("degraded on_exit callback failed")
+
+    # -- consumers ----------------------------------------------------------
+    def note_bind_rejected(self) -> None:
+        """Count one bind shed by the route layer's degraded gate —
+        under the lock: verb handler threads race here."""
+        with self._lock:
+            self.binds_rejected += 1
+
+    def allow_probe(self, now: float | None = None) -> bool:
+        """While degraded, claim the single half-open probe slot (one
+        per ``probe_every_s``): the route layer lets that ONE bind
+        through instead of shedding it, and its write outcome is what
+        observes the heal. Callers race safely — the slot is claimed
+        under the lock."""
+        with self._lock:
+            if not self.active:
+                return True
+            if now is None:
+                now = self.clock()
+            if now - self._last_probe >= self.probe_every_s:
+                self._last_probe = now
+                return True
+            return False
+
+    def allow_writes(self) -> bool:
+        """Gate for the in-process write loops (recovery/batch/
+        autoscaler): False while degraded — one attribute load."""
+        return not self.active
+
+    def degraded_gauge_values(self, now: float | None = None) -> dict:
+        """The ``nanotpu_degraded_*`` gauge values. Keys must match the
+        ``_DEGRADED_GAUGES`` table in nanotpu/metrics/degraded.py —
+        nanolint pins the equivalence both ways."""
+        with self._lock:
+            if now is None:
+                now = self.clock()
+            current = (
+                max(0.0, now - self._entered_at) if self.active else 0.0
+            )
+            return {
+                "active": 1.0 if self.active else 0.0,
+                "entries": self.entries,
+                "exits": self.exits,
+                "binds_rejected": self.binds_rejected,
+                "failures_in_mode": self.failures_in_mode,
+                "current_seconds": round(current, 6),
+                "total_seconds": round(self.total_degraded_s + current, 6),
+            }
+
+    def status(self, now: float | None = None) -> dict:
+        """Timeline ``degraded`` tick section / debug body — the same
+        numbers as the gauges (one producer, docs/observability.md)."""
+        return self.degraded_gauge_values(now=now)

@@ -54,6 +54,7 @@ import json
 import logging
 import os
 import time
+import zlib
 
 from nanotpu.analysis.witness import make_lock
 
@@ -68,6 +69,105 @@ NOTE_KINDS = ("gang_park", "gang_unpark", "hole", "lease", "view")
 #: buffered checkpoint lines before emit() hands a batch to the file
 #: (written outside the lock; flush() forces the remainder out)
 _FLUSH_EVERY = 256
+
+#: checkpoint/stream schema version (docs/ha.md "State integrity").
+#: Version 2 added per-record CRC32 + the writer-epoch stamp. A
+#: checkpoint whose snapshot header carries a DIFFERENT version is not
+#: corruption — it is an honest incompatibility, and the loader falls
+#: back to the full annotation resync LOUDLY instead of guessing at the
+#: old layout.
+CHECKPOINT_SCHEMA = 2
+
+
+def record_crc(rec: dict) -> int:
+    """CRC32 over the record's canonical JSON, excluding the ``crc``
+    field itself. Stamped at emit time, verified at the WIRE boundary
+    (the HTTP tail) — a bit flip in transit becomes a typed recovery
+    instead of silently-applied garbage."""
+    body = {k: v for k, v in rec.items() if k != "crc"}
+    return zlib.crc32(
+        json.dumps(body, sort_keys=True, separators=(",", ":")).encode()
+    )
+
+
+def verify_record(rec: dict) -> bool:
+    """True iff the record carries a crc and it matches its content."""
+    crc = rec.get("crc")
+    return isinstance(crc, int) and crc == record_crc(rec)
+
+
+def _crc_line(payload: str) -> str:
+    """One checkpoint line: ``<crc32 hex8> <json>``. The prefix covers
+    the payload BYTES, so verification at load is one C-speed
+    ``zlib.crc32`` over the raw line — no re-serialization (re-dumping
+    a 4096-host snapshot to verify it would eat the warm-restart win
+    the checkpoint exists for)."""
+    return f"{zlib.crc32(payload.encode()) & 0xFFFFFFFF:08x} {payload}"
+
+
+def _parse_crc_line(line: str | bytes) -> dict | None:
+    """Parse one ``<crc8> <json>`` checkpoint line; None on ANY
+    integrity failure (torn prefix, crc mismatch, bad JSON). Accepts
+    bytes so the loader can verify the RAW file bytes without a
+    decode+re-encode round trip (the snapshot line is megabytes at
+    fleet scale and this sits on the warm-restart critical path)."""
+    if isinstance(line, str):
+        line = line.encode()
+    if len(line) < 10 or line[8:9] != b" ":
+        return None
+    try:
+        expect = int(line[:8], 16)
+    except ValueError:
+        return None
+    payload = line[9:]
+    if zlib.crc32(payload) & 0xFFFFFFFF != expect:
+        return None
+    try:
+        out = json.loads(payload)
+    except json.JSONDecodeError:
+        return None
+    return out if isinstance(out, dict) else None
+
+
+#: checkpoint files quarantined this process (path -> reason), consumed
+#: by pop_quarantine_events() so cmd/main can dump a flight-recorder
+#: bundle once the recorder exists (corruption is found at BOOT, before
+#: the observability stack is wired)
+_QUARANTINES: list[dict] = []
+
+
+def pop_quarantine_events() -> list[dict]:
+    """Drain the pending quarantine events (see ``_QUARANTINES``)."""
+    global _QUARANTINES
+    out, _QUARANTINES = _QUARANTINES, []
+    return out
+
+
+def _quarantine(path: str, reason: str) -> str:
+    """Move a corrupt checkpoint aside (``<path>.corrupt``, uniquified
+    when that already exists) so the next snapshot write gets a clean
+    path while the bad bytes survive for forensics — a SECOND
+    corruption must not clobber the first incident's evidence — and
+    record the event for a flight-recorder bundle."""
+    target = f"{path}.corrupt"
+    n = 1
+    while os.path.exists(target) and n < 100:
+        target = f"{path}.corrupt.{n}"
+        n += 1
+    try:
+        os.replace(path, target)
+    except OSError:
+        log.exception("could not quarantine corrupt checkpoint %s", path)
+        target = path
+    log.error(
+        "checkpoint %s QUARANTINED to %s: %s (state recovered up to the "
+        "last intact record; the apiserver resync covers the remainder)",
+        path, target, reason,
+    )
+    _QUARANTINES.append(
+        {"path": path, "quarantined_to": target, "reason": reason}
+    )
+    return target
 
 
 class DeltaLog:
@@ -90,46 +190,61 @@ class DeltaLog:
         self._lock = make_lock("DeltaLog._lock")
         self._ring: list[dict] = []
         self.seq = 0
-        #: checkpoint lines buffered for the next batched file append
-        self._pending_file: list[str] = []
+        #: the emitting process's current leader-lease epoch (0 when no
+        #: fence is wired — docs/ha.md): stamped on every record so a
+        #: tailing standby can recognize records from a SUPERSEDED term
+        #: and treat them as suspect at reconcile time
+        self.epoch = 0
+        #: records buffered for the next batched file append —
+        #: serialized OUTSIDE the lock at flush time (records are
+        #: append-only after emit, so flushing reads them race-free)
+        self._pending_file: list[dict] = []
 
     # -- write side --------------------------------------------------------
     def emit(self, kind: str, data: dict) -> int:
         """Append one record; returns its sequence number. The only work
-        under the lock is two appends — file I/O batches outside it."""
-        lines: list[str] | None = None
+        under the lock is the appends + ONE canonical dump for the wire
+        crc — file-line serialization batches outside it."""
+        batch: list[dict] | None = None
         with self._lock:
             self.seq += 1
             rec = {
                 "seq": self.seq,
                 "t": round(self.clock(), 6),
                 "kind": kind,
+                "epoch": self.epoch,
                 "data": data,
             }
+            rec["crc"] = record_crc(rec)
             self._ring.append(rec)
             if len(self._ring) > self.capacity:
                 # amortized trim: drop the oldest quarter in one slice
                 del self._ring[: max(1, self.capacity // 4)]
             if self.path:
-                self._pending_file.append(
-                    json.dumps(rec, sort_keys=True, separators=(",", ":"))
-                )
+                self._pending_file.append(rec)
                 if len(self._pending_file) >= _FLUSH_EVERY:
-                    lines, self._pending_file = self._pending_file, []
+                    batch, self._pending_file = self._pending_file, []
             seq = self.seq
-        if lines:
-            self._append_lines(lines)
+        if batch:
+            self._append_records(batch)
         return seq
 
     def flush(self) -> None:
-        """Force buffered checkpoint lines to disk (no-op without a path)."""
+        """Force buffered checkpoint records to disk (no-op without a
+        path)."""
         with self._lock:
-            lines, self._pending_file = self._pending_file, []
-        if lines:
-            self._append_lines(lines)
+            batch, self._pending_file = self._pending_file, []
+        if batch:
+            self._append_records(batch)
 
-    def _append_lines(self, lines: list[str]) -> None:
+    def _append_records(self, batch: list[dict]) -> None:
         try:
+            lines = [
+                _crc_line(json.dumps(
+                    rec, sort_keys=True, separators=(",", ":")
+                ))
+                for rec in batch
+            ]
             with open(self.path, "a", encoding="utf-8") as fh:
                 fh.write("\n".join(lines) + "\n")
         except OSError:
@@ -181,51 +296,102 @@ class DeltaLog:
 
 # -- checkpoint file format ------------------------------------------------
 def write_checkpoint(path: str, state: dict, seq: int = 0) -> None:
-    """Write a fresh checkpoint: one snapshot line (full dealer state),
-    ready for delta lines to append after it. Atomic via tmp+rename so a
-    crash mid-write leaves the previous checkpoint intact."""
+    """Write a fresh checkpoint: one versioned, CRC-stamped snapshot
+    line (full dealer state), ready for delta lines to append after it.
+    Atomic via tmp+rename so a crash mid-write leaves the previous
+    checkpoint intact."""
+    head = {
+        "kind": "snapshot", "v": CHECKPOINT_SCHEMA, "seq": seq,
+        "state": state,
+    }
     tmp = f"{path}.tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
-        fh.write(json.dumps(
-            {"kind": "snapshot", "seq": seq, "state": state},
-            sort_keys=True, separators=(",", ":"),
-        ) + "\n")
+        fh.write(_crc_line(json.dumps(
+            head, sort_keys=True, separators=(",", ":"),
+        )) + "\n")
     os.replace(tmp, path)
 
 
 def load_checkpoint(path: str) -> tuple[dict | None, list[dict]]:
     """``(snapshot state | None, [delta records])`` from a checkpoint
-    file. A missing/corrupt file returns ``(None, [])`` — the caller
-    falls back to the full annotation replay; a corrupt TAIL line keeps
-    the records before it (the apiserver resync covers the remainder)."""
+    file, with every line's CRC verified (docs/ha.md "State integrity").
+
+    Recovery taxonomy — each case deterministic, none of them a crash:
+
+    * missing file / empty file → ``(None, [])``: first boot, full
+      annotation replay.
+    * snapshot header from a DIFFERENT schema version → ``(None, [])``
+      loudly: honest incompatibility, full resync (the file is left in
+      place — it is valid, just old).
+    * corrupt header (bad JSON / bad CRC / not a snapshot) →
+      ``(None, [])`` and the file is QUARANTINED (renamed aside).
+    * corrupt tail line (torn final write, mid-file bit flip) →
+      truncate to the records BEFORE the first bad line, quarantine the
+      file; everything after the flip is covered by the apiserver
+      resync instead of being half-trusted."""
     if not os.path.exists(path):
         # first boot: no checkpoint yet is the normal case, not a
         # warning-with-traceback
         return None, []
     try:
-        with open(path, encoding="utf-8") as fh:
-            first = fh.readline()
+        with open(path, "rb") as fh:
+            first = fh.readline().strip()
             if not first:
                 return None, []
-            head = json.loads(first)
+            head = _parse_crc_line(first)
+            if head is None:
+                # either corruption or an OLD-format (pre-integrity,
+                # unprefixed v1) file: peek at the payload to tell the
+                # two apart honestly — an old file is a version
+                # mismatch (loud full resync, file left in place), not
+                # corruption
+                try:
+                    legacy = json.loads(first)
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    legacy = None
+                if (
+                    isinstance(legacy, dict)
+                    and legacy.get("kind") == "snapshot"
+                    and legacy.get("v") != CHECKPOINT_SCHEMA
+                ):
+                    head = legacy  # version mismatch, not corruption
+                else:
+                    _quarantine(
+                        path,
+                        "snapshot header corrupt (bad crc prefix or "
+                        "JSON)",
+                    )
+                    return None, []
             if head.get("kind") != "snapshot":
+                _quarantine(path, "first line is not a snapshot header")
+                return None, []
+            version = head.get("v")
+            if version != CHECKPOINT_SCHEMA:
+                log.error(
+                    "checkpoint %s is schema v%s but this build reads "
+                    "v%d: falling back to the FULL annotation resync "
+                    "(slow but correct; the next snapshot rewrites the "
+                    "file at the current version)",
+                    path, version, CHECKPOINT_SCHEMA,
+                )
                 return None, []
             records: list[dict] = []
             for line in fh:
                 line = line.strip()
                 if not line:
                     continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    log.warning(
-                        "checkpoint %s: corrupt tail line ignored "
-                        "(%d records kept)", path, len(records),
+                rec = _parse_crc_line(line)
+                if rec is None:
+                    _quarantine(
+                        path,
+                        f"corrupt/torn delta line after record "
+                        f"{len(records)} (truncated to the last good "
+                        "record)",
                     )
                     break
                 records.append(rec)
             return head.get("state") or None, records
-    except (OSError, json.JSONDecodeError, ValueError):
+    except (OSError, ValueError):
         log.warning("checkpoint %s unreadable; full replay", path,
                     exc_info=True)
         return None, []

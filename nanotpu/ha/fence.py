@@ -1,0 +1,128 @@
+"""Epoch fencing: the write path's local, non-cooperative kill switch.
+
+The HA pair's lease dance (ha/lease.py) is COOPERATIVE: ``on_demote``
+fires when a renew comes back "no". The failure mode docs/ha.md actually
+fears is the one where the lease API is the thing that's unreachable — a
+partitioned or GC-paused active never hears "no", keeps believing it
+holds the lease, and keeps committing in-flight binds while the standby
+steals and promotes. Split brain on the write path.
+
+:class:`EpochFence` closes that hole with two LOCAL facts, neither of
+which needs the network:
+
+* **validity**: every successful acquire/renew arms the fence until
+  ``renew time + ttl − max_clock_skew`` on the holder's OWN clock. Once
+  that deadline passes without another successful renew, the holder can
+  no longer prove it is the leader — the standby's steal clock (which
+  judges expiry at ``renew + ttl + skew``, the conservative other side
+  of the same margin) may already have fired. Every write past the
+  deadline fast-fails with :class:`~nanotpu.k8s.resilience.FencedError`
+  BEFORE it reaches the apiserver.
+* **epoch**: a monotonic counter carried in the lease object, bumped on
+  every acquire-after-another-holder (steal, promotion, fresh create).
+  Every annotation the scheduler writes is stamped with the writer's
+  epoch (``tpu.io/epoch``), so a write that slipped out just before the
+  fence closed is detectable after the fact: the assume-TTL sweeper
+  strips assumed-never-bound pods whose stamped epoch is older than the
+  current leader's without waiting out the TTL, and a promotion treats
+  older-epoch delta records as suspect (their pods stay in the dirty
+  window and reconcile against informer truth).
+
+The check itself is wait-free: one attribute load when no fence is
+attached (``ResilientClientset.fence is None`` — the non-HA path), two
+loads + a float compare when armed. Writers (the lease dance) serialize
+on a small lock; readers never take it (float/int stores are atomic
+under the GIL, and a torn read across ``epoch``/``_valid_until`` can
+only make the fence MORE conservative for one call).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from nanotpu.analysis.witness import make_lock
+from nanotpu.k8s.resilience import FencedError
+
+log = logging.getLogger("nanotpu.ha.fence")
+
+
+class EpochFence:
+    """One process's view of its own right to write (see module doc)."""
+
+    def __init__(self, clock=time.monotonic):
+        self.clock = clock
+        self._lock = make_lock("EpochFence._lock")
+        #: the writer epoch of the lease term this fence is armed for
+        #: (0 == never held the lease)
+        self.epoch = 0
+        #: local-clock deadline the current term is provably valid until
+        #: (None == not armed: demoted, suspended, or never acquired)
+        self._valid_until: float | None = None
+        #: writes rejected because the fence was closed
+        self.rejections = 0
+        #: terms this fence has been armed for (acquires + promotions,
+        #: not renews)
+        self.terms = 0
+
+    # -- writer side (the lease dance) -------------------------------------
+    def arm(self, epoch: int, valid_until: float) -> None:
+        """A lease term was won (acquire/steal/promotion): adopt its
+        epoch and open the fence until ``valid_until``."""
+        with self._lock:
+            if epoch != self.epoch:
+                self.terms += 1
+            self.epoch = int(epoch)
+            self._valid_until = float(valid_until)
+
+    def extend(self, valid_until: float) -> None:
+        """A renew landed: push the validity deadline out. The epoch is
+        unchanged — renewing is not a new term."""
+        with self._lock:
+            self._valid_until = float(valid_until)
+
+    def suspend(self) -> None:
+        """Leadership lost (renew said no, or a clean release): close
+        the fence NOW instead of waiting out the validity window."""
+        with self._lock:
+            self._valid_until = None
+
+    # -- reader side (every guarded write) ---------------------------------
+    def valid(self, now: float | None = None) -> bool:
+        deadline = self._valid_until  # one load; None == closed
+        if deadline is None:
+            return False
+        if now is None:
+            now = self.clock()
+        return now < deadline
+
+    def check(self, target: str) -> None:
+        """Raise :class:`FencedError` unless this process can currently
+        prove it holds the lease. Called by ``ResilientClientset._call``
+        before every guarded write."""
+        if self.valid():
+            return
+        with self._lock:
+            self.rejections += 1
+        raise FencedError(
+            f"{target} write fenced: this process cannot prove it still "
+            f"holds the leader lease (epoch {self.epoch}; a standby may "
+            "already have promoted — docs/ha.md)",
+            code=503,
+        )
+
+    # -- observability ------------------------------------------------------
+    def status(self, now: float | None = None) -> dict:
+        if now is None:
+            now = self.clock()
+        deadline = self._valid_until
+        return {
+            "epoch": self.epoch,
+            "valid": bool(deadline is not None and now < deadline),
+            "valid_for_s": (
+                round(max(0.0, deadline - now), 6)
+                if deadline is not None else 0.0
+            ),
+            "rejections": self.rejections,
+            "terms": self.terms,
+        }

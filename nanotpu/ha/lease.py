@@ -21,6 +21,7 @@ breaker discipline every other apiserver write lives under
 from __future__ import annotations
 
 import logging
+import random
 import time
 
 from nanotpu.k8s.client import ApiError, ConflictError, NotFoundError
@@ -32,14 +33,50 @@ DEFAULT_LEASE_NAMESPACE = "kube-system"
 
 
 class LeaderLease:
-    """One participant's view of the shared leader lease."""
+    """One participant's view of the shared leader lease.
+
+    Beyond the basic dance, three production hardenings (docs/ha.md
+    "Split brain and fencing"):
+
+    * **epoch** — a monotonic counter in the lease spec, bumped on every
+      acquisition that displaces (or follows) another holder. The fence
+      stamps it onto every write; renewing never bumps it.
+    * **clock-skew margin** — ``max_clock_skew_s`` is the operator's
+      bound on inter-replica wall-clock disagreement (NTP). The HOLDER
+      judges its own term valid only until ``renew + ttl − skew``; a
+      CHALLENGER judges the holder expired only after
+      ``renew + ttl + skew``. The two margins lean opposite ways, so
+      with real skew inside the bound there is never a moment where a
+      deposed holder still believes AND a challenger already steals —
+      the hazard docs/ha.md used to merely document.
+    * **steal hysteresis + jittered backoff** — a challenger steals only
+      after ``steal_hysteresis`` CONSECUTIVE probes observed the holder
+      expired (one flapping lease-API read cannot trigger a promotion),
+      and a failed acquire/steal backs off ``steal_backoff_s`` with
+      jitter before the next attempt (N standbys cannot storm the lease
+      object, and a thrashing lease API bounds promotions per window).
+    """
 
     def __init__(self, client, holder: str,
                  name: str = DEFAULT_LEASE_NAME,
                  namespace: str = DEFAULT_LEASE_NAMESPACE,
-                 ttl_s: float = 3.0, clock=None):
+                 ttl_s: float = 3.0, clock=None,
+                 max_clock_skew_s: float = 0.0,
+                 steal_hysteresis: int = 1,
+                 steal_backoff_s: float = 0.0,
+                 rng=None, fence=None):
         if ttl_s <= 0:
             raise ValueError(f"lease ttl must be > 0, got {ttl_s}")
+        if not 0.0 <= max_clock_skew_s < ttl_s:
+            raise ValueError(
+                f"max_clock_skew_s must be in [0, ttl): a skew bound of "
+                f"{max_clock_skew_s} against ttl {ttl_s} leaves no valid "
+                "holder window at all"
+            )
+        if steal_hysteresis < 1:
+            raise ValueError(
+                f"steal_hysteresis must be >= 1, got {steal_hysteresis}"
+            )
         if clock is None:
             # WALL clock on purpose (never monotonic): acquire/renew
             # times are written by one replica and judged by ANOTHER on
@@ -57,17 +94,75 @@ class LeaderLease:
         self.namespace = namespace
         self.ttl_s = float(ttl_s)
         self.clock = clock
+        self.max_clock_skew_s = float(max_clock_skew_s)
+        self.steal_hysteresis = int(steal_hysteresis)
+        self.steal_backoff_s = float(steal_backoff_s)
+        self._rng = rng or random.Random()
+        #: optional :class:`~nanotpu.ha.fence.EpochFence` this lease
+        #: arms/extends/suspends as its term changes — the one writer of
+        #: the fence's state, so lease truth and write permission can
+        #: never drift. The fence ADOPTS this lease's clock: validity
+        #: deadlines are lease-clock instants (wall time in production),
+        #: and judging them on the fence's own default monotonic clock
+        #: would leave the fence open ~forever — exactly the
+        #: non-cooperative expiry the fence exists to enforce.
+        self.fence = fence
+        if fence is not None:
+            fence.clock = self.clock
         #: acquisitions that displaced a live-but-expired holder
         self.steals = 0
+        #: the epoch of the term this participant last held (0 == never)
+        self.epoch = 0
+        #: consecutive probes that observed the current holder expired
+        #: (reset by any probe that does not, or by the holder's
+        #: renewTime moving — a renew between probes proves life even
+        #: when the next read looks expired again)
+        self._expired_streak = 0
+        self._last_renew_seen: object = None
+        #: no acquire/steal attempts before this local-clock time
+        self._cooloff_until = 0.0
+
+    @property
+    def renew_margin_s(self) -> float:
+        """How long a successful renew proves the term for, on the
+        holder's own clock: ``ttl − max_clock_skew``. The fence's
+        validity window — derived, not configured, so the NTP-skew
+        hazard docs/ha.md describes is arithmetic instead of prose."""
+        return self.ttl_s - self.max_clock_skew_s
 
     # -- raw object helpers ------------------------------------------------
-    def _spec(self, now: float, acquired_at: float | None = None) -> dict:
+    def _spec(self, now: float, acquired_at: float | None = None,
+              epoch: int | None = None) -> dict:
         return {
             "holderIdentity": self.holder,
             "leaseDurationSeconds": self.ttl_s,
             "acquireTime": now if acquired_at is None else acquired_at,
             "renewTime": now,
+            "epoch": self.epoch if epoch is None else int(epoch),
         }
+
+    @staticmethod
+    def _epoch_of(raw: dict) -> int:
+        try:
+            return int((raw.get("spec") or {}).get("epoch") or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def _won(self, now: float, epoch: int) -> None:
+        """Common bookkeeping for every successful acquire/renew: adopt
+        the term's epoch and (when a fence is attached) prove the term
+        valid for the skew-derated window."""
+        self.epoch = epoch
+        self._expired_streak = 0
+        if self.fence is not None:
+            if self.fence.epoch != epoch:
+                self.fence.arm(epoch, now + self.renew_margin_s)
+            else:
+                self.fence.extend(now + self.renew_margin_s)
+
+    def _lost(self) -> None:
+        if self.fence is not None:
+            self.fence.suspend()
 
     def _get(self) -> dict | None:
         try:
@@ -82,60 +177,119 @@ class LeaderLease:
         return str((raw.get("spec") or {}).get("holderIdentity") or "")
 
     def _expired(self, raw: dict, now: float) -> bool:
+        """Challenger-side expiry: the holder is judged dead only after
+        ``ttl + max_clock_skew`` — the conservative complement of the
+        holder's ``ttl − skew`` validity window, so bounded clock skew
+        can never make both sides believe at once."""
         spec = raw.get("spec") or {}
         renew = spec.get("renewTime")
         ttl = float(spec.get("leaseDurationSeconds") or self.ttl_s)
         if renew is None:
             return True
-        return now - float(renew) > ttl
+        return now - float(renew) > ttl + self.max_clock_skew_s
+
+    def _backoff(self, now: float) -> None:
+        """A failed acquire/steal attempt cools this participant off for
+        a jittered window — the promotion-storm bound under a flapping
+        lease API (the jitter de-synchronizes N standbys)."""
+        if self.steal_backoff_s > 0:
+            self._cooloff_until = now + self.steal_backoff_s * (
+                0.5 + self._rng.random()
+            )
 
     # -- the protocol ------------------------------------------------------
     def try_acquire(self, now: float | None = None) -> bool:
         """Become (or remain) the holder. Create when absent, renew when
-        already ours, STEAL when the current holder's renewTime is a full
-        TTL stale. Any conflict/API failure answers False — the caller
+        already ours, STEAL when the current holder's renewTime is
+        ``ttl + skew`` stale for ``steal_hysteresis`` consecutive
+        probes. Any conflict/API failure answers False — the caller
         stays (or becomes) standby and probes again next period."""
         if now is None:
             now = self.clock()
         raw = self._get()
         if raw is None:
+            if now < self._cooloff_until:
+                return False
             try:
                 self.client.create_lease(self.namespace, self.name, {
                     "metadata": {
                         "name": self.name, "namespace": self.namespace,
                     },
-                    "spec": self._spec(now),
+                    "spec": self._spec(now, epoch=1),
                 })
+                self._won(now, 1)
                 return True
             except (ConflictError, ApiError):
+                self._backoff(now)
                 return False  # racer created it first; probe again
         holder = self._holder_of(raw)
         if holder == self.holder:
             return self._renew_raw(raw, now)
+        if holder == "":
+            # cooperatively released (the zero-downtime handoff): take
+            # over NOW — hysteresis guards against misjudging a live
+            # holder, and a blank holder is not a judgment call. The
+            # jittered cooloff still applies: N standbys racing a
+            # released lease must de-synchronize like any other
+            # contention, or the backoff's storm bound is dead here
+            if now < self._cooloff_until:
+                return False
+            taken = self._renew_raw(
+                raw, now, acquired_at=now, epoch=self._epoch_of(raw) + 1
+            )
+            if not taken:
+                self._backoff(now)
+            return taken
+        renew_seen = (raw.get("spec") or {}).get("renewTime")
+        if renew_seen != self._last_renew_seen:
+            # the holder RENEWED since our last probe: whatever the
+            # expiry arithmetic says right now, it was alive recently —
+            # restart the streak (the flapping-API guard must not
+            # accumulate observations across proofs of life)
+            self._expired_streak = 0
+            self._last_renew_seen = renew_seen
         if not self._expired(raw, now):
+            self._expired_streak = 0
             return False
-        stolen = self._renew_raw(raw, now, acquired_at=now)
+        self._expired_streak += 1
+        if self._expired_streak < self.steal_hysteresis:
+            # one stale read is not a dead leader: wait for the streak
+            # (the flapping-lease-API guard, pinned by the lease_thrash
+            # fault in the partition soak)
+            return False
+        if now < self._cooloff_until:
+            return False
+        stolen = self._renew_raw(
+            raw, now, acquired_at=now, epoch=self._epoch_of(raw) + 1
+        )
         if stolen:
             self.steals += 1
             log.warning(
-                "lease %s/%s stolen from expired holder %r",
-                self.namespace, self.name, holder,
+                "lease %s/%s stolen from expired holder %r (epoch %d)",
+                self.namespace, self.name, holder, self.epoch,
             )
+        else:
+            self._backoff(now)
         return stolen
 
     def renew(self, now: float | None = None) -> bool:
         """Refresh renewTime; False means we LOST the lease (someone else
         holds it, it vanished, or the write failed) — the caller must
-        drop leadership, not keep serving writes on a stale claim."""
+        drop leadership, not keep serving writes on a stale claim. The
+        attached fence is suspended on loss and extended on success, so
+        write permission tracks lease truth exactly."""
         if now is None:
             now = self.clock()
         raw = self._get()
         if raw is None or self._holder_of(raw) != self.holder:
+            self._lost()
             return False
         return self._renew_raw(raw, now)
 
     def _renew_raw(self, raw: dict, now: float,
-                   acquired_at: float | None = None) -> bool:
+                   acquired_at: float | None = None,
+                   epoch: int | None = None) -> bool:
+        new_epoch = self._epoch_of(raw) if epoch is None else int(epoch)
         updated = {
             "metadata": dict(raw.get("metadata") or {}),
             "spec": self._spec(
@@ -144,14 +298,18 @@ class LeaderLease:
                     acquired_at if acquired_at is not None
                     else (raw.get("spec") or {}).get("acquireTime", now)
                 ),
+                epoch=new_epoch,
             ),
         }
         try:
             self.client.update_lease(self.namespace, self.name, updated)
+            self._won(now, new_epoch)
             return True
         except (ConflictError, NotFoundError):
+            self._lost()
             return False  # lost the optimistic race: the other side won
         except ApiError:
+            self._lost()
             return False
 
     def release(self, now: float | None = None) -> bool:
@@ -170,10 +328,16 @@ class LeaderLease:
                 "leaseDurationSeconds": self.ttl_s,
                 "acquireTime": None,
                 "renewTime": None,
+                # the epoch SURVIVES the handoff: the successor bumps
+                # from it, so epochs stay monotonic across clean
+                # releases too (a stamp from term N must never tie with
+                # a later term's)
+                "epoch": self._epoch_of(raw),
             },
         }
         try:
             self.client.update_lease(self.namespace, self.name, updated)
+            self._lost()  # we no longer hold it: close the fence NOW
             return True
         except (ConflictError, NotFoundError, ApiError):
             return False

@@ -42,12 +42,22 @@ class HACoordinator:
     def __init__(self, dealer, role: str = "active",
                  log_: DeltaLog | None = None, source=None,
                  controller=None, lease=None, flight=None,
-                 lag_events: int = 0, clock=time.monotonic):
+                 lag_events: int = 0, clock=time.monotonic,
+                 fence=None, client=None):
         if role not in ("active", "standby"):
             raise ValueError(f"role must be active|standby, got {role!r}")
         self._lock = make_lock("HACoordinator._lock")
         self.dealer = dealer
         self.role = role
+        #: optional :class:`~nanotpu.ha.fence.EpochFence` — the same
+        #: instance attached to the resilient client; the coordinator
+        #: reads it for epoch stamping (delta records, gauges) and never
+        #: writes it (the lease dance owns the fence's state)
+        self.fence = fence
+        #: optional clientset for the post-promotion verify_state deep
+        #: check (list_pods only — reads a standby may do). None skips
+        #: the check, keeping pre-fencing behavior byte-identical.
+        self.client = client
         #: the active's emitting log (standby: None until promoted)
         self.log = log_
         #: the standby's tail source: anything with ``.seq`` and
@@ -86,6 +96,17 @@ class HACoordinator:
         #: recovery-plane earmark counts mirrored from note records
         self.holes_open = 0
         self.leases_active = 0
+        #: newest writer epoch observed on the stream; records stamped
+        #: with an OLDER epoch came from a superseded lease term and are
+        #: treated as suspect (docs/ha.md "Split brain and fencing")
+        self.max_epoch = 0
+        #: suspect records seen (skipped, their pods left dirty so the
+        #: promotion reconcile judges them against informer truth)
+        self.suspect_deltas = 0
+        #: result of the newest post-promotion verify_state deep check
+        self.last_verify: dict | None = None
+        #: verify_state runs that found a mismatch
+        self.verify_failures = 0
 
     def is_leader(self) -> bool:
         return self.role == "active"
@@ -149,9 +170,31 @@ class HACoordinator:
     def apply(self, rec: dict) -> None:
         """Apply ONE record (standby side). State kinds go through the
         dealer; note kinds update coordinator bookkeeping; ``view``
-        records warm the dealer's frozen views + renderers."""
+        records warm the dealer's frozen views + renderers.
+
+        Records stamped with an epoch OLDER than the newest one seen are
+        SUSPECT: they were emitted by a superseded lease term, and the
+        write they describe may have been fenced before it landed (or
+        landed just before the fence closed). They are skipped — never
+        applied — and their pods' informer dirty entries survive, so the
+        next reconcile judges those pods against durable truth instead
+        of a deposed leader's word."""
         kind = rec["kind"]
         data = rec.get("data") or {}
+        rec_epoch = int(rec.get("epoch") or 0)
+        if rec_epoch > self.max_epoch:
+            self.max_epoch = rec_epoch
+        elif 0 < rec_epoch < self.max_epoch:
+            # epoch 0 is exempt (same rule as the sweeper's stale-epoch
+            # heal): an UNSTAMPED record means a fence-less emitter — a
+            # pre-fencing build or a lease-less restart — not a
+            # superseded term; treating its whole stream as suspect
+            # would silently freeze the standby
+            self.suspect_deltas += 1
+            self.applied_seq = rec["seq"]
+            self.applied_deltas += 1
+            self.last_applied_t = float(rec.get("t", 0.0))
+            return
         if kind in STATE_KINDS:
             landed = self.dealer.apply_delta(rec)
             if not landed:
@@ -239,9 +282,14 @@ class HACoordinator:
                     self.dealer.write_checkpoint(self.checkpoint_path)
                 except Exception:
                     log.exception("post-promotion checkpoint failed")
+        if self.fence is not None:
+            # the new term's records carry the new epoch — the NEXT
+            # standby can then recognize any stragglers from ours
+            self.log.epoch = self.fence.epoch
         self.dealer.ha = self.log
         if self.controller is not None:
             self.controller.exit_standby()
+        verify = self._verify_after_promotion()
         if self.flight is not None:
             try:
                 self.flight.dump("ha_promotion", now=now)
@@ -249,11 +297,36 @@ class HACoordinator:
                 log.exception("promotion flight dump failed")
         log.warning(
             "promoted to active: reconciled %d pods "
-            "(applied_seq=%d, stale=%s)",
+            "(applied_seq=%d, stale=%s, verify=%s)",
             reconciled, self.applied_seq, self.stale,
+            "skipped" if verify is None else (
+                "ok" if verify["match"] else "MISMATCH"
+            ),
         )
-        return {"promoted": True, "reconciled": reconciled,
-                "stale": self.stale}
+        out = {"promoted": True, "reconciled": reconciled,
+               "stale": self.stale}
+        if verify is not None:
+            out["verify"] = verify
+        return out
+
+    def _verify_after_promotion(self) -> dict | None:
+        """The deep self-check (ha/verify.py), run against live pods
+        right after the reconcile closed the lag window — a promotion
+        that inherited corrupt or suspect state must say so NOW, in its
+        own log line and gauges, not when the next bind miscommits."""
+        if self.client is None:
+            return None
+        try:
+            from nanotpu.ha.verify import verify_state
+
+            result = verify_state(self.dealer, self.client.list_pods())
+        except Exception:
+            log.exception("post-promotion verify_state failed")
+            return None
+        self.last_verify = result
+        if not result["match"]:
+            self.verify_failures += 1
+        return result
 
     def _reconcile(self, now: float) -> int:
         """Close the lag window against informer state. Dirty keys are
@@ -280,10 +353,21 @@ class HACoordinator:
             return -1
         return self._reconcile_dirty()
 
+    def reconcile_dirty(self) -> int:
+        """Public dirty-window reconcile for a LONG-LIVED standby: a
+        deposed leader demoted in place (docs/ha.md "Split brain")
+        accumulates informer events whose deltas will never arrive —
+        they fell in the handover gap between its last emit and the new
+        leader's first. Draining them through the controller's sync
+        rules (GETs + local accounting, which a standby may do) keeps
+        its state convergent without waiting for its next promotion."""
+        return self._reconcile_dirty()
+
     def _reconcile_dirty(self) -> int:
         """Drain the dirty window through the controller's sync rules —
-        shared by promotion and a stream rebase (a standby may run it:
-        GETs + local accounting, never an apiserver write)."""
+        shared by promotion, a stream rebase, and the periodic standby
+        reconcile (a standby may run it: GETs + local accounting, never
+        an apiserver write)."""
         from nanotpu.utils import pod as podutil
 
         controller = self.controller
@@ -346,6 +430,7 @@ class HACoordinator:
         ways (a value produced here but never exported, or declared
         there but never produced, is a lint finding)."""
         log_ = self.log
+        fence = self.fence
         return {
             "role": 1.0 if self.role == "active" else 0.0,
             "lag_events": self.lag(),
@@ -357,6 +442,15 @@ class HACoordinator:
             "apply_failures": self.apply_failures,
             "tail_stale": 1.0 if self.stale else 0.0,
             "parked_noted": len(self.parked),
+            "fence_epoch": fence.epoch if fence is not None else 0,
+            "fence_valid": (
+                1.0 if fence is not None and fence.valid() else 0.0
+            ),
+            "fence_rejections": (
+                fence.rejections if fence is not None else 0
+            ),
+            "suspect_deltas": self.suspect_deltas,
+            "verify_failures": self.verify_failures,
         }
 
     def status(self, now: float | None = None) -> dict:
@@ -370,6 +464,12 @@ class HACoordinator:
             "reconciled_pods": self.reconciled_pods,
             "stale": self.stale,
         }
+        if self.suspect_deltas:
+            out["suspect_deltas"] = self.suspect_deltas
+        if self.fence is not None:
+            out["fence"] = self.fence.status(now=now)
+        if self.last_verify is not None:
+            out["verify"] = self.last_verify
         if self.log is not None:
             out["log"] = self.log.status()
         return out
@@ -393,10 +493,16 @@ class HttpDeltaSource:
         self._stale = False
         #: polls that failed to reach the active (telemetry only)
         self.poll_errors = 0
+        #: windows discarded because a record failed its CRC (the wire
+        #: is a serialization boundary like the checkpoint file — a
+        #: corrupt record is re-fetched next poll, never applied)
+        self.crc_failures = 0
 
     def poll(self, since: int) -> None:
         import json as _json
         import urllib.request
+
+        from nanotpu.ha.delta import verify_record
 
         url = f"{self.base_url}/debug/ha?since={int(since)}&limit={self.page}"
         try:
@@ -406,8 +512,20 @@ class HttpDeltaSource:
             self.poll_errors += 1
             self._records = []
             return
+        records = list(body.get("records") or [])
+        if any(
+            not verify_record(r) for r in records if "crc" in r
+        ):
+            # integrity failure on the tail transport: drop the whole
+            # window (the next poll re-fetches the same range) rather
+            # than apply a record whose bytes cannot be trusted.
+            # Records WITHOUT a crc are a pre-integrity active — apply
+            # them as before (version skew during a rolling upgrade).
+            self.crc_failures += 1
+            self._records = []
+            return
         self._stale = bool(body.get("stale_tail"))
-        self._records = list(body.get("records") or [])
+        self._records = records
         self.seq = int((body.get("log") or {}).get("seq") or 0)
 
     def since(self, seq: int, limit: int | None = None):
@@ -472,6 +590,14 @@ class HALoop:
                             self.on_promote()
                 else:
                     lease = co.lease
+                    if (
+                        lease is not None and co.log is not None
+                        and co.log.epoch != lease.epoch
+                    ):
+                        # stamp the stream with the CURRENT term: a
+                        # demote/re-promote on the same process keeps
+                        # its log, so the epoch must follow the lease
+                        co.log.epoch = lease.epoch
                     if lease is not None and not (
                         lease.renew() or lease.try_acquire()
                     ):

@@ -1,0 +1,94 @@
+"""The deep state self-check: dealer accounting vs informer ground truth.
+
+Split-brain containment (docs/ha.md) needs more than counters: after a
+promotion — or any time an operator doubts the control plane — the
+question is "does this dealer's chip accounting agree, pod by pod, with
+what the durable annotations say?". :func:`verify_state` answers it with
+two digests over the SAME canonical shape:
+
+* **truth** — every live pod carrying placement annotations AND a
+  ``spec.nodeName``: ``uid -> (node, {container: chips})``, straight
+  from the pod objects (an informer cache read or a list — never a
+  write, so a standby may run it too);
+* **dealer** — the dealer's tracked-pod map rendered into the identical
+  shape.
+
+Equal digests prove byte-equal placement state. Unequal digests come
+with a bounded diff naming the first offending uids, so the operator
+(or the promotion log) sees WHICH pods disagree, not just that
+something does. Runs after every promotion (``HACoordinator.promote``)
+and on demand via ``GET /debug/verify``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+
+from nanotpu.utils import pod as podutil
+
+log = logging.getLogger("nanotpu.ha")
+
+#: at most this many differing uids are named in the result (the check
+#: must stay cheap to serve from a debug route mid-incident)
+_DIFF_LIMIT = 16
+
+
+def _placements_of_pods(pods) -> dict:
+    out: dict = {}
+    for pod in pods:
+        if not pod.node_name or podutil.is_completed_pod(pod):
+            continue
+        chips = podutil.get_assigned_chips(pod)
+        if chips is None:
+            continue
+        out[pod.uid] = {
+            "node": pod.node_name,
+            "chips": {c: sorted(v) for c, v in sorted(chips.items())},
+        }
+    return out
+
+
+def _digest(placements: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(placements, sort_keys=True, separators=(",", ":"))
+        .encode()
+    ).hexdigest()[:16]
+
+
+def verify_state(dealer, pods) -> dict:
+    """Compare the dealer's placement accounting against the live pod
+    objects (see module docstring). ``pods`` is any iterable of
+    :class:`~nanotpu.k8s.objects.Pod` — ``client.list_pods()`` or an
+    informer cache snapshot."""
+    truth = _placements_of_pods(pods)
+    dealer_side = _placements_of_pods(dealer.tracked_pods())
+    truth_digest = _digest(truth)
+    dealer_digest = _digest(dealer_side)
+    out = {
+        "match": truth_digest == dealer_digest,
+        "truth_digest": truth_digest,
+        "dealer_digest": dealer_digest,
+        "pods_truth": len(truth),
+        "pods_dealer": len(dealer_side),
+    }
+    if not out["match"]:
+        missing = sorted(set(truth) - set(dealer_side))
+        extra = sorted(set(dealer_side) - set(truth))
+        moved = sorted(
+            uid for uid in set(truth) & set(dealer_side)
+            if truth[uid] != dealer_side[uid]
+        )
+        out["diff"] = {
+            "missing_from_dealer": missing[:_DIFF_LIMIT],
+            "not_in_truth": extra[:_DIFF_LIMIT],
+            "disagree": moved[:_DIFF_LIMIT],
+        }
+        log.error(
+            "verify_state MISMATCH: dealer %s vs truth %s "
+            "(missing=%d extra=%d disagree=%d)",
+            dealer_digest, truth_digest,
+            len(missing), len(extra), len(moved),
+        )
+    return out

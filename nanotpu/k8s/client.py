@@ -216,6 +216,16 @@ class FakeClientset:
             if key not in self._pods:
                 raise NotFoundError(f"pod {key} not found")
             raw = self._pods[key]
+            bound = (raw.get("spec") or {}).get("nodeName")
+            if bound and bound != node_name:
+                # the real apiserver rejects re-binding a bound pod —
+                # the durable half of the double-bind net (docs/ha.md):
+                # even if every in-process fence failed, a split-brain
+                # second bind dies HERE as a semantic 409
+                raise ConflictError(
+                    f"pod {key} is already bound to {bound}; "
+                    f"cannot bind to {node_name}"
+                )
             raw.setdefault("spec", {})["nodeName"] = node_name
             self._bump(raw)
             self.bindings.append((namespace, name, node_name))

@@ -62,6 +62,19 @@ class BreakerOpenError(ApiError):
     decision ledger's ``breaker_open`` instead of a generic API error."""
 
 
+class FencedError(ApiError):
+    """A write fast-failed because this process cannot prove it still
+    holds the leader lease (docs/ha.md "Split brain and fencing").
+
+    Raised by the :class:`~nanotpu.ha.fence.EpochFence` attached to the
+    client BEFORE the request leaves the process: a partitioned or
+    GC-paused deposed leader's in-flight bind dies here instead of
+    double-committing against the promoted standby's writes. The dealer
+    rolls chip accounting back exactly as it does for a breaker
+    fast-fail, and the decision ledger records the typed ``fenced``
+    reason."""
+
+
 def _retryable(e: ApiError) -> bool:
     """Transient server/transport trouble, not a semantic answer."""
     return not isinstance(e, (NotFoundError, ConflictError)) and (
@@ -202,12 +215,43 @@ class ResilientClientset:
             t: _RetryBudget(retry_budget, retry_refill_per_s, clock)
             for t in self.breakers
         }
+        #: optional :class:`~nanotpu.ha.fence.EpochFence` (docs/ha.md):
+        #: when attached, every guarded write is gated on this process
+        #: still being able to PROVE it holds the leader lease, and every
+        #: pod mutation is stamped with the writer's epoch. None (the
+        #: non-HA path) costs exactly one attribute load per write.
+        self.fence = None
+        #: optional :class:`~nanotpu.ha.degraded.DegradedMonitor`: fed
+        #: the outcome of every guarded write attempt so an active that
+        #: cannot reach the apiserver past budget can enter degraded
+        #: mode. None costs one attribute load per outcome.
+        self.degraded = None
 
     # -- write plumbing ----------------------------------------------------
     def _call(self, target: str, fn, fail_open: bool = False):
+        fence = self.fence
+        if fence is not None and not fail_open:
+            # the split-brain gate (docs/ha.md): a deposed leader's
+            # writes die HERE, typed, before touching the apiserver.
+            # Events stay exempt — they already fail open and carry no
+            # placement state a stale leader could corrupt.
+            fence.check(target)
         breaker = self.breakers[target]
+        # the degraded monitor watches only the FAIL-CLOSED targets
+        # (bind/annotation writes — the traffic whose loss actually
+        # pauses scheduling). Events are best-effort AND posted from the
+        # recorder's background thread: keying mode transitions off them
+        # would both add noise and make the sim's journal depend on
+        # thread interleaving (docs/ha.md "Degraded mode").
+        monitor = self.degraded if not fail_open else None
         if not breaker.allow():
             self.counters.inc("breaker_fastfails", target)
+            if monitor is not None:
+                # a fast-fail is the breaker REMEMBERING the apiserver
+                # is down — the degraded budget keeps running on it
+                # (only a real success resets the clock), otherwise an
+                # open breaker would mask the outage from the monitor
+                monitor.note_failure(target)
             trace = current_trace()
             if trace is not None:
                 trace.event("api:breaker-fastfail", target)
@@ -225,6 +269,8 @@ class ResilientClientset:
                 out = fn()
             except (NotFoundError, ConflictError):
                 breaker.record(True)  # a healthy server said no
+                if monitor is not None:
+                    monitor.note_success(target)  # the server IS reachable
                 raise
             # broad on purpose: the REST client maps most transport trouble
             # to ApiError, but read-phase timeouts/resets surface raw — and
@@ -232,6 +278,8 @@ class ResilientClientset:
             # half-open probe slot, wedging the breaker open forever
             except Exception as e:
                 breaker.record(False)
+                if monitor is not None:
+                    monitor.note_failure(target)
                 may_retry = (
                     (_retryable(e) if isinstance(e, ApiError) else True)
                     and attempt + 1 < self.max_attempts
@@ -262,9 +310,31 @@ class ResilientClientset:
                 raise
             else:
                 breaker.record(True)
+                if monitor is not None:
+                    monitor.note_success(target)
                 return out
 
     # -- guarded writes ----------------------------------------------------
+    def _stamp_epoch(self, pod) -> None:
+        """Stamp the writer's epoch onto a pod mutation (docs/ha.md):
+        the durable record of WHICH lease term wrote this placement.
+        The assume-TTL sweeper strips assumed-never-bound pods whose
+        stamped epoch predates the current leader's without waiting out
+        the TTL — the post-heal cleanup for a deposed leader's
+        annotation PUT that slipped out before its fence closed.
+        In-place on purpose: the dealer's tracked copy must agree with
+        what the server stores. Only PLACEMENT-bearing writes are
+        stamped (the pod carries the assume annotation): a strip —
+        the sweeper's heal, a preemption — removes the epoch with the
+        placement and must not be re-stamped on its way out."""
+        fence = self.fence
+        if fence is not None and fence.epoch > 0:
+            from nanotpu import types
+
+            ann = pod.ensure_annotations()
+            if types.ANNOTATION_ASSUME in ann:
+                ann[types.ANNOTATION_EPOCH] = str(fence.epoch)
+
     def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
         return self._call(
             TARGET_BIND,
@@ -272,9 +342,27 @@ class ResilientClientset:
         )
 
     def update_pod(self, pod):
+        self._stamp_epoch(pod)
         return self._call(
             TARGET_POD_WRITE, lambda: self.inner.update_pod(pod)
         )
+
+    def create_pod(self, pod):
+        # scheduler-initiated creates (autoscaler replica pods) carry the
+        # same fence gate + epoch stamp as every other mutation; no
+        # retry/breaker — a create is not yet on any hot path, and its
+        # callers own their own retry policy
+        fence = self.fence
+        if fence is not None:
+            fence.check(TARGET_POD_WRITE)
+            self._stamp_epoch(pod)
+        return self.inner.create_pod(pod)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        fence = self.fence
+        if fence is not None:
+            fence.check(TARGET_POD_WRITE)
+        return self.inner.delete_pod(namespace, name)
 
     def create_event(self, namespace: str, event: dict) -> None:
         return self._call(

@@ -1,0 +1,68 @@
+"""``nanotpu_degraded_*`` exposition: the degraded-mode scrape surface.
+
+The gauge values come from ONE producer —
+:meth:`DegradedMonitor.degraded_gauge_values
+<nanotpu.ha.degraded.DegradedMonitor.degraded_gauge_values>` — so the
+scrape surface and the timeline's ``degraded`` tick section read the
+same numbers. The nanolint metrics-completeness pass cross-checks
+:data:`_DEGRADED_GAUGES` against that producer BOTH directions — the
+same honesty contract the throughput/timeline/SLO/serving/HA families
+live under (docs/ha.md "Degraded mode")."""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger("nanotpu.metrics.degraded")
+
+_FAMILY = "nanotpu_degraded_"
+
+#: gauge suffix -> help text. Keys must match
+#: DegradedMonitor.degraded_gauge_values() exactly — nanolint pins the
+#: equivalence both ways.
+_DEGRADED_GAUGES: dict[str, str] = {
+    "active":
+        "1 while this replica is in degraded mode (apiserver writes "
+        "failing past budget): binds 503 with Retry-After, reads keep "
+        "answering from RCU snapshots, write loops paused",
+    "entries":
+        "Degraded-mode entries since boot (apiserver unreachable past "
+        "the configured budget of continuous write failure)",
+    "exits":
+        "Degraded-mode exits (the first successful apiserver write "
+        "resumes binds and write loops — no restart needed)",
+    "binds_rejected":
+        "Bind/batchadmit requests answered 503 Degraded + Retry-After "
+        "while in degraded mode (kube-scheduler retries them)",
+    "failures_in_mode":
+        "Apiserver write failures observed WHILE degraded — the doomed "
+        "traffic the mode absorbed instead of burning retries on",
+    "current_seconds":
+        "Seconds spent in the CURRENT degraded episode (0 when healthy)",
+    "total_seconds":
+        "Cumulative seconds spent degraded since boot",
+}
+
+
+class DegradedExporter:
+    """Registry-compatible renderer (``Registry.register``) for the
+    degraded-mode gauges. Registered exactly when a monitor is attached
+    (``SchedulerAPI.attach_degraded``), so deployments without one
+    export nothing new."""
+
+    def __init__(self, monitor):
+        self.monitor = monitor
+
+    def render(self) -> list[str]:
+        out: list[str] = []
+        try:
+            values = self.monitor.degraded_gauge_values()
+        except Exception:
+            log.warning("degraded gauge producer failed", exc_info=True)
+            return out
+        for suffix in sorted(_DEGRADED_GAUGES):
+            name = _FAMILY + suffix
+            out.append(f"# HELP {name} {_DEGRADED_GAUGES[suffix]}")
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {float(values[suffix])}")
+        return out

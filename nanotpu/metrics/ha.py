@@ -51,6 +51,26 @@ _HA_GAUGES: dict[str, str] = {
     "parked_noted":
         "Strict-gang reservations the active reported parked "
         "(bookkeeping only: reservations die with the active)",
+    "fence_epoch":
+        "The leader-lease epoch this replica's epoch fence is armed "
+        "for (0 = no fence attached or lease never held) — every "
+        "apiserver mutation is stamped with it (docs/ha.md)",
+    "fence_valid":
+        "1 while this replica can locally prove its lease term is "
+        "still valid (renew + ttl - max_clock_skew); 0 = writes are "
+        "fenced (typed FencedError, dealer rolls back)",
+    "fence_rejections":
+        "Apiserver writes fast-failed by the epoch fence because this "
+        "replica could not prove it still held the lease — each one is "
+        "a split-brain write that did NOT happen",
+    "suspect_deltas":
+        "Delta records skipped because their writer epoch predates the "
+        "newest term seen (a superseded leader's stragglers; their "
+        "pods reconcile against informer truth instead)",
+    "verify_failures":
+        "Post-promotion verify_state deep checks that found the "
+        "dealer's placement accounting disagreeing with the live pod "
+        "annotations (see GET /debug/verify)",
 }
 
 

@@ -48,6 +48,9 @@ REASON_SLO_BREACH = "slo_breach"
 REASON_BATCH_PACKED = "batch_packed"
 REASON_DRAINING = "draining"
 REASON_DRAIN_EXPIRED = "drain_expired"
+REASON_FENCED = "fenced"
+REASON_DEGRADED_SHED = "degraded_shed"
+REASON_EPOCH_STALE = "epoch_stale"
 
 #: code -> operator-facing description. Keys must be exactly the
 #: ``REASON_*`` constants above (nanolint pins the equivalence).
@@ -101,6 +104,18 @@ REASONS: dict[str, str] = {
     REASON_DRAIN_EXPIRED:
         "drain lease expired with requests still in flight; replica "
         "pod deleted by the recovery plane's lease sweep",
+    REASON_FENCED:
+        "write fast-failed by the epoch fence: this replica could not "
+        "prove it still held the leader lease (a deposed leader's "
+        "split-brain write, rolled back — docs/ha.md)",
+    REASON_DEGRADED_SHED:
+        "bind 503'd in degraded mode: the apiserver has been "
+        "unreachable past budget, reads still answer from RCU "
+        "snapshots (Retry-After set; docs/ha.md)",
+    REASON_EPOCH_STALE:
+        "assumed-never-bound pod stripped because its stamped writer "
+        "epoch predates the current lease term (a deposed leader's "
+        "half-bind, healed without waiting out the TTL)",
 }
 
 

@@ -122,6 +122,11 @@ class Timeline:
         #: promotions). The key is PRESENT ONLY THEN, so single-replica
         #: tick bytes — and every pinned scenario digest — are unchanged.
         self.ha = None
+        #: optional degraded-mode monitor (docs/ha.md "Degraded mode"):
+        #: when attached, every tick gains a ``degraded`` section — the
+        #: SLO-addressable series (``degraded.active`` etc.). Same
+        #: present-only-then rule as ``ha``.
+        self.degraded = None
         self.capacity = int(capacity)
         self.clock = clock
         self.deterministic = bool(deterministic)
@@ -193,6 +198,8 @@ class Timeline:
             tick["throughput"] = self._sample_throughput(now)
             if self.ha is not None:
                 tick["ha"] = self._sample_ha()
+            if self.degraded is not None:
+                tick["degraded"] = self._sample_degraded(now)
             tick["ext"] = ext
             if len(self._ring) < self.capacity:
                 self._ring.append(tick)
@@ -343,6 +350,13 @@ class Timeline:
             "promotions": status["promotions"],
             "reconciled_pods": status["reconciled_pods"],
         }
+
+    def _sample_degraded(self, now: float) -> dict:
+        try:
+            return self.degraded.status(now=now)
+        except Exception:  # a broken monitor must not kill a tick
+            log.exception("timeline degraded tap failed")
+            return {"error": 1}
 
     def _sample_sources(self) -> dict:
         out: dict = {}

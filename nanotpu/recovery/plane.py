@@ -1109,9 +1109,15 @@ class RecoveryLoop:
     ``period_s``. The sim never uses this — it steps the plane
     deterministically through its own ``recovery_cycle`` events."""
 
-    def __init__(self, plane: RecoveryPlane, period_s: float = 2.0):
+    def __init__(self, plane: RecoveryPlane, period_s: float = 2.0,
+                 gate=None):
         self.plane = plane
         self.period_s = period_s
+        #: optional write gate (docs/ha.md "Degraded mode"): a callable
+        #: answering False pauses cycles — every recovery action is an
+        #: apiserver write, and spending the cycle budget on a dead
+        #: apiserver starves the heal. None == always run.
+        self.gate = gate
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -1141,6 +1147,8 @@ class RecoveryLoop:
     def _loop(self) -> None:
         while not self._stop.wait(self.period_s):
             try:
+                if self.gate is not None and not self.gate():
+                    continue  # degraded: skip the cycle, stay alive
                 self.plane.run_once(
                     self.plane.clock(),
                     self.plane.dealer.parked_gang_pods(),

@@ -49,7 +49,11 @@ from nanotpu.dealer import Dealer
 from nanotpu.metrics.registry import Registry, _escape_label_value
 from nanotpu.metrics.resilience import ResilienceCounters, ResilienceExporter
 from nanotpu.obs import Observability, set_current
-from nanotpu.obs.decisions import REASON_ADMISSION_SHED, REASON_DEADLINE_SHED
+from nanotpu.obs.decisions import (
+    REASON_ADMISSION_SHED,
+    REASON_DEADLINE_SHED,
+    REASON_DEGRADED_SHED,
+)
 from nanotpu.scheduler.verbs import Bind, Predicate, Prioritize, VerbError
 from nanotpu.utils.deadline import Deadline, DeadlineExceeded, check as deadline_check
 
@@ -68,6 +72,7 @@ DEBUG_ROUTES = (
     "/debug/decisions",
     "/debug/timeline",
     "/debug/ha",
+    "/debug/verify",
 )
 
 
@@ -275,6 +280,15 @@ class SchedulerAPI:
         #: serves GET /debug/ha. None == single-replica == zero new code
         #: on any request path.
         self.ha = None
+        #: degraded-mode monitor (docs/ha.md "Degraded mode"), attached
+        #: by attach_degraded: binds 503 Degraded + Retry-After while
+        #: the apiserver is unreachable past budget. None costs one
+        #: attribute load on the bind path only.
+        self.degraded = None
+        #: callable -> the verify_state deep-check dict (ha/verify.py),
+        #: wired by cmd/main with the live clientset; GET /debug/verify
+        #: 404s when absent.
+        self.verify_state = None
         #: NodeNames-span bytes -> parsed list. nodeCacheCapable payloads
         #: repeat the identical candidate list across every pod's Filter,
         #: and that list is most of the body — the pre-tokenized fast path
@@ -318,6 +332,8 @@ class SchedulerAPI:
                 return self._debug_timeline(path)
             if method == "GET" and path.startswith("/debug/ha"):
                 return self._debug_ha(path)
+            if method == "GET" and path.startswith("/debug/verify"):
+                return self._debug_verify()
             return 404, "application/json", error_body(
                 "NotFound", f"no route {path}"
             )
@@ -348,6 +364,34 @@ class SchedulerAPI:
                 "this replica is the warm standby; binds commit only "
                 "on the leader (docs/ha.md)",
                 Role=self.ha.role,
+                RetryAfterSeconds=self.overload.retry_after_s,
+            )
+        monitor = self.degraded
+        if (
+            verb.name == "bind"
+            and monitor is not None
+            and monitor.active
+            and not monitor.allow_probe()
+        ):
+            # degraded mode (docs/ha.md): the apiserver has been
+            # unreachable past budget — accepting this bind only burns
+            # its write budget on a doomed request. Say so NOW with
+            # Retry-After; Filter/Prioritize keep answering from the
+            # RCU snapshots so the scheduler stays warm for the heal.
+            # One bind per probe interval DOES go through (the claimed
+            # allow_probe slot): its write outcome is how the mode
+            # observes the heal and exits.
+            monitor.note_bind_rejected()
+            self.resilience.inc("shed", verb.name)
+            self.verb_total.inc(verb=verb.name, code="503")
+            uid = _trace_uid(verb.name, None)
+            if self.obs.tracer.sample:
+                self.obs.ledger.abort(uid, verb.name, REASON_DEGRADED_SHED)
+            return 503, "application/json", error_body(
+                "Degraded",
+                "apiserver unreachable past budget: binds are paused "
+                "(reads still answer); retry after the link heals "
+                "(docs/ha.md)",
                 RetryAfterSeconds=self.overload.retry_after_s,
             )
         shed_inflight = -1
@@ -515,6 +559,16 @@ class SchedulerAPI:
                 Role=self.ha.role,
                 RetryAfterSeconds=self.overload.retry_after_s,
             )
+        monitor = self.degraded
+        if monitor is not None and monitor.active:
+            # the batch cycle commits binds — same degraded gate as /bind
+            monitor.note_bind_rejected()
+            return 503, "application/json", error_body(
+                "Degraded",
+                "apiserver unreachable past budget: batch admission is "
+                "paused (docs/ha.md)",
+                RetryAfterSeconds=self.overload.retry_after_s,
+            )
         started = time.perf_counter()
         code = 200
         try:
@@ -654,6 +708,35 @@ class SchedulerAPI:
         self.registry.register(TimelineExporter(timeline))
         if watchdog is not None:
             self.registry.register(SLOExporter(watchdog))
+
+    # -- degraded mode (docs/ha.md "Degraded mode") ------------------------
+    def attach_degraded(self, monitor) -> None:
+        """Adopt a degraded-mode monitor: binds/batchadmit 503 while it
+        is active, and the ``nanotpu_degraded_*`` exporter registers.
+        Deployments without one never call this and change by
+        nothing."""
+        from nanotpu.metrics.degraded import DegradedExporter
+
+        self.degraded = monitor
+        self.registry.register(DegradedExporter(monitor))
+
+    def _debug_verify(self) -> tuple[int, str, str]:
+        """``GET /debug/verify``: run the verify_state deep self-check
+        (dealer accounting vs live pod annotations, ha/verify.py) on
+        demand. Admission-exempt — a suspect control plane is exactly
+        when the operator needs this. 404 when no checker is wired."""
+        if self.verify_state is None:
+            return 404, "application/json", error_body(
+                "NotFound",
+                "no state verifier wired (cmd/main attaches one when "
+                "it owns a clientset; docs/ha.md)",
+            )
+        result = self.verify_state()
+        # a mismatch is an INCIDENT answer, not a handler error: 200
+        # with match=false so the caller always gets the diff
+        return 200, "application/json", json.dumps(
+            result, sort_keys=True
+        )
 
     # -- HA (docs/ha.md) ---------------------------------------------------
     def attach_ha(self, coordinator) -> None:

@@ -430,10 +430,15 @@ class AutoscaleLoop:
     ``autoscale_cycle`` events."""
 
     def __init__(self, autoscaler: ReplicaAutoscaler, signal_fn,
-                 period_s: float = 2.0):
+                 period_s: float = 2.0, gate=None):
         self.autoscaler = autoscaler
         self.signal_fn = signal_fn
         self.period_s = period_s
+        #: optional write gate (docs/ha.md "Degraded mode"): a callable
+        #: answering False pauses cycles — every scale decision is an
+        #: apiserver write, doomed while the link is down. None ==
+        #: always run (the same contract RecoveryLoop/BatchLoop honor).
+        self.gate = gate
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -451,6 +456,8 @@ class AutoscaleLoop:
     def _loop(self) -> None:
         while not self._stop.wait(self.period_s):
             try:
+                if self.gate is not None and not self.gate():
+                    continue  # degraded: skip the cycle, stay alive
                 self.autoscaler.run_once(
                     self.autoscaler.clock(), self.signal_fn()
                 )

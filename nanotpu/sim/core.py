@@ -62,20 +62,29 @@ BIND_RETRIES_PER_CYCLE = 2
 
 
 class _StandbyStack:
-    """The warm-standby replica's process-equivalent inside the sim: its
-    own dealer, standby-mode controller, informer watches, and the
-    coordinator tailing the active's delta stream (docs/ha.md)."""
+    """One replica's process-equivalent inside the sim: its own dealer,
+    controller, informer watches, and coordinator. In crash mode it is
+    only ever the warm standby; in lease mode (docs/ha.md "Split brain
+    and fencing") the SAME shape also carries the per-process fault tap,
+    leader lease, epoch fence, and degraded monitor, because leadership
+    moves between two live stacks and the cut/clock/fence state must
+    follow the PROCESS, not the role."""
 
     __slots__ = ("dealer", "controller", "coordinator", "pod_watch",
-                 "node_watch")
+                 "node_watch", "lease", "fence", "tap", "monitor")
 
     def __init__(self, dealer, controller, coordinator, pod_watch,
-                 node_watch):
+                 node_watch, lease=None, fence=None, tap=None,
+                 monitor=None):
         self.dealer = dealer
         self.controller = controller
         self.coordinator = coordinator
         self.pod_watch = pod_watch
         self.node_watch = node_watch
+        self.lease = lease
+        self.fence = fence
+        self.tap = tap
+        self.monitor = monitor
 
 
 class Simulator:
@@ -129,9 +138,26 @@ class Simulator:
         # `ha.enabled` can never shift a sibling stream (same isolation
         # rule as rng_defrag; pinned by the crash toggle test)
         self.rng_crash = random.Random(base + 9)
+        # the non-fail-stop fault suite (docs/ha.md "Split brain and
+        # fencing"), one reserved stream each — the same isolation rule:
+        # toggling any of partition/skew/thrash/gray can never shift a
+        # sibling stream (pinned by the toggle tests). partition and
+        # skew schedule everything up front and draw nothing today; the
+        # streams are allocated so any future draw has a home.
+        self.rng_partition = random.Random(base + 10)
+        self.rng_skew = random.Random(base + 11)
+        # per-call coins: lease-API flaps and gray write timeouts
+        self.rng_thrash = random.Random(base + 12)
+        self.rng_gray = random.Random(base + 13)
+        # the lease dance's jittered steal backoff draws on the HA
+        # plane's reserved stream (exactly what it was allocated for)
+        self.rng_lease = self.rng_crash
 
         self.client = make_fleet(self.scenario["fleet"])
-        self.faults = FaultPlan(self.scenario["faults"], self.rng_fault)
+        self.faults = FaultPlan(
+            self.scenario["faults"], self.rng_fault,
+            rng_thrash=self.rng_thrash, rng_gray=self.rng_gray,
+        )
         self._bind_hook = self.faults.make_bind_hook()
         #: the degradation ledger, shared across agent restarts (it is the
         #: run's measurement, not the dealer's state) and snapshotted into
@@ -274,6 +300,19 @@ class Simulator:
         self._ha_promotions = 0
         self._ha_reconciled = 0
         self.standby = None
+        #: non-fail-stop fault state (docs/ha.md "Split brain"): the
+        #: open partition window's scope+tap, the standby-tail cut, and
+        #: the gray window's afflicted tap
+        self._partition_state: dict | None = None
+        self._stream_cut = False
+        self._gray_tap = None
+        #: lease-mode double-bind guard: pod name -> node for every
+        #: CURRENTLY bound pod; a second successful bind without a
+        #: removal in between is the split-brain violation the fencing
+        #: exists to prevent (guard armed only in lease mode so the
+        #: recovery plane's legitimate strip-and-rebind flows — absent
+        #: there — can never false-positive)
+        self._bound_nodes: dict[str, str] = {}
         if self.scenario["ha"]["enabled"]:
             self._build_standby()
 
@@ -289,6 +328,52 @@ class Simulator:
         self.lock_witness_edges = 0
 
     # -- construction --------------------------------------------------------
+    def _side_clock(self, offset_s: float, drift_ppm: float):
+        """A per-process lease/fence clock: virtual time plus this
+        process's NTP error (the clock_skew fault, docs/ha.md). Offset 0
+        / drift 0 reads exactly ``self.now``."""
+        if offset_s == 0.0 and drift_ppm == 0.0:
+            return lambda: self.now
+        return lambda: (
+            self.now + offset_s + drift_ppm * 1e-6 * self.now
+        )
+
+    def _build_side(self, holder: str, offset_s: float, drift_ppm: float,
+                    api_client) -> tuple:
+        """(lease, fence, monitor) for one process-equivalent in lease
+        mode, wired into its resilient client. None-tuple when lease
+        mode is off (the crash-fault promotion path stays
+        byte-identical)."""
+        from nanotpu.ha.degraded import DegradedMonitor
+        from nanotpu.ha.fence import EpochFence
+        from nanotpu.ha.lease import LeaderLease
+
+        cfg = self.scenario["ha"]["lease"]
+        clock = self._side_clock(offset_s, drift_ppm)
+        fence = EpochFence(clock=clock)
+        api_client.fence = fence
+        lease = LeaderLease(
+            api_client, holder, ttl_s=cfg["ttl_s"], clock=clock,
+            max_clock_skew_s=cfg["max_clock_skew_s"],
+            steal_hysteresis=cfg["steal_hysteresis"],
+            steal_backoff_s=cfg["backoff_s"],
+            rng=self.rng_lease, fence=fence,
+        )
+        monitor = None
+        budget = self.scenario["ha"]["degraded_budget_s"]
+        if budget > 0:
+            monitor = DegradedMonitor(
+                budget_s=budget, clock=lambda: self.now,
+                on_enter=lambda h=holder: self.report.journal(
+                    self.now, f"degraded-enter {h}"
+                ),
+                on_exit=lambda h=holder: self.report.journal(
+                    self.now, f"degraded-exit {h}"
+                ),
+            )
+            api_client.degraded = monitor
+        return lease, fence, monitor
+
     def _build_stack(self) -> None:
         """(Re)build dealer + verbs — boot and the agent-restart fault.
 
@@ -298,13 +383,21 @@ class Simulator:
         degradation code. The wrapper is rebuilt with the dealer: breaker
         and budget state die with the process they model, while the
         counters (the run's measurement) persist."""
+        tap = BrownoutClient(self.client, self.faults)
         api_client = ResilientClientset(
-            BrownoutClient(self.client, self.faults),
+            tap,
             counters=self.resilience,
             clock=lambda: self.now,
             sleep=lambda s: None,
             rng=self.rng_retry,
         )
+        #: this side's fault tap + (lease mode) lease/fence/monitor —
+        #: the partition/gray window events flip flags on the tap of
+        #: whichever side is active at window open
+        self._active_tap = tap
+        self._active_lease = None
+        self._active_fence = None
+        self._active_monitor = None
         self.dealer = Dealer(
             api_client, make_rater(self.scenario["policy"]), assume_workers=2,
             obs=self.obs, shards=self.scenario["shards"],
@@ -319,9 +412,29 @@ class Simulator:
             from nanotpu.ha import DeltaLog, HACoordinator
 
             self.dealer.ha = DeltaLog(clock=lambda: self.now)
+            fence = None
+            lease_client = None
+            if self.scenario["ha"]["lease"]["enabled"]:
+                skew = self.scenario["faults"]["clock_skew"]
+                lease, fence, monitor = self._build_side(
+                    "rep-0",
+                    float(skew.get("active_offset_s", 0) or 0),
+                    float(skew.get("active_drift_ppm", 0) or 0),
+                    api_client,
+                )
+                self._active_lease = lease
+                self._active_fence = fence
+                self._active_monitor = monitor
+                # boot-time election: rep-0 races first and wins the
+                # empty lease (deterministic — rep-1 probes only from
+                # its first ha_tick)
+                lease.try_acquire(now=lease.clock())
+                self.dealer.ha.epoch = lease.epoch
+                lease_client = self.client
             self.ha_active = HACoordinator(
                 self.dealer, role="active", log_=self.dealer.ha,
-                clock=lambda: self.now,
+                clock=lambda: self.now, lease=self._active_lease,
+                fence=fence, client=lease_client,
             )
             sb = getattr(self, "standby", None)
             if sb is not None:
@@ -329,6 +442,11 @@ class Simulator:
         else:
             self.ha_active = None
         self._wire_dealer()
+        if self.ha_active is not None and self.ha_active.controller is None:
+            # lease mode can demote this side into a standby later; the
+            # coordinator needs its controller for the dirty-window
+            # machinery then (crash mode never demotes the active)
+            self.ha_active.controller = self.controller
 
     def _wire_dealer(self) -> None:
         """Point every stack component at ``self.dealer`` — boot, the
@@ -411,8 +529,9 @@ class Simulator:
         from nanotpu.ha import HACoordinator
 
         start_seq = self.dealer.ha.seq
+        tap = BrownoutClient(self.client, self.faults)
         api_client = ResilientClientset(
-            BrownoutClient(self.client, self.faults),
+            tap,
             counters=self.resilience,
             clock=lambda: self.now,
             sleep=lambda s: None,
@@ -431,15 +550,28 @@ class Simulator:
         )
         sc.enter_standby()
         sc.resync_once()  # standby mode: cache prime + synced() gate
+        lease = fence = monitor = None
+        lease_client = None
+        if self.scenario["ha"]["lease"]["enabled"]:
+            skew = self.scenario["faults"]["clock_skew"]
+            lease, fence, monitor = self._build_side(
+                "rep-1",
+                float(skew.get("standby_offset_s", 0) or 0),
+                float(skew.get("standby_drift_ppm", 0) or 0),
+                api_client,
+            )
+            lease_client = self.client
         coordinator = HACoordinator(
             sd, role="standby", source=self.dealer.ha, controller=sc,
             lag_events=self.scenario["ha"]["lag_events"],
-            clock=lambda: self.now,
+            clock=lambda: self.now, lease=lease, fence=fence,
+            client=lease_client,
         )
         coordinator.applied_seq = start_seq
         self.standby = _StandbyStack(
             sd, sc, coordinator,
             self.client.watch_pods(), self.client.watch_nodes(),
+            lease=lease, fence=fence, tap=tap, monitor=monitor,
         )
 
     def _push(self, t: float, kind: str, payload=None) -> None:
@@ -469,6 +601,11 @@ class Simulator:
                 self._check(converged=False)
         self._settle(horizon)
         self.report.fault_counts = dict(self.faults.counts)
+        if self.faults.nfs_armed:
+            # the non-fail-stop counter block appears exactly when one
+            # of partition/skew/thrash/gray is configured — existing
+            # scenarios' reports (and pinned digests) stay byte-identical
+            self.report.fault_counts.update(self.faults.counts_nfs)
         self.report.pods["pending_final"] = len(self._pending)
         self.report.resilience = self._deterministic_resilience()
         # every trace/decision timestamp is virtual time and every event
@@ -513,6 +650,21 @@ class Simulator:
         for start, end in self.faults.brownout_windows(horizon):
             self._push(start, "brownout", True)
             self._push(end, "brownout", False)
+        for start, end, scope in self.faults.partition_windows(horizon):
+            self._push(start, "partition", {"on": True, "scope": scope})
+            self._push(end, "partition", {"on": False})
+        for start, end in self.faults.thrash_windows(horizon):
+            self._push(start, "lease_thrash", True)
+            self._push(end, "lease_thrash", False)
+        for start, end in self.faults.gray_windows(horizon):
+            self._push(start, "gray", True)
+            self._push(end, "gray", False)
+        lease_cfg = self.scenario["ha"]["lease"]
+        if lease_cfg["enabled"]:
+            t = lease_cfg["period_s"]
+            while t < horizon:
+                self._push(t, "ha_tick", None)
+                t += lease_cfg["period_s"]
         ttl = self.scenario["assume_ttl_s"]
         if ttl > 0:
             t = ttl / 2
@@ -598,6 +750,14 @@ class Simulator:
             self._on_gang_resubmit(payload)
         elif kind == "brownout":
             self._on_brownout(payload)
+        elif kind == "partition":
+            self._on_partition(payload)
+        elif kind == "lease_thrash":
+            self._on_lease_thrash(payload)
+        elif kind == "gray":
+            self._on_gray(payload)
+        elif kind == "ha_tick":
+            self._on_ha_tick()
         elif kind == "assume_sweep":
             self._on_assume_sweep()
         elif kind == "recovery_cycle":
@@ -618,8 +778,13 @@ class Simulator:
     # -- informer tap --------------------------------------------------------
     def _pump_informers(self) -> None:
         """Deliver queued watch events to the real controller handlers,
-        applying drop/duplicate faults, then drain the sync workqueue."""
-        delivered = True
+        applying drop/duplicate faults, then drain the sync workqueue.
+        A side whose apiserver link is partitioned polls nothing — its
+        events buffer in the watch and deliver in order at heal (the
+        informer backlog of a real reconnect)."""
+        delivered = not (
+            self._active_tap is not None and self._active_tap.partitioned
+        )
         while delivered:
             delivered = False
             for watch, handler in (
@@ -654,16 +819,18 @@ class Simulator:
         sb = self.standby
         if sb is None:
             return
-        for watch, handler in (
-            (sb.node_watch, sb.controller.handle_node_event),
-            (sb.pod_watch, sb.controller.handle_pod_event),
-        ):
-            while True:
-                event = watch.poll(timeout=0.0)
-                if event is None:
-                    break
-                handler(event)
-        sb.coordinator.tail_once()
+        if not (sb.tap is not None and sb.tap.partitioned):
+            for watch, handler in (
+                (sb.node_watch, sb.controller.handle_node_event),
+                (sb.pod_watch, sb.controller.handle_pod_event),
+            ):
+                while True:
+                    event = watch.poll(timeout=0.0)
+                    if event is None:
+                        break
+                    handler(event)
+        if not self._stream_cut:
+            sb.coordinator.tail_once()
 
     # -- scheduling cycle ----------------------------------------------------
     def _live_node_names(self) -> list[str]:
@@ -784,6 +951,21 @@ class Simulator:
         the batch-admission cycle (one copy: departure scheduling, gang
         completion, and the recovery plane's lease hook must not drift
         between the two admission paths)."""
+        if self.scenario["ha"]["lease"]["enabled"]:
+            # the split-brain certification's sharpest check: a second
+            # successful bind of a still-bound pod means TWO dealers
+            # each believed they committed it — exactly what the epoch
+            # fence exists to prevent (docs/ha.md)
+            prev = self._bound_nodes.get(pod.name)
+            if prev is not None:
+                self.report.violations.append({
+                    "kind": "double_bind",
+                    "detail": (
+                        f"pod {pod.name} bound to {best} while still "
+                        f"bound to {prev} (split-brain write)"
+                    ),
+                })
+            self._bound_nodes[pod.name] = best
         job.bound_t[pod.name] = self.now
         self.report.pods["bound"] += 1
         self.report.config_count(job.config, "bound")
@@ -924,6 +1106,7 @@ class Simulator:
         if pod.name in self._pending:
             self._pending.remove(pod.name)
         self._pod_job.pop(pod.name, None)
+        self._bound_nodes.pop(pod.name, None)
         if self.plane is not None:
             self.plane.pod_gone(pod.uid)
 
@@ -1130,6 +1313,149 @@ class Simulator:
             self.now, "brownout-start" if active else "brownout-end"
         )
 
+    # -- non-fail-stop faults (docs/ha.md "Split brain and fencing") ---------
+    def _on_partition(self, payload: dict) -> None:
+        """Cut (or heal) the CURRENT active's links. Both processes stay
+        alive and keep trying — the whole point: the deposed side's
+        writes must die on its fence, not on its absence."""
+        if payload["on"]:
+            scope = payload["scope"]
+            self.faults.counts_nfs["partitions"] += 1
+            state = {"scope": scope, "tap": self._active_tap}
+            if scope in ("api", "full") and self._active_tap is not None:
+                self._active_tap.partitioned = True
+            if scope in ("stream", "full"):
+                self._stream_cut = True
+            self._partition_state = state
+            self.report.journal(self.now, f"partition-start scope={scope}")
+        else:
+            state = self._partition_state or {}
+            tap = state.get("tap")
+            if tap is not None:
+                tap.partitioned = False
+            self._stream_cut = False
+            self._partition_state = None
+            self.report.journal(
+                self.now, f"partition-end scope={state.get('scope', '?')}"
+            )
+
+    def _on_lease_thrash(self, active: bool) -> None:
+        self.faults.thrash_active = active
+        if active:
+            self.faults.counts_nfs["lease_thrash_windows"] += 1
+        self.report.journal(
+            self.now,
+            "lease-thrash-start" if active else "lease-thrash-end",
+        )
+
+    def _on_gray(self, active: bool) -> None:
+        """Gray degradation afflicts the side that is active at window
+        open; like the partition, the affliction follows the process."""
+        if active:
+            self.faults.counts_nfs["gray_windows"] += 1
+            self._gray_tap = self._active_tap
+            if self._gray_tap is not None:
+                self._gray_tap.gray = True
+            self.report.journal(self.now, "gray-start")
+        else:
+            if self._gray_tap is not None:
+                self._gray_tap.gray = False
+            self._gray_tap = None
+            self.report.journal(self.now, "gray-end")
+
+    def _on_ha_tick(self) -> None:
+        """One lease-dance cycle for BOTH processes on virtual time —
+        the sim-side HALoop body. Active side first (renew or demote),
+        then the standby's steal probe; promotion swaps the sim's
+        serving pointer between two LIVE stacks."""
+        co_a = self.ha_active
+        lease_a = self._active_lease
+        if co_a is not None and lease_a is not None:
+            if co_a.role == "active":
+                if co_a.log is not None and co_a.log.epoch != lease_a.epoch:
+                    co_a.log.epoch = lease_a.epoch
+                now_a = lease_a.clock()
+                if not (
+                    lease_a.renew(now=now_a)
+                    or lease_a.try_acquire(now=now_a)
+                ):
+                    # leadership lost (or unprovable): demote IN PLACE.
+                    # The stack stays alive and keeps answering reads;
+                    # its fence already closed, so writes die typed.
+                    co_a.role = "standby"
+                    self.report.journal(
+                        self.now, f"ha-demote {lease_a.holder}"
+                    )
+            elif lease_a.try_acquire(now=lease_a.clock()):
+                # a deposed-in-place leader re-won (lease API healed
+                # before the peer stole): flip back — same process,
+                # new epoch term, no swap needed
+                result = co_a.promote(now=self.now)
+                self._ha_promotions += 1
+                self._ha_reconciled += max(result["reconciled"], 0)
+                self.report.journal(
+                    self.now,
+                    f"ha-repromote {lease_a.holder} "
+                    f"epoch={lease_a.epoch} "
+                    f"reconciled={result['reconciled']}",
+                )
+                self._on_retry()
+        sb = self.standby
+        if (
+            sb is not None and sb.lease is not None
+            and sb.coordinator.role == "standby"
+            and sb.lease.try_acquire(now=sb.lease.clock())
+        ):
+            result = sb.coordinator.promote(now=self.now)
+            self._ha_promotions += 1
+            self._ha_reconciled += max(result["reconciled"], 0)
+            verify = result.get("verify") or {}
+            self.report.journal(
+                self.now,
+                f"ha-promote {sb.lease.holder} epoch={sb.lease.epoch} "
+                f"reconciled={result['reconciled']} "
+                f"verify={verify.get('match', 'skipped')}",
+            )
+            self._swap_leader(sb)
+
+    def _swap_leader(self, sb) -> None:
+        """Adopt the freshly-promoted standby as the serving stack and
+        demote the old active INTO the standby slot — both processes
+        stay alive (the split-brain drill). The old side re-tails the
+        new leader's stream anchored at its present seq: its own state
+        is consistent with everything it committed (fenced writes
+        rolled back), and the new leader's future commits stream to it
+        like to any standby."""
+        old = _StandbyStack(
+            self.dealer, self.controller, self.ha_active,
+            self._pod_watch, self._node_watch,
+            lease=self._active_lease, fence=self._active_fence,
+            tap=self._active_tap, monitor=self._active_monitor,
+        )
+        old.coordinator.role = "standby"
+        old.controller.enter_standby()
+        old.coordinator.source = sb.dealer.ha
+        old.coordinator.applied_seq = sb.dealer.ha.seq
+        old.coordinator.lag_events = self.scenario["ha"]["lag_events"]
+        old.coordinator.stale = False
+        # adopt the new leader
+        self.dealer = sb.dealer
+        self.controller = sb.controller
+        self._pod_watch = sb.pod_watch
+        self._node_watch = sb.node_watch
+        self.ha_active = sb.coordinator
+        self._active_lease = sb.lease
+        self._active_fence = sb.fence
+        self._active_tap = sb.tap
+        self._active_monitor = sb.monitor
+        self._wire_dealer()
+        self.controller.drain_sync()
+        self.standby = old
+        # pending pods retry against the new leader immediately — the
+        # sim analogue of kube-scheduler's retry landing on the freshly
+        # ready replica
+        self._on_retry()
+
     def _on_recovery(self) -> None:
         """One capacity-recovery cycle on virtual time: hand the plane
         the pending GANG pods (the sim's view of a parked gang — the
@@ -1139,6 +1465,8 @@ class Simulator:
         requeue evicted pods into the pending list — the sim-side half
         of preempt-and-requeue (the coalescing-queue half runs inside
         the plane via Controller.requeue)."""
+        if self._degraded_skip("recovery"):
+            return
         parked = []
         for name in self._pending:
             job = self._pod_job.get(name)
@@ -1198,6 +1526,8 @@ class Simulator:
         commit fan-out), journal every action (digest-witnessed), and
         leave losers pending for the pod-at-a-time retry path
         untouched."""
+        if self._degraded_skip("batch"):
+            return
         if not self._pending:
             return
         offered: list = []
@@ -1335,6 +1665,8 @@ class Simulator:
         the sim routes its pod writes back through the event loop —
         scale-ups into the admission path, drains into the virtual
         fleet's no-new-work state, deletes into cohort requeue."""
+        if self._degraded_skip("autoscale"):
+            return
         self._sync_replicas()
         result = self.autoscaler.run_once(self.now, self.serve.signal())
         for kind, detail in result["actions"]:
@@ -1366,9 +1698,31 @@ class Simulator:
             self.serve.register_pending(name)
             self._admit_replica_pod(pod)
 
+    def _api_cut(self) -> bool:
+        """True while the active's apiserver link is partitioned — the
+        list-driven loops (resync, sweeper) cannot run then, exactly as
+        a real partitioned process could not list."""
+        return (
+            self._active_tap is not None and self._active_tap.partitioned
+        )
+
+    def _degraded_skip(self, what: str) -> bool:
+        """True (journaled) when the active's degraded monitor has the
+        write loops paused — the sim-side analogue of the production
+        loops' gate (docs/ha.md 'Degraded mode')."""
+        monitor = self._active_monitor
+        if monitor is not None and not monitor.allow_writes():
+            self.report.journal(self.now, f"degraded-skip {what}")
+            return True
+        return False
+
     def _on_assume_sweep(self) -> None:
+        if self._api_cut():
+            return
+        fence = self._active_fence
         expired = self.controller.sweep_assumed_once(
-            self.scenario["assume_ttl_s"], now=self.now
+            self.scenario["assume_ttl_s"], now=self.now,
+            epoch=(fence.epoch if fence is not None else None),
         )
         if expired:
             self.report.journal(self.now, f"assume-expire {expired}")
@@ -1436,6 +1790,8 @@ class Simulator:
             self.dealer.publish_usage(tuple(sorted(touched)))
 
     def _on_resync(self) -> None:
+        if self._api_cut():
+            return  # a partitioned process cannot list
         self.controller.resync_once()
         self.controller.drain_sync()
 
@@ -1504,6 +1860,19 @@ class Simulator:
         self.now = horizon
         self.faults.armed = False
         self.faults.brownout_active = False  # windows are horizon-clipped
+        self.faults.thrash_active = False
+        # heal any window still open at the horizon: convergence is
+        # only checkable with every link up
+        for side_tap in (
+            self._active_tap,
+            self.standby.tap if self.standby is not None else None,
+        ):
+            if side_tap is not None:
+                side_tap.partitioned = False
+                side_tap.gray = False
+        self._stream_cut = False
+        self._partition_state = None
+        self._gray_tap = None
         self._pump_informers()
         self.controller.resync_once()
         self.controller.drain_sync()
@@ -1587,6 +1956,13 @@ class Simulator:
             if sb is not None:
                 sb.coordinator.lag_events = 0
                 self._pump_standby()
+                if self.scenario["ha"]["lease"]["enabled"]:
+                    # a deposed-in-place leader's dirty window holds
+                    # events from the handover gap (no delta will ever
+                    # cover them) — the standby-side reconcile drains
+                    # them so the convergence check judges real state,
+                    # not the gap (docs/ha.md "Split brain")
+                    sb.coordinator.reconcile_dirty()
                 sb_occ = sb.dealer.occupancy()
                 sb_truth = ground_truth_occupancy(sb.dealer, self.client)
                 sb_drift = abs(sb_occ - sb_truth)
@@ -1619,6 +1995,91 @@ class Simulator:
                 f"applied={self.report.ha['applied_deltas']} "
                 f"standby_drift={sb_drift:.6f}",
             )
+            if self.scenario["ha"]["lease"]["enabled"]:
+                self._settle_lease(horizon)
+        # deterministic serving section (docs/serving-loop.md)
+        self._settle_serving(horizon)
+
+    def _settle_lease(self, horizon: float) -> None:
+        """The split-brain certification block (docs/ha.md): fencing,
+        epoch, degraded-mode, and promotion-storm accounting for BOTH
+        live sides, plus the promotion bound assert. Lease-mode
+        scenarios only — crash-mode `ha` sections stay byte-identical."""
+        sb = self.standby
+        sides = [
+            (self._active_lease, self._active_fence, self._active_monitor,
+             self.ha_active, self.controller),
+        ]
+        if sb is not None:
+            sides.append(
+                (sb.lease, sb.fence, sb.monitor, sb.coordinator,
+                 sb.controller)
+            )
+        fence_rejections = sum(
+            f.rejections for _, f, _m, _c, _ct in sides if f is not None
+        )
+        steals = sum(
+            le.steals for le, _f, _m, _c, _ct in sides if le is not None
+        )
+        epoch_final = max(
+            (le.epoch for le, _f, _m, _c, _ct in sides
+             if le is not None), default=0,
+        )
+        suspect = sum(c.suspect_deltas for _l, _f, _m, c, _ct in sides)
+        heals = sum(ct.epoch_heals for _l, _f, _m, _c, ct in sides)
+        verify_failures = sum(
+            c.verify_failures for _l, _f, _m, c, _ct in sides
+        )
+        lease_block = {
+            "epoch_final": epoch_final,
+            "steals": steals,
+            "fence_rejections": fence_rejections,
+            "suspect_deltas": suspect,
+            "epoch_heals": heals,
+            "verify_failures": verify_failures,
+        }
+        monitors = [m for _l, _f, m, _c, _ct in sides if m is not None]
+        if monitors:
+            lease_block["degraded"] = {
+                "entries": sum(m.entries for m in monitors),
+                "exits": sum(m.exits for m in monitors),
+            }
+        self.report.ha["lease"] = lease_block
+        bound = self.scenario["ha"]["promotion_bound"]
+        if bound > 0 and self._ha_promotions > bound:
+            self.report.violations.append({
+                "kind": "promotion_storm",
+                "detail": (
+                    f"{self._ha_promotions} promotions exceed the "
+                    f"scenario bound of {bound} (steal hysteresis / "
+                    "backoff failed to contain the thrash)"
+                ),
+            })
+        # post-promotion verifies run MID-RUN, where a dropped event
+        # awaiting resync is a legitimate transient (verify_failures is
+        # reported, not asserted). The CONVERGED verify here is the
+        # certification: with everything healed and resynced, the deep
+        # check must match to the byte.
+        from nanotpu.ha.verify import verify_state
+
+        final_verify = verify_state(self.dealer, self.client.list_pods())
+        lease_block["final_verify_match"] = bool(final_verify["match"])
+        if not final_verify["match"]:
+            self.report.violations.append({
+                "kind": "verify_state_mismatch",
+                "detail": (
+                    "converged verify_state found dealer-vs-truth "
+                    f"divergence: {final_verify}"
+                ),
+            })
+        self.report.journal(
+            horizon,
+            f"ha-lease epoch={epoch_final} steals={steals} "
+            f"fenced={fence_rejections} suspect={suspect} "
+            f"epoch_heals={heals} verify_failures={verify_failures}",
+        )
+
+    def _settle_serving(self, horizon: float) -> None:
         if self.serve is not None:
             # deterministic serving section (docs/serving-loop.md): the
             # certification metrics — tokens/s-per-chip, TTFT

@@ -38,6 +38,28 @@ it injected. The taxonomy (scenario ``faults`` section):
   state), and a FRESH standby boots behind the new active. Requires the
   scenario's ``ha`` section enabled; converged equality and zero
   double-binds are the certification.
+
+Non-fail-stop faults (docs/ha.md "Split brain and fencing"; all require
+lease-arbitrated leadership, ``ha.lease.enabled``, except gray which
+needs only ``ha``):
+
+* ``network_partition`` — windows where the CURRENT active's links are
+  cut while BOTH processes stay alive and keep trying: scope ``api``
+  (active↔apiserver, including the lease API and its informer tap),
+  ``stream`` (active↔standby delta tail), or ``full`` (both). The
+  standby steals the lease after TTL+skew, promotes, and the deposed
+  active's in-flight writes die on its epoch fence — zero double-binds
+  with two live dealers is the certification.
+* ``clock_skew`` — per-process offset/drift on the lease+fence clocks;
+  the lease's configured ``max_clock_skew_s`` margin must absorb it
+  (no premature steal, no deposed-leader validity overlap).
+* ``lease_thrash`` — windows where lease API calls from BOTH sides
+  fail with ``fail_prob``; steal hysteresis + jittered backoff must
+  bound promotions-per-window.
+* ``gray_degradation`` — slow-not-dead: the active's scheduler-side
+  writes fail with ``fail_prob`` inside the windows (the timeout-heavy
+  half-alive apiserver link), exercising breaker/degraded-mode behavior
+  without a clean cut.
 """
 
 from __future__ import annotations
@@ -49,24 +71,74 @@ from nanotpu.k8s.client import ApiError
 
 class BrownoutClient:
     """Clientset proxy the DEALER sees: fails scheduler-side API writes
-    while a brownout window is active.
+    while a brownout window is active, this side's link is partitioned,
+    or a gray window's seeded coin says the write timed out.
 
     Deliberately not a ``FakeClientset`` hook: the sim's own lifecycle
     writes (pod completion, eviction, the sweeper's annotation strip) are
     kubelet/controller traffic that does not flow through the scheduler's
-    client in a real cluster, so the brownout must not touch them."""
+    client in a real cluster, so the faults must not touch them.
+
+    One instance per PROCESS-equivalent (the active stack and the
+    standby stack each have their own): ``partitioned``/``gray`` are
+    set by the sim's partition/gray window events on the tap of
+    whichever side is active at window open, and the cut follows the
+    PROCESS — a mid-window leader swap does not move it."""
 
     def __init__(self, inner, faults: "FaultPlan"):
         self._inner = inner
         self._faults = faults
+        #: True while a network_partition window cuts this side's
+        #: apiserver link (scope api|full)
+        self.partitioned = False
+        #: True while a gray_degradation window afflicts this side
+        self.gray = False
+
+    def _check_write(self, what: str) -> None:
+        if self.partitioned:
+            self._faults.count_partition_rejection()
+            raise ApiError(
+                f"injected network partition ({what})", code=503
+            )
+        if self.gray and self._faults.gray_coin():
+            raise ApiError(
+                f"injected gray-failure timeout ({what})", code=504
+            )
+        self._faults.check_brownout(what)
 
     def update_pod(self, pod):
-        self._faults.check_brownout("update_pod")
+        self._check_write("update_pod")
         return self._inner.update_pod(pod)
 
     def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
-        self._faults.check_brownout("bind_pod")
+        self._check_write("bind_pod")
         return self._inner.bind_pod(namespace, name, node_name)
+
+    # -- the lease API (coordination.k8s.io) -------------------------------
+    # A partition that cuts this side from the apiserver cuts its lease
+    # traffic too — that is exactly the non-fail-stop case: the active
+    # cannot renew AND cannot hear that it lost. lease_thrash flaps the
+    # lease API for EVERY side (the lease object's etcd is sick, not one
+    # link).
+    def _check_lease(self, what: str) -> None:
+        if self.partitioned:
+            self._faults.count_partition_rejection()
+            raise ApiError(
+                f"injected network partition ({what})", code=503
+            )
+        self._faults.check_lease_call(what)
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        self._check_lease("get_lease")
+        return self._inner.get_lease(namespace, name)
+
+    def create_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        self._check_lease("create_lease")
+        return self._inner.create_lease(namespace, name, lease)
+
+    def update_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        self._check_lease("update_lease")
+        return self._inner.update_lease(namespace, name, lease)
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
@@ -75,14 +147,24 @@ class BrownoutClient:
 class FaultPlan:
     """Seeded per-run fault decisions + injection counters."""
 
-    def __init__(self, spec: dict, rng: random.Random):
+    def __init__(self, spec: dict, rng: random.Random,
+                 rng_thrash: random.Random | None = None,
+                 rng_gray: random.Random | None = None):
         self.spec = spec
         self.rng = rng
+        #: dedicated streams for the non-fail-stop faults (docs/ha.md):
+        #: per-call coins live here exclusively so toggling a fault can
+        #: never shift a sibling stream (the rng-isolation rule every
+        #: fault lives under)
+        self.rng_thrash = rng_thrash or random.Random()
+        self.rng_gray = rng_gray or random.Random()
         #: set False during the settle phase: convergence is only checkable
         #: once the fault tap stops perturbing the event stream
         self.armed = True
         #: True inside an api_brownout window (core.py toggles via events)
         self.brownout_active = False
+        #: True inside a lease_thrash window (core.py toggles via events)
+        self.thrash_active = False
         self.counts = {
             "node_flaps": 0,
             "pods_evicted": 0,
@@ -98,6 +180,34 @@ class FaultPlan:
             "brownout_rejections": 0,
             "scheduler_crashes": 0,
         }
+        #: non-fail-stop fault counters, kept SEPARATE from ``counts``
+        #: and merged into the report's fault_counts only when one of
+        #: the four faults is configured (``nfs_armed``) — existing
+        #: scenarios' reports, and their pinned digests, stay
+        #: byte-identical
+        self.counts_nfs = {
+            "partitions": 0,
+            "partition_rejections": 0,
+            "lease_thrash_windows": 0,
+            "lease_calls_failed": 0,
+            "gray_windows": 0,
+            "gray_failures_injected": 0,
+        }
+
+    @property
+    def nfs_armed(self) -> bool:
+        """True when any non-fail-stop fault is configured — the gate
+        for the report's extra counter block (docs/ha.md)."""
+        return bool(
+            (self.spec["network_partition"].get("windows"))
+            or self.spec["lease_thrash"].get("at_s")
+            or self.spec["gray_degradation"].get("at_s")
+            or any(
+                float(self.spec["clock_skew"].get(k, 0) or 0) != 0.0
+                for k in ("active_offset_s", "standby_offset_s",
+                          "active_drift_ppm", "standby_drift_ppm")
+            )
+        )
 
     # -- schedule-time queries (used once, at sim setup) --------------------
     def flap_times(self, horizon_s: float) -> list[float]:
@@ -176,6 +286,71 @@ class FaultPlan:
                 out.append((t, rng.choices(kinds, weights=weights)[0]))
         self.counts["overload_arrivals"] += len(out)
         return out
+
+    def partition_windows(
+        self, horizon_s: float
+    ) -> list[tuple[float, float, str]]:
+        """``(start, end, scope)`` partition windows clipped inside the
+        horizon (a window must CLOSE before settle so convergence is
+        checkable; validation already ordered them non-overlapping)."""
+        out = []
+        for win in self.spec["network_partition"].get("windows") or []:
+            start = float(win["at_s"])
+            if not 0 < start < horizon_s:
+                continue
+            out.append((
+                start,
+                min(start + float(win["duration_s"]), horizon_s),
+                str(win.get("scope", "api")),
+            ))
+        return out
+
+    def thrash_windows(self, horizon_s: float) -> list[tuple[float, float]]:
+        th = self.spec["lease_thrash"]
+        duration = float(th.get("duration_s", 0) or 0)
+        if duration <= 0:
+            return []
+        return [
+            (t, min(t + duration, horizon_s))
+            for t in sorted(float(x) for x in th.get("at_s", []))
+            if 0 < t < horizon_s
+        ]
+
+    def gray_windows(self, horizon_s: float) -> list[tuple[float, float]]:
+        gd = self.spec["gray_degradation"]
+        duration = float(gd.get("duration_s", 0) or 0)
+        if duration <= 0:
+            return []
+        return [
+            (t, min(t + duration, horizon_s))
+            for t in sorted(float(x) for x in gd.get("at_s", []))
+            if 0 < t < horizon_s
+        ]
+
+    def count_partition_rejection(self) -> None:
+        self.counts_nfs["partition_rejections"] += 1
+
+    def check_lease_call(self, what: str) -> None:
+        """Raise for a lease API call inside a thrash window (coin on
+        the dedicated rng_thrash stream; both sides flap — the lease
+        backend is sick, not one link)."""
+        if not (self.armed and self.thrash_active):
+            return
+        prob = float(self.spec["lease_thrash"].get("fail_prob", 0.5))
+        if self.rng_thrash.random() < prob:
+            self.counts_nfs["lease_calls_failed"] += 1
+            raise ApiError(f"injected lease-API flap ({what})", code=503)
+
+    def gray_coin(self) -> bool:
+        """One seeded should-this-write-time-out decision inside a gray
+        window (dedicated rng_gray stream)."""
+        if not self.armed:
+            return False
+        prob = float(self.spec["gray_degradation"].get("fail_prob", 0.5))
+        if self.rng_gray.random() < prob:
+            self.counts_nfs["gray_failures_injected"] += 1
+            return True
+        return False
 
     def brownout_windows(self, horizon_s: float) -> list[tuple[float, float]]:
         """API-brownout windows [(start, end)) clipped inside the horizon

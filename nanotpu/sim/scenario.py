@@ -30,18 +30,78 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
         "overload": {"burst_every_s": 8.0, "burst_s": 3.0,
                      "rate_multiplier": 4.0},
         "api_brownout": {"at_s": [12.0], "duration_s": 4.0},
-        "scheduler_crash": {"at_s": [20.0]}  # kill the ACTIVE dealer —
+        "scheduler_crash": {"at_s": [20.0]},  # kill the ACTIVE dealer —
                                      # requires ha.enabled (docs/ha.md)
+        "network_partition": {       # non-fail-stop (docs/ha.md "Split
+                                     # brain"): BOTH processes stay
+                                     # alive; the window cuts the
+                                     # CURRENT active's links. scope:
+                                     # "api" (active<->apiserver incl.
+                                     # the lease API + its informer),
+                                     # "stream" (active<->standby delta
+                                     # tail), "full" (both). Requires
+                                     # ha.lease.enabled.
+          "windows": [{"at_s": 10.0, "duration_s": 3.0, "scope": "api"}]
+        },
+        "clock_skew": {              # per-process lease/fence clock
+                                     # offset+drift (requires
+                                     # ha.lease.enabled); the lease's
+                                     # skew margin must absorb it
+          "active_offset_s": 0.0, "standby_offset_s": 0.0,
+          "active_drift_ppm": 0.0, "standby_drift_ppm": 0.0
+        },
+        "lease_thrash": {            # flapping lease API: lease calls
+                                     # from BOTH sides fail with prob
+                                     # inside the windows (requires
+                                     # ha.lease.enabled); steal
+                                     # hysteresis + backoff must bound
+                                     # promotions
+          "at_s": [], "duration_s": 0.0, "fail_prob": 0.5
+        },
+        "gray_degradation": {        # slow-not-dead: the CURRENT
+                                     # active's scheduler-side writes
+                                     # fail with prob inside the
+                                     # windows (timeouts), exercising
+                                     # degraded mode without a clean
+                                     # partition
+          "at_s": [], "duration_s": 0.0, "fail_prob": 0.5
+        }
       },
       "ha": {                        # warm-standby dealer pair
                                      # (docs/ha.md); absent/disabled
                                      # keeps every existing digest
                                      # byte-identical
         "enabled": false,
-        "lag_events": 8              # delta records the standby's apply
+        "lag_events": 8,             # delta records the standby's apply
                                      # trails the stream by (the sim's
                                      # stream-latency model; the crash's
                                      # reconcile window)
+        "lease": {                   # lease-arbitrated leadership on
+                                     # virtual time (docs/ha.md "Split
+                                     # brain and fencing"): epoch
+                                     # fences on both write paths,
+                                     # ha_tick renew/steal events, and
+                                     # live leader swaps (both stacks
+                                     # stay alive). Off keeps the
+                                     # crash-fault promotion path — and
+                                     # every existing digest —
+                                     # byte-identical.
+          "enabled": false,
+          "ttl_s": 1.0,
+          "period_s": 0.25,          # ha_tick cadence (renew + probes)
+          "steal_hysteresis": 2,
+          "max_clock_skew_s": 0.0,
+          "backoff_s": 0.0
+        },
+        "degraded_budget_s": 0.0,    # >0: a DegradedMonitor per side
+                                     # (docs/ha.md "Degraded mode") —
+                                     # recovery/batch/autoscale cycles
+                                     # skip while the active is
+                                     # degraded, transitions journaled
+        "promotion_bound": 0         # >0: settle asserts total
+                                     # promotions <= this (violation
+                                     # otherwise) — the promotion-storm
+                                     # certification
       },
       "resync_every_s": 5.0,
       "sample_every_s": 1.0,
@@ -268,7 +328,8 @@ def normalize_scenario(raw: dict) -> dict:
     f = dict(raw.get("faults") or {})
     for key in ("node_flap", "bind_failure", "drop_event", "dup_event",
                 "metric_sync", "agent_restart", "overload", "api_brownout",
-                "scheduler_crash"):
+                "scheduler_crash", "network_partition", "clock_skew",
+                "lease_thrash", "gray_degradation"):
         f.setdefault(key, {})
     for key in ("bind_failure", "drop_event", "dup_event"):
         prob = float(f[key].get("prob", 0.0))
@@ -281,6 +342,41 @@ def normalize_scenario(raw: dict) -> dict:
         float(f["api_brownout"].get("duration_s", 0) or 0) >= 0,
         "faults.api_brownout.duration_s must be >= 0",
     )
+    windows = f["network_partition"].get("windows") or []
+    _require(isinstance(windows, list), "network_partition.windows")
+    last_end = -1.0
+    for win in windows:
+        _require(
+            isinstance(win, dict)
+            and float(win.get("duration_s", 0)) > 0
+            and float(win.get("at_s", -1)) >= 0
+            and win.get("scope", "api") in ("api", "stream", "full"),
+            "network_partition windows need at_s >= 0, duration_s > 0, "
+            "scope in api|stream|full",
+        )
+        _require(
+            float(win["at_s"]) >= last_end,
+            "network_partition windows must be sorted and non-overlapping",
+        )
+        last_end = float(win["at_s"]) + float(win["duration_s"])
+    for key in ("lease_thrash", "gray_degradation"):
+        prob = float(f[key].get("fail_prob", 0.5))
+        _require(0.0 <= prob <= 1.0,
+                 f"faults.{key}.fail_prob must be in [0, 1]")
+        duration = float(f[key].get("duration_s", 0) or 0)
+        _require(duration >= 0, f"faults.{key}.duration_s must be >= 0")
+        # windows toggle one shared flag, so an overlap would let the
+        # FIRST window's end event silently disarm the second — same
+        # rule network_partition validates
+        starts = sorted(float(t) for t in f[key].get("at_s", []))
+        _require(
+            all(
+                b - a >= duration
+                for a, b in zip(starts, starts[1:])
+            ),
+            f"faults.{key}.at_s windows must not overlap "
+            "(spacing >= duration_s)",
+        )
     shards = raw.get("shards", 1)
     _require(
         shards in (1, "auto"),
@@ -417,18 +513,75 @@ def normalize_scenario(raw: dict) -> dict:
             )
 
     ha_raw = dict(raw.get("ha") or {})
+    lease_raw = dict(ha_raw.get("lease") or {})
     ha = {
         "enabled": bool(ha_raw.get("enabled", False)),
         "lag_events": int(ha_raw.get("lag_events", 8)),
+        "lease": {
+            "enabled": bool(lease_raw.get("enabled", False)),
+            "ttl_s": float(lease_raw.get("ttl_s", 1.0)),
+            "period_s": float(lease_raw.get("period_s", 0.25)),
+            "steal_hysteresis": int(lease_raw.get("steal_hysteresis", 2)),
+            "max_clock_skew_s": float(
+                lease_raw.get("max_clock_skew_s", 0.0)
+            ),
+            "backoff_s": float(lease_raw.get("backoff_s", 0.0)),
+        },
+        "degraded_budget_s": float(ha_raw.get("degraded_budget_s", 0.0)),
+        "promotion_bound": int(ha_raw.get("promotion_bound", 0)),
     }
     _require(
         ha["lag_events"] >= 0,
         "ha.lag_events must be >= 0",
     )
+    lease = ha["lease"]
+    if lease["enabled"]:
+        _require(ha["enabled"], "ha.lease requires ha.enabled")
+        _require(
+            lease["ttl_s"] > 0 and lease["period_s"] > 0,
+            "ha.lease.ttl_s and period_s must be > 0",
+        )
+        _require(
+            0.0 <= lease["max_clock_skew_s"] < lease["ttl_s"],
+            "ha.lease.max_clock_skew_s must be in [0, ttl)",
+        )
+        _require(
+            lease["steal_hysteresis"] >= 1 and lease["backoff_s"] >= 0,
+            "ha.lease.steal_hysteresis must be >= 1, backoff_s >= 0",
+        )
+    _require(
+        ha["degraded_budget_s"] >= 0 and ha["promotion_bound"] >= 0,
+        "ha.degraded_budget_s and ha.promotion_bound must be >= 0",
+    )
     _require(
         not f["scheduler_crash"].get("at_s") or ha["enabled"],
         "faults.scheduler_crash requires ha.enabled (there is no "
         "standby to promote otherwise)",
+    )
+    _require(
+        not f["scheduler_crash"].get("at_s") or not lease["enabled"],
+        "faults.scheduler_crash and ha.lease are mutually exclusive: "
+        "the crash fault's adopt-and-rebuild promotion path assumes "
+        "the sim owns leadership, the lease mode arbitrates it",
+    )
+    for key in ("network_partition", "clock_skew", "lease_thrash"):
+        spec = f[key]
+        armed = bool(
+            spec.get("windows") or spec.get("at_s")
+            or any(
+                float(spec.get(k2, 0) or 0) != 0.0
+                for k2 in ("active_offset_s", "standby_offset_s",
+                           "active_drift_ppm", "standby_drift_ppm")
+            )
+        )
+        _require(
+            not armed or lease["enabled"],
+            f"faults.{key} requires ha.lease.enabled (leadership must "
+            "be lease-arbitrated for a non-fail-stop fault to contest)",
+        )
+    _require(
+        not f["gray_degradation"].get("at_s") or ha["enabled"],
+        "faults.gray_degradation requires ha.enabled",
     )
 
     rec = dict(raw.get("recovery") or {})

@@ -159,6 +159,14 @@ ANNOTATION_EXPECTED_RUNTIME = "tpu.io/expected-runtime-s"
 #: scale-down drains them under a deadline lease instead of deleting.
 ANNOTATION_SERVING_REPLICA = "tpu.io/serving-replica"
 
+#: The leader-lease epoch (monotonic int as string) of the scheduler
+#: replica that wrote this pod's placement (docs/ha.md "Split brain and
+#: fencing"). Stamped by the resilient client on every pod mutation when
+#: an EpochFence is attached; the assume-TTL sweeper strips
+#: assumed-never-bound pods whose stamped epoch predates the current
+#: leader's without waiting out the TTL.
+ANNOTATION_EPOCH = "tpu.io/epoch"
+
 # --------------------------------------------------------------------------
 # Placement-policy names (CLI flag values).
 # Reference: PriorityBinPack/PrioritySpread (pkg/types/types.go:18-21);

@@ -158,16 +158,32 @@ def expected_runtime_s(pod: Pod) -> float | None:
     return val if math.isfinite(val) and val > 0 else None
 
 
+def epoch_of(pod: Pod) -> int:
+    """The leader-lease epoch stamped on the pod's placement
+    (``tpu.io/epoch``), or 0 when absent/malformed — pre-fencing pods
+    and single-replica deployments read as epoch 0, which is never
+    "stale" (the sweeper's stale-epoch heal compares strictly)."""
+    raw = pod.annotations.get(types.ANNOTATION_EPOCH)
+    if raw is None:
+        return 0
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return 0
+
+
 def strip_placement(pod: Pod, clear_node: bool = False) -> Pod:
     """Deep-copied pod with every placement mark removed: the assume
-    annotation AND label, the bound-by policy, and each container's chip
-    annotation — exactly what the assume-TTL sweeper strips, shared here
-    so preemption (which additionally clears ``spec.nodeName``, the
-    requeue half of preempt-and-requeue) can never drift from it."""
+    annotation AND label, the bound-by policy, the writer-epoch stamp,
+    and each container's chip annotation — exactly what the assume-TTL
+    sweeper strips, shared here so preemption (which additionally clears
+    ``spec.nodeName``, the requeue half of preempt-and-requeue) can
+    never drift from it."""
     out = pod.deepcopy()
     ann = out.ensure_annotations()
     ann.pop(types.ANNOTATION_ASSUME, None)
     ann.pop(types.ANNOTATION_BOUND_POLICY, None)
+    ann.pop(types.ANNOTATION_EPOCH, None)
     for c in out.containers:
         ann.pop(types.ANNOTATION_CONTAINER_FMT.format(name=c.name), None)
     out.ensure_labels().pop(types.ANNOTATION_ASSUME, None)

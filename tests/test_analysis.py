@@ -1619,3 +1619,147 @@ class TestWitness:
         if not witness.active():
             pytest.skip("witness disabled in this environment")
         witness.global_witness().assert_acyclic()
+
+
+# ---------------------------------------------------------------------------
+# split-brain containment modules (docs/ha.md): seeded fixtures for the
+# fencing/degraded scopes (ISSUE r15 satellite)
+# ---------------------------------------------------------------------------
+class TestSplitBrainScopes:
+    def test_degraded_gauge_produced_but_undeclared(self, tmp_path):
+        report = lint(tmp_path, {
+            "degraded.py": """
+                _DEGRADED_GAUGES = {"active": "1 while degraded"}
+
+                class DegradedMonitor:
+                    def degraded_gauge_values(self):
+                        return {"active": 0.0, "ghost_degraded_gauge": 1}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("ghost_degraded_gauge" in m and "not declared" in m
+                   for m in msgs), msgs
+
+    def test_degraded_gauge_declared_but_never_produced(self, tmp_path):
+        report = lint(tmp_path, {
+            "degraded.py": """
+                _DEGRADED_GAUGES = {
+                    "active": "1 while degraded",
+                    "dead_degraded_gauge": "declared, never produced",
+                }
+
+                class DegradedMonitor:
+                    def degraded_gauge_values(self):
+                        return {"active": 0.0}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("dead_degraded_gauge" in m and "KeyError" in m
+                   for m in msgs), msgs
+
+    def test_degraded_and_ha_families_do_not_cross_pollinate(self, tmp_path):
+        # the fence gauges live in _HA_GAUGES, the degraded gauges in
+        # _DEGRADED_GAUGES — a producer key in ONE family must not
+        # satisfy a declaration in the other
+        report = lint(tmp_path, {
+            "ha.py": """
+                _HA_GAUGES = {"fence_epoch": "armed term"}
+
+                class HACoordinator:
+                    def ha_gauge_values(self):
+                        return {"fence_epoch": 1}
+                """,
+            "degraded.py": """
+                _DEGRADED_GAUGES = {"fence_epoch": "wrong family"}
+
+                class DegradedMonitor:
+                    def degraded_gauge_values(self):
+                        return {"active": 0.0}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("fence_epoch" in m and "KeyError" in m for m in msgs), \
+            msgs
+        assert any("'active'" in m for m in msgs), msgs
+
+    def test_blocking_call_under_fence_lock_is_a_finding(self, tmp_path):
+        # the fence check sits on EVERY apiserver write: a blocking call
+        # under its lock would stall the whole write path at once
+        report = one(tmp_path, """
+            import time
+
+            from nanotpu.analysis.witness import make_lock
+
+            class EpochFence:
+                def __init__(self):
+                    self._lock = make_lock("EpochFence._lock")
+
+                def check(self, client):
+                    with self._lock:
+                        time.sleep(0.1)
+            """, "lock-discipline")
+        assert any("time.sleep" in f.message for f in report.findings), \
+            report.findings
+
+    def test_monitor_dealer_lock_inversion_is_a_finding(self, tmp_path):
+        # seeded inversion: the degraded monitor's lock vs the dealer's
+        # — production never nests them (note_* runs in the client
+        # wrapper, outside every dealer critical section)
+        report = one(tmp_path, """
+            from nanotpu.analysis.witness import make_lock
+
+            class DegradedMonitor:
+                def __init__(self):
+                    self._lock = make_lock("DegradedMonitor._lock")
+
+            class Dealer:
+                def __init__(self):
+                    self._lock = make_lock("Dealer._lock")
+
+            class Tangle:
+                def one(self, m: DegradedMonitor, dealer: Dealer):
+                    with m._lock:
+                        with dealer._lock:
+                            pass
+
+                def two(self, m: DegradedMonitor, dealer: Dealer):
+                    with dealer._lock:
+                        with m._lock:
+                            pass
+            """, "lock-discipline")
+        assert any("cycle" in f.message for f in report.findings), \
+            report.findings
+
+    def test_wall_clock_in_fence_module_is_a_finding(self, tmp_path):
+        # the sim drives lease/fence/degraded on virtual time: an
+        # ambient time.time() CALL in their bodies would desync the two
+        # sides' clocks from the injected ones
+        report = one(tmp_path, """
+            import time
+
+            class EpochFence:
+                def valid(self):
+                    return time.time() < self._valid_until
+            """, "sim-determinism")
+        assert any("time.time" in f.message for f in report.findings), \
+            report.findings
+
+    def test_injected_clock_idiom_stays_clean(self, tmp_path):
+        report = one(tmp_path, """
+            class DegradedMonitor:
+                def __init__(self, clock):
+                    self.clock = clock
+
+                def note_failure(self, target):
+                    now = self.clock()
+                    return now
+            """, "sim-determinism")
+        assert report.findings == []
+
+    def test_production_scope_covers_the_new_modules(self):
+        from nanotpu.analysis.passes.determinism import SCOPE as DET_SCOPE
+        from nanotpu.analysis.passes.locks import SCOPE as LOCK_SCOPE
+
+        assert "nanotpu.ha" in DET_SCOPE
+        assert "nanotpu.metrics.degraded" in DET_SCOPE
+        assert "nanotpu.ha" in LOCK_SCOPE

@@ -811,3 +811,992 @@ class TestStandbyController:
         assert sc._queue.unfinished_tasks == 0
         assert sc._known("default/q1") is not None
         dealer.close()
+
+
+# -- split-brain containment (docs/ha.md "Split brain and fencing") --------
+
+class TestEpochFence:
+    def test_arm_extend_suspend_check(self):
+        from nanotpu.ha.fence import EpochFence
+        from nanotpu.k8s.resilience import FencedError
+
+        now = [0.0]
+        f = EpochFence(clock=lambda: now[0])
+        with pytest.raises(FencedError):
+            f.check("bind")  # never armed: no right to write
+        f.arm(1, valid_until=2.0)
+        f.check("bind")  # valid: silent
+        now[0] = 1.9
+        f.check("bind")
+        f.extend(4.0)
+        now[0] = 3.0
+        f.check("bind")
+        now[0] = 4.0  # validity boundary is EXCLUSIVE
+        with pytest.raises(FencedError):
+            f.check("bind")
+        f.arm(2, valid_until=6.0)
+        f.check("bind")
+        f.suspend()
+        with pytest.raises(FencedError):
+            f.check("bind")
+        assert f.epoch == 2 and f.terms == 2 and f.rejections == 3
+        st = f.status(now=5.0)
+        assert st["valid"] is False and st["epoch"] == 2
+
+    def test_resilient_client_gates_writes_and_stamps_epoch(self):
+        from nanotpu.ha.fence import EpochFence
+        from nanotpu.k8s.resilience import FencedError, ResilientClientset
+
+        now = [0.0]
+        client = make_mock_cluster(2)
+        rc = ResilientClientset(client, clock=lambda: now[0],
+                                sleep=lambda s: None)
+        fence = EpochFence(clock=lambda: now[0])
+        rc.fence = fence
+        pod = client.create_pod(tpu_pod("fence-p1"))
+        fence.arm(3, valid_until=10.0)
+        # placement-bearing writes (assume annotation present) carry
+        # the writer's epoch; strips (assume removed) must NOT be
+        # re-stamped on their way out (docs/ha.md)
+        pod.ensure_annotations()[types.ANNOTATION_ASSUME] = "true"
+        updated = rc.update_pod(pod)
+        assert updated.annotations[types.ANNOTATION_EPOCH] == "3"
+        from nanotpu.utils import pod as podutil
+
+        stripped = rc.update_pod(podutil.strip_placement(updated))
+        assert types.ANNOTATION_EPOCH not in stripped.annotations
+        now[0] = 11.0  # term expired without a renew: fence closes
+        with pytest.raises(FencedError):
+            rc.update_pod(updated)
+        with pytest.raises(FencedError):
+            rc.bind_pod("default", "fence-p1", "anything")
+        with pytest.raises(FencedError):
+            rc.create_pod(tpu_pod("fence-p2"))
+        with pytest.raises(FencedError):
+            rc.delete_pod("default", "fence-p1")
+        assert fence.rejections == 4
+        # events stay fail-open and unfenced (best-effort objects)
+        rc.create_event("default", {"reason": "x"})
+
+    def test_fenced_bind_rolls_back_like_a_breaker_fastfail(self):
+        from nanotpu.ha.fence import EpochFence
+        from nanotpu.k8s.resilience import ResilientClientset
+        from nanotpu.dealer.dealer import BindError
+        from nanotpu.obs.decisions import REASON_FENCED
+
+        now = [0.0]
+        client = make_mock_cluster(2)
+        rc = ResilientClientset(client, clock=lambda: now[0],
+                                sleep=lambda s: None)
+        fence = EpochFence(clock=lambda: now[0])
+        rc.fence = fence
+        fence.arm(1, valid_until=5.0)
+        dealer = Dealer(rc, make_rater("binpack"))
+        pod = client.create_pod(tpu_pod("fence-bind"))
+        ok, _ = dealer.assume(dealer.node_names(), pod)
+        now[0] = 6.0  # deposed mid-flight: the in-flight bind must die
+        with pytest.raises(BindError) as exc:
+            dealer.bind(ok[0], pod)
+        assert exc.value.reason == REASON_FENCED
+        assert dealer.occupancy() == 0.0  # chips rolled back
+        assert not dealer.tracks(pod.uid)
+        dealer.close()
+
+    def test_gauges_cover_fence_and_suspects(self):
+        from nanotpu.ha.fence import EpochFence
+        from nanotpu.metrics.ha import _HA_GAUGES
+
+        fence = EpochFence(clock=lambda: 0.0)
+        co = HACoordinator(object(), role="standby", fence=fence)
+        values = co.ha_gauge_values(now=0.0)
+        assert set(values) == set(_HA_GAUGES)
+        assert values["fence_epoch"] == 0
+        assert values["fence_valid"] == 0.0
+
+
+class TestLeaseHardening:
+    def test_epoch_monotonic_across_steal_and_handoff(self):
+        client = FakeClientset()
+        a = LeaderLease(client, "a", ttl_s=2.0)
+        b = LeaderLease(client, "b", ttl_s=2.0)
+        assert a.try_acquire(now=0.0) and a.epoch == 1
+        assert a.renew(now=1.0) and a.epoch == 1  # renew never bumps
+        assert b.try_acquire(now=5.0) and b.epoch == 2  # steal bumps
+        assert b.release(now=6.0)
+        assert a.try_acquire(now=6.1) and a.epoch == 3  # handoff bumps
+
+    def test_steal_hysteresis_needs_consecutive_observations(self):
+        client = FakeClientset()
+        a = LeaderLease(client, "a", ttl_s=1.0)
+        b = LeaderLease(client, "b", ttl_s=1.0, steal_hysteresis=3)
+        assert a.try_acquire(now=0.0)
+        # expired, but one observation is not a dead leader
+        assert not b.try_acquire(now=5.0)
+        assert not b.try_acquire(now=5.1)
+        # a live renew in between RESETS the streak
+        assert a.renew(now=5.2)
+        assert not b.try_acquire(now=7.0)
+        assert not b.try_acquire(now=7.1)
+        assert b.try_acquire(now=7.2)
+        assert b.steals == 1
+
+    def test_failed_acquire_backs_off_jittered(self):
+        import random as _random
+
+        client = FakeClientset()
+        a = LeaderLease(client, "a", ttl_s=10.0)
+        assert a.try_acquire(now=0.0)
+        b = LeaderLease(client, "b", ttl_s=10.0, steal_backoff_s=2.0,
+                        rng=_random.Random(7))
+
+        def fail_update(*args, **kw):
+            from nanotpu.k8s.client import ApiError
+
+            raise ApiError("flap", code=503)
+
+        client.update_lease, orig = fail_update, client.update_lease
+        a2 = LeaderLease(client, "a2", ttl_s=10.0, steal_backoff_s=2.0,
+                         rng=_random.Random(7))
+        # holder expired by 15.0; the steal attempt fails -> cooloff
+        assert not a2.try_acquire(now=15.0)
+        assert a2._cooloff_until > 15.0
+        cool = a2._cooloff_until
+        # inside the cooloff no further attempt is made (streak keeps)
+        assert not a2.try_acquire(now=cool - 0.01)
+        client.update_lease = orig
+        assert a2.try_acquire(now=cool + 0.01)
+
+    def test_skew_margin_leaves_no_overlap_window(self):
+        """The satellite's arithmetic, executed: with both clocks inside
+        the configured skew bound, the holder's fence always closes
+        BEFORE the challenger may steal — at no instant can both sides
+        believe."""
+        from nanotpu.ha.fence import EpochFence
+
+        skew = 0.5
+        client = FakeClientset()
+        now = [0.0]
+        clock_a = lambda: now[0] + skew   # a's clock runs fast
+        clock_b = lambda: now[0] - skew   # b's runs slow (worst case)
+        fence_a = EpochFence(clock=clock_a)
+        a = LeaderLease(client, "a", ttl_s=3.0, clock=clock_a,
+                        max_clock_skew_s=skew, fence=fence_a)
+        b = LeaderLease(client, "b", ttl_s=3.0, clock=clock_b,
+                        max_clock_skew_s=skew)
+        assert a.renew_margin_s == pytest.approx(2.5)
+        assert a.try_acquire(now=clock_a())
+        # sweep virtual time: find the last instant a's fence is open
+        # and the first instant b may steal (hysteresis 1 for the sweep)
+        last_valid = first_steal = None
+        t = 0.0
+        while t < 12.0:
+            now[0] = t
+            if fence_a.valid():
+                last_valid = t
+            if first_steal is None and b.try_acquire(now=clock_b()):
+                first_steal = t
+                break
+            t += 0.05
+        assert last_valid is not None and first_steal is not None
+        assert last_valid < first_steal, (
+            f"fence open at {last_valid} but steal possible at "
+            f"{first_steal}: split-brain overlap"
+        )
+
+    def test_renew_failure_suspends_the_fence(self):
+        from nanotpu.ha.fence import EpochFence
+
+        client = FakeClientset()
+        fence = EpochFence(clock=lambda: 0.0)
+        a = LeaderLease(client, "a", ttl_s=5.0, fence=fence)
+        assert a.try_acquire(now=0.0)
+        assert fence.valid(now=1.0)
+        b = LeaderLease(client, "b", ttl_s=5.0)
+        assert b.try_acquire(now=20.0)  # stole the expired lease
+        assert not a.renew(now=21.0)
+        assert not fence.valid(now=21.0)  # loss closed the fence NOW
+
+
+class TestStaleEpochHeal:
+    def _half_bound(self, client, epoch):
+        """An assumed-never-bound pod stamped by lease term ``epoch`` —
+        the deposed leader's half-bind (annotation PUT landed, the
+        binding POST never did)."""
+        pod = tpu_pod(f"half-{epoch}")
+        ann = pod.ensure_annotations()
+        ann[types.ANNOTATION_ASSUME] = "true"
+        ann[types.ANNOTATION_CONTAINER_FMT.format(name="t")] = "0"
+        ann[types.ANNOTATION_EPOCH] = str(epoch)
+        pod.ensure_labels()[types.ANNOTATION_ASSUME] = "true"
+        return client.create_pod(pod)
+
+    def test_stale_epoch_strips_without_the_ttl_wait(self):
+        client = make_mock_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        c = Controller(client, dealer, resync_period_s=0, assume_ttl_s=60)
+        self._half_bound(client, epoch=1)
+        # current term is 2: the stamped pod is a superseded leader's
+        expired = c.sweep_assumed_once(now=0.0, epoch=2)
+        assert expired == 1 and c.epoch_heals == 1
+        fresh = client.get_pod("default", "half-1")
+        assert types.ANNOTATION_ASSUME not in fresh.annotations
+        assert types.ANNOTATION_EPOCH not in fresh.annotations
+        dealer.close()
+
+    def test_current_and_unstamped_epochs_take_the_ttl_path(self):
+        client = make_mock_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        c = Controller(client, dealer, resync_period_s=0, assume_ttl_s=60)
+        self._half_bound(client, epoch=2)  # CURRENT term: not stale
+        unstamped = tpu_pod("half-plain")
+        ann = unstamped.ensure_annotations()
+        ann[types.ANNOTATION_ASSUME] = "true"
+        ann[types.ANNOTATION_CONTAINER_FMT.format(name="t")] = "0"
+        unstamped.ensure_labels()[types.ANNOTATION_ASSUME] = "true"
+        client.create_pod(unstamped)
+        assert c.sweep_assumed_once(now=0.0, epoch=2) == 0
+        # the TTL path still works for both once it elapses
+        assert c.sweep_assumed_once(now=61.0, epoch=2) == 2
+        dealer.close()
+
+    def test_epoch_of_callable_feeds_the_sweeper(self):
+        from nanotpu.ha.fence import EpochFence
+
+        client = make_mock_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        c = Controller(client, dealer, resync_period_s=0, assume_ttl_s=60)
+        fence = EpochFence(clock=lambda: 0.0)
+        fence.arm(5, valid_until=10.0)
+        c.epoch_of = lambda: fence.epoch
+        self._half_bound(client, epoch=3)
+        assert c.sweep_assumed_once(now=0.0) == 1
+        dealer.close()
+
+
+class TestSuspectDeltas:
+    def test_older_epoch_records_skip_and_keep_dirty(self):
+        client, active, log_, standby, sc, co = make_pair()
+        pod = client.create_pod(tpu_pod("sus-1"))
+        log_.epoch = 2
+        ok, _ = active.assume(active.node_names(), pod)
+        active.bind(ok[0], pod)
+        co.tail_once()
+        assert co.max_epoch == 2 and co.suspect_deltas == 0
+        # a straggler from the superseded term 1 arrives afterwards
+        stale = client.create_pod(tpu_pod("sus-stale"))
+        log_.epoch = 1
+        ok2, _ = active.assume(active.node_names(), stale)
+        active.bind(ok2[0], stale)
+        before = standby.occupancy()
+        co.tail_once()
+        assert co.suspect_deltas >= 1
+        # the suspect record was NOT applied: the standby's accounting
+        # is unchanged, and the pod reconciles against informer truth
+        assert standby.occupancy() == before
+        assert not standby.tracks(stale.uid)
+        active.close()
+        standby.close()
+
+
+class TestStateIntegrity:
+    def _checkpointed(self, tmp_path, n_pods=4):
+        client = make_mock_cluster(4)
+        path = str(tmp_path / "ckpt")
+        log_ = DeltaLog(path=path)
+        dealer = Dealer(client, make_rater("binpack"), ha_log=log_)
+        dealer.write_checkpoint(path)
+        for i in range(n_pods):
+            pod = client.create_pod(tpu_pod(f"ck-{i}"))
+            ok, _ = dealer.assume(dealer.node_names(), pod)
+            dealer.bind(ok[0], pod)
+        log_.flush()
+        return client, dealer, path
+
+    def test_round_trip_with_crc_and_version(self, tmp_path):
+        from nanotpu.ha.delta import (
+            CHECKPOINT_SCHEMA,
+            pop_quarantine_events,
+            verify_record,
+        )
+
+        from nanotpu.ha.delta import _parse_crc_line
+
+        pop_quarantine_events()  # drain other tests' corrupt-file events
+        client, dealer, path = self._checkpointed(tmp_path)
+        with open(path) as fh:
+            head = _parse_crc_line(fh.readline().strip())
+            assert head is not None and head["v"] == CHECKPOINT_SCHEMA
+            for line in fh:
+                rec = _parse_crc_line(line.strip())
+                assert rec is not None
+                # the wire-side integrity stamp rides inside the record
+                assert verify_record(rec)
+        state, records = load_checkpoint(path)
+        assert state is not None and len(records) >= 4
+        assert pop_quarantine_events() == []
+        restored = Dealer(client, make_rater("binpack"), restore_from=path)
+        equal_state(dealer, restored)
+        dealer.close()
+        restored.close()
+
+    def test_torn_final_line_truncates_and_quarantines(self, tmp_path):
+        import os
+
+        from nanotpu.ha.delta import pop_quarantine_events
+
+        pop_quarantine_events()  # isolation: drain other tests' events
+        client, dealer, path = self._checkpointed(tmp_path)
+        with open(path, "a") as fh:
+            fh.write('{"seq": 99, "kind": "bound", "da')  # torn write
+        state, records = load_checkpoint(path)
+        assert state is not None and len(records) >= 4
+        assert not os.path.exists(path)  # quarantined aside
+        assert os.path.exists(path + ".corrupt")
+        events = pop_quarantine_events()
+        assert len(events) == 1 and "torn" in events[0]["reason"] or \
+            "corrupt" in events[0]["reason"]
+        # deterministic second load: the quarantined path now reads as
+        # a clean first boot (full replay), not a crash
+        assert load_checkpoint(path) == (None, [])
+        dealer.close()
+
+    def test_midfile_bit_flip_truncates_to_last_good_record(self, tmp_path):
+        import os
+
+        from nanotpu.ha.delta import pop_quarantine_events
+
+        client, dealer, path = self._checkpointed(tmp_path)
+        lines = open(path).read().splitlines()
+        assert len(lines) >= 5  # head + >=4 records
+        flipped = list(lines)
+        # flip one byte INSIDE a middle record's payload while keeping
+        # it valid JSON — only the line CRC catches it (the nastier
+        # corruption); the stale prefix is the tell
+        mid = 2
+        prefix, _, payload = flipped[mid].partition(" ")
+        rec = json.loads(payload)
+        rec["data"]["pod"]["metadata"]["name"] = "tampered"
+        flipped[mid] = prefix + " " + json.dumps(
+            rec, sort_keys=True, separators=(",", ":")
+        )
+        with open(path, "w") as fh:
+            fh.write("\n".join(flipped) + "\n")
+        state, records = load_checkpoint(path)
+        assert state is not None
+        assert len(records) == mid - 1  # truncated AT the flip
+        assert os.path.exists(path + ".corrupt")
+        assert pop_quarantine_events()
+        # the restore path survives it: prefix + annotation resync
+        restored = Dealer(client, make_rater("binpack"), restore_from=path)
+        restored.close()
+        dealer.close()
+
+    def test_schema_version_bump_falls_back_loudly(self, tmp_path):
+        import os
+
+        from nanotpu.ha.delta import _crc_line, pop_quarantine_events
+
+        client, dealer, path = self._checkpointed(tmp_path)
+        lines = open(path).read().splitlines()
+        head = json.loads(lines[0].partition(" ")[2])
+        head["v"] = 99
+        # a VALID crc over the bumped header: this must read as version
+        # skew (loud resync, file kept), never as corruption
+        lines[0] = _crc_line(
+            json.dumps(head, sort_keys=True, separators=(",", ":"))
+        )
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        assert load_checkpoint(path) == (None, [])
+        # honest incompatibility, not corruption: NO quarantine — the
+        # file stays for the old binary that can read it
+        assert os.path.exists(path)
+        assert pop_quarantine_events() == []
+        dealer.close()
+
+    def test_empty_file_recovers_deterministically(self, tmp_path):
+        import os
+
+        from nanotpu.ha.delta import pop_quarantine_events
+
+        path = str(tmp_path / "ckpt")
+        open(path, "w").close()
+        assert load_checkpoint(path) == (None, [])
+        assert load_checkpoint(path) == (None, [])
+        assert os.path.exists(path)
+        assert pop_quarantine_events() == []
+
+    def test_http_source_drops_windows_failing_crc(self, monkeypatch):
+        import io
+        import urllib.request
+
+        from nanotpu.ha.delta import record_crc
+        from nanotpu.ha.standby import HttpDeltaSource
+
+        good = {"seq": 1, "t": 0.0, "kind": "bound", "epoch": 0,
+                "data": {}}
+        good["crc"] = record_crc(good)
+        bad = dict(good, seq=2)
+        bad["crc"] = 12345  # wrong on purpose
+        body = json.dumps({
+            "log": {"seq": 2}, "records": [good, bad],
+        }).encode()
+
+        class _Resp(io.BytesIO):
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        monkeypatch.setattr(
+            urllib.request, "urlopen",
+            lambda url, timeout=None: _Resp(body),
+        )
+        src = HttpDeltaSource("http://127.0.0.1:1")
+        src.poll(0)
+        assert src.crc_failures == 1
+        assert src.since(0) == []  # the whole window was discarded
+        # a clean window flows through
+        body2 = json.dumps({
+            "log": {"seq": 1}, "records": [good],
+        }).encode()
+        monkeypatch.setattr(
+            urllib.request, "urlopen",
+            lambda url, timeout=None: _Resp(body2),
+        )
+        src.poll(0)
+        assert [r["seq"] for r in src.since(0)] == [1]
+
+
+class TestVerifyState:
+    def test_match_and_mismatch_with_bounded_diff(self):
+        from nanotpu.ha.verify import verify_state
+
+        client = make_mock_cluster(4)
+        dealer = Dealer(client, make_rater("binpack"))
+        for i in range(3):
+            pod = client.create_pod(tpu_pod(f"v-{i}"))
+            ok, _ = dealer.assume(dealer.node_names(), pod)
+            dealer.bind(ok[0], pod)
+        out = verify_state(dealer, client.list_pods())
+        assert out["match"] and out["pods_truth"] == 3
+        # delete one pod behind the dealer's back: truth moves, the
+        # dealer does not — the diff names the divergent uid
+        victim = client.get_pod("default", "v-0")
+        client.delete_pod("default", "v-0")
+        out = verify_state(dealer, client.list_pods())
+        assert not out["match"]
+        assert victim.uid in out["diff"]["not_in_truth"]
+        dealer.close()
+
+    def test_debug_verify_route(self):
+        from nanotpu.ha.verify import verify_state
+
+        client = make_mock_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        api = SchedulerAPI(dealer, Registry())
+        code, _, body = api.dispatch("GET", "/debug/verify", b"")
+        assert code == 404  # no verifier wired
+        api.verify_state = lambda: verify_state(
+            dealer, client.list_pods()
+        )
+        code, _, body = api.dispatch("GET", "/debug/verify", b"")
+        assert code == 200
+        out = json.loads(body)
+        assert out["match"] is True
+        dealer.close()
+
+    def test_promotion_runs_verify_when_client_attached(self):
+        client, active, log_, standby, sc, co = make_pair()
+        co.client = client
+        pod = client.create_pod(tpu_pod("pv-1"))
+        ok, _ = active.assume(active.node_names(), pod)
+        active.bind(ok[0], pod)
+        for watch in (sc,):
+            pass
+        # feed the standby's informer + stream, then promote
+        co.tail_once()
+        result = co.promote(now=1.0)
+        assert result["promoted"]
+        assert "verify" in result and result["verify"]["match"]
+        assert co.last_verify is not None
+        active.close()
+        standby.close()
+
+
+class TestDegradedMode:
+    def _monitor(self, budget=2.0):
+        from nanotpu.ha.degraded import DegradedMonitor
+
+        now = [0.0]
+        transitions = []
+        m = DegradedMonitor(
+            budget_s=budget, clock=lambda: now[0],
+            on_enter=lambda: transitions.append("enter"),
+            on_exit=lambda: transitions.append("exit"),
+        )
+        return now, transitions, m
+
+    def test_latches_after_budget_and_exits_on_success(self):
+        now, transitions, m = self._monitor()
+        m.note_failure("bind")
+        assert not m.active
+        now[0] = 1.9
+        m.note_failure("pod_write")
+        assert not m.active  # still inside budget
+        now[0] = 2.0
+        m.note_failure("bind")
+        assert m.active and transitions == ["enter"]
+        now[0] = 3.0
+        m.note_failure("bind")
+        assert m.failures_in_mode == 1
+        m.note_success("pod_write")
+        assert not m.active and transitions == ["enter", "exit"]
+        vals = m.degraded_gauge_values(now=3.0)
+        assert vals["entries"] == 1 and vals["exits"] == 1
+        assert vals["total_seconds"] == pytest.approx(1.0)
+
+    def test_success_resets_the_failure_run(self):
+        now, _, m = self._monitor()
+        m.note_failure("bind")
+        now[0] = 1.5
+        m.note_success("bind")
+        now[0] = 3.0
+        m.note_failure("bind")  # fresh run starts HERE
+        assert not m.active
+        now[0] = 4.9
+        m.note_failure("bind")
+        assert not m.active
+        now[0] = 5.0
+        m.note_failure("bind")
+        assert m.active
+
+    def test_resilient_client_feeds_failures_and_breaker_fastfails(self):
+        from nanotpu.k8s.client import ApiError
+        from nanotpu.k8s.resilience import ResilientClientset
+
+        class _DeadInner:
+            def update_pod(self, pod):
+                raise ApiError("down", code=503)
+
+        now = [0.0]
+        _, _, m = self._monitor(budget=1.0)
+        m.clock = lambda: now[0]
+        rc = ResilientClientset(
+            _DeadInner(), clock=lambda: now[0], sleep=lambda s: None,
+            max_attempts=1,
+        )
+        rc.degraded = m
+        for i in range(8):
+            now[0] = i * 0.4
+            with pytest.raises(ApiError):
+                rc.update_pod(object())
+        # the breaker opened along the way; its fast-fails kept feeding
+        # the budget clock instead of masking the outage
+        assert m.active
+
+    def test_events_do_not_touch_the_monitor(self):
+        from nanotpu.k8s.resilience import ResilientClientset
+
+        class _EventsOnly:
+            def create_event(self, ns, ev):
+                return None
+
+        now, _, m = self._monitor(budget=1.0)
+        rc = ResilientClientset(
+            _EventsOnly(), clock=lambda: now[0], sleep=lambda s: None,
+        )
+        rc.degraded = m
+        m.note_failure("bind")
+        now[0] = 0.9
+        rc.create_event("default", {})  # an event success must NOT
+        now[0] = 1.0                    # reset the fail-closed run
+        m.note_failure("bind")
+        assert m.active
+
+    def test_isolated_blips_across_idle_gaps_do_not_sum(self):
+        # "continuous" means back-to-back failure within the budget: a
+        # blip, a long quiet gap with no writes at all, and another
+        # blip prove nothing about the link
+        now, _, m = self._monitor(budget=1.0)
+        m.note_failure("bind")
+        now[0] = 600.0  # ten quiet minutes, zero writes attempted
+        m.note_failure("bind")
+        assert not m.active
+        now[0] = 601.0  # but a real run from the SECOND blip latches
+        m.note_failure("bind")
+        assert m.active
+
+    def test_routes_shed_binds_503_degraded(self):
+        from nanotpu.ha.degraded import DegradedMonitor
+
+        client = make_mock_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        api = SchedulerAPI(dealer, Registry())
+        m = DegradedMonitor(budget_s=1.0, clock=lambda: 0.0)
+        api.attach_degraded(m)
+        pod = client.create_pod(tpu_pod("dg-1"))
+        body = json.dumps({
+            "PodName": "dg-1", "PodNamespace": "default",
+            "PodUID": pod.uid, "Node": dealer.node_names()[0],
+        }).encode()
+        m.active = True
+        code, _, payload = api.dispatch(
+            "POST", "/scheduler/bind", body
+        )
+        assert code == 503
+        out = json.loads(payload)
+        assert out["Reason"] == "Degraded"
+        assert out["RetryAfterSeconds"] >= 1
+        assert m.binds_rejected == 1
+        # reads keep answering from the snapshots
+        fargs = json.dumps({
+            "Pod": pod.raw, "NodeNames": dealer.node_names(),
+        }).encode()
+        code, _, _ = api.dispatch("POST", "/scheduler/filter", fargs)
+        assert code == 200
+        # batchadmit takes the same gate (when an admitter exists)
+        from nanotpu.dealer.admit import BatchAdmitter
+
+        dealer.batch = BatchAdmitter(dealer)
+        code, _, payload = api.dispatch(
+            "POST", "/scheduler/batchadmit", b"{}"
+        )
+        assert code == 503 and "Degraded" in payload
+        m.active = False
+        code, _, _ = api.dispatch("POST", "/scheduler/bind", body)
+        assert code == 200
+        # /metrics exports the family
+        _, _, metrics = api.dispatch("GET", "/metrics", b"")
+        assert "nanotpu_degraded_active" in metrics
+        dealer.close()
+
+    def test_write_loop_gates_pause_cycles(self):
+        from nanotpu.dealer.admit import BatchAdmitter, BatchLoop
+        from nanotpu.ha.degraded import DegradedMonitor
+
+        m = DegradedMonitor(budget_s=1.0, clock=lambda: 0.0)
+        ran = []
+
+        class _Admitter:
+            def run_once(self):
+                ran.append(1)
+
+        loop = BatchLoop(_Admitter(), period_s=0.01,
+                         gate=m.allow_writes)
+        m.active = True
+        loop.start()
+        time.sleep(0.08)
+        assert ran == []  # degraded: cycles skipped, thread alive
+        m.active = False
+        time.sleep(0.08)
+        loop.stop()
+        assert ran  # resumed on heal without a restart
+
+    def test_gauge_table_matches_producer_keys(self):
+        from nanotpu.ha.degraded import DegradedMonitor
+        from nanotpu.metrics.degraded import _DEGRADED_GAUGES
+
+        m = DegradedMonitor(budget_s=1.0, clock=lambda: 0.0)
+        assert set(m.degraded_gauge_values(now=0.0)) == set(
+            _DEGRADED_GAUGES
+        )
+
+    def test_timeline_tick_gains_degraded_section_only_when_attached(self):
+        from nanotpu.ha.degraded import DegradedMonitor
+        from nanotpu.obs.timeline import Timeline
+
+        client = make_mock_cluster(2)
+        dealer = Dealer(client, make_rater("binpack"))
+        tl = Timeline(dealer=dealer, clock=lambda: 0.0)
+        tick = tl.tick()
+        assert "degraded" not in tick
+        tl.degraded = DegradedMonitor(budget_s=1.0, clock=lambda: 0.0)
+        tick = tl.tick()
+        assert tick["degraded"]["active"] == 0.0
+        dealer.close()
+
+
+@pytest.mark.fullstack
+class TestLiveSplitBrainDrive:
+    """The acceptance drill (docs/ha.md 'Split brain and fencing'),
+    LIVE over HTTP: two replica stacks with real servers share one
+    cluster; the leader is deposed by a lease steal while it still
+    believes, its in-flight bind dies on the epoch fence (typed
+    rejection + rollback over the wire), the new leader's heal sweep
+    clears the deposed term's stale-epoch half-bind, and the pod then
+    binds exactly once through the new leader."""
+
+    def test_deposed_leader_is_fenced_and_healed(self):
+        from http.client import HTTPConnection
+
+        from nanotpu.ha.fence import EpochFence
+        from nanotpu.ha.standby import HttpDeltaSource
+        from nanotpu.k8s.client import ApiError
+        from nanotpu.k8s.resilience import ResilientClientset
+        from nanotpu.routes.server import serve
+
+        client = make_mock_cluster(4)
+        now = [0.0]
+        ttl, skew = 1.0, 0.1
+
+        def _post(port, path, obj):
+            conn = HTTPConnection("127.0.0.1", port, timeout=10)
+            body = json.dumps(obj).encode()
+            conn.request("POST", path, body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            conn.close()
+            return resp.status, out
+
+        # replica A: the initial leader
+        fence_a = EpochFence(clock=lambda: now[0])
+        rc_a = ResilientClientset(
+            client, clock=lambda: now[0], sleep=lambda s: None,
+            max_attempts=1,
+        )
+        rc_a.fence = fence_a
+        lease_a = LeaderLease(
+            client, "rep-a", ttl_s=ttl, clock=lambda: now[0],
+            max_clock_skew_s=skew, fence=fence_a,
+        )
+        assert lease_a.try_acquire()
+        log_a = DeltaLog()
+        log_a.epoch = lease_a.epoch
+        dealer_a = Dealer(rc_a, make_rater("binpack"), ha_log=log_a)
+        co_a = HACoordinator(
+            dealer_a, role="active", log_=log_a, lease=lease_a,
+            fence=fence_a, client=client,
+        )
+        api_a = SchedulerAPI(dealer_a, Registry())
+        api_a.attach_ha(co_a)
+        srv_a = serve(api_a, 0, host="127.0.0.1")
+        api_a.stop_idle_gc()
+        port_a = srv_a.server_address[1]
+
+        # replica B: warm standby tailing A over HTTP
+        fence_b = EpochFence(clock=lambda: now[0])
+        rc_b = ResilientClientset(
+            client, clock=lambda: now[0], sleep=lambda s: None,
+            max_attempts=1,
+        )
+        rc_b.fence = fence_b
+        lease_b = LeaderLease(
+            client, "rep-b", ttl_s=ttl, clock=lambda: now[0],
+            max_clock_skew_s=skew, steal_hysteresis=2, fence=fence_b,
+        )
+        dealer_b = Dealer(client, make_rater("binpack"))
+        dealer_b.client = rc_b
+        sc_b = Controller(client, dealer_b, resync_period_s=0,
+                          assume_ttl_s=60)
+        sc_b.enter_standby()
+        sc_b.resync_once()
+        sc_b.epoch_of = lambda: fence_b.epoch
+        co_b = HACoordinator(
+            dealer_b, role="standby",
+            source=HttpDeltaSource(f"http://127.0.0.1:{port_a}"),
+            controller=sc_b, lease=lease_b, fence=fence_b,
+            client=client,
+        )
+        api_b = SchedulerAPI(dealer_b, Registry())
+        api_b.attach_ha(co_b)
+        srv_b = serve(api_b, 0, host="127.0.0.1")
+        api_b.stop_idle_gc()
+        port_b = srv_b.server_address[1]
+
+        try:
+            nodes = dealer_a.node_names()
+            # 1) a normal bind through the leader carries its epoch
+            p1 = client.create_pod(tpu_pod("live-1"))
+            code, out = _post(port_a, "/scheduler/bind", {
+                "PodName": "live-1", "PodNamespace": "default",
+                "PodUID": p1.uid, "Node": nodes[0],
+            })
+            assert code == 200 and out["Error"] == "", out
+            fresh = client.get_pod("default", "live-1")
+            assert fresh.annotations[types.ANNOTATION_EPOCH] == str(
+                lease_a.epoch
+            )
+
+            # 2) a half-bind from term 1: annotation PUT lands, the
+            # binding POST dies (the classic crash-between-two-writes)
+            p2 = client.create_pod(tpu_pod("live-2"))
+            fail_once = [True]
+
+            def sabotage(ns, name, node):
+                if fail_once[0] and name == "live-2":
+                    fail_once[0] = False
+                    raise ApiError("injected", code=503)
+
+            client.before_bind = sabotage
+            code, out = _post(port_a, "/scheduler/bind", {
+                "PodName": "live-2", "PodNamespace": "default",
+                "PodUID": p2.uid, "Node": nodes[1],
+            })
+            assert out["Error"] != ""  # the bind half failed
+            client.before_bind = None
+            half = client.get_pod("default", "live-2")
+            assert half.annotations.get(types.ANNOTATION_ASSUME) == "true"
+            assert half.node_name == ""
+            assert half.annotations[types.ANNOTATION_EPOCH] == "1"
+            dealer_a.forget(half)  # its chips rolled back already
+
+            # 3) partition: A stops renewing (it cannot reach the lease
+            # API and never hears it lost); B steals after ttl+skew
+            # with hysteresis, tails A's stream, and promotes
+            now[0] = ttl + skew + 0.05
+            co_b.tail_once()
+            assert not lease_b.try_acquire()  # hysteresis probe 1
+            assert lease_b.try_acquire()      # probe 2: steal
+            assert lease_b.epoch == 2
+            result = co_b.promote(now=now[0])
+            assert result["promoted"]
+            assert co_b.is_leader() and fence_b.valid()
+
+            # 4) the deposed leader's in-flight bind dies on its fence:
+            # typed rejection over the wire, chips rolled back
+            assert not fence_a.valid()
+            p3 = client.create_pod(tpu_pod("live-3"))
+            occ_before = dealer_a.occupancy()
+            code, out = _post(port_a, "/scheduler/bind", {
+                "PodName": "live-3", "PodNamespace": "default",
+                "PodUID": p3.uid, "Node": nodes[2],
+            })
+            assert out["Error"] != "" and "fenced" in out["Error"], out
+            assert fence_a.rejections > 0
+            assert dealer_a.occupancy() == occ_before
+            assert not dealer_a.tracks(p3.uid)
+            assert client.get_pod("default", "live-3").node_name == ""
+
+            # 5) the heal sweep: the NEW leader strips the deposed
+            # term's stale-epoch half-bind without waiting out the TTL
+            healed = sc_b.sweep_assumed_once(now=now[0])
+            assert healed == 1 and sc_b.epoch_heals == 1
+            clean = client.get_pod("default", "live-2")
+            assert types.ANNOTATION_ASSUME not in clean.annotations
+            assert types.ANNOTATION_EPOCH not in clean.annotations
+
+            # 6) the pod binds exactly once through the new leader,
+            # stamped with the new term
+            code, out = _post(port_b, "/scheduler/bind", {
+                "PodName": "live-3", "PodNamespace": "default",
+                "PodUID": p3.uid, "Node": nodes[2],
+            })
+            assert code == 200 and out["Error"] == "", out
+            bound = client.get_pod("default", "live-3")
+            assert bound.node_name == nodes[2]
+            assert bound.annotations[types.ANNOTATION_EPOCH] == "2"
+            # and the deposed side answers binds 503 NotLeader once its
+            # coordinator knows (the HTTP gate backstop)
+            co_a.role = "standby"
+            code, out = _post(port_a, "/scheduler/bind", {
+                "PodName": "live-3", "PodNamespace": "default",
+                "PodUID": p3.uid, "Node": nodes[2],
+            })
+            assert code == 503 and out["Reason"] == "NotLeader"
+        finally:
+            srv_a.shutdown()
+            srv_b.shutdown()
+            dealer_a.close()
+            dealer_b.close()
+
+
+class TestDegradedProbe:
+    def test_one_probe_per_interval_observes_the_heal(self):
+        from nanotpu.ha.degraded import DegradedMonitor
+
+        now = [0.0]
+        m = DegradedMonitor(budget_s=2.0, clock=lambda: now[0])
+        m.note_failure("bind")
+        now[0] = 2.0
+        m.note_failure("bind")
+        assert m.active
+        # first probe slot opens one interval after entry; claims are
+        # exclusive until the next interval
+        assert not m.allow_probe()
+        now[0] = 2.0 + m.probe_every_s
+        assert m.allow_probe()
+        assert not m.allow_probe()
+        now[0] += m.probe_every_s
+        assert m.allow_probe()
+        # healthy mode never gates
+        m.note_success("bind")
+        assert not m.active and m.allow_probe()
+
+    def test_route_gate_lets_the_probe_bind_through(self):
+        from nanotpu.ha.degraded import DegradedMonitor
+        from nanotpu.k8s.resilience import ResilientClientset
+
+        client = make_mock_cluster(2)
+        now = [0.0]
+        m = DegradedMonitor(budget_s=1.0, clock=lambda: now[0])
+        rc = ResilientClientset(client, clock=lambda: now[0],
+                                sleep=lambda s: None)
+        rc.degraded = m  # production wiring: the dealer writes through it
+        dealer = Dealer(rc, make_rater("binpack"))
+        api = SchedulerAPI(dealer, Registry())
+        api.attach_degraded(m)
+        m.note_failure("bind")
+        now[0] = 1.0
+        m.note_failure("bind")
+        assert m.active
+        pod = client.create_pod(tpu_pod("probe-1"))
+        body = json.dumps({
+            "PodName": "probe-1", "PodNamespace": "default",
+            "PodUID": pod.uid, "Node": dealer.node_names()[0],
+        }).encode()
+        code, _, _ = api.dispatch("POST", "/scheduler/bind", body)
+        assert code == 503  # inside the probe interval: shed
+        now[0] = 1.0 + m.probe_every_s
+        code, _, out = api.dispatch("POST", "/scheduler/bind", body)
+        # the probe went through and its write SUCCEEDED (the link is
+        # healthy here): the mode exits on the real outcome
+        assert code == 200, out
+        assert not m.active and m.exits == 1
+        dealer.close()
+
+
+class TestFenceClockCoherence:
+    def test_lease_aligns_the_fence_clock(self):
+        """Caught by the live verify drive: cmd/main built the fence on
+        its default monotonic clock while the lease armed it with
+        WALL-clock deadlines — valid_for_s read ~57 years and the
+        non-cooperative expiry could never fire. The lease now forces
+        its fence onto its own clock."""
+        from nanotpu.ha.fence import EpochFence
+
+        client = FakeClientset()
+        fence = EpochFence()  # defaults to time.monotonic
+        lease = LeaderLease(client, "a", ttl_s=2.0, fence=fence)
+        assert fence.clock is lease.clock
+        assert lease.try_acquire()
+        st = fence.status()
+        # the validity window is ttl-bounded, not epoch-float-bounded
+        assert 0.0 < st["valid_for_s"] <= 2.0
+
+
+class TestUnstampedDeltasAreNotSuspect:
+    def test_epoch_zero_records_apply_after_a_fenced_term(self):
+        """Review catch: an UNSTAMPED (epoch-0) record means a
+        fence-less emitter — a pre-fencing build or a lease-less
+        restart (the rolling-upgrade case the HTTP tail explicitly
+        supports) — not a superseded term. Treating its stream as
+        suspect would silently freeze the standby."""
+        client, active, log_, standby, sc, co = make_pair()
+        log_.epoch = 3  # a fenced term emitted first
+        p1 = client.create_pod(tpu_pod("uz-1"))
+        ok, _ = active.assume(active.node_names(), p1)
+        active.bind(ok[0], p1)
+        co.tail_once()
+        assert co.max_epoch == 3
+        log_.epoch = 0  # fence-less emitter takes over the stream
+        p2 = client.create_pod(tpu_pod("uz-2"))
+        ok2, _ = active.assume(active.node_names(), p2)
+        active.bind(ok2[0], p2)
+        co.tail_once()
+        assert co.suspect_deltas == 0
+        assert standby.tracks(p2.uid)  # the record APPLIED
+        active.close()
+        standby.close()

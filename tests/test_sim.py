@@ -753,3 +753,134 @@ class TestSchedulerCrash:
                 "fleet": {"pools": [{"generation": "v5p", "hosts": 2}]},
                 "faults": {"scheduler_crash": {"at_s": [5.0]}},
             })
+
+
+class TestSplitBrainFaults:
+    """The non-fail-stop fault suite (docs/ha.md 'Split brain and
+    fencing'): toggle isolation on the reserved streams, lease-mode
+    determinism on a short horizon, and the partition-soak
+    certification (slow; `make partition-soak` gates it)."""
+
+    def _scenario(self, armed: bool) -> dict:
+        from nanotpu.sim.scenario import load_scenario
+
+        scenario = load_scenario("examples/sim/partition-soak.json")
+        scenario["horizon_s"] = 12.0
+        if not armed:
+            scenario["faults"]["network_partition"]["windows"] = []
+            scenario["faults"]["lease_thrash"]["at_s"] = []
+            scenario["faults"]["gray_degradation"]["at_s"] = []
+            for key in ("active_offset_s", "standby_offset_s"):
+                scenario["faults"]["clock_skew"][key] = 0.0
+        return scenario
+
+    def test_fault_toggle_does_not_reshape_base_jobs(self):
+        def job_shapes(armed):
+            sim = Simulator(self._scenario(armed), seed=5)
+            sim.run()
+            shapes = [
+                (j.config, round(j.lifetime_s, 9), j.size)
+                for j in sim.jobs
+                if j.incarnation == 0 and not getattr(j, "burst", False)
+            ]
+            sim.dealer.close()
+            sim.standby.dealer.close()
+            return shapes
+
+        on = job_shapes(True)
+        off = job_shapes(False)
+        assert on and on == off
+
+    def test_fault_toggle_does_not_shift_arrival_schedule(self):
+        def scheduled(armed):
+            sim = Simulator(self._scenario(armed), seed=5)
+            sim._schedule_static_events(12.0)
+            out = sorted(
+                (round(t, 9), payload["config"])
+                for t, _, kind, payload in sim._heap
+                if kind == "arrival" and not payload.get("burst")
+            )
+            sim.dealer.close()
+            sim.standby.dealer.close()
+            return out
+
+        assert scheduled(True) == scheduled(False)
+
+    def test_reserved_streams_are_distinct_and_seeded(self):
+        sim = Simulator(self._scenario(True), seed=5)
+        streams = [
+            sim.rng_partition, sim.rng_skew, sim.rng_thrash,
+            sim.rng_gray,
+        ]
+        others = {
+            id(sim.rng_workload), id(sim.rng_fault), id(sim.rng_metric),
+            id(sim.rng_lifecycle), id(sim.rng_overload),
+            id(sim.rng_retry), id(sim.rng_defrag), id(sim.rng_serve),
+        }
+        assert len({id(s) for s in streams}) == 4
+        assert not ({id(s) for s in streams} & others)
+        twin = Simulator(self._scenario(True), seed=5)
+        assert sim.rng_thrash.random() == twin.rng_thrash.random()
+        assert sim.rng_gray.random() == twin.rng_gray.random()
+        for s in (sim, twin):
+            s.dealer.close()
+            s.standby.dealer.close()
+
+    def test_lease_mode_short_horizon_is_deterministic(self):
+        def digest():
+            report = run_scenario(self._scenario(True), seed=5)
+            return report["digest"], report["ha"]
+
+        (d1, ha1), (d2, ha2) = digest(), digest()
+        assert d1 == d2
+        assert ha1 == ha2
+        # the api partition at 6s ran inside the 12s horizon: the fence
+        # actually fired and leadership actually moved
+        assert ha1["lease"]["fence_rejections"] > 0
+        assert ha1["promotions"] >= 1
+
+    def test_faults_require_lease_mode(self):
+        from nanotpu.sim.scenario import normalize_scenario
+
+        base = self._scenario(True)
+        base["ha"]["lease"]["enabled"] = False
+        with pytest.raises(ValueError, match="ha.lease.enabled"):
+            normalize_scenario(base)
+
+    def test_crash_fault_and_lease_mode_are_exclusive(self):
+        from nanotpu.sim.scenario import normalize_scenario
+
+        base = self._scenario(True)
+        base["faults"]["scheduler_crash"] = {"at_s": [5.0]}
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            normalize_scenario(base)
+
+    @pytest.mark.slow
+    def test_partition_soak_certification(self):
+        """The acceptance gate (`make partition-soak`): both stacks
+        alive through every chaos phase — zero violations (including
+        zero double-binds with two live dealers), bounded promotions,
+        the fence actually fired, degraded mode entered AND exited,
+        converged equality after every heal."""
+        from nanotpu.sim.scenario import load_scenario
+
+        scenario = load_scenario("examples/sim/partition-soak.json")
+        report = run_scenario(scenario, seed=0)
+        assert report["invariants"]["violations"] == 0, (
+            report["invariants"]["first"]
+        )
+        ha = report["ha"]
+        assert ha["crashes"] == 0  # nothing died: non-fail-stop only
+        assert 1 <= ha["promotions"] <= scenario["ha"]["promotion_bound"]
+        lease = ha["lease"]
+        assert lease["steals"] >= 2          # leadership moved both ways
+        assert lease["fence_rejections"] > 0  # the fence fired
+        assert lease["final_verify_match"] is True
+        assert lease["degraded"]["entries"] >= 1
+        assert lease["degraded"]["exits"] >= 1
+        assert ha["standby_drift_pct"] == 0.0
+        faults = report["faults"]
+        assert faults["partitions"] == 3
+        assert faults["partition_rejections"] > 0
+        assert faults["lease_thrash_windows"] == 1
+        assert faults["gray_windows"] == 1
