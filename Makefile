@@ -3,7 +3,7 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm batch-4k sim-smoke sim-multipool sim-het sim-defrag sim-batch sim-serve chaos-soak obs-check timeline-check fanout-4k ha-soak partition-soak image clean
+.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm batch-4k sim-smoke sim-multipool sim-het sim-defrag sim-batch sim-serve chaos-soak obs-check timeline-check fanout-4k ha-soak partition-soak follower-soak image clean
 
 # Default verification tier: static analysis, then the fast inner loop
 # (test-fast includes sim-smoke), then the observability gate, then the
@@ -11,7 +11,7 @@ TAG ?= latest
 # certifications and the sharded 4096-host fan-out gate (FAST=1 skips
 # those three). The tier-1 gate (`pytest tests/ -m 'not slow'` over
 # everything) is unchanged — run it via `make test` / CI.
-all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag sim-batch sim-serve fanout-4k batch-4k ha-soak partition-soak
+all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag sim-batch sim-serve fanout-4k batch-4k ha-soak partition-soak follower-soak
 
 # nanolint (docs/static-analysis.md): AST invariant passes over the
 # scheduler's concurrency & determinism contracts — lock discipline,
@@ -258,6 +258,32 @@ partition-soak: native
 		python -m pytest tests/test_ha.py -q -k \
 			"Fence or Lease or StaleEpoch or Suspect or Integrity or Verify or Degraded or SplitBrain" && \
 		python -m pytest tests/test_sim.py -q -k partition_soak_certification; \
+	fi
+
+# Read-plane follower-fleet gate (docs/read-plane.md): the ha-crash
+# fault plan with THREE followers tailing the leader's delta stream
+# under a 64-event staleness bound — every scheduler crash promotes the
+# standby while the followers re-anchor onto the new leader's log with
+# ZERO read downtime (reads_refused must stay 0) and zero end-state
+# drift vs the durable annotations — run TWICE (--check-determinism,
+# lock witness armed), then the follower test suite (byte-equal
+# leader/follower parity, NotSynced lag bound, fenced-bind safety,
+# drain/rejoin, /debug/ha paging), then the bench half: the scale-out
+# read row (parity + independence counters + >=4x aggregate ratio at 3
+# followers, asserted in-bench) and the 16k follower x shard
+# composition row. `FAST=1 make all` skips it (same rule as ha-soak).
+# A/B against a pre-follower base ref with:
+#   make bench-ab AB_CMD="python bench.py --follower-rep" \
+#        AB_KEY=flfan_aggregate_reads_per_s
+follower-soak: native
+	@if [ "$(FAST)" = "1" ]; then \
+		echo "follower-soak: skipped (FAST=1)"; \
+	else \
+		NANOTPU_LOCK_WITNESS=1 python -m nanotpu.sim \
+			--scenario examples/sim/follower-scale.json --seed 0 \
+			--check-determinism > /dev/null && \
+		python -m pytest tests/test_followers.py -q && \
+		python bench.py --follower-fanout; \
 	fi
 
 # The 4096-host multi-pool churn scenario through the sharded dealer,

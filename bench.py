@@ -2151,6 +2151,379 @@ def run_ha_soak() -> dict:
     return out
 
 
+def _follower_available() -> bool:
+    """Feature detection for the read-plane follower fleet (the same
+    bench file runs on pre-follower base refs under bench_ab): the
+    follower rows no-op with a note there instead of crashing."""
+    if not _ha_available():
+        return False
+    from nanotpu.ha import HACoordinator
+
+    # the follower read surface arrived with the role itself; probing
+    # the method avoids constructing a coordinator just to ask
+    return hasattr(HACoordinator, "follower_gauge_values")
+
+
+#: The 16384-host fleet for the follower x shard composition row: four
+#: v5p-4096 pools, one snapshot shard per pool under ``shards="auto"``
+#: — each follower replica runs the SAME sharded RCU chains the leader
+#: does (docs/read-plane.md), so the two scaling axes multiply.
+FLEET_16K = {
+    "pools": [{
+        "generation": "v5p", "hosts": 4096, "slice_hosts": 64,
+        "prefix": "v5p-mega", "count": 4,
+    }]
+}
+
+
+def run_follower_fanout(n_followers: int = 3, n_hosts: int = 256,
+                        n_cycles: int = 96, n_reads: int = 96,
+                        warm_pods: int = 24, fleet: dict | None = None,
+                        shards: int | str = 1,
+                        require_ratio: float | None = 4.0,
+                        verb_budget_s: float | None = None,
+                        prefix: str = "flfan") -> dict:
+    """The scale-out read-plane row (docs/read-plane.md): one leader +
+    ``n_followers`` follower replicas, each follower tailing the
+    leader's delta stream over live HTTP (``HttpDeltaSource`` against
+    the leader's real ``/debug/ha`` pages) into its OWN dealer + RCU
+    snapshot chains, then serving Filter/Prioritize from local state.
+
+    Measurement protocol — this is a ONE-CORE box, so concurrent
+    replica processes cannot demonstrate parallel speedup here; the row
+    instead proves the property that makes the fleet scale on real
+    hardware and measures each term of the sum:
+
+    * **baseline window** (the single-process HEAD): the leader alone
+      runs full filter+prioritize+bind cycles — the workload one
+      process serves when it is the whole scheduler.
+    * **fleet windows**, interleaved in the same process and minute:
+      the leader runs the SAME mixed cycle (the write plane does not
+      slow down), then each follower — synced via a real HTTP tail
+      catch-up — serves a pure Filter+Prioritize read window from its
+      local snapshots.
+    * **independence proof**: across every follower read window the
+      LEADER's perf counters must not move AT ALL — a follower read
+      touches no shared lock, no leader socket, no leader snapshot, so
+      on n+1 cores the windows overlap perfectly and the aggregate is
+      the sum. The bench asserts the counters byte-still and then
+      reports ``aggregate = leader_rate + sum(follower_rates)`` with
+      every term in the artifact.
+
+    In-bench asserts: follower Filter/Prioritize bytes EQUAL the
+    leader's for the same args (the parity pin over live HTTP),
+    follower binds answer 503 NotLeader with a leader hint, drain
+    pulls a follower out of rotation (reads 503 NotSynced) and rejoin
+    restores byte-equal service (the rolling-upgrade step), zero
+    view/renderer builds and zero gen-2 collections inside every timed
+    window, and — when ``require_ratio`` is set — the aggregate read
+    throughput at 3 followers clears >= 4x the single-process
+    baseline."""
+    from nanotpu.controller.controller import Controller
+    from nanotpu.ha import DeltaLog, HACoordinator
+    from nanotpu.ha.standby import HttpDeltaSource
+
+    import gc
+
+    if fleet is None:
+        client = make_mock_cluster(n_hosts, CHIPS_PER_HOST)
+        nodes = [f"v5p-host-{i}" for i in range(n_hosts)]
+    else:
+        from nanotpu.sim.fleet import make_fleet
+
+        client = make_fleet(fleet)
+        nodes = [n.name for n in client.list_nodes()]
+        assert len(nodes) == n_hosts, (len(nodes), n_hosts)
+    node_bytes = [n.encode() for n in nodes]
+    log_ = DeltaLog()
+    leader = Dealer(client, make_rater("binpack"), ha_log=log_,
+                    shards=shards)
+    co_l = HACoordinator(leader, role="active", log_=log_)
+    api_l = SchedulerAPI(leader, Registry())
+    api_l.attach_ha(co_l)
+    srv_l = serve(api_l, 0, host="127.0.0.1")
+    api_l.stop_idle_gc()
+    leader_port = srv_l.server_address[1]
+    conn_l = HttpClient("127.0.0.1", leader_port)
+
+    followers: list[tuple] = []
+
+    def mk_follower():
+        """One follower replica: warm boot (full resync over the shared
+        apiserver state, a real follower's checkpoint restore) then a
+        live HTTP tail anchored at the leader's current seq."""
+        fd = Dealer(client, make_rater("binpack"), shards=shards)
+        fc = Controller(client, fd, resync_period_s=0, assume_ttl_s=0)
+        fc.enter_standby()
+        fc.resync_once()
+        co = HACoordinator(
+            fd, role="follower", controller=fc,
+            source=HttpDeltaSource(f"http://127.0.0.1:{leader_port}"),
+        )
+        api_f = SchedulerAPI(fd, Registry())
+        api_f.attach_ha(co)
+        srv_f = serve(api_f, 0, host="127.0.0.1")
+        api_f.stop_idle_gc()
+        conn_f = HttpClient("127.0.0.1", srv_f.server_address[1])
+        followers.append((fd, co, api_f, srv_f, conn_f))
+
+    def mk_cycle_pods(tag: str, count: int):
+        out = []
+        for i in range(count):
+            name = f"{prefix}-{tag}-{i}"
+            pod = client.create_pod(make_pod(name, containers=[
+                make_container("t", {types.RESOURCE_TPU_PERCENT: 100})
+            ]))
+            args = json.dumps(
+                {"Pod": pod.raw, "NodeNames": nodes}, separators=_GO_SEP
+            ).encode()
+            bind_prefix = (
+                f'{{"PodName":"{name}","PodNamespace":"default",'
+                f'"PodUID":"{pod.uid}","Node":"'
+            ).encode()
+            out.append((args, bind_prefix))
+        return out
+
+    attr_total: dict[str, int] = {}
+
+    def _attr_add(attr: dict) -> dict:
+        for k, v in attr.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                attr_total[k] = attr_total.get(k, 0) + v
+        return attr
+
+    def mixed_window(prepared) -> float:
+        """Full schedule cycles on the leader; returns cycles/s."""
+        gc.collect()
+        gc_before = gc.get_stats()
+        perf_before = leader.perf_totals()
+        t0 = time.perf_counter()
+        for args, bind_prefix in prepared:
+            filt = conn_l.post_raw("/scheduler/filter", args)
+            prio = conn_l.post_raw("/scheduler/priorities", args)
+            best = _scan_best(prio, _scan_feasible(filt), node_bytes)
+            r = conn_l.post_raw(
+                "/scheduler/bind", bind_prefix + best.encode() + b'"}'
+            )
+            assert b'"Error":""' in r, r
+        elapsed = time.perf_counter() - t0
+        attr = _gc_deltas(gc_before, gc.get_stats())
+        perf_after = leader.perf_totals()
+        assert attr["gen2_collections"] == 0, attr
+        assert perf_after["view_builds"] == perf_before["view_builds"]
+        _attr_add(attr)
+        _attr_add({
+            k: perf_after[k] - perf_before[k] for k in perf_after
+        })
+        return len(prepared) / elapsed
+
+    def read_window(conn, dealer, reads) -> tuple[float, list, list]:
+        """Pure Filter+Prioritize cycles; returns (pairs/s, f_lats,
+        p_lats). Leader perf counters must not move: asserted by the
+        caller around follower windows (the independence proof)."""
+        gc.collect()
+        gc_before = gc.get_stats()
+        perf_before = dealer.perf_totals()
+        f_lats, p_lats = [], []
+        t0 = time.perf_counter()
+        for args, _bp in reads:
+            ta = time.perf_counter()
+            filt = conn.post_raw("/scheduler/filter", args)
+            tb = time.perf_counter()
+            prio = conn.post_raw("/scheduler/priorities", args)
+            f_lats.append(tb - ta)
+            p_lats.append(time.perf_counter() - tb)
+            assert filt.startswith(b"{"), filt
+            assert prio.startswith(b"["), prio
+        elapsed = time.perf_counter() - t0
+        attr = _gc_deltas(gc_before, gc.get_stats())
+        perf_after = dealer.perf_totals()
+        assert attr["gen2_collections"] == 0, attr
+        # warm-window contract: the replica's views pre-exist (streamed
+        # warm hints + the warm probe) — reads build nothing
+        assert perf_after["view_builds"] == perf_before["view_builds"]
+        assert (perf_after["renderer_builds"]
+                == perf_before["renderer_builds"])
+        _attr_add(attr)
+        _attr_add({
+            k: perf_after[k] - perf_before[k] for k in perf_after
+        })
+        return len(reads) / elapsed, f_lats, p_lats
+
+    try:
+        # ---- warm phase (untimed): occupancy + views + tail anchors
+        for args, bind_prefix in mk_cycle_pods("warm", warm_pods):
+            filt = conn_l.post_raw("/scheduler/filter", args)
+            prio = conn_l.post_raw("/scheduler/priorities", args)
+            best = _scan_best(prio, _scan_feasible(filt), node_bytes)
+            r = conn_l.post_raw(
+                "/scheduler/bind", bind_prefix + best.encode() + b'"}'
+            )
+            assert b'"Error":""' in r, r
+        probe = mk_cycle_pods("probe", 1)[0][0]
+        for _fi in range(n_followers):
+            mk_follower()  # warm boot AFTER the warm binds it resyncs
+        for fd, co, _api, _srv, conn_f in followers:
+            co.tail_once()  # first contact anchors at the leader's seq
+            assert co.synced(), co.lag()
+            assert fd.warm_views(nodes)
+            # parity pin over live HTTP: same args, byte-equal answers
+            assert (conn_f.post_raw("/scheduler/filter", probe)
+                    == conn_l.post_raw("/scheduler/filter", probe))
+            assert (conn_f.post_raw("/scheduler/priorities", probe)
+                    == conn_l.post_raw("/scheduler/priorities", probe))
+            # leader-only write plane: follower binds answer NotLeader
+            # with the tail URL as the redirect hint
+            r = conn_f.post_raw("/scheduler/bind", {
+                "PodName": "gate", "PodNamespace": "default",
+                "PodUID": "gate", "Node": nodes[0],
+            })
+            assert b"NotLeader" in r and b"LeaderHint" in r, r
+        # leader warm probe so its first timed cycle builds nothing
+        conn_l.post_raw("/scheduler/filter", probe)
+        conn_l.post_raw("/scheduler/priorities", probe)
+
+        # ---- baseline window: the single-process HEAD
+        single_rate = mixed_window(mk_cycle_pods("base", n_cycles))
+
+        # ---- fleet windows, same process, same minute
+        leader_rate = mixed_window(mk_cycle_pods("fleet", n_cycles))
+        follower_rates = []
+        f_lats_all: list[float] = []
+        p_lats_all: list[float] = []
+        reads = mk_cycle_pods("read", n_reads)
+        for fd, co, _api, _srv, conn_f in followers:
+            applied = co.tail_once()  # real HTTP catch-up, then serve
+            assert co.synced(), co.lag()
+            lp_before = leader.perf_totals()
+            rate, f_lats, p_lats = read_window(conn_f, fd, reads)
+            # the independence proof: a follower read window leaves the
+            # leader's counters byte-still — nothing crossed replicas,
+            # so on real cores these windows overlap losslessly
+            assert leader.perf_totals() == lp_before
+            follower_rates.append(round(rate, 1))
+            f_lats_all.extend(f_lats)
+            p_lats_all.extend(p_lats)
+        aggregate = leader_rate + sum(follower_rates)
+        ratio = aggregate / single_rate if single_rate else 0.0
+        if require_ratio is not None:
+            assert ratio >= require_ratio, (ratio, aggregate,
+                                            single_rate)
+        filter_p99 = percentile(f_lats_all, 0.99)
+        prio_p99 = percentile(p_lats_all, 0.99)
+        if verb_budget_s is not None:
+            assert max(f_lats_all) < verb_budget_s, max(f_lats_all)
+            assert max(p_lats_all) < verb_budget_s, max(p_lats_all)
+
+        # ---- rolling-upgrade step: drain -> refused reads -> rejoin
+        fd0, co0, _api0, _srv0, conn0 = followers[0]
+        r = conn0.post_raw("/debug/ha/drain", b"")
+        assert b'"draining": true' in r or b'"draining":true' in r, r
+        r = conn0.post_raw("/scheduler/filter", probe)
+        assert b"NotSynced" in r, r
+        assert b"ha-follower-synced" in conn0.get_raw("/readyz")
+        r = conn0.post_raw("/debug/ha/rejoin", b"")
+        assert b"NotSynced" not in r, r
+        assert (conn0.post_raw("/scheduler/filter", probe)
+                == conn_l.post_raw("/scheduler/filter", probe))
+        lag_events = [co.lag() for _fd, co, _a, _s, _c in followers]
+        tail_retries = [
+            co.source.tail_retries for _fd, co, _a, _s, _c in followers
+        ]
+    finally:
+        conn_l.close()
+        srv_l.shutdown()
+        srv_l.server_close()
+        leader.close()
+        for fd, _co, _api, srv_f, conn_f in followers:
+            conn_f.close()
+            srv_f.shutdown()
+            srv_f.server_close()
+            fd.close()
+        gc.collect()
+    return {
+        f"{prefix}_hosts": n_hosts,
+        f"{prefix}_followers": n_followers,
+        f"{prefix}_single_cycles_per_s": round(single_rate, 1),
+        f"{prefix}_leader_cycles_per_s": round(leader_rate, 1),
+        f"{prefix}_follower_reads_per_s": follower_rates,
+        f"{prefix}_aggregate_reads_per_s": round(aggregate, 1),
+        f"{prefix}_scaleout_ratio": round(ratio, 2),
+        f"{prefix}_filter_p99_ms": round(filter_p99 * 1000, 3),
+        f"{prefix}_prioritize_p99_ms": round(prio_p99 * 1000, 3),
+        f"{prefix}_lag_events_end": lag_events,
+        f"{prefix}_tail_retries": tail_retries,
+        f"{prefix}_loadavg_1m": round(os.getloadavg()[0], 2),
+        # summed in-window counters across every timed window (leader
+        # fleet window + all follower read windows): GC generation
+        # deltas + dealer hot-path counters, the bench_ab attr-diff
+        # input that separates in-process change from host noise
+        "attr": attr_total,
+    }
+
+
+def run_follower_fanout_reps(reps: int = 3, max_reps: int = 5,
+                             **kwargs) -> dict:
+    """Noise-aware reps of the follower row (the run_fanout_reps
+    convention): median ratio with the full dispersion; extra reps when
+    the observed spread is wide, decided only by the spread."""
+    outs, ratios = [], []
+    n = 0
+    while n < reps or (
+        n < max_reps and max(ratios) > 1.25 * min(ratios)
+    ):
+        outs.append(run_follower_fanout(**kwargs))
+        ratios.append(outs[-1]["flfan_scaleout_ratio"])
+        n += 1
+    mid = outs[sorted(range(n), key=lambda i: ratios[i])[n // 2]]
+    out = dict(mid)
+    out["flfan_reps"] = n
+    out["flfan_scaleout_ratio"] = statistics.median(ratios)
+    out["flfan_scaleout_ratio_all"] = sorted(ratios)
+    out["flfan_note"] = (
+        "one-core box: per-replica windows run sequentially in one "
+        "process (leader mixed filter+prio+bind cycles, followers pure "
+        "filter+prio from local snapshots after a live-HTTP tail "
+        "catch-up); aggregate = leader + sum(followers), valid because "
+        "the in-bench independence assert holds the leader's perf "
+        "counters byte-still across every follower read window — "
+        "follower reads cross no shared lock, socket, or snapshot"
+    )
+    return out
+
+
+def run_follower_16k(n_followers: int = 1) -> dict:
+    """The follower x shard composition row: 16384 hosts as four
+    sharded v5p-4096 pools, each follower running the same sharded RCU
+    chains as the leader (docs/read-plane.md). One follower suffices to
+    prove the axes compose — the per-replica terms are independent (the
+    256-host row's independence assert), so follower count multiplies
+    the same way at any host count. Per-verb reads stay inside the 2s
+    extender budget at 16k candidates; the ratio is recorded, not
+    gated (2 replicas bound it at ~2x by construction)."""
+    return run_follower_fanout(
+        n_followers=n_followers, n_hosts=16384, fleet=FLEET_16K,
+        shards="auto", n_cycles=12, n_reads=12, warm_pods=8,
+        require_ratio=None, verb_budget_s=VERB_BUDGET_S,
+        prefix="flfan16k",
+    )
+
+
+def run_follower_soak() -> dict:
+    """``make follower-soak``'s bench half: the 256-host scale-out row
+    (ratio gate in-bench) + the 16k follower x shard row. No-ops with a
+    note on pre-follower bases (bench_ab compatibility)."""
+    if not _follower_available():
+        return {"follower_skipped":
+                "follower read plane unavailable on this ref"}
+    out = run_follower_fanout_reps()
+    import gc
+
+    gc.collect()
+    out.update(run_follower_16k())
+    return out
+
+
 def _fencing_available() -> bool:
     """Feature detection for the split-brain containment layer (the
     same bench file runs on pre-fencing base refs under bench_ab): the
@@ -2465,6 +2838,11 @@ def run() -> dict:
     # churn cannot depress the read-path rows above
     ha = run_ha_soak()
     gc.collect()
+    # flfan_* = the scale-out read-plane rows (docs/read-plane.md):
+    # leader + followers with the in-bench independence/parity/ratio
+    # asserts, plus the 16k follower x shard composition row
+    flfan = run_follower_soak()
+    gc.collect()
     run_once()  # warmup: module-level caches (topology link bounds, demand
     # hashes, compactness) persist across repetitions, as in a live scheduler
     latencies: list[float] = []
@@ -2527,6 +2905,7 @@ def run() -> dict:
     out.update(bindstorm)
     out.update(batch4k)
     out.update(ha)
+    out.update(flfan)
     out["host_loadavg_start"] = load_start
     out["host_loadavg_end"] = [round(x, 2) for x in os.getloadavg()]
     out["host_cpu_count"] = os.cpu_count()
@@ -2609,6 +2988,36 @@ if __name__ == "__main__":
             run_failover(n_failovers=1) if _ha_available()
             else {"ha_skipped": "nanotpu.ha unavailable on this ref"}
         ))
+    elif "--follower-fanout" in sys.argv:
+        # `make follower-soak`'s bench half (docs/read-plane.md): the
+        # scale-out read row (parity, NotLeader gate, drain/rejoin,
+        # independence counters, >=4x aggregate ratio at 3 followers)
+        # + the 16k follower x shard composition row — every acceptance
+        # assert runs in-bench, an AssertionError exits nonzero. No-ops
+        # with a note on pre-follower base refs.
+        print(json.dumps(run_follower_soak()))
+    elif "--follower-rep" in sys.argv:
+        # one rep, for bench_ab.py's interleaved A/B protocol
+        # (AB_KEY=flfan_aggregate_reads_per_s). On a pre-follower base
+        # the rate key pairs against the single-process read plane: one
+        # process serving the whole mixed workload IS that build's
+        # aggregate read capacity, which is exactly the comparison the
+        # acceptance ratio is about (fleet aggregate vs single-process
+        # same-day HEAD)
+        if _follower_available():
+            print(json.dumps(run_follower_fanout(require_ratio=None)))
+        else:
+            base = run_fanout(n_hosts=256, n_pods=96, warm_pods=24)
+            print(json.dumps({
+                "flfan_hosts": 256,
+                "flfan_followers": 0,
+                "flfan_single_cycles_per_s": base["fanout_pods_per_s"],
+                "flfan_aggregate_reads_per_s":
+                    base["fanout_pods_per_s"],
+                "attr": base["attr"],
+                "flfan_note": "pre-follower base: one process serves "
+                              "the whole read plane (mixed cycles)",
+            }))
     elif "--partition" in sys.argv:
         # the split-brain containment row (docs/ha.md): bind
         # availability + typed shed attribution through a mid-storm

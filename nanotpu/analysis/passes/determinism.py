@@ -57,7 +57,10 @@ SCOPE = (
     "nanotpu.metrics.serving",
     # the HA plane (docs/ha.md): the sim drives the REAL delta log,
     # lease, and coordinator on virtual time, so all three must draw
-    # time only from their injectable clocks
+    # time only from their injectable clocks. The follower read plane
+    # (docs/read-plane.md) rides in the same modules: the sim pumps
+    # follower coordinators per event, and the HttpDeltaSource backoff
+    # jitter draws only from its injectable clock/rng defaults
     "nanotpu.ha", "nanotpu.metrics.ha", "nanotpu.metrics.degraded",
     "nanotpu.k8s.objects", "nanotpu.k8s.client", "nanotpu.k8s.resilience",
     "nanotpu.k8s.events",

@@ -50,7 +50,12 @@ SCOPE = (
     "nanotpu.serving.feedback", "nanotpu.serving.autoscale",
     # the HA plane (docs/ha.md): the delta log is appended on the bind
     # hot path, and the coordinator's role lock nests with nothing by
-    # contract — promotion's reconcile (apiserver syncs) runs outside it
+    # contract — promotion's reconcile (apiserver syncs) runs outside it.
+    # The follower read plane (docs/read-plane.md) lives in the same
+    # modules and adds NO lock: the drain/rejoin flags flip under the
+    # existing HACoordinator._lock, and the HttpDeltaSource backoff is
+    # single-threaded (one tail loop per process), so HOT_LOCKS is
+    # unchanged.
     "nanotpu.ha",
 )
 

@@ -70,6 +70,13 @@ promise has three string-ly typed seams this pass stitches shut:
   ``record(reason=...)`` arguments, mapping-table values, and
   ``BindError(..., reason=...)`` constructors all count.
 
+* **HA / follower gauges** (``nanotpu_ha_*`` and ``nanotpu_follower_*``,
+  docs/ha.md + docs/read-plane.md): ``_HA_GAUGES`` vs
+  ``HACoordinator.ha_gauge_values()`` and ``_FOLLOWER_GAUGES`` vs
+  ``HACoordinator.follower_gauge_values()`` — both directions each, so
+  the read plane's staleness contract (lag, synced, draining,
+  tail_retries) can never ship a lying zero or a scrape-time KeyError.
+
 Registry-built metrics (``registry.counter(...)`` etc.) register at
 construction by design and need no check here.
 """
@@ -280,6 +287,8 @@ class _MetricsPass:
         srvgauges_mod: Module | None = None
         hagauges: dict[str, int] | None = None
         hagauges_mod: Module | None = None
+        flgauges: dict[str, int] | None = None
+        flgauges_mod: Module | None = None
         dggauges: dict[str, int] | None = None
         dggauges_mod: Module | None = None
         for mod in modules:
@@ -313,6 +322,9 @@ class _MetricsPass:
             hg = _declared_gauge_table(mod, "_HA_GAUGES")
             if hg is not None:
                 hagauges, hagauges_mod = hg, mod
+            fl = _declared_gauge_table(mod, "_FOLLOWER_GAUGES")
+            if fl is not None:
+                flgauges, flgauges_mod = fl, mod
             dg = _declared_gauge_table(mod, "_DEGRADED_GAUGES")
             if dg is not None:
                 dggauges, dggauges_mod = dg, mod
@@ -439,6 +451,7 @@ class _MetricsPass:
             ("slo", slogauges, slogauges_mod, "slo_gauge_values"),
             ("serving", srvgauges, srvgauges_mod, "serving_gauge_values"),
             ("ha", hagauges, hagauges_mod, "ha_gauge_values"),
+            ("follower", flgauges, flgauges_mod, "follower_gauge_values"),
             ("degraded", dggauges, dggauges_mod, "degraded_gauge_values"),
         ):
             if table is not None and table_mod is not None:

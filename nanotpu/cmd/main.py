@@ -196,7 +196,9 @@ def build_app(argv: list[str] | None = None):
         "the winner serves as the ACTIVE (emitting its delta stream on "
         "GET /debug/ha), the loser runs as a warm STANDBY — informer "
         "cache + delta tail, /readyz 503 NotReady with Role standby, "
-        "binds gated 503 NotLeader — and promotes in <1s on lease loss",
+        "binds gated 503 NotLeader — and promotes in <1s on lease loss. "
+        "--role follower joins the read plane instead "
+        "(docs/read-plane.md)",
     )
     parser.add_argument(
         "--ha-peer", default="", metavar="URL",
@@ -240,6 +242,27 @@ def build_app(argv: list[str] | None = None):
         "de-synchronizes competing standbys",
     )
     parser.add_argument(
+        "--role", choices=("auto", "follower"), default="auto",
+        help="HA role (with --ha): 'auto' races for the leader lease "
+        "(active or warm standby, docs/ha.md); 'follower' joins the "
+        "scale-out READ plane (docs/read-plane.md) — tail the leader's "
+        "delta stream from --ha-peer into a live local dealer, answer "
+        "Filter/Prioritize from warm snapshots within the staleness "
+        "bound, never lease, never lead, binds 503 NotLeader with a "
+        "LeaderHint",
+    )
+    parser.add_argument(
+        "--follower-lag-bound", type=int, default=256, metavar="N",
+        help="follower staleness bound in delta events: past it, reads "
+        "answer 503 NotSynced (and /readyz 503 pulls the replica from "
+        "the read Service) until the tail catches up; 0 = unbounded",
+    )
+    parser.add_argument(
+        "--follower-lag-bound-s", type=float, default=0.0, metavar="S",
+        help="follower staleness bound in seconds (age of the newest "
+        "pending delta); 0 disables the time bound (events-only)",
+    )
+    parser.add_argument(
         "--degraded-budget", type=float, default=0.0, metavar="S",
         help="degraded mode (docs/ha.md): after this many seconds of "
         "CONTINUOUS apiserver write failure, binds answer 503 Degraded "
@@ -257,6 +280,11 @@ def build_app(argv: list[str] | None = None):
     )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
+    if args.role == "follower" and not (args.ha and args.ha_peer):
+        # a peer-less follower would refuse every read forever — fail
+        # loud at boot instead of joining the fleet permanently NotSynced
+        parser.error("--role follower requires --ha and --ha-peer "
+                     "(the leader's delta stream is what it serves from)")
 
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
@@ -390,7 +418,30 @@ def main(argv: list[str] | None = None) -> int:
             steal_backoff_s=args.ha_steal_backoff,
             fence=fence,
         )
-        if lease.try_acquire():
+        if args.role == "follower":
+            # read-plane follower (docs/read-plane.md): never races the
+            # lease, never leads. Tails the leader's delta stream into
+            # its OWN live dealer + RCU snapshot chain and answers
+            # Filter/Prioritize within the staleness bound; binds 503
+            # NotLeader with a LeaderHint, and the never-armed epoch
+            # fence fast-fails any apiserver mutation that slips past
+            # the HTTP gate.
+            source = HttpDeltaSource(args.ha_peer)
+            coordinator = HACoordinator(
+                dealer, role="follower", source=source,
+                controller=controller, fence=fence, client=client,
+            )
+            coordinator.read_lag_bound = max(0, args.follower_lag_bound)
+            coordinator.read_lag_bound_s = max(
+                0.0, args.follower_lag_bound_s
+            )
+            controller.enter_standby()
+            log.info(
+                "HA: serving as read-plane FOLLOWER (peer=%s, lag "
+                "bound %d events / %.1fs)", args.ha_peer,
+                coordinator.read_lag_bound, coordinator.read_lag_bound_s,
+            )
+        elif lease.try_acquire():
             ha_log = DeltaLog(path=args.ha_checkpoint)
             ha_log.epoch = lease.epoch
             if args.ha_checkpoint:

@@ -1,7 +1,7 @@
 """The warm standby: tail, apply, stay warm, promote in one step.
 
-:class:`HACoordinator` is one replica's HA state machine, used by BOTH
-roles (docs/ha.md):
+:class:`HACoordinator` is one replica's HA state machine, used by ALL
+roles (docs/ha.md, docs/read-plane.md):
 
 * role ``active`` — owns the :class:`~nanotpu.ha.delta.DeltaLog` the
   dealer emits into, renews the leader lease, serves ``/debug/ha``;
@@ -11,7 +11,16 @@ roles (docs/ha.md):
   while its Controller runs in standby mode (informer cache + dirty-key
   tracking, no dealer writes). ``view`` records pre-build the active's
   candidate-tuple views + renderers, so the standby's first
-  post-promotion Filter costs zero view/renderer builds (bench-pinned).
+  post-promotion Filter costs zero view/renderer builds (bench-pinned);
+* role ``follower`` — the read plane's scale-out unit: a standby that
+  SERVES Filter/Prioritize from its local snapshots and never contends
+  for the leader lease. Same tail/apply/warm machinery, plus a
+  bounded-staleness contract: :meth:`synced` is true while the tail lag
+  stays inside ``read_lag_bound`` events / ``read_lag_bound_s``
+  seconds, and the route layer refuses reads (503 ``NotSynced``) past
+  it. Binds stay leader-only behind the epoch fence; a follower's
+  lifecycle is join (warm boot + tail catch-up), :meth:`drain` (out of
+  read rotation, tail keeps running), :meth:`rejoin`.
 
 Promotion (:meth:`promote`) is ONE step because the views are already
 warm: flip the role, reconcile only the DIRTY window — pod keys whose
@@ -27,6 +36,7 @@ sweeper, and re-issued binds are idempotent by uid.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 
@@ -44,8 +54,10 @@ class HACoordinator:
                  controller=None, lease=None, flight=None,
                  lag_events: int = 0, clock=time.monotonic,
                  fence=None, client=None):
-        if role not in ("active", "standby"):
-            raise ValueError(f"role must be active|standby, got {role!r}")
+        if role not in ("active", "standby", "follower"):
+            raise ValueError(
+                f"role must be active|standby|follower, got {role!r}"
+            )
         self._lock = make_lock("HACoordinator._lock")
         self.dealer = dealer
         self.role = role
@@ -107,9 +119,73 @@ class HACoordinator:
         self.last_verify: dict | None = None
         #: verify_state runs that found a mismatch
         self.verify_failures = 0
+        #: the follower staleness contract (docs/read-plane.md): reads
+        #: answer only while the tail lag stays within BOTH bounds —
+        #: events behind the stream head, and seconds behind the newest
+        #: applied record. 0 disables that bound. Leaders/standbys
+        #: ignore these (a standby serves no reads; a leader is never
+        #: stale against itself).
+        self.read_lag_bound = 256
+        self.read_lag_bound_s = 0.0
+        #: True while the operator pulled this follower out of read
+        #: rotation (rolling upgrade, docs/read-plane.md): /readyz goes
+        #: NotReady so the Service stops steering reads here, while the
+        #: tail keeps running so a rejoin is instant
+        self.draining = False
+        #: reads refused because the tail lag exceeded the staleness
+        #: bound (the route layer bumps it on every 503 NotSynced)
+        self.reads_refused = 0
 
     def is_leader(self) -> bool:
         return self.role == "active"
+
+    # -- follower lifecycle (docs/read-plane.md) ---------------------------
+    def synced(self, now: float | None = None) -> bool:
+        """Bounded-staleness check: True while this replica's snapshots
+        are close enough to the stream head to serve reads. A leader is
+        trivially synced; a tail that fell off the ring is not (its gap
+        is unbounded staleness, whatever the counters say)."""
+        if self.role == "active":
+            return True
+        if self.stale:
+            return False
+        if self.read_lag_bound > 0 and self.lag() > self.read_lag_bound:
+            return False
+        if (
+            self.read_lag_bound_s > 0
+            and self.lag_seconds(now=now) > self.read_lag_bound_s
+        ):
+            return False
+        return True
+
+    def ready_to_serve(self, now: float | None = None) -> bool:
+        """The follower's /readyz gate: in rotation and within the
+        staleness bound. Drain flips it false without stopping the tail,
+        so a drained follower rejoins warm (docs/read-plane.md)."""
+        return not self.draining and self.synced(now=now)
+
+    def drain(self) -> dict:
+        """Take this follower out of read rotation (rolling-upgrade
+        step 1): /readyz goes NotReady, reads gate 503, the tail keeps
+        running. Idempotent."""
+        with self._lock:
+            already = self.draining
+            self.draining = True
+        if not already:
+            log.info("follower draining: out of read rotation")
+        return {"draining": True, "was_draining": already}
+
+    def rejoin(self) -> dict:
+        """Return a drained follower to read rotation (rolling-upgrade
+        step 3): /readyz answers again once the tail is inside the
+        staleness bound — a freshly restarted follower warm-boots from
+        its checkpoint and catches up before readiness flips."""
+        with self._lock:
+            was = self.draining
+            self.draining = False
+        if was:
+            log.info("follower rejoining read rotation (lag=%d)", self.lag())
+        return {"draining": False, "synced": self.synced()}
 
     # -- standby: tail + apply ---------------------------------------------
     def tail_once(self, limit: int | None = None) -> int:
@@ -118,7 +194,7 @@ class HACoordinator:
         the ring) marks the coordinator for full-resync promotion
         instead of silently skipping the gap."""
         source = self.source
-        if self.role != "standby" or source is None:
+        if self.role == "active" or source is None:
             return 0
         poll = getattr(source, "poll", None)
         if poll is not None:
@@ -263,6 +339,13 @@ class HACoordinator:
             now = self.clock()
         with self._lock:
             if self.role == "active":
+                return {"promoted": False, "reconciled": 0}
+            if self.role == "follower":
+                # the read plane never writes: a follower holds no lease
+                # and must not promote even if asked — the STANDBY is
+                # the insurance policy, followers just re-anchor their
+                # tails on whoever wins (docs/read-plane.md)
+                log.warning("promote() refused: followers never lead")
                 return {"promoted": False, "reconciled": 0}
             self.role = "active"
             self.promotions += 1
@@ -410,7 +493,7 @@ class HACoordinator:
     def lag(self) -> int:
         """Records emitted by the source but not yet applied."""
         source = self.source
-        if self.role != "standby" or source is None:
+        if self.role == "active" or source is None:
             return 0
         return max(0, source.seq - self.applied_seq)
 
@@ -453,6 +536,22 @@ class HACoordinator:
             "verify_failures": self.verify_failures,
         }
 
+    def follower_gauge_values(self, now: float | None = None) -> dict:
+        """The ``nanotpu_follower_*`` gauge values — the read plane's
+        staleness contract on /metrics (docs/read-plane.md). Keys must
+        match the ``_FOLLOWER_GAUGES`` table in nanotpu/metrics/ha.py
+        exactly — the nanolint metrics-completeness pass pins the
+        equivalence both ways, same as the ``nanotpu_ha_*`` family."""
+        return {
+            "lag_events": self.lag(),
+            "lag_seconds": self.lag_seconds(now=now),
+            "lag_bound_events": self.read_lag_bound,
+            "synced": 1.0 if self.synced(now=now) else 0.0,
+            "draining": 1.0 if self.draining else 0.0,
+            "reads_refused": self.reads_refused,
+            "tail_retries": getattr(self.source, "tail_retries", 0),
+        }
+
     def status(self, now: float | None = None) -> dict:
         """``/debug/ha`` + timeline ``ha`` section body (sans records)."""
         out = {
@@ -466,6 +565,17 @@ class HACoordinator:
         }
         if self.suspect_deltas:
             out["suspect_deltas"] = self.suspect_deltas
+        if self.role == "follower":
+            # the read-plane block rides along only on followers, so
+            # existing active/standby /debug/ha bodies (and their golden
+            # schemas) stay byte-identical
+            out["follower"] = {
+                "synced": self.synced(now=now),
+                "draining": self.draining,
+                "reads_refused": self.reads_refused,
+                "lag_bound_events": self.read_lag_bound,
+                "lag_bound_s": self.read_lag_bound_s,
+            }
         if self.fence is not None:
             out["fence"] = self.fence.status(now=now)
         if self.last_verify is not None:
@@ -481,13 +591,26 @@ class HttpDeltaSource:
     (``.seq`` + ``.since()``) the coordinator tails. One GET per
     :meth:`poll`; a dead active (connection refused — the exact moment
     the lease is about to expire) just yields an empty window, and the
-    lease steal does the rest."""
+    lease steal does the rest.
+
+    Failed fetches (transport OR crc) back off with jitter instead of
+    re-fetching on the very next poll: a follower fleet tailing one
+    flapping leader link would otherwise hot-loop N pollers against a
+    server that is already struggling. The backoff doubles per
+    consecutive failure up to ``backoff_cap_s``, jittered ±50% so
+    followers de-synchronize; ``tail_retries`` counts the re-fetches
+    that ran after a failure window elapsed."""
 
     def __init__(self, base_url: str, timeout_s: float = 2.0,
-                 page: int = 2048):
+                 page: int = 2048, backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 2.0, clock=None, rng=None):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = float(timeout_s)
         self.page = int(page)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.clock = time.monotonic if clock is None else clock
+        self.rng = rng or random.Random()
         self.seq = 0
         self._records: list[dict] = []
         self._stale = False
@@ -497,6 +620,24 @@ class HttpDeltaSource:
         #: is a serialization boundary like the checkpoint file — a
         #: corrupt record is re-fetched next poll, never applied)
         self.crc_failures = 0
+        #: re-fetches attempted after a failure's backoff window
+        #: elapsed (exported as nanotpu_follower_tail_retries)
+        self.tail_retries = 0
+        #: consecutive failed fetches (resets on the first success)
+        self._fail_streak = 0
+        #: no fetch before this clock() reading while a streak is open
+        self._retry_at = 0.0
+
+    def _note_failure(self, now: float) -> None:
+        """Arm (or extend) the jittered backoff window: base * 2^streak
+        capped, then jittered into [0.5x, 1.5x) so a follower fleet
+        never re-fetches in lockstep."""
+        self._fail_streak += 1
+        delay = min(
+            self.backoff_cap_s,
+            self.backoff_base_s * (2 ** (self._fail_streak - 1)),
+        )
+        self._retry_at = now + delay * (0.5 + self.rng.random())
 
     def poll(self, since: int) -> None:
         import json as _json
@@ -504,6 +645,13 @@ class HttpDeltaSource:
 
         from nanotpu.ha.delta import verify_record
 
+        now = self.clock()
+        if self._fail_streak:
+            if now < self._retry_at:
+                # inside the backoff window: keep the (empty) window,
+                # the coordinator simply has nothing new to apply
+                return
+            self.tail_retries += 1
         url = f"{self.base_url}/debug/ha?since={int(since)}&limit={self.page}"
         try:
             with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
@@ -511,19 +659,22 @@ class HttpDeltaSource:
         except Exception:
             self.poll_errors += 1
             self._records = []
+            self._note_failure(now)
             return
         records = list(body.get("records") or [])
         if any(
             not verify_record(r) for r in records if "crc" in r
         ):
             # integrity failure on the tail transport: drop the whole
-            # window (the next poll re-fetches the same range) rather
+            # window (a later poll re-fetches the same range) rather
             # than apply a record whose bytes cannot be trusted.
             # Records WITHOUT a crc are a pre-integrity active — apply
             # them as before (version skew during a rolling upgrade).
             self.crc_failures += 1
             self._records = []
+            self._note_failure(now)
             return
+        self._fail_streak = 0
         self._stale = bool(body.get("stale_tail"))
         self._records = records
         self.seq = int((body.get("log") or {}).get("seq") or 0)
@@ -581,7 +732,17 @@ class HALoop:
         co = self.coordinator
         while not self._stop.wait(self.period_s):
             try:
-                if co.role == "standby":
+                if co.role == "follower":
+                    # the read plane: tail + stay warm, NEVER touch the
+                    # lease — a follower fleet must not stampede the
+                    # lease API or race the standby on leader loss
+                    # (docs/read-plane.md). The periodic dirty-window
+                    # reconcile keeps a long-lived follower convergent
+                    # across leader handovers (events whose deltas fell
+                    # in the gap).
+                    co.tail_once()
+                    co.reconcile_dirty()
+                elif co.role == "standby":
                     co.tail_once()
                     lease = co.lease
                     if lease is not None and lease.try_acquire():

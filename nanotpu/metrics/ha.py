@@ -8,7 +8,10 @@ nanolint metrics-completeness pass cross-checks :data:`_HA_GAUGES`
 against that producer BOTH directions (a suffix declared here but never
 produced, or produced there but never declared, is a lint finding) —
 the same honesty contract the throughput/timeline/SLO/serving families
-live under."""
+live under. The ``nanotpu_follower_*`` family (docs/read-plane.md) is
+pinned the same way against
+:meth:`HACoordinator.follower_gauge_values`, and registers only on
+followers."""
 
 from __future__ import annotations
 
@@ -24,7 +27,8 @@ _FAMILY = "nanotpu_ha_"
 _HA_GAUGES: dict[str, str] = {
     "role":
         "This replica's HA role: 1 = active (holds the leader lease, "
-        "serves writes), 0 = warm standby (tails the delta stream)",
+        "serves writes), 0 = warm standby or read-serving follower "
+        "(tails the delta stream)",
     "lag_events":
         "Delta records the active has emitted that this standby has not "
         "yet applied (0 on the active)",
@@ -74,6 +78,36 @@ _HA_GAUGES: dict[str, str] = {
 }
 
 
+_FOLLOWER_FAMILY = "nanotpu_follower_"
+
+#: gauge suffix -> help text for the read plane (docs/read-plane.md).
+#: Keys must match HACoordinator.follower_gauge_values() exactly —
+#: nanolint pins the equivalence both ways, same as _HA_GAUGES.
+_FOLLOWER_GAUGES: dict[str, str] = {
+    "lag_events":
+        "Delta records the leader has emitted that this follower has "
+        "not yet applied — the read plane's staleness, in events",
+    "lag_seconds":
+        "Age of the newest applied delta while records are pending — "
+        "the read plane's staleness, in seconds",
+    "lag_bound_events":
+        "The configured staleness bound: reads answer 503 NotSynced "
+        "once lag_events exceeds it (0 = unbounded)",
+    "synced":
+        "1 while this follower is inside its staleness bound and "
+        "serving reads; 0 = reads refuse with 503 NotSynced",
+    "draining":
+        "1 while the operator has pulled this follower out of read "
+        "rotation (rolling upgrade); the tail keeps running",
+    "reads_refused":
+        "Filter/Prioritize requests refused with 503 NotSynced because "
+        "the tail lag exceeded the staleness bound",
+    "tail_retries":
+        "Delta-tail re-fetches attempted after a failed fetch's "
+        "jittered backoff window elapsed (transport or crc failure)",
+}
+
+
 class HAExporter:
     """Registry-compatible renderer (``Registry.register``) for the HA
     gauges. Registered exactly when a coordinator is attached
@@ -93,6 +127,30 @@ class HAExporter:
         for suffix in sorted(_HA_GAUGES):
             name = _FAMILY + suffix
             out.append(f"# HELP {name} {_HA_GAUGES[suffix]}")
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {float(values[suffix])}")
+        return out
+
+
+class FollowerExporter:
+    """The ``nanotpu_follower_*`` family: registered by ``attach_ha``
+    exactly when the coordinator's role is ``follower``, so leaders,
+    standbys, and single-replica deployments export nothing new
+    (docs/read-plane.md)."""
+
+    def __init__(self, coordinator):
+        self.coordinator = coordinator
+
+    def render(self) -> list[str]:
+        out: list[str] = []
+        try:
+            values = self.coordinator.follower_gauge_values()
+        except Exception:
+            log.warning("follower gauge producer failed", exc_info=True)
+            return out
+        for suffix in sorted(_FOLLOWER_GAUGES):
+            name = _FOLLOWER_FAMILY + suffix
+            out.append(f"# HELP {name} {_FOLLOWER_GAUGES[suffix]}")
             out.append(f"# TYPE {name} gauge")
             out.append(f"{name} {float(values[suffix])}")
         return out

@@ -65,7 +65,9 @@ VERSION = "0.1.0"
 #: are admission-gate-exempt like /healthz — an overloaded scheduler is
 #: exactly when its diagnostics matter — and the overload tests
 #: parametrize over this tuple so a new endpoint joins the exemption
-#: pin automatically (docs/observability.md).
+#: pin automatically (docs/observability.md). The follower lifecycle
+#: routes (POST /debug/ha/drain, /debug/ha/rejoin — docs/read-plane.md)
+#: ride the ``/debug/ha`` prefix, so the pin covers them too.
 DEBUG_ROUTES = (
     "/debug/pprof",
     "/debug/traces/",
@@ -280,6 +282,9 @@ class SchedulerAPI:
         #: serves GET /debug/ha. None == single-replica == zero new code
         #: on any request path.
         self.ha = None
+        #: server-side page bound for /debug/ha?since= — attach_ha's
+        #: max_records overrides; a client limit= above it is clamped
+        self.ha_max_records = 2048
         #: degraded-mode monitor (docs/ha.md "Degraded mode"), attached
         #: by attach_degraded: binds 503 Degraded + Retry-After while
         #: the apiserver is unreachable past budget. None costs one
@@ -330,6 +335,12 @@ class SchedulerAPI:
                 return self._debug_decisions(path)
             if method == "GET" and path.startswith("/debug/timeline"):
                 return self._debug_timeline(path)
+            if method == "POST" and path == "/debug/ha/drain":
+                # follower lifecycle (docs/read-plane.md): pull this
+                # replica out of read rotation for a rolling upgrade
+                return self._debug_ha_lifecycle("drain")
+            if method == "POST" and path == "/debug/ha/rejoin":
+                return self._debug_ha_lifecycle("rejoin")
             if method == "GET" and path.startswith("/debug/ha"):
                 return self._debug_ha(path)
             if method == "GET" and path.startswith("/debug/verify"):
@@ -351,19 +362,49 @@ class SchedulerAPI:
             and self.ha is not None
             and not self.ha.is_leader()
         ):
-            # leader gate on the WRITE verb (docs/ha.md): a standby must
-            # never commit chips or apiserver writes — kube-scheduler's
-            # retry lands on the active (readiness steers the Service
-            # there; this gate is the backstop for direct traffic).
-            # Filter/Prioritize stay answerable: reads off the warm
-            # snapshots are harmless and keep the standby's caches hot.
+            # leader gate on the WRITE verb (docs/ha.md): a standby or
+            # follower must never commit chips or apiserver writes —
+            # kube-scheduler's retry lands on the active (readiness
+            # steers the Service there; this gate is the backstop for
+            # direct traffic). Filter/Prioritize stay answerable: reads
+            # off the warm snapshots are harmless and keep the caches
+            # hot. LeaderHint carries the tail source's base URL so a
+            # routing client can redirect without a second probe
+            # (docs/read-plane.md).
             self.resilience.inc("shed", verb.name)
             self.verb_total.inc(verb=verb.name, code="503")
             return 503, "application/json", error_body(
                 "NotLeader",
-                "this replica is the warm standby; binds commit only "
+                f"this replica is a {self.ha.role}; binds commit only "
                 "on the leader (docs/ha.md)",
                 Role=self.ha.role,
+                LeaderHint=getattr(self.ha.source, "base_url", ""),
+                RetryAfterSeconds=self.overload.retry_after_s,
+            )
+        if (
+            verb.name != "bind"
+            and self.ha is not None
+            and self.ha.role == "follower"
+            and not self.ha.ready_to_serve()
+        ):
+            # bounded-staleness contract (docs/read-plane.md): a
+            # follower past its lag bound (or draining for an upgrade)
+            # answers 503 NotSynced instead of serving bytes staler
+            # than the bound promises — the client's next try lands on
+            # a synced follower or the leader. Never silently stale.
+            self.ha.reads_refused += 1
+            self.resilience.inc("shed", verb.name)
+            self.verb_total.inc(verb=verb.name, code="503")
+            why = ("draining" if self.ha.draining
+                   else "past its staleness bound")
+            return 503, "application/json", error_body(
+                "NotSynced",
+                f"follower {why}; reads refuse rather than "
+                "answer stale (docs/read-plane.md)",
+                Role=self.ha.role,
+                LagEvents=self.ha.lag(),
+                Draining=bool(self.ha.draining),
+                LeaderHint=getattr(self.ha.source, "base_url", ""),
                 RetryAfterSeconds=self.overload.retry_after_s,
             )
         monitor = self.degraded
@@ -554,9 +595,10 @@ class SchedulerAPI:
             # the batch cycle commits binds — same leader gate as /bind
             return 503, "application/json", error_body(
                 "NotLeader",
-                "this replica is the warm standby; batch admission "
+                f"this replica is a {self.ha.role}; batch admission "
                 "commits only on the leader (docs/ha.md)",
                 Role=self.ha.role,
+                LeaderHint=getattr(self.ha.source, "base_url", ""),
                 RetryAfterSeconds=self.overload.retry_after_s,
             )
         monitor = self.degraded
@@ -739,19 +781,58 @@ class SchedulerAPI:
         )
 
     # -- HA (docs/ha.md) ---------------------------------------------------
-    def attach_ha(self, coordinator) -> None:
+    def attach_ha(self, coordinator, max_records: int = 2048) -> None:
         """Adopt the replica's HA coordinator: register the
         ``nanotpu_ha_*`` exporter, gate the write verbs on leadership,
-        add the leader readiness gate (a standby answers /readyz 503 so
-        the Service steers kube-scheduler to the active — failover flips
-        it within one probe period), and serve ``GET /debug/ha``.
-        Single-replica deployments never call this and change by
-        nothing."""
-        from nanotpu.metrics.ha import HAExporter
+        add the role's readiness gate, and serve ``GET /debug/ha``
+        (paged at ``max_records`` per response). Single-replica
+        deployments never call this and change by nothing.
+
+        The readiness gate is role-shaped (docs/read-plane.md): an
+        active/standby pair gates on leadership (a standby answers
+        /readyz 503 so the write Service steers kube-scheduler to the
+        active — failover flips it within one probe period), while a
+        follower gates on ``ready_to_serve`` — synced within its lag
+        bound and not draining — so the READ Service only routes to
+        followers whose staleness the contract covers. Followers also
+        register the ``nanotpu_follower_*`` exporter."""
+        from nanotpu.metrics.ha import FollowerExporter, HAExporter
 
         self.ha = coordinator
+        self.ha_max_records = max(1, int(max_records))
         self.registry.register(HAExporter(coordinator))
-        self.add_ready_check("ha-leader", coordinator.is_leader)
+        if coordinator.role == "follower":
+            self.registry.register(FollowerExporter(coordinator))
+            self.add_ready_check(
+                "ha-follower-synced", coordinator.ready_to_serve
+            )
+        else:
+            self.add_ready_check("ha-leader", coordinator.is_leader)
+
+    def _debug_ha_lifecycle(self, op: str) -> tuple[int, str, str]:
+        """``POST /debug/ha/drain`` / ``/debug/ha/rejoin``: follower
+        read-rotation lifecycle (docs/read-plane.md). Drain flips the
+        replica's /readyz to 503 so the read Service stops routing new
+        work while the delta tail keeps running — a rolling upgrade
+        restarts a drained follower without serving one stale byte;
+        rejoin re-arms serving once the tail is back inside the bound.
+        Covered by the ``/debug/ha`` DEBUG_ROUTES admission-exemption
+        prefix like every debug route. 409 on non-followers: leaders
+        and standbys are not in read rotation."""
+        if self.ha is None:
+            return 404, "application/json", error_body(
+                "NotFound",
+                "HA disabled; start a replicated pair (docs/ha.md)",
+            )
+        if self.ha.role != "follower":
+            return 409, "application/json", error_body(
+                "NotFollower",
+                f"{op} applies to read-plane followers; this replica "
+                f"is a {self.ha.role} (docs/read-plane.md)",
+                Role=self.ha.role,
+            )
+        out = self.ha.drain() if op == "drain" else self.ha.rejoin()
+        return 200, "application/json", json.dumps(out, sort_keys=True)
 
     def _debug_ha(self, path: str) -> tuple[int, str, str]:
         """``GET /debug/ha?since=<seq>&limit=N``: role + stream status,
@@ -769,7 +850,14 @@ class SchedulerAPI:
         )
         try:
             since = int(params.get("since", -1))
-            limit = min(max(int(params.get("limit", 512)), 1), 4096)
+            # page bound: a follower fleet's tail polls must not make
+            # one request a full-log dump — limit clamps to the
+            # server-side max_records (attach_ha), pinned by the
+            # paging test in tests/test_followers.py
+            limit = min(
+                max(int(params.get("limit", 512)), 1),
+                self.ha_max_records,
+            )
         except ValueError:
             return 400, "application/json", error_body(
                 "BadRequest", "since and limit must be integers"

@@ -313,8 +313,18 @@ class Simulator:
         #: recovery plane's legitimate strip-and-rebind flows — absent
         #: there — can never false-positive)
         self._bound_nodes: dict[str, str] = {}
+        #: read-plane follower stacks (docs/read-plane.md) + the run's
+        #: read-availability ledger: each sample event asks every
+        #: follower "would a Filter/Prioritize answer right now?" —
+        #: ready_to_serve() counts ok, past-bound counts refused
+        #: (NotSynced), never silently stale
+        self.followers: list[_StandbyStack] = []
+        self._follower_reads_ok = 0
+        self._follower_reads_refused = 0
         if self.scenario["ha"]["enabled"]:
             self._build_standby()
+            for _ in range(self.scenario["ha"]["followers"]):
+                self._build_follower()
 
         self.report = ReportBuilder(self.scenario, seed)
         self._heap: list[tuple[float, int, object, object]] = []
@@ -439,6 +449,10 @@ class Simulator:
             sb = getattr(self, "standby", None)
             if sb is not None:
                 sb.coordinator.rebase(self.dealer.ha)
+            for fl in getattr(self, "followers", []):
+                # an agent restart mints a fresh log; the follower fleet
+                # re-tails it exactly like the standby does
+                fl.coordinator.rebase(self.dealer.ha)
         else:
             self.ha_active = None
         self._wire_dealer()
@@ -573,6 +587,55 @@ class Simulator:
             self.client.watch_pods(), self.client.watch_nodes(),
             lease=lease, fence=fence, tap=tap, monitor=monitor,
         )
+
+    def _build_follower(self) -> None:
+        """One read-plane follower stack (docs/read-plane.md): its own
+        dealer + RCU snapshots tailing the CURRENT active's delta log
+        within the same lag window the standby models, standby-mode
+        controller for the informer cache, and a coordinator that never
+        leases and never leads. Reuses ``_StandbyStack`` (lease-less) —
+        the cut/tap state follows the process shape exactly like the
+        standby's. Followers draw nothing from any rng stream, so
+        ``ha.followers`` can never shift a sibling stream (the same
+        isolation rule every fault toggle lives under)."""
+        from nanotpu.ha import HACoordinator
+
+        start_seq = self.dealer.ha.seq
+        tap = BrownoutClient(self.client, self.faults)
+        api_client = ResilientClientset(
+            tap,
+            counters=self.resilience,
+            clock=lambda: self.now,
+            sleep=lambda s: None,
+            rng=self.rng_retry,
+        )
+        fd = Dealer(
+            api_client, make_rater(self.scenario["policy"]),
+            assume_workers=2, obs=self.obs,
+            shards=self.scenario["shards"],
+            pipeline_depth=self.scenario["pipeline"],
+        )
+        fc = Controller(
+            self.client, fd, resync_period_s=0,
+            queue_max=self.scenario["queue_max"], assume_ttl_s=0,
+            resilience=self.resilience, obs=self.obs,
+        )
+        fc.enter_standby()
+        fc.resync_once()  # standby mode: cache prime + synced() gate
+        coordinator = HACoordinator(
+            fd, role="follower", source=self.dealer.ha, controller=fc,
+            lag_events=self.scenario["ha"]["lag_events"],
+            clock=lambda: self.now,
+        )
+        coordinator.applied_seq = start_seq
+        coordinator.read_lag_bound = self.scenario["ha"][
+            "follower_lag_bound"
+        ]
+        self.followers.append(_StandbyStack(
+            fd, fc, coordinator,
+            self.client.watch_pods(), self.client.watch_nodes(),
+            tap=tap,
+        ))
 
     def _push(self, t: float, kind: str, payload=None) -> None:
         heapq.heappush(self._heap, (t, next(self._seq), kind, payload))
@@ -812,25 +875,27 @@ class Simulator:
         self._pump_standby()
 
     def _pump_standby(self) -> None:
-        """Deliver the standby's informer events (fault-free: the
-        faults under test live on the active's tap) and tail the delta
-        stream within the configured lag — the standby replica's event
-        loop, stepped deterministically on the sim thread."""
-        sb = self.standby
-        if sb is None:
-            return
-        if not (sb.tap is not None and sb.tap.partitioned):
-            for watch, handler in (
-                (sb.node_watch, sb.controller.handle_node_event),
-                (sb.pod_watch, sb.controller.handle_pod_event),
-            ):
-                while True:
-                    event = watch.poll(timeout=0.0)
-                    if event is None:
-                        break
-                    handler(event)
-        if not self._stream_cut:
-            sb.coordinator.tail_once()
+        """Deliver the standby's and every follower's informer events
+        (fault-free: the faults under test live on the active's tap)
+        and tail the delta stream within the configured lag — each
+        replica's event loop, stepped deterministically on the sim
+        thread."""
+        for sb in ([self.standby] if self.standby is not None else []) \
+                + self.followers:
+            if not (sb.tap is not None and sb.tap.partitioned):
+                for watch, handler in (
+                    (sb.node_watch, sb.controller.handle_node_event),
+                    (sb.pod_watch, sb.controller.handle_pod_event),
+                ):
+                    while True:
+                        event = watch.poll(timeout=0.0)
+                        if event is None:
+                            break
+                        handler(event)
+            # the stream-cut fault severs every replica tailing the
+            # active — standby and follower fleet alike
+            if not self._stream_cut:
+                sb.coordinator.tail_once()
 
     # -- scheduling cycle ----------------------------------------------------
     def _live_node_names(self) -> list[str]:
@@ -1297,6 +1362,18 @@ class Simulator:
                     f"but live annotations say {occ_truth:.6f}"
                 ),
             })
+        # the follower fleet re-anchors its tails onto the promoted
+        # leader's fresh delta log (docs/read-plane.md) — each
+        # follower's own warm state keeps serving throughout, and the
+        # availability ledger witnesses it: a follower that came out of
+        # the re-anchor unable to serve counts a refused read
+        for fl in self.followers:
+            fl.coordinator.rebase(self.dealer.ha)
+            if fl.coordinator.ready_to_serve(now=self.now):
+                self._follower_reads_ok += 1
+            else:
+                self._follower_reads_refused += 1
+                fl.coordinator.reads_refused += 1
         # pending pods retry against the new leader immediately — the
         # sim analogue of kube-scheduler's retry landing on the freshly
         # ready replica
@@ -1451,6 +1528,16 @@ class Simulator:
         self._wire_dealer()
         self.controller.drain_sync()
         self.standby = old
+        # the follower fleet re-tails the new leader's stream, serving
+        # throughout — same re-anchor + availability accounting as the
+        # crash path (docs/read-plane.md)
+        for fl in self.followers:
+            fl.coordinator.rebase(self.dealer.ha)
+            if fl.coordinator.ready_to_serve(now=self.now):
+                self._follower_reads_ok += 1
+            else:
+                self._follower_reads_refused += 1
+                fl.coordinator.reads_refused += 1
         # pending pods retry against the new leader immediately — the
         # sim analogue of kube-scheduler's retry landing on the freshly
         # ready replica
@@ -1802,6 +1889,17 @@ class Simulator:
         self.report.journal(
             self.now, f"sample occ={occ:.6f} frag={frag:.4f}"
         )
+        # read-availability ledger (docs/read-plane.md): each sample is
+        # a virtual client asking every follower for a read — within
+        # the staleness bound answers, past it refuses (NotSynced).
+        # Counters only; the journal line above stays byte-identical
+        # with followers off.
+        for fl in self.followers:
+            if fl.coordinator.ready_to_serve(now=self.now):
+                self._follower_reads_ok += 1
+            else:
+                self._follower_reads_refused += 1
+                fl.coordinator.reads_refused += 1
 
     def _on_retry(self) -> None:
         if not self._pending:
@@ -1866,6 +1964,7 @@ class Simulator:
         for side_tap in (
             self._active_tap,
             self.standby.tap if self.standby is not None else None,
+            *(fl.tap for fl in self.followers),
         ):
             if side_tap is not None:
                 side_tap.partitioned = False
@@ -1995,6 +2094,51 @@ class Simulator:
                 f"applied={self.report.ha['applied_deltas']} "
                 f"standby_drift={sb_drift:.6f}",
             )
+            if self.followers:
+                # the read-plane certification (docs/read-plane.md):
+                # every follower drains its remaining lag at settle and
+                # must then agree with the durable annotations exactly —
+                # byte-for-byte the same convergence bar the standby
+                # meets, held by N replicas at once. Block and journal
+                # line appear only with followers on, so every existing
+                # digest stays byte-identical.
+                fl_drift = 0.0
+                for fl in self.followers:
+                    fl.coordinator.lag_events = 0
+                self._pump_standby()
+                for i, fl in enumerate(self.followers):
+                    fl_occ = fl.dealer.occupancy()
+                    fl_truth = ground_truth_occupancy(
+                        fl.dealer, self.client
+                    )
+                    drift_i = abs(fl_occ - fl_truth)
+                    fl_drift = max(fl_drift, drift_i)
+                    if drift_i > 1e-9:
+                        self.report.violations.append({
+                            "kind": "follower_occupancy_drift",
+                            "detail": (
+                                f"settled follower {i} holds occupancy "
+                                f"{fl_occ:.6f} but live annotations "
+                                f"say {fl_truth:.6f}"
+                            ),
+                        })
+                self.report.ha["followers"] = {
+                    "count": len(self.followers),
+                    "applied_deltas": sum(
+                        fl.coordinator.applied_deltas
+                        for fl in self.followers
+                    ),
+                    "reads_ok": self._follower_reads_ok,
+                    "reads_refused": self._follower_reads_refused,
+                    "max_drift_pct": round(100 * fl_drift, 6),
+                }
+                self.report.journal(
+                    horizon,
+                    f"followers n={len(self.followers)} "
+                    f"reads_ok={self._follower_reads_ok} "
+                    f"reads_refused={self._follower_reads_refused} "
+                    f"max_drift={fl_drift:.6f}",
+                )
             if self.scenario["ha"]["lease"]["enabled"]:
                 self._settle_lease(horizon)
         # deterministic serving section (docs/serving-loop.md)

@@ -98,10 +98,22 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
                                      # recovery/batch/autoscale cycles
                                      # skip while the active is
                                      # degraded, transitions journaled
-        "promotion_bound": 0         # >0: settle asserts total
+        "promotion_bound": 0,        # >0: settle asserts total
                                      # promotions <= this (violation
                                      # otherwise) — the promotion-storm
                                      # certification
+        "followers": 0,              # >0: that many read-plane follower
+                                     # stacks (docs/read-plane.md) tail
+                                     # the leader's stream, answer reads
+                                     # within follower_lag_bound, and
+                                     # re-anchor across crashes; settle
+                                     # asserts zero occupancy drift and
+                                     # zero read downtime. 0 keeps every
+                                     # existing digest byte-identical
+        "follower_lag_bound": 256    # staleness bound in delta events:
+                                     # past it a follower's sampled read
+                                     # counts as refused (NotSynced),
+                                     # never as stale bytes served
       },
       "resync_every_s": 5.0,
       "sample_every_s": 1.0,
@@ -517,6 +529,8 @@ def normalize_scenario(raw: dict) -> dict:
     ha = {
         "enabled": bool(ha_raw.get("enabled", False)),
         "lag_events": int(ha_raw.get("lag_events", 8)),
+        "followers": int(ha_raw.get("followers", 0)),
+        "follower_lag_bound": int(ha_raw.get("follower_lag_bound", 256)),
         "lease": {
             "enabled": bool(lease_raw.get("enabled", False)),
             "ttl_s": float(lease_raw.get("ttl_s", 1.0)),
@@ -533,6 +547,15 @@ def normalize_scenario(raw: dict) -> dict:
     _require(
         ha["lag_events"] >= 0,
         "ha.lag_events must be >= 0",
+    )
+    _require(
+        ha["followers"] >= 0 and ha["follower_lag_bound"] >= 0,
+        "ha.followers and ha.follower_lag_bound must be >= 0",
+    )
+    _require(
+        ha["followers"] == 0 or ha["enabled"],
+        "ha.followers requires ha.enabled (followers tail the "
+        "leader's delta stream)",
     )
     lease = ha["lease"]
     if lease["enabled"]:

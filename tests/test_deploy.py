@@ -46,7 +46,10 @@ def test_ha_manifest_matches_cli_and_lease_rbac():
     leader-aware readiness probe, and lease RBAC for the acquire /
     renew / steal dance."""
     docs = _docs("nanotpu-scheduler-ha.yaml")
-    (dep,) = _by_kind(docs, "Deployment")
+    (dep,) = [
+        d for d in _by_kind(docs, "Deployment")
+        if d["metadata"]["name"] == "nanotpu-scheduler"
+    ]
     assert dep["spec"]["replicas"] == 2
     c = dep["spec"]["template"]["spec"]["containers"][0]
     args = c["args"]
@@ -70,6 +73,43 @@ def test_ha_manifest_matches_cli_and_lease_rbac():
     assert rule["apiGroups"] == ["coordination.k8s.io"]
     assert rule["resources"] == ["leases"]
     assert {"get", "create", "update"} <= set(rule["verbs"])
+
+
+def test_follower_manifest_matches_cli_and_leader_service():
+    """The follower fleet (docs/read-plane.md): the read Deployment
+    spells the --role/--follower-lag-bound flags as cmd/main registers
+    them, tails through the leader Service (so its poll only ever
+    reaches the lease holder), gates rotation on /readyz, and drains
+    via POST on preStop; the two Services split by tier label."""
+    docs = _docs("nanotpu-scheduler-ha.yaml")
+    (dep,) = [
+        d for d in _by_kind(docs, "Deployment")
+        if d["metadata"]["name"] == "nanotpu-scheduler-follower"
+    ]
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    args = c["args"]
+    assert "--ha" in args  # --role follower requires --ha (cmd/main)
+    assert "--role=follower" in args
+    assert any(a.startswith("--follower-lag-bound=") for a in args)
+    svcs = {s["metadata"]["name"]: s for s in _by_kind(docs, "Service")}
+    leader = svcs["nanotpu-scheduler-leader"]
+    peer = next(a for a in args if a.startswith("--ha-peer="))
+    # the tail targets the leader Service by its in-cluster DNS name on
+    # the Service's own port — the stream every follower must follow
+    assert leader["metadata"]["name"] in peer
+    assert str(leader["spec"]["ports"][0]["port"]) in peer
+    assert leader["spec"]["selector"]["tier"] == "leader-pair"
+    read = svcs["nanotpu-scheduler-read"]
+    assert read["spec"]["selector"]["tier"] == "follower"
+    assert read["spec"]["selector"] == {
+        k: v
+        for k, v in dep["spec"]["template"]["metadata"]["labels"].items()
+        if k in read["spec"]["selector"]
+    }
+    assert c["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    assert c["readinessProbe"]["periodSeconds"] == 1
+    pre = c["lifecycle"]["preStop"]["exec"]["command"]
+    assert "/debug/ha/drain" in " ".join(pre)  # POST-only route: exec, not httpGet
 
 
 def test_scheduler_deployment_args_match_cli():

@@ -1,0 +1,533 @@
+"""Read-plane follower fleet (ISSUE r17 tentpole, docs/read-plane.md).
+
+The load-bearing properties:
+
+* **Parity pin** — a follower that tails the leader's delta stream
+  answers Filter/Prioritize BYTE-IDENTICALLY to the leader over a
+  seeded real-dispatch event sequence (the test_shard.py parity
+  pattern, pointed at replication instead of sharding): followers are
+  a throughput partition of the read plane, never a policy change.
+* **Bounded staleness** — a follower past its lag bound answers 503
+  ``NotSynced`` (and counts the refusal), NEVER stale bytes; catching
+  up restores byte-equal service.
+* **Bind safety** — a follower answers binds 503 ``NotLeader`` with a
+  ``LeaderHint``, refuses promote(), and its never-armed epoch fence
+  fast-fails any bind that slips past the HTTP gate (the
+  deposed-epoch backstop).
+* **Operability** — /debug/ha paging honors the server-side
+  ``max_records`` bound, drain/rejoin pull a follower out of (and back
+  into) read rotation, /readyz gates on ``ready_to_serve``, and the
+  ``nanotpu_follower_*`` gauges render from the one pinned producer.
+* **Fleet certification** — the sim's ``ha.followers`` knob runs N
+  follower stacks through chaos with a reproducible digest, zero
+  convergence drift, and zero read downtime across promotions; with
+  followers off, every existing scenario digest stays byte-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from nanotpu import native
+from nanotpu.allocator.rater import make_rater
+from nanotpu.cmd.main import make_mock_cluster
+from nanotpu.controller.controller import Controller
+from nanotpu.dealer import Dealer
+from nanotpu.ha import DeltaLog, HACoordinator
+from nanotpu.ha.standby import HttpDeltaSource
+from nanotpu.k8s.objects import make_container, make_pod
+from nanotpu.metrics.registry import Registry
+from nanotpu.routes.server import SchedulerAPI
+from nanotpu.sim.fleet import make_fleet
+from nanotpu import types
+
+FLEET_SPEC = {
+    "pools": [
+        {"generation": "v5p", "hosts": 8, "slice_hosts": 4,
+         "prefix": "v5p-a", "slice_prefix": "fama"},
+        {"generation": "v4", "hosts": 4, "prefix": "v4-host",
+         "slice_prefix": "v4slice"},
+    ]
+}
+
+POD_SHAPES = (50, 100, 200, 400)
+
+
+def _mk_pod(client, name: str, percent: int, gang: str | None = None):
+    ann = {}
+    if gang:
+        ann = {
+            types.ANNOTATION_GANG_NAME: gang,
+            types.ANNOTATION_GANG_SIZE: "4",
+        }
+    return client.create_pod(
+        make_pod(
+            name,
+            containers=[
+                make_container("t", {types.RESOURCE_TPU_PERCENT: percent})
+            ],
+            annotations=ann,
+        )
+    )
+
+
+class _Replica:
+    """One replica's serving surface over a SHARED cluster: leader or
+    follower, each with its own dealer + API (the follower's state
+    arrives only via the delta tail, exactly like production)."""
+
+    def __init__(self, client, dealer, coordinator):
+        self.client = client
+        self.dealer = dealer
+        self.coordinator = coordinator
+        self.api = SchedulerAPI(dealer, Registry())
+        self.api.attach_ha(coordinator)
+        self.nodes = [n.name for n in client.list_nodes()]
+
+    def verb(self, path: str, body: bytes):
+        code, _ctype, payload = self.api.dispatch("POST", path, body)
+        assert code == 200, (path, code, payload)
+        return payload if isinstance(payload, bytes) else payload.encode()
+
+    def close(self):
+        self.dealer.close()
+
+
+def _leader_follower(lag_bound: int = 256):
+    """A leader emitting its delta stream + one follower tailing it
+    in-process (the HttpDeltaSource transport is pinned separately in
+    test_ha.py — the apply path is identical either way)."""
+    client = make_fleet(FLEET_SPEC)
+    log_ = DeltaLog()
+    ld = Dealer(client, make_rater("binpack"), ha_log=log_)
+    leader = _Replica(
+        client, ld, HACoordinator(ld, role="active", log_=log_)
+    )
+    fd = Dealer(client, make_rater("binpack"))
+    fc = Controller(client, fd, resync_period_s=0, assume_ttl_s=0)
+    fc.enter_standby()
+    fc.resync_once()
+    co = HACoordinator(fd, role="follower", source=log_, controller=fc)
+    co.read_lag_bound = lag_bound
+    follower = _Replica(client, fd, co)
+    return leader, follower
+
+
+@pytest.fixture
+def pair():
+    leader, follower = _leader_follower()
+    yield leader, follower
+    leader.close()
+    follower.close()
+
+
+class TestFollowerParity:
+    """The parity pin: over a seeded sequence of real dispatches
+    (schedules, binds, releases, gangs, fractional pods), a synced
+    follower's Filter/Prioritize bytes equal the leader's."""
+
+    def _read_parity(self, leader, follower, pod, nodes) -> bytes:
+        args = json.dumps(
+            {"Pod": pod.raw, "NodeNames": nodes}, separators=(",", ":")
+        ).encode()
+        filt_l = leader.verb("/scheduler/filter", args)
+        filt_f = follower.verb("/scheduler/filter", args)
+        assert filt_l == filt_f
+        prio_l = leader.verb("/scheduler/priorities", args)
+        prio_f = follower.verb("/scheduler/priorities", args)
+        assert prio_l == prio_f
+        return filt_l
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_event_sequence_parity(self, pair, seed):
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        leader, follower = pair
+        rng = random.Random(seed)
+        bound: list = []
+        for step in range(30):
+            roll = rng.random()
+            if roll < 0.7 or not bound:
+                percent = rng.choice(POD_SHAPES)
+                gang = f"g{step % 3}" if rng.random() < 0.3 else None
+                pod = _mk_pod(
+                    leader.client, f"p-{seed}-{step}", percent, gang
+                )
+                filt = self._read_parity(
+                    leader, follower, pod, leader.nodes
+                )
+                feasible = json.loads(filt)["NodeNames"]
+                if feasible:
+                    bind = json.dumps({
+                        "PodName": pod.name, "PodNamespace": "default",
+                        "PodUID": pod.uid, "Node": feasible[0],
+                    }).encode()
+                    res = leader.verb("/scheduler/bind", bind)
+                    if json.loads(res)["Error"] == "":
+                        bound.append(pod)
+            else:
+                pod = bound.pop(rng.randrange(len(bound)))
+                assert leader.dealer.release(pod)
+            # the follower's event loop: tail the stream, then both
+            # replicas must agree byte-for-byte on the next read
+            follower.coordinator.tail_once()
+        assert follower.coordinator.lag() == 0
+        assert leader.dealer.occupancy() == follower.dealer.occupancy()
+        snap_l = leader.dealer.debug_snapshot()
+        snap_f = follower.dealer.debug_snapshot()
+        assert snap_l["tracked_uids"] == snap_f["tracked_uids"]
+        assert snap_l["accounted"] == snap_f["accounted"]
+
+
+class TestBoundedStaleness:
+    def test_reads_refuse_past_bound_never_stale_bytes(self):
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        leader, follower = _leader_follower(lag_bound=4)
+        try:
+            for i in range(6):
+                pod = _mk_pod(leader.client, f"lag-{i}", 100)
+                ok, _ = leader.dealer.assume([f"v4-host-{i % 4}"], pod)
+                leader.dealer.bind(ok[0], pod)
+            # 6 unapplied deltas > bound 4: the follower must refuse,
+            # not answer from its (stale) snapshots
+            assert follower.coordinator.lag() >= 6
+            assert not follower.coordinator.synced()
+            probe = _mk_pod(leader.client, "probe", 100)
+            args = json.dumps({
+                "Pod": probe.raw, "NodeNames": follower.nodes,
+            }).encode()
+            code, _, payload = follower.api.dispatch(
+                "POST", "/scheduler/filter", args
+            )
+            assert code == 503
+            body = json.loads(payload)
+            assert body["Reason"] == "NotSynced"
+            assert body["Role"] == "follower"
+            assert body["LagEvents"] >= 6
+            assert follower.coordinator.reads_refused == 1
+            # binds keep their own gate (NotLeader, not NotSynced)
+            code, _, payload = follower.api.dispatch(
+                "POST", "/scheduler/bind",
+                json.dumps({
+                    "PodName": "x", "PodNamespace": "default",
+                    "PodUID": "u", "Node": "v4-host-0",
+                }).encode(),
+            )
+            assert json.loads(payload)["Reason"] == "NotLeader"
+            # catching up restores byte-equal service
+            follower.coordinator.tail_once()
+            assert follower.coordinator.synced()
+            filt_f = follower.verb("/scheduler/filter", args)
+            filt_l = leader.verb("/scheduler/filter", args)
+            assert filt_f == filt_l
+        finally:
+            leader.close()
+            follower.close()
+
+    def test_time_bound_refuses_aged_lag(self):
+        now = [0.0]
+        dealer = Dealer(make_mock_cluster(2), make_rater("binpack"))
+        log_ = DeltaLog(clock=lambda: now[0])
+        src_dealer = Dealer(
+            make_mock_cluster(2), make_rater("binpack"), ha_log=log_
+        )
+        try:
+            co = HACoordinator(
+                dealer, role="follower", source=log_,
+                clock=lambda: now[0],
+            )
+            co.read_lag_bound = 0  # events unbounded
+            co.read_lag_bound_s = 2.0
+
+            def _bind(name, node):
+                pod = src_dealer.client.create_pod(
+                    make_pod(name, containers=[
+                        make_container(
+                            "t", {types.RESOURCE_TPU_PERCENT: 100}
+                        )
+                    ])
+                )
+                ok, _ = src_dealer.assume([node], pod)
+                src_dealer.bind(ok[0], pod)
+
+            now[0] = 1.0  # nonzero so last_applied_t is meaningful
+            _bind("t0", "v5p-host-0")
+            co.tail_once()  # stamps last_applied_t at now=1
+            _bind("t1", "v5p-host-1")  # pending: the lag starts aging
+            assert co.lag() > 0
+            assert co.synced(now=2.0)  # young lag: inside the bound
+            now[0] = 5.0
+            assert not co.synced(now=5.0)  # same lag, aged out
+            co.tail_once()
+            assert co.synced(now=5.0)
+        finally:
+            dealer.close()
+            src_dealer.close()
+
+
+class TestBindSafety:
+    def test_follower_bind_503_with_leader_hint(self):
+        client = make_mock_cluster(2)
+        fd = Dealer(client, make_rater("binpack"))
+        try:
+            co = HACoordinator(
+                fd, role="follower",
+                source=HttpDeltaSource("http://leader:10251/"),
+            )
+            api = SchedulerAPI(fd, Registry())
+            api.attach_ha(co)
+            code, _, payload = api.dispatch(
+                "POST", "/scheduler/bind",
+                json.dumps({
+                    "PodName": "x", "PodNamespace": "default",
+                    "PodUID": "u1", "Node": "v5p-host-0",
+                }).encode(),
+            )
+            assert code == 503
+            body = json.loads(payload)
+            assert body["Reason"] == "NotLeader"
+            assert body["Role"] == "follower"
+            # the tail source IS the leader: clients redirect without
+            # a second probe (trailing slash normalized away)
+            assert body["LeaderHint"] == "http://leader:10251"
+        finally:
+            fd.close()
+
+    def test_never_armed_fence_fast_fails_an_inprocess_bind(self):
+        """The deposed-epoch backstop: even if a bind slips PAST the
+        HTTP gate (operator curl, future bug), a follower's fence was
+        never armed by any lease term, so the apiserver write dies
+        typed and the chips roll back — a follower can never commit."""
+        from nanotpu.dealer.dealer import BindError
+        from nanotpu.ha.fence import EpochFence
+        from nanotpu.k8s.resilience import ResilientClientset
+        from nanotpu.obs.decisions import REASON_FENCED
+
+        client = make_mock_cluster(2)
+        rc = ResilientClientset(
+            client, clock=lambda: 0.0, sleep=lambda s: None
+        )
+        rc.fence = EpochFence(clock=lambda: 0.0)  # never armed
+        fd = Dealer(rc, make_rater("binpack"))
+        try:
+            pod = client.create_pod(
+                make_pod("sneak", containers=[
+                    make_container(
+                        "t", {types.RESOURCE_TPU_PERCENT: 100}
+                    )
+                ])
+            )
+            ok, _ = fd.assume(fd.node_names(), pod)
+            with pytest.raises(BindError) as exc:
+                fd.bind(ok[0], pod)
+            assert exc.value.reason == REASON_FENCED
+            assert fd.occupancy() == 0.0
+            assert not fd.tracks(pod.uid)
+            assert rc.fence.rejections >= 1
+        finally:
+            fd.close()
+
+    def test_promote_refused_for_followers(self):
+        fd = Dealer(make_mock_cluster(2), make_rater("binpack"))
+        try:
+            co = HACoordinator(fd, role="follower", source=DeltaLog())
+            out = co.promote()
+            assert out == {"promoted": False, "reconciled": 0}
+            assert co.role == "follower"
+            assert co.promotions == 0
+        finally:
+            fd.close()
+
+
+class TestDebugHaPaging:
+    def _active_api(self, max_records=None):
+        client = make_mock_cluster(4)
+        log_ = DeltaLog()
+        ad = Dealer(client, make_rater("binpack"), ha_log=log_)
+        api = SchedulerAPI(ad, Registry())
+        co = HACoordinator(ad, role="active", log_=log_)
+        if max_records is None:
+            api.attach_ha(co)
+        else:
+            api.attach_ha(co, max_records=max_records)
+        for i in range(8):
+            pod = client.create_pod(
+                make_pod(f"pg-{i}", containers=[
+                    make_container(
+                        "t", {types.RESOURCE_TPU_PERCENT: 50}
+                    )
+                ])
+            )
+            ok, _ = ad.assume(ad.node_names(), pod)
+            ad.bind(ok[0], pod)
+        return ad, api
+
+    def test_max_records_bounds_every_page(self):
+        ad, api = self._active_api(max_records=5)
+        try:
+            code, _, payload = api.dispatch(
+                "GET", "/debug/ha?since=0&limit=4096", b""
+            )
+            assert code == 200
+            body = json.loads(payload)
+            assert len(body["records"]) == 5  # clamped server-side
+            seqs = [r["seq"] for r in body["records"]]
+            assert seqs == list(range(1, 6))
+            # the pager walks: next page picks up where this ended
+            code, _, payload = api.dispatch(
+                "GET", f"/debug/ha?since={seqs[-1]}&limit=4096", b""
+            )
+            rest = [r["seq"] for r in json.loads(payload)["records"]]
+            assert rest[0] == 6
+            assert rest[-1] == body["log"]["seq"]
+        finally:
+            ad.close()
+
+    def test_default_bound_serves_the_window(self):
+        ad, api = self._active_api()
+        try:
+            code, _, payload = api.dispatch(
+                "GET", "/debug/ha?since=0", b""
+            )
+            body = json.loads(payload)
+            assert len(body["records"]) == body["log"]["seq"]
+        finally:
+            ad.close()
+
+
+class TestDrainRejoin:
+    def test_lifecycle_pulls_and_restores_read_rotation(self, pair):
+        leader, follower = pair
+        # synced and serving: /readyz 200 through ha-follower-synced
+        code, _, payload = follower.api.dispatch("GET", "/readyz", b"")
+        assert code == 200
+        assert json.loads(payload)["role"] == "follower"
+        code, _, payload = follower.api.dispatch(
+            "POST", "/debug/ha/drain", b""
+        )
+        assert code == 200
+        assert json.loads(payload)["draining"] is True
+        # drained: out of rotation (readyz names the gate), reads 503
+        code, _, payload = follower.api.dispatch("GET", "/readyz", b"")
+        assert code == 503
+        assert "ha-follower-synced" in json.loads(payload)["Waiting"]
+        pod = _mk_pod(leader.client, "drain-probe", 100)
+        code, _, payload = follower.api.dispatch(
+            "POST", "/scheduler/filter",
+            json.dumps({
+                "Pod": pod.raw, "NodeNames": follower.nodes,
+            }).encode(),
+        )
+        body = json.loads(payload)
+        assert code == 503 and body["Reason"] == "NotSynced"
+        assert body["Draining"] is True
+        # the tail keeps running while drained (upgrade window)
+        assert follower.coordinator.tail_once() == 0
+        code, _, payload = follower.api.dispatch(
+            "POST", "/debug/ha/rejoin", b""
+        )
+        assert code == 200
+        body = json.loads(payload)
+        assert body["draining"] is False and body["synced"] is True
+        code, _, _ = follower.api.dispatch("GET", "/readyz", b"")
+        assert code == 200
+
+    def test_drain_answers_409_on_non_followers(self, pair):
+        leader, _follower = pair
+        code, _, payload = leader.api.dispatch(
+            "POST", "/debug/ha/drain", b""
+        )
+        assert code == 409
+        body = json.loads(payload)
+        assert body["Reason"] == "NotFollower"
+        assert body["Role"] == "active"
+
+    def test_drain_rejoin_idempotent(self, pair):
+        _leader, follower = pair
+        co = follower.coordinator
+        assert co.drain() == {"draining": True, "was_draining": False}
+        assert co.drain() == {"draining": True, "was_draining": True}
+        out = co.rejoin()
+        assert out["draining"] is False
+        assert co.rejoin()["draining"] is False
+
+
+class TestFollowerGauges:
+    def test_producer_matches_declared_family_both_ways(self, pair):
+        from nanotpu.metrics.ha import _FOLLOWER_GAUGES
+
+        _leader, follower = pair
+        values = follower.coordinator.follower_gauge_values()
+        assert set(values) == set(_FOLLOWER_GAUGES)
+
+    def test_follower_family_renders_only_on_followers(self, pair):
+        leader, follower = pair
+        text = follower.api.registry.render()
+        assert "nanotpu_follower_lag_events 0.0" in text
+        assert "nanotpu_follower_synced 1.0" in text
+        # the ha family rides along on every role
+        assert "nanotpu_ha_role 0.0" in text
+        # leaders/standbys export nothing new
+        assert "nanotpu_follower_" not in leader.api.registry.render()
+
+    def test_tail_retries_gauge_reads_the_source_counter(self, pair):
+        _leader, follower = pair
+        src = HttpDeltaSource("http://x:1")
+        src.tail_retries = 3
+        follower.coordinator.source = src
+        values = follower.coordinator.follower_gauge_values()
+        assert values["tail_retries"] == 3
+
+
+def _follower_scenario(followers: int) -> dict:
+    return {
+        "name": "follower-mini",
+        "fleet": {"pools": [
+            {"generation": "v5p", "hosts": 4, "slice_hosts": 2,
+             "prefix": "v5p-host"},
+        ]},
+        "policy": "binpack",
+        "horizon_s": 8.0,
+        "workload": {
+            "kind": "poisson", "rate_per_s": 1.0,
+            "mix": {"fractional": 0.5, "spread": 0.5},
+            "lifetime_s": {"dist": "exp", "mean": 4.0},
+        },
+        "ha": {
+            "enabled": True, "lag_events": 2,
+            "followers": followers, "follower_lag_bound": 32,
+        },
+        "faults": {"scheduler_crash": {"at_s": [4.0]}},
+        "sample_every_s": 1.0,
+        "retry_every_s": 0.5,
+    }
+
+
+class TestFollowerFleetSim:
+    def test_fleet_converges_with_zero_read_downtime(self):
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        from nanotpu.sim.core import Simulator
+
+        r1 = Simulator(_follower_scenario(2), seed=3).run()
+        r2 = Simulator(_follower_scenario(2), seed=3).run()
+        assert r1["digest"] == r2["digest"]  # reproducible
+        assert r1["invariants"]["violations"] == 0
+        fl = r1["ha"]["followers"]
+        assert fl["count"] == 2
+        assert fl["applied_deltas"] > 0
+        assert fl["reads_ok"] > 0
+        assert fl["reads_refused"] == 0  # zero read downtime
+        assert fl["max_drift_pct"] == 0.0
+
+    def test_followers_off_leaves_the_report_shape_alone(self):
+        if not native.available():
+            pytest.skip("native allocator unavailable")
+        from nanotpu.sim.core import Simulator
+
+        report = Simulator(_follower_scenario(0), seed=3).run()
+        assert "followers" not in report["ha"]

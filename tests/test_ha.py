@@ -8,6 +8,7 @@ Dealer.close + the Recovery/Batch/Telemetry loops."""
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 
@@ -1253,11 +1254,19 @@ class TestStateIntegrity:
             urllib.request, "urlopen",
             lambda url, timeout=None: _Resp(body),
         )
-        src = HttpDeltaSource("http://127.0.0.1:1")
+        t = [0.0]
+        src = HttpDeltaSource(
+            "http://127.0.0.1:1", clock=lambda: t[0],
+            rng=random.Random(7),
+        )
         src.poll(0)
         assert src.crc_failures == 1
         assert src.since(0) == []  # the whole window was discarded
-        # a clean window flows through
+        # a failed window arms the jittered backoff: re-polling inside
+        # it is a no-op (no re-fetch), not a hot loop against the link
+        src.poll(0)
+        assert src.crc_failures == 1 and src.tail_retries == 0
+        # a clean window flows through once the window elapses
         body2 = json.dumps({
             "log": {"seq": 1}, "records": [good],
         }).encode()
@@ -1265,7 +1274,9 @@ class TestStateIntegrity:
             urllib.request, "urlopen",
             lambda url, timeout=None: _Resp(body2),
         )
+        t[0] = 10.0  # past any backoff_cap_s window
         src.poll(0)
+        assert src.tail_retries == 1
         assert [r["seq"] for r in src.since(0)] == [1]
 
 
