@@ -3,7 +3,7 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm batch-4k sim-smoke sim-multipool sim-het sim-defrag sim-batch sim-serve chaos-soak obs-check timeline-check fanout-4k ha-soak partition-soak follower-soak image clean
+.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm batch-4k sim-smoke sim-multipool sim-het sim-defrag sim-batch sim-serve chaos-soak obs-check timeline-check fanout-4k ha-soak partition-soak follower-soak policy-check image clean
 
 # Default verification tier: static analysis, then the fast inner loop
 # (test-fast includes sim-smoke), then the observability gate, then the
@@ -11,7 +11,7 @@ TAG ?= latest
 # certifications and the sharded 4096-host fan-out gate (FAST=1 skips
 # those three). The tier-1 gate (`pytest tests/ -m 'not slow'` over
 # everything) is unchanged — run it via `make test` / CI.
-all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag sim-batch sim-serve fanout-4k batch-4k ha-soak partition-soak follower-soak
+all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag sim-batch sim-serve fanout-4k batch-4k ha-soak partition-soak follower-soak policy-check
 
 # nanolint (docs/static-analysis.md): AST invariant passes over the
 # scheduler's concurrency & determinism contracts — lock discipline,
@@ -284,6 +284,30 @@ follower-soak: native
 			--check-determinism > /dev/null && \
 		python -m pytest tests/test_followers.py -q && \
 		python bench.py --follower-fanout; \
+	fi
+
+# Verified-policy-program gate (docs/policy-programs.md): the verifier/
+# compiler/shadow test suite (>=12 seeded rejections pinned to typed
+# findings, wire-byte binpack parity single-shard AND sharded, watcher
+# keep-last-good, /debug/shadow golden schema), then the policy-shadow
+# scenario run TWICE (--check-determinism: two followers shadow-scoring
+# the byte-equivalent candidate must certify ZERO divergences with a
+# byte-reproducible records digest), then the promotion gate BOTH ways:
+# binpack_q16 must promote (exit 0) and the divergent fixture must be
+# REFUSED (exit 1 — its shadow replay ledgers a divergence on every
+# row). `FAST=1 make all` skips the replays (same rule as sim-het); the
+# test suite always runs.
+policy-check:
+	python -m pytest tests/test_policy_ir.py -q
+	@if [ "$(FAST)" = "1" ]; then \
+		echo "policy-check: replays skipped (FAST=1)"; \
+	else \
+		python -m nanotpu.sim --scenario examples/sim/policy-shadow.json \
+			--seed 0 --check-determinism > /dev/null && \
+		python -m nanotpu.policy_ir.gate --program binpack_q16 \
+			> /dev/null && \
+		! python -m nanotpu.policy_ir.gate --program divergent \
+			> /dev/null; \
 	fi
 
 # The 4096-host multi-pool churn scenario through the sharded dealer,

@@ -551,6 +551,45 @@ def run_het_throughput(reps: int = 3, max_reps: int = 5) -> dict:
     )
 
 
+def run_program_fanout(reps: int = 3, max_reps: int = 5) -> dict:
+    """The verified-policy-program row (docs/policy-programs.md):
+    ``program:binpack_q16`` — the restricted-Python re-expression of the
+    built-in binpack rater — serves the same 256-host fan-out through
+    the Python batch row hook. Before timing, an in-bench parity assert
+    scores a staggered-occupancy 64-host fleet with BOTH raters through
+    fresh dealers and requires byte-identical single-chip wire scores
+    (the certified equivalence class: compactness 1, idle loads)."""
+    client = make_mock_cluster(64, CHIPS_PER_HOST)
+    nodes = [f"v5p-host-{i}" for i in range(64)]
+    seed = Dealer(client, make_rater("binpack"))
+    for i in range(0, 64, 2):  # stagger occupancy across half the fleet
+        pod = client.create_pod(make_pod(
+            f"parity-fill-{i}",
+            containers=[make_container(
+                "t", {types.RESOURCE_TPU_PERCENT: 100 * (1 + i % 3)}
+            )],
+        ))
+        seed.assume([nodes[i]], pod)
+        seed.bind(nodes[i], pod)
+    probe = client.create_pod(make_pod(
+        "parity-probe",
+        containers=[make_container("t", {types.RESOURCE_TPU_PERCENT: 100})],
+    ))
+    # fresh dealers adopt the bound pods from the client, so both sides
+    # score identical reconstructed chip state
+    want = Dealer(client, make_rater("binpack")).score(nodes, probe)
+    got = Dealer(
+        client, make_rater("program:binpack_q16")
+    ).score(nodes, probe)
+    assert got == want, "program:binpack_q16 lost wire parity"
+    out = run_fanout_reps(
+        reps=reps, max_reps=max_reps, prefix="prog",
+        rater="program:binpack_q16",
+    )
+    out["prog_parity_hosts"] = len(nodes)
+    return out
+
+
 #: Dealer feature probe: the same bench file runs inside the A/B
 #: harness's base-ref worktree (bench_ab.py copies it there), whose Dealer
 #: may predate the commit pipeline — pass the knob only when it exists.
@@ -2926,6 +2965,11 @@ if __name__ == "__main__":
         # the base worktree and feature-detects whether that dealer
         # scores the model natively (ABI 7) or through the row hook
         print(json.dumps(run_het_throughput(reps=1, max_reps=1)))
+    elif "--program-fanout" in sys.argv:
+        # the verified-policy-program row on its own: in-bench parity
+        # assert (builtin vs program wire scores) then the program-hook
+        # fan-out; an AssertionError exits nonzero
+        print(json.dumps(run_program_fanout(reps=1, max_reps=1)))
     elif "--fanout-rep" in sys.argv:
         # one 256-host default-rater rep, for bench_ab.py's interleaved
         # A/B protocol (the "hot path unregressed with the new rater

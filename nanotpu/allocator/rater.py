@@ -357,7 +357,16 @@ _RATERS = {
 
 
 def make_rater(name: str) -> Rater:
-    """Policy name -> rater (cmd/main.go:83-91's flag dispatch)."""
+    """Policy name -> rater (cmd/main.go:83-91's flag dispatch).
+
+    ``program:<name>`` resolves a verified policy program
+    (docs/policy-programs.md) — the in-tree source is verified and
+    compiled here, so an unprovable program fails construction loudly
+    instead of serving."""
+    if name.startswith("program:"):
+        from nanotpu.policy_ir import load_program
+
+        return load_program(name[len("program:"):])
     try:
         return _RATERS[name]()
     except KeyError:

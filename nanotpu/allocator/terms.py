@@ -1,0 +1,64 @@
+"""Q16 scoring-term extraction: the policy-program input ABI.
+
+Verified policy programs (docs/policy-programs.md) score over FIVE
+integer terms, all derived here from the same per-chip state every
+other scoring path reads — a ChipSet on the per-node path, a frozen
+BatchScorer row on the batch path. Keeping the extraction in ONE module
+is the bit-determinism argument: the per-node ``rate`` and the batch
+``score_hook`` hand a program literally the same integers, so a program
+cannot diverge between paths the way a float formula could.
+
+The terms (all Q16 unless noted, docs/scoring.md):
+
+* ``occupancy``  — bound fraction of the node's chip capacity,
+  ``((total - free) * Q_ONE) // total``; 0 for a capacity-less node.
+* ``fragmentation`` — share of the free capacity that sits on WHOLLY
+  free chips (whole-chip headroom), ``(whole_free * Q_ONE) // free``;
+  0 when nothing is free. Same ``whole_free`` rule as the throughput
+  rater's frag term (a chip counts only when ``free == total > 0``).
+* ``contention`` — mean per-card quantized load, ``sum(load_q) // n``.
+* ``base_q`` — the model base fraction. Programs are model-free, so
+  both extraction paths pass the neutral ``Q_ONE``; the slot exists so
+  the ABI matches the r9 fused-score term layout.
+* ``gang_bonus`` — [0, SCORE_MAX] integer. 0 on the batch path: the
+  dealer folds the gang bonus AFTER the hook (``_hook_gang_bonus``),
+  exactly as it does for the throughput rater, so a program must not
+  add it again.
+
+Every division is floor division of non-negative integers — C's
+truncating ``/`` on the same operands, the same parity discipline as
+``Throughput._combine``.
+"""
+
+from __future__ import annotations
+
+from nanotpu.allocator.throughput import Q_ONE, quantize
+
+__all__ = ["Q_ONE", "q16_row_terms", "q16_chipset_terms"]
+
+
+def q16_row_terms(free, total, load_q) -> tuple[int, int, int]:
+    """``(occupancy, fragmentation, contention)`` from one batch row's
+    raw integer chip percents + pre-quantized loads (the SAME arrays
+    the native mirror path consumes — no float touches them here)."""
+    total_sum = sum(total)
+    free_sum = sum(free)
+    occupancy = (
+        ((total_sum - free_sum) * Q_ONE) // total_sum if total_sum else 0
+    )
+    whole_free = sum(f for f, t in zip(free, total) if f == t and t > 0)
+    fragmentation = (whole_free * Q_ONE) // free_sum if free_sum else 0
+    n = len(load_q)
+    contention = sum(load_q) // n if n else 0
+    return occupancy, fragmentation, contention
+
+
+def q16_chipset_terms(chips) -> tuple[int, int, int]:
+    """Per-node-path adapter: the same terms from a ChipSet, quantizing
+    each card's float load at the float/int edge (the one place floats
+    may appear, same rule as ``Throughput._score_terms``)."""
+    return q16_row_terms(
+        [c.percent_free for c in chips.chips],
+        [c.percent_total for c in chips.chips],
+        [quantize(c.load) for c in chips.chips],
+    )

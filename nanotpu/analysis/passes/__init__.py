@@ -6,10 +6,15 @@ from nanotpu.analysis.passes.deadlines import PASS as DEADLINES
 from nanotpu.analysis.passes.determinism import PASS as DETERMINISM
 from nanotpu.analysis.passes.locks import PASS as LOCKS
 from nanotpu.analysis.passes.metrics import PASS as METRICS
+from nanotpu.analysis.passes.replication import PASS as REPLICATION
 from nanotpu.analysis.passes.snapshots import PASS as SNAPSHOTS
+from nanotpu.analysis.policyver import PASS as POLICYVER
 
 #: registry order == report order (lock discipline first: its findings
 #: are the ones that turn into 3am pages)
-ALL_PASSES = (LOCKS, SNAPSHOTS, DEADLINES, DETERMINISM, METRICS)
+ALL_PASSES = (
+    LOCKS, SNAPSHOTS, DEADLINES, DETERMINISM, METRICS, REPLICATION,
+    POLICYVER,
+)
 
 BY_NAME = {p.name: p for p in ALL_PASSES}

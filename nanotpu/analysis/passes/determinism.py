@@ -62,6 +62,10 @@ SCOPE = (
     # follower coordinators per event, and the HttpDeltaSource backoff
     # jitter draws only from its injectable clock/rng defaults
     "nanotpu.ha", "nanotpu.metrics.ha", "nanotpu.metrics.degraded",
+    # verified policy programs (docs/policy-programs.md): the verifier
+    # bans nondeterminism INSIDE programs; this pins the loader /
+    # compiler / shadow plumbing around them to the same bar
+    "nanotpu.policy_ir", "nanotpu.metrics.shadow",
     "nanotpu.k8s.objects", "nanotpu.k8s.client", "nanotpu.k8s.resilience",
     "nanotpu.k8s.events",
     "nanotpu.metrics.resilience", "nanotpu.metrics.stats",

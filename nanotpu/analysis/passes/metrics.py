@@ -77,6 +77,12 @@ promise has three string-ly typed seams this pass stitches shut:
   the read plane's staleness contract (lag, synced, draining,
   tail_retries) can never ship a lying zero or a scrape-time KeyError.
 
+* **Shadow gauges** (``nanotpu_shadow_*``, docs/policy-programs.md):
+  ``_SHADOW_GAUGES`` (``nanotpu/metrics/shadow.py``) vs
+  ``ShadowScorer.shadow_gauge_values()`` — both directions, so the
+  shadow-mode A/B evidence (cycles, rows, divergences, max_abs_delta)
+  can never ship a lying zero or a scrape-time KeyError.
+
 Registry-built metrics (``registry.counter(...)`` etc.) register at
 construction by design and need no check here.
 """
@@ -291,6 +297,8 @@ class _MetricsPass:
         flgauges_mod: Module | None = None
         dggauges: dict[str, int] | None = None
         dggauges_mod: Module | None = None
+        shgauges: dict[str, int] | None = None
+        shgauges_mod: Module | None = None
         for mod in modules:
             d = _declared_resilience(mod)
             if d is not None:
@@ -328,6 +336,9 @@ class _MetricsPass:
             dg = _declared_gauge_table(mod, "_DEGRADED_GAUGES")
             if dg is not None:
                 dggauges, dggauges_mod = dg, mod
+            sh = _declared_gauge_table(mod, "_SHADOW_GAUGES")
+            if sh is not None:
+                shgauges, shgauges_mod = sh, mod
 
         inc_sites: dict[str, tuple[str, int]] = {}
         perf_incs: dict[str, tuple[str, int]] = {}
@@ -453,6 +464,7 @@ class _MetricsPass:
             ("ha", hagauges, hagauges_mod, "ha_gauge_values"),
             ("follower", flgauges, flgauges_mod, "follower_gauge_values"),
             ("degraded", dggauges, dggauges_mod, "degraded_gauge_values"),
+            ("shadow", shgauges, shgauges_mod, "shadow_gauge_values"),
         ):
             if table is not None and table_mod is not None:
                 findings.extend(self._check_gauge_table(

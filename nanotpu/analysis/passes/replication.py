@@ -1,0 +1,212 @@
+"""replication-completeness: the delta stream must carry every mutation.
+
+The HA replication contract (docs/ha.md, docs/read-plane.md): every
+Dealer/recovery commit point that publishes a mutation also appends ONE
+typed DeltaLog record, and the standby/follower ``apply`` consumes
+every kind the leader can emit. A kind emitted but missing from the
+``STATE_KINDS``/``NOTE_KINDS`` catalogue is dropped on the follower's
+forward-compat skip — silent replica/checkpoint drift, the exact bug
+class this pass exists to make un-shippable. A kind declared but never
+emitted is dead schema (or a silently MISSED emit at a ``_republish``
+commit point); declared but never applied is follower drift from the
+other side.
+
+Like metrics-completeness, the check is a catalogue cross-check, BOTH
+directions, over three record sets collected per module group:
+
+* **declared** — the ``STATE_KINDS = (...)`` / ``NOTE_KINDS = (...)``
+  tuple-of-string assignments (nanotpu.ha.delta on the real tree);
+* **emitted** — the literal first argument of every ``*._ha_emit(...)``
+  / ``*._ha_note(...)`` call (the one-liner wrappers every commit point
+  routes through; a NON-literal kind is its own finding — a dynamic
+  kind cannot be cross-checked, so it cannot be reviewed either);
+* **applied** — kinds consumed inside ``apply``/``apply_delta``:
+  string literals compared with ``==``/``in (tuple)``, plus a
+  ``kind in STATE_KINDS`` membership test, which marks the whole state
+  catalogue applied (the dealer dispatches those internally).
+
+All checks gate on a catalogue being present in the analyzed module
+set, so unrelated fixture trees are no-ops.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from nanotpu.analysis.core import Finding, Module, dotted
+
+PASS_NAME = "replication-completeness"
+
+SCOPE = ("nanotpu.ha", "nanotpu.dealer", "nanotpu.recovery")
+
+_CATALOGUES = ("STATE_KINDS", "NOTE_KINDS")
+_EMIT_SUFFIXES = ("_ha_emit", "_ha_note")
+_APPLY_FNS = ("apply", "apply_delta")
+
+
+def _declared_kinds(mod: Module) -> dict[str, tuple[str, int]]:
+    """kind -> (catalogue name, line) for every string in a top-level
+    STATE_KINDS/NOTE_KINDS tuple assignment."""
+    out: dict[str, tuple[str, int]] = {}
+    for node in mod.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name) or (
+            target.id not in _CATALOGUES
+        ):
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List)):
+            continue
+        for elt in node.value.elts:
+            if isinstance(elt, ast.Constant) and isinstance(
+                elt.value, str
+            ):
+                out[elt.value] = (target.id, node.lineno)
+    return out
+
+
+def _emit_sites(mod: Module):
+    """Yield ``(kind | None, line)`` per ``*._ha_emit``/``*._ha_note``
+    call; None == non-literal kind argument."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name is None or not name.endswith(_EMIT_SUFFIXES):
+            continue
+        if not node.args:
+            yield None, node.lineno
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(
+            first.value, str
+        ):
+            yield first.value, node.lineno
+        else:
+            yield None, node.lineno
+
+
+def _applied_kinds(mod: Module):
+    """``(kinds, state_membership, sites)`` consumed by apply-side
+    dispatch: literal kinds (with the line of each compare) and whether
+    a ``... in STATE_KINDS`` membership test covers the state
+    catalogue wholesale."""
+    kinds: dict[str, int] = {}
+    state_membership = False
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.FunctionDef) or (
+            node.name not in _APPLY_FNS
+        ):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Compare):
+                continue
+            # only compares whose subject is a plain local (the `kind`
+            # variable) count as kind dispatch — payload compares like
+            # `data.get("action") == "open"` are not dispatch
+            if not isinstance(sub.left, ast.Name):
+                continue
+            for op, comp in zip(sub.ops, sub.comparators):
+                if isinstance(op, (ast.In, ast.NotIn)):
+                    if isinstance(comp, ast.Name) and (
+                        comp.id == "STATE_KINDS"
+                    ) and isinstance(op, ast.In):
+                        state_membership = True
+                    elif isinstance(comp, (ast.Tuple, ast.List)) and (
+                        isinstance(op, ast.In)
+                    ):
+                        for elt in comp.elts:
+                            if isinstance(elt, ast.Constant) and (
+                                isinstance(elt.value, str)
+                            ):
+                                kinds.setdefault(elt.value, sub.lineno)
+                elif isinstance(op, ast.Eq):
+                    if isinstance(comp, ast.Constant) and (
+                        isinstance(comp.value, str)
+                    ):
+                        kinds.setdefault(comp.value, sub.lineno)
+    return kinds, state_membership
+
+
+class _ReplicationPass:
+    name = PASS_NAME
+    doc = (
+        "every delta kind a commit point emits is declared in the "
+        "STATE_KINDS/NOTE_KINDS catalogue, every declared kind is "
+        "emitted somewhere, and the standby apply path consumes all of "
+        "them — a miss in any direction is silent follower drift"
+    )
+    scope = SCOPE
+
+    def run(self, modules: list[Module]) -> list[Finding]:
+        declared: dict[str, tuple[str, int, Module]] = {}
+        emitted: dict[str, int] = {}
+        emit_findings: list[tuple[Module, int]] = []
+        applied: dict[str, int] = {}
+        applied_sites: dict[str, Module] = {}
+        state_membership = False
+        emit_mods: dict[str, Module] = {}
+        for mod in modules:
+            for kind, (cat, line) in _declared_kinds(mod).items():
+                declared.setdefault(kind, (cat, line, mod))
+            for kind, line in _emit_sites(mod):
+                if kind is None:
+                    emit_findings.append((mod, line))
+                else:
+                    emitted.setdefault(kind, line)
+                    emit_mods.setdefault(kind, mod)
+            mod_applied, mod_membership = _applied_kinds(mod)
+            state_membership = state_membership or mod_membership
+            for kind, line in mod_applied.items():
+                applied.setdefault(kind, line)
+                applied_sites.setdefault(kind, mod)
+        if not declared:
+            return []  # no catalogue in this module set: nothing to pin
+        findings: list[Finding] = []
+        for mod, line in emit_findings:
+            findings.append(Finding(
+                PASS_NAME, str(mod.path), line,
+                "delta emit with a non-literal kind — the catalogue "
+                "cross-check (and review) cannot see it; emit a literal "
+                "STATE_KINDS/NOTE_KINDS member",
+            ))
+        for kind, line in sorted(emitted.items()):
+            if kind not in declared:
+                findings.append(Finding(
+                    PASS_NAME, str(emit_mods[kind].path), line,
+                    f"delta kind {kind!r} is emitted but not declared "
+                    "in STATE_KINDS/NOTE_KINDS — the follower's "
+                    "forward-compat skip drops it on the floor "
+                    "(silent replica drift)",
+                ))
+        for kind, (cat, line, mod) in sorted(declared.items()):
+            if kind not in emitted:
+                findings.append(Finding(
+                    PASS_NAME, str(mod.path), line,
+                    f"delta kind {kind!r} is declared in {cat} but no "
+                    "commit point emits it — dead schema, or a "
+                    "_republish commit point silently missing its "
+                    "emit",
+                ))
+            if kind not in applied and not (
+                cat == "STATE_KINDS" and state_membership
+            ):
+                findings.append(Finding(
+                    PASS_NAME, str(mod.path), line,
+                    f"delta kind {kind!r} is declared in {cat} but the "
+                    "apply path never consumes it — followers drop the "
+                    "record (replica drift from the read side)",
+                ))
+        for kind, line in sorted(applied.items()):
+            if kind not in declared:
+                findings.append(Finding(
+                    PASS_NAME, str(applied_sites[kind].path), line,
+                    f"apply dispatches on kind {kind!r} which is not "
+                    "declared in STATE_KINDS/NOTE_KINDS — unreachable "
+                    "dispatch (the emitter can never send it)",
+                ))
+        return findings
+
+
+PASS = _ReplicationPass()

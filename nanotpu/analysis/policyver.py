@@ -1,0 +1,69 @@
+"""policyver: the policy-program verifier as a nanolint pass.
+
+The runtime verifier (:mod:`nanotpu.policy_ir.verify`) proves a
+candidate scoring program safe to hot-load; THIS pass runs the same
+proof at lint time over the in-tree program corpus
+(``nanotpu/policy_ir/programs/``), so ``make lint`` refuses a tree
+carrying a program the ``PolicyWatcher`` would reject at reload — the
+verifier's typed violations surface as ordinary findings under
+nanolint's exit contract, ignore budget, and ``--json`` rendering
+(docs/static-analysis.md).
+
+One verifier, two mouths: the pass does NOT reimplement any rule — it
+maps :class:`~nanotpu.policy_ir.verify.Violation` records into findings
+(message prefixed ``[<code>]`` so tests pin the typed code), which is
+what keeps ``python -m nanotpu.analysis --pass policyver`` and the
+reload path's acceptance decision identical by construction.
+
+Fixture modules (anything outside ``nanotpu``) are verified as whole
+programs when they define a ``score`` function — that is how seeded
+program fixtures pin each banned construct to its typed finding,
+without the pass claiming every unrelated fixture module in a mixed
+tmp tree is a malformed program.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from nanotpu.analysis.core import Finding, Module
+from nanotpu.policy_ir.verify import verify_tree
+
+PASS_NAME = "policyver"
+
+#: in-tree programs live here; the registry module itself (the package
+#: ``__init__``) is loader code, not a program
+SCOPE = ("nanotpu.policy_ir.programs",)
+_REGISTRY_MODULE = "nanotpu.policy_ir.programs"
+
+
+class _PolicyVerPass:
+    name = PASS_NAME
+    doc = (
+        "policy programs must pass the hot-load verifier: isolation, "
+        "integer-only Q16 ops, bounded loops, totality, clamp proof, "
+        "zero nondeterminism"
+    )
+    scope = SCOPE
+
+    def run(self, modules: list[Module]) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in modules:
+            if mod.name == _REGISTRY_MODULE:
+                continue
+            if not mod.name.startswith(SCOPE) and not any(
+                isinstance(n, ast.FunctionDef) and n.name == "score"
+                for n in mod.tree.body
+            ):
+                # fixture module that is not a policy program at all —
+                # in-tree corpus modules are always verified
+                continue
+            for v in verify_tree(mod.tree):
+                findings.append(Finding(
+                    PASS_NAME, str(mod.path), v.line,
+                    f"[{v.code}] {v.message}",
+                ))
+        return findings
+
+
+PASS = _PolicyVerPass()

@@ -271,6 +271,19 @@ def build_app(argv: list[str] | None = None):
         "successful write exits the mode. 0 disables",
     )
     parser.add_argument(
+        "--shadow-program", default="", metavar="NAME",
+        help="shadow-mode A/B (docs/policy-programs.md, follower role "
+        "only): audition the named verified policy program by scoring "
+        "sampled cycles against this follower's own snapshots; "
+        "divergences from the serving policy become typed ledger "
+        "records on GET /debug/shadow plus nanotpu_shadow_* gauges. "
+        "Empty disables (zero overhead)",
+    )
+    parser.add_argument(
+        "--shadow-period", type=float, default=5.0, metavar="S",
+        help="shadow sampling cadence (with --shadow-program)",
+    )
+    parser.add_argument(
         "--serving-stats-url", default="", metavar="URL",
         help="scheduler<->serving feedback (docs/serving-loop.md): poll "
         "a serving replica's /v1/stats at URL, export the fleet's "
@@ -514,6 +527,97 @@ def main(argv: list[str] | None = None) -> int:
 
     api.verify_state = lambda: _verify_state(dealer, client.list_pods())
 
+    if api.policy_watcher is not None:
+        # verified policy programs (docs/policy-programs.md): a
+        # `program:` section hot-loads through the one policy watcher.
+        # parse_policy already ran the verifier, so a document carrying
+        # an unprovable program never produced a spec at all — the
+        # watcher kept the last good one and counted a typed "parse"
+        # reload failure, and the serving rater was never touched.
+        # Removing the section reverts to the boot rater.
+        from nanotpu.policy_ir import PolicyProgramError, compile_program
+
+        base_rater = dealer.rater
+        prev_reload = api.policy_watcher.on_reload
+
+        def _apply_program(spec) -> None:
+            if spec.program is not None:
+                try:
+                    rater = compile_program(
+                        spec.program.source, name=spec.program.name
+                    )
+                except PolicyProgramError as e:
+                    # unreachable for a parse_policy-produced spec (the
+                    # verifier gates compilation), kept as a LOUD
+                    # belt-and-braces refusal: old rater keeps serving
+                    log.error(
+                        "policy program %r refused at compile: %s; "
+                        "keeping %s", spec.program.name, e,
+                        dealer.rater.name,
+                    )
+                    return
+                dealer.install_rater(rater)
+                log.info(
+                    "policy program %r (%s) installed as the serving "
+                    "rater", rater.program_name, rater.fingerprint,
+                )
+            elif dealer.rater is not base_rater:
+                dealer.install_rater(base_rater)
+                log.info(
+                    "policy program section removed; reverted to %s",
+                    base_rater.name,
+                )
+
+        def _on_program_reload(spec, _prev=prev_reload):
+            if _prev is not None:
+                _prev(spec)
+            _apply_program(spec)
+
+        api.policy_watcher.on_reload = _on_program_reload
+        # the initial load ran before this chain existed
+        _apply_program(api.policy_watcher.spec())
+
+    shadow_stop = None
+    if args.shadow_program:
+        # shadow-mode A/B tap (docs/policy-programs.md): follower-only —
+        # candidates audition on the read plane, never where binds commit
+        if not (args.ha and args.role == "follower"):
+            log.error(
+                "--shadow-program requires --ha --role follower "
+                "(candidates audition on the read plane); ignoring"
+            )
+        else:
+            import threading as _threading
+
+            from nanotpu.allocator.core import Demand
+            from nanotpu.policy_ir import load_program
+            from nanotpu.policy_ir.shadow import ShadowScorer
+
+            shadow_scorer = ShadowScorer(
+                dealer, load_program(args.shadow_program)
+            )
+            api.attach_shadow(shadow_scorer)
+            probe = Demand(
+                percents=(25,), container_names=("shadow-probe",)
+            )
+            shadow_stop = _threading.Event()
+
+            def _shadow_pump():
+                while not shadow_stop.wait(max(args.shadow_period, 0.1)):
+                    try:
+                        shadow_scorer.sample(probe)
+                    except Exception:
+                        # the audit must never take a follower down
+                        log.exception("shadow sample failed")
+
+            _threading.Thread(
+                target=_shadow_pump, daemon=True, name="shadow-ab"
+            ).start()
+            log.info(
+                "shadow-mode A/B: auditioning %r every %.1fs",
+                args.shadow_program, args.shadow_period,
+            )
+
     def _start_or_defer(loop) -> None:
         """Track a write-side loop for leadership transitions, starting
         it now only when this replica IS the leader (single replica /
@@ -695,6 +799,8 @@ def main(argv: list[str] | None = None) -> int:
         if batch_loop is not None:
             batch_loop.stop()
         controller.stop()
+        if shadow_stop is not None:
+            shadow_stop.set()
         if api.policy_watcher is not None:
             api.policy_watcher.stop()
         # flush pending K8s Events; a timeout logs + counts the unposted

@@ -206,8 +206,9 @@ class Dealer:
     ):
         self.client = client
         self.rater = rater
-        #: rater integration hooks, resolved once (the rater is fixed for
-        #: the dealer's lifetime). ``_native_model`` is the rater's
+        #: rater integration hooks, resolved at init and RE-resolved only
+        #: by :meth:`install_rater` (verified policy-program hot reload,
+        #: docs/policy-programs.md). ``_native_model`` is the rater's
         #: ThroughputModel when the native engine can evaluate its
         #: formula in C (ABI 7, docs/scoring.md): scoring views mirror
         #: the model's quantized state and the fused score+render path
@@ -997,6 +998,50 @@ class Dealer:
         # name -> row index: lets a publish advance this view by probing
         # only the rows its commit touched
         return scorer, names, non_tpu, {n: i for i, n in enumerate(names)}
+
+    # -- rater hot swap ----------------------------------------------------
+    def install_rater(self, rater) -> None:
+        """Hot-swap the scoring policy (verified policy programs,
+        docs/policy-programs.md: the ``PolicyWatcher``'s ``program:``
+        reload lands here AFTER verification — a failing candidate never
+        reaches this method, the old rater keeps serving).
+
+        Re-resolves the integration hooks ``__init__`` captured
+        (``_batch_hook``/``_native_model``/``_hook_active``/observe/
+        forget) and invalidates every score artifact computed under the
+        old rater: per-node plan caches (their scores embed the old
+        policy) and the published frozen views (built with the old
+        rater's native-model binding). Chip accounting, gang state, and
+        the HA stream are untouched — scores are derived state, and the
+        batch scorer's native memo already keys on ``prefer_used`` so a
+        preference flip cannot serve a stale arena."""
+        # resolve the native binding OUTSIDE the hot lock: native.available()
+        # is a ctypes probe, and swaps are rare control-plane events
+        nm_fn = getattr(rater, "native_model", None)
+        native_model = (
+            nm_fn()
+            if nm_fn is not None
+            and os.environ.get("NANOTPU_NATIVE_MODEL", "1") != "0"
+            and native.available()
+            else None
+        )
+        with self._lock:
+            self.rater = rater
+            self._batch_hook = getattr(rater, "batch_score_rows", None)
+            self._native_model = native_model
+            self._hook_active = (
+                self._batch_hook is not None and self._native_model is None
+            )
+            self._rater_observe = getattr(rater, "observe_usage", None)
+            self._rater_forget = getattr(rater, "forget_node", None)
+            nodes = list(self._nodes.values())
+            shards = list(self._shards.values())
+        for info in nodes:
+            info.invalidate_plans()
+        for shard in shards:
+            # drop the frozen views wholesale: they rebuild on next use
+            # (a structural event, same cost class as a node join)
+            shard._published.views.clear()
 
     # -- batched scoring fast path -----------------------------------------
     #: rater name -> prefer_used flag for the native batch engine; raters

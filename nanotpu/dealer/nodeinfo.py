@@ -169,6 +169,16 @@ class NodeInfo:
         plan = self.assume(demand, rater)
         return plan.score if plan is not None else types.SCORE_MIN
 
+    def invalidate_plans(self) -> None:
+        """Drop every cached plan unconditionally — the RATER changed
+        (``Dealer.install_rater``, docs/policy-programs.md), so plans
+        scored under the old policy must not serve the new one. Chip
+        state is untouched and ``version`` does not move: batch-scorer
+        rows mirror chip state, which is rater-independent."""
+        with self.lock:
+            self._plan_cache.clear()
+            self._plan_cache_token = None
+
     def bind(self, demand: Demand, rater: Rater) -> Plan | None:
         """Apply the (cached or recomputed) plan to chip accounting and drop
         the cache — the node's state changed (node.go:70-84)."""

@@ -1,0 +1,64 @@
+"""``nanotpu_shadow_*`` exposition: shadow-mode A/B scrape surface
+(docs/policy-programs.md).
+
+The gauge values come from ONE producer —
+:meth:`ShadowScorer.shadow_gauge_values
+<nanotpu.policy_ir.shadow.ShadowScorer.shadow_gauge_values>` — so the
+scrape surface, ``GET /debug/shadow``, and the sim's ``shadow`` report
+section read the same numbers. The nanolint metrics-completeness pass
+cross-checks :data:`_SHADOW_GAUGES` against that producer BOTH
+directions (a suffix declared here but never produced, or produced
+there but never declared, is a lint finding) — the same honesty
+contract every other gauge family lives under. Registered only when a
+shadow scorer is attached (``SchedulerAPI.attach_shadow``), so leaders
+and shadow-less followers export nothing new."""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger("nanotpu.metrics.shadow")
+
+_FAMILY = "nanotpu_shadow_"
+
+#: gauge suffix -> help text. Keys must match
+#: ShadowScorer.shadow_gauge_values() exactly — nanolint pins the
+#: equivalence both ways.
+_SHADOW_GAUGES: dict[str, str] = {
+    "cycles":
+        "Shadow scoring cycles this follower has run (one sampled "
+        "demand scored against the whole snapshot per cycle)",
+    "rows":
+        "Feasible candidate rows scored by both the serving policy and "
+        "the shadow candidate (infeasible rows are rater-independent "
+        "and excluded)",
+    "divergences":
+        "Rows where the shadow candidate's score differed from the "
+        "serving policy's wire score — each one is a typed "
+        "shadow_divergence record in GET /debug/shadow",
+    "max_abs_delta":
+        "Largest |candidate - serving| score delta observed — how far "
+        "the candidate would move a placement decision, worst case",
+}
+
+
+class ShadowExporter:
+    """Registry-compatible renderer (``Registry.register``) for the
+    shadow gauges."""
+
+    def __init__(self, scorer):
+        self.scorer = scorer
+
+    def render(self) -> list[str]:
+        out: list[str] = []
+        try:
+            values = self.scorer.shadow_gauge_values()
+        except Exception:
+            log.warning("shadow gauge producer failed", exc_info=True)
+            return out
+        for suffix in sorted(_SHADOW_GAUGES):
+            name = _FAMILY + suffix
+            out.append(f"# HELP {name} {_SHADOW_GAUGES[suffix]}")
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {float(values[suffix])}")
+        return out

@@ -51,6 +51,7 @@ REASON_DRAIN_EXPIRED = "drain_expired"
 REASON_FENCED = "fenced"
 REASON_DEGRADED_SHED = "degraded_shed"
 REASON_EPOCH_STALE = "epoch_stale"
+REASON_SHADOW_DIVERGENCE = "shadow_divergence"
 
 #: code -> operator-facing description. Keys must be exactly the
 #: ``REASON_*`` constants above (nanolint pins the equivalence).
@@ -116,6 +117,11 @@ REASONS: dict[str, str] = {
         "assumed-never-bound pod stripped because its stamped writer "
         "epoch predates the current lease term (a deposed leader's "
         "half-bind, healed without waiting out the TTL)",
+    REASON_SHADOW_DIVERGENCE:
+        "a shadow-mode candidate policy program scored this node "
+        "differently from the serving policy's wire score on the same "
+        "follower snapshot (docs/policy-programs.md; the record is the "
+        "promotion gate's evidence, the pod was NOT rescheduled)",
 }
 
 

@@ -94,6 +94,20 @@ class ThroughputSpec:
 
 
 @dataclass(frozen=True)
+class ProgramSpec:
+    """``policy.yaml``'s ``program:`` section — a verified policy
+    program as config (docs/policy-programs.md). ``source`` is the full
+    program text (inline ``source:`` or resolved from an in-tree
+    ``name:``), already VERIFIED at parse time: a candidate that fails
+    the proof makes ``parse_policy`` raise, which the watcher's
+    keep-last-good contract turns into "rejected loudly, old program
+    keeps serving"."""
+
+    name: str
+    source: str
+
+
+@dataclass(frozen=True)
 class PolicySpec:
     sync_periods: tuple[SyncPeriod, ...] = ()
     priorities: tuple[PriorityWeight, ...] = ()
@@ -104,6 +118,11 @@ class PolicySpec:
     #: SLO watchdog via on_reload like the throughput table; None == no
     #: slo section (the watchdog keeps its current objective set)
     slo: tuple | None = None
+    #: verified policy program (``program:`` section,
+    #: docs/policy-programs.md) — hot-loaded into the dealer via
+    #: on_reload + ``Dealer.install_rater``; None == no program section
+    #: (the built-in rater keeps serving)
+    program: ProgramSpec | None = None
 
     def period_for(self, metric: str, default: float = 15.0) -> float:
         for sp in self.sync_periods:
@@ -149,12 +168,14 @@ def parse_policy(text: str) -> PolicySpec:
     if not isinstance(body, dict):
         raise ValueError("policy document must be a mapping")
     if not any(
-        k in body for k in ("syncPeriod", "priority", "throughput", "slo")
+        k in body
+        for k in ("syncPeriod", "priority", "throughput", "slo", "program")
     ):
         # any YAML mapping parses "successfully"; require at least one known
         # key so unrelated/garbage files don't silently become empty policy
         raise ValueError(
-            "policy document has none of syncPeriod/priority/throughput/slo"
+            "policy document has none of "
+            "syncPeriod/priority/throughput/slo/program"
         )
     periods = []
     for entry in body.get("syncPeriod") or []:
@@ -208,10 +229,47 @@ def parse_policy(text: str) -> PolicySpec:
         from nanotpu.metrics.slo import parse_objectives
 
         slo = parse_objectives(body.get("slo") or [])
+    program = None
+    if "program" in body:
+        program = _parse_program(body.get("program"))
     return PolicySpec(
         sync_periods=tuple(periods), priorities=tuple(weights),
-        throughput=throughput, slo=slo,
+        throughput=throughput, slo=slo, program=program,
     )
+
+
+def _parse_program(section) -> ProgramSpec:
+    """``program:`` section -> verified :class:`ProgramSpec`. The
+    verifier runs HERE, at parse time: a program that cannot be proven
+    safe makes the whole document invalid, so the watcher's
+    keep-last-good path rejects it loudly and the serving rater is
+    never touched (docs/policy-programs.md). Lazy imports mirror the
+    ``slo`` section's parse_objectives idiom."""
+    from nanotpu.policy_ir.programs import program_source
+    from nanotpu.policy_ir.verify import verify_source
+
+    if not isinstance(section, dict):
+        raise ValueError("policy.program must be a mapping")
+    name = str(section.get("name") or "")
+    source = section.get("source")
+    if source is not None and not isinstance(source, str):
+        raise ValueError("policy.program.source must be a string")
+    if source is None:
+        if not name:
+            raise ValueError(
+                "policy.program needs `source:` (inline program text) "
+                "or `name:` (an in-tree program)"
+            )
+        source = program_source(name)
+    elif not name:
+        name = "inline"
+    violations = verify_source(source, path=f"<program:{name}>")
+    if violations:
+        shown = "; ".join(v.render() for v in violations[:8])
+        raise ValueError(
+            f"policy.program {name!r} failed verification: {shown}"
+        )
+    return ProgramSpec(name=name, source=source)
 
 
 class PolicyWatcher:
@@ -232,6 +290,16 @@ class PolicyWatcher:
         #: YAML overrides hot (docs/scoring.md); a raising callback is
         #: logged, never fatal to the poller
         self.on_reload = on_reload
+        #: typed reload-failure accounting: a half-written policy.yaml
+        #: (ConfigMap mid-rewrite, truncated YAML, a program failing
+        #: verification) must keep the last-good spec AND be visible —
+        #: ``reload_failures`` counts every failed load, and
+        #: ``last_reload_error`` holds the failure class ("io" =
+        #: unreadable file, "parse" = invalid document/program).
+        #: on_reload is NOT called on failure, so consumers never see a
+        #: half-written spec.
+        self.reload_failures = 0
+        self.last_reload_error = ""
         if path:
             self._load(initial=True)
             threading.Thread(
@@ -262,6 +330,14 @@ class PolicyWatcher:
                 except Exception:
                     log.exception("policy on_reload callback failed")
         except (OSError, ValueError) as e:
+            with self._lock:
+                self.reload_failures += 1
+                self.last_reload_error = (
+                    "io" if isinstance(e, OSError) else "parse"
+                )
+            # _mtime is deliberately NOT advanced: the next poll retries,
+            # so a ConfigMap caught mid-rewrite heals as soon as the
+            # write completes
             log.error("policy load failed (%s); keeping last good spec", e)
 
     def _poll(self) -> None:

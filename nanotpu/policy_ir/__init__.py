@@ -1,0 +1,40 @@
+"""Verified policy programs: restricted-Python scoring, proven safe
+before load (docs/policy-programs.md).
+
+The pipeline: :mod:`verify` PROVES a candidate program is isolated,
+integer-only, terminating, total, and clamped; :mod:`compiler` lowers a
+proven program to the batch ``score_hook`` path in Q16 fixed point;
+:mod:`programs` holds the in-tree corpus ``make lint`` verifies;
+:mod:`shadow` scores candidates on a follower's RCU snapshots and
+ledgers divergences; :mod:`gate` is the ``make policy-check`` promotion
+bar a candidate must clear before the leader may load it.
+"""
+
+from __future__ import annotations
+
+from nanotpu.policy_ir.compiler import (
+    PolicyProgramError,
+    ProgramRater,
+    compile_program,
+)
+from nanotpu.policy_ir.programs import load_program, program_source
+from nanotpu.policy_ir.verify import (
+    LOOP_BOUND_MAX,
+    SCORE_PARAMS,
+    Violation,
+    verify_source,
+    verify_tree,
+)
+
+__all__ = [
+    "PolicyProgramError",
+    "ProgramRater",
+    "compile_program",
+    "load_program",
+    "program_source",
+    "LOOP_BOUND_MAX",
+    "SCORE_PARAMS",
+    "Violation",
+    "verify_source",
+    "verify_tree",
+]

@@ -1,0 +1,138 @@
+"""The policy-program compiler: verified AST -> batch-path rater.
+
+Compilation is deliberately boring: the verifier
+(:mod:`nanotpu.policy_ir.verify`) has already PROVEN the program is a
+pure, total, terminating, integer-only function of its five parameters,
+so lowering is CPython ``compile()`` of the verified AST under empty
+globals (``__builtins__`` pared to the three whitelisted calls). The
+interesting contract is the rater the program becomes:
+
+* :meth:`ProgramRater.batch_score_rows` is the ``score_hook`` the
+  BatchScorer runs over frozen rows — same slot, same refusal
+  semantics (``perf.hook_refusals``) as the r8 throughput rater, with
+  term extraction from :mod:`nanotpu.allocator.terms` so the program
+  sees bit-identical integers on every path;
+* infeasible rows score ``SCORE_MIN`` in the hook and the dealer folds
+  the gang bonus AFTER it (``_hook_gang_bonus``) — matching the native
+  fused path's ``0 + gang_bonus`` infeasible rule byte for byte;
+* ``rate``/``choose`` serve the per-node fallback path with the same
+  terms; ``choose`` places via the shared engine with
+  ``prefer_used=True`` (programs rank candidates, the placement engine
+  packs — the ``plan.score == rate`` discipline the throughput rater
+  established).
+
+A program that fails verification raises :class:`PolicyProgramError`
+carrying every typed violation — callers (PolicyWatcher's ``program:``
+reload, the promotion gate) reject LOUDLY and keep serving the old
+program.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+
+from nanotpu import types
+from nanotpu.allocator.terms import Q_ONE, q16_chipset_terms, q16_row_terms
+from nanotpu.policy_ir.verify import Violation, verify_source
+
+#: the only names a compiled program's globals expose — the verifier
+#: has proven these are the only calls it makes
+_SAFE_BUILTINS = {"min": min, "max": max, "abs": abs, "range": range}
+
+
+class PolicyProgramError(ValueError):
+    """A candidate program failed verification; ``violations`` carries
+    the typed findings (the reload path logs them one per line)."""
+
+    def __init__(self, name: str, violations: list[Violation]):
+        self.program_name = name
+        self.violations = violations
+        lines = "; ".join(v.render() for v in violations[:8])
+        more = (
+            f" (+{len(violations) - 8} more)" if len(violations) > 8 else ""
+        )
+        super().__init__(
+            f"policy program {name!r} failed verification: {lines}{more}"
+        )
+
+
+class ProgramRater:
+    """A verified, compiled policy program serving the Rater protocol +
+    the batch row hook. ``fingerprint`` is the source sha256 — what the
+    reload log and ``/debug/shadow`` report, so an operator can tell
+    WHICH program is serving without diffing YAML."""
+
+    def __init__(self, fn, program_name: str, fingerprint: str,
+                 source: str):
+        self._fn = fn
+        self.program_name = program_name
+        self.fingerprint = fingerprint
+        self.source = source
+        self.name = f"program:{program_name}"
+
+    # -- Rater protocol ----------------------------------------------------
+    def rate(self, chips, demand) -> int:
+        occupancy, fragmentation, contention = q16_chipset_terms(chips)
+        # defense in depth only: the verifier proved the range already,
+        # and clamping an in-range int is the identity (bit-safe)
+        return max(types.SCORE_MIN, min(
+            types.SCORE_MAX,
+            self._fn(Q_ONE, contention, fragmentation, occupancy, 0),
+        ))
+
+    def choose(self, chips, demand):
+        from nanotpu.allocator.rater import Plan, _choose
+
+        assignments = _choose(chips, demand, prefer_used=True)
+        if assignments is None:
+            return None
+        # plan.score == rate: one number across the per-node path, the
+        # batch hook, and the ledger (no plan-local bonus) — same
+        # discipline as the throughput rater
+        return Plan(
+            demand=demand, assignments=assignments,
+            score=self.rate(chips, demand),
+        )
+
+    # -- batch row hook (BatchScorer.run(score_hook=...)) ------------------
+    def batch_score_rows(self, scorer, demand, feasible) -> list[int]:
+        """The program over a frozen BatchScorer's row arrays: same
+        integer terms as the per-node path (rows are copies of exactly
+        that state), infeasible rows score SCORE_MIN, the dealer folds
+        gang bonuses after — so program wire bytes match the built-in
+        raters' discipline on every path."""
+        fn = self._fn
+        c = scorer.chip_count
+        out: list[int] = []
+        for i in range(len(scorer.infos)):
+            if not feasible[i]:
+                out.append(types.SCORE_MIN)
+                continue
+            row = i * c
+            occupancy, fragmentation, contention = q16_row_terms(
+                scorer.free[row:row + c],
+                scorer.total[row:row + c],
+                scorer.load_q[row:row + c],
+            )
+            out.append(max(types.SCORE_MIN, min(
+                types.SCORE_MAX,
+                fn(Q_ONE, contention, fragmentation, occupancy, 0),
+            )))
+        return out
+
+
+def compile_program(text: str, name: str = "policy") -> ProgramRater:
+    """Verify ``text`` and lower it to a :class:`ProgramRater`.
+    Raises :class:`PolicyProgramError` (with every typed violation) if
+    the proof fails — nothing is executed in that case."""
+    violations = verify_source(text, path=f"<program:{name}>")
+    if violations:
+        raise PolicyProgramError(name, violations)
+    tree = ast.parse(text, filename=f"<program:{name}>")
+    code = compile(tree, filename=f"<program:{name}>", mode="exec")
+    namespace: dict = {"__builtins__": dict(_SAFE_BUILTINS)}
+    exec(code, namespace)  # verified: defs + int constants only
+    fn = namespace["score"]
+    fingerprint = hashlib.sha256(text.encode()).hexdigest()[:16]
+    return ProgramRater(fn, name, fingerprint, text)

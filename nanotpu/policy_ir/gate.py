@@ -1,0 +1,157 @@
+"""Promotion gate: the evidence bar a candidate policy program must
+clear before policy.yaml may serve it (docs/policy-programs.md).
+
+``python -m nanotpu.policy_ir.gate --program <name>`` replays one
+deterministic sim scenario three ways and emits a JSON verdict:
+
+1. **proof** — the static verifier must accept the program (an
+   unprovable program is refused before any replay runs);
+2. **shadow** — the candidate shadows the follower fleet against the
+   serving policy; any divergence is refused by default (a program that
+   scores differently is a behavior change, and behavior changes need
+   the explicit ``--allow-divergence`` operator override, never a
+   silent promotion);
+3. **serving** — the candidate replaces the serving policy for a full
+   replay, which must finish with ZERO invariant violations and at
+   least parity with the baseline on mean/final occupancy and
+   mean/final fragmentation.
+
+Exit 0 = promote, 1 = refused (the verdict says exactly why), 2 = bad
+usage/scenario — the same contract as ``python -m nanotpu.sim``.
+``make policy-check`` runs this gate twice: the byte-equivalent
+``binpack_q16`` must pass, the ``divergent`` fixture must be refused.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_DEFAULT_SCENARIO = "examples/sim/policy-shadow.json"
+
+
+def _replay(scenario: dict, seed: int) -> dict:
+    from nanotpu.sim.core import Simulator
+
+    return Simulator(scenario, seed=seed).run()
+
+
+def run_gate(program: str, scenario: dict, seed: int = 0,
+             allow_divergence: bool = False) -> dict:
+    """The gate's full evidence run -> verdict dict (``promote`` bool +
+    per-check results). ``scenario`` is the RAW scenario document; the
+    gate derives the three replays from it."""
+    from nanotpu.policy_ir.programs import program_source
+    from nanotpu.policy_ir.verify import verify_source
+
+    verdict: dict = {"program": program, "seed": seed, "checks": {}}
+
+    # 1. proof: refuse before spending a single replay on an unprovable
+    # program — and report the typed violations, not a stack trace
+    try:
+        source = program_source(program)
+    except ValueError as e:
+        verdict["checks"]["proof"] = {"ok": False, "error": str(e)}
+        verdict["promote"] = False
+        return verdict
+    violations = verify_source(source, path=f"<program:{program}>")
+    verdict["checks"]["proof"] = {
+        "ok": not violations,
+        "violations": [v.render() for v in violations],
+    }
+    if violations:
+        verdict["promote"] = False
+        return verdict
+
+    def _variant(policy=None, shadow_program=None):
+        scn = json.loads(json.dumps(scenario))  # deep copy, JSON-pure
+        ha = scn.setdefault("ha", {})
+        shadow = ha.setdefault("shadow", {})
+        shadow["enabled"] = shadow_program is not None
+        if shadow_program is not None:
+            shadow["program"] = shadow_program
+        if policy is not None:
+            scn["policy"] = policy
+        return scn
+
+    # 2. shadow: candidate vs serving policy on the follower fleet
+    shadow_rep = _replay(_variant(shadow_program=program), seed)
+    sh = shadow_rep.get("shadow", {})
+    verdict["checks"]["shadow"] = {
+        "ok": allow_divergence or sh.get("divergences", 0) == 0,
+        "divergences": sh.get("divergences", 0),
+        "rows": sh.get("rows", 0),
+        "max_abs_delta": sh.get("max_abs_delta", 0),
+        "records_digest": sh.get("records_digest", ""),
+        "allow_divergence": allow_divergence,
+    }
+
+    # 3. serving: the candidate carries the whole replay
+    baseline = _replay(_variant(), seed)
+    candidate = _replay(_variant(policy=f"program:{program}"), seed)
+    occ_b, occ_c = baseline["occupancy_pct"], candidate["occupancy_pct"]
+    frag_b, frag_c = baseline["fragmentation"], candidate["fragmentation"]
+    viol = candidate["invariants"]["violations"]
+    verdict["checks"]["invariants"] = {"ok": viol == 0, "violations": viol}
+    verdict["checks"]["occupancy"] = {
+        "ok": occ_c["mean"] >= occ_b["mean"]
+        and occ_c["final"] >= occ_b["final"],
+        "baseline": occ_b, "candidate": occ_c,
+    }
+    verdict["checks"]["fragmentation"] = {
+        "ok": frag_c["mean"] <= frag_b["mean"]
+        and frag_c["final"] <= frag_b["final"],
+        "baseline": frag_b, "candidate": frag_c,
+    }
+    verdict["checks"]["bound"] = {
+        # a candidate that strands pods the baseline placed is a
+        # regression no score parity excuses
+        "ok": candidate["pods"]["bound"] >= baseline["pods"]["bound"],
+        "baseline": baseline["pods"]["bound"],
+        "candidate": candidate["pods"]["bound"],
+    }
+    verdict["promote"] = all(
+        c["ok"] for c in verdict["checks"].values()
+    )
+    return verdict
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m nanotpu.policy_ir.gate",
+        description="promotion gate for verified policy programs "
+                    "(docs/policy-programs.md)",
+    )
+    parser.add_argument("--program", required=True,
+                        help="in-tree program name (policy_ir/programs/)")
+    parser.add_argument("--scenario", default=_DEFAULT_SCENARIO,
+                        help=f"replay scenario (default {_DEFAULT_SCENARIO})")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--allow-divergence", action="store_true",
+        help="operator override: promote on parity+invariants even when "
+             "the shadow replay diverges (an intentional behavior change)",
+    )
+    args = parser.parse_args(argv)
+    try:
+        with open(args.scenario) as f:
+            scenario = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"gate: cannot load scenario {args.scenario!r}: {e}",
+              file=sys.stderr)
+        return 2
+    try:
+        verdict = run_gate(
+            args.program, scenario, seed=args.seed,
+            allow_divergence=args.allow_divergence,
+        )
+    except ValueError as e:
+        print(f"gate: bad scenario: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(verdict, sort_keys=True, indent=2))
+    return 0 if verdict["promote"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,43 @@
+"""In-tree policy programs (docs/policy-programs.md).
+
+Every ``*.py`` here (this registry module aside) is a restricted-Python
+policy program: the nanolint ``policyver`` pass verifies each one on
+every ``make lint``, so the tree cannot carry a program the runtime
+would refuse to load. ``load_program`` is the one consumer-facing
+entry: sim scenarios, the promotion gate, and tests name programs by
+module basename (``"binpack_q16"``), never by path.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from nanotpu.policy_ir.compiler import ProgramRater, compile_program
+
+_HERE = Path(__file__).resolve().parent
+
+
+def program_names() -> list[str]:
+    """Basenames of every in-tree program, sorted."""
+    return sorted(
+        p.stem for p in _HERE.glob("*.py") if p.stem != "__init__"
+    )
+
+
+def program_source(name: str) -> str:
+    """Source text of an in-tree program. ValueError on unknown names
+    (and on anything that is not a plain module basename — the sim
+    scenario knob feeds this, so path traversal must not)."""
+    if not name.isidentifier():
+        raise ValueError(f"program name {name!r} is not a module basename")
+    path = _HERE / f"{name}.py"
+    if not path.is_file():
+        raise ValueError(
+            f"unknown policy program {name!r}; have {program_names()}"
+        )
+    return path.read_text()
+
+
+def load_program(name: str) -> ProgramRater:
+    """Verify + compile an in-tree program by basename."""
+    return compile_program(program_source(name), name)

@@ -1,0 +1,37 @@
+"""Binpack, re-expressed as a verified policy program.
+
+The built-in binpack wire score on the batch path is
+``clamp(min(base, 90) + compactness * 10)`` with
+``base = clamp(usage * 100 - mean_load * 50)`` (native
+``score_placed``). This program computes the same number from the Q16
+terms: ``occupancy`` IS usage (Q16), ``contention`` IS the mean
+quantized per-card load, and on single-chip fractional placements the
+compactness band is the constant ``+ 10`` (a one-chip placement is
+maximally compact). The gang bonus is NOT added here — the dealer folds
+it after the hook, exactly as for the built-in raters.
+
+``DEQUANT_SLACK`` undoes the double floor: ``occupancy`` is already
+``floor(used * Q / total)``, so flooring ``occupancy * 100 / Q`` again
+drops up to ``100 * frac(used * Q / total)`` — which lands exactly on
+the "nice" percentages (``used * 100 / total`` integral, e.g. 20 of
+400) and scores them one point low. Adding 99 before the floor restores
+``(used * 100) // total`` exactly on hosts up to 6 chips (the dropped
+fraction is at most ``100 - 100 * gcd(Q, total) / total`` ≤ 96 there)
+without ever rounding a non-integral percentage up.
+
+tests/test_policy_ir.py pins wire-byte parity against the built-in
+binpack rater, single-shard and sharded, on fleets where these
+identities are exact (docs/policy-programs.md walks the argument).
+"""
+
+LOAD_WEIGHT = 50
+COMPACTNESS_BAND = 10
+Q_ONE = 65536
+DEQUANT_SLACK = 99
+
+
+def score(base_q, contention, fragmentation, occupancy, gang_bonus):
+    usage_pct = (occupancy * 100 + DEQUANT_SLACK) // Q_ONE
+    base = usage_pct - (contention * LOAD_WEIGHT) // Q_ONE
+    base = max(0, min(100, base))
+    return min(base, 100 - COMPACTNESS_BAND) + COMPACTNESS_BAND

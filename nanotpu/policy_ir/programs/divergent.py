@@ -1,0 +1,14 @@
+"""Deliberately divergent candidate: SPREADS instead of packing
+(score falls as occupancy rises — binpack inverted). It verifies
+cleanly — divergence is a quality problem, not a safety one — which is
+exactly what shadow mode exists to catch: run it on a follower and the
+``shadow_divergence`` ledger records + ``nanotpu_shadow_*`` gauges
+light up, and the ``make policy-check`` promotion gate refuses it on
+the occupancy/fragmentation parity bar (docs/policy-programs.md)."""
+
+Q_ONE = 65536
+
+
+def score(base_q, contention, fragmentation, occupancy, gang_bonus):
+    spread = ((Q_ONE - occupancy) * 100) // Q_ONE
+    return max(0, min(100, spread - (contention * 30) // Q_ONE))

@@ -1,0 +1,18 @@
+"""Fragmentation-guarding packer: a program the built-in raters cannot
+express — it pays for occupancy like binpack but REWARDS keeping whole
+chips whole (the ``fragmentation`` term is the share of free capacity
+on wholly-free chips), steering fractional pods onto already-broken
+chips so gangs keep finding contiguous boxes (docs/defrag.md's goal,
+as a config push instead of the recovery plane's repair work)."""
+
+BASE_BAND = 60
+FRAG_BAND = 25
+CONTENTION_BAND = 15
+Q_ONE = 65536
+
+
+def score(base_q, contention, fragmentation, occupancy, gang_bonus):
+    base = (BASE_BAND * occupancy) // Q_ONE
+    frag = (FRAG_BAND * fragmentation) // Q_ONE
+    cont = (CONTENTION_BAND * contention) // Q_ONE
+    return max(0, min(100, base + frag - cont))

@@ -1,0 +1,164 @@
+"""Shadow-mode A/B: candidate programs scored on the follower fleet.
+
+A follower (docs/read-plane.md) is an always-warm, read-only replica of
+the whole fleet — the perfect host for auditioning a candidate policy
+program with ZERO leader risk. The :class:`ShadowScorer` scores sampled
+cycles TWICE against the follower's own RCU snapshot: once with the
+serving policy (the follower's rater is its replica of the leader's
+policy, so these are the leader's wire scores — native parity is
+fuzz-pinned), once with the verified candidate. Rows where the two
+disagree become typed ``shadow_divergence`` ledger records
+(:data:`~nanotpu.obs.decisions.REASON_SHADOW_DIVERGENCE`) in a bounded
+ring served by ``GET /debug/shadow``, plus the ``nanotpu_shadow_*``
+gauges — the evidence ``make policy-check``'s promotion gate weighs
+before the leader may load the candidate.
+
+Feasibility is rater-independent (a placement exists or it does not),
+so infeasible rows are excluded from both sides rather than counted as
+trivial agreement. Nothing here mutates fleet state: the scorer reads
+the published snapshot and per-node chip sets exactly like a follower
+read would.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from nanotpu.analysis.witness import make_lock
+from nanotpu.obs import decisions
+
+
+class ShadowScorer:
+    """Per-follower shadow scorer for ONE candidate program.
+
+    ``clock`` is injectable so the sim's records carry virtual time and
+    stay byte-reproducible (same rule as the decision ledger)."""
+
+    def __init__(self, dealer, candidate, capacity: int = 256,
+                 clock=time.monotonic):
+        if capacity <= 0:
+            raise ValueError(
+                f"shadow record capacity must be > 0, got {capacity}"
+            )
+        self.dealer = dealer
+        self.candidate = candidate
+        self.clock = clock
+        #: ring bound, exposed for /debug/shadow's limit clamp
+        self.capacity = int(capacity)
+        self._lock = make_lock("ShadowScorer._lock")
+        self._ring: deque[dict] = deque(maxlen=int(capacity))
+        self.cycles = 0
+        self.rows = 0
+        self.divergences = 0
+        self.max_abs_delta = 0
+        self._seq = 0
+
+    # -- scoring -----------------------------------------------------------
+    def sample(self, demand) -> dict:
+        """Score one shadow cycle: every node in the follower's
+        published snapshot, serving policy vs candidate, divergent rows
+        ledgered. Returns the cycle summary (the sim's report section
+        aggregates these)."""
+        dealer = self.dealer
+        nodes = self._snapshot_nodes(dealer)
+        baseline_rater = dealer.rater
+        candidate = self.candidate
+        compared = 0
+        diverged = []
+        t = round(self.clock(), 6)
+        for name in sorted(nodes):
+            info = nodes[name]
+            plan = info.assume(demand, baseline_rater)
+            if plan is None:
+                continue  # infeasible: rater-independent, both sides skip
+            baseline = plan.score
+            shadow = candidate.rate(info.chips, demand)
+            compared += 1
+            if shadow != baseline:
+                diverged.append({
+                    "node": name,
+                    "baseline": int(baseline),
+                    "candidate": int(shadow),
+                    "delta": int(shadow) - int(baseline),
+                })
+        with self._lock:
+            self.cycles += 1
+            self.rows += compared
+            self.divergences += len(diverged)
+            self._seq += 1
+            seq = self._seq
+            for row in diverged:
+                self.max_abs_delta = max(
+                    self.max_abs_delta, abs(row["delta"])
+                )
+                self._ring.append({
+                    "reason": decisions.REASON_SHADOW_DIVERGENCE,
+                    "seq": seq,
+                    "t": t,
+                    "program": candidate.program_name,
+                    "fingerprint": candidate.fingerprint,
+                    "demand": demand.hash(),
+                    **row,
+                })
+        return {
+            "seq": seq,
+            "rows": compared,
+            "diverged": len(diverged),
+        }
+
+    @staticmethod
+    def _snapshot_nodes(dealer) -> dict:
+        """Published NodeInfos across every shard — the same RCU
+        snapshots follower reads serve from, so shadow baselines are
+        exactly the scores the leader's wire protocol would answer."""
+        if getattr(dealer, "_shard_fn", None) is None:
+            return dict(dealer._published.nodes)
+        nodes: dict = {}
+        # list() snapshot: _register_node can insert a new shard mid-walk
+        for shard in list(dealer._shards.values()):
+            if shard._pending or shard._pending_all:
+                dealer._drain_shard(shard)  # commit-pipeline read barrier
+            nodes.update(shard._published.nodes)
+        return nodes
+
+    # -- retrieval ---------------------------------------------------------
+    def dump(self) -> list[dict]:
+        """Every retained divergence record, oldest first (digest
+        input for the sim's ``shadow`` report section)."""
+        with self._lock:
+            return [dict(r) for r in self._ring]
+
+    def recent(self, limit: int = 50) -> list[dict]:
+        """The newest ``limit`` divergence records, newest first."""
+        with self._lock:
+            records = list(self._ring)
+        records.reverse()
+        return [dict(r) for r in records[:max(0, limit)]]
+
+    def status(self) -> dict:
+        """The ``GET /debug/shadow`` body (sans records): which program
+        is shadowing and what it has disagreed with so far."""
+        with self._lock:
+            return {
+                "program": self.candidate.program_name,
+                "fingerprint": self.candidate.fingerprint,
+                "cycles": self.cycles,
+                "rows": self.rows,
+                "divergences": self.divergences,
+                "max_abs_delta": self.max_abs_delta,
+                "records_retained": len(self._ring),
+            }
+
+    # -- exposition --------------------------------------------------------
+    def shadow_gauge_values(self) -> dict:
+        """The ``nanotpu_shadow_*`` producer; keys are pinned against
+        ``nanotpu.metrics.shadow._SHADOW_GAUGES`` both directions by the
+        nanolint metrics-completeness pass."""
+        with self._lock:
+            return {
+                "cycles": self.cycles,
+                "rows": self.rows,
+                "divergences": self.divergences,
+                "max_abs_delta": self.max_abs_delta,
+            }

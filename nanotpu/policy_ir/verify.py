@@ -1,0 +1,795 @@
+"""The policy-program verifier: PROVE a program is safe to hot-load.
+
+A policy program is a restricted-Python module that scores one
+placement candidate from the five Q16 terms the scoring ABI exposes
+(``nanotpu.allocator.terms``). Before the compiler will touch it, this
+module proves — by AST inspection plus integer interval analysis, no
+execution — that the program:
+
+* imports nothing, opens nothing, locks nothing, reads no global
+  mutable state (**isolation**);
+* uses only whitelisted integer operations — ``+ - * // %``,
+  comparisons, ``min``/``max``/``abs``, ``if``/``elif``/``else`` and
+  conditional expressions (**integer-only**: ``/`` and float literals
+  are typed violations, so Q16 bit-determinism survives by
+  construction);
+* loops only via ``for _ in range(K)`` with a constant bound
+  ``K <= LOOP_BOUND_MAX`` (**termination**);
+* returns on every path (**totality**) a value PROVABLY inside
+  ``[SCORE_MIN, SCORE_MAX]`` (**clamp proof**, by interval analysis
+  over the declared term ranges);
+* calls nothing nondeterministic — no time, no random, no set-order
+  dependence — the same idioms the sim-determinism pass bans
+  (docs/static-analysis.md).
+
+The grammar (docs/policy-programs.md):
+
+    '''optional docstring'''
+    SOME_CONST = 42              # optional UPPER_CASE int constants
+
+    def score(base_q, contention, fragmentation, occupancy, gang_bonus):
+        ...                      # restricted statements
+        return <provably clamped int>
+
+Violations are TYPED — each carries a stable ``code`` the nanolint
+``policyver`` pass (and the rejection-corpus tests) pin on, the same
+contract the other passes' findings live under.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from nanotpu import types
+
+#: the exact score() parameter list, in ABI order (docs/policy-programs.md)
+SCORE_PARAMS = (
+    "base_q", "contention", "fragmentation", "occupancy", "gang_bonus",
+)
+
+#: hard termination bound: the abstract interpreter unrolls every loop,
+#: so the bound is also what keeps VERIFICATION itself O(small)
+LOOP_BOUND_MAX = 64
+
+Q_ONE = 1 << 16
+
+#: declared input intervals the clamp proof starts from — the term
+#: extractor (nanotpu.allocator.terms) guarantees these at runtime
+PARAM_RANGES: dict[str, tuple[int, int]] = {
+    "base_q": (0, Q_ONE),
+    "contention": (0, Q_ONE),
+    "fragmentation": (0, Q_ONE),
+    "occupancy": (0, Q_ONE),
+    "gang_bonus": (types.SCORE_MIN, types.SCORE_MAX),
+}
+
+#: pure integer builtins a program may call
+_ALLOWED_CALLS = ("min", "max", "abs")
+
+#: call roots that mean nondeterminism, typed separately from the
+#: generic whitelist miss so the finding names the actual hazard (the
+#: sim-determinism pass's ban list, minus what the grammar already
+#: makes unreachable)
+_NONDET_ROOTS = (
+    "time", "random", "uuid", "os", "datetime", "secrets",
+)
+_NONDET_BUILTINS = (
+    "set", "frozenset", "sorted", "hash", "id", "iter", "next",
+    "vars", "dir", "globals", "locals",
+)
+
+#: statement types that are banned wholesale; everything not explicitly
+#: handled by the walker is a forbidden-construct finding too, so new
+#: Python syntax fails CLOSED
+_BANNED_STMTS = {
+    ast.While: "while loops cannot be proven to terminate — use "
+               "`for _ in range(K)` with a constant bound",
+    ast.Try: "exception handling is control flow the clamp proof "
+             "cannot follow",
+    ast.With: "context managers can acquire locks / open files",
+    ast.Raise: "a raising program is not total",
+    ast.Assert: "assert vanishes under -O; encode the check as an if",
+    ast.Delete: "del serves no purpose over integer locals",
+    ast.Global: "global state breaks isolation",
+    ast.Nonlocal: "nonlocal state breaks isolation",
+    ast.ClassDef: "class definitions are not part of the subset",
+    ast.AsyncFunctionDef: "async code is not part of the subset",
+    ast.Lambda: "nested callables hide control flow from the verifier",
+}
+
+#: whitelisted integer binary operators
+_INT_BINOPS = (ast.Add, ast.Sub, ast.Mult, ast.FloorDiv, ast.Mod)
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One typed verification failure (code is the stable contract the
+    policyver pass and the rejection corpus pin on)."""
+
+    code: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"line {self.line}: [{self.code}] {self.message}"
+
+
+# -- interval arithmetic ---------------------------------------------------
+
+_TOP = (None, None)  # unknown bounds (still an int — type is by grammar)
+
+
+def _iv_add(a, b):
+    lo = None if a[0] is None or b[0] is None else a[0] + b[0]
+    hi = None if a[1] is None or b[1] is None else a[1] + b[1]
+    return (lo, hi)
+
+
+def _iv_neg(a):
+    return (
+        None if a[1] is None else -a[1],
+        None if a[0] is None else -a[0],
+    )
+
+
+def _iv_sub(a, b):
+    return _iv_add(a, _iv_neg(b))
+
+
+def _iv_mul(a, b):
+    if None in a or None in b:
+        return _TOP
+    prods = [a[0] * b[0], a[0] * b[1], a[1] * b[0], a[1] * b[1]]
+    return (min(prods), max(prods))
+
+
+def _iv_floordiv(a, b):
+    # caller has already proven 0 not in b
+    if None in a or None in b:
+        return _TOP
+    quots = [a[0] // b[0], a[0] // b[1], a[1] // b[0], a[1] // b[1]]
+    return (min(quots), max(quots))
+
+
+def _iv_mod(a, b):
+    # x % y for y > 0 lands in [0, y_hi - 1]; for y < 0 in (y_lo, 0]
+    if None in b:
+        return _TOP
+    if b[0] > 0:
+        return (0, b[1] - 1)
+    if b[1] < 0:
+        return (b[0] + 1, 0)
+    return _TOP  # mixed-sign divisor interval (0 already excluded)
+
+
+def _iv_min(ivs):
+    lo = None
+    his = []
+    for iv in ivs:
+        if iv[0] is not None:
+            lo = iv[0] if lo is None else min(lo, iv[0])
+        his.append(iv[1])
+    if any(iv[0] is None for iv in ivs):
+        lo = None
+    hi = None if all(h is None for h in his) else min(
+        h for h in his if h is not None
+    )
+    return (lo, hi)
+
+
+def _iv_max(ivs):
+    los = []
+    hi = None
+    for iv in ivs:
+        if iv[1] is not None:
+            hi = iv[1] if hi is None else max(hi, iv[1])
+        los.append(iv[0])
+    if any(iv[1] is None for iv in ivs):
+        hi = None
+    lo = None if all(l is None for l in los) else max(
+        l for l in los if l is not None
+    )
+    return (lo, hi)
+
+
+def _iv_abs(a):
+    if None in a:
+        # |x| is at least 0 even with unknown inputs
+        return (0, None)
+    if a[0] >= 0:
+        return a
+    if a[1] <= 0:
+        return _iv_neg(a)
+    return (0, max(-a[0], a[1]))
+
+
+def _iv_join(a, b):
+    """Least upper bound of two intervals (if/else merge)."""
+    lo = None if a[0] is None or b[0] is None else min(a[0], b[0])
+    hi = None if a[1] is None or b[1] is None else max(a[1], b[1])
+    return (lo, hi)
+
+
+class _Verifier:
+    """One program's verification state: violations + the abstract
+    environments the clamp proof threads through the body."""
+
+    def __init__(self):
+        self.violations: list[Violation] = []
+        self.consts: dict[str, int] = {}
+
+    def fail(self, code: str, node: ast.AST, message: str) -> None:
+        self.violations.append(
+            Violation(code, getattr(node, "lineno", 0), message)
+        )
+
+    # -- module shape ------------------------------------------------------
+    def run(self, tree: ast.Module) -> None:
+        score_def = None
+        body = list(tree.body)
+        if body and isinstance(body[0], ast.Expr) and isinstance(
+            body[0].value, ast.Constant
+        ) and isinstance(body[0].value.value, str):
+            body = body[1:]  # module docstring
+        for node in body:
+            if isinstance(node, ast.Assign) or isinstance(
+                node, ast.AnnAssign
+            ):
+                self._module_const(node)
+            elif isinstance(node, ast.FunctionDef):
+                if node.name != "score":
+                    self.fail(
+                        "bad-signature", node,
+                        f"only `def score(...)` is allowed at module "
+                        f"level, found `def {node.name}`",
+                    )
+                elif score_def is not None:
+                    self.fail(
+                        "bad-signature", node, "duplicate `def score`"
+                    )
+                else:
+                    score_def = node
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                self.fail(
+                    "forbidden-import", node,
+                    "programs import nothing — the five term parameters "
+                    "are the entire input surface",
+                )
+            else:
+                self.fail(
+                    "forbidden-construct", node,
+                    f"{type(node).__name__} is not part of the module "
+                    "grammar (docstring, UPPER_CASE int constants, "
+                    "def score)",
+                )
+        if score_def is None:
+            self.fail(
+                "bad-signature", tree,
+                "program must define "
+                f"`def score({', '.join(SCORE_PARAMS)})`",
+            )
+            return
+        self._check_signature(score_def)
+        env = dict.fromkeys(SCORE_PARAMS)
+        for p, rng in PARAM_RANGES.items():
+            env[p] = rng
+        env.update({k: (v, v) for k, v in self.consts.items()})
+        self._exec_block(score_def.body, env, in_score=True)
+        if not self._always_returns(score_def.body):
+            self.fail(
+                "non-total", score_def,
+                "a path through score() falls off the end without "
+                "returning — every path must return",
+            )
+
+    def _module_const(self, node) -> None:
+        if isinstance(node, ast.AnnAssign):
+            self.fail(
+                "forbidden-construct", node,
+                "annotated assignments are not part of the subset",
+            )
+            return
+        if len(node.targets) != 1 or not isinstance(
+            node.targets[0], ast.Name
+        ):
+            self.fail(
+                "forbidden-construct", node,
+                "module constants assign one plain name",
+            )
+            return
+        name = node.targets[0].id
+        if not name.isupper():
+            self.fail(
+                "bad-signature", node,
+                f"module-level name {name!r} must be UPPER_CASE (a "
+                "constant) — programs hold no mutable state",
+            )
+        value = node.value
+        neg = False
+        if isinstance(value, ast.UnaryOp) and isinstance(
+            value.op, ast.USub
+        ):
+            neg, value = True, value.operand
+        if not (
+            isinstance(value, ast.Constant)
+            and type(value.value) is int
+        ):
+            if isinstance(value, ast.Constant) and isinstance(
+                value.value, float
+            ):
+                self.fail(
+                    "float-literal", node,
+                    "float constants break Q16 bit-determinism — scale "
+                    "into Q16 integers instead",
+                )
+            else:
+                self.fail(
+                    "forbidden-construct", node,
+                    "module constants must be integer literals",
+                )
+            return
+        self.consts[name] = -value.value if neg else value.value
+
+    def _check_signature(self, fn: ast.FunctionDef) -> None:
+        a = fn.args
+        if (
+            a.posonlyargs or a.kwonlyargs or a.vararg or a.kwarg
+            or a.defaults or a.kw_defaults
+        ):
+            self.fail(
+                "bad-signature", fn,
+                "score() takes exactly the five positional term "
+                "parameters, no defaults/varargs",
+            )
+        names = tuple(arg.arg for arg in a.args)
+        if names != SCORE_PARAMS:
+            self.fail(
+                "bad-signature", fn,
+                f"score() parameters must be exactly "
+                f"({', '.join(SCORE_PARAMS)}), got ({', '.join(names)})",
+            )
+        if fn.decorator_list:
+            self.fail(
+                "forbidden-construct", fn,
+                "decorators run arbitrary code at definition time",
+            )
+
+    # -- statements --------------------------------------------------------
+    def _exec_block(self, stmts, env: dict, in_score: bool) -> dict:
+        """Abstractly execute a statement block, mutating a COPY of the
+        caller's env; returns the post-state (callers join branches)."""
+        for stmt in stmts:
+            env = self._exec_stmt(stmt, env, in_score)
+        return env
+
+    def _exec_stmt(self, stmt, env: dict, in_score: bool) -> dict:
+        for banned, why in _BANNED_STMTS.items():
+            if isinstance(stmt, banned):
+                code = (
+                    "unbounded-loop"
+                    if isinstance(stmt, ast.While) else
+                    "forbidden-construct"
+                )
+                self.fail(code, stmt, why)
+                return env
+        if isinstance(stmt, ast.Return):
+            self._check_return(stmt, env)
+            return env
+        if isinstance(stmt, ast.Assign):
+            if len(stmt.targets) != 1 or not isinstance(
+                stmt.targets[0], ast.Name
+            ):
+                self.fail(
+                    "forbidden-construct", stmt,
+                    "assignments bind one plain local name (no tuple / "
+                    "subscript / attribute targets)",
+                )
+                return env
+            iv = self._eval(stmt.value, env)
+            env = dict(env)
+            env[stmt.targets[0].id] = iv
+            return env
+        if isinstance(stmt, ast.AugAssign):
+            if not isinstance(stmt.target, ast.Name):
+                self.fail(
+                    "forbidden-construct", stmt,
+                    "augmented assignment must target a plain local",
+                )
+                return env
+            fake = ast.BinOp(
+                left=ast.copy_location(
+                    ast.Name(id=stmt.target.id, ctx=ast.Load()), stmt
+                ),
+                op=stmt.op, right=stmt.value,
+            )
+            ast.copy_location(fake, stmt)
+            ast.fix_missing_locations(fake)
+            iv = self._eval(fake, env)
+            env = dict(env)
+            env[stmt.target.id] = iv
+            return env
+        if isinstance(stmt, ast.If):
+            self._eval(stmt.test, env, as_test=True)
+            then_env = self._exec_block(stmt.body, dict(env), in_score)
+            else_env = self._exec_block(stmt.orelse, dict(env), in_score)
+            return self._join_envs(then_env, else_env)
+        if isinstance(stmt, ast.For):
+            return self._exec_for(stmt, env, in_score)
+        if isinstance(stmt, ast.Pass):
+            return env
+        if isinstance(stmt, ast.Expr):
+            self.fail(
+                "forbidden-construct", stmt,
+                "bare expressions have no effect in a pure program",
+            )
+            return env
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            self.fail(
+                "forbidden-construct", stmt,
+                "break/continue make the loop bound conditional — the "
+                "termination proof wants straight-line range loops",
+            )
+            return env
+        self.fail(
+            "forbidden-construct", stmt,
+            f"{type(stmt).__name__} is not part of the subset",
+        )
+        return env
+
+    def _exec_for(self, stmt: ast.For, env: dict, in_score: bool) -> dict:
+        if stmt.orelse:
+            self.fail(
+                "forbidden-construct", stmt,
+                "for/else is not part of the subset",
+            )
+        if not isinstance(stmt.target, ast.Name):
+            self.fail(
+                "forbidden-construct", stmt,
+                "loop target must be one plain name",
+            )
+            return env
+        bound = self._range_bound(stmt.iter)
+        if bound is None:
+            self.fail(
+                "unbounded-loop", stmt,
+                "loops must iterate `range(K)` for a constant "
+                f"K in [1, {LOOP_BOUND_MAX}] — anything else has no "
+                "termination proof",
+            )
+            return env
+        # unroll abstractly: the loop var holds [0, K-1] every pass, so
+        # K transfer applications reach the exact post-loop state
+        env = dict(env)
+        env[stmt.target.id] = (0, bound - 1)
+        for _ in range(bound):
+            body_env = self._exec_block(stmt.body, dict(env), in_score)
+            body_env[stmt.target.id] = (0, bound - 1)
+            joined = self._join_envs(env, body_env)
+            if joined == env:
+                break  # fixpoint before the bound — common for clamps
+            env = joined
+        return env
+
+    def _range_bound(self, iter_node) -> int | None:
+        if not (
+            isinstance(iter_node, ast.Call)
+            and isinstance(iter_node.func, ast.Name)
+            and iter_node.func.id == "range"
+            and not iter_node.keywords
+            and len(iter_node.args) == 1
+        ):
+            return None
+        arg = iter_node.args[0]
+        if isinstance(arg, ast.Constant) and type(arg.value) is int:
+            k = arg.value
+        elif isinstance(arg, ast.Name) and arg.id in self.consts:
+            k = self.consts[arg.id]
+        else:
+            return None
+        if not 1 <= k <= LOOP_BOUND_MAX:
+            return None
+        return k
+
+    def _join_envs(self, a: dict, b: dict) -> dict:
+        out = {}
+        for name in a.keys() & b.keys():
+            ia, ib = a[name], b[name]
+            if ia is None or ib is None:
+                out[name] = None
+            else:
+                out[name] = _iv_join(ia, ib)
+        return out
+
+    def _check_return(self, stmt: ast.Return, env: dict) -> None:
+        if stmt.value is None:
+            self.fail(
+                "non-total", stmt,
+                "bare `return` returns None, not a score",
+            )
+            return
+        iv = self._eval(stmt.value, env)
+        lo, hi = iv
+        if lo is None or hi is None or lo < types.SCORE_MIN or (
+            hi > types.SCORE_MAX
+        ):
+            shown = (
+                "unbounded" if lo is None or hi is None
+                else f"[{lo}, {hi}]"
+            )
+            self.fail(
+                "unclamped-return", stmt,
+                f"returned value has interval {shown}, not provably in "
+                f"[{types.SCORE_MIN}, {types.SCORE_MAX}] — clamp with "
+                f"max({types.SCORE_MIN}, min({types.SCORE_MAX}, x))",
+            )
+
+    # -- expressions -------------------------------------------------------
+    def _eval(self, node, env: dict, as_test: bool = False):
+        """Interval of an expression; records violations as it walks.
+        ``as_test`` admits boolean glue (comparisons / and / or / not)
+        at the top of an if/while-style test position."""
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if type(v) is int:
+                return (v, v)
+            if isinstance(v, float):
+                self.fail(
+                    "float-literal", node,
+                    f"float literal {v!r} breaks Q16 bit-determinism — "
+                    "scale into Q16 integers instead",
+                )
+            elif isinstance(v, bool):
+                self.fail(
+                    "forbidden-construct", node,
+                    "boolean constants are not score values",
+                )
+            else:
+                self.fail(
+                    "forbidden-construct", node,
+                    f"{type(v).__name__} literals are not part of the "
+                    "integer-only subset",
+                )
+            return _TOP
+        if isinstance(node, ast.Name):
+            if node.id in env:
+                iv = env[node.id]
+                if iv is None:
+                    self.fail(
+                        "unknown-name", node,
+                        f"{node.id!r} may be unbound on some path "
+                        "through score()",
+                    )
+                    return _TOP
+                return iv
+            if node.id in _NONDET_BUILTINS or node.id in _NONDET_ROOTS:
+                self.fail(
+                    "nondeterminism", node,
+                    f"{node.id!r} is a nondeterminism source (time / "
+                    "random / set-order) — banned, same rule as the "
+                    "sim-determinism pass",
+                )
+            else:
+                self.fail(
+                    "unknown-name", node,
+                    f"{node.id!r} is not a parameter, local, or module "
+                    "constant — programs read no outer state",
+                )
+            return _TOP
+        if isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in _NONDET_ROOTS:
+                self.fail(
+                    "nondeterminism", node,
+                    f"{ast.unparse(node)} is a nondeterminism source — "
+                    "a program scoring the same row twice must produce "
+                    "the same byte",
+                )
+            else:
+                self.fail(
+                    "attribute-escape", node,
+                    "attribute access reaches outside the five integer "
+                    "parameters — there are no objects in the subset",
+                )
+            return _TOP
+        if isinstance(node, ast.BinOp):
+            left = self._eval(node.left, env)
+            right = self._eval(node.right, env)
+            op = node.op
+            if isinstance(op, ast.Div):
+                self.fail(
+                    "float-op", node,
+                    "true division `/` produces floats — use floor "
+                    "division `//` (Q16 stays integer)",
+                )
+                return _TOP
+            if isinstance(op, ast.Pow):
+                self.fail(
+                    "float-op", node,
+                    "`**` can overflow the interval proof and produce "
+                    "floats on negative exponents — multiply it out",
+                )
+                return _TOP
+            if not isinstance(op, _INT_BINOPS):
+                self.fail(
+                    "forbidden-construct", node,
+                    f"operator {type(op).__name__} is not in the "
+                    "integer whitelist (+ - * // %)",
+                )
+                return _TOP
+            if isinstance(op, (ast.FloorDiv, ast.Mod)):
+                lo, hi = right
+                if lo is None or hi is None or lo <= 0 <= hi:
+                    self.fail(
+                        "division-by-zero", node,
+                        "divisor interval includes 0 — guard the "
+                        "division or divide by a nonzero constant",
+                    )
+                    return _TOP
+                return (
+                    _iv_floordiv(left, right)
+                    if isinstance(op, ast.FloorDiv)
+                    else _iv_mod(left, right)
+                )
+            if isinstance(op, ast.Add):
+                return _iv_add(left, right)
+            if isinstance(op, ast.Sub):
+                return _iv_sub(left, right)
+            return _iv_mul(left, right)
+        if isinstance(node, ast.UnaryOp):
+            if isinstance(node.op, ast.USub):
+                return _iv_neg(self._eval(node.operand, env))
+            if isinstance(node.op, ast.UAdd):
+                return self._eval(node.operand, env)
+            if isinstance(node.op, ast.Not) and as_test:
+                self._eval(node.operand, env, as_test=True)
+                return (0, 1)
+            self.fail(
+                "forbidden-construct", node,
+                f"unary {type(node.op).__name__} is not in the subset",
+            )
+            return _TOP
+        if isinstance(node, ast.Compare):
+            if not as_test:
+                self.fail(
+                    "forbidden-construct", node,
+                    "comparisons are boolean glue for if-tests, not "
+                    "score values",
+                )
+            self._eval(node.left, env)
+            for op, comp in zip(node.ops, node.comparators):
+                if not isinstance(op, (
+                    ast.Lt, ast.LtE, ast.Gt, ast.GtE, ast.Eq, ast.NotEq,
+                )):
+                    self.fail(
+                        "forbidden-construct", node,
+                        f"{type(op).__name__} comparisons (identity / "
+                        "membership) need objects the subset lacks",
+                    )
+                self._eval(comp, env)
+            return (0, 1)
+        if isinstance(node, ast.BoolOp):
+            if not as_test:
+                self.fail(
+                    "forbidden-construct", node,
+                    "and/or are boolean glue for if-tests, not score "
+                    "values",
+                )
+            for v in node.values:
+                self._eval(v, env, as_test=True)
+            return (0, 1)
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, env, as_test=True)
+            return _iv_join(
+                self._eval(node.body, env),
+                self._eval(node.orelse, env),
+            )
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env)
+        if isinstance(node, ast.Lambda):
+            self.fail(
+                "forbidden-construct", node,
+                "nested callables hide control flow from the verifier",
+            )
+            return _TOP
+        if isinstance(node, (
+            ast.List, ast.Tuple, ast.Dict, ast.Set, ast.ListComp,
+            ast.SetComp, ast.DictComp, ast.GeneratorExp, ast.Starred,
+            ast.JoinedStr, ast.Subscript,
+        )):
+            self.fail(
+                "forbidden-construct", node,
+                f"{type(node).__name__} — containers and subscripts are "
+                "not part of the integer-only subset",
+            )
+            return _TOP
+        self.fail(
+            "forbidden-construct", node,
+            f"{type(node).__name__} is not part of the subset",
+        )
+        return _TOP
+
+    def _eval_call(self, node: ast.Call, env: dict):
+        if node.keywords:
+            self.fail(
+                "forbidden-call", node,
+                "keyword arguments are not part of the subset",
+            )
+            return _TOP
+        func = node.func
+        if not isinstance(func, ast.Name):
+            # attribute calls: _eval(Attribute) types it nondeterminism
+            # vs escape
+            self._eval(func, env)
+            for a in node.args:
+                self._eval(a, env)
+            return _TOP
+        name = func.id
+        if name in _ALLOWED_CALLS:
+            if not node.args:
+                self.fail(
+                    "forbidden-call", node, f"{name}() needs arguments"
+                )
+                return _TOP
+            ivs = [self._eval(a, env) for a in node.args]
+            if name == "abs":
+                if len(node.args) != 1:
+                    self.fail(
+                        "forbidden-call", node,
+                        "abs() takes exactly one argument",
+                    )
+                    return _TOP
+                return _iv_abs(ivs[0])
+            return _iv_min(ivs) if name == "min" else _iv_max(ivs)
+        if name in _NONDET_BUILTINS or name in _NONDET_ROOTS:
+            self.fail(
+                "nondeterminism", node,
+                f"{name}() is a nondeterminism source (time / random / "
+                "set-order) — banned, same rule as the sim-determinism "
+                "pass",
+            )
+        elif name == "range":
+            self.fail(
+                "forbidden-call", node,
+                "range() only appears as a for-loop iterable",
+            )
+        else:
+            self.fail(
+                "forbidden-call", node,
+                f"{name}() is not in the call whitelist "
+                f"({', '.join(_ALLOWED_CALLS)})",
+            )
+        for a in node.args:
+            self._eval(a, env)
+        return _TOP
+
+    # -- totality ----------------------------------------------------------
+    def _always_returns(self, stmts) -> bool:
+        for stmt in stmts:
+            if isinstance(stmt, ast.Return):
+                return True
+            if isinstance(stmt, ast.If) and stmt.orelse:
+                if self._always_returns(stmt.body) and (
+                    self._always_returns(stmt.orelse)
+                ):
+                    return True
+        return False
+
+
+def verify_tree(tree: ast.Module) -> list[Violation]:
+    """Verify a parsed program module; [] == PROVEN safe to compile."""
+    v = _Verifier()
+    v.run(tree)
+    return sorted(v.violations, key=lambda x: (x.line, x.code))
+
+
+def verify_source(text: str, path: str = "<policy>") -> list[Violation]:
+    """Verify program source; parse failures are typed violations, not
+    exceptions (same contract as nanolint's unparsable-module finding)."""
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        return [Violation("parse", e.lineno or 0, f"syntax error: {e.msg}")]
+    return verify_tree(tree)

@@ -74,6 +74,7 @@ DEBUG_ROUTES = (
     "/debug/decisions",
     "/debug/timeline",
     "/debug/ha",
+    "/debug/shadow",
     "/debug/verify",
 )
 
@@ -290,6 +291,12 @@ class SchedulerAPI:
         #: the apiserver is unreachable past budget. None costs one
         #: attribute load on the bind path only.
         self.degraded = None
+        #: shadow-mode scorer (docs/policy-programs.md), attached by
+        #: attach_shadow on followers auditioning a candidate policy
+        #: program: serves GET /debug/shadow and registers the
+        #: nanotpu_shadow_* exporter. None == no candidate == zero new
+        #: code on any request path.
+        self.shadow = None
         #: callable -> the verify_state deep-check dict (ha/verify.py),
         #: wired by cmd/main with the live clientset; GET /debug/verify
         #: 404s when absent.
@@ -343,6 +350,8 @@ class SchedulerAPI:
                 return self._debug_ha_lifecycle("rejoin")
             if method == "GET" and path.startswith("/debug/ha"):
                 return self._debug_ha(path)
+            if method == "GET" and path.startswith("/debug/shadow"):
+                return self._debug_shadow(path)
             if method == "GET" and path.startswith("/debug/verify"):
                 return self._debug_verify()
             return 404, "application/json", error_body(
@@ -873,6 +882,45 @@ class SchedulerAPI:
                 body["records"] = []
             else:
                 body["records"] = records
+        return 200, "application/json", json.dumps(body, sort_keys=True)
+
+    # -- shadow mode (docs/policy-programs.md) -----------------------------
+    def attach_shadow(self, scorer) -> None:
+        """Adopt a follower's shadow scorer: serve ``GET /debug/shadow``
+        and register the ``nanotpu_shadow_*`` exporter. Replicas with no
+        candidate program never call this and change by nothing."""
+        from nanotpu.metrics.shadow import ShadowExporter
+
+        self.shadow = scorer
+        self.registry.register(ShadowExporter(scorer))
+
+    def _debug_shadow(self, path: str) -> tuple[int, str, str]:
+        """``GET /debug/shadow?limit=N``: which candidate program is
+        shadowing this follower, its aggregate divergence stats, and the
+        newest ``limit`` (default 50) typed ``shadow_divergence``
+        records — the promotion gate's evidence surface
+        (docs/policy-programs.md). Admission-exempt like every /debug
+        route: an operator weighing a promotion must see the evidence
+        even on a busy replica."""
+        if self.shadow is None:
+            return 404, "application/json", error_body(
+                "NotFound",
+                "no shadow candidate attached (followers run one via "
+                "--shadow-program; docs/policy-programs.md)",
+            )
+        _, _, query = path.partition("?")
+        params = dict(
+            kv.split("=", 1) for kv in query.split("&") if "=" in kv
+        )
+        try:
+            limit = min(max(int(params.get("limit", 50)), 1),
+                        self.shadow.capacity)
+        except ValueError:
+            return 400, "application/json", error_body(
+                "BadRequest", "limit must be an integer"
+            )
+        body = dict(self.shadow.status())
+        body["records"] = self.shadow.recent(limit)
         return 200, "application/json", json.dumps(body, sort_keys=True)
 
     # -- readiness ---------------------------------------------------------

@@ -321,6 +321,10 @@ class Simulator:
         self.followers: list[_StandbyStack] = []
         self._follower_reads_ok = 0
         self._follower_reads_refused = 0
+        #: per-follower shadow scorers (docs/policy-programs.md),
+        #: index-aligned with ``followers``; empty == no candidate ==
+        #: every existing digest byte-identical
+        self.shadows: list = []
         if self.scenario["ha"]["enabled"]:
             self._build_standby()
             for _ in range(self.scenario["ha"]["followers"]):
@@ -636,6 +640,20 @@ class Simulator:
             self.client.watch_pods(), self.client.watch_nodes(),
             tap=tap,
         ))
+        shadow = self.scenario["ha"]["shadow"]
+        if shadow["enabled"]:
+            # shadow-mode A/B (docs/policy-programs.md): this follower
+            # also scores every sampled cycle with a verified candidate
+            # program against its own RCU snapshot. The virtual clock
+            # keeps the divergence records (and hence the shadow
+            # section's digest) byte-reproducible.
+            from nanotpu.policy_ir import load_program
+            from nanotpu.policy_ir.shadow import ShadowScorer
+
+            self.shadows.append(ShadowScorer(
+                fd, load_program(shadow["program"]),
+                clock=lambda: self.now,
+            ))
 
     def _push(self, t: float, kind: str, payload=None) -> None:
         heapq.heappush(self._heap, (t, next(self._seq), kind, payload))
@@ -1900,6 +1918,29 @@ class Simulator:
             else:
                 self._follower_reads_refused += 1
                 fl.coordinator.reads_refused += 1
+        if self.shadows:
+            # shadow-mode A/B (docs/policy-programs.md): each sampled
+            # cycle the candidate scores the follower's own snapshot
+            # against the serving policy's wire scores. The journal line
+            # folds the divergence count into the determinism digest —
+            # shadow-off scenarios skip the block and stay byte-identical.
+            from nanotpu.allocator.core import Demand
+
+            probe = Demand(
+                percents=(25,), container_names=("shadow-probe",)
+            )
+            sampled = diverged = 0
+            for i, ss in enumerate(self.shadows):
+                if not self.followers[i].coordinator.ready_to_serve(
+                    now=self.now
+                ):
+                    continue  # an unserving follower audits nothing
+                out = ss.sample(probe)
+                sampled += out["rows"]
+                diverged += out["diverged"]
+            self.report.journal(
+                self.now, f"shadow rows={sampled} diverged={diverged}"
+            )
 
     def _on_retry(self) -> None:
         if not self._pending:
@@ -2139,10 +2180,52 @@ class Simulator:
                     f"reads_refused={self._follower_reads_refused} "
                     f"max_drift={fl_drift:.6f}",
                 )
+            if self.shadows:
+                self._settle_shadow(horizon)
             if self.scenario["ha"]["lease"]["enabled"]:
                 self._settle_lease(horizon)
         # deterministic serving section (docs/serving-loop.md)
         self._settle_serving(horizon)
+
+    def _settle_shadow(self, horizon: float) -> None:
+        """The shadow-mode certification block (docs/policy-programs.md):
+        aggregate candidate-vs-serving divergence evidence across the
+        follower fleet into the deterministic ``shadow`` report section.
+        ``records_digest`` hashes every retained divergence record, so
+        two runs that happen to agree on the counters but disagree on a
+        single ledger byte still certify differently — the same witness
+        discipline as the journal digest. Shadow-off scenarios never
+        reach this and every existing section stays byte-identical."""
+        import hashlib
+        import json
+
+        cycles = rows = divergences = 0
+        max_delta = 0
+        agg = hashlib.sha256()
+        for ss in self.shadows:
+            st = ss.status()
+            cycles += st["cycles"]
+            rows += st["rows"]
+            divergences += st["divergences"]
+            max_delta = max(max_delta, st["max_abs_delta"])
+            for rec in ss.dump():
+                agg.update(json.dumps(rec, sort_keys=True).encode())
+        candidate = self.shadows[0].candidate
+        self.report.shadow = {
+            "program": candidate.program_name,
+            "fingerprint": candidate.fingerprint,
+            "followers": len(self.shadows),
+            "cycles": cycles,
+            "rows": rows,
+            "divergences": divergences,
+            "max_abs_delta": max_delta,
+            "records_digest": "sha256:" + agg.hexdigest(),
+        }
+        self.report.journal(
+            horizon,
+            f"shadow settle program={candidate.program_name} "
+            f"divergences={divergences} max_delta={max_delta}",
+        )
 
     def _settle_lease(self, horizon: float) -> None:
         """The split-brain certification block (docs/ha.md): fencing,

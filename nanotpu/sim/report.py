@@ -82,6 +82,11 @@ class ReportBuilder:
         #: applied, reconcile-window sizes, standby-vs-truth drift —
         #: docs/ha.md); empty == ha disabled, same opt-in digest rule
         self.ha: dict = {}
+        #: shadow-mode A/B summary (candidate program, cycles, rows,
+        #: divergences, max_abs_delta, records digest —
+        #: docs/policy-programs.md); empty == no shadow candidate, same
+        #: opt-in digest rule as the sections above
+        self.shadow: dict = {}
         self.restart_occupancy_drift = 0.0
         self.final_occupancy = 0.0
         self.final_fragmentation = 0.0
@@ -190,6 +195,11 @@ class ReportBuilder:
         if self.ha:
             # same opt-in rule (docs/ha.md)
             report["ha"] = {k: self.ha[k] for k in sorted(self.ha)}
+        if self.shadow:
+            # same opt-in rule (docs/policy-programs.md)
+            report["shadow"] = {
+                k: self.shadow[k] for k in sorted(self.shadow)
+            }
         if include_timing:
             report["timing"] = {
                 "note": "wall-clock; excluded from the determinism contract",

@@ -250,9 +250,21 @@ def normalize_scenario(raw: dict) -> dict:
     _require(bool(fleet.get("pools")), "fleet.pools is required")
     policy = raw.get("policy", types.POLICY_BINPACK)
     _require(
-        policy in _POLICIES,
-        f"policy {policy!r} not in {_POLICIES} (random is non-deterministic)",
+        policy in _POLICIES or policy.startswith("program:"),
+        f"policy {policy!r} not in {_POLICIES} (random is "
+        "non-deterministic; program:<name> serves a verified policy "
+        "program, docs/policy-programs.md)",
     )
+    if policy.startswith("program:"):
+        # resolve NOW so a bad program name / unprovable program is a
+        # scenario error, not a mid-run construction crash; integer-only
+        # Q16 programs are deterministic by the verifier's proof
+        from nanotpu.policy_ir import PolicyProgramError, load_program
+
+        try:
+            load_program(policy[len("program:"):])
+        except (ValueError, PolicyProgramError) as e:
+            _require(False, f"policy {policy!r}: {e}")
     horizon = float(raw.get("horizon_s", 30.0))
     _require(horizon > 0, "horizon_s must be > 0")
 
@@ -526,6 +538,7 @@ def normalize_scenario(raw: dict) -> dict:
 
     ha_raw = dict(raw.get("ha") or {})
     lease_raw = dict(ha_raw.get("lease") or {})
+    shadow_raw = dict(ha_raw.get("shadow") or {})
     ha = {
         "enabled": bool(ha_raw.get("enabled", False)),
         "lag_events": int(ha_raw.get("lag_events", 8)),
@@ -543,6 +556,13 @@ def normalize_scenario(raw: dict) -> dict:
         },
         "degraded_budget_s": float(ha_raw.get("degraded_budget_s", 0.0)),
         "promotion_bound": int(ha_raw.get("promotion_bound", 0)),
+        # shadow-mode A/B (docs/policy-programs.md): audition a verified
+        # policy program on the follower fleet, divergences ledgered and
+        # reported in the deterministic `shadow` report section
+        "shadow": {
+            "enabled": bool(shadow_raw.get("enabled", False)),
+            "program": str(shadow_raw.get("program", "binpack_q16")),
+        },
     }
     _require(
         ha["lag_events"] >= 0,
@@ -576,6 +596,25 @@ def normalize_scenario(raw: dict) -> dict:
         ha["degraded_budget_s"] >= 0 and ha["promotion_bound"] >= 0,
         "ha.degraded_budget_s and ha.promotion_bound must be >= 0",
     )
+    if ha["shadow"]["enabled"]:
+        _require(
+            ha["followers"] >= 1,
+            "ha.shadow requires ha.followers >= 1 (candidates audition "
+            "on the follower fleet, never the leader)",
+        )
+        _require(
+            bool(ha["shadow"]["program"]),
+            "ha.shadow.program must name a policy program",
+        )
+        # resolve NOW, same rule as the policy "program:" knob: an
+        # unknown or unprovable candidate is a scenario error, not a
+        # mid-run crash on the first shadow cycle
+        from nanotpu.policy_ir import PolicyProgramError, load_program
+
+        try:
+            load_program(ha["shadow"]["program"])
+        except (ValueError, PolicyProgramError) as e:
+            _require(False, f"ha.shadow.program: {e}")
     _require(
         not f["scheduler_crash"].get("at_s") or ha["enabled"],
         "faults.scheduler_crash requires ha.enabled (there is no "

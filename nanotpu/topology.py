@@ -123,10 +123,12 @@ class Torus:
         """True if ``chips`` forms one ICI-connected component."""
         if not chips:
             return True
-        seen = {next(iter(chips))}
-        # nanolint: ignore[sim-determinism]: BFS seed/visit order cannot
-        # change the connectivity verdict (the result is a set equality)
-        frontier = list(seen)
+        # seed from the lowest id (set→sorted idiom): the connectivity
+        # verdict is seed-independent, and the walk order is now
+        # deterministic for free
+        start = sorted(chips)[0]
+        seen = {start}
+        frontier = [start]
         while frontier:
             c = frontier.pop()
             for n in self.neighbors(c):
@@ -191,11 +193,11 @@ class Torus:
             }
             if not frontier:
                 return None
-            # nanolint: ignore[sim-determinism]: the key is fully
-            # discriminating (-n tiebreak), so max() over the set picks
-            # the same chip regardless of iteration order
+            # set→sorted before max(): the key was already fully
+            # discriminating (-n tiebreak), so the pick is unchanged —
+            # the sort just makes the order-independence structural
             pick = max(
-                frontier,
+                sorted(frontier),
                 key=lambda n: (
                     sum(1 for m in self.neighbors(n) if m in chosen),
                     -n,

@@ -1269,6 +1269,181 @@ class TestMetricsCompleteness:
 
 
 # ---------------------------------------------------------------------------
+# replication-completeness
+# ---------------------------------------------------------------------------
+class TestReplicationCompleteness:
+    """The delta stream catalogue cross-check, seeded in every direction
+    (docs/ha.md): a kind emitted/declared/applied out of sync is silent
+    replica drift, and the pass must catch each planted mismatch."""
+
+    CLEAN = """
+        STATE_KINDS = ("bind", "unbind")
+        NOTE_KINDS = ("lag",)
+
+        class Dealer:
+            def commit(self, log):
+                log._ha_emit("bind", {})
+                log._ha_emit("unbind", {})
+                log._ha_note("lag", {})
+
+        class Standby:
+            def apply(self, kind, data):
+                if kind == "bind":
+                    return 1
+                if kind in ("unbind", "lag"):
+                    return 2
+                return 0
+        """
+
+    def test_consistent_catalogue_is_clean(self, tmp_path):
+        report = one(tmp_path, self.CLEAN, "replication-completeness")
+        assert report.findings == [], [f.render() for f in report.findings]
+
+    def test_emitted_but_not_declared(self, tmp_path):
+        report = one(tmp_path, self.CLEAN.replace(
+            'log._ha_note("lag", {})',
+            'log._ha_note("lag", {})\n                '
+            'log._ha_emit("rogue", {})',
+        ), "replication-completeness")
+        assert any(
+            "'rogue' is emitted but not declared" in f.message
+            for f in report.findings
+        ), [f.render() for f in report.findings]
+
+    def test_declared_but_never_emitted(self, tmp_path):
+        report = one(tmp_path, self.CLEAN.replace(
+            'NOTE_KINDS = ("lag",)', 'NOTE_KINDS = ("lag", "ghost")'
+        ), "replication-completeness")
+        assert any(
+            "'ghost' is declared in NOTE_KINDS but no commit point "
+            "emits it" in f.message for f in report.findings
+        )
+
+    def test_declared_but_never_applied(self, tmp_path):
+        report = one(tmp_path, self.CLEAN.replace(
+            'if kind in ("unbind", "lag"):', 'if kind in ("unbind",):'
+        ), "replication-completeness")
+        assert any(
+            "'lag' is declared in NOTE_KINDS but the apply path never "
+            "consumes it" in f.message for f in report.findings
+        )
+
+    def test_applied_but_not_declared_is_unreachable_dispatch(
+        self, tmp_path
+    ):
+        report = one(tmp_path, self.CLEAN.replace(
+            'if kind == "bind":', 'if kind == "zombie":'
+        ), "replication-completeness")
+        assert any(
+            "'zombie' which is not declared" in f.message
+            for f in report.findings
+        )
+
+    def test_non_literal_kind_is_its_own_finding(self, tmp_path):
+        report = one(tmp_path, self.CLEAN.replace(
+            'log._ha_emit("bind", {})', 'log._ha_emit(kind_var, {})'
+        ), "replication-completeness")
+        assert any(
+            "non-literal kind" in f.message for f in report.findings
+        )
+
+    def test_state_membership_covers_the_state_catalogue(self, tmp_path):
+        # `kind in STATE_KINDS` marks every state kind applied wholesale
+        # (the dealer dispatches those internally) — but NOTE_KINDS
+        # members still need their own dispatch
+        report = one(tmp_path, """
+            STATE_KINDS = ("bind", "unbind")
+            NOTE_KINDS = ("lag",)
+
+            class Dealer:
+                def commit(self, log):
+                    log._ha_emit("bind", {})
+                    log._ha_emit("unbind", {})
+                    log._ha_note("lag", {})
+
+            class Standby:
+                def apply(self, kind, data):
+                    if kind in STATE_KINDS:
+                        return 1
+                    return 0
+            """, "replication-completeness")
+        assert [f for f in report.findings if "'lag'" in f.message]
+        assert not [
+            f for f in report.findings
+            if "'bind'" in f.message or "'unbind'" in f.message
+        ]
+
+    def test_no_catalogue_is_a_no_op(self, tmp_path):
+        report = one(tmp_path, """
+            class Unrelated:
+                def apply(self, kind, data):
+                    if kind == "whatever":
+                        return 1
+            """, "replication-completeness")
+        assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
+# policyver (the policy-program verifier as a lint pass)
+# ---------------------------------------------------------------------------
+class TestPolicyverPass:
+    """One verifier, two mouths: the pass maps the runtime verifier's
+    typed violations into findings, so lint and the reload path refuse
+    the same programs (docs/policy-programs.md)."""
+
+    def test_registered_with_the_other_passes(self):
+        assert "policyver" in BY_NAME
+        assert "replication-completeness" in BY_NAME
+        assert len(ALL_PASSES) == 7
+
+    def test_seeded_program_violation_carries_typed_code(self, tmp_path):
+        report = one(tmp_path, """
+            def score(base_q, contention, fragmentation, occupancy,
+                      gang_bonus):
+                weight = 0.5
+                return occupancy
+            """, "policyver")
+        messages = [f.message for f in report.findings]
+        assert any("[float-literal]" in m for m in messages), messages
+        assert any("[unclamped-return]" in m for m in messages)
+
+    def test_clean_program_fixture_passes(self, tmp_path):
+        report = one(tmp_path, """
+            def score(base_q, contention, fragmentation, occupancy,
+                      gang_bonus):
+                return max(0, min(100, occupancy - contention))
+            """, "policyver")
+        assert report.findings == []
+
+    def test_in_tree_corpus_verifies_clean(self):
+        report = run_analysis(NANOTPU_ROOT, [BY_NAME["policyver"]])
+        assert report.findings == [], [
+            f.render() for f in report.findings
+        ]
+
+    def test_cli_exit_contract_matches_other_passes(self, tmp_path, capsys):
+        """`python -m nanotpu.analysis --pass policyver --json` shares
+        the exit contract: 1 + JSON findings on a refused program, 0 on
+        a clean tree — byte-parity with how the reload path decides."""
+        (tmp_path / "prog.py").write_text(
+            "def score(base_q, contention, fragmentation, occupancy, "
+            "gang_bonus):\n    return occupancy\n"
+        )
+        rc = lint_main([
+            "--root", str(tmp_path), "--pass", "policyver", "--json",
+        ])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["ok"] is False
+        assert all(f["pass"] == "policyver" for f in doc["findings"])
+        assert any("[unclamped-return]" in f["message"]
+                   for f in doc["findings"])
+        assert lint_main(
+            ["--root", str(NANOTPU_ROOT), "--pass", "policyver"]
+        ) == 0
+
+
+# ---------------------------------------------------------------------------
 # the ignore budget
 # ---------------------------------------------------------------------------
 class TestIgnoreBudget:
@@ -1355,6 +1530,20 @@ class TestCleanTree:
         silently rot."""
         report = run_analysis(NANOTPU_ROOT, list(ALL_PASSES))
         assert report.suppressed >= 1
+
+    def test_ignore_budget_ratcheted_at_two(self):
+        """The ratchet: the tree carries exactly TWO justified ignores,
+        both the dealer's documented lock-hold exclusions. Raising this
+        number is a reviewed decision, not drift — burn an ignore
+        (topology.py's set-iteration pair went via sorted()) before
+        adding one."""
+        report = run_analysis(NANOTPU_ROOT, list(ALL_PASSES))
+        assert len(report.ignores) == 2, [
+            f"{ig.path}:{ig.line}" for ig in report.ignores
+        ]
+        assert all(
+            ig.path.endswith("dealer.py") for ig in report.ignores
+        ), [ig.path for ig in report.ignores]
 
 
 class TestCli:

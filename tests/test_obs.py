@@ -377,12 +377,23 @@ class TestGoldenDebugSchema:
         api.attach_telemetry(timeline, watchdog)
         timeline.tick()
         watchdog.evaluate()
+        from nanotpu.allocator.core import Demand
+        from nanotpu.policy_ir import load_program
+        from nanotpu.policy_ir.shadow import ShadowScorer
+
+        scorer = ShadowScorer(
+            dealer, load_program("divergent"), clock=lambda: 0.0
+        )
+        api.attach_shadow(scorer)
+        scorer.sample(Demand(percents=(25,)))  # populate records[]
         _, _, traces = api.dispatch("GET", f"/debug/traces/{uid}", b"")
         _, _, decisions = api.dispatch("GET", "/debug/decisions?limit=5", b"")
         _, _, tl = api.dispatch("GET", "/debug/timeline?limit=5", b"")
+        _, _, shadow = api.dispatch("GET", "/debug/shadow?limit=5", b"")
         return {
             "debug_traces": self._shape(json.loads(traces)),
             "debug_decisions": self._shape(json.loads(decisions)),
+            "debug_shadow": self._shape(json.loads(shadow)),
             "debug_timeline": self._shape(json.loads(tl)),
         }
 

@@ -1,0 +1,735 @@
+"""Verified policy programs (docs/policy-programs.md).
+
+Four layers under test, mirroring tests/test_analysis.py's philosophy:
+
+* **the rejection corpus** — one seeded fixture per verifier invariant
+  (isolation, integer-only, termination, totality, clamp proof,
+  determinism); a verifier that cannot refuse its planted violation
+  proves nothing, and every refusal must carry the TYPED code the
+  policyver pass and the reload log pin on;
+* **the acceptance corpus** — every in-tree program plus inline
+  bounded-loop/branching programs must verify clean and compile;
+* **wire parity** — the byte-equivalent binpack re-expression
+  (``binpack_q16``) must score byte-for-byte with the built-in rater
+  through the REAL dealer, single-shard AND sharded, before and after
+  an ``install_rater`` hot swap;
+* **the shadow plane** — divergent candidates become typed
+  ``shadow_divergence`` ledger records, ``nanotpu_shadow_*`` gauges,
+  a deterministic sim report section, and a promotion-gate refusal.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.core import Demand
+from nanotpu.allocator.rater import Binpack, make_rater
+from nanotpu.allocator.terms import Q_ONE, q16_chipset_terms, q16_row_terms
+from nanotpu.dealer import Dealer
+from nanotpu.k8s.client import FakeClientset
+from nanotpu.k8s.objects import make_container, make_node, make_pod
+from nanotpu.metrics.registry import Registry
+from nanotpu.metrics.shadow import _SHADOW_GAUGES, ShadowExporter
+from nanotpu.obs.decisions import REASON_SHADOW_DIVERGENCE, REASONS
+from nanotpu.policy import PolicyWatcher, parse_policy
+from nanotpu.policy_ir import (
+    PolicyProgramError,
+    ProgramRater,
+    compile_program,
+    load_program,
+    program_source,
+    verify_source,
+)
+from nanotpu.policy_ir.gate import run_gate
+from nanotpu.policy_ir.programs import program_names
+from nanotpu.policy_ir.shadow import ShadowScorer
+from nanotpu.routes.server import SchedulerAPI
+from nanotpu.sim import run_scenario
+from nanotpu.sim.report import render, strip_timing
+
+SIG = "def score(base_q, contention, fragmentation, occupancy, gang_bonus):"
+
+
+def codes(src: str) -> set[str]:
+    return {v.code for v in verify_source(textwrap.dedent(src))}
+
+
+# ---------------------------------------------------------------------------
+# the rejection corpus: every verifier invariant refuses its planted bug
+# ---------------------------------------------------------------------------
+#: (fixture id, program source, expected typed code) — the stable code
+#: contract the policyver pass, the reload log, and the gate all share
+REJECTIONS = [
+    ("unbounded-while", f"""
+        {SIG}
+            total = 0
+            while occupancy > 0:
+                total = total + 1
+            return 0
+    """, "unbounded-loop"),
+    ("unbounded-range", f"""
+        {SIG}
+            total = 0
+            for i in range(occupancy):
+                total = total + 1
+            return 0
+    """, "unbounded-loop"),
+    ("float-literal", f"""
+        {SIG}
+            weight = 0.5
+            return 0
+    """, "float-literal"),
+    ("float-const", f"""
+        WEIGHT = 0.5
+        {SIG}
+            return 0
+    """, "float-literal"),
+    ("true-division", f"""
+        {SIG}
+            return max(0, min(100, occupancy / 655))
+    """, "float-op"),
+    ("forbidden-import", f"""
+        import os
+        {SIG}
+            return 0
+    """, "forbidden-import"),
+    ("attribute-escape", f"""
+        {SIG}
+            leak = base_q.numerator
+            return 0
+    """, "attribute-escape"),
+    ("nondet-time", f"""
+        {SIG}
+            now = time.time()
+            return 0
+    """, "nondeterminism"),
+    ("nondet-hash", f"""
+        {SIG}
+            salt = hash(occupancy)
+            return 0
+    """, "nondeterminism"),
+    ("non-total", f"""
+        {SIG}
+            if occupancy > 32768:
+                return 100
+    """, "non-total"),
+    ("unclamped-return", f"""
+        {SIG}
+            return occupancy
+    """, "unclamped-return"),
+    ("division-by-zero", f"""
+        {SIG}
+            return max(0, min(100, occupancy // gang_bonus))
+    """, "division-by-zero"),
+    ("forbidden-call", f"""
+        {SIG}
+            handle = open(base_q)
+            return 0
+    """, "forbidden-call"),
+    ("forbidden-container", f"""
+        {SIG}
+            weights = [1, 2, 3]
+            return 0
+    """, "forbidden-construct"),
+    ("bad-signature", """
+        def score(occupancy, fragmentation):
+            return 0
+    """, "bad-signature"),
+    ("unknown-name", f"""
+        {SIG}
+            return max(0, min(100, mystery))
+    """, "unknown-name"),
+    ("syntax-error", f"""
+        {SIG}
+            return ((
+    """, "parse"),
+]
+
+
+class TestRejectionCorpus:
+    @pytest.mark.parametrize(
+        "src,code",
+        [(src, code) for _, src, code in REJECTIONS],
+        ids=[fid for fid, _, _ in REJECTIONS],
+    )
+    def test_seeded_violation_refused_with_typed_code(self, src, code):
+        assert code in codes(src), (
+            f"verifier missed its planted {code!r} violation"
+        )
+
+    @pytest.mark.parametrize(
+        "src,code",
+        [(src, code) for _, src, code in REJECTIONS],
+        ids=[fid for fid, _, _ in REJECTIONS],
+    )
+    def test_compiler_refuses_loudly_without_executing(self, src, code):
+        with pytest.raises(PolicyProgramError) as ei:
+            compile_program(textwrap.dedent(src), name="fixture")
+        err = ei.value
+        assert err.program_name == "fixture"
+        assert any(v.code == code for v in err.violations)
+        # the message an operator sees names the typed code, not a trace
+        assert f"[{code}]" in str(err)
+
+    def test_violations_carry_lines_and_render(self):
+        vs = verify_source(
+            textwrap.dedent(f"""
+                {SIG}
+                    weight = 0.5
+                    return 0
+            """)
+        )
+        assert vs and all(v.line > 0 for v in vs)
+        assert all(v.code in v.render() for v in vs)
+
+    def test_mutable_global_state_refused(self):
+        # lowercase module-level names are mutable state by convention —
+        # the isolation invariant refuses them even when integer-typed
+        vs = codes(f"""
+            counter = 0
+            {SIG}
+                return 0
+        """)
+        assert "bad-signature" in vs
+
+
+# ---------------------------------------------------------------------------
+# the acceptance corpus
+# ---------------------------------------------------------------------------
+class TestAcceptanceCorpus:
+    def test_in_tree_corpus_has_expected_programs(self):
+        names = program_names()
+        assert {"binpack_q16", "frag_guard", "divergent"} <= set(names)
+
+    @pytest.mark.parametrize("name", program_names())
+    def test_every_in_tree_program_verifies_and_compiles(self, name):
+        assert verify_source(program_source(name)) == []
+        rater = load_program(name)
+        assert isinstance(rater, ProgramRater)
+        assert rater.name == f"program:{name}"
+        assert len(rater.fingerprint) == 16
+
+    def test_bounded_loop_program_accepted(self):
+        rater = compile_program(textwrap.dedent(f"""
+            ROUNDS = 8
+            {SIG}
+                acc = 0
+                for i in range(ROUNDS):
+                    acc = acc + 1
+                return max(0, min(100, acc + gang_bonus))
+        """), name="bounded")
+        assert rater._fn(Q_ONE, 0, 0, 0, 0) == 8
+
+    def test_branching_program_accepted_and_total(self):
+        rater = compile_program(textwrap.dedent(f"""
+            HOT = 32768
+            {SIG}
+                if contention > HOT:
+                    bonus = 0
+                elif fragmentation > HOT:
+                    bonus = 10
+                else:
+                    bonus = 25
+                return 50 + bonus
+        """), name="branchy")
+        assert rater._fn(Q_ONE, Q_ONE, 0, 0, 0) == 50
+        assert rater._fn(Q_ONE, 0, 0, 0, 0) == 75
+
+    def test_clamp_idiom_proves_any_expression(self):
+        # the documented clamp idiom is what makes big intermediate
+        # intervals provable — the exact guidance the unclamped-return
+        # message gives
+        assert codes(f"""
+            {SIG}
+                raw = occupancy * 100 - (contention * 50) // {Q_ONE}
+                return max(0, min(100, raw))
+        """) == set()
+
+    def test_fingerprint_is_source_stable(self):
+        src = program_source("binpack_q16")
+        assert compile_program(src).fingerprint == (
+            compile_program(src).fingerprint
+        )
+        assert compile_program(src).fingerprint != (
+            load_program("frag_guard").fingerprint
+        )
+
+
+# ---------------------------------------------------------------------------
+# the registry + make_rater routing
+# ---------------------------------------------------------------------------
+class TestProgramRegistry:
+    def test_unknown_program_raises_with_inventory(self):
+        with pytest.raises(ValueError, match="binpack_q16"):
+            load_program("nope")
+
+    @pytest.mark.parametrize("name", ["../evil", "a/b", "a.b", ""])
+    def test_non_basename_rejected_before_touching_disk(self, name):
+        # the sim scenario knob feeds this — path traversal must not
+        with pytest.raises(ValueError):
+            program_source(name)
+
+    def test_make_rater_program_prefix(self):
+        rater = make_rater("program:binpack_q16")
+        assert isinstance(rater, ProgramRater)
+        assert rater.name == "program:binpack_q16"
+        with pytest.raises(ValueError):
+            make_rater("program:nope")
+
+
+# ---------------------------------------------------------------------------
+# Q16 term extraction: the program input ABI
+# ---------------------------------------------------------------------------
+class TestTermExtraction:
+    def test_row_terms_exact_formulas(self):
+        free, total = [100, 0, 400], [400, 400, 400]
+        occ, frag, cont = q16_row_terms(free, total, [0, Q_ONE, Q_ONE // 2])
+        assert occ == ((1200 - 500) * Q_ONE) // 1200
+        # only the wholly-free chip counts toward whole-chip headroom
+        assert frag == (400 * Q_ONE) // 500
+        assert cont == (0 + Q_ONE + Q_ONE // 2) // 3
+
+    def test_empty_and_full_edges(self):
+        assert q16_row_terms([], [], []) == (0, 0, 0)
+        # nothing free: occupancy saturates, fragmentation defines to 0
+        assert q16_row_terms([0, 0], [400, 400], [0, 0]) == (Q_ONE, 0, 0)
+
+    def test_chipset_path_matches_row_path(self):
+        client = FakeClientset()
+        client.create_node(_v5p("n1"))
+        d = Dealer(client, Binpack())
+        _fill(d, client, "n1", (100,))
+        info = d._published.nodes["n1"]
+        chips = info.chips
+        assert q16_chipset_terms(chips) == q16_row_terms(
+            [c.percent_free for c in chips.chips],
+            [c.percent_total for c in chips.chips],
+            [0 for _ in chips.chips],
+        )
+
+
+# ---------------------------------------------------------------------------
+# wire parity: binpack_q16 vs the built-in rater through the real dealer
+# ---------------------------------------------------------------------------
+def _v5p(name, slice_name="s0", coords="0,0,0"):
+    return make_node(
+        name,
+        {types.RESOURCE_TPU_PERCENT: 4 * types.PERCENT_PER_CHIP},
+        labels={
+            types.LABEL_TPU_GENERATION: "v5p",
+            types.LABEL_TPU_TOPOLOGY: "2x2x1",
+            types.LABEL_TPU_SLICE: slice_name,
+            types.LABEL_TPU_SLICE_COORDS: coords,
+        },
+    )
+
+
+#: node -> filler demand: three distinct occupancy levels + one empty
+_FILLS = {"n0": (100,), "n1": (100, 100), "n2": (300,)}
+_NODES = ["n0", "n1", "n2", "n3"]
+
+
+def _fill(dealer, client, node, percents):
+    pod = make_pod(f"fill-{node}", containers=[
+        make_container(f"c{i}", {types.RESOURCE_TPU_PERCENT: p})
+        for i, p in enumerate(percents)
+    ])
+    ok, _ = dealer.assume([node], pod)
+    assert ok == [node]
+    dealer.bind(node, client.create_pod(pod))
+
+
+def _fleet(rater, shards=1):
+    client = FakeClientset()
+    for i, name in enumerate(_NODES):
+        client.create_node(
+            _v5p(name, slice_name=f"s{i % 2}", coords=f"{i},0,0")
+        )
+    d = Dealer(client, rater, shards=shards)
+    for node, percents in _FILLS.items():
+        _fill(d, client, node, percents)
+    return d, client
+
+
+def _probe(percents=(25,)):
+    return make_pod("probe", containers=[
+        make_container(f"c{i}", {types.RESOURCE_TPU_PERCENT: p})
+        for i, p in enumerate(percents)
+    ])
+
+
+class TestWireParity:
+    """binpack_q16 is certified BYTE-EQUIVALENT to the built-in binpack
+    on single-chip placements with idle loads (docs/policy-programs.md
+    derives why: compactness is 1 and the load term is 0, so both
+    formulas reduce to min(usage_pct, 90) + 10)."""
+
+    def test_single_shard_scores_byte_identical(self):
+        baseline, _ = _fleet(Binpack())
+        program, _ = _fleet(load_program("binpack_q16"))
+        want = baseline.score(_NODES, _probe())
+        got = program.score(_NODES, _probe())
+        assert got == want
+        # the fleet separates the nodes: parity must hold on distinct
+        # scores, not one degenerate constant
+        assert len({s for _, s in want}) > 1
+
+    def test_sharded_scores_byte_identical(self):
+        baseline, _ = _fleet(Binpack())
+        sharded, _ = _fleet(load_program("binpack_q16"), shards="auto")
+        assert sharded._shard_fn is not None and len(sharded._shards) > 1
+        assert sharded.score(_NODES, _probe()) == (
+            baseline.score(_NODES, _probe())
+        )
+
+    def test_program_serves_through_the_batch_hook(self):
+        d, _ = _fleet(load_program("binpack_q16"))
+        assert d._batch_hook is not None
+        if d._native_model is None:
+            assert d._hook_active
+
+    def test_plan_score_equals_rate_discipline(self):
+        d, _ = _fleet(load_program("binpack_q16"))
+        info = d._published.nodes["n1"]
+        plan = d.rater.choose(info.chips, Demand.from_pod(_probe()))
+        assert plan is not None
+        assert plan.score == d.rater.rate(
+            info.chips, Demand.from_pod(_probe())
+        )
+
+
+class TestInstallRater:
+    def test_hot_swap_changes_scores_and_swap_back_restores(self):
+        d, _ = _fleet(Binpack())
+        before = d.score(_NODES, _probe())
+        d.install_rater(load_program("divergent"))
+        assert d.rater.name == "program:divergent"
+        swapped = d.score(_NODES, _probe())
+        assert swapped != before  # stale plan caches would hide the swap
+        d.install_rater(Binpack())
+        assert d.score(_NODES, _probe()) == before
+
+    def test_swap_invalidates_plan_caches_and_views(self):
+        d, _ = _fleet(Binpack())
+        d.score(_NODES, _probe())  # warm plan caches + frozen views
+        d.install_rater(load_program("binpack_q16"))
+        for info in d._nodes.values():
+            assert not info._plan_cache, (
+                "plan cache survived the rater swap"
+            )
+        for shard in d._shards.values():
+            assert not shard._published.views
+
+    def test_swap_preserves_chip_accounting(self):
+        d, _ = _fleet(Binpack())
+        occ_before = q16_chipset_terms(d._published.nodes["n2"].chips)
+        d.install_rater(load_program("binpack_q16"))
+        assert q16_chipset_terms(
+            d._published.nodes["n2"].chips
+        ) == occ_before
+
+
+# ---------------------------------------------------------------------------
+# the shadow plane
+# ---------------------------------------------------------------------------
+class TestShadowScorer:
+    def test_byte_equivalent_candidate_never_diverges(self):
+        d, _ = _fleet(Binpack())
+        ss = ShadowScorer(d, load_program("binpack_q16"), clock=lambda: 1.0)
+        summary = ss.sample(Demand(percents=(25,)))
+        assert summary["rows"] > 0 and summary["diverged"] == 0
+        assert ss.status()["divergences"] == 0
+        assert ss.dump() == []
+
+    def test_divergent_candidate_ledgers_typed_records(self):
+        d, _ = _fleet(Binpack())
+        ss = ShadowScorer(d, load_program("divergent"), clock=lambda: 2.5)
+        ss.sample(Demand(percents=(25,)))
+        records = ss.dump()
+        assert records, "divergent candidate produced no records"
+        for rec in records:
+            assert rec["reason"] == REASON_SHADOW_DIVERGENCE
+            assert rec["program"] == "divergent"
+            assert rec["delta"] == rec["candidate"] - rec["baseline"]
+            assert rec["t"] == 2.5
+            assert {"node", "fingerprint", "demand", "seq"} <= set(rec)
+        status = ss.status()
+        assert status["divergences"] == len(records)
+        assert status["max_abs_delta"] == max(
+            abs(r["delta"]) for r in records
+        )
+
+    def test_shadow_divergence_is_a_registered_ledger_reason(self):
+        assert REASON_SHADOW_DIVERGENCE in REASONS
+
+    def test_ring_is_bounded_and_recent_is_newest_first(self):
+        d, _ = _fleet(Binpack())
+        ss = ShadowScorer(d, load_program("divergent"), capacity=3,
+                          clock=lambda: 0.0)
+        for _ in range(4):
+            ss.sample(Demand(percents=(25,)))
+        assert len(ss.dump()) == 3 == ss.capacity
+        newest = ss.recent(limit=2)
+        assert len(newest) == 2
+        assert newest[0]["seq"] >= newest[1]["seq"]
+        with pytest.raises(ValueError):
+            ShadowScorer(d, load_program("divergent"), capacity=0)
+
+    def test_infeasible_rows_are_excluded_not_agreed(self):
+        client = FakeClientset()
+        client.create_node(_v5p("n1"))
+        d = Dealer(client, Binpack())
+        _fill(d, client, "n1", (400,))  # node full: probe is infeasible
+        ss = ShadowScorer(d, load_program("divergent"), clock=lambda: 0.0)
+        assert ss.sample(Demand(percents=(100,)))["rows"] == 0
+
+    def test_gauge_producer_matches_declared_table(self):
+        # both directions — the same contract the nanolint
+        # metrics-completeness pass enforces on the real tree
+        d, _ = _fleet(Binpack())
+        ss = ShadowScorer(d, load_program("divergent"), clock=lambda: 0.0)
+        ss.sample(Demand(percents=(25,)))
+        values = ss.shadow_gauge_values()
+        assert set(values) == set(_SHADOW_GAUGES)
+        assert values["divergences"] > 0
+
+    def test_exporter_renders_prom_text(self):
+        d, _ = _fleet(Binpack())
+        ss = ShadowScorer(d, load_program("divergent"), clock=lambda: 0.0)
+        ss.sample(Demand(percents=(25,)))
+        text = "\n".join(ShadowExporter(ss).render())
+        for suffix in _SHADOW_GAUGES:
+            assert f"# HELP nanotpu_shadow_{suffix} " in text
+            assert f"# TYPE nanotpu_shadow_{suffix} gauge" in text
+            assert f"nanotpu_shadow_{suffix} " in text
+
+
+class TestDebugShadowRoute:
+    def test_unattached_returns_404_with_hint(self):
+        d, _ = _fleet(Binpack())
+        api = SchedulerAPI(d, Registry())
+        code, _, payload = api.dispatch("GET", "/debug/shadow", b"")
+        assert code == 404
+        body = json.loads(payload)
+        assert body["Reason"] == "NotFound"
+        assert "--shadow-program" in body["Error"]
+
+    def test_attached_serves_status_records_and_limit(self):
+        d, _ = _fleet(Binpack())
+        api = SchedulerAPI(d, Registry())
+        ss = ShadowScorer(d, load_program("divergent"), clock=lambda: 0.0)
+        api.attach_shadow(ss)
+        ss.sample(Demand(percents=(25,)))
+        code, _, payload = api.dispatch("GET", "/debug/shadow", b"")
+        assert code == 200
+        body = json.loads(payload)
+        assert body["program"] == "divergent"
+        assert body["divergences"] == len(body["records"]) > 1
+        code, _, payload = api.dispatch(
+            "GET", "/debug/shadow?limit=1", b""
+        )
+        assert len(json.loads(payload)["records"]) == 1
+        # the registered exporter feeds /metrics
+        assert "nanotpu_shadow_divergences" in api.registry.render()
+
+
+# ---------------------------------------------------------------------------
+# policy.yaml `program:` section + keep-last-good hot reload
+# ---------------------------------------------------------------------------
+GOOD_YAML = """
+policy:
+  program:
+    name: binpack_q16
+"""
+
+INLINE_YAML = f"""
+policy:
+  program:
+    source: |
+      {SIG}
+          return 50
+"""
+
+
+class TestParsePolicyProgram:
+    def test_in_tree_name_resolves_source(self):
+        spec = parse_policy(GOOD_YAML)
+        assert spec.program.name == "binpack_q16"
+        assert spec.program.source == program_source("binpack_q16")
+
+    def test_inline_source_verified_at_parse_time(self):
+        assert parse_policy(INLINE_YAML).program.name == "inline"
+
+    def test_unprovable_program_invalidates_the_document(self):
+        bad = textwrap.dedent("""
+        policy:
+          program:
+            source: |
+              def score(base_q, contention, fragmentation, occupancy, gang_bonus):
+                  return occupancy
+        """)
+        with pytest.raises(ValueError, match="failed verification"):
+            parse_policy(bad)
+
+    def test_malformed_section_rejected(self):
+        with pytest.raises(ValueError, match="mapping"):
+            parse_policy("policy:\n  program: [list]\n")
+        with pytest.raises(ValueError, match="source"):
+            parse_policy("policy:\n  program: {}\n")
+
+
+class TestWatcherKeepsLastGood:
+    """Satellite 6: a half-written policy.yaml (ConfigMap mid-rewrite)
+    must keep the last-good spec, count a TYPED reload failure, never
+    call on_reload, and heal on the next complete write."""
+
+    def _watcher(self, tmp_path):
+        p = tmp_path / "policy.yaml"
+        p.write_text(GOOD_YAML)
+        seen = []
+        w = PolicyWatcher(str(p), poll_s=3600, on_reload=seen.append)
+        return p, w, seen
+
+    @staticmethod
+    def _touch(path, bump):
+        os.utime(path, (1000.0 + bump, 1000.0 + bump))
+
+    def test_half_written_yaml_keeps_last_good(self, tmp_path):
+        p, w, seen = self._watcher(tmp_path)
+        assert w.spec().program.name == "binpack_q16"
+        assert len(seen) == 1
+        p.write_text("policy:\n  program:\n    source: |\n      def scor")
+        self._touch(p, 1)
+        w._load()
+        assert w.reload_failures == 1
+        assert w.last_reload_error == "parse"
+        assert w.spec().program.name == "binpack_q16"  # last good serves
+        assert len(seen) == 1  # consumers never saw the torn spec
+        w.stop()
+
+    def test_unreadable_file_is_typed_io(self, tmp_path):
+        p, w, _ = self._watcher(tmp_path)
+        p.unlink()
+        w._load()
+        assert w.reload_failures == 1
+        assert w.last_reload_error == "io"
+        assert w.spec().program is not None
+        w.stop()
+
+    def test_heals_on_next_complete_write(self, tmp_path):
+        p, w, seen = self._watcher(tmp_path)
+        p.write_text("policy:\n  program:\n    source: |\n      def scor")
+        self._touch(p, 1)
+        w._load()
+        p.write_text(INLINE_YAML)
+        self._touch(p, 2)
+        w._load()
+        assert w.reload_failures == 1  # the failure stays on the books
+        assert w.spec().program.name == "inline"
+        assert len(seen) == 2
+        w.stop()
+
+
+# ---------------------------------------------------------------------------
+# the deterministic sim shadow section + the promotion gate
+# ---------------------------------------------------------------------------
+#: shortened policy-shadow scenario: 4 hosts, 1 follower, 10s horizon —
+#: enough cycles to separate a divergent candidate from a byte-equal one
+SHADOW_SCENARIO = {
+    "name": "shadow-unit",
+    "fleet": {"pools": [
+        {"generation": "v5p", "hosts": 4, "slice_hosts": 4},
+    ]},
+    "policy": "binpack",
+    "horizon_s": 10.0,
+    "workload": {
+        "kind": "poisson", "rate_per_s": 1.0,
+        "mix": {"fractional": 0.5, "spread": 0.2, "multi_container": 0.3},
+        "lifetime_s": {"dist": "exp", "mean": 8.0},
+    },
+    "ha": {
+        "enabled": True, "followers": 1,
+        "shadow": {"enabled": True, "program": "binpack_q16"},
+    },
+    "sample_every_s": 1.0,
+}
+
+
+def _shadow_scn(program="binpack_q16", enabled=True):
+    scn = json.loads(json.dumps(SHADOW_SCENARIO))
+    scn["ha"]["shadow"] = {"enabled": enabled, "program": program}
+    return scn
+
+
+class TestSimShadowSection:
+    def test_byte_equivalent_candidate_reports_zero_divergences(self):
+        report = run_scenario(_shadow_scn(), seed=0)
+        sh = report["shadow"]
+        assert sh["program"] == "binpack_q16"
+        assert sh["rows"] > 0 and sh["divergences"] == 0
+        assert sh["max_abs_delta"] == 0
+
+    def test_divergent_candidate_reports_and_reproduces(self):
+        a = run_scenario(_shadow_scn("divergent"), seed=0)
+        assert a["shadow"]["divergences"] > 0
+        assert a["shadow"]["records_digest"].startswith("sha256:")
+        b = run_scenario(_shadow_scn("divergent"), seed=0)
+        assert render(strip_timing(a)) == render(strip_timing(b))
+
+    def test_shadow_off_omits_the_section(self):
+        assert "shadow" not in run_scenario(
+            _shadow_scn(enabled=False), seed=0
+        )
+
+    def test_program_as_serving_policy_matches_builtin_digest(self):
+        # the strongest parity statement: the verified re-expression
+        # SERVES a whole replay and the journal digest is byte-identical
+        base = _shadow_scn(enabled=False)
+        prog = json.loads(json.dumps(base))
+        prog["policy"] = "program:binpack_q16"
+        a = run_scenario(base, seed=0)
+        b = run_scenario(prog, seed=0)
+        assert a["digest"] == b["digest"]
+
+    def test_unknown_program_scenario_rejected_at_normalize(self):
+        from nanotpu.sim.scenario import normalize_scenario
+
+        bad = _shadow_scn("nope")
+        with pytest.raises(ValueError):
+            normalize_scenario(bad)
+        worse = _shadow_scn(enabled=False)
+        worse["policy"] = "program:nope"
+        with pytest.raises(ValueError):
+            normalize_scenario(worse)
+
+
+class TestPromotionGate:
+    def test_byte_equivalent_candidate_promotes(self):
+        verdict = run_gate("binpack_q16", SHADOW_SCENARIO, seed=0)
+        assert verdict["promote"], verdict
+        assert all(c["ok"] for c in verdict["checks"].values())
+        assert verdict["checks"]["shadow"]["divergences"] == 0
+
+    def test_divergent_candidate_refused_on_shadow_evidence(self):
+        verdict = run_gate("divergent", SHADOW_SCENARIO, seed=0)
+        assert not verdict["promote"]
+        assert not verdict["checks"]["shadow"]["ok"]
+        assert verdict["checks"]["shadow"]["divergences"] > 0
+
+    def test_allow_divergence_is_an_explicit_operator_override(self):
+        verdict = run_gate(
+            "divergent", SHADOW_SCENARIO, seed=0, allow_divergence=True
+        )
+        assert verdict["checks"]["shadow"]["ok"]
+        assert verdict["checks"]["shadow"]["allow_divergence"]
+
+    def test_unprovable_candidate_refused_before_any_replay(self):
+        verdict = run_gate("nope", SHADOW_SCENARIO, seed=0)
+        assert not verdict["promote"]
+        assert not verdict["checks"]["proof"]["ok"]
+        assert list(verdict["checks"]) == ["proof"]  # no replays ran

@@ -627,6 +627,7 @@ _DEBUG_PATHS = {
     "/debug/decisions": "/debug/decisions?limit=5",
     "/debug/timeline": "/debug/timeline",
     "/debug/ha": "/debug/ha?since=0",
+    "/debug/shadow": "/debug/shadow",
     "/debug/verify": "/debug/verify",
 }
 
