@@ -3,7 +3,7 @@
 IMAGE ?= nanotpu/scheduler
 TAG ?= latest
 
-.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm batch-4k sim-smoke sim-multipool sim-het sim-defrag sim-batch sim-serve chaos-soak obs-check timeline-check fanout-4k ha-soak partition-soak follower-soak policy-check image clean
+.PHONY: all native lint test test-fast bench bench-ab bench-het-ab bind-storm gang-storm batch-4k sim-smoke sim-multipool sim-het sim-defrag sim-batch sim-serve chaos-soak obs-check timeline-check fleet-obs-check fanout-4k ha-soak partition-soak follower-soak policy-check image clean
 
 # Default verification tier: static analysis, then the fast inner loop
 # (test-fast includes sim-smoke), then the observability gate, then the
@@ -11,7 +11,7 @@ TAG ?= latest
 # certifications and the sharded 4096-host fan-out gate (FAST=1 skips
 # those three). The tier-1 gate (`pytest tests/ -m 'not slow'` over
 # everything) is unchanged — run it via `make test` / CI.
-all: native lint test-fast obs-check timeline-check chaos-soak sim-het sim-defrag sim-batch sim-serve fanout-4k batch-4k ha-soak partition-soak follower-soak policy-check
+all: native lint test-fast obs-check timeline-check fleet-obs-check chaos-soak sim-het sim-defrag sim-batch sim-serve fanout-4k batch-4k ha-soak partition-soak follower-soak policy-check
 
 # nanolint (docs/static-analysis.md): AST invariant passes over the
 # scheduler's concurrency & determinism contracts — lock discipline,
@@ -95,6 +95,26 @@ timeline-check:
 	python -m pytest tests/test_timeline.py -q
 	python -m nanotpu.sim --scenario examples/sim/telemetry-soak.json \
 		--seed 0 --check-determinism > /dev/null
+
+# Fleet-observability gate (docs/observability.md "Fleet observability"
+# / "Decision export format"): the fleet/export test suite — FleetView
+# merge + /debug/fleet + /debug/story golden schemas, export framing /
+# rotation / corrupt-line recovery, the cross-process sticky-sampling
+# pin, the live two-process story drive — then the fleet-obs scenario
+# (leader + standby + two followers, export armed, sink-less) run TWICE
+# (--check-determinism): the report's `export` section — record count,
+# byte count, stream sha256 — must be byte-reproducible, proving the
+# durable forensic record is a pure function of (scenario, seed).
+# `FAST=1 make all` skips the replay (same rule as policy-check); the
+# test suite always runs.
+fleet-obs-check:
+	python -m pytest tests/test_fleet.py -q
+	@if [ "$(FAST)" = "1" ]; then \
+		echo "fleet-obs-check: replay skipped (FAST=1)"; \
+	else \
+		python -m nanotpu.sim --scenario examples/sim/fleet-obs.json \
+			--seed 0 --check-determinism > /dev/null; \
+	fi
 
 # Overload-resilience gate (docs/robustness.md): smoke's faults + arrival
 # bursts + API brownouts through the resilient write path, bounded sync

@@ -41,6 +41,15 @@ try:
 except ImportError:  # pragma: no cover - base-ref worktrees only
     _Timeline = None
 
+try:
+    # same feature-detect for the durable decision export: a sink-less
+    # probe frames the rep's end-state tick so the artifact names the
+    # per-rep export cost in bytes — dict-valued, so bench_ab's numeric
+    # attr diff against pre-export bases stays empty
+    from nanotpu.obs.export import DecisionExporter as _DecisionExporter
+except ImportError:  # pragma: no cover - base-ref worktrees only
+    _DecisionExporter = None
+
 N_HOSTS = 16
 CHIPS_PER_HOST = 4
 N_PODS = 32
@@ -401,6 +410,15 @@ def run_fanout(n_hosts: int = 256, n_pods: int = 256,
                 for verb in sorted(tick["verbs"])
             },
         }
+        if _DecisionExporter is not None:
+            # sink-less export probe (docs/observability.md "Decision
+            # export format"), OUTSIDE the timed window: what one
+            # end-state tick costs the export stream, in framed bytes
+            exporter = _DecisionExporter(path="", sample=1)
+            exporter.tick(tick)
+            attr["timeline"]["export_frame_bytes"] = (
+                exporter.bytes_written
+            )
     # the whole point of the discipline: no full collection may land
     # inside a timed window (it would be an unattributed multi-ms stall
     # charged to whatever pod it interrupts)

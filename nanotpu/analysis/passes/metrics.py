@@ -83,6 +83,13 @@ promise has three string-ly typed seams this pass stitches shut:
   shadow-mode A/B evidence (cycles, rows, divergences, max_abs_delta)
   can never ship a lying zero or a scrape-time KeyError.
 
+* **Fleet gauges** (``nanotpu_fleet_*``, docs/observability.md "Fleet
+  observability"): ``_FLEET_GAUGES`` (``nanotpu/metrics/fleet.py``) vs
+  ``FleetView.fleet_gauge_values()`` — both directions, so the fleet
+  aggregation plane's headline numbers (peers, synced count, worst
+  lag, story joins, export bytes/rotations/drops) can never ship a
+  lying zero or a scrape-time KeyError.
+
 Registry-built metrics (``registry.counter(...)`` etc.) register at
 construction by design and need no check here.
 """
@@ -299,6 +306,8 @@ class _MetricsPass:
         dggauges_mod: Module | None = None
         shgauges: dict[str, int] | None = None
         shgauges_mod: Module | None = None
+        ftgauges: dict[str, int] | None = None
+        ftgauges_mod: Module | None = None
         for mod in modules:
             d = _declared_resilience(mod)
             if d is not None:
@@ -339,6 +348,9 @@ class _MetricsPass:
             sh = _declared_gauge_table(mod, "_SHADOW_GAUGES")
             if sh is not None:
                 shgauges, shgauges_mod = sh, mod
+            ft = _declared_gauge_table(mod, "_FLEET_GAUGES")
+            if ft is not None:
+                ftgauges, ftgauges_mod = ft, mod
 
         inc_sites: dict[str, tuple[str, int]] = {}
         perf_incs: dict[str, tuple[str, int]] = {}
@@ -465,6 +477,7 @@ class _MetricsPass:
             ("follower", flgauges, flgauges_mod, "follower_gauge_values"),
             ("degraded", dggauges, dggauges_mod, "degraded_gauge_values"),
             ("shadow", shgauges, shgauges_mod, "shadow_gauge_values"),
+            ("fleet", ftgauges, ftgauges_mod, "fleet_gauge_values"),
         ):
             if table is not None and table_mod is not None:
                 findings.extend(self._check_gauge_table(

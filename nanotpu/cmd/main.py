@@ -191,6 +191,28 @@ def build_app(argv: list[str] | None = None):
         "faulthandler stacks land in PATH.stacks on hard crashes",
     )
     parser.add_argument(
+        "--obs-export", default="", metavar="PATH",
+        help="durable decision export (docs/observability.md 'Decision "
+        "export format'): append sampled finalized decision cycles and "
+        "telemetry ticks to PATH as crc-framed canonical JSONL (the "
+        "checkpoint line framing), rotating to PATH.1 at the size "
+        "bound; empty disables (zero overhead)",
+    )
+    parser.add_argument(
+        "--obs-export-sample", type=int, default=1, metavar="N",
+        help="export 1-in-N pods by the sticky crc32(uid) verdict "
+        "(with --obs-export) — the SAME verdict the tracer uses, so "
+        "every replica of a fleet exports the same pod population; "
+        "1 = all, 0 = none",
+    )
+    parser.add_argument(
+        "--obs-export-max-bytes", type=int, default=8 * 1024 * 1024,
+        metavar="B",
+        help="export segment size bound (with --obs-export): the live "
+        "file rotates to PATH.1 past B bytes, keeping exactly one "
+        "previous segment",
+    )
+    parser.add_argument(
         "--ha", action="store_true",
         help="HA replica pair (docs/ha.md): race for the leader lease; "
         "the winner serves as the ACTIVE (emitting its delta stream on "
@@ -205,6 +227,21 @@ def build_app(argv: list[str] | None = None):
         help="the active replica's base URL (with --ha): the standby "
         "tails GET /debug/ha from it; without a peer the standby "
         "promotes via one full resync instead of the O(lag) window",
+    )
+    parser.add_argument(
+        "--ha-peers", default="", metavar="URLS",
+        help="fleet aggregation plane (docs/observability.md 'Fleet "
+        "observability'): comma-separated base URLs of the OTHER "
+        "replicas (typically the follower read Service endpoints); the "
+        "leader polls each peer's /debug/timeline, /debug/ha, and "
+        "/debug/shadow pages into GET /debug/fleet (one merged fleet "
+        "tick per poll: aggregate lag, per-follower reads-refused, "
+        "shadow divergence totals) and joins per-pod cross-process "
+        "stories on GET /debug/story/<uid>; empty disables",
+    )
+    parser.add_argument(
+        "--fleet-period", type=float, default=10.0, metavar="S",
+        help="fleet aggregation poll cadence (with --ha-peers)",
     )
     parser.add_argument(
         "--ha-checkpoint", default="", metavar="PATH",
@@ -439,7 +476,13 @@ def main(argv: list[str] | None = None) -> int:
             # NotLeader with a LeaderHint, and the never-armed epoch
             # fence fast-fails any apiserver mutation that slips past
             # the HTTP gate.
-            source = HttpDeltaSource(args.ha_peer)
+            # the delta tail identifies itself to the leader via the
+            # X-Nanotpu-Trace header (docs/observability.md "Fleet
+            # observability"): sampled leader-side traces gain a `ctx`
+            # event naming which replica pulled the stream
+            source = HttpDeltaSource(
+                args.ha_peer, trace_context=f"follower:{holder}"
+            )
             coordinator = HACoordinator(
                 dealer, role="follower", source=source,
                 controller=controller, fence=fence, client=client,
@@ -472,7 +515,10 @@ def main(argv: list[str] | None = None) -> int:
             )
         else:
             source = (
-                HttpDeltaSource(args.ha_peer) if args.ha_peer else None
+                HttpDeltaSource(
+                    args.ha_peer, trace_context=f"standby:{holder}"
+                )
+                if args.ha_peer else None
             )
             coordinator = HACoordinator(
                 dealer, role="standby", source=source,
@@ -496,6 +542,20 @@ def main(argv: list[str] | None = None) -> int:
         # without waiting out the TTL (docs/ha.md)
         controller.epoch_of = lambda: fence.epoch
         api.attach_ha(coordinator)
+        # cross-process trail close (docs/observability.md "Fleet
+        # observability"): a follower/standby's apply() opens+commits a
+        # local `ha:bound` / `ha:released` trail when a state delta
+        # lands, stamped with the delta's (epoch, seq) — the follower
+        # half of the /debug/story/<uid> join
+        coordinator.obs = api.obs
+        if args.log_json:
+            # fleet-triage keys (role / synced / fence_epoch) on every
+            # log line, read LIVE so a promotion shows on the very next
+            # record (docs/observability.md)
+            for handler in logging.getLogger().handlers:
+                fmt = handler.formatter
+                if isinstance(fmt, JsonLogFormatter):
+                    fmt.attach_ha(coordinator)
 
         def _on_promote():
             for loop in write_loops:
@@ -674,6 +734,25 @@ def main(argv: list[str] | None = None) -> int:
         )
         _start_or_defer(recovery_loop)
 
+    # durable decision export (docs/observability.md "Decision export
+    # format"): sampled finalized cycles (and timeline ticks, below) as
+    # crc-framed canonical JSONL on disk — the record of WHY each pod
+    # landed where it did that outlives the process and its rings
+    exporter = None
+    if args.obs_export:
+        from nanotpu.obs.export import DecisionExporter
+
+        exporter = DecisionExporter(
+            path=args.obs_export, sample=args.obs_export_sample,
+            max_bytes=args.obs_export_max_bytes,
+        )
+        api.obs.ledger.exporter = exporter
+        log.info(
+            "decision export: appending to %s (sample 1-in-%d, "
+            "rotate at %d bytes)", args.obs_export,
+            args.obs_export_sample, args.obs_export_max_bytes,
+        )
+
     telemetry_loop = None
     if args.timeline_period > 0 or args.flight_recorder:
         from nanotpu.metrics.slo import SLOWatchdog
@@ -716,6 +795,17 @@ def main(argv: list[str] | None = None) -> int:
         if degraded_monitor is not None:
             # every tick gains the SLO-addressable `degraded` section
             timeline.degraded = degraded_monitor
+        if api.ha is not None:
+            # every tick gains the `ha` section; bundles gain `ha` (+
+            # `follower` on followers) — the failover post-mortem keys
+            timeline.ha = api.ha
+            flight.ha = api.ha
+        if api.shadow is not None:
+            flight.shadow = api.shadow
+        if exporter is not None:
+            # timeline ticks join the export stream: the fleet-health
+            # time axis lands next to the decisions it explains
+            timeline.exporter = exporter
         # a checkpoint quarantined during the warm-restart boot (corrupt
         # tail — docs/ha.md "State integrity") gets its forensics bundle
         # now that a recorder exists
@@ -757,6 +847,28 @@ def main(argv: list[str] | None = None) -> int:
         if api.timeline is not None:
             api.timeline.register_source(serving_source)
 
+    # fleet aggregation plane (docs/observability.md "Fleet
+    # observability"): the leader polls each --ha-peers replica's debug
+    # pages into merged fleet ticks (GET /debug/fleet) and joins per-pod
+    # cross-process stories (GET /debug/story/<uid>). Built AFTER the
+    # telemetry/ha/shadow wiring so the local row taps are live.
+    fleet_loop = None
+    if args.ha_peers:
+        from nanotpu.obs.fleet import FleetLoop, FleetView
+
+        fleet_view = FleetView(
+            args.ha_peers.split(","), obs=api.obs, ha=api.ha,
+            timeline=api.timeline, shadow=api.shadow,
+            exporter=exporter,
+        )
+        api.attach_fleet(fleet_view)
+        fleet_loop = FleetLoop(fleet_view, period_s=args.fleet_period)
+        fleet_loop.start()
+        log.info(
+            "fleet view: polling %d peer(s) every %.1fs",
+            len(fleet_view.peers), args.fleet_period,
+        )
+
     if ha_loop is not None:
         # started after the telemetry/flight wiring so a promotion's
         # flight dump has a recorder to land in
@@ -777,6 +889,8 @@ def main(argv: list[str] | None = None) -> int:
             os._exit(1)
         stop["flag"] = True
         log.info("signal %s: shutting down", signum)
+        if fleet_loop is not None:
+            fleet_loop.stop()
         if telemetry_loop is not None:
             telemetry_loop.stop()
         if api.flight is not None:
@@ -803,6 +917,10 @@ def main(argv: list[str] | None = None) -> int:
             shadow_stop.set()
         if api.policy_watcher is not None:
             api.policy_watcher.stop()
+        if exporter is not None:
+            # flush + close the export stream: the last frames are the
+            # ones a post-mortem needs most
+            exporter.close()
         # flush pending K8s Events; a timeout logs + counts the unposted
         # backlog (events_unflushed) instead of silently dropping it
         dealer.recorder.flush(timeout=2.0)

@@ -129,6 +129,15 @@ def _parse_crc_line(line: str | bytes) -> dict | None:
     return out if isinstance(out, dict) else None
 
 
+#: public aliases for the line framing: the decision-record export
+#: (obs/export.py) appends the exact checkpoint framing — ``<crc32
+#: hex8> <json>`` — so its files verify with the same
+#: one-crc32-per-line loader (docs/observability.md "Decision export
+#: format")
+crc_line = _crc_line
+parse_crc_line = _parse_crc_line
+
+
 #: checkpoint files quarantined this process (path -> reason), consumed
 #: by pop_quarantine_events() so cmd/main can dump a flight-recorder
 #: bundle once the recorder exists (corruption is found at BOOT, before

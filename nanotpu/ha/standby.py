@@ -117,6 +117,17 @@ class HACoordinator:
         self.suspect_deltas = 0
         #: result of the newest post-promotion verify_state deep check
         self.last_verify: dict | None = None
+        #: optional :class:`~nanotpu.obs.Observability` bundle: when
+        #: attached (cmd/main wires the replica's own), a landing
+        #: ``bound``/``released`` record CLOSES the pod's follower-side
+        #: trail — a committed ``ha:<kind>`` trace stamped with
+        #: ``(role, epoch, seq)`` provenance — so ``/debug/story/<uid>``
+        #: shows when the leader's decision became visible on THIS
+        #: replica (docs/observability.md "Fleet observability"). The
+        #: sticky per-uid crc32 sampling verdict (obs/trace.py) gates
+        #: it, so every replica trails the same pods with zero
+        #: coordination. None == one attribute load per applied record.
+        self.obs = None
         #: verify_state runs that found a mismatch
         self.verify_failures = 0
         #: the follower staleness contract (docs/read-plane.md): reads
@@ -295,6 +306,27 @@ class HACoordinator:
                         f"/{data.get('name', '')}",
                         kind="released",
                     )
+            obs = self.obs
+            if obs is not None and obs.tracer.sample and landed and (
+                kind in ("bound", "released")
+            ):
+                # close the pod's cross-process trail: the leader's
+                # decision just became visible HERE. begin() applies
+                # the sticky per-uid verdict, so this replica trails
+                # exactly the pods every other replica trails.
+                if kind == "bound":
+                    meta = (data.get("pod") or {}).get("metadata") or {}
+                    uid = str(meta.get("uid") or "")
+                else:
+                    uid = str(data.get("uid") or "")
+                if uid:
+                    trail = obs.tracer.begin(f"ha:{kind}", uid)
+                    if trail is not None:
+                        trail.stamp(self.role, rec_epoch, rec["seq"])
+                        trail.event(
+                            "delta:applied", f"{kind} seq={rec['seq']}"
+                        )
+                        obs.tracer.commit(trail)
         elif kind == "view":
             self.dealer.warm_views(list(data.get("names") or []))
         elif kind == "gang_park":
@@ -603,10 +635,16 @@ class HttpDeltaSource:
 
     def __init__(self, base_url: str, timeout_s: float = 2.0,
                  page: int = 2048, backoff_base_s: float = 0.05,
-                 backoff_cap_s: float = 2.0, clock=None, rng=None):
+                 backoff_cap_s: float = 2.0, clock=None, rng=None,
+                 trace_context: str = ""):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = float(timeout_s)
         self.page = int(page)
+        #: stamped on every tail poll as ``X-Nanotpu-Trace`` (empty
+        #: omits the header): names this replica on the leader's side
+        #: of the stream, the delta half of the cross-process trace
+        #: contract (docs/observability.md "Fleet observability")
+        self.trace_context = str(trace_context or "")
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_cap_s = float(backoff_cap_s)
         self.clock = time.monotonic if clock is None else clock
@@ -653,8 +691,11 @@ class HttpDeltaSource:
                 return
             self.tail_retries += 1
         url = f"{self.base_url}/debug/ha?since={int(since)}&limit={self.page}"
+        req = urllib.request.Request(url)
+        if self.trace_context:
+            req.add_header("X-Nanotpu-Trace", self.trace_context)
         try:
-            with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                 body = _json.loads(resp.read())
         except Exception:
             self.poll_errors += 1

@@ -1,0 +1,73 @@
+"""``nanotpu_fleet_*`` exposition: the fleet aggregation plane's scrape
+surface (docs/observability.md "Fleet observability").
+
+The gauge values come from ONE producer —
+:meth:`FleetView.fleet_gauge_values
+<nanotpu.obs.fleet.FleetView.fleet_gauge_values>` — so the scrape
+surface and ``GET /debug/fleet`` read the same numbers. The nanolint
+metrics-completeness pass cross-checks :data:`_FLEET_GAUGES` against
+that producer BOTH directions (a suffix declared here but never
+produced, or produced there but never declared, is a lint finding) —
+the same honesty contract the ha/follower/shadow families live under.
+Registered only when a view is attached (``SchedulerAPI.attach_fleet``),
+so every existing deployment's ``/metrics`` body is unchanged."""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger("nanotpu.metrics.fleet")
+
+_FAMILY = "nanotpu_fleet_"
+
+#: gauge suffix -> help text. Keys must match
+#: FleetView.fleet_gauge_values() exactly — nanolint pins the
+#: equivalence both ways.
+_FLEET_GAUGES: dict[str, str] = {
+    "peers":
+        "Replicas this leader's fleet view polls (the --ha-peers list; "
+        "excludes the local process)",
+    "peers_synced":
+        "Replicas inside their read-plane staleness bound at the last "
+        "fleet poll (actives always count; the local replica included)",
+    "max_lag_events":
+        "The worst delta-stream lag across the fleet at the last poll, "
+        "in events — the fleet's read-staleness headline number",
+    "stories_served":
+        "GET /debug/story/<uid> cross-replica joins this process has "
+        "served",
+    "export_bytes":
+        "Bytes framed into the durable decision export over this "
+        "process's lifetime — across rotations, so the gauge is "
+        "monotonic even though the live segment is size-bounded",
+    "export_rotations":
+        "Export segment rotations (live segment reached --obs-export-"
+        "max-bytes and was renamed to <path>.1)",
+    "export_drops":
+        "Export records lost to sink write failures (counted, never "
+        "raised — the export is forensics, the scheduler outlives it)",
+}
+
+
+class FleetExporter:
+    """Registry-compatible renderer (``Registry.register``) for the
+    fleet gauges. Registered exactly when a view is attached
+    (``SchedulerAPI.attach_fleet``), so fleet-less deployments export
+    nothing new."""
+
+    def __init__(self, view):
+        self.view = view
+
+    def render(self) -> list[str]:
+        out: list[str] = []
+        try:
+            values = self.view.fleet_gauge_values()
+        except Exception:
+            log.warning("fleet gauge producer failed", exc_info=True)
+            return out
+        for suffix in sorted(_FLEET_GAUGES):
+            name = _FAMILY + suffix
+            out.append(f"# HELP {name} {_FLEET_GAUGES[suffix]}")
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {float(values[suffix])}")
+        return out

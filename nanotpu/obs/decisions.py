@@ -206,6 +206,23 @@ class DecisionLedger:
         #: overload burst cannot evict the per-pod records the ledger
         #: exists to keep
         self._uidless_aborts: dict[str, int] = {}
+        #: optional :class:`~nanotpu.obs.export.DecisionExporter`: every
+        #: FINALIZED cycle whose uid passes the sticky sampling verdict
+        #: is appended to the durable export stream the moment it
+        #: retires from the building set (docs/observability.md
+        #: "Decision export format"). With no exporter the finalize
+        #: path pays one attribute load — the rings' zero-cost rule.
+        self.exporter = None
+
+    def _retire_locked(self, cyc: _Cycle) -> None:
+        """File a finalized cycle into the ring and, when an exporter is
+        wired and the pod is sampled, append it to the export stream —
+        ONE retirement point so every finalize path (bind, abort,
+        retry roll, building-set overflow) exports identically."""
+        self._ring.append(cyc)
+        exp = self.exporter
+        if exp is not None and exp.sampled(cyc.uid):
+            exp.cycle(cyc.as_dict())
 
     # -- recording ---------------------------------------------------------
     def _cycle_locked(self, uid: str, pod: str = "") -> _Cycle:
@@ -217,7 +234,7 @@ class DecisionLedger:
             while len(self._building) > BUILDING_MAX:
                 _, stale = self._building.popitem(last=False)
                 stale.outcome = stale.outcome or "abandoned"
-                self._ring.append(stale)
+                self._retire_locked(stale)
         elif pod and not cyc.pod:
             cyc.pod = pod
         return cyc
@@ -231,7 +248,7 @@ class DecisionLedger:
             prev = self._building.get(uid)
             if prev is not None and (prev.verdicts or prev.binds):
                 prev.outcome = prev.outcome or "retried"
-                self._ring.append(self._building.pop(uid))
+                self._retire_locked(self._building.pop(uid))
             cyc = self._cycle_locked(uid, pod)
             cyc.verdicts = dict(verdicts)
             if policy:
@@ -297,7 +314,7 @@ class DecisionLedger:
             })
             if bound or final:
                 cyc.outcome = "bound" if bound else reason
-                self._ring.append(self._building.pop(uid))
+                self._retire_locked(self._building.pop(uid))
 
     def abort(self, uid: str, verb: str, reason: str) -> None:
         """A request ended without a decision (deadline / admission shed);
@@ -318,7 +335,7 @@ class DecisionLedger:
                 self._seq += 1
                 cyc = _Cycle(uid, "", self._seq, round(self.clock(), 6))
             cyc.outcome = key
-            self._ring.append(cyc)
+            self._retire_locked(cyc)
 
     def abort_summary(self) -> dict[str, int]:
         """Aggregate counts of UID-less aborts ("<reason>:<verb>" keys)."""

@@ -1,0 +1,231 @@
+"""Durable decision-record export: the fleet's reproducible training corpus.
+
+The traces, decision ledger, and timeline (PR 5 / PR 11) live in bounded
+in-memory rings inside whichever process answered — they evaporate on
+restart, which blocks ROADMAP item 4 (predictive dispatch trained
+"against the ledger's ``score_terms`` + measured-tok/s records") and
+item 1's per-tenant accountability. The :class:`DecisionExporter`
+appends every **finalized** ledger cycle (score breakdowns, bind
+outcomes, batch/recovery/SLO reason codes) and every telemetry tick to
+an append-only JSONL file under ``--obs-export PATH``
+(docs/observability.md "Decision export format").
+
+Framing is the checkpoint line format from ha/delta.py, byte for byte:
+``<crc32 hex8> <canonical json>`` — one C-speed ``zlib.crc32`` verifies
+a line at load, a torn tail line is skipped instead of poisoning the
+corpus, and the reader (:func:`read_export`) is the same loader shape
+the checkpoint uses.
+
+Rotation is size-bounded: when the live segment passes ``max_bytes`` it
+is renamed to ``<path>.1`` (replacing the previous rotation — two
+segments bound the disk) and a fresh segment opens. The counters
+(``export_bytes`` / ``export_rotations`` / ``export_drops``) surface on
+``/metrics`` through the ``nanotpu_fleet_*`` family.
+
+Sampling rides the SAME sticky per-pod-uid crc32 verdict as the tracer
+(obs/trace.py): ``crc32(uid) % sample == 0``. That verdict is the
+cross-process sampling contract — every replica exports the same pods
+with zero coordination, so a pod's leader-side and follower-side
+records land in every export stream or in none.
+
+Determinism contract: the exporter stamps nothing itself — cycles and
+ticks already carry their producer's (injectable) clock — so the sim
+drives it on virtual time and :meth:`digest` is byte-reproducible; the
+report's ``export`` section folds it into ``--check-determinism``.
+With ``path=""`` the exporter runs sink-less (counters + digest, no
+file I/O): the sim's default, keeping ``--check-determinism`` free of
+tmp-file plumbing while still certifying the stream bytes.
+
+Cost contract: with no exporter attached the ledger finalize path and
+the timeline tick pay ONE attribute load each (``self.exporter is
+None``) — the bench's A/B attribution diff pins it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from zlib import crc32
+
+from nanotpu.analysis.witness import make_lock
+from nanotpu.ha.delta import crc_line, parse_crc_line
+
+log = logging.getLogger("nanotpu.obs.export")
+
+#: default live-segment bound before rotation (two segments retained)
+DEFAULT_MAX_BYTES = 8 * 1024 * 1024
+
+
+class DecisionExporter:
+    """Append-only crc-framed JSONL sink for decision records + ticks.
+
+    Thread-safe; every write failure is counted (``drops``) and never
+    raised — the export is forensics, the scheduler must outlive it.
+    ``sample`` follows the tracer's convention (0 off, 1 all, N sticky
+    1-in-N per pod uid)."""
+
+    def __init__(self, path: str = "", sample: int = 1,
+                 max_bytes: int = DEFAULT_MAX_BYTES):
+        if max_bytes <= 0:
+            raise ValueError(
+                f"export max_bytes must be > 0, got {max_bytes}"
+            )
+        self.path = str(path or "")
+        self.sample = max(0, int(sample))
+        self.max_bytes = int(max_bytes)
+        self._lock = make_lock("DecisionExporter._lock")
+        self._hash = hashlib.sha256()
+        self._file = None
+        #: records framed (exported) over the exporter's lifetime
+        self.records = 0
+        #: bytes framed over the lifetime — ACROSS rotations (the gauge
+        #: is monotonic even though the live segment is bounded)
+        self.bytes_written = 0
+        #: bytes in the live segment (resets on rotation)
+        self.segment_bytes = 0
+        self.rotations = 0
+        #: records lost to sink write failures (counted, never raised)
+        self.drops = 0
+
+    def sampled(self, uid: str) -> bool:
+        """The sticky per-pod verdict — same formula as
+        ``Tracer.sampled`` (obs/trace.py), which is what makes the
+        sampling contract hold ACROSS processes: every replica computes
+        the same crc32 over the same uid."""
+        if self.sample <= 0:
+            return False
+        if self.sample == 1:
+            return True
+        return crc32(uid.encode()) % self.sample == 0
+
+    # -- recording ---------------------------------------------------------
+    def cycle(self, record: dict) -> None:
+        """Export one finalized decision-ledger cycle (already sampled
+        by the caller — the ledger checks :meth:`sampled` so unsampled
+        pods record nothing anywhere, the rings' rule)."""
+        self._emit("cycle", record)
+
+    def tick(self, record: dict) -> None:
+        """Export one telemetry-timeline tick (uid-less: ticks are
+        aggregate series and always export when an exporter is wired)."""
+        self._emit("tick", record)
+
+    def _emit(self, kind: str, record: dict) -> None:
+        payload = json.dumps(
+            {"kind": kind, "record": record},
+            sort_keys=True, separators=(",", ":"),
+        )
+        line = crc_line(payload) + "\n"
+        data = line.encode()
+        with self._lock:
+            self._hash.update(data)
+            self.records += 1
+            self.bytes_written += len(data)
+            self.segment_bytes += len(data)
+            if self.path:
+                try:
+                    if self._file is None:
+                        self._open_locked()
+                    self._file.write(data)
+                    self._file.flush()
+                except OSError:
+                    self.drops += 1
+                    log.exception("export write failed (%s)", self.path)
+            if self.segment_bytes >= self.max_bytes:
+                self._rotate_locked()
+
+    def _open_locked(self) -> None:
+        self._file = open(self.path, "ab")
+        # a reopened segment (process restart) keeps rotating on size:
+        # the bound is the FILE's, not this process's write count
+        self.segment_bytes = max(
+            self.segment_bytes, self._file.tell()
+        )
+
+    def _rotate_locked(self) -> None:
+        """Size-bounded rotation: live segment -> ``<path>.1``
+        (replacing the previous rotation), fresh segment opens on the
+        next write. Sink-less exporters rotate their COUNTERS on the
+        same bound, so the sim certifies rotation deterministically."""
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+            try:
+                os.replace(self.path, f"{self.path}.1")
+            except OSError:
+                self.drops += 1
+                log.exception("export rotation failed (%s)", self.path)
+        self.rotations += 1
+        self.segment_bytes = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+    # -- observability -----------------------------------------------------
+    def digest(self) -> str:
+        """sha256 over every framed line ever emitted — rotations
+        included, so the digest certifies the STREAM, not whichever
+        segment survived. Byte-reproducible under the sim's virtual
+        clock (the report's ``export`` section)."""
+        with self._lock:
+            return "sha256:" + self._hash.hexdigest()
+
+    def status(self) -> dict:
+        """The ``/debug/fleet`` export block + the sim report's
+        ``export`` section (no path: tmp paths must not enter a pinned
+        digest)."""
+        with self._lock:
+            return {
+                "records": self.records,
+                "bytes": self.bytes_written,
+                "segment_bytes": self.segment_bytes,
+                "rotations": self.rotations,
+                "drops": self.drops,
+                "sample": self.sample,
+                "digest": "sha256:" + self._hash.hexdigest(),
+            }
+
+
+def read_export(path: str) -> list[dict]:
+    """Load one export segment: every line that verifies, in order —
+    ``{"kind": "cycle"|"tick", "record": {...}}``. A torn or corrupt
+    line is SKIPPED (counted in the log), the checkpoint loader's rule:
+    a crash mid-append must cost at most its own final line."""
+    out: list[dict] = []
+    bad = 0
+    with open(path, "rb") as fh:
+        for raw in fh:
+            line = raw.rstrip(b"\n")
+            if not line:
+                continue
+            rec = parse_crc_line(line)
+            if rec is None:
+                bad += 1
+                continue
+            out.append(rec)
+    if bad:
+        log.warning("export load skipped %d corrupt line(s) (%s)",
+                    bad, path)
+    return out
+
+
+def export_digest(path: str) -> str:
+    """sha256 over the verified lines of one segment, reframed — the
+    ``make fleet-obs-check`` reproducibility probe (two sim runs with
+    the same scenario+seed must produce files with equal digests)."""
+    hasher = hashlib.sha256()
+    for rec in read_export(path):
+        payload = json.dumps(rec, sort_keys=True, separators=(",", ":"))
+        hasher.update((crc_line(payload) + "\n").encode())
+    return "sha256:" + hasher.hexdigest()

@@ -1,0 +1,395 @@
+"""Fleet aggregation plane: the leader's merged view of every replica.
+
+The read plane (docs/read-plane.md) scaled Filter/Prioritize out to
+followers — and scattered the observability story with it: a pod's
+Filter trace lives in whichever follower served it, its Bind cycle in
+the leader's ledger, and the operator's first question ("what is the
+FLEET's lag / refusal / divergence picture right now?") has no single
+answer. The :class:`FleetView` is that answer: a leader-side poller
+that merges each peer's existing debug pages — ``/debug/ha`` (role,
+lag, the follower read-plane block), ``/debug/timeline?since=`` (only
+the tick delta since the last poll), ``/debug/shadow`` (divergence
+totals; 404-tolerant, shadowing is optional) — into
+
+* ``GET /debug/fleet`` — one *fleet tick* per poll: aggregate lag
+  (max + sum), per-follower reads-refused, shadow divergence totals,
+  reachability; plus the durable-export counters when an exporter is
+  wired (docs/observability.md "Fleet observability");
+* ``GET /debug/story/<uid>`` — the pod's END-TO-END causal record:
+  local traces + ledger cycles joined with every peer's
+  ``/debug/traces/<uid>`` page, ordered by ``(epoch, seq, t)`` — the
+  trace ``origin`` stamp (obs/trace.py) is what makes records from
+  different processes totally orderable.
+
+Injectability: ``peers`` is a plain URL list (``--ha-peers`` /
+the deploy Service), ``fetch`` and ``clock`` are injectable so tests
+drive the view against in-process fakes with a virtual clock; the
+default fetch is one urllib GET per page with the same short timeout
+discipline as :class:`~nanotpu.ha.standby.HttpDeltaSource`.
+
+Sampling: the story join does not re-sample — each replica's rings
+already hold only pods that passed the sticky crc32 verdict
+(obs/trace.py), and that verdict is replica-independent, so a sampled
+pod's records exist on EVERY replica that touched it or on none.
+
+Cost contract: the view runs on its own cadence thread
+(:class:`FleetLoop`) or under a debug GET — never on the verb hot
+path; an unattached API pays one ``self.fleet is None`` check per
+debug dispatch and nothing else.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from nanotpu.analysis.witness import make_lock
+
+log = logging.getLogger("nanotpu.obs.fleet")
+
+#: fleet ticks retained (the /debug/fleet?since= window)
+DEFAULT_CAPACITY = 256
+
+
+def http_fetch(base_url: str, path: str, timeout_s: float = 2.0):
+    """Default peer fetch: one GET, parsed JSON dict on 200, None on
+    ANY failure (refused, timeout, non-200, bad JSON) — an unreachable
+    peer is a data point for the fleet tick, never an exception."""
+    url = f"{base_url.rstrip('/')}{path}"
+    req = urllib.request.Request(url, headers={"Accept": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            if resp.status != 200:
+                return None
+            body = json.loads(resp.read().decode())
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+    return body if isinstance(body, dict) else None
+
+
+def _story_key(entry: dict):
+    """The story's total order: ``(epoch, seq, t)``. Epoch/seq come
+    from the trace ``origin`` stamp (delta-log position — comparable
+    ACROSS processes); unstamped records (single-replica traces,
+    ledger cycles) sort at stream position zero by their own
+    producer-clock timestamp, which keeps a one-process story in plain
+    time order."""
+    return (
+        entry.get("epoch", 0), entry.get("seq", 0), entry.get("t", 0.0),
+        entry.get("source", ""),
+    )
+
+
+class FleetView:
+    """Merged multi-replica observability (see module docstring).
+
+    ``peers`` are base URLs of the OTHER replicas; the local process's
+    own tracer/ledger/coordinator/exporter are read directly (no
+    loopback HTTP). All taps are optional — a view over an HA-less
+    single process still serves ``/debug/story`` from local rings."""
+
+    def __init__(self, peers, obs=None, ha=None, timeline=None,
+                 shadow=None, exporter=None, fetch=None,
+                 timeout_s: float = 2.0, capacity: int = DEFAULT_CAPACITY,
+                 clock=time.monotonic):
+        if capacity <= 0:
+            raise ValueError(f"fleet capacity must be > 0, got {capacity}")
+        self.peers = [str(p).rstrip("/") for p in peers if str(p).strip()]
+        self.obs = obs
+        self.ha = ha
+        self.timeline = timeline
+        self.shadow = shadow
+        self.exporter = exporter
+        self.timeout_s = float(timeout_s)
+        self.capacity = int(capacity)
+        self.clock = clock
+        self._fetch = fetch or (
+            lambda base, path: http_fetch(base, path, self.timeout_s)
+        )
+        self._lock = make_lock("FleetView._lock")
+        self._ring: list[dict] = []
+        self._n = 0  # fleet ticks taken (monotonic sequence)
+        #: per-peer cursor: last timeline tick seq seen, so each poll
+        #: fetches only the delta (the ?since= contract)
+        self._peer_tick: dict[str, int] = {}
+        #: per-peer newest page results (the /debug/fleet peer table)
+        self._peer_state: dict[str, dict] = {}
+        self.polls = 0
+        self.fetch_errors = 0
+        self.stories_served = 0
+
+    # -- polling -----------------------------------------------------------
+    def poll_once(self, now: float | None = None) -> dict:
+        """One fleet tick: fetch every peer's ha/timeline/shadow pages,
+        fold them with the LOCAL replica's state, append to the ring.
+        Runs on the FleetLoop cadence (or a test's direct call) — one
+        slow peer costs its timeout, never a verb."""
+        if now is None:
+            now = self.clock()
+        rows = [self._local_row()]
+        for base in self.peers:
+            rows.append(self._poll_peer(base))
+        reachable = [r for r in rows if r["reachable"]]
+        tick = {
+            "t": round(now, 6),
+            "peers": len(self.peers),
+            "peers_reachable": sum(
+                1 for r in reachable if r["source"] != "local"
+            ),
+            "peers_synced": sum(1 for r in reachable if r["synced"]),
+            "lag_events_max": max(
+                (r["lag_events"] for r in reachable), default=0
+            ),
+            "lag_events_sum": sum(r["lag_events"] for r in reachable),
+            "reads_refused_total": sum(
+                r["reads_refused"] for r in reachable
+            ),
+            "shadow_divergences_total": sum(
+                r["shadow_divergences"] for r in reachable
+            ),
+            "replicas": rows,
+        }
+        exporter = self.exporter
+        if exporter is not None:
+            tick["export"] = exporter.status()
+        with self._lock:
+            self._n += 1
+            tick["fleet_tick"] = self._n
+            self.polls += 1
+            self._ring.append(tick)
+            if len(self._ring) > self.capacity:
+                del self._ring[0]
+            for row in rows:
+                self._peer_state[row["source"]] = row
+        return tick
+
+    def _local_row(self) -> dict:
+        """This replica's own row — read directly, not over loopback."""
+        row = self._blank_row("local")
+        row["reachable"] = True
+        ha = self.ha
+        if ha is not None:
+            try:
+                status = ha.status()
+            except Exception:
+                log.exception("fleet local ha tap failed")
+                status = {}
+            self._fold_ha(row, status)
+        else:
+            row["role"] = "single"
+            row["synced"] = True
+        timeline = self.timeline
+        if timeline is not None:
+            row["timeline_tick"] = timeline.latest_tick
+        shadow = self.shadow
+        if shadow is not None:
+            try:
+                row["shadow_divergences"] = shadow.status()["divergences"]
+            except Exception:
+                log.exception("fleet local shadow tap failed")
+        return row
+
+    def _poll_peer(self, base: str) -> dict:
+        row = self._blank_row(base)
+        ha_page = self._fetch(base, "/debug/ha?since=0&limit=0")
+        if ha_page is None:
+            self.fetch_errors += 1
+            return row
+        row["reachable"] = True
+        self._fold_ha(row, ha_page)
+        since = self._peer_tick.get(base, 0)
+        tl_page = self._fetch(base, f"/debug/timeline?since={since}")
+        if tl_page is not None:
+            latest = int(tl_page.get("latest", since) or 0)
+            self._peer_tick[base] = max(since, latest)
+            row["timeline_tick"] = self._peer_tick[base]
+            row["ticks_new"] = int(tl_page.get("count", 0) or 0)
+        sh_page = self._fetch(base, "/debug/shadow?limit=0")
+        if sh_page is not None:
+            # absent page (404 -> None) just means no shadow attached
+            row["shadow_divergences"] = int(
+                sh_page.get("divergences", 0) or 0
+            )
+        return row
+
+    @staticmethod
+    def _blank_row(source: str) -> dict:
+        return {
+            "source": source, "reachable": False, "role": "",
+            "synced": False, "epoch": 0, "lag_events": 0,
+            "reads_refused": 0, "shadow_divergences": 0,
+            "timeline_tick": 0, "ticks_new": 0,
+        }
+
+    @staticmethod
+    def _fold_ha(row: dict, status: dict) -> None:
+        """Fold one ``/debug/ha`` body (or a local ``status()`` dict)
+        into a peer row. The follower read-plane block rides only on
+        followers (docs/read-plane.md); actives count as synced."""
+        row["role"] = str(status.get("role", "") or "")
+        row["lag_events"] = int(status.get("lag_events", 0) or 0)
+        follower = status.get("follower")
+        if isinstance(follower, dict):
+            row["synced"] = bool(follower.get("synced"))
+            row["reads_refused"] = int(
+                follower.get("reads_refused", 0) or 0
+            )
+        else:
+            row["synced"] = row["role"] in ("active", "single", "")
+        fence = status.get("fence")
+        if isinstance(fence, dict):
+            row["epoch"] = int(fence.get("epoch", 0) or 0)
+
+    # -- retrieval ---------------------------------------------------------
+    def latest(self) -> dict | None:
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def since(self, tick: int = 0) -> list[dict]:
+        """Fleet ticks with ``fleet_tick > tick``, oldest first (the
+        same delta-cursor contract as the timeline)."""
+        with self._lock:
+            return [t for t in self._ring if t["fleet_tick"] > tick]
+
+    def fleet_status(self) -> dict:
+        """The ``GET /debug/fleet`` body."""
+        with self._lock:
+            latest = self._ring[-1] if self._ring else None
+            out = {
+                "peers": list(self.peers),
+                "polls": self.polls,
+                "fetch_errors": self.fetch_errors,
+                "stories_served": self.stories_served,
+                "latest": latest,
+            }
+        exporter = self.exporter
+        if exporter is not None:
+            out["export"] = exporter.status()
+        return out
+
+    # -- the per-pod story -------------------------------------------------
+    def story(self, uid: str) -> dict:
+        """``GET /debug/story/<uid>``: every trace + ledger record the
+        fleet retains for one pod, merged across replicas and ordered
+        by ``(epoch, seq, t)`` — follower-served Filter/Prioritize
+        trails first at their stream position, the leader's Bind cycle
+        where the delta log placed it, a recovery-plane migration
+        appended where its audit record landed."""
+        entries: list[dict] = []
+        obs = self.obs
+        if obs is not None:
+            role = self.ha.role if self.ha is not None else "single"
+            for tr in obs.tracer.get(uid):
+                entries.append(self._trace_entry("local", role, tr))
+            for cyc in obs.ledger.get(uid):
+                entries.append(self._cycle_entry("local", role, cyc))
+        for base in self.peers:
+            page = self._fetch(
+                base, f"/debug/traces/{urllib.parse.quote(uid)}"
+            )
+            if page is None:
+                # 404 here just means this peer retains nothing for the
+                # uid — an unsampled pod or an evicted ring slot
+                continue
+            role = str(page.get("role", "") or "peer")
+            for tr in page.get("traces", ()):
+                entries.append(self._trace_entry(base, role, tr))
+            for cyc in page.get("decisions", ()):
+                entries.append(self._cycle_entry(base, role, cyc))
+        entries.sort(key=_story_key)
+        with self._lock:
+            self.stories_served += 1
+        return {"uid": uid, "count": len(entries), "entries": entries}
+
+    @staticmethod
+    def _trace_entry(source: str, role: str, trace: dict) -> dict:
+        origin = trace.get("origin") or {}
+        return {
+            "kind": "trace",
+            "source": source,
+            "role": str(origin.get("role", role) or role),
+            "epoch": int(origin.get("epoch", 0) or 0),
+            "seq": int(origin.get("seq", 0) or 0),
+            "t": float(trace.get("t0", 0.0) or 0.0),
+            "record": trace,
+        }
+
+    @staticmethod
+    def _cycle_entry(source: str, role: str, cycle: dict) -> dict:
+        return {
+            "kind": "decision",
+            "source": source,
+            "role": role,
+            "epoch": 0,
+            "seq": 0,
+            "t": float(cycle.get("t0", 0.0) or 0.0),
+            "record": cycle,
+        }
+
+    # -- exposition --------------------------------------------------------
+    def fleet_gauge_values(self) -> dict:
+        """The ``nanotpu_fleet_*`` producer; keys are pinned against
+        ``nanotpu.metrics.fleet._FLEET_GAUGES`` both directions by the
+        nanolint metrics-completeness pass, the same honesty contract
+        every other gauge family lives under."""
+        with self._lock:
+            latest = self._ring[-1] if self._ring else None
+            stories = self.stories_served
+        exporter = self.exporter
+        return {
+            "peers": len(self.peers),
+            "peers_synced": latest["peers_synced"] if latest else 0,
+            "max_lag_events": latest["lag_events_max"] if latest else 0,
+            "stories_served": stories,
+            "export_bytes": (
+                exporter.bytes_written if exporter is not None else 0
+            ),
+            "export_rotations": (
+                exporter.rotations if exporter is not None else 0
+            ),
+            "export_drops": (
+                exporter.drops if exporter is not None else 0
+            ),
+        }
+
+
+class FleetLoop:
+    """Production cadence driver for the view: one daemon thread
+    polling every ``period_s`` — the TelemetryLoop shape, minus the
+    watchdog (fleet ticks are an aggregation surface, not an SLO
+    input ... yet)."""
+
+    def __init__(self, view: FleetView, period_s: float = 10.0):
+        if period_s <= 0:
+            raise ValueError(f"fleet period must be > 0, got {period_s}")
+        self.view = view
+        self.period_s = float(period_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="fleet-view"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.view.poll_once()
+            except Exception:  # observability must never kill the process
+                log.exception("fleet poll failed")

@@ -12,6 +12,11 @@ answers all three with ONE canonical-JSON bundle
   joined with their pods' retained traces (the causal record);
 * ``shards`` / ``pipeline`` / ``recovery`` / ``gangs`` — the dealer's
   live status taps (the control-plane state);
+* ``ha`` / ``follower`` / ``shadow`` — the replica's role, stream
+  lag, fence validity, read-plane state, and shadow-divergence totals,
+  present exactly when the corresponding component is attached (a
+  post-mortem of a failover or a stale read plane starts here;
+  single-replica bundle bytes are unchanged);
 * ``perf`` / ``resilience`` — counter totals (the attribution);
 * ``config_fingerprint`` — sha256 of the canonical config the process
   booted with, so a bundle names the exact configuration it describes.
@@ -74,6 +79,13 @@ class FlightRecorder:
         self.decisions = int(decisions)
         self.clock = clock
         self.deterministic = bool(deterministic)
+        #: optional HA coordinator / shadow scorer (docs/ha.md,
+        #: docs/policy-programs.md): when attached the bundle gains
+        #: ``ha`` (+ ``follower`` on followers) / ``shadow`` sections.
+        #: PRESENT ONLY THEN — single-replica bundle bytes (and the
+        #: sim's pinned flight digests) are unchanged.
+        self.ha = None
+        self.shadow = None
         self._lock = make_lock("FlightRecorder._lock")
         self.bundles = 0
         self._last_bytes: bytes | None = None
@@ -130,6 +142,19 @@ class FlightRecorder:
             lambda: dealer.perf_totals() if dealer is not None else {}
         )
         out["resilience"] = self._tap(self._resilience)
+        ha = self.ha
+        if ha is not None:
+            # self-guarded like every tap: a mid-promotion (or dead)
+            # coordinator degrades to an error marker, never kills the
+            # dump — the recorder exists for exactly those moments
+            out["ha"] = self._tap(lambda: ha.status(now=now))
+            if ha.role == "follower":
+                out["follower"] = self._tap(
+                    lambda: ha.follower_gauge_values(now=now)
+                )
+        shadow = self.shadow
+        if shadow is not None:
+            out["shadow"] = self._tap(lambda: shadow.status())
         return out
 
     @staticmethod

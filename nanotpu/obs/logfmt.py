@@ -5,7 +5,16 @@ record becomes one JSON object per line, and records emitted while a
 sampled request is active on the thread are stamped with that request's
 pod UID and trace id — so logs and ``/debug/traces/<uid>`` join on one
 key instead of by eyeball-on-timestamps.
-"""
+
+In an HA fleet the same join problem recurs one level up: N replicas'
+log streams land in one aggregator, and "which ROLE said this, and was
+it synced / fenced at the time?" is the first triage question. When a
+coordinator is attached (``attach_ha``), every record is additionally
+stamped with ``role``, ``synced``, and ``fence_epoch`` read from the
+LIVE coordinator/fence at emit time — not captured at boot, because a
+promotion flips all three mid-process and the logs around that flip are
+exactly the ones that matter. HA-less processes emit byte-identical
+lines to before (the keys are absent, not null)."""
 
 from __future__ import annotations
 
@@ -16,7 +25,20 @@ from nanotpu.obs.trace import current
 
 
 class JsonLogFormatter(logging.Formatter):
-    """One JSON object per log line, trace-correlated when possible."""
+    """One JSON object per log line, trace-correlated when possible and
+    role-stamped when an HA coordinator is attached."""
+
+    def __init__(self):
+        super().__init__()
+        #: optional live HACoordinator (attach_ha): stamps role /
+        #: synced / fence_epoch per record. Read at format time —
+        #: promotions must show up on the very next line.
+        self.ha = None
+
+    def attach_ha(self, coordinator) -> None:
+        """Adopt the replica's coordinator (cmd/main wires this right
+        after building it); logs gain the fleet-triage keys."""
+        self.ha = coordinator
 
     def format(self, record: logging.LogRecord) -> str:
         out = {
@@ -32,4 +54,14 @@ class JsonLogFormatter(logging.Formatter):
             out["pod_uid"] = trace.uid
             out["trace_id"] = trace.trace_id
             out["verb"] = trace.verb
+        ha = self.ha
+        if ha is not None:
+            try:
+                out["role"] = ha.role
+                out["synced"] = bool(ha.synced())
+                fence = ha.fence
+                out["fence_epoch"] = fence.epoch if fence is not None else 0
+            except Exception:
+                # a mid-promotion coordinator must never kill a log line
+                out["role"] = "?"
         return json.dumps(out, sort_keys=True, separators=(",", ":"))

@@ -127,6 +127,12 @@ class Timeline:
         #: SLO-addressable series (``degraded.active`` etc.). Same
         #: present-only-then rule as ``ha``.
         self.degraded = None
+        #: optional :class:`~nanotpu.obs.export.DecisionExporter`: every
+        #: tick is appended to the durable export stream alongside the
+        #: ledger's cycles (docs/observability.md "Decision export
+        #: format"). One attribute load when absent — the tick already
+        #: runs off the verb hot path, but the rule is uniform.
+        self.exporter = None
         self.capacity = int(capacity)
         self.clock = clock
         self.deterministic = bool(deterministic)
@@ -206,7 +212,13 @@ class Timeline:
             else:
                 self._ring[self._slot] = tick
                 self._slot = (self._slot + 1) % self.capacity
-            return tick
+        exporter = self.exporter
+        if exporter is not None:
+            # outside the lock: the exporter serializes + may touch the
+            # filesystem, neither belongs under the ring lock (and the
+            # exporter has its own)
+            exporter.tick(tick)
+        return tick
 
     def _sample_fleet(self, now: float) -> tuple[dict, dict]:
         fleet = {

@@ -63,7 +63,8 @@ class Trace:
     request thread that began it is the only appender, so ``event()``
     needs no lock; readers only see it after ``Tracer.commit``."""
 
-    __slots__ = ("uid", "trace_id", "verb", "seq", "t0", "events", "_clock")
+    __slots__ = ("uid", "trace_id", "verb", "seq", "t0", "events",
+                 "origin", "_clock")
 
     def __init__(self, uid: str, verb: str, seq: int, clock):
         self.uid = uid
@@ -73,20 +74,41 @@ class Trace:
         self._clock = clock
         self.t0 = round(clock(), 6)
         self.events: list[tuple[float, str, str]] = []
+        #: cross-process provenance — ``{"role", "epoch", "seq"}``
+        #: stamped by the route layer (and the follower's delta-apply
+        #: trail closer) against the HA stream position: ``epoch`` is
+        #: the writer term, ``seq`` the delta-log sequence this replica
+        #: had reached, which is what makes trails from DIFFERENT
+        #: processes totally orderable in ``/debug/story/<uid>``
+        #: (docs/observability.md "Fleet observability"). None until
+        #: stamped, and absent from :meth:`as_dict` then, so HA-less
+        #: trace bytes (and every pinned sim digest) are unchanged.
+        self.origin: dict | None = None
 
     def event(self, kind: str, detail: str = "") -> None:
         """Append one timestamped event (timestamps come from the
         tracer's clock: wall in production, virtual in the sim)."""
         self.events.append((round(self._clock(), 6), kind, detail))
 
+    def stamp(self, role: str, epoch: int, seq: int) -> None:
+        """Stamp ``(role, epoch, seq)`` provenance (see ``origin``)."""
+        self.origin = {
+            "role": str(role), "epoch": int(epoch), "seq": int(seq),
+        }
+
     def as_dict(self) -> dict:
-        return {
+        out = {
             "uid": self.uid,
             "trace_id": self.trace_id,
             "verb": self.verb,
             "t0": self.t0,
             "events": [[t, kind, detail] for t, kind, detail in self.events],
         }
+        if self.origin is not None:
+            # present only when stamped: pre-fleet trace bytes (and the
+            # sim's trace digests) stay byte-identical
+            out["origin"] = dict(self.origin)
+        return out
 
 
 class Tracer:

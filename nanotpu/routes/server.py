@@ -76,6 +76,8 @@ DEBUG_ROUTES = (
     "/debug/ha",
     "/debug/shadow",
     "/debug/verify",
+    "/debug/fleet",
+    "/debug/story/",
 )
 
 
@@ -297,6 +299,13 @@ class SchedulerAPI:
         #: nanotpu_shadow_* exporter. None == no candidate == zero new
         #: code on any request path.
         self.shadow = None
+        #: fleet aggregation view (docs/observability.md "Fleet
+        #: observability"), attached by attach_fleet on the replica
+        #: that polls its peers: serves GET /debug/fleet +
+        #: GET /debug/story/<uid> and registers the nanotpu_fleet_*
+        #: exporter. None == no fleet plane == zero new code on any
+        #: request path.
+        self.fleet = None
         #: callable -> the verify_state deep-check dict (ha/verify.py),
         #: wired by cmd/main with the live clientset; GET /debug/verify
         #: 404s when absent.
@@ -308,15 +317,20 @@ class SchedulerAPI:
         self._nodenames_cache: dict[bytes, list] = {}
 
     # -- request dispatch --------------------------------------------------
-    def dispatch(self, method: str, path: str, body: bytes) -> tuple[int, str, str]:
-        """Returns (http status, content-type, payload)."""
+    def dispatch(self, method: str, path: str, body: bytes,
+                 trace_ctx: str = "") -> tuple[int, str, str]:
+        """Returns (http status, content-type, payload). ``trace_ctx``
+        is the caller's ``X-Nanotpu-Trace`` header (empty when absent):
+        a sampled request records it as a ``ctx`` event, tying this
+        replica's trail to the upstream trail that carried it
+        (docs/observability.md "Fleet observability")."""
         try:
             if method == "POST" and path == "/scheduler/filter":
-                return self._verb(self.predicate, body)
+                return self._verb(self.predicate, body, trace_ctx)
             if method == "POST" and path == "/scheduler/priorities":
-                return self._verb(self.prioritize, body)
+                return self._verb(self.prioritize, body, trace_ctx)
             if method == "POST" and path == "/scheduler/bind":
-                return self._verb(self.bind, body)
+                return self._verb(self.bind, body, trace_ctx)
             if method == "POST" and path == "/scheduler/batchadmit":
                 # batch admission (docs/batch-admission.md): 404 unless a
                 # BatchAdmitter is attached — the default wire surface is
@@ -354,6 +368,10 @@ class SchedulerAPI:
                 return self._debug_shadow(path)
             if method == "GET" and path.startswith("/debug/verify"):
                 return self._debug_verify()
+            if method == "GET" and path.startswith("/debug/fleet"):
+                return self._debug_fleet(path)
+            if method == "GET" and path.startswith("/debug/story/"):
+                return self._debug_story(path)
             return 404, "application/json", error_body(
                 "NotFound", f"no route {path}"
             )
@@ -365,7 +383,8 @@ class SchedulerAPI:
                 error_body("Internal", traceback.format_exc(limit=3)),
             )
 
-    def _verb(self, verb, body: bytes) -> tuple[int, str, str]:
+    def _verb(self, verb, body: bytes,
+              trace_ctx: str = "") -> tuple[int, str, str]:
         if (
             verb.name == "bind"
             and self.ha is not None
@@ -483,7 +502,7 @@ class SchedulerAPI:
                 RetryAfterSeconds=self.overload.retry_after_s,
             )
         try:
-            code, ctype, payload = self._verb_timed(verb, body)
+            code, ctype, payload = self._verb_timed(verb, body, trace_ctx)
             self.verb_bytes.inc(len(payload), verb=verb.name)
             return code, ctype, payload
         finally:
@@ -491,7 +510,8 @@ class SchedulerAPI:
             with self._inflight_lock:
                 self.inflight -= 1
 
-    def _verb_timed(self, verb, body: bytes) -> tuple[int, str, str]:
+    def _verb_timed(self, verb, body: bytes,
+                    trace_ctx: str = "") -> tuple[int, str, str]:
         started = time.perf_counter()
         code = 200
         trace = None
@@ -524,6 +544,12 @@ class SchedulerAPI:
                 if trace is not None:
                     set_current(trace)
                     trace.event("verb:recv", f"{verb.name} {len(body)}B")
+                    if trace_ctx:
+                        # the wire-carried upstream trail id
+                        # (X-Nanotpu-Trace): recorded, never trusted —
+                        # the story join keys on pod UID, this event
+                        # only names WHICH upstream trail drove us
+                        trace.event("ctx", trace_ctx)
             try:
                 # a huge body can burn the whole budget in the JSON parse;
                 # abort before any dealer work if so
@@ -578,6 +604,20 @@ class SchedulerAPI:
         finally:
             if trace is not None:
                 trace.event("verb:done", f"{verb.name}:{code}")
+                ha = self.ha
+                if ha is not None:
+                    # (role, epoch, seq) provenance against the delta
+                    # stream position: the leader stamps its log head,
+                    # a follower/standby the seq it has applied — the
+                    # coordinate /debug/story/<uid> uses to order
+                    # trails across processes. HA-less trails stay
+                    # unstamped, so single-replica trace bytes (and
+                    # every pinned sim digest) are unchanged.
+                    log_ = ha.log
+                    if log_ is not None and ha.role == "active":
+                        trace.stamp(ha.role, log_.epoch, log_.seq)
+                    else:
+                        trace.stamp(ha.role, ha.max_epoch, ha.applied_seq)
                 set_current(None)
                 self.obs.tracer.commit(trace)
             elapsed = time.perf_counter() - started
@@ -923,6 +963,72 @@ class SchedulerAPI:
         body["records"] = self.shadow.recent(limit)
         return 200, "application/json", json.dumps(body, sort_keys=True)
 
+    # -- fleet view (docs/observability.md "Fleet observability") ----------
+    def attach_fleet(self, view) -> None:
+        """Adopt a fleet aggregation view: serve ``GET /debug/fleet`` +
+        ``GET /debug/story/<uid>`` and register the ``nanotpu_fleet_*``
+        exporter. Replicas that poll no peers never call this and
+        change by nothing."""
+        from nanotpu.metrics.fleet import FleetExporter
+
+        self.fleet = view
+        self.registry.register(FleetExporter(view))
+
+    def _debug_fleet(self, path: str) -> tuple[int, str, str]:
+        """``GET /debug/fleet[?since=<fleet_tick>]``: the merged
+        multi-replica picture — per-replica role/lag/refusals/shadow
+        divergences, the aggregate fleet tick, and the durable-export
+        counters (docs/observability.md "Fleet observability").
+        ``since=`` returns only fleet ticks newer than the cursor, the
+        same delta contract as /debug/timeline. Admission-exempt like
+        every /debug route."""
+        if self.fleet is None:
+            return 404, "application/json", error_body(
+                "NotFound",
+                "no fleet view attached (the leader polls peers via "
+                "--ha-peers; docs/observability.md)",
+            )
+        _, _, query = path.partition("?")
+        params = dict(
+            kv.split("=", 1) for kv in query.split("&") if "=" in kv
+        )
+        body = self.fleet.fleet_status()
+        if "since" in params:
+            try:
+                since = int(params["since"])
+            except ValueError:
+                return 400, "application/json", error_body(
+                    "BadRequest", "since must be an integer"
+                )
+            body["ticks"] = self.fleet.since(since)
+        return 200, "application/json", json.dumps(body, sort_keys=True)
+
+    def _debug_story(self, path: str) -> tuple[int, str, str]:
+        """``GET /debug/story/<pod-uid>``: the pod's end-to-end
+        cross-process record — every replica's traces + ledger cycles
+        for the uid, merged and ordered by ``(epoch, seq, t)``
+        (docs/observability.md "Fleet observability").
+        Admission-exempt."""
+        if self.fleet is None:
+            return 404, "application/json", error_body(
+                "NotFound",
+                "no fleet view attached (the leader polls peers via "
+                "--ha-peers; docs/observability.md)",
+            )
+        uid = path[len("/debug/story/"):].partition("?")[0]
+        if not uid:
+            return 400, "application/json", error_body(
+                "BadRequest", "usage: /debug/story/<pod-uid>"
+            )
+        story = self.fleet.story(uid)
+        if not story["entries"]:
+            return 404, "application/json", error_body(
+                "NotFound",
+                f"no record of pod uid {uid} on any reachable replica "
+                f"(sampling {'off' if not self.obs.enabled else 'on'})",
+            )
+        return 200, "application/json", json.dumps(story, sort_keys=True)
+
     # -- readiness ---------------------------------------------------------
     def add_ready_check(self, name: str, fn) -> None:
         """Register a readiness gate; ``fn()`` truthy == ready. cmd/main
@@ -975,14 +1081,20 @@ class SchedulerAPI:
             )
         return 200, "application/json", json.dumps({
             "uid": uid,
+            # the serving replica's role: the FleetView story join
+            # labels this page's unstamped records with it
+            "role": self.ha.role if self.ha is not None else "single",
             "sampling": self.obs.tracer.sample,
             "traces": traces,
             "decisions": decisions,
         }, sort_keys=True)
 
     def _debug_decisions(self, path: str) -> tuple[int, str, str]:
-        """``GET /debug/decisions?limit=N``: newest finalized decision
-        records (default 50). Admission-exempt."""
+        """``GET /debug/decisions?limit=N[&uid=<pod-uid>]``: newest
+        finalized decision records (default 50); ``uid=`` narrows to
+        one pod's cycles oldest-first — the fleet story join's page
+        (docs/observability.md "Fleet observability").
+        Admission-exempt."""
         _, _, query = path.partition("?")
         params = dict(
             kv.split("=", 1) for kv in query.split("&") if "=" in kv
@@ -994,7 +1106,11 @@ class SchedulerAPI:
             return 400, "application/json", error_body(
                 "BadRequest", "limit must be an integer"
             )
-        records = self.obs.ledger.recent(limit)
+        uid = params.get("uid", "")
+        if uid:
+            records = self.obs.ledger.get(uid)[:limit]
+        else:
+            records = self.obs.ledger.recent(limit)
         shard_status = getattr(self.dealer, "shard_status", None)
         pipeline_status = getattr(self.dealer, "pipeline_status", None)
         recovery = getattr(self.dealer, "recovery", None)
@@ -1294,6 +1410,7 @@ class _Handler(socketserver.StreamRequestHandler):
             length = 0
             keep_alive = version == "HTTP/1.1"
             chunked = False
+            trace_ctx = ""
             n_headers = 0
             while True:
                 h = self.rfile.readline(8192)
@@ -1324,6 +1441,12 @@ class _Handler(socketserver.StreamRequestHandler):
                     keep_alive = v.strip().lower() != b"close"
                 elif k == b"transfer-encoding":
                     chunked = v.strip().lower() != b"identity"
+                elif k == b"x-nanotpu-trace":
+                    # cross-process trace context (docs/observability.md
+                    # "Fleet observability"): an opaque upstream trail
+                    # id, capped so a hostile header cannot bloat the
+                    # trace ring
+                    trace_ctx = v.strip().decode("latin-1")[:128]
             if chunked:
                 # chunk framing is not implemented; silently dispatching an
                 # empty body would desync the connection on the chunk bytes
@@ -1338,7 +1461,14 @@ class _Handler(socketserver.StreamRequestHandler):
                                        "invalid Content-Length"), False)
                 return
             body = self.rfile.read(length) if length else b""
-            code, ctype, payload = self.api.dispatch(method, path, body)
+            if trace_ctx:
+                # kwarg only when the header arrived: bare three-arg
+                # dispatch() fakes (tests, older APIs) stay callable
+                code, ctype, payload = self.api.dispatch(
+                    method, path, body, trace_ctx=trace_ctx
+                )
+            else:
+                code, ctype, payload = self.api.dispatch(method, path, body)
             if isinstance(payload, (str, bytes)):
                 self._write(code, ctype, payload, keep_alive)
             else:

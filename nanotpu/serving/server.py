@@ -70,7 +70,11 @@ class ServingAPI:
             lambda: engine.moe_prefill_dropped_total
         )
 
-    def dispatch(self, method: str, path: str, body: bytes) -> tuple[int, str, str]:
+    def dispatch(self, method: str, path: str, body: bytes,
+                 trace_ctx: str = "") -> tuple[int, str, str]:
+        # trace_ctx (the X-Nanotpu-Trace header) is accepted for handler
+        # parity with SchedulerAPI and ignored: serving requests are not
+        # part of the scheduler's cross-process story
         try:
             if method == "POST" and path == "/v1/generate":
                 return self._generate(body)

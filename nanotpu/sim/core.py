@@ -246,6 +246,27 @@ class Simulator:
             )
         else:
             self.timeline = self.watchdog = self.flight = None
+        # durable decision export (docs/observability.md "Decision
+        # export format"): sampled finalized cycles + timeline ticks
+        # framed as crc-checked canonical JSONL, on the VIRTUAL clock —
+        # the exporter is clock-free (records carry their own t), so the
+        # stream sha256 joins the determinism contract. Sink-less by
+        # default (path "") so the digest pin needs no filesystem. None
+        # when disabled; the ledger/timeline attach points are plain
+        # attribute stores, so default-path digests are byte-identical.
+        exp = self.scenario["export"]
+        if exp["enabled"]:
+            from nanotpu.obs.export import DecisionExporter
+
+            self.exporter = DecisionExporter(
+                path=exp["path"], sample=exp["sample"],
+                max_bytes=exp["max_bytes"],
+            )
+            self.obs.ledger.exporter = self.exporter
+            if self.timeline is not None:
+                self.timeline.exporter = self.exporter
+        else:
+            self.exporter = None
         # scheduler<->serving loop (docs/serving-loop.md): a virtual
         # replica fleet served on the diurnal trace, with the REAL
         # autoscaler deciding fleet size and the REAL serving tap
@@ -2066,6 +2087,17 @@ class Simulator:
                 f"telemetry ticks={self.timeline.latest_tick} "
                 f"breaches={sum(breaches.values())} "
                 f"bundles={self.flight.bundles}",
+            )
+        if self.exporter is not None:
+            # deterministic export section: records are framed only on
+            # the sim thread with virtual-time payloads, so the stream
+            # sha256 is byte-reproducible and joins --check-determinism
+            status = self.exporter.status()
+            self.report.export = status
+            self.report.journal(
+                horizon,
+                f"export records={status['records']} "
+                f"bytes={status['bytes']} digest={status['digest']}",
             )
         if self.plane is not None:
             # deterministic recovery section: counters are bumped only on

@@ -87,6 +87,12 @@ class ReportBuilder:
         #: docs/policy-programs.md); empty == no shadow candidate, same
         #: opt-in digest rule as the sections above
         self.shadow: dict = {}
+        #: durable decision-export summary (records, bytes, rotations,
+        #: stream sha256 — docs/observability.md "Decision export
+        #: format"); empty == export disabled, same opt-in digest rule.
+        #: The stream digest inside joins --check-determinism: two runs
+        #: of the same (scenario, seed) must frame identical bytes.
+        self.export: dict = {}
         self.restart_occupancy_drift = 0.0
         self.final_occupancy = 0.0
         self.final_fragmentation = 0.0
@@ -199,6 +205,11 @@ class ReportBuilder:
             # same opt-in rule (docs/policy-programs.md)
             report["shadow"] = {
                 k: self.shadow[k] for k in sorted(self.shadow)
+            }
+        if self.export:
+            # same opt-in rule (docs/observability.md)
+            report["export"] = {
+                k: self.export[k] for k in sorted(self.export)
             }
         if include_timing:
             report["timing"] = {

@@ -175,6 +175,16 @@ defensively. Schema (see docs/simulation.md for the full field reference)::
         "slo": []                    # SLO objectives (same schema as
                                      # policy.yaml's slo: section)
       },
+      "export": {                    # durable decision-record export
+                                     # (docs/observability.md "Decision
+                                     # export format"); absent/disabled
+                                     # keeps every existing digest
+                                     # byte-identical
+        "enabled": false,
+        "path": "",                  # "" = sink-less (counters + digest)
+        "sample": 1,                 # sticky 1-in-N per pod uid (0 off)
+        "max_bytes": 8388608         # segment bound before rotation
+      },
       "batch": {                     # joint batch admission
                                      # (docs/batch-admission.md); absent/
                                      # disabled keeps every existing
@@ -436,6 +446,25 @@ def normalize_scenario(raw: dict) -> dict:
         "telemetry.capacity and telemetry.flight_ticks must be > 0",
     )
 
+    exp = dict(raw.get("export") or {})
+    export = {
+        # durable decision-record export (docs/observability.md
+        # "Decision export format"): the exporter runs sink-less by
+        # default (path "" = counters + digest only) so
+        # --check-determinism certifies the stream bytes with no
+        # tmp-file plumbing; a path writes the crc-framed JSONL file
+        "enabled": bool(exp.get("enabled", False)),
+        "path": str(exp.get("path", "")),
+        "sample": int(exp.get("sample", 1)),
+        "max_bytes": int(exp.get("max_bytes", 8 * 1024 * 1024)),
+    }
+    _require(
+        export["sample"] >= 0, "export.sample must be >= 0",
+    )
+    _require(
+        export["max_bytes"] > 0, "export.max_bytes must be > 0",
+    )
+
     bat = dict(raw.get("batch") or {})
     batch = {
         "enabled": bool(bat.get("enabled", False)),
@@ -690,6 +719,7 @@ def normalize_scenario(raw: dict) -> dict:
         "ha": ha,
         "recovery": recovery,
         "telemetry": telemetry,
+        "export": export,
         "serving": serving,
         "metric_from_allocation": bool(
             raw.get("metric_from_allocation", False)

@@ -1231,6 +1231,40 @@ class TestMetricsCompleteness:
         assert any("dead_ha_gauge" in m and "KeyError" in m
                    for m in msgs), msgs
 
+    # -- fleet gauge family (nanotpu/metrics/fleet.py) ---------------------
+    def test_fleet_gauge_produced_but_undeclared(self, tmp_path):
+        # ISSUE 20 satellite: the nanotpu_fleet_* table <-> producer
+        # held both directions, same structural check as the others
+        report = lint(tmp_path, {
+            "fleet.py": """
+                _FLEET_GAUGES = {"peers": "n"}
+
+                class FleetView:
+                    def fleet_gauge_values(self):
+                        return {"peers": 2, "ghost_fleet_gauge": 1}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("ghost_fleet_gauge" in m and "not declared" in m
+                   for m in msgs), msgs
+
+    def test_fleet_gauge_declared_but_never_produced(self, tmp_path):
+        report = lint(tmp_path, {
+            "fleet.py": """
+                _FLEET_GAUGES = {
+                    "peers": "n",
+                    "dead_fleet_gauge": "declared but never produced",
+                }
+
+                class FleetView:
+                    def fleet_gauge_values(self):
+                        return {"peers": 2}
+                """,
+        }, ["metrics-completeness"])
+        msgs = [f.message for f in report.findings]
+        assert any("dead_fleet_gauge" in m and "KeyError" in m
+                   for m in msgs), msgs
+
     def test_gauge_families_do_not_cross_pollinate(self, tmp_path):
         # distinct producer names per family: a timeline tick gauge must
         # not be held against the throughput/SLO tables (and vice versa)
@@ -1241,6 +1275,7 @@ class TestMetricsCompleteness:
                 _SLO_GAUGES = {"objectives": "n"}
                 _SERVING_GAUGES = {"tok_s": "decode rate"}
                 _HA_GAUGES = {"role": "active/standby"}
+                _FLEET_GAUGES = {"peers": "n"}
                 """,
             "producers.py": """
                 class Model:
@@ -1262,6 +1297,10 @@ class TestMetricsCompleteness:
                 class HACoordinator:
                     def ha_gauge_values(self, now=None):
                         return {"role": 1.0}
+
+                class FleetView:
+                    def fleet_gauge_values(self):
+                        return {"peers": 2}
                 """,
         }, ["metrics-completeness"])
         assert not any("gauge" in f.message for f in report.findings), \

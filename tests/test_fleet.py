@@ -1,0 +1,740 @@
+"""Fleet-scoped observability (docs/observability.md "Fleet
+observability" + "Decision export format"): the durable decision-record
+export's crc framing / rotation / sticky sampling, the cross-process
+sampling contract (same uid => same verdict on independent instances),
+trace provenance stamps and the X-Nanotpu-Trace wire contract, the
+follower's delta-apply trail closer, the FleetView aggregation plane
+(peer merge, delta cursors, the /debug/fleet + /debug/story/<uid>
+routes, the pinned nanotpu_fleet_* gauge producer), and the LIVE
+two-process acceptance drive: a pod's complete cross-process story —
+follower-served Filter/Prioritize, leader Bind, recovery-plane
+migration — joined over real HTTP and ordered by ``(epoch, seq, t)``.
+"""
+
+import json
+import os
+import time
+from zlib import crc32
+
+import pytest
+
+from nanotpu import types
+from nanotpu.allocator.rater import make_rater
+from nanotpu.cmd.main import make_mock_cluster
+from nanotpu.controller.controller import Controller
+from nanotpu.dealer import Dealer
+from nanotpu.ha import DeltaLog, HACoordinator
+from nanotpu.ha.standby import HttpDeltaSource
+from nanotpu.k8s.objects import make_container, make_pod
+from nanotpu.metrics.fleet import _FLEET_GAUGES, FleetExporter
+from nanotpu.metrics.registry import Registry
+from nanotpu.obs import Observability
+from nanotpu.obs.export import (
+    DecisionExporter,
+    export_digest,
+    read_export,
+)
+from nanotpu.obs.fleet import FleetLoop, FleetView
+from nanotpu.obs.timeline import Timeline
+from nanotpu.obs.trace import Tracer
+from nanotpu.routes.server import DEBUG_ROUTES, SchedulerAPI
+
+
+def _stack(n_hosts=2, sample=1):
+    client = make_mock_cluster(n_hosts)
+    dealer = Dealer(client, make_rater(types.POLICY_BINPACK))
+    api = SchedulerAPI(
+        dealer, Registry(), obs=Observability(sample=sample)
+    )
+    return client, dealer, api
+
+
+def _schedule_one(client, api, name="job-0", percent=200,
+                  n_hosts=2, trace_ctx=""):
+    pod = make_pod(
+        name,
+        containers=[make_container(
+            "main", {types.RESOURCE_TPU_PERCENT: percent}
+        )],
+    )
+    client.create_pod(pod)
+    server_pod = client.get_pod("default", name)
+    args = json.dumps({
+        "Pod": server_pod.raw,
+        "NodeNames": [f"v5p-host-{i}" for i in range(n_hosts)],
+    }).encode()
+    kw = {"trace_ctx": trace_ctx} if trace_ctx else {}
+    code, _, filt = api.dispatch("POST", "/scheduler/filter", args, **kw)
+    assert code == 200, filt
+    api.dispatch("POST", "/scheduler/priorities", args, **kw)
+    best = json.loads(filt)["NodeNames"][0]
+    code, _, bound = api.dispatch("POST", "/scheduler/bind", json.dumps({
+        "PodName": name, "PodNamespace": "default",
+        "PodUID": server_pod.uid, "Node": best,
+    }).encode())
+    assert code == 200 and json.loads(bound)["Error"] == "", bound
+    return server_pod.uid
+
+
+# ---------------------------------------------------------------------------
+# durable decision-record export
+# ---------------------------------------------------------------------------
+class TestDecisionExporter:
+    def test_framed_lines_round_trip(self, tmp_path):
+        path = str(tmp_path / "export.jsonl")
+        exp = DecisionExporter(path=path, sample=1)
+        exp.cycle({"uid": "u-1", "outcome": "bound", "t0": 1.0})
+        exp.tick({"tick": 1, "t": 2.0})
+        exp.close()
+        recs = read_export(path)
+        assert [r["kind"] for r in recs] == ["cycle", "tick"]
+        assert recs[0]["record"]["uid"] == "u-1"
+        status = exp.status()
+        assert status["records"] == 2 and status["drops"] == 0
+        assert status["digest"].startswith("sha256:")
+        # the status digest certifies exactly the bytes on disk (no
+        # rotation yet): the independent file-side reframe agrees
+        assert export_digest(path) == status["digest"]
+        assert os.path.getsize(path) == status["bytes"]
+
+    def test_corrupt_line_skipped_not_poisoning(self, tmp_path):
+        path = str(tmp_path / "export.jsonl")
+        exp = DecisionExporter(path=path, sample=1)
+        for i in range(3):
+            exp.cycle({"uid": f"u-{i}", "t0": float(i)})
+        exp.close()
+        lines = open(path, "rb").read().splitlines()
+        assert len(lines) == 3
+        lines[1] = lines[1][:-1] + (b"0" if lines[1][-1:] != b"0" else b"1")
+        with open(path, "wb") as fh:
+            fh.write(b"\n".join(lines) + b"\n")
+        recs = read_export(path)
+        assert [r["record"]["uid"] for r in recs] == ["u-0", "u-2"]
+        # the reframed digest covers only verified lines
+        assert export_digest(path).startswith("sha256:")
+
+    def test_rotation_bounds_disk_to_two_segments(self, tmp_path):
+        path = str(tmp_path / "export.jsonl")
+        # every record overflows a 1-byte segment: one rotation per emit
+        exp = DecisionExporter(path=path, sample=1, max_bytes=1)
+        for i in range(3):
+            exp.cycle({"uid": f"u-{i}", "t0": float(i)})
+        exp.close()
+        assert exp.rotations == 3
+        # the live segment rotated away on the last emit; only the .1
+        # rotation survives — two names bound the disk, always
+        assert not os.path.exists(path)
+        assert os.path.exists(path + ".1")
+        assert [r["record"]["uid"] for r in read_export(path + ".1")] == [
+            "u-2"
+        ]
+        # lifetime counters are monotonic ACROSS rotations
+        status = exp.status()
+        assert status["records"] == 3
+        assert status["bytes"] > os.path.getsize(path + ".1")
+
+    def test_digest_is_stream_reproducible_sinkless(self, tmp_path):
+        records = [{"uid": f"u-{i}", "t0": float(i)} for i in range(4)]
+        sinkless_a = DecisionExporter(path="", sample=1)
+        sinkless_b = DecisionExporter(path="", sample=1)
+        path = str(tmp_path / "export.jsonl")
+        sunk = DecisionExporter(path=path, sample=1)
+        for exp in (sinkless_a, sinkless_b, sunk):
+            for rec in records:
+                exp.cycle(rec)
+            exp.tick({"tick": 1})
+        sunk.close()
+        assert sinkless_a.digest() == sinkless_b.digest() == sunk.digest()
+        assert export_digest(path) == sinkless_a.digest()
+        # sink-less exporters still count and rotate nothing on disk
+        assert sinkless_a.status()["bytes"] == sunk.status()["bytes"]
+
+    def test_max_bytes_validated(self):
+        with pytest.raises(ValueError):
+            DecisionExporter(max_bytes=0)
+
+    def test_ledger_exports_finalized_cycles(self):
+        client, dealer, api = _stack(sample=1)
+        exp = DecisionExporter(path="", sample=1)
+        api.obs.ledger.exporter = exp
+        uid = _schedule_one(client, api)
+        assert exp.records >= 1
+        assert api.obs.ledger.get(uid)  # ring copy unchanged
+        dealer.close()
+
+    def test_ledger_respects_sticky_export_verdict(self):
+        client, dealer, api = _stack(sample=1)
+        exp = DecisionExporter(path="", sample=0)  # off: nothing exports
+        api.obs.ledger.exporter = exp
+        _schedule_one(client, api)
+        assert exp.records == 0
+        dealer.close()
+
+    def test_timeline_ticks_export(self):
+        client, dealer, api = _stack(sample=0)
+        tl = Timeline(dealer=dealer, clock=lambda: 5.0)
+        exp = DecisionExporter(path="", sample=1)
+        tl.exporter = exp
+        tl.tick()
+        assert exp.records == 1
+        assert "tick" in exp.digest() or exp.digest().startswith("sha256:")
+        dealer.close()
+
+
+class TestStickySamplingContract:
+    def test_same_uid_same_verdict_across_instances(self):
+        """The cross-process sampling contract: two independent tracers
+        (two processes) and the exporter all compute the same sticky
+        crc32 verdict per pod uid — a sampled pod's records exist on
+        EVERY replica that touched it, or on none."""
+        tracer_a = Tracer(sample=7)
+        tracer_b = Tracer(sample=7)
+        exporter = DecisionExporter(path="", sample=7)
+        uids = [f"pod-uid-{i}" for i in range(64)]
+        verdicts = [tracer_a.sampled(u) for u in uids]
+        assert verdicts == [tracer_b.sampled(u) for u in uids]
+        assert verdicts == [exporter.sampled(u) for u in uids]
+        assert verdicts == [crc32(u.encode()) % 7 == 0 for u in uids]
+        assert any(verdicts) and not all(verdicts)
+
+    def test_edge_rates(self):
+        assert not Tracer(sample=0).enabled
+        assert DecisionExporter(path="", sample=0).sampled("u") is False
+        assert DecisionExporter(path="", sample=1).sampled("u") is True
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace propagation
+# ---------------------------------------------------------------------------
+class TestTraceProvenance:
+    def test_ha_less_traces_stay_unstamped(self):
+        client, dealer, api = _stack(sample=1)
+        uid = _schedule_one(client, api)
+        traces = api.obs.tracer.get(uid)
+        assert traces
+        assert all("origin" not in t for t in traces)
+        dealer.close()
+
+    def test_leader_stamps_log_head(self):
+        client = make_mock_cluster(2)
+        log_ = DeltaLog()
+        log_.epoch = 3
+        dealer = Dealer(client, make_rater(types.POLICY_BINPACK),
+                        ha_log=log_)
+        api = SchedulerAPI(
+            dealer, Registry(), obs=Observability(sample=1)
+        )
+        api.attach_ha(HACoordinator(dealer, role="active", log_=log_))
+        uid = _schedule_one(client, api)
+        traces = api.obs.tracer.get(uid)
+        assert traces
+        for tr in traces:
+            assert tr["origin"]["role"] == "active"
+            assert tr["origin"]["epoch"] == 3
+        bind = [t for t in traces if t["verb"] == "bind"][-1]
+        assert bind["origin"]["seq"] >= 1  # the bound delta landed
+        dealer.close()
+
+    def test_wire_trace_ctx_recorded_as_event(self):
+        client, dealer, api = _stack(sample=1)
+        uid = _schedule_one(client, api, trace_ctx="follower:rep-b t9")
+        traces = api.obs.tracer.get(uid)
+        filt = [t for t in traces if t["verb"] == "filter"][0]
+        events = [(kind, detail) for _, kind, detail in filt["events"]]
+        assert ("ctx", "follower:rep-b t9") in events
+        dealer.close()
+
+    def test_no_ctx_event_without_header(self):
+        client, dealer, api = _stack(sample=1)
+        uid = _schedule_one(client, api)
+        for tr in api.obs.tracer.get(uid):
+            assert all(kind != "ctx" for _, kind, _ in tr["events"])
+        dealer.close()
+
+
+class _Resp:
+    def __init__(self, body):
+        self._body = json.dumps(body).encode()
+
+    def read(self):
+        return self._body
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class TestDeltaSourceTraceHeader:
+    def test_tail_poll_carries_trace_header(self, monkeypatch):
+        seen = {}
+
+        def fake_urlopen(req, timeout=None):
+            seen["headers"] = {
+                k.lower(): v for k, v in req.header_items()
+            }
+            return _Resp({"records": [], "stale_tail": False})
+
+        monkeypatch.setattr("urllib.request.urlopen", fake_urlopen)
+        src = HttpDeltaSource("http://leader:10250",
+                              trace_context="follower:rep-b")
+        src.poll(0)
+        assert seen["headers"]["x-nanotpu-trace"] == "follower:rep-b"
+
+    def test_empty_context_omits_header(self, monkeypatch):
+        seen = {}
+
+        def fake_urlopen(req, timeout=None):
+            seen["headers"] = {
+                k.lower(): v for k, v in req.header_items()
+            }
+            return _Resp({"records": [], "stale_tail": False})
+
+        monkeypatch.setattr("urllib.request.urlopen", fake_urlopen)
+        HttpDeltaSource("http://leader:10250").poll(0)
+        assert "x-nanotpu-trace" not in seen["headers"]
+
+
+class TestTrailClose:
+    def _pair(self, sample=1):
+        client = make_mock_cluster(2)
+        log_ = DeltaLog()
+        log_.epoch = 2
+        ld = Dealer(client, make_rater(types.POLICY_BINPACK), ha_log=log_)
+        leader = SchedulerAPI(ld, Registry())
+        leader.attach_ha(HACoordinator(ld, role="active", log_=log_))
+        fd = Dealer(client, make_rater(types.POLICY_BINPACK))
+        fc = Controller(client, fd, resync_period_s=0, assume_ttl_s=0)
+        fc.enter_standby()
+        fc.resync_once()
+        co = HACoordinator(fd, role="follower", source=log_,
+                           controller=fc)
+        co.obs = Observability(sample=sample)
+        return client, log_, ld, leader, fd, co
+
+    def _bind_one(self, client, leader, name="trail-0"):
+        pod = make_pod(
+            name,
+            containers=[make_container(
+                "main", {types.RESOURCE_TPU_PERCENT: 200}
+            )],
+        )
+        client.create_pod(pod)
+        server_pod = client.get_pod("default", name)
+        code, _, out = leader.dispatch("POST", "/scheduler/bind",
+                                       json.dumps({
+                                           "PodName": name,
+                                           "PodNamespace": "default",
+                                           "PodUID": server_pod.uid,
+                                           "Node": "v5p-host-0",
+                                       }).encode())
+        assert code == 200 and json.loads(out)["Error"] == "", out
+        return server_pod.uid
+
+    def test_follower_closes_trail_on_bound_and_released(self):
+        client, log_, ld, leader, fd, co = self._pair()
+        uid = self._bind_one(client, leader)
+        assert co.tail_once() >= 1
+        trails = co.obs.tracer.get(uid)
+        assert [t["verb"] for t in trails] == ["ha:bound"]
+        trail = trails[0]
+        assert trail["origin"]["role"] == "follower"
+        assert trail["origin"]["epoch"] == 2
+        assert trail["origin"]["seq"] >= 1
+        kinds = [kind for _, kind, _ in trail["events"]]
+        assert "delta:applied" in kinds
+        # the leader releases: the follower's trail records that too
+        log_.emit("released", {"uid": uid, "namespace": "default",
+                               "name": "trail-0"})
+        co.tail_once()
+        verbs = [t["verb"] for t in co.obs.tracer.get(uid)]
+        assert verbs == ["ha:bound", "ha:released"]
+        ld.close()
+        fd.close()
+
+    def test_sampling_off_closes_nothing(self):
+        client, log_, ld, leader, fd, co = self._pair(sample=0)
+        uid = self._bind_one(client, leader)
+        assert co.tail_once() >= 1  # the delta still applies
+        assert co.obs.tracer.get(uid) == []
+        ld.close()
+        fd.close()
+
+
+# ---------------------------------------------------------------------------
+# the fleet aggregation plane
+# ---------------------------------------------------------------------------
+def _follower_ha_page(lag=3, refused=2, epoch=5, synced=True):
+    return {
+        "role": "follower", "lag_events": lag,
+        "follower": {"synced": synced, "reads_refused": refused},
+        "fence": {"epoch": epoch},
+    }
+
+
+class _PeerFetch:
+    """Canned per-peer debug pages; records every (base, path) asked."""
+
+    def __init__(self, pages):
+        self.pages = pages
+        self.calls = []
+
+    def __call__(self, base, path):
+        self.calls.append((base, path))
+        for prefix, body in (self.pages.get(base) or {}).items():
+            if path.startswith(prefix):
+                return body
+        return None
+
+
+class TestFleetView:
+    def test_poll_merges_local_and_peers(self):
+        fetch = _PeerFetch({
+            "http://peer-0:10250": {
+                "/debug/ha": _follower_ha_page(lag=5, refused=2, epoch=3),
+                "/debug/timeline": {"latest": 7, "count": 2},
+                "/debug/shadow": {"divergences": 4},
+            },
+            # peer-1 entirely unreachable
+        })
+        view = FleetView(
+            ["http://peer-0:10250", "http://peer-1:10250"],
+            fetch=fetch, clock=lambda: 1.0,
+        )
+        tick = view.poll_once()
+        assert tick["fleet_tick"] == 1 and tick["t"] == 1.0
+        assert tick["peers"] == 2
+        assert tick["peers_reachable"] == 1
+        # local HA-less row counts as synced, plus the synced follower
+        assert tick["peers_synced"] == 2
+        assert tick["lag_events_max"] == 5
+        assert tick["lag_events_sum"] == 5
+        assert tick["reads_refused_total"] == 2
+        assert tick["shadow_divergences_total"] == 4
+        assert len(tick["replicas"]) == 3
+        assert "export" not in tick  # present only when wired
+        assert view.fetch_errors == 1
+        local = tick["replicas"][0]
+        assert local["source"] == "local" and local["role"] == "single"
+        peer = tick["replicas"][1]
+        assert peer["epoch"] == 3 and peer["ticks_new"] == 2
+
+    def test_timeline_cursor_advances_per_peer(self):
+        fetch = _PeerFetch({
+            "http://peer-0:10250": {
+                "/debug/ha": _follower_ha_page(),
+                "/debug/timeline": {"latest": 7, "count": 2},
+            },
+        })
+        view = FleetView(["http://peer-0:10250"], fetch=fetch,
+                         clock=lambda: 0.0)
+        view.poll_once()
+        view.poll_once()
+        tl_calls = [p for _, p in fetch.calls
+                    if p.startswith("/debug/timeline")]
+        assert tl_calls == ["/debug/timeline?since=0",
+                            "/debug/timeline?since=7"]
+
+    def test_ring_capacity_and_since_cursor(self):
+        view = FleetView([], capacity=2, clock=lambda: 0.0)
+        for _ in range(3):
+            view.poll_once()
+        assert view.polls == 3
+        assert [t["fleet_tick"] for t in view.since(0)] == [2, 3]
+        assert view.latest()["fleet_tick"] == 3
+        assert view.since(3) == []
+
+    def test_export_block_present_only_when_wired(self):
+        exp = DecisionExporter(path="", sample=1)
+        view = FleetView([], exporter=exp, clock=lambda: 0.0)
+        tick = view.poll_once()
+        assert tick["export"]["records"] == 0
+        assert view.fleet_status()["export"]["sample"] == 1
+
+    def test_story_merges_and_orders_across_processes(self):
+        obs = Observability(sample=1, clock=lambda: 1.5)
+        tr = obs.tracer.begin("bind", "pod-x")
+        tr.stamp("active", 2, 9)
+        obs.tracer.commit(tr)
+        obs.ledger.bind_outcome("pod-x", "v5p-host-0", "bound", True,
+                                pod="default/x", final=True)
+        fetch = _PeerFetch({
+            "http://peer-0:10250": {
+                "/debug/traces/": {
+                    "role": "follower",
+                    "traces": [{
+                        "uid": "pod-x", "trace_id": "t1",
+                        "verb": "filter", "t0": 0.5,
+                        "events": [[0.5, "verb:recv", "filter 10B"]],
+                        "origin": {"role": "follower", "epoch": 1,
+                                   "seq": 4},
+                    }],
+                    "decisions": [],
+                },
+            },
+        })
+        view = FleetView(["http://peer-0:10250"], obs=obs, fetch=fetch,
+                         clock=lambda: 2.0)
+        story = view.story("pod-x")
+        assert story["uid"] == "pod-x" and story["count"] == 3
+        keyed = [(e["epoch"], e["seq"], e["kind"])
+                 for e in story["entries"]]
+        # unstamped ledger cycle at stream origin, then the follower's
+        # filter trail, then the leader's bind — (epoch, seq, t) order
+        assert keyed == [(0, 0, "decision"), (1, 4, "trace"),
+                         (2, 9, "trace")]
+        assert story["entries"][1]["source"] == "http://peer-0:10250"
+        assert story["entries"][1]["role"] == "follower"
+        assert view.stories_served == 1
+
+    def test_story_unknown_uid_is_empty(self):
+        view = FleetView([], obs=Observability(sample=1))
+        assert view.story("nope")["count"] == 0
+
+    def test_gauge_table_matches_producer_both_directions(self):
+        view = FleetView(["http://peer-0:10250"],
+                         exporter=DecisionExporter(path="", sample=1))
+        assert set(view.fleet_gauge_values()) == set(_FLEET_GAUGES)
+
+    def test_fleet_exporter_renders_every_gauge(self):
+        view = FleetView([], clock=lambda: 0.0)
+        view.poll_once()
+        body = "\n".join(FleetExporter(view).render())
+        for suffix in _FLEET_GAUGES:
+            assert f"nanotpu_fleet_{suffix} " in body
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            FleetView([], capacity=0)
+        with pytest.raises(ValueError):
+            FleetLoop(FleetView([]), period_s=0)
+
+    def test_loop_polls_on_cadence(self):
+        view = FleetView([], clock=lambda: 0.0)
+        loop = FleetLoop(view, period_s=0.005)
+        loop.start()
+        deadline = time.monotonic() + 2.0
+        while view.polls == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        loop.stop()
+        assert view.polls >= 1
+
+
+class TestDebugFleetRoutes:
+    def test_routes_join_debug_table(self):
+        assert "/debug/fleet" in DEBUG_ROUTES
+        assert "/debug/story/" in DEBUG_ROUTES
+
+    def test_unattached_404_names_the_flag(self):
+        client, dealer, api = _stack(sample=0)
+        code, _, body = api.dispatch("GET", "/debug/fleet", b"")
+        assert code == 404 and "--ha-peers" in body
+        code, _, body = api.dispatch("GET", "/debug/story/some-uid", b"")
+        assert code == 404 and "--ha-peers" in body
+        dealer.close()
+
+    def test_fleet_body_since_and_metrics_registration(self):
+        client, dealer, api = _stack(sample=0)
+        view = FleetView([], obs=api.obs, clock=lambda: 0.0)
+        api.attach_fleet(view)
+        view.poll_once()
+        view.poll_once()
+        code, _, body = api.dispatch("GET", "/debug/fleet", b"")
+        assert code == 200
+        out = json.loads(body)
+        assert out["polls"] == 2 and out["latest"]["fleet_tick"] == 2
+        assert "ticks" not in out
+        code, _, body = api.dispatch("GET", "/debug/fleet?since=1", b"")
+        assert [t["fleet_tick"] for t in json.loads(body)["ticks"]] == [2]
+        code, _, body = api.dispatch("GET", "/debug/fleet?since=x", b"")
+        assert code == 400
+        # attach_fleet registered the nanotpu_fleet_* exposition
+        code, _, metrics = api.dispatch("GET", "/metrics", b"")
+        assert code == 200 and "nanotpu_fleet_peers" in metrics
+        dealer.close()
+
+    def test_story_route(self):
+        client, dealer, api = _stack(sample=1)
+        uid = _schedule_one(client, api)
+        api.attach_fleet(FleetView([], obs=api.obs))
+        code, _, body = api.dispatch("GET", f"/debug/story/{uid}", b"")
+        assert code == 200
+        story = json.loads(body)
+        assert story["uid"] == uid and story["count"] >= 3
+        keys = [(e["epoch"], e["seq"], e["t"]) for e in story["entries"]]
+        assert keys == sorted(keys)
+        code, _, _ = api.dispatch("GET", "/debug/story/", b"")
+        assert code == 400
+        code, _, _ = api.dispatch("GET", "/debug/story/unknown-uid", b"")
+        assert code == 404
+        dealer.close()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drive: a pod's cross-process story over live HTTP
+# ---------------------------------------------------------------------------
+@pytest.mark.fullstack
+class TestLiveFleetStory:
+    """Two replica stacks over real HTTP: the follower serves
+    Filter/Prioritize (stamping the kube-side X-Nanotpu-Trace context),
+    the leader commits Bind, the follower's delta tail closes the
+    trail, and the leader's FleetView joins the whole causal record at
+    ``GET /debug/story/<uid>`` — then a recovery-plane migration
+    appends to the same story."""
+
+    def test_story_spans_processes_and_migration(self):
+        from http.client import HTTPConnection
+
+        from nanotpu.obs.fleet import FleetView
+        from nanotpu.recovery.plane import RecoveryPlane
+        from nanotpu.routes.server import serve
+
+        def _req(port, method, path, obj=None, headers=None):
+            conn = HTTPConnection("127.0.0.1", port, timeout=10)
+            body = json.dumps(obj).encode() if obj is not None else None
+            hdrs = {"Content-Type": "application/json"}
+            hdrs.update(headers or {})
+            conn.request(method, path, body, hdrs)
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            conn.close()
+            return resp.status, out
+
+        client = make_mock_cluster(4)
+
+        # the leader: active coordinator emitting the delta stream
+        log_a = DeltaLog()
+        log_a.epoch = 1
+        dealer_a = Dealer(client, make_rater(types.POLICY_BINPACK),
+                          ha_log=log_a)
+        co_a = HACoordinator(dealer_a, role="active", log_=log_a)
+        obs_a = Observability(sample=1)
+        api_a = SchedulerAPI(dealer_a, Registry(), obs=obs_a)
+        api_a.attach_ha(co_a)
+        co_a.obs = obs_a
+        srv_a = serve(api_a, 0, host="127.0.0.1")
+        api_a.stop_idle_gc()
+        port_a = srv_a.server_address[1]
+
+        # the follower: tails the leader over HTTP, serves reads
+        dealer_b = Dealer(client, make_rater(types.POLICY_BINPACK))
+        sc_b = Controller(client, dealer_b, resync_period_s=0,
+                          assume_ttl_s=60)
+        sc_b.enter_standby()
+        sc_b.resync_once()
+        co_b = HACoordinator(
+            dealer_b, role="follower",
+            source=HttpDeltaSource(f"http://127.0.0.1:{port_a}",
+                                   trace_context="follower:rep-b"),
+            controller=sc_b,
+        )
+        obs_b = Observability(sample=1)
+        api_b = SchedulerAPI(dealer_b, Registry(), obs=obs_b)
+        api_b.attach_ha(co_b)
+        co_b.obs = obs_b
+        srv_b = serve(api_b, 0, host="127.0.0.1")
+        api_b.stop_idle_gc()
+        port_b = srv_b.server_address[1]
+
+        try:
+            # anchor the cross-process tail first: HttpDeltaSource
+            # anchors at the active's CURRENT seq on first contact, so
+            # only records emitted after this point replay
+            log_a.emit("view", {"names": []})
+            assert co_b.tail_once() == 0  # anchor poll
+            pod = make_pod(
+                "story-0",
+                containers=[make_container(
+                    "main", {types.RESOURCE_TPU_PERCENT: 200}
+                )],
+            )
+            client.create_pod(pod)
+            server_pod = client.get_pod("default", "story-0")
+            uid = server_pod.uid
+            args = {
+                "Pod": server_pod.raw,
+                "NodeNames": dealer_a.node_names(),
+            }
+            ctx = "kube-scheduler:cycle-41"
+
+            # 1) read plane: the FOLLOWER serves Filter + Prioritize,
+            #    recording the upstream wire context
+            code, out = _req(port_b, "POST", "/scheduler/filter", args,
+                             headers={"X-Nanotpu-Trace": ctx})
+            assert code == 200, out
+            best = out["NodeNames"][0]
+            code, _ = _req(port_b, "POST", "/scheduler/priorities", args,
+                           headers={"X-Nanotpu-Trace": ctx})
+            assert code == 200
+
+            # 2) write plane: the LEADER commits the bind
+            code, out = _req(port_a, "POST", "/scheduler/bind", {
+                "PodName": "story-0", "PodNamespace": "default",
+                "PodUID": uid, "Node": best,
+            })
+            assert code == 200 and out["Error"] == "", out
+
+            # 3) the follower's tail applies the bound delta over HTTP
+            #    and closes the pod's trail on its side
+            assert co_b.tail_once() >= 1
+            assert [t["verb"] for t in obs_b.tracer.get(uid)][-1] == (
+                "ha:bound"
+            )
+
+            # 4) the leader's fleet view joins the story over live HTTP
+            fleet = FleetView([f"http://127.0.0.1:{port_b}"],
+                              obs=obs_a, ha=co_a)
+            api_a.attach_fleet(fleet)
+            tick = fleet.poll_once()
+            assert tick["peers_reachable"] == 1
+
+            code, story = _req(port_a, "GET", f"/debug/story/{uid}")
+            assert code == 200, story
+            entries = story["entries"]
+            keys = [(e["epoch"], e["seq"], e["t"], e["source"])
+                    for e in entries]
+            assert keys == sorted(keys)  # the (epoch, seq, t) contract
+            follower_src = f"http://127.0.0.1:{port_b}"
+            verbs = {
+                (e["source"], e["record"].get("verb"))
+                for e in entries if e["kind"] == "trace"
+            }
+            # the follower's read-plane trails AND its delta trail
+            assert (follower_src, "filter") in verbs
+            assert (follower_src, "priorities") in verbs
+            assert (follower_src, "ha:bound") in verbs
+            # the leader's bind trail, stamped at its log head
+            assert ("local", "bind") in verbs
+            bind_entry = [e for e in entries
+                          if e["record"].get("verb") == "bind"][0]
+            assert bind_entry["role"] == "active"
+            assert bind_entry["epoch"] == 1 and bind_entry["seq"] >= 1
+            # the follower's filter trail carries the wire context
+            filt = [e for e in entries
+                    if e["record"].get("verb") == "filter"][0]
+            events = [(k, d) for _, k, d in filt["record"]["events"]]
+            assert ("ctx", ctx) in events
+            # follower-served reads precede the leader's decision
+            assert entries.index(filt) < entries.index(bind_entry)
+            # and the leader's decision cycle rides along
+            assert any(e["kind"] == "decision" for e in entries)
+
+            # 5) a recovery-plane migration appends to the SAME story
+            plane = RecoveryPlane(dealer_a, obs=obs_a)
+            fresh = client.get_pod("default", "story-0")
+            target = next(n for n in dealer_a.node_names()
+                          if n != fresh.node_name)
+            assert plane._migrate(fresh, target, []) is not None
+            code, story2 = _req(port_a, "GET", f"/debug/story/{uid}")
+            assert code == 200
+            assert story2["count"] > story["count"]
+            outcomes = [e["record"].get("outcome")
+                        for e in story2["entries"]
+                        if e["kind"] == "decision"]
+            assert "migrated" in outcomes
+        finally:
+            srv_a.shutdown()
+            srv_b.shutdown()
+            dealer_a.close()
+            dealer_b.close()

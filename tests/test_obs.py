@@ -386,15 +386,53 @@ class TestGoldenDebugSchema:
         )
         api.attach_shadow(scorer)
         scorer.sample(Demand(percents=(25,)))  # populate records[]
+        from nanotpu.obs.fleet import FleetView
+
+        def _peer_fetch(base, path):
+            # one canned, fully-populated peer so the fleet/story shapes
+            # cover the follower row and a cross-process story entry
+            if path.startswith("/debug/ha"):
+                return {
+                    "role": "follower", "lag_events": 1,
+                    "follower": {"synced": True, "reads_refused": 0},
+                    "fence": {"epoch": 2},
+                }
+            if path.startswith("/debug/timeline"):
+                return {"latest": 3, "count": 0, "ticks": []}
+            if path.startswith("/debug/shadow"):
+                return {"divergences": 1}
+            if path.startswith("/debug/traces/"):
+                return {
+                    "role": "follower",
+                    "traces": [{
+                        "uid": uid, "verb": "filter", "t0": 0.5,
+                        "events": [],
+                        "origin": {"role": "follower", "epoch": 1,
+                                   "seq": 4},
+                    }],
+                    "decisions": [],
+                }
+            return None
+
+        fleet = FleetView(
+            ["http://peer-0:10250"], obs=api.obs, timeline=timeline,
+            shadow=scorer, fetch=_peer_fetch, clock=lambda: 1.0,
+        )
+        api.attach_fleet(fleet)
+        fleet.poll_once()
         _, _, traces = api.dispatch("GET", f"/debug/traces/{uid}", b"")
         _, _, decisions = api.dispatch("GET", "/debug/decisions?limit=5", b"")
         _, _, tl = api.dispatch("GET", "/debug/timeline?limit=5", b"")
         _, _, shadow = api.dispatch("GET", "/debug/shadow?limit=5", b"")
+        _, _, fleet_body = api.dispatch("GET", "/debug/fleet?since=0", b"")
+        _, _, story = api.dispatch("GET", f"/debug/story/{uid}", b"")
         return {
             "debug_traces": self._shape(json.loads(traces)),
             "debug_decisions": self._shape(json.loads(decisions)),
             "debug_shadow": self._shape(json.loads(shadow)),
             "debug_timeline": self._shape(json.loads(tl)),
+            "debug_fleet": self._shape(json.loads(fleet_body)),
+            "debug_story": self._shape(json.loads(story)),
         }
 
     def test_debug_json_matches_golden_schema(self, request):

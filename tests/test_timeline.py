@@ -545,10 +545,50 @@ class TestFlightRecorder:
         dealer.close()
         # a half-dead stack still yields a complete, honest bundle:
         # live taps answer, a broken tap degrades to an error marker
+
+        class _DeadHA:
+            role = "active"
+
+            def status(self, now=None):
+                raise RuntimeError("coordinator torn down")
+
+        rec.ha = _DeadHA()
         dealer.shard_status = None  # simulate a torn-down attribute
         bundle = rec.bundle("dealer_death")
         assert "error" in bundle["shards"]
+        assert "error" in bundle["ha"]  # self-guarded like every tap
         assert bundle["ticks"] and bundle["decisions"]
+
+    def test_bundle_ha_shadow_sections_present_only_when_attached(self):
+        rec, _, _ = self._recorder()
+        bundle = rec.bundle("slo:floor")
+        # single-replica bundles: the keys are ABSENT, not null — the
+        # sim's pinned flight digests depend on it
+        assert "ha" not in bundle
+        assert "follower" not in bundle
+        assert "shadow" not in bundle
+
+        class _HA:
+            role = "follower"
+
+            def status(self, now=None):
+                return {"role": "follower", "lag_events": 3}
+
+            def follower_gauge_values(self, now=None):
+                return {"synced": 1, "reads_refused": 2}
+
+        class _Shadow:
+            @staticmethod
+            def status():
+                return {"divergences": 5}
+
+        rec.ha = _HA()
+        rec.shadow = _Shadow()
+        bundle = rec.bundle("slo:floor")
+        assert bundle["ha"]["lag_events"] == 3
+        # follower role: the read-plane gauge block rides along
+        assert bundle["follower"]["reads_refused"] == 2
+        assert bundle["shadow"]["divergences"] == 5
 
     def test_atexit_hook_dumps_on_process_exit(self, tmp_path):
         # a real interpreter exit (the only honest way to test atexit)
@@ -629,6 +669,8 @@ _DEBUG_PATHS = {
     "/debug/ha": "/debug/ha?since=0",
     "/debug/shadow": "/debug/shadow",
     "/debug/verify": "/debug/verify",
+    "/debug/fleet": "/debug/fleet",
+    "/debug/story/": "/debug/story/some-uid",
 }
 
 
